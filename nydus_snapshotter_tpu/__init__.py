@@ -1,0 +1,31 @@
+"""nydus_snapshotter_tpu — a TPU-native re-design of the Nydus snapshotter stack.
+
+A brand-new framework with the capabilities of containerd/nydus-snapshotter
+(reference surveyed in /root/repo/SURVEY.md): a containerd remote-snapshotter
+control plane plus the OCI→RAFS image conversion surface, with the conversion
+hot path (content-defined chunking, chunk digesting, cross-image dedup) running
+as a JAX/XLA data plane on TPU instead of the reference's external Rust
+``nydus-image`` binary.
+
+Layout (tpu-first, not a port of the reference's Go package tree):
+
+- ``models/``    on-disk/on-wire data models: RAFS bootstraps, nydus-tar
+                 framing, TOC entries, eStargz TOC, OCI media types.
+- ``ops/``       JAX/Pallas compute kernels: gear rolling hash, CDC cut-point
+                 resolution, SHA-256 lanes, dict probes.
+- ``parallel/``  mesh construction, sharded HBM chunk-dict, host<->device
+                 streaming pipeline, multi-host coordination.
+- ``converter/`` the Pack/Merge/Unpack public surface (reference
+                 pkg/converter) backed by the TPU engine.
+- ``snapshot/``  containerd-snapshotter control plane (reference snapshot/).
+- ``daemon/`` ``manager/`` ``supervisor/``  daemon lifecycle, liveness
+                 monitoring, fd-passing failover (reference pkg/{daemon,
+                 manager,supervisor}).
+- ``store/``     persistence (reference pkg/store bbolt database).
+- ``config/``    layered TOML config + daemon config templates.
+- ``utils/``     retry, transport, mount/erofs helpers, signals.
+"""
+
+__version__ = "0.1.0"
+
+from nydus_snapshotter_tpu import constants  # noqa: F401

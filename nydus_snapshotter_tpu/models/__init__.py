@@ -1,0 +1,1 @@
+"""On-disk / on-wire data models: RAFS bootstraps, nydus-tar framing, TOC."""

@@ -1,0 +1,332 @@
+"""Format core tests: layout sniffing, TOC entries, tar framing, bootstraps.
+
+Modeled on the reference's format-level assertions (pkg/layout/layout.go
+version detection, pkg/converter/types.go TOCEntry geometry, and the
+bit-exactness bar of tests/converter_test.go:380-530).
+"""
+
+import hashlib
+import io
+import struct
+
+import pytest
+
+from nydus_snapshotter_tpu import constants
+from nydus_snapshotter_tpu.models import layout, nydus_tar, toc
+from nydus_snapshotter_tpu.models.bootstrap import (
+    BlobRecord,
+    Bootstrap,
+    ChunkDict,
+    ChunkRecord,
+    Inode,
+    parse_chunk_dict_arg,
+)
+
+
+def sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+# ---------------------------------------------------------------------------
+# layout
+# ---------------------------------------------------------------------------
+
+
+class TestLayout:
+    def test_v5_magic(self):
+        buf = struct.pack("<II", layout.RAFS_V5_SUPER_MAGIC, layout.RAFS_V5_SUPER_VERSION)
+        assert layout.detect_fs_version(buf) == "v5"
+
+    def test_v6_magic(self):
+        buf = bytearray(layout.RAFS_V6_SUPER_BLOCK_SIZE)
+        struct.pack_into("<I", buf, 1024, layout.RAFS_V6_SUPER_MAGIC)
+        assert layout.detect_fs_version(bytes(buf)) == "v6"
+
+    def test_unknown(self):
+        with pytest.raises(layout.LayoutError):
+            layout.detect_fs_version(b"\x00" * 4096)
+
+    def test_short_buffer(self):
+        with pytest.raises(layout.LayoutError):
+            layout.detect_fs_version(b"\x00" * 4)
+
+
+# ---------------------------------------------------------------------------
+# TOC
+# ---------------------------------------------------------------------------
+
+
+class TestTOC:
+    def test_entry_is_128_bytes(self):
+        e = toc.TOCEntry(name="blob.data", flags=constants.COMPRESSOR_ZSTD)
+        assert len(e.pack()) == 128
+
+    def test_roundtrip(self):
+        e = toc.TOCEntry(
+            name="blob.meta",
+            flags=constants.COMPRESSOR_NONE,
+            uncompressed_digest=sha256(b"hello"),
+            compressed_offset=1234,
+            compressed_size=999,
+            uncompressed_size=4096,
+        )
+        got = toc.TOCEntry.unpack(e.pack())
+        assert got == e
+
+    def test_field_offsets_match_reference_struct(self):
+        # Go struct offsets (pkg/converter/types.go:147-162): Flags@0,
+        # Name@8, Digest@24, CompressedOffset@56, CompressedSize@64,
+        # UncompressedSize@72.
+        e = toc.TOCEntry(
+            name="image.boot",
+            flags=0xABCD,
+            uncompressed_digest=bytes(range(32)),
+            compressed_offset=0x1122334455667788,
+            compressed_size=0x99,
+            uncompressed_size=0xAA,
+        )
+        raw = e.pack()
+        assert struct.unpack_from("<I", raw, 0)[0] == 0xABCD
+        assert raw[8:18] == b"image.boot"
+        assert raw[24:56] == bytes(range(32))
+        assert struct.unpack_from("<Q", raw, 56)[0] == 0x1122334455667788
+        assert struct.unpack_from("<Q", raw, 64)[0] == 0x99
+        assert struct.unpack_from("<Q", raw, 72)[0] == 0xAA
+
+    def test_compressor(self):
+        assert (
+            toc.TOCEntry(name="x", flags=constants.COMPRESSOR_ZSTD).compressor()
+            == constants.COMPRESSOR_ZSTD
+        )
+        with pytest.raises(toc.TOCError):
+            toc.TOCEntry(name="x", flags=0x8).compressor()
+
+    def test_multi_entry_toc(self):
+        entries = [toc.TOCEntry(name=f"e{i}") for i in range(3)]
+        buf = toc.pack_toc(entries)
+        assert toc.unpack_toc(buf) == entries
+
+
+# ---------------------------------------------------------------------------
+# nydus tar framing
+# ---------------------------------------------------------------------------
+
+
+class TestTarFraming:
+    def test_data_before_header_unpadded(self):
+        # Reference framing (convert_unix.go:162-218): header sits exactly
+        # hdr.size bytes after the data start, no padding.
+        blob = nydus_tar.pack_entries([("image.blob", b"x" * 100)])
+        assert len(blob) == 100 + 512
+        assert blob[:100] == b"x" * 100
+        info = nydus_tar.parse_header(blob[100:612])
+        assert info is not None and info.name == "image.blob" and info.size == 100
+
+    def test_large_entry_header(self):
+        # >= 8 GiB sections fall back to GNU base-256 size encoding but stay
+        # a single 512-byte header block.
+        hdr = nydus_tar.make_header("image.blob", 2**33 + 5)
+        assert len(hdr) == 512
+        info = nydus_tar.parse_header(hdr)
+        assert info is not None and info.size == 2**33 + 5
+
+    def test_seek_by_tar_header(self):
+        blob = nydus_tar.pack_entries(
+            [("image.blob", b"A" * 1000), ("image.boot", b"B" * 700)]
+        )
+        f = io.BytesIO(blob)
+        off, size = nydus_tar.seek_file_by_tar_header(f, len(blob), "image.blob")
+        assert blob[off : off + size] == b"A" * 1000
+        off, size = nydus_tar.seek_file_by_tar_header(f, len(blob), "image.boot")
+        assert blob[off : off + size] == b"B" * 700
+        assert nydus_tar.seek_file_by_tar_header(f, len(blob), "missing") is None
+
+    def test_corrupt_header_raises(self):
+        # Reference propagates tar-parse errors (convert_unix.go:181-185)
+        # instead of reporting "not found".
+        blob = bytearray(nydus_tar.pack_entries([("image.blob", b"z" * 100)]))
+        blob[-100:] = b"\xff" * 100
+        with pytest.raises(nydus_tar.TarFramingError):
+            nydus_tar.seek_file_by_tar_header(io.BytesIO(bytes(blob)), len(blob), "image.blob")
+
+    def test_seek_by_toc(self):
+        data = b"D" * 300
+        entries = [
+            toc.TOCEntry(
+                name="image.blob",
+                flags=constants.COMPRESSOR_NONE,
+                uncompressed_digest=sha256(data),
+                compressed_offset=0,
+                compressed_size=len(data),
+                uncompressed_size=len(data),
+            )
+        ]
+        blob = nydus_tar.pack_entries(
+            [("image.blob", data), (toc.ENTRY_BLOB_TOC, toc.pack_toc(entries))]
+        )
+        f = io.BytesIO(blob)
+        got = nydus_tar.read_toc(f, len(blob))
+        assert got == entries
+        off, size = nydus_tar.seek_file_by_toc(f, len(blob), "image.blob")
+        assert blob[off : off + size] == data
+
+    def test_deterministic(self):
+        a = nydus_tar.pack_entries([("image.blob", b"abc")])
+        b = nydus_tar.pack_entries([("image.blob", b"abc")])
+        assert a == b
+
+
+# ---------------------------------------------------------------------------
+# bootstrap
+# ---------------------------------------------------------------------------
+
+
+def _sample_bootstrap(version: str) -> Bootstrap:
+    data1, data2 = b"a" * 5000, b"b" * 3000
+    chunks = [
+        ChunkRecord(
+            digest=sha256(data1),
+            blob_index=0,
+            uncompressed_offset=0,
+            uncompressed_size=len(data1),
+            compressed_offset=0,
+            compressed_size=len(data1),
+        ),
+        ChunkRecord(
+            digest=sha256(data2),
+            blob_index=0,
+            uncompressed_offset=len(data1),
+            uncompressed_size=len(data2),
+            compressed_offset=len(data1),
+            compressed_size=len(data2),
+        ),
+    ]
+    blobs = [
+        BlobRecord(
+            blob_id=hashlib.sha256(data1 + data2).hexdigest(),
+            compressed_size=8000,
+            uncompressed_size=8000,
+            chunk_count=2,
+        )
+    ]
+    inodes = [
+        Inode(path="/", mode=0o40755),
+        Inode(path="/etc", mode=0o40755, xattrs={"user.k": b"v"}),
+        Inode(path="/etc/hosts", mode=0o100644, size=8000, chunk_index=0, chunk_count=2),
+        Inode(path="/bin", mode=0o40755),
+        Inode(path="/bin/sh", mode=0o120777, symlink_target="/bin/busybox"),
+    ]
+    return Bootstrap(version=version, chunk_size=0x100000, inodes=inodes, chunks=chunks, blobs=blobs)
+
+
+class TestBootstrap:
+    @pytest.mark.parametrize("version", ["v5", "v6"])
+    def test_roundtrip(self, version):
+        bs = _sample_bootstrap(version)
+        buf = bs.to_bytes()
+        assert layout.detect_fs_version(buf) == version
+        got = Bootstrap.from_bytes(buf)
+        assert got.version == version
+        assert got.chunk_size == bs.chunk_size
+        assert [i.path for i in got.inodes] == ["/", "/bin", "/bin/sh", "/etc", "/etc/hosts"]
+        by_path = got.inode_by_path()
+        assert by_path["/etc/hosts"].chunk_count == 2
+        assert by_path["/bin/sh"].symlink_target == "/bin/busybox"
+        assert by_path["/etc"].xattrs == {"user.k": b"v"}
+        assert got.chunks == bs.chunks
+        assert got.blobs == bs.blobs
+
+    def test_deterministic_emission(self):
+        a = _sample_bootstrap("v6").to_bytes()
+        b = _sample_bootstrap("v6").to_bytes()
+        assert a == b
+
+    def test_inode_order_independent(self):
+        bs = _sample_bootstrap("v6")
+        shuffled = Bootstrap(
+            version="v6",
+            chunk_size=bs.chunk_size,
+            inodes=list(reversed(bs.inodes)),
+            chunks=bs.chunks,
+            blobs=bs.blobs,
+        )
+        assert shuffled.to_bytes() == bs.to_bytes()
+
+    def test_digests_u32_shape(self):
+        bs = _sample_bootstrap("v6")
+        arr = bs.chunk_digests_u32()
+        assert arr.shape == (2, 8)
+        assert arr.dtype.name == "uint32"
+        assert arr.tobytes() == bs.chunks[0].digest + bs.chunks[1].digest
+
+    def test_referenced_blob_ids(self):
+        bs = _sample_bootstrap("v6")
+        assert bs.referenced_blob_ids() == [bs.blobs[0].blob_id]
+
+    def test_missing_parent_rejected(self):
+        bs = Bootstrap(inodes=[Inode(path="/"), Inode(path="/a/b")])
+        with pytest.raises(Exception):
+            bs.to_bytes()
+
+    def test_hardlink_roundtrip_with_resorting(self):
+        # Hardlinks are path-addressed in the model; serialization resolves
+        # them to final inos even when path sorting renumbers inodes, and a
+        # link may point at a target that sorts after it.
+        from nydus_snapshotter_tpu.models.bootstrap import INODE_FLAG_HARDLINK
+
+        bs = Bootstrap(
+            version="v6",
+            inodes=[
+                Inode(path="/zz-target", mode=0o100644, size=10),
+                Inode(path="/", mode=0o40755),
+                Inode(
+                    path="/aa-link",
+                    mode=0o100644,
+                    flags=INODE_FLAG_HARDLINK,
+                    hardlink_target="/zz-target",
+                ),
+            ],
+        )
+        got = Bootstrap.from_bytes(bs.to_bytes())
+        assert got.inode_by_path()["/aa-link"].hardlink_target == "/zz-target"
+
+    def test_hardlink_dangling_rejected(self):
+        bs = Bootstrap(
+            inodes=[Inode(path="/"), Inode(path="/l", hardlink_target="/gone")]
+        )
+        with pytest.raises(Exception):
+            bs.to_bytes()
+
+
+class TestChunkDict:
+    def test_lookup(self, tmp_path):
+        bs = _sample_bootstrap("v6")
+        p = tmp_path / "dict.boot"
+        p.write_bytes(bs.to_bytes())
+        d = ChunkDict.from_path(str(p))
+        assert len(d) == 2
+        assert sha256(b"a" * 5000) in d
+        assert sha256(b"nope") not in d
+        chunk = d.get(sha256(b"b" * 3000))
+        assert chunk is not None and chunk.uncompressed_size == 3000
+        assert d.blob_id_for(chunk) == bs.blobs[0].blob_id
+        assert d.digests_u32().shape == (2, 8)
+
+    def test_parse_arg(self):
+        assert parse_chunk_dict_arg("bootstrap=/x/y.boot") == "/x/y.boot"
+        assert parse_chunk_dict_arg("/x/y.boot") == "/x/y.boot"
+        # '=' inside a bare path is not a type prefix
+        assert parse_chunk_dict_arg("/data/run=3/dict.boot") == "/data/run=3/dict.boot"
+
+    def test_foreign_bootstrap_rejected(self, tmp_path):
+        # Same v6 magic but garbage superblock fields (e.g. a real
+        # Rust-nydus-image bootstrap) must raise BootstrapError, not crash.
+        from nydus_snapshotter_tpu.models.bootstrap import BootstrapError
+
+        buf = bytearray(4096)
+        struct.pack_into("<I", buf, 1024, layout.RAFS_V6_SUPER_MAGIC)
+        buf[1028:2048] = bytes(
+            (i * 37) % 251 + 1 for i in range(2048 - 1028)
+        )  # garbage fields
+        with pytest.raises(BootstrapError):
+            Bootstrap.from_bytes(bytes(buf))
