@@ -1,0 +1,18 @@
+"""Conversion surface: Pack/Merge/Unpack with the reference's option model.
+
+Public API parity with reference pkg/converter (convert_unix.go:325,560,669;
+types.go:58-145), backed by the TPU chunk/digest engine instead of the
+external ``nydus-image`` binary.
+"""
+
+from nydus_snapshotter_tpu.converter.types import (  # noqa: F401
+    MergeOption,
+    PackOption,
+    UnpackOption,
+)
+from nydus_snapshotter_tpu.converter.convert import (  # noqa: F401
+    Merge,
+    Pack,
+    Unpack,
+    pack_layer,
+)

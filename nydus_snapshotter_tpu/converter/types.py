@@ -1,0 +1,78 @@
+"""Converter option surfaces — semantic parity with reference types.go:58-145."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from nydus_snapshotter_tpu import constants
+from nydus_snapshotter_tpu.models import layout
+
+
+class ConvertError(RuntimeError):
+    pass
+
+
+@dataclass
+class PackOption:
+    """Options for packing one OCI layer tar into a nydus blob.
+
+    Field semantics follow reference PackOption (pkg/converter/types.go:58-90);
+    fields that configured the external builder binary are replaced by engine
+    selection knobs (``backend``, ``chunking``).
+    """
+
+    work_dir: str = ""
+    fs_version: str = layout.RAFS_V6
+    chunk_dict_path: str = ""
+    prefetch_patterns: str = ""
+    compressor: str = "zstd"  # "none" | "zstd" (lz4_block: no codec in env)
+    oci_ref: bool = False
+    aligned_chunk: bool = False
+    chunk_size: int = constants.CHUNK_SIZE_DEFAULT
+    batch_size: int = 0
+    timeout: Optional[float] = None
+    encrypt: bool = False
+    # Engine selection (replaces BuilderPath): jax = TPU data plane,
+    # numpy = host differential path.
+    backend: str = "jax"
+    chunking: str = "cdc"  # "cdc" | "fixed"
+
+    def validate(self) -> None:
+        if self.fs_version not in (layout.RAFS_V5, layout.RAFS_V6):
+            raise ConvertError(f"invalid fs version {self.fs_version!r}")
+        if self.compressor not in ("none", "zstd"):
+            raise ConvertError(f"unsupported compressor {self.compressor!r}")
+        cs = self.chunk_size
+        if cs & (cs - 1) or not (constants.CHUNK_SIZE_MIN <= cs <= constants.CHUNK_SIZE_MAX):
+            raise ConvertError(
+                f"chunk size must be power of two in "
+                f"[{constants.CHUNK_SIZE_MIN:#x}, {constants.CHUNK_SIZE_MAX:#x}]"
+            )
+
+
+@dataclass
+class MergeOption:
+    """Options for merging layer bootstraps into an image bootstrap
+    (reference types.go:92-133)."""
+
+    work_dir: str = ""
+    fs_version: str = layout.RAFS_V6
+    chunk_dict_path: str = ""
+    parent_bootstrap_path: str = ""
+    prefetch_patterns: str = ""
+    with_tar: bool = False
+    oci: bool = False
+    oci_ref: bool = False
+    with_referrer: bool = False
+    timeout: Optional[float] = None
+
+
+@dataclass
+class UnpackOption:
+    """Options for unpacking a nydus blob back to an OCI tar
+    (reference types.go:135-145)."""
+
+    work_dir: str = ""
+    timeout: Optional[float] = None
+    stream: bool = False

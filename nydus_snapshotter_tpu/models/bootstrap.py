@@ -180,10 +180,15 @@ def _unpack_xattrs(buf: bytes) -> dict[str, bytes]:
     out: dict[str, bytes] = {}
     off = 0
     while off < len(buf):
-        klen, vlen = struct.unpack_from("<HI", buf, off)
-        off += 6
-        key = buf[off : off + klen].decode()
+        try:
+            klen, vlen = struct.unpack_from("<HI", buf, off)
+            off += 6
+            key = buf[off : off + klen].decode()
+        except (struct.error, UnicodeDecodeError) as e:
+            raise BootstrapError(f"corrupt xattr region at byte {off}: {e}") from e
         off += klen
+        if off + vlen > len(buf):
+            raise BootstrapError("xattr value overflows its region")
         out[key] = buf[off : off + vlen]
         off += vlen
     return out
@@ -208,6 +213,12 @@ class Bootstrap:
 
         inodes = sorted(self.inodes, key=lambda i: _path_key(i.path))
         ino_by_path = {inode.path: idx + 1 for idx, inode in enumerate(inodes)}
+        if len(ino_by_path) != len(inodes):
+            seen: set[str] = set()
+            for inode in inodes:
+                if inode.path in seen:
+                    raise BootstrapError(f"duplicate inode path {inode.path!r}")
+                seen.add(inode.path)
 
         heap = bytearray()
         inode_buf = bytearray()
@@ -369,6 +380,18 @@ class Bootstrap:
                 xattr_len,
                 hardlink_ino,
             ) = _INODE_STRUCT.unpack(rec[: _INODE_STRUCT.size])
+            for what, off, ln in (
+                ("name", name_off, name_len),
+                ("symlink", symlink_off, symlink_len),
+                ("xattr", xattr_off, xattr_len),
+            ):
+                if off + ln > heap_size:
+                    raise BootstrapError(
+                        f"inode record {i}: {what} heap ref [{off}, +{ln}] overflows "
+                        f"heap of {heap_size} bytes"
+                    )
+            if name_len == 0:
+                raise BootstrapError(f"inode record {i} has an empty name")
             try:
                 name = heap[name_off : name_off + name_len].decode()
                 parent_path = paths_by_ino[parent_ino]
@@ -387,7 +410,9 @@ class Bootstrap:
                     mtime=mtime,
                     size=size,
                     flags=flags,
-                    symlink_target=heap[symlink_off : symlink_off + symlink_len].decode(),
+                    symlink_target=heap[symlink_off : symlink_off + symlink_len].decode(
+                        errors="replace"
+                    ),
                     xattrs=_unpack_xattrs(heap[xattr_off : xattr_off + xattr_len]),
                     chunk_index=chunk_index,
                     chunk_count=cc,
@@ -399,6 +424,10 @@ class Bootstrap:
         # inos are known.
         for inode, hl_ino in zip(inodes, hardlink_inos):
             if hl_ino:
+                if hl_ino not in paths_by_ino:
+                    raise BootstrapError(
+                        f"inode {inode.path!r} hardlinks to unknown ino {hl_ino}"
+                    )
                 inode.hardlink_target = paths_by_ino[hl_ino]
 
         chunks = [

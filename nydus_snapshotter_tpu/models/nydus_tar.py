@@ -89,6 +89,8 @@ def iter_entries_backward(blob: BinaryIO, blob_size: int) -> Iterator[tuple[tarf
             raise TarFramingError(f"entry {info.name!r} overflows blob start")
         yield info, data_offset
         cursor = data_offset
+    if cursor != 0:
+        raise TarFramingError(f"{cursor} residual bytes before first entry")
 
 
 def seek_file_by_tar_header(blob: BinaryIO, blob_size: int, name: str) -> Optional[tuple[int, int]]:

@@ -1,0 +1,1 @@
+"""JAX/Pallas compute kernels: gear rolling hash, CDC, SHA-256, dict probes."""

@@ -1,0 +1,1 @@
+"""Mesh construction, sharded HBM chunk-dict, host<->device pipelines."""

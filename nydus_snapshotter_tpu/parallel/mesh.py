@@ -1,0 +1,47 @@
+"""Device mesh construction for the conversion data plane.
+
+The reference scales conversion by forking one ``nydus-image`` process per
+layer (pkg/converter convert_unix.go:443-539) and distributing across hosts
+behind the registry; the TPU rebuild scales over a ``jax.sharding.Mesh``:
+
+- axis ``data``  — independent layer windows (batch parallelism)
+- axis ``dict``  — shards of the HBM-resident chunk dictionary
+
+Multi-host runs extend the same mesh over DCN via ``jax.distributed`` —
+collectives ride ICI within a slice, DCN across hosts, with no NCCL/MPI-style
+backend to manage.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS_DATA = "data"
+AXIS_DICT = "dict"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over all (or the first n) local devices.
+
+    The dict axis reuses the same devices as the data axis (a 1-D mesh named
+    twice would need distinct axes, so the dictionary shards along the same
+    physical axis — each chip holds one dict shard *and* processes its slice
+    of the window batch).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"requested {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (AXIS_DATA,))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) axis across the data axis."""
+    return NamedSharding(mesh, PartitionSpec(AXIS_DATA))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

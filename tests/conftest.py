@@ -1,16 +1,21 @@
 """Test bootstrap: run JAX on a virtual 8-device CPU mesh.
 
-Must set platform env vars before anything imports jax (multi-chip sharding
-is tested on virtual CPU devices; the one real TPU chip is reserved for
-bench.py).
+Multi-chip sharding is tested on virtual CPU devices; the one real TPU chip
+is reserved for bench.py. The environment pins JAX_PLATFORMS=axon (and a
+site hook re-pins it even if overridden), so the CPU platform must be forced
+through jax.config, not env vars. XLA_FLAGS still must be set before the
+backend initializes.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,143 @@
+"""Chunk-engine tests: differential parity, seam stability, dedup property.
+
+The differential harness mirrors the reference's correctness bar (bit-exact
+chunking/digesting vs the CPU implementation, tests/converter_test.go:515-530):
+the parallel two-phase TPU pipeline must produce exactly the boundaries and
+digests of the byte-sequential oracle.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu.ops import cdc, gear, sha256
+from nydus_snapshotter_tpu.ops.chunker import ChunkDigestEngine
+
+RNG = np.random.default_rng(1234)
+PARAMS = cdc.CDCParams(0x1000)  # 4 KiB average keeps the oracle fast
+
+
+def _corpora():
+    return [
+        ("random", RNG.integers(0, 256, 200_000, dtype=np.uint8).tobytes()),
+        ("zeros", b"\x00" * 120_000),
+        ("periodic", b"hello world " * 15_000),
+        ("low-entropy", RNG.integers(0, 4, 150_000, dtype=np.uint8).tobytes()),
+        ("empty", b""),
+        ("tiny", b"x" * 17),
+        ("min-size", b"y" * PARAMS.min_size),
+        ("max-ish", RNG.integers(0, 256, PARAMS.max_size + 3, dtype=np.uint8).tobytes()),
+    ]
+
+
+class TestGear:
+    def test_table_deterministic(self):
+        t = gear.gear_table()
+        assert t.shape == (256,) and t.dtype == np.uint32
+        # pinned first entry: regenerating anywhere must give identical cuts
+        assert t[0] == np.frombuffer(
+            hashlib.sha256(b"nydus-tpu-gear-v1\x00").digest()[:4], dtype="<u4"
+        )
+
+    def test_np_equals_jax(self):
+        data = RNG.integers(0, 256, 50_000, dtype=np.uint8)
+        assert np.array_equal(gear.gear_hashes_np(data), np.asarray(gear.gear_hashes_jax(data)))
+
+    def test_window_seam_equivalence(self):
+        data = RNG.integers(0, 256, 100_000, dtype=np.uint8)
+        whole = gear.gear_hashes_np(data)
+        parts = []
+        w = 4096
+        for off in range(0, len(data), w):
+            tail = data[max(0, off - 31) : off]
+            tail = np.concatenate([np.zeros(31 - len(tail), np.uint8), tail])
+            parts.append(gear.gear_hashes_np(data[off : off + w], tail))
+        assert np.array_equal(whole, np.concatenate(parts))
+
+
+class TestCDCDifferential:
+    @pytest.mark.parametrize("name,data", _corpora())
+    def test_parallel_equals_sequential(self, name, data):
+        seq = cdc.chunk_sequential_reference(data, PARAMS)
+        par_np = cdc.chunk_data_np(data, PARAMS)
+        par_jax = cdc.chunk_data_jax(data, PARAMS)
+        assert np.array_equal(seq, par_np), name
+        assert np.array_equal(seq, par_jax), name
+
+    def test_size_bounds_hold(self):
+        data = RNG.integers(0, 256, 500_000, dtype=np.uint8).tobytes()
+        cuts = cdc.chunk_data_np(data, PARAMS)
+        sizes = np.diff(np.concatenate([[0], cuts]))
+        assert sizes[:-1].min() >= PARAMS.min_size
+        assert sizes.max() <= PARAMS.max_size
+
+    def test_chunk_size_validation(self):
+        with pytest.raises(cdc.CDCError):
+            cdc.CDCParams(0x1001)  # not a power of two
+        with pytest.raises(cdc.CDCError):
+            cdc.CDCParams(0x800)  # below reference minimum 0x1000
+
+    def test_fixed_chunking(self):
+        cuts = cdc.chunk_fixed(10_000, 4096)
+        assert list(cuts) == [4096, 8192, 10_000]
+        assert list(cdc.chunk_fixed(0, 4096)) == []
+
+
+class TestSHA256:
+    def test_matches_hashlib(self):
+        msgs = [b"", b"abc", b"a" * 55, b"a" * 56, b"a" * 64, b"a" * 65, b"q" * 10_000]
+        got = sha256.sha256_many(msgs)
+        for m, g in zip(msgs, got):
+            assert g == hashlib.sha256(m).digest(), len(m)
+
+    def test_block_capacity_overflow(self):
+        with pytest.raises(ValueError):
+            sha256.pack_messages_np([b"x" * 1000], block_capacity=1)
+
+
+class TestEngine:
+    def test_windowed_equals_whole_stream(self):
+        data = RNG.integers(0, 256, 3_000_000, dtype=np.uint8).tobytes()
+        small_window = ChunkDigestEngine(chunk_size=0x1000, window=1 << 20)
+        whole = ChunkDigestEngine(chunk_size=0x1000, backend="numpy")
+        assert np.array_equal(small_window.boundaries(data), whole.boundaries(data))
+
+    def test_process_digests(self):
+        data = RNG.integers(0, 256, 400_000, dtype=np.uint8).tobytes()
+        metas = ChunkDigestEngine(chunk_size=0x1000).process(data)
+        assert sum(m.size for m in metas) == len(data)
+        for m in metas:
+            assert m.digest == hashlib.sha256(data[m.offset : m.offset + m.size]).digest()
+
+    def test_dedup_property(self):
+        # Two streams sharing a large common middle must share chunk digests
+        # for the common region — the property the chunk-dict dedup relies on.
+        common = RNG.integers(0, 256, 600_000, dtype=np.uint8).tobytes()
+        a = RNG.integers(0, 256, 50_000, dtype=np.uint8).tobytes() + common
+        b = RNG.integers(0, 256, 37_000, dtype=np.uint8).tobytes() + common
+        eng = ChunkDigestEngine(chunk_size=0x1000)
+        da = {m.digest for m in eng.process(a)}
+        db = {m.digest for m in eng.process(b)}
+        shared = len(da & db)
+        # CDC realigns after ~max_size; nearly all common chunks dedup.
+        assert shared >= 0.8 * min(len(da), len(db))
+
+    def test_fixed_mode(self):
+        data = b"z" * 200_000
+        metas = ChunkDigestEngine(chunk_size=0x10000, mode="fixed").process(data)
+        assert [m.size for m in metas] == [0x10000] * 3 + [200_000 - 3 * 0x10000]
+
+    def test_empty_and_tiny(self):
+        eng = ChunkDigestEngine(chunk_size=0x1000)
+        assert eng.process(b"") == []
+        t = eng.process(b"hi")
+        assert len(t) == 1 and t[0].digest == hashlib.sha256(b"hi").digest()
+
+    def test_invalid_args(self):
+        with pytest.raises(ValueError):
+            ChunkDigestEngine(mode="nope")
+        with pytest.raises(ValueError):
+            ChunkDigestEngine(backend="cuda")
+        with pytest.raises(ValueError):
+            ChunkDigestEngine(window=100)

@@ -141,6 +141,12 @@ class TestTarFraming:
         assert blob[off : off + size] == b"B" * 700
         assert nydus_tar.seek_file_by_tar_header(f, len(blob), "missing") is None
 
+    def test_residual_prefix_raises(self):
+        # Junk bytes before the first entry are corruption, not slack.
+        blob = b"\x01" * 100 + nydus_tar.pack_entries([("image.blob", b"z" * 100)])
+        with pytest.raises(nydus_tar.TarFramingError, match="residual"):
+            list(nydus_tar.iter_entries_backward(io.BytesIO(blob), len(blob)))
+
     def test_corrupt_header_raises(self):
         # Reference propagates tar-parse errors (convert_unix.go:181-185)
         # instead of reporting "not found".
@@ -296,6 +302,44 @@ class TestBootstrap:
         )
         with pytest.raises(Exception):
             bs.to_bytes()
+
+    def test_duplicate_paths_rejected(self):
+        from nydus_snapshotter_tpu.models.bootstrap import BootstrapError
+
+        bs = Bootstrap(
+            inodes=[Inode(path="/"), Inode(path="/f", size=1), Inode(path="/f", size=2)]
+        )
+        with pytest.raises(BootstrapError, match="duplicate"):
+            bs.to_bytes()
+
+    @pytest.mark.parametrize(
+        "mutate",
+        [
+            pytest.param(lambda rec: rec.__setitem__(slice(56, 60), (0xFFFF).to_bytes(4, "little")), id="name-off-overflow"),
+            pytest.param(lambda rec: rec.__setitem__(slice(60, 62), (0).to_bytes(2, "little")), id="empty-name"),
+            pytest.param(lambda rec: rec.__setitem__(slice(80, 88), (999).to_bytes(8, "little")), id="dangling-hardlink"),
+        ],
+    )
+    def test_corrupt_inode_record_raises_bootstrap_error(self, mutate):
+        # All corruption must surface as BootstrapError, never raw
+        # KeyError/struct.error/silent garbage. Inode record field offsets
+        # (packed little-endian, no padding): name_off@56(u32),
+        # name_len@60(u16), hardlink_ino@80(u64).
+        from nydus_snapshotter_tpu.models.bootstrap import (
+            BootstrapError,
+            INODE_SIZE,
+            _V6_HEADER_SIZE,
+        )
+
+        bs = _sample_bootstrap("v6")
+        buf = bytearray(bs.to_bytes())
+        # corrupt the second inode record ("/bin")
+        rec_off = _V6_HEADER_SIZE + INODE_SIZE
+        rec = buf[rec_off : rec_off + INODE_SIZE]
+        mutate(rec)
+        buf[rec_off : rec_off + INODE_SIZE] = rec
+        with pytest.raises(BootstrapError):
+            Bootstrap.from_bytes(bytes(buf))
 
 
 class TestChunkDict:
