@@ -1,0 +1,89 @@
+"""Conversion data-plane benchmark on the real TPU chip.
+
+Measures the accel hot path the BASELINE targets (RAFS convert GiB/s/chip):
+content-defined chunking + SHA-256 chunk digesting + chunk-dict dedup probe
+over a synthetic layer corpus (mixed random/duplicated content, like the
+reference smoke corpus, tests/converter_test.go:177-225).
+
+Prints ONE JSON line: metric, value (GiB/s on this chip), unit, vs_baseline
+(fraction of the 2.5 GiB/s per-chip share of the 20 GiB/s v5e-8 target).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+PER_CHIP_TARGET_GIBPS = 20.0 / 8.0  # north-star 20 GiB/s on a v5e-8
+
+CORPUS_MIB = 192
+CHUNK_SIZE = 0x10000  # 64 KiB average: matches dedup-grade chunking
+N_FILES = 24
+WARMUP_MIB = 16
+
+
+def build_corpus(total_mib: int, n_files: int) -> list[bytes]:
+    rng = np.random.default_rng(42)
+    per = total_mib * (1 << 20) // n_files
+    base = rng.integers(0, 256, per, dtype=np.uint8).tobytes()
+    files = []
+    for i in range(n_files):
+        if i % 3 == 2:
+            files.append(base)  # duplicated content: dedup work is real
+        else:
+            files.append(rng.integers(0, 256, per, dtype=np.uint8).tobytes())
+    return files
+
+
+def main() -> None:
+    from nydus_snapshotter_tpu.ops.chunker import ChunkDigestEngine
+    from nydus_snapshotter_tpu.parallel import mesh as mesh_lib
+    from nydus_snapshotter_tpu.parallel.sharded_dict import ShardedChunkDict
+
+    engine = ChunkDigestEngine(chunk_size=CHUNK_SIZE, mode="cdc", backend="jax")
+    files = build_corpus(CORPUS_MIB, N_FILES)
+    total_bytes = sum(len(f) for f in files)
+
+    # Warm-up: compile every kernel shape on a small slice.
+    warm = build_corpus(WARMUP_MIB, 2)
+    warm_metas = engine.process_many(warm)
+    mesh = mesh_lib.make_mesh(1)
+    dict_digests = np.frombuffer(
+        b"".join(m.digest for metas in warm_metas for m in metas), dtype="<u4"
+    ).reshape(-1, 8)
+    sdict = ShardedChunkDict(dict_digests, mesh)
+    sdict.lookup_u32(dict_digests[: max(1, len(dict_digests) // 2)])
+
+    t0 = time.time()
+    metas = engine.process_many(files)
+    all_digests = [m.digest for file_metas in metas for m in file_metas]
+    hits = sdict.lookup_digests(all_digests)
+    elapsed = time.time() - t0
+
+    n_chunks = len(all_digests)
+    gibps = total_bytes / elapsed / (1 << 30)
+    print(
+        json.dumps(
+            {
+                "metric": "rafs_convert_throughput_per_chip",
+                "value": round(gibps, 4),
+                "unit": "GiB/s",
+                "vs_baseline": round(gibps / PER_CHIP_TARGET_GIBPS, 4),
+                "detail": {
+                    "corpus_mib": CORPUS_MIB,
+                    "chunk_size": CHUNK_SIZE,
+                    "n_chunks": n_chunks,
+                    "dict_probes": int(len(hits)),
+                    "elapsed_s": round(elapsed, 2),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    main()

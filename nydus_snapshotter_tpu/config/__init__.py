@@ -1,0 +1,9 @@
+"""Layered configuration: defaults ← TOML ← CLI, validated then frozen."""
+
+from nydus_snapshotter_tpu.config.config import (  # noqa: F401
+    SnapshotterConfig,
+    ConfigError,
+    load_config,
+    set_global_config,
+    get_global_config,
+)

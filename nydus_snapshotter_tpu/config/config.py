@@ -1,0 +1,241 @@
+"""Snapshotter configuration system.
+
+Reference behavior (config/config.go:223-399, internal/constant/values.go):
+a versioned TOML file with per-subsystem sections, deep-merged over defaults,
+overridden by CLI parameters, validated (including the unix(7) sun_path
+limit on the root path), then frozen behind package-global accessors.
+
+Implemented as nested dataclasses + dict deep-merge: ``load_config`` is the
+one entry point (defaults ← TOML ← overrides → validate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from nydus_snapshotter_tpu import constants
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class SystemConfig:
+    enable: bool = True
+    address: str = constants.DEFAULT_SYSTEM_CONTROLLER_ADDRESS
+    # pprof-equivalent debug profiler endpoint (reference DebugConfig)
+    debug_profile_duration_secs: int = 5
+    debug_pprof_address: str = ""
+
+
+@dataclass
+class MetricsConfig:
+    address: str = constants.DEFAULT_METRICS_ADDRESS
+
+
+@dataclass
+class DaemonConfig:
+    nydusd_path: str = ""
+    nydusd_config_path: str = "/etc/nydus/nydusd-config.json"
+    recover_policy: str = constants.RECOVER_POLICY_RESTART
+    fs_driver: str = constants.DEFAULT_FS_DRIVER
+    threads_number: int = 4
+    log_rotation_size: int = 100  # MiB
+    # TPU sidecar (conversion data plane) settings
+    accel_enable: bool = True
+    accel_chunk_size: int = constants.CHUNK_SIZE_DEFAULT
+    accel_backend: str = "jax"
+
+
+@dataclass
+class CgroupConfig:
+    enable: bool = False
+    memory_limit: str = ""
+
+
+@dataclass
+class LoggingConfig:
+    log_level: str = constants.DEFAULT_LOG_LEVEL
+    log_dir: str = ""
+    log_to_stdout: bool = True
+    rotate_log_max_size: int = 200  # MiB
+    rotate_log_max_backups: int = 5
+    rotate_log_max_age: int = 0
+    rotate_log_compress: bool = True
+
+
+@dataclass
+class MirrorConfig:
+    host: str = ""
+    headers: dict[str, str] = field(default_factory=dict)
+    health_check_interval: int = 5
+    failure_limit: int = 5
+    ping_url: str = ""
+
+
+@dataclass
+class RemoteConfig:
+    convert_vpc_registry: bool = False
+    skip_ssl_verify: bool = False
+    mirrors_config_dir: str = ""
+    auth_config_path: str = ""
+
+
+@dataclass
+class SnapshotConfig:
+    enable_nydus_overlayfs: bool = False
+    nydus_overlayfs_path: str = "nydus-overlayfs"
+    sync_remove: bool = False
+
+
+@dataclass
+class CacheManagerConfig:
+    enable: bool = True
+    gc_period: str = constants.DEFAULT_GC_PERIOD
+    cache_dir: str = ""
+
+
+@dataclass
+class ImageConfig:
+    public_key_file: str = ""
+    check_pause_image: bool = False
+
+
+@dataclass
+class ExperimentalConfig:
+    enable_stargz: bool = False
+    enable_referrer_detect: bool = False
+    tarfs_enable: bool = False
+    tarfs_mount_on_host: bool = False
+    tarfs_export_mode: str = ""
+    tarfs_max_concurrent_proc: int = 4
+
+
+@dataclass
+class SnapshotterConfig:
+    """Top-level config: the 11 sections of the reference TOML."""
+
+    version: int = 1
+    root: str = constants.DEFAULT_ROOT_DIR
+    address: str = constants.DEFAULT_ADDRESS
+    daemon_mode: str = constants.DEFAULT_DAEMON_MODE
+    cleanup_on_close: bool = False
+
+    system: SystemConfig = field(default_factory=SystemConfig)
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    daemon: DaemonConfig = field(default_factory=DaemonConfig)
+    cgroup: CgroupConfig = field(default_factory=CgroupConfig)
+    log: LoggingConfig = field(default_factory=LoggingConfig)
+    remote: RemoteConfig = field(default_factory=RemoteConfig)
+    snapshot: SnapshotConfig = field(default_factory=SnapshotConfig)
+    cache_manager: CacheManagerConfig = field(default_factory=CacheManagerConfig)
+    image: ImageConfig = field(default_factory=ImageConfig)
+    experimental: ExperimentalConfig = field(default_factory=ExperimentalConfig)
+
+    # -- derived paths (reference config/global.go accessors) ---------------
+
+    @property
+    def socket_root(self) -> str:
+        return os.path.join(self.root, "socket")
+
+    @property
+    def config_root(self) -> str:
+        return os.path.join(self.root, "config")
+
+    @property
+    def cache_root(self) -> str:
+        return self.cache_manager.cache_dir or os.path.join(self.root, "cache")
+
+    @property
+    def snapshots_root(self) -> str:
+        return os.path.join(self.root, "snapshots")
+
+    @property
+    def database_path(self) -> str:
+        return os.path.join(self.root, "nydus.db")
+
+    def validate(self) -> None:
+        if self.version != 1:
+            raise ConfigError(f"unsupported config version {self.version} (expect 1)")
+        # unix(7) sun_path is 108 bytes; the reference enforces root < 70 so
+        # per-daemon socket paths still fit (config.go:50-59).
+        if len(self.root) > constants.MAX_ROOT_PATH_LEN:
+            raise ConfigError(
+                f"root path {self.root!r} is longer than {constants.MAX_ROOT_PATH_LEN} bytes"
+            )
+        if not os.path.isabs(self.root):
+            raise ConfigError("root path must be absolute")
+        if self.daemon_mode not in (
+            constants.DAEMON_MODE_SHARED,
+            constants.DAEMON_MODE_DEDICATED,
+            constants.DAEMON_MODE_NONE,
+        ):
+            raise ConfigError(f"invalid daemon mode {self.daemon_mode!r}")
+        if self.daemon.fs_driver not in constants.FS_DRIVERS:
+            raise ConfigError(f"invalid fs driver {self.daemon.fs_driver!r}")
+        if self.daemon.recover_policy not in (
+            constants.RECOVER_POLICY_NONE,
+            constants.RECOVER_POLICY_RESTART,
+            constants.RECOVER_POLICY_FAILOVER,
+        ):
+            raise ConfigError(f"invalid recover policy {self.daemon.recover_policy!r}")
+        if self.daemon.fs_driver in (constants.FS_DRIVER_BLOCKDEV, constants.FS_DRIVER_PROXY):
+            # Proxy/blockdev modes run without nydusd daemons
+            # (reference config.go:300-311 forces daemon_mode none).
+            self.daemon_mode = constants.DAEMON_MODE_NONE
+
+
+def _merge_into_dataclass(obj: Any, data: dict[str, Any], path: str = "") -> None:
+    fields = {f.name: f for f in dataclasses.fields(obj)}
+    for key, value in data.items():
+        if key not in fields:
+            raise ConfigError(f"unknown config key {path + key!r}")
+        cur = getattr(obj, key)
+        if dataclasses.is_dataclass(cur) and isinstance(value, dict):
+            _merge_into_dataclass(cur, value, path=f"{path}{key}.")
+        else:
+            if cur is not None and value is not None and not isinstance(value, type(cur)):
+                # tolerate int-for-bool style TOML looseness only for numbers
+                if not (isinstance(cur, bool) is isinstance(value, bool) and isinstance(value, (int, float, str, list, dict))):
+                    raise ConfigError(
+                        f"config key {path + key!r}: expected {type(cur).__name__}, "
+                        f"got {type(value).__name__}"
+                    )
+            setattr(obj, key, value)
+
+
+def load_config(
+    path: Optional[str] = None,
+    overrides: Optional[dict[str, Any]] = None,
+) -> SnapshotterConfig:
+    """defaults ← TOML file ← CLI overrides → validate."""
+    cfg = SnapshotterConfig()
+    if path:
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        _merge_into_dataclass(cfg, data)
+    if overrides:
+        _merge_into_dataclass(cfg, overrides)
+    cfg.validate()
+    return cfg
+
+
+# -- frozen global accessor (reference config/global.go:24-221) -------------
+
+_global: Optional[SnapshotterConfig] = None
+
+
+def set_global_config(cfg: SnapshotterConfig) -> None:
+    global _global
+    _global = cfg
+
+
+def get_global_config() -> SnapshotterConfig:
+    if _global is None:
+        raise ConfigError("global config not initialized")
+    return _global

@@ -54,13 +54,13 @@ class MergeResult:
     blob_digests: list[str]  # referenced blob ids after dedup, table order
 
 
-def _compress_chunk(data: bytes, compressor: str) -> tuple[bytes, int]:
+def _make_compressor(compressor: str):
+    """One reusable codec per Pack — a fresh zstd context per chunk costs
+    allocation/init for every one of the thousands of chunks in a layer."""
     if compressor == "zstd":
-        return (
-            zstandard.ZstdCompressor(level=_ZSTD_LEVEL).compress(data),
-            constants.COMPRESSOR_ZSTD,
-        )
-    return data, constants.COMPRESSOR_NONE
+        ctx = zstandard.ZstdCompressor(level=_ZSTD_LEVEL)
+        return lambda data: (ctx.compress(data), constants.COMPRESSOR_ZSTD)
+    return lambda data: (data, constants.COMPRESSOR_NONE)
 
 
 def _decompress_chunk(data: bytes, flags: int, expect_size: int) -> bytes:
@@ -133,13 +133,14 @@ def Pack(dest: BinaryIO, src_tar: BinaryIO | bytes, opt: PackOption) -> PackResu
 
     # Compress unique chunks, lay out the blob data section.
     align = 4096 if (opt.aligned_chunk and opt.fs_version == layout.RAFS_V5) else 1
+    compress = _make_compressor(opt.compressor)
     blob_parts: list[bytes] = []
     comp_extents: list[tuple[int, int, int]] = []  # (offset, csize, flags)
     uncomp_offsets: list[int] = []
     coff = 0
     uoff = 0
     for data in unique_data:
-        comp, cflag = _compress_chunk(data, opt.compressor)
+        comp, cflag = compress(data)
         pad = (-coff) % align
         if pad:
             blob_parts.append(b"\x00" * pad)
@@ -150,7 +151,8 @@ def Pack(dest: BinaryIO, src_tar: BinaryIO | bytes, opt: PackOption) -> PackResu
         coff += len(comp)
         uoff += len(data)
     blob_data = b"".join(blob_parts)
-    blob_id = hashlib.sha256(blob_data).hexdigest() if blob_data else ""
+    blob_sha = hashlib.sha256(blob_data) if blob_data else None
+    blob_id = blob_sha.hexdigest() if blob_sha else ""
 
     # Blob table: own blob first (if it stores anything), then dict blobs.
     blob_table: list[BlobRecord] = []
@@ -231,7 +233,7 @@ def Pack(dest: BinaryIO, src_tar: BinaryIO | bytes, opt: PackOption) -> PackResu
             toc.TOCEntry(
                 name=toc.ENTRY_BLOB_DATA,
                 flags=constants.COMPRESSOR_NONE,
-                uncompressed_digest=hashlib.sha256(blob_data).digest(),
+                uncompressed_digest=blob_sha.digest(),
                 compressed_size=len(blob_data),
                 uncompressed_size=len(blob_data),
             )

@@ -57,7 +57,8 @@ class MergeOption:
     (reference types.go:92-133)."""
 
     work_dir: str = ""
-    fs_version: str = layout.RAFS_V6
+    # Empty = inherit the version of the top layer (explicit value overrides).
+    fs_version: str = ""
     chunk_dict_path: str = ""
     parent_bootstrap_path: str = ""
     prefetch_patterns: str = ""

@@ -1,0 +1,217 @@
+"""Host-side daemon model (reference pkg/daemon/daemon.go:64-662).
+
+Tracks one daemon process: identity, sockets, config, lifecycle state
+polling, ref-counted RAFS instance attachment, shared mounts through the
+API client, and vestige cleanup after crashes.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from nydus_snapshotter_tpu import constants
+from nydus_snapshotter_tpu.daemon.client import NydusdClient
+from nydus_snapshotter_tpu.daemon.command import DaemonCommand
+from nydus_snapshotter_tpu.daemon.types import DaemonState
+from nydus_snapshotter_tpu.rafs.rafs import Rafs, RafsCache
+from nydus_snapshotter_tpu.utils import errdefs
+
+SHARED_DAEMON_ID = "shared_daemon"
+
+
+@dataclass
+class ConfigState:
+    """Persisted daemon identity/config (reference daemon.go ConfigState)."""
+
+    daemon_id: str
+    fs_driver: str = constants.FS_DRIVER_FUSEDEV
+    daemon_mode: str = constants.DAEMON_MODE_DEDICATED
+    api_socket: str = ""
+    log_file: str = ""
+    workdir: str = ""
+    supervisor_path: str = ""
+    config_path: str = ""
+    process_id: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return dict(self.__dict__)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ConfigState":
+        return cls(**d)
+
+
+class Daemon:
+    def __init__(self, states: ConfigState):
+        self.states = states
+        self.instances = RafsCache()
+        self._proc: Optional[subprocess.Popen] = None
+        self._client: Optional[NydusdClient] = None
+
+    # -- identity -----------------------------------------------------------
+
+    @property
+    def id(self) -> str:
+        return self.states.daemon_id
+
+    @property
+    def pid(self) -> int:
+        if self._proc is not None:
+            return self._proc.pid
+        return self.states.process_id
+
+    def client(self) -> NydusdClient:
+        if self._client is None:
+            self._client = NydusdClient(self.states.api_socket)
+        return self._client
+
+    def is_shared(self) -> bool:
+        return self.states.daemon_mode == constants.DAEMON_MODE_SHARED
+
+    # -- process ------------------------------------------------------------
+
+    def command(self, upgrade: bool = False) -> DaemonCommand:
+        return DaemonCommand(
+            id=self.id,
+            apisock=self.states.api_socket,
+            supervisor=self.states.supervisor_path,
+            workdir=self.states.workdir,
+            log_file=self.states.log_file,
+            upgrade=upgrade,
+        )
+
+    def spawn(self, upgrade: bool = False) -> int:
+        argv = self.command(upgrade=upgrade).build()
+        # The daemon runs `-m nydus_snapshotter_tpu.daemon.server`; make sure
+        # the package root is importable regardless of the caller's cwd.
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        self._proc = subprocess.Popen(
+            argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env
+        )
+        self.states.process_id = self._proc.pid
+        return self._proc.pid
+
+    def terminate(self) -> None:
+        pid = self.pid
+        if pid:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+
+    def wait(self, timeout: float = 10.0) -> None:
+        if self._proc is not None:
+            try:
+                self._proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait(timeout=5)
+        else:
+            deadline = time.time() + timeout
+            while time.time() < deadline and _pid_alive(self.states.process_id):
+                time.sleep(0.05)
+
+    # -- state machine ------------------------------------------------------
+
+    def state(self) -> DaemonState:
+        try:
+            info = self.client().get_daemon_info()
+            return DaemonState(info.get("state", DaemonState.UNKNOWN.value))
+        except (OSError, errdefs.NydusError, ValueError):
+            return DaemonState.UNKNOWN
+
+    def wait_until_state(self, want: DaemonState, timeout: float = 30.0) -> None:
+        """Poll the API until the daemon reaches `want`
+        (reference daemon.go:197-227)."""
+        deadline = time.time() + timeout
+        last = DaemonState.UNKNOWN
+        while time.time() < deadline:
+            last = self.state()
+            if last == want:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"daemon {self.id} stuck in {last.value}, wanted {want.value}")
+
+    def start(self) -> None:
+        self.client().start()
+
+    def exit(self) -> None:
+        self.client().exit()
+
+    def send_fd(self) -> None:
+        self.client().send_fd(self._fd_driver())
+
+    def takeover(self) -> None:
+        self.client().takeover(self._fd_driver())
+
+    def _fd_driver(self) -> str:
+        return "fscache" if self.states.fs_driver == constants.FS_DRIVER_FSCACHE else "fuse"
+
+    # -- instances ----------------------------------------------------------
+
+    def add_rafs_instance(self, rafs: Rafs) -> None:
+        self.instances.add(rafs)
+
+    def remove_rafs_instance(self, snapshot_id: str) -> None:
+        self.instances.remove(snapshot_id)
+
+    def ref_count(self) -> int:
+        return len(self.instances)
+
+    def shared_mount(self, rafs: Rafs, bootstrap: str, config_json: str) -> None:
+        """Attach one RAFS instance to a running daemon via the API
+        (reference daemon.go:229-273)."""
+        self.client().mount(rafs.relative_mountpoint(), bootstrap, config_json)
+        self.add_rafs_instance(rafs)
+
+    def shared_umount(self, rafs: Rafs) -> None:
+        self.client().umount(rafs.relative_mountpoint())
+        self.remove_rafs_instance(rafs.snapshot_id)
+
+    def recover_rafs_instances(self, instances: list[Rafs], configs: dict[str, str]) -> None:
+        """Replay persisted mounts in seq order after daemon restart
+        (reference daemon.go:618-660)."""
+        for rafs in sorted(instances, key=lambda r: r.seq):
+            bootstrap = rafs.bootstrap_file()
+            self.client().mount(
+                rafs.relative_mountpoint(), bootstrap, configs.get(rafs.snapshot_id, "")
+            )
+            self.add_rafs_instance(rafs)
+
+    # -- cleanup ------------------------------------------------------------
+
+    def clear_vestige(self) -> None:
+        """Remove leftovers of a dead daemon: stale api socket
+        (reference daemon.go:579-605)."""
+        sock = self.states.api_socket
+        if sock and os.path.exists(sock) and not _pid_alive(self.states.process_id):
+            try:
+                os.unlink(sock)
+            except OSError:
+                pass
+
+    def get_daemon_version(self) -> str:
+        info = self.client().get_daemon_info()
+        version = info.get("version", {})
+        return version.get("package_ver", "") if isinstance(version, dict) else str(version)
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True

@@ -1,0 +1,444 @@
+"""The daemon process — this framework's nydusd equivalent.
+
+Serves the reference nydusd HTTP-over-UDS API (surface catalogued at
+pkg/daemon/client.go:31-58): daemon info/state, mount/umount, blob binding,
+metrics (fs/cache/inflight), start/exit, and the supervisor
+sendfd/takeover dance used for failover and live upgrade
+(SURVEY §3.4). The data plane is a userspace read API (stat/list/read on
+mounted RAFS instances, chunks resolved from local blob cache) instead of a
+kernel FUSE session — lazy serving is I/O-bound and out of the TPU north
+star, but the full control surface exists for parity with the reference's
+lifecycle, failover, and upgrade flows.
+
+Run: ``python -m nydus_snapshotter_tpu.daemon.server --id ID --apisock PATH
+[--supervisor PATH] [--upgrade] [--workdir DIR]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import socketserver
+import stat as stat_mod
+import sys
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Optional
+
+from nydus_snapshotter_tpu import constants
+from nydus_snapshotter_tpu.converter.convert import _decompress_chunk
+from nydus_snapshotter_tpu.daemon.types import DaemonState, FsMetrics
+from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
+
+__version__ = "0.1.0"
+
+
+class _Instance:
+    """One mounted RAFS instance."""
+
+    def __init__(self, mountpoint: str, source: str, config_json: str):
+        self.mountpoint = mountpoint
+        self.source = source
+        self.config_json = config_json
+        with open(source, "rb") as f:
+            self.bootstrap = Bootstrap.from_bytes(f.read())
+        self.by_path = self.bootstrap.inode_by_path()
+        self.metrics = FsMetrics()
+
+    def blob_dir(self, default_dir: str) -> str:
+        try:
+            cfg = json.loads(self.config_json) if self.config_json else {}
+        except json.JSONDecodeError:
+            cfg = {}
+        be = ((cfg.get("device") or {}).get("backend") or {}).get("config") or {}
+        return be.get("blob_dir") or default_dir
+
+    def read(self, path: str, offset: int, size: int, blob_dir: str) -> bytes:
+        inode = self.by_path.get(path)
+        if inode is None:
+            raise FileNotFoundError(path)
+        if inode.hardlink_target:
+            inode = self.by_path[inode.hardlink_target]
+        if not stat_mod.S_ISREG(inode.mode):
+            raise IsADirectoryError(path)
+        out = bytearray()
+        pos = 0
+        end = min(offset + size, inode.size) if size >= 0 else inode.size
+        for rec in self.bootstrap.chunks[
+            inode.chunk_index : inode.chunk_index + inode.chunk_count
+        ]:
+            clen = rec.uncompressed_size
+            if pos + clen <= offset:
+                pos += clen
+                continue
+            if pos >= end:
+                break
+            blob_id = self.bootstrap.blobs[rec.blob_index].blob_id
+            blob_path = os.path.join(blob_dir, blob_id)
+            with open(blob_path, "rb") as f:
+                f.seek(rec.compressed_offset)
+                raw = f.read(rec.compressed_size)
+            data = _decompress_chunk(raw, rec.flags, clen)
+            lo = max(0, offset - pos)
+            hi = min(clen, end - pos)
+            out += data[lo:hi]
+            pos += clen
+        self.metrics.data_read += len(out)
+        self.metrics.fop_hits["Read"] = self.metrics.fop_hits.get("Read", 0) + 1
+        return bytes(out)
+
+
+class DaemonServer:
+    def __init__(
+        self,
+        daemon_id: str,
+        apisock: str,
+        supervisor: str = "",
+        workdir: str = "",
+        upgrade: bool = False,
+    ):
+        self.id = daemon_id
+        self.apisock = apisock
+        self.supervisor = supervisor
+        self.workdir = workdir or os.getcwd()
+        self.state = DaemonState.INIT
+        self.instances: dict[str, _Instance] = {}
+        self._lock = threading.RLock()
+        self._httpd: Optional[socketserver.ThreadingMixIn] = None
+        self._started_in_upgrade = upgrade
+        if not upgrade:
+            # Normal boot: nothing to restore, become READY immediately.
+            self.state = DaemonState.READY
+
+    # -- state snapshot for failover/upgrade -------------------------------
+
+    def snapshot_state(self) -> bytes:
+        with self._lock:
+            return json.dumps(
+                {
+                    "id": self.id,
+                    "instances": [
+                        {
+                            "mountpoint": i.mountpoint,
+                            "source": i.source,
+                            "config": i.config_json,
+                        }
+                        for i in self.instances.values()
+                    ],
+                },
+                sort_keys=True,
+            ).encode()
+
+    def restore_state(self, blob: bytes) -> None:
+        data = json.loads(blob)
+        with self._lock:
+            for inst in data.get("instances", []):
+                self.instances[inst["mountpoint"]] = _Instance(
+                    inst["mountpoint"], inst["source"], inst["config"]
+                )
+            self.state = DaemonState.READY
+
+    # -- supervisor interaction (SCM_RIGHTS fd passing) ---------------------
+
+    def send_states_to_supervisor(self) -> None:
+        """PUT .../sendfd handler body: push state + session fd to the
+        supervisor socket (reference supervisor.go:107-178 receiver side)."""
+        if not self.supervisor:
+            raise RuntimeError("daemon started without --supervisor")
+        state = self.snapshot_state()
+        fd = os.memfd_create(f"nydus-session-{self.id}")
+        try:
+            os.write(fd, state)
+            with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+                s.connect(self.supervisor)
+                socket.send_fds(s, [state], [fd])
+        finally:
+            os.close(fd)
+
+    def takeover_from_supervisor(self) -> None:
+        """PUT .../takeover: pull state + fd back and restore mounts."""
+        if not self.supervisor:
+            raise RuntimeError("daemon started without --supervisor")
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.connect(self.supervisor)
+            # Announce we want the saved session back.
+            s.sendall(b"TAKEOVER")
+            msg, fds, _flags, _addr = socket.recv_fds(s, 1 << 20, 4)
+        try:
+            state = msg
+            if fds:
+                size = os.fstat(fds[0]).st_size
+                os.lseek(fds[0], 0, os.SEEK_SET)
+                state = os.read(fds[0], size)
+            self.restore_state(state)
+        finally:
+            for fd in fds:
+                os.close(fd)
+
+    # -- http server --------------------------------------------------------
+
+    def serve_forever(self) -> None:
+        os.makedirs(os.path.dirname(self.apisock) or ".", exist_ok=True)
+        if os.path.exists(self.apisock):
+            os.unlink(self.apisock)
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code: int, obj: Any = None) -> None:
+                body = b"" if obj is None else json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _reply_raw(self, code: int, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length") or 0)
+                return self.rfile.read(n) if n else b""
+
+            def do_GET(self):
+                u = urllib.parse.urlparse(self.path)
+                q = urllib.parse.parse_qs(u.query)
+                if u.path == "/api/v1/daemon":
+                    self._reply(200, daemon.info())
+                elif u.path == "/api/v1/metrics":
+                    mp = q.get("id", [""])[0]
+                    self._reply(200, daemon.fs_metrics(mp))
+                elif u.path == "/api/v1/metrics/blobcache":
+                    self._reply(200, {"prefetch_data_amount": 0})
+                elif u.path == "/api/v1/metrics/inflight":
+                    self._reply(200, [])
+                elif u.path == "/api/v1/fs":
+                    try:
+                        self._handle_fs(q)
+                    except (FileNotFoundError, KeyError) as e:
+                        self._reply(404, {"error": str(e)})
+                    except IsADirectoryError as e:
+                        self._reply(400, {"error": f"not a regular file: {e}"})
+                else:
+                    self._reply(404, {"error": f"no route {u.path}"})
+
+            def _handle_fs(self, q):
+                mp = q.get("mountpoint", [""])[0]
+                op = q.get("op", ["stat"])[0]
+                path = q.get("path", ["/"])[0]
+                inst = daemon.instance(mp)
+                if op == "read":
+                    offset = int(q.get("offset", ["0"])[0])
+                    size = int(q.get("size", ["-1"])[0])
+                    data = inst.read(path, offset, size, inst.blob_dir(daemon.workdir))
+                    self._reply_raw(200, data)
+                elif op == "stat":
+                    inode = inst.by_path.get(path)
+                    if inode is None:
+                        raise FileNotFoundError(path)
+                    self._reply(
+                        200,
+                        {
+                            "path": inode.path,
+                            "mode": inode.mode,
+                            "size": inode.size,
+                            "uid": inode.uid,
+                            "gid": inode.gid,
+                            "symlink": inode.symlink_target,
+                            "hardlink": inode.hardlink_target,
+                        },
+                    )
+                elif op == "list":
+                    prefix = path.rstrip("/") + "/" if path != "/" else "/"
+                    names = sorted(
+                        p[len(prefix) :]
+                        for p in inst.by_path
+                        if p.startswith(prefix) and p != "/" and "/" not in p[len(prefix) :]
+                        and p != path
+                    )
+                    self._reply(200, names)
+                else:
+                    self._reply(400, {"error": f"bad op {op}"})
+
+            def do_POST(self):
+                u = urllib.parse.urlparse(self.path)
+                q = urllib.parse.parse_qs(u.query)
+                if u.path == "/api/v1/mount":
+                    mp = q.get("mountpoint", [""])[0]
+                    body = json.loads(self._body() or b"{}")
+                    try:
+                        daemon.mount(mp, body.get("source", ""), body.get("config", ""))
+                        self._reply(204)
+                    except FileExistsError:
+                        self._reply(409, {"error": f"{mp} already mounted"})
+                    except Exception as e:
+                        self._reply(400, {"error": str(e)})
+                else:
+                    self._reply(404, {"error": f"no route {u.path}"})
+
+            def do_PUT(self):
+                u = urllib.parse.urlparse(self.path)
+                if u.path == "/api/v1/daemon/start":
+                    daemon.start()
+                    self._reply(204)
+                elif u.path == "/api/v1/daemon/exit":
+                    self._reply(204)
+                    threading.Thread(target=daemon.shutdown, daemon=True).start()
+                elif u.path in ("/api/v1/daemon/fuse/sendfd", "/api/v1/daemon/fscache/sendfd"):
+                    try:
+                        daemon.send_states_to_supervisor()
+                        self._reply(204)
+                    except Exception as e:
+                        self._reply(500, {"error": str(e)})
+                elif u.path in ("/api/v1/daemon/fuse/takeover", "/api/v1/daemon/fscache/takeover"):
+                    try:
+                        daemon.takeover_from_supervisor()
+                        self._reply(204)
+                    except Exception as e:
+                        self._reply(500, {"error": str(e)})
+                else:
+                    self._reply(404, {"error": f"no route {u.path}"})
+
+            def do_DELETE(self):
+                u = urllib.parse.urlparse(self.path)
+                q = urllib.parse.parse_qs(u.query)
+                if u.path == "/api/v1/mount":
+                    mp = q.get("mountpoint", [""])[0]
+                    try:
+                        daemon.umount(mp)
+                        self._reply(204)
+                    except KeyError:
+                        self._reply(404, {"error": f"{mp} not mounted"})
+                else:
+                    self._reply(404, {"error": f"no route {u.path}"})
+
+        class Server(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+            # BaseHTTPRequestHandler expects a (host, port) client address.
+            def get_request(self):
+                request, _ = super().get_request()
+                return request, ("uds", 0)
+
+        self._httpd = Server(self.apisock, Handler)
+        self._httpd.serve_forever(poll_interval=0.1)
+
+    # -- operations ---------------------------------------------------------
+
+    def info(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "version": {"package_ver": __version__, "git_commit": ""},
+            "state": self.state.value,
+            "backend_collection": {},
+            "supervisor": self.supervisor,
+            "pid": os.getpid(),
+        }
+
+    def instance(self, mountpoint: str) -> _Instance:
+        with self._lock:
+            inst = self.instances.get(mountpoint)
+        if inst is None:
+            raise KeyError(f"no instance mounted at {mountpoint}")
+        return inst
+
+    def mount(self, mountpoint: str, source: str, config: str) -> None:
+        if not mountpoint:
+            raise ValueError("mountpoint required")
+        with self._lock:
+            if self.state not in (DaemonState.READY, DaemonState.RUNNING):
+                raise RuntimeError(f"daemon in state {self.state}, cannot mount")
+            if mountpoint in self.instances:
+                raise FileExistsError(mountpoint)
+            self.instances[mountpoint] = _Instance(mountpoint, source, config)
+        self._push_state_async()
+
+    def umount(self, mountpoint: str) -> None:
+        with self._lock:
+            del self.instances[mountpoint]
+        self._push_state_async()
+
+    def _push_state_async(self) -> None:
+        """Keep the supervisor's saved session current after every mount
+        change, so a SIGKILL'd daemon can still be failed over (the
+        reference nydusd continuously syncs state to --supervisor)."""
+        if not self.supervisor:
+            return
+
+        def push():
+            try:
+                self.send_states_to_supervisor()
+            except OSError:
+                pass  # supervisor not up yet; next change retries
+
+        threading.Thread(target=push, daemon=True).start()
+
+    def start(self) -> None:
+        with self._lock:
+            self.state = DaemonState.RUNNING
+
+    def fs_metrics(self, mountpoint: str) -> dict[str, Any]:
+        with self._lock:
+            if mountpoint and mountpoint in self.instances:
+                return self.instances[mountpoint].metrics.to_dict()
+            total = FsMetrics()
+            for inst in self.instances.values():
+                total.data_read += inst.metrics.data_read
+                for k, v in inst.metrics.fop_hits.items():
+                    total.fop_hits[k] = total.fop_hits.get(k, 0) + v
+            return total.to_dict()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self.state = DaemonState.DESTROYED
+        if self._httpd is not None:
+            self._httpd.shutdown()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="nydus-tpu-daemon")
+    p.add_argument("--id", required=True)
+    p.add_argument("--apisock", required=True)
+    p.add_argument("--supervisor", default="")
+    p.add_argument("--workdir", default="")
+    p.add_argument("--upgrade", action="store_true")
+    p.add_argument("--log-file", default="")
+    args = p.parse_args(argv)
+
+    if args.log_file:
+        sys.stderr = sys.stdout = open(args.log_file, "a", buffering=1)
+
+    server = DaemonServer(
+        args.id,
+        args.apisock,
+        supervisor=args.supervisor,
+        workdir=args.workdir,
+        upgrade=args.upgrade,
+    )
+    signal.signal(signal.SIGTERM, lambda *_: server.shutdown())
+    try:
+        server.serve_forever()
+    finally:
+        try:
+            os.unlink(args.apisock)
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,4 @@
+"""Daemon lifecycle manager + liveness monitoring (reference pkg/manager)."""
+
+from nydus_snapshotter_tpu.manager.monitor import LivenessMonitor, DeathEvent  # noqa: F401
+from nydus_snapshotter_tpu.manager.manager import Manager  # noqa: F401

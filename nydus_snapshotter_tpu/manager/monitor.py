@@ -1,0 +1,92 @@
+"""Liveness monitor: epoll on daemon API sockets.
+
+Reference pkg/manager/monitor.go:26-229: subscribe a connected unix socket
+per daemon, watch EPOLLHUP/EPOLLERR edge-triggered; a hangup means the
+daemon died — emit a death event on the notifier channel.
+"""
+
+from __future__ import annotations
+
+import queue
+import select
+import socket
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class DeathEvent:
+    daemon_id: str
+    path: str
+
+
+class LivenessMonitor:
+    def __init__(self):
+        self._epoll = select.epoll()
+        self._lock = threading.Lock()
+        self._socks: dict[int, tuple[str, str, socket.socket]] = {}  # fd -> (id, path, sock)
+        self._by_id: dict[str, int] = {}
+        self.events: "queue.Queue[DeathEvent]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def subscribe(self, daemon_id: str, sock_path: str) -> None:
+        """Connect to the daemon socket and watch for hangup
+        (reference monitor.go:81-138)."""
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(sock_path)
+        s.setblocking(False)
+        fd = s.fileno()
+        with self._lock:
+            if daemon_id in self._by_id:
+                self._unsubscribe_locked(daemon_id)
+            self._socks[fd] = (daemon_id, sock_path, s)
+            self._by_id[daemon_id] = fd
+        self._epoll.register(fd, select.EPOLLHUP | select.EPOLLERR | select.EPOLLET)
+
+    def unsubscribe(self, daemon_id: str) -> None:
+        with self._lock:
+            self._unsubscribe_locked(daemon_id)
+
+    def _unsubscribe_locked(self, daemon_id: str) -> None:
+        fd = self._by_id.pop(daemon_id, None)
+        if fd is None:
+            return
+        try:
+            self._epoll.unregister(fd)
+        except (OSError, FileNotFoundError):
+            pass
+        _, _, s = self._socks.pop(fd)
+        s.close()
+
+    def run(self) -> None:
+        """Event loop (reference monitor.go:191-229)."""
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                events = self._epoll.poll(timeout=0.2)
+            except (OSError, ValueError):
+                return
+            for fd, event in events:
+                if event & (select.EPOLLHUP | select.EPOLLERR):
+                    with self._lock:
+                        entry = self._socks.get(fd)
+                        if entry is None:
+                            continue
+                        daemon_id, path, _ = entry
+                        self._unsubscribe_locked(daemon_id)
+                    self.events.put(DeathEvent(daemon_id=daemon_id, path=path))
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        with self._lock:
+            for daemon_id in list(self._by_id):
+                self._unsubscribe_locked(daemon_id)
+        self._epoll.close()

@@ -12,6 +12,7 @@ and a tree serializes back to a deterministic tar for Unpack
 from __future__ import annotations
 
 import io
+import os
 import stat
 import tarfile
 from dataclasses import dataclass, field
@@ -111,8 +112,16 @@ def tree_from_tar(fileobj: BinaryIO | bytes) -> list[FileEntry]:
 
 
 def _entry_from_tarinfo(tf: tarfile.TarFile, info: tarfile.TarInfo, path: str) -> FileEntry:
-    xattrs = {k: v.encode() if isinstance(v, str) else v for k, v in (info.pax_headers or {}).items() if k.startswith(("SCHILY.xattr.",))}
-    xattrs = {k[len("SCHILY.xattr.") :]: v for k, v in xattrs.items()}
+    # tarfile decodes pax values as utf-8 with surrogateescape; encoding back
+    # the same way round-trips arbitrary binary xattrs (e.g. the
+    # security.capability on ping/sudo) losslessly.
+    xattrs = {
+        k[len("SCHILY.xattr.") :]: (
+            v.encode("utf-8", "surrogateescape") if isinstance(v, str) else v
+        )
+        for k, v in (info.pax_headers or {}).items()
+        if k.startswith("SCHILY.xattr.")
+    }
     e = FileEntry(
         path=path,
         uid=info.uid,
@@ -133,10 +142,10 @@ def _entry_from_tarinfo(tf: tarfile.TarFile, info: tarfile.TarInfo, path: str) -
         e.flags |= INODE_FLAG_HARDLINK
     elif info.ischr():
         e.mode = stat.S_IFCHR | perm
-        e.rdev = (info.devmajor << 8) | info.devminor
+        e.rdev = os.makedev(info.devmajor, info.devminor)
     elif info.isblk():
         e.mode = stat.S_IFBLK | perm
-        e.rdev = (info.devmajor << 8) | info.devminor
+        e.rdev = os.makedev(info.devmajor, info.devminor)
     elif info.isfifo():
         e.mode = stat.S_IFIFO | perm
     elif info.isreg():
@@ -214,7 +223,10 @@ def tar_from_tree(entries: list[FileEntry]) -> bytes:
             info.uid, info.gid, info.mtime = e.uid, e.gid, e.mtime
             if e.xattrs:
                 info.pax_headers.update(
-                    {f"SCHILY.xattr.{k}": v.decode("latin-1") for k, v in e.xattrs.items()}
+                    {
+                        f"SCHILY.xattr.{k}": v.decode("utf-8", "surrogateescape")
+                        for k, v in e.xattrs.items()
+                    }
                 )
             data = None
             if e.hardlink_target:
@@ -227,10 +239,10 @@ def tar_from_tree(entries: list[FileEntry]) -> bytes:
                 info.linkname = e.symlink_target
             elif stat.S_ISCHR(e.mode):
                 info.type = tarfile.CHRTYPE
-                info.devmajor, info.devminor = e.rdev >> 8, e.rdev & 0xFF
+                info.devmajor, info.devminor = os.major(e.rdev), os.minor(e.rdev)
             elif stat.S_ISBLK(e.mode):
                 info.type = tarfile.BLKTYPE
-                info.devmajor, info.devminor = e.rdev >> 8, e.rdev & 0xFF
+                info.devmajor, info.devminor = os.major(e.rdev), os.minor(e.rdev)
             elif stat.S_ISFIFO(e.mode):
                 info.type = tarfile.FIFOTYPE
             else:

@@ -1,0 +1,3 @@
+"""Persistence for daemon + RAFS instance states (reference pkg/store)."""
+
+from nydus_snapshotter_tpu.store.database import Database, StoreError  # noqa: F401

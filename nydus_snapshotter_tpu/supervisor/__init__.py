@@ -1,0 +1,6 @@
+"""Failover state keeper (reference pkg/supervisor)."""
+
+from nydus_snapshotter_tpu.supervisor.supervisor import (  # noqa: F401
+    Supervisor,
+    SupervisorSet,
+)

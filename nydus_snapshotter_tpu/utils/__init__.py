@@ -1,0 +1,1 @@
+"""Shared utilities: retry, error predicates, transport helpers."""

@@ -1,0 +1,41 @@
+"""Exponential-backoff retry (reference pkg/utils/retry/retry.go semantics:
+bounded attempts, growing delay, last error surfaced)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, Type, TypeVar
+
+T = TypeVar("T")
+
+
+class RetryError(Exception):
+    def __init__(self, attempts: int, last: BaseException):
+        super().__init__(f"all {attempts} attempts failed: {last}")
+        self.attempts = attempts
+        self.last = last
+
+
+def do(
+    fn: Callable[[], T],
+    attempts: int = 3,
+    delay: float = 0.1,
+    backoff: float = 2.0,
+    max_delay: float = 5.0,
+    retry_on: tuple[Type[BaseException], ...] = (Exception,),
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Run fn with retries; raises RetryError wrapping the final failure."""
+    if attempts < 1:
+        raise ValueError("attempts must be >= 1")
+    cur = delay
+    last: BaseException | None = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203
+            last = e
+            if i + 1 < attempts:
+                sleep(min(cur, max_delay))
+                cur *= backoff
+    raise RetryError(attempts, last)  # type: ignore[arg-type]

@@ -272,3 +272,47 @@ class TestMerge:
     def test_merge_empty_layers_rejected(self):
         with pytest.raises(ConvertError):
             Merge([], MergeOption())
+
+    def test_merge_inherits_layer_version(self):
+        blob, _ = pack_layer(build_lower(), PackOption(fs_version="v5", chunk_size=0x1000))
+        merged = Merge([blob], MergeOption())
+        assert Bootstrap.from_bytes(merged.bootstrap).version == "v5"
+        merged6 = Merge([blob], MergeOption(fs_version="v6"))
+        assert Bootstrap.from_bytes(merged6.bootstrap).version == "v6"
+
+
+class TestFsTreeFidelity:
+    def test_binary_xattr_roundtrip(self):
+        # security.capability-style binary xattr survives pack->unpack.
+        cap = b"\x01\x00\x00\x02\xff\x00\xde\xad"
+        out = io.BytesIO()
+        with tarfile.open(fileobj=out, mode="w:", format=tarfile.PAX_FORMAT) as tf:
+            info = tarfile.TarInfo("bin/ping")
+            info.size = 4
+            info.pax_headers["SCHILY.xattr.security.capability"] = cap.decode(
+                "utf-8", "surrogateescape"
+            )
+            tf.addfile(info, io.BytesIO(b"ELF!"))
+        blob, res = pack_layer(out.getvalue(), PackOption(chunk_size=0x1000))
+        bs = bootstrap_from_layer_blob(blob)
+        assert bs.inode_by_path()["/bin/ping"].xattrs["security.capability"] == cap
+        out_tar = Unpack(bs, {res.blob_id: blob_data_from_layer_blob(blob)})
+        with tarfile.open(fileobj=io.BytesIO(out_tar), mode="r:") as tf:
+            v = tf.getmember("bin/ping").pax_headers["SCHILY.xattr.security.capability"]
+            assert v.encode("utf-8", "surrogateescape") == cap
+
+    def test_large_device_minor_roundtrip(self):
+        out = io.BytesIO()
+        with tarfile.open(fileobj=out, mode="w:") as tf:
+            info = tarfile.TarInfo("dev/dm-0")
+            info.type = tarfile.BLKTYPE
+            info.devmajor, info.devminor = 253, 300  # minor > 255
+            tf.addfile(info)
+        blob, res = pack_layer(out.getvalue(), PackOption(chunk_size=0x1000))
+        out_tar = Unpack(
+            bootstrap_from_layer_blob(blob),
+            {res.blob_id: blob_data_from_layer_blob(blob)},
+        )
+        with tarfile.open(fileobj=io.BytesIO(out_tar), mode="r:") as tf:
+            m = tf.getmember("dev/dm-0")
+            assert (m.devmajor, m.devminor) == (253, 300)

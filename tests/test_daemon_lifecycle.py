@@ -1,0 +1,262 @@
+"""Daemon lifecycle e2e: spawn, mount, read, kill, failover, restart.
+
+Python-process analog of the reference integration scenarios
+(integration/entrypoint.sh: kill_nydusd_recover_nydusd :478,
+kill_multiple_nydusd_recover_failover :529) plus unit coverage for the
+monitor, supervisor, store, and config stack.
+"""
+
+import io
+import json
+import os
+import signal
+import tarfile
+import time
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu import constants
+from nydus_snapshotter_tpu.config.config import SnapshotterConfig, load_config, ConfigError
+from nydus_snapshotter_tpu.converter import MergeOption, Merge, PackOption, pack_layer
+from nydus_snapshotter_tpu.converter.convert import blob_data_from_layer_blob
+from nydus_snapshotter_tpu.daemon.daemon import ConfigState, Daemon
+from nydus_snapshotter_tpu.daemon.types import DaemonState
+from nydus_snapshotter_tpu.manager.manager import Manager
+from nydus_snapshotter_tpu.rafs.rafs import Rafs
+from nydus_snapshotter_tpu.store.database import Database
+from nydus_snapshotter_tpu.utils import errdefs
+
+RNG = np.random.default_rng(77)
+
+
+def _build_image(tmp_path):
+    """Pack a tiny image; return (bootstrap_path, blob_dir, file_map)."""
+    files = {
+        "/app/data.bin": RNG.integers(0, 256, 200_000, dtype=np.uint8).tobytes(),
+        "/app/hello.txt": b"hello from rafs\n",
+    }
+    out = io.BytesIO()
+    with tarfile.open(fileobj=out, mode="w:") as tf:
+        for path, data in files.items():
+            info = tarfile.TarInfo(path.strip("/"))
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    blob, res = pack_layer(out.getvalue(), PackOption(chunk_size=0x1000, backend="numpy"))
+    merged = Merge([blob], MergeOption())
+    boot_path = tmp_path / "image.boot"
+    boot_path.write_bytes(merged.bootstrap)
+    blob_dir = tmp_path / "blobs"
+    blob_dir.mkdir(exist_ok=True)
+    (blob_dir / res.blob_id).write_bytes(blob_data_from_layer_blob(blob))
+    return str(boot_path), str(blob_dir), files
+
+
+def _mk_config(tmp_path, policy=constants.RECOVER_POLICY_RESTART) -> SnapshotterConfig:
+    root = str(tmp_path / "r")  # keep the socket paths short (sun_path)
+    os.makedirs(root, exist_ok=True)
+    cfg = SnapshotterConfig(root=root)
+    cfg.daemon.recover_policy = policy
+    cfg.validate()
+    return cfg
+
+
+def _daemon_config_json(blob_dir: str) -> str:
+    return json.dumps(
+        {"device": {"backend": {"type": "localfs", "config": {"blob_dir": blob_dir}}}}
+    )
+
+
+@pytest.fixture
+def image(tmp_path):
+    return _build_image(tmp_path)
+
+
+class TestDaemonEndToEnd:
+    def test_mount_and_read(self, tmp_path, image):
+        boot, blob_dir, files = image
+        cfg = _mk_config(tmp_path)
+        mgr = Manager(cfg, Database(cfg.database_path))
+        daemon = mgr.new_daemon("d1")
+        mgr.add_daemon(daemon)
+        try:
+            mgr.start_daemon(daemon)
+            assert daemon.state() == DaemonState.RUNNING
+            rafs = Rafs(snapshot_id="snap1", daemon_id="d1")
+            daemon.shared_mount(rafs, boot, _daemon_config_json(blob_dir))
+            cl = daemon.client()
+            assert cl.read_file("/snap1", "/app/hello.txt") == files["/app/hello.txt"]
+            data = cl.read_file("/snap1", "/app/data.bin")
+            assert data == files["/app/data.bin"]
+            # ranged read
+            assert cl.read_file("/snap1", "/app/data.bin", offset=100, size=50) == data[100:150]
+            assert cl.list_dir("/snap1", "/app") == ["data.bin", "hello.txt"]
+            st = cl.stat_file("/snap1", "/app/data.bin")
+            assert st["size"] == 200_000
+            # metrics counted the reads
+            m = cl.fs_metrics("/snap1")
+            assert m["data_read"] >= 200_000
+            daemon.shared_umount(rafs)
+            with pytest.raises(errdefs.NotFound):
+                cl.read_file("/snap1", "/app/hello.txt")
+        finally:
+            mgr.destroy_daemon(daemon)
+            mgr.stop()
+
+    def test_monitor_detects_death(self, tmp_path, image):
+        cfg = _mk_config(tmp_path)
+        mgr = Manager(cfg, Database(cfg.database_path))
+        mgr.recover_policy = constants.RECOVER_POLICY_NONE
+        daemon = mgr.new_daemon("d2")
+        mgr.add_daemon(daemon)
+        try:
+            mgr.start_daemon(daemon)
+            mgr.monitor.run()
+            os.kill(daemon.pid, signal.SIGKILL)
+            event = mgr.monitor.events.get(timeout=5)
+            assert event.daemon_id == "d2"
+        finally:
+            daemon.terminate()
+            mgr.stop()
+
+    def test_restart_policy_recovers_mounts(self, tmp_path, image):
+        boot, blob_dir, files = image
+        cfg = _mk_config(tmp_path, policy=constants.RECOVER_POLICY_RESTART)
+        mgr = Manager(cfg, Database(cfg.database_path))
+        daemon = mgr.new_daemon("d3")
+        mgr.add_daemon(daemon)
+        recovered = []
+        mgr.on_death = lambda e: recovered.append(e.daemon_id)
+        try:
+            mgr.start_daemon(daemon)
+            rafs = Rafs(snapshot_id="s", daemon_id="d3", snapshot_dir=str(tmp_path))
+            daemon.shared_mount(rafs, boot, _daemon_config_json(blob_dir))
+            # persist instance config for replay
+            with open(os.path.join(daemon.states.workdir, "s.json"), "w") as f:
+                f.write(_daemon_config_json(blob_dir))
+            # monkey-patch replay source: bootstrap lives at a fixed path
+            rafs.bootstrap_file = lambda: boot  # type: ignore[method-assign]
+            mgr.run_death_handler()
+            os.kill(daemon.pid, signal.SIGKILL)
+            deadline = time.time() + 20
+            while not recovered and time.time() < deadline:
+                time.sleep(0.1)
+            assert recovered == ["d3"]
+            # all mounts replayed; reads work again
+            assert daemon.client().read_file("/s", "/app/hello.txt") == files["/app/hello.txt"]
+        finally:
+            mgr.destroy_daemon(daemon)
+            mgr.stop()
+
+    def test_failover_policy_takeover(self, tmp_path, image):
+        boot, blob_dir, files = image
+        cfg = _mk_config(tmp_path, policy=constants.RECOVER_POLICY_FAILOVER)
+        mgr = Manager(cfg, Database(cfg.database_path))
+        daemon = mgr.new_daemon("d4")
+        assert daemon.states.supervisor_path  # failover pre-wires a supervisor
+        mgr.add_daemon(daemon)
+        recovered = []
+        mgr.on_death = lambda e: recovered.append(e.daemon_id)
+        try:
+            mgr.start_daemon(daemon)
+            rafs = Rafs(snapshot_id="s", daemon_id="d4")
+            daemon.shared_mount(rafs, boot, _daemon_config_json(blob_dir))
+            # wait until the daemon has synced its session to the supervisor
+            sup = mgr.supervisors.get("d4")
+            assert sup.wait_for_state(timeout=5)
+            mgr.run_death_handler()
+            os.kill(daemon.pid, signal.SIGKILL)
+            deadline = time.time() + 20
+            while not recovered and time.time() < deadline:
+                time.sleep(0.1)
+            assert recovered == ["d4"]
+            # mounts restored from the supervisor session — not re-mounted
+            # by the manager — and reads work.
+            assert daemon.client().read_file("/s", "/app/hello.txt") == files["/app/hello.txt"]
+        finally:
+            mgr.destroy_daemon(daemon)
+            mgr.stop()
+
+    def test_snapshotter_restart_recovers_daemon_cache(self, tmp_path, image):
+        boot, blob_dir, files = image
+        cfg = _mk_config(tmp_path)
+        db = Database(cfg.database_path)
+        mgr = Manager(cfg, db)
+        daemon = mgr.new_daemon("d5")
+        mgr.add_daemon(daemon)
+        try:
+            mgr.start_daemon(daemon)
+            # "restart" the snapshotter: a new manager over the same store
+            mgr2 = Manager(cfg, db)
+            live, dead = mgr2.recover()
+            assert [d.id for d in live] == ["d5"] and not dead
+            assert live[0].state() == DaemonState.RUNNING
+            mgr2.stop()
+        finally:
+            mgr.destroy_daemon(daemon)
+            mgr.stop()
+
+
+class TestStore:
+    def test_daemon_roundtrip(self, tmp_path):
+        db = Database(str(tmp_path / "nydus.db"))
+        db.save_daemon("a", {"x": 1})
+        with pytest.raises(errdefs.AlreadyExists):
+            db.save_daemon("a", {"x": 2})
+        db.update_daemon("a", {"x": 3})
+        assert db.get_daemon("a") == {"x": 3}
+        assert list(db.walk_daemons()) == [{"x": 3}]
+        db.delete_daemon("a")
+        with pytest.raises(errdefs.NotFound):
+            db.get_daemon("a")
+
+    def test_instance_seq_monotonic(self, tmp_path):
+        db = Database(str(tmp_path / "nydus.db"))
+        s1, s2 = db.next_instance_seq(), db.next_instance_seq()
+        db.save_instance("i1", {"n": 1}, s1)
+        db.save_instance("i2", {"n": 2}, s2)
+        db.delete_instance("i1")
+        s3 = db.next_instance_seq()
+        assert s1 < s2 < s3  # survives deletes
+        assert [v["n"] for v, _ in db.walk_instances()] == [2]
+
+    def test_reopen_preserves(self, tmp_path):
+        path = str(tmp_path / "nydus.db")
+        db = Database(path)
+        db.save_daemon("d", {"k": "v"})
+        db.close()
+        db2 = Database(path)
+        assert db2.get_daemon("d") == {"k": "v"}
+
+
+class TestConfig:
+    def test_defaults_valid(self):
+        cfg = SnapshotterConfig()
+        cfg.validate()
+        assert cfg.daemon_mode == constants.DAEMON_MODE_DEDICATED
+
+    def test_toml_and_overrides(self, tmp_path):
+        p = tmp_path / "c.toml"
+        p.write_text(
+            'version = 1\nroot = "/tmp/nydus-test"\n'
+            "[daemon]\nrecover_policy = \"failover\"\n[log]\nlog_level = \"debug\"\n"
+        )
+        cfg = load_config(str(p), overrides={"daemon_mode": "shared"})
+        assert cfg.root == "/tmp/nydus-test"
+        assert cfg.daemon.recover_policy == "failover"
+        assert cfg.log.log_level == "debug"
+        assert cfg.daemon_mode == "shared"
+
+    def test_validation_failures(self, tmp_path):
+        with pytest.raises(ConfigError):
+            load_config(overrides={"version": 2})
+        with pytest.raises(ConfigError):
+            load_config(overrides={"root": "/" + "x" * 80})
+        with pytest.raises(ConfigError):
+            load_config(overrides={"daemon": {"fs_driver": "warpdrive"}})
+        with pytest.raises(ConfigError):
+            load_config(overrides={"nope": 1})
+
+    def test_blockdev_forces_none_mode(self):
+        cfg = load_config(overrides={"daemon": {"fs_driver": "blockdev"}})
+        assert cfg.daemon_mode == constants.DAEMON_MODE_NONE
