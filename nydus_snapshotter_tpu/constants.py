@@ -90,6 +90,16 @@ NYDUS_TARFS_LAYER = "containerd.io/snapshot/nydus-tarfs"
 NYDUS_PROXY_MODE = "containerd.io/snapshot/nydus-proxy-mode"
 OVERLAYFS_VOLATILE_OPT = "containerd.io/snapshot/overlay.volatile"
 TARGET_IMAGE_REF = "containerd.io/snapshot/remote/image.reference"
+# Dm-verity information for image/layer block devices (label.go:41-44).
+NYDUS_IMAGE_BLOCK_INFO = "containerd.io/snapshot/nydus-image-block"
+NYDUS_LAYER_BLOCK_INFO = "containerd.io/snapshot/nydus-layer-block"
+# Registry pull credentials attached by CRI (label.go:45-48).
+NYDUS_IMAGE_PULL_SECRET = "containerd.io/snapshot/pullsecret"
+NYDUS_IMAGE_PULL_USERNAME = "containerd.io/snapshot/pullusername"
+# Marks a snapshot holding an estargz layer (label.go:54).
+STARGZ_LAYER = "containerd.io/snapshot/stargz"
+# Builder hint that an image should run in tarfs mode (label.go:63-65).
+TARFS_HINT = "containerd.io/snapshot/tarfs-hint"
 
 # ---------------------------------------------------------------------------
 # Chunking parameters (reference pkg/converter/types.go:76-79 bounds)

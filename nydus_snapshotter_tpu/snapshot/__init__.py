@@ -1,0 +1,1 @@
+"""Snapshotter core (reference snapshot/ + pkg/label + pkg/snapshot)."""

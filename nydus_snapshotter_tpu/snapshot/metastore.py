@@ -1,0 +1,257 @@
+"""Snapshot metadata store.
+
+The reference leans on containerd's ``storage.MetaStore`` (bbolt,
+snapshot/snapshot.go:272) for snapshot parentage, kinds, labels, and usage,
+plus the helpers in pkg/snapshot/storage.go:19-108 (get/walk/update info,
+``IterateParentSnapshots``). This module reproduces those semantics on
+sqlite (stdlib, WAL, transactional):
+
+- snapshots are addressed by *key* (client name) and carry an internal
+  monotonic numeric *id* used for on-disk directory names;
+- kinds: view / active / committed; Commit turns an active snapshot into a
+  committed one under a new name;
+- ``Snapshot.parent_ids`` is the full ancestor id chain, immediate parent
+  first — what overlay lowerdir synthesis consumes;
+- usage (size, inodes) recorded at commit time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Optional
+
+from nydus_snapshotter_tpu.utils import errdefs
+
+KIND_VIEW = "view"
+KIND_ACTIVE = "active"
+KIND_COMMITTED = "committed"
+
+
+@dataclass
+class Usage:
+    size: int = 0
+    inodes: int = 0
+
+    def add(self, other: "Usage") -> None:
+        self.size += other.size
+        self.inodes += other.inodes
+
+
+@dataclass
+class Info:
+    kind: str
+    name: str
+    parent: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    created: float = 0.0
+    updated: float = 0.0
+
+
+@dataclass
+class Snapshot:
+    id: str
+    kind: str
+    parent_ids: list[str] = field(default_factory=list)
+
+
+class MetaStore:
+    """Transactional snapshot metadata store keyed by snapshot name."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock = threading.RLock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        with self._conn:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS snapshots ("
+                " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+                " key TEXT UNIQUE NOT NULL,"
+                " kind TEXT NOT NULL,"
+                " parent TEXT NOT NULL DEFAULT '',"
+                " labels TEXT NOT NULL DEFAULT '{}',"
+                " size INTEGER NOT NULL DEFAULT 0,"
+                " inodes INTEGER NOT NULL DEFAULT 0,"
+                " created REAL NOT NULL,"
+                " updated REAL NOT NULL)"
+            )
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    # -- internal ------------------------------------------------------------
+
+    def _row(self, key: str) -> sqlite3.Row:
+        self._conn.row_factory = sqlite3.Row
+        row = self._conn.execute("SELECT * FROM snapshots WHERE key=?", (key,)).fetchone()
+        if row is None:
+            raise errdefs.NotFound(f"snapshot {key!r} not found")
+        return row
+
+    def _info(self, row: sqlite3.Row) -> Info:
+        return Info(
+            kind=row["kind"],
+            name=row["key"],
+            parent=row["parent"],
+            labels=json.loads(row["labels"]),
+            created=row["created"],
+            updated=row["updated"],
+        )
+
+    def _parent_ids(self, parent_key: str) -> list[str]:
+        ids: list[str] = []
+        key = parent_key
+        while key:
+            row = self._row(key)
+            ids.append(str(row["id"]))
+            key = row["parent"]
+        return ids
+
+    # -- storage API (containerd storage package parity) ---------------------
+
+    def create_snapshot(
+        self, kind: str, key: str, parent: str = "", labels: Optional[dict[str, str]] = None
+    ) -> Snapshot:
+        if kind not in (KIND_VIEW, KIND_ACTIVE):
+            raise errdefs.InvalidArgument(f"snapshot kind {kind!r} not creatable")
+        if not key:
+            raise errdefs.InvalidArgument("snapshot key is empty")
+        with self._lock:
+            if parent:
+                prow = self._row(parent)
+                if prow["kind"] != KIND_COMMITTED:
+                    raise errdefs.InvalidArgument(f"parent {parent!r} is not committed")
+            now = time.time()
+            try:
+                with self._conn:
+                    cur = self._conn.execute(
+                        "INSERT INTO snapshots (key, kind, parent, labels, created, updated)"
+                        " VALUES (?,?,?,?,?,?)",
+                        (key, kind, parent, json.dumps(labels or {}), now, now),
+                    )
+            except sqlite3.IntegrityError:
+                raise errdefs.AlreadyExists(f"snapshot {key!r} already exists") from None
+            return Snapshot(
+                id=str(cur.lastrowid),
+                kind=kind,
+                parent_ids=self._parent_ids(parent) if parent else [],
+            )
+
+    def get_snapshot(self, key: str) -> Snapshot:
+        with self._lock:
+            row = self._row(key)
+            return Snapshot(
+                id=str(row["id"]),
+                kind=row["kind"],
+                parent_ids=self._parent_ids(row["parent"]) if row["parent"] else [],
+            )
+
+    def get_info(self, key: str) -> tuple[str, Info, Usage]:
+        with self._lock:
+            row = self._row(key)
+            return str(row["id"]), self._info(row), Usage(row["size"], row["inodes"])
+
+    def update_info(self, info: Info, *fieldpaths: str) -> Info:
+        """Update mutable snapshot fields; with fieldpaths only the named
+        `labels.*` / `labels` paths change (containerd Update contract)."""
+        with self._lock:
+            row = self._row(info.name)
+            labels = json.loads(row["labels"])
+            if fieldpaths:
+                for fp in fieldpaths:
+                    if fp == "labels":
+                        labels = dict(info.labels)
+                    elif fp.startswith("labels."):
+                        k = fp[len("labels.") :]
+                        if k in info.labels:
+                            labels[k] = info.labels[k]
+                        else:
+                            labels.pop(k, None)
+                    else:
+                        raise errdefs.InvalidArgument(f"cannot update field {fp!r}")
+            else:
+                labels = dict(info.labels)
+            now = time.time()
+            with self._conn:
+                self._conn.execute(
+                    "UPDATE snapshots SET labels=?, updated=? WHERE key=?",
+                    (json.dumps(labels), now, info.name),
+                )
+            row = self._row(info.name)
+            return self._info(row)
+
+    def commit_active(self, key: str, name: str, usage: Usage) -> str:
+        """Commit active snapshot `key` as committed snapshot `name`;
+        returns the (unchanged) snapshot id."""
+        if not name:
+            raise errdefs.InvalidArgument("committed name is empty")
+        with self._lock:
+            row = self._row(key)
+            if row["kind"] != KIND_ACTIVE:
+                raise errdefs.InvalidArgument(f"snapshot {key!r} is not active")
+            dup = self._conn.execute("SELECT 1 FROM snapshots WHERE key=?", (name,)).fetchone()
+            if dup is not None:
+                raise errdefs.AlreadyExists(f"snapshot {name!r} already exists")
+            with self._conn:
+                self._conn.execute(
+                    "UPDATE snapshots SET key=?, kind=?, size=?, inodes=?, updated=?"
+                    " WHERE key=?",
+                    (name, KIND_COMMITTED, usage.size, usage.inodes, time.time(), key),
+                )
+            return str(row["id"])
+
+    def remove(self, key: str) -> tuple[str, str]:
+        """Remove snapshot `key`; returns (id, kind). Fails while children
+        reference it (containerd Remove contract)."""
+        with self._lock:
+            row = self._row(key)
+            child = self._conn.execute(
+                "SELECT 1 FROM snapshots WHERE parent=?", (key,)
+            ).fetchone()
+            if child is not None:
+                raise errdefs.FailedPrecondition(f"snapshot {key!r} has children")
+            with self._conn:
+                self._conn.execute("DELETE FROM snapshots WHERE key=?", (key,))
+            return str(row["id"]), row["kind"]
+
+    def walk(self, fn: Callable[[str, Info], None]) -> None:
+        with self._lock:
+            self._conn.row_factory = sqlite3.Row
+            rows = self._conn.execute("SELECT * FROM snapshots ORDER BY id").fetchall()
+        for row in rows:
+            fn(str(row["id"]), self._info(row))
+
+    def id_map(self) -> dict[str, str]:
+        """id -> key for every stored snapshot (storage.IDMap, used by
+        orphan-directory cleanup snapshot.go:1006-1038)."""
+        with self._lock:
+            rows = self._conn.execute("SELECT id, key FROM snapshots").fetchall()
+        return {str(i): k for i, k in rows}
+
+    def usage(self, key: str) -> Usage:
+        with self._lock:
+            row = self._row(key)
+            return Usage(row["size"], row["inodes"])
+
+    # -- helpers (reference pkg/snapshot/storage.go) -------------------------
+
+    def iterate_parent_snapshots(
+        self, key: str, fn: Callable[[str, Info], bool]
+    ) -> tuple[str, Info]:
+        """Walk the parent chain starting at `key` until fn returns True
+        (reference storage.go:79-108 IterateParentSnapshots); raises
+        NotFound when the chain is exhausted."""
+        cur = key
+        while cur:
+            sid, info, _ = self.get_info(cur)
+            if fn(sid, info):
+                return sid, info
+            cur = info.parent
+        raise errdefs.NotFound(f"no matching parent snapshot for {key!r}")

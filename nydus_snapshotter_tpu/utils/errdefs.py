@@ -29,6 +29,10 @@ class Unavailable(NydusError):
     pass
 
 
+class FailedPrecondition(NydusError):
+    pass
+
+
 def is_already_exists(err: BaseException) -> bool:
     return isinstance(err, (AlreadyExists, FileExistsError)) or (
         isinstance(err, OSError) and err.errno == errno.EEXIST

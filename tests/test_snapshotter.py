@@ -1,0 +1,407 @@
+"""Snapshotter core tests: metastore semantics, mount synthesis, label
+routing — mirroring what the reference exercises through its unit tests and
+integration scenarios (snapshot/snapshot.go, snapshot/process.go)."""
+
+import os
+
+import pytest
+
+from nydus_snapshotter_tpu import constants as C
+from nydus_snapshotter_tpu.snapshot import metastore as ms
+from nydus_snapshotter_tpu.snapshot.metastore import MetaStore, Usage
+from nydus_snapshotter_tpu.snapshot.mount import (
+    DmVerityInfo,
+    ExtraOption,
+    KataVirtualVolume,
+    parse_tarfs_dm_verity,
+)
+from nydus_snapshotter_tpu.snapshot.snapshotter import Snapshotter
+from nydus_snapshotter_tpu.utils import errdefs
+
+
+# ---------------------------------------------------------------------------
+# MetaStore
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = MetaStore(str(tmp_path / "metadata.db"))
+    yield s
+    s.close()
+
+
+class TestMetaStore:
+    def test_create_get_commit_chain(self, store):
+        s1 = store.create_snapshot(ms.KIND_ACTIVE, "prep-1")
+        assert s1.kind == ms.KIND_ACTIVE and s1.parent_ids == []
+        store.commit_active("prep-1", "layer-1", Usage(size=100, inodes=3))
+
+        s2 = store.create_snapshot(ms.KIND_ACTIVE, "prep-2", parent="layer-1")
+        assert s2.parent_ids == [s1.id]
+        store.commit_active("prep-2", "layer-2", Usage())
+
+        s3 = store.create_snapshot(ms.KIND_ACTIVE, "prep-3", parent="layer-2")
+        # immediate parent first, then up the chain
+        assert s3.parent_ids == [s2.id, s1.id]
+
+        _, info, usage = store.get_info("layer-1")
+        assert info.kind == ms.KIND_COMMITTED and usage.size == 100 and usage.inodes == 3
+
+    def test_create_duplicate_and_bad_parent(self, store):
+        store.create_snapshot(ms.KIND_ACTIVE, "a")
+        with pytest.raises(errdefs.AlreadyExists):
+            store.create_snapshot(ms.KIND_ACTIVE, "a")
+        with pytest.raises(errdefs.InvalidArgument):
+            # active parent is not committed
+            store.create_snapshot(ms.KIND_ACTIVE, "b", parent="a")
+        with pytest.raises(errdefs.NotFound):
+            store.create_snapshot(ms.KIND_ACTIVE, "c", parent="ghost")
+
+    def test_remove_with_children_refused(self, store):
+        store.create_snapshot(ms.KIND_ACTIVE, "p")
+        store.commit_active("p", "base", Usage())
+        store.create_snapshot(ms.KIND_ACTIVE, "child", parent="base")
+        with pytest.raises(errdefs.FailedPrecondition):
+            store.remove("base")
+        store.remove("child")
+        sid, kind = store.remove("base")
+        assert kind == ms.KIND_COMMITTED
+
+    def test_update_labels_fieldpaths(self, store):
+        store.create_snapshot(ms.KIND_ACTIVE, "k", labels={"a": "1", "b": "2"})
+        _, info, _ = store.get_info("k")
+        info.labels = {"a": "9", "c": "3"}
+        out = store.update_info(info, "labels.a", "labels.c")
+        assert out.labels == {"a": "9", "b": "2", "c": "3"}
+        info.labels = {"only": "this"}
+        out = store.update_info(info)
+        assert out.labels == {"only": "this"}
+
+    def test_walk_and_id_map(self, store):
+        store.create_snapshot(ms.KIND_ACTIVE, "one")
+        store.create_snapshot(ms.KIND_VIEW, "two")
+        seen = {}
+        store.walk(lambda sid, info: seen.update({info.name: info.kind}))
+        assert seen == {"one": ms.KIND_ACTIVE, "two": ms.KIND_VIEW}
+        assert set(store.id_map().values()) == {"one", "two"}
+
+    def test_iterate_parent_snapshots(self, store):
+        store.create_snapshot(ms.KIND_ACTIVE, "p", labels={C.NYDUS_META_LAYER: "true"})
+        store.commit_active("p", "meta", Usage())
+        store.create_snapshot(ms.KIND_ACTIVE, "top", parent="meta")
+        sid, info = store.iterate_parent_snapshots(
+            "top", lambda _sid, i: C.NYDUS_META_LAYER in i.labels
+        )
+        assert info.name == "meta"
+        with pytest.raises(errdefs.NotFound):
+            store.iterate_parent_snapshots("top", lambda _sid, i: False)
+
+
+# ---------------------------------------------------------------------------
+# Mount options
+# ---------------------------------------------------------------------------
+
+
+class TestMountOptions:
+    def test_extraoption_roundtrip(self):
+        eo = ExtraOption(
+            source="/s/fs/image/image.boot", config="{}", snapshotdir="/s", fs_version="6"
+        )
+        opt = eo.encode()
+        assert opt.startswith("extraoption=")
+        back = ExtraOption.decode(opt)
+        assert back == eo
+
+    def test_dm_verity_parse_and_validate(self):
+        h = "a" * 64
+        di = parse_tarfs_dm_verity(f"4096,2097152,sha256:{h}")
+        assert di.blocknum == 4096 and di.offset == 2097152 and di.hash == h
+        with pytest.raises(errdefs.InvalidArgument):
+            parse_tarfs_dm_verity("garbage")
+        with pytest.raises(errdefs.InvalidArgument):
+            # offset below data area end
+            parse_tarfs_dm_verity(f"4096,512,sha256:{h}")
+        bad = DmVerityInfo(hashtype="md5", hash="00", blocknum=1, offset=4096)
+        with pytest.raises(errdefs.InvalidArgument):
+            bad.validate()
+
+    def test_kata_volume_roundtrip_and_validation(self):
+        v = KataVirtualVolume(volume_type="image_guest_pull")
+        assert not v.validate()  # image_pull required
+        from nydus_snapshotter_tpu.snapshot.mount import ImagePullVolume
+
+        v.image_pull = ImagePullVolume(metadata={"ref": "img"})
+        opt = v.encode_option()
+        back = KataVirtualVolume.decode_option(opt)
+        assert back.volume_type == "image_guest_pull"
+        assert back.image_pull.metadata == {"ref": "img"}
+
+        blk = KataVirtualVolume(volume_type="layer_raw_block", source="/dev/loop1")
+        assert blk.validate()
+        assert KataVirtualVolume(volume_type="bogus", source="x").validate() is False
+
+
+# ---------------------------------------------------------------------------
+# Snapshotter routing / lifecycle (fake fs)
+# ---------------------------------------------------------------------------
+
+
+class FakeFs:
+    """Duck-typed L3 facade recording calls (reference tests do the same
+    through integration scenarios)."""
+
+    def __init__(self):
+        self.mounted = {}
+        self.ready = set()
+        self.calls = []
+        self.stargz = False
+        self.tarfs = False
+        self.referrer = False
+
+    def mount(self, sid, labels, snapshot):
+        self.calls.append(("mount", sid))
+        self.mounted[sid] = labels
+        self.ready.add(sid)
+
+    def umount(self, sid):
+        self.calls.append(("umount", sid))
+        self.mounted.pop(sid, None)
+
+    def wait_until_ready(self, sid):
+        if sid not in self.ready:
+            raise errdefs.NotFound(sid)
+
+    def mount_point(self, sid):
+        if sid in self.mounted:
+            return f"/mnt/nydus/{sid}"
+        raise errdefs.NotFound(sid)
+
+    def bootstrap_file(self, sid):
+        return f"/snap/{sid}/fs/image/image.boot"
+
+    def remove_cache(self, digest):
+        self.calls.append(("remove_cache", digest))
+
+    def cache_usage(self, digest):
+        return Usage(size=42, inodes=1)
+
+    def teardown(self):
+        self.calls.append(("teardown",))
+
+    def try_stop_shared_daemon(self):
+        self.calls.append(("stop_shared",))
+
+    def check_referrer(self, labels):
+        return False
+
+    def referrer_detect_enabled(self):
+        return self.referrer
+
+    def try_fetch_metadata(self, labels, meta_path):
+        pass
+
+    def stargz_enabled(self):
+        return self.stargz
+
+    def is_stargz_data_layer(self, labels):
+        return False, None
+
+    def prepare_stargz_meta_layer(self, blob, storage_path, labels):
+        pass
+
+    def merge_stargz_meta_layer(self, snapshot):
+        pass
+
+    def tarfs_enabled(self):
+        return self.tarfs
+
+    def prepare_tarfs_layer(self, labels, sid, upper):
+        self.calls.append(("prepare_tarfs", sid))
+
+    def merge_tarfs_layers(self, snapshot, path_fn):
+        self.calls.append(("merge_tarfs", snapshot.id))
+
+    def export_block_data(self, snapshot, per_layer, labels, path_fn):
+        return []
+
+    def detach_tarfs_layer(self, sid):
+        self.calls.append(("detach_tarfs", sid))
+
+    def tarfs_export_enabled(self):
+        return False
+
+    def get_instance_extra_option(self, sid):
+        return ExtraOption(
+            source=self.bootstrap_file(sid),
+            config="{}",
+            snapshotdir=f"/snap/{sid}",
+            fs_version="6",
+        )
+
+
+@pytest.fixture
+def sn(tmp_path):
+    fs = FakeFs()
+    s = Snapshotter(root=str(tmp_path), fs=fs)
+    yield s, fs
+    s.close()
+
+
+class TestSnapshotter:
+    def test_prepare_native_first_layer_bind_mount(self, sn):
+        s, fs = sn
+        mounts = s.prepare("prep-1", "")
+        assert len(mounts) == 1 and mounts[0].type == "bind"
+        assert "rw" in mounts[0].options
+        sid = s.ms.get_snapshot("prep-1").id
+        assert os.path.isdir(s.upper_path(sid))
+        assert os.path.isdir(s.work_path(sid))
+
+    def test_prepare_nydus_data_layer_skips_download(self, sn):
+        s, fs = sn
+        labels = {
+            C.TARGET_SNAPSHOT_REF: "sha256:target",
+            C.NYDUS_DATA_LAYER: "true",
+        }
+        with pytest.raises(errdefs.AlreadyExists):
+            s.prepare("prep-data", "", labels)
+        # snapshot was committed under the target name with labels intact
+        _, info, _ = s.ms.get_info("sha256:target")
+        assert info.kind == ms.KIND_COMMITTED
+        assert C.NYDUS_DATA_LAYER in info.labels
+
+    def test_prepare_meta_layer_downloads(self, sn):
+        s, fs = sn
+        labels = {
+            C.TARGET_SNAPSHOT_REF: "sha256:meta",
+            C.NYDUS_META_LAYER: "true",
+        }
+        mounts = s.prepare("prep-meta", "", labels)
+        # default handler: native bind mount so containerd unpacks bootstrap
+        assert mounts[0].type == "bind"
+
+    def test_writable_layer_over_meta_mounts_remote(self, sn):
+        s, fs = sn
+        # commit a meta layer
+        meta_labels = {C.NYDUS_META_LAYER: "true"}
+        s.prepare("p-meta", "", {C.TARGET_SNAPSHOT_REF: "ref-x", **meta_labels})
+        s.commit("sha256:meta-committed", "p-meta", meta_labels)
+        # prepare the container writable layer above it
+        mounts = s.prepare("container-rw", "sha256:meta-committed")
+        meta_sid, _, _ = s.ms.get_info("sha256:meta-committed")
+        assert ("mount", meta_sid) in fs.calls
+        assert mounts[0].type == "overlay"
+        opts = " ".join(mounts[0].options)
+        assert f"/mnt/nydus/{meta_sid}" in opts  # rafs mountpoint as lowerdir
+        assert "workdir=" in opts and "upperdir=" in opts
+
+    def test_mounts_active_over_meta(self, sn):
+        s, fs = sn
+        meta_labels = {C.NYDUS_META_LAYER: "true"}
+        s.prepare("p-meta", "", {C.TARGET_SNAPSHOT_REF: "ref-y", **meta_labels})
+        s.commit("meta-c", "p-meta", meta_labels)
+        s.prepare("rw", "meta-c")
+        mounts = s.mounts("rw")
+        assert mounts[0].type == "overlay"
+
+    def test_view_of_meta_layer_mounts_on_demand(self, sn):
+        s, fs = sn
+        meta_labels = {C.NYDUS_META_LAYER: "true"}
+        s.prepare("p-m", "", {C.TARGET_SNAPSHOT_REF: "ref-z", **meta_labels})
+        s.commit("meta-v", "p-m", meta_labels)
+        meta_sid, _, _ = s.ms.get_info("meta-v")
+        mounts = s.view("view-1", "meta-v")
+        # daemon was not running → View triggers fs.mount itself
+        assert ("mount", meta_sid) in fs.calls
+        assert mounts[0].type == "overlay"
+
+    def test_view_of_data_layer_rejected(self, sn):
+        s, fs = sn
+        with pytest.raises(errdefs.AlreadyExists):
+            s.prepare("p-d", "", {C.TARGET_SNAPSHOT_REF: "d-ref", C.NYDUS_DATA_LAYER: "y"})
+        with pytest.raises(errdefs.InvalidArgument):
+            s.view("view-d", "d-ref")
+
+    def test_remove_and_cleanup_orphans(self, sn, tmp_path):
+        s, fs = sn
+        s.prepare("gone", "")
+        sid = s.ms.get_snapshot("gone").id
+        s.remove("gone")
+        # directory is orphaned until Cleanup
+        assert os.path.isdir(s.snapshot_dir(sid))
+        s.cleanup()
+        assert not os.path.isdir(s.snapshot_dir(sid))
+
+    def test_sync_remove(self, tmp_path):
+        fs = FakeFs()
+        s = Snapshotter(root=str(tmp_path), fs=fs, sync_remove=True)
+        s.prepare("x", "")
+        sid = s.ms.get_snapshot("x").id
+        s.remove("x")
+        assert not os.path.isdir(s.snapshot_dir(sid))
+        s.close()
+
+    def test_usage_active_counts_upper(self, sn):
+        s, fs = sn
+        s.prepare("u", "")
+        sid = s.ms.get_snapshot("u").id
+        with open(os.path.join(s.upper_path(sid), "f.bin"), "wb") as f:
+            f.write(b"x" * 1234)
+        u = s.usage("u")
+        assert u.size == 1234 and u.inodes == 1
+
+    def test_usage_committed_nydus_adds_cache(self, sn):
+        s, fs = sn
+        labels = {C.NYDUS_DATA_LAYER: "true", C.CRI_LAYER_DIGEST: "sha256:blob"}
+        s.prepare("c", "")
+        s.commit("c-committed", "c", labels)
+        u = s.usage("c-committed")
+        assert u.size >= 42  # cache usage added
+
+    def test_proxy_driver_mounts(self, tmp_path):
+        fs = FakeFs()
+        s = Snapshotter(root=str(tmp_path), fs=fs, fs_driver=C.FS_DRIVER_PROXY)
+        labels = {C.TARGET_SNAPSHOT_REF: "t-proxy", C.CRI_LAYER_DIGEST: "sha256:d"}
+        with pytest.raises(errdefs.AlreadyExists):
+            s.prepare("pp", "", labels)
+        _, info, _ = s.ms.get_info("t-proxy")
+        assert info.labels.get(C.NYDUS_PROXY_MODE) == "true"
+        s.close()
+
+    def test_stargz_layer_routing(self, tmp_path):
+        fs = FakeFs()
+        fs.stargz = True
+
+        class Blob:
+            pass
+
+        fs.is_stargz_data_layer = lambda labels: (True, Blob())
+        s = Snapshotter(root=str(tmp_path), fs=fs)
+        labels = {C.TARGET_SNAPSHOT_REF: "t-sgz"}
+        with pytest.raises(errdefs.AlreadyExists):
+            s.prepare("sgz", "", labels)
+        _, info, _ = s.ms.get_info("t-sgz")
+        assert info.labels.get(C.STARGZ_LAYER) == "true"
+        s.close()
+
+    def test_tarfs_layer_routing(self, tmp_path):
+        fs = FakeFs()
+        fs.tarfs = True
+        s = Snapshotter(root=str(tmp_path), fs=fs)
+        labels = {C.TARGET_SNAPSHOT_REF: "t-tarfs"}
+        with pytest.raises(errdefs.AlreadyExists):
+            s.prepare("tfs", "", labels)
+        assert any(c[0] == "prepare_tarfs" for c in fs.calls)
+        s.close()
+
+    def test_extra_options_mount(self, tmp_path):
+        fs = FakeFs()
+        s = Snapshotter(root=str(tmp_path), fs=fs, enable_nydus_overlayfs=True)
+        meta_labels = {C.NYDUS_META_LAYER: "true"}
+        s.prepare("m", "", {C.TARGET_SNAPSHOT_REF: "m-ref", **meta_labels})
+        s.commit("m-c", "m", meta_labels)
+        mounts = s.prepare("rw2", "m-c")
+        assert mounts[0].type == "fuse.nydus-overlayfs"
+        assert any(o.startswith("extraoption=") for o in mounts[0].options)
+        s.close()
