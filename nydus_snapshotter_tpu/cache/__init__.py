@@ -1,0 +1,5 @@
+"""Blob-cache accounting and GC (reference pkg/cache)."""
+
+from nydus_snapshotter_tpu.cache.manager import CacheManager
+
+__all__ = ["CacheManager"]

@@ -78,6 +78,7 @@ LAYER_ANNOTATION_UNCOMPRESSED = "containerd.io/uncompressed"
 CRI_IMAGE_REF = "containerd.io/snapshot/cri.image-ref"
 CRI_LAYER_DIGEST = "containerd.io/snapshot/cri.layer-digest"
 CRI_IMAGE_LAYERS = "containerd.io/snapshot/cri.image-layers"
+CRI_MANIFEST_DIGEST = "containerd.io/snapshot/cri.manifest-digest"
 TARGET_SNAPSHOT_REF = "containerd.io/snapshot.ref"
 
 # Labels that drive the per-layer processor choice

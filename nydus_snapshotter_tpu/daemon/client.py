@@ -108,6 +108,14 @@ class NydusdClient:
     def umount(self, mountpoint: str) -> None:
         self._request("DELETE", f"/api/v1/mount?mountpoint={mountpoint}")
 
+    # -- fscache v2 blobs API (reference client.go:47-58) --------------------
+
+    def bind_blob(self, daemon_config: str) -> None:
+        self._request("PUT", "/api/v2/blobs", {"config": daemon_config})
+
+    def unbind_blob(self, domain_id: str, blob_id: str) -> None:
+        self._request("DELETE", f"/api/v2/blobs?domain_id={domain_id}&blob_id={blob_id}")
+
     # -- metrics ------------------------------------------------------------
 
     def fs_metrics(self, mountpoint: str = "") -> dict[str, Any]:

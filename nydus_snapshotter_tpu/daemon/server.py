@@ -107,6 +107,7 @@ class DaemonServer:
         self.workdir = workdir or os.getcwd()
         self.state = DaemonState.INIT
         self.instances: dict[str, _Instance] = {}
+        self.bound_blobs: set[str] = set()
         self._lock = threading.RLock()
         self._httpd: Optional[socketserver.ThreadingMixIn] = None
         self._started_in_upgrade = upgrade
@@ -309,6 +310,13 @@ class DaemonServer:
                         self._reply(204)
                     except Exception as e:
                         self._reply(500, {"error": str(e)})
+                elif u.path == "/api/v2/blobs":
+                    try:
+                        body = json.loads(self._body() or b"{}")
+                        daemon.bind_blob(body.get("config", ""))
+                        self._reply(204)
+                    except Exception as e:
+                        self._reply(400, {"error": str(e)})
                 else:
                     self._reply(404, {"error": f"no route {u.path}"})
 
@@ -322,6 +330,11 @@ class DaemonServer:
                         self._reply(204)
                     except KeyError:
                         self._reply(404, {"error": f"{mp} not mounted"})
+                elif u.path == "/api/v2/blobs":
+                    daemon.unbind_blob(
+                        q.get("domain_id", [""])[0], q.get("blob_id", [""])[0]
+                    )
+                    self._reply(204)
                 else:
                     self._reply(404, {"error": f"no route {u.path}"})
 
@@ -371,6 +384,21 @@ class DaemonServer:
         with self._lock:
             del self.instances[mountpoint]
         self._push_state_async()
+
+    # -- fscache v2 blobs (reference nydusd /api/v2/blobs) -------------------
+
+    def bind_blob(self, daemon_config: str) -> None:
+        with self._lock:
+            try:
+                blob_id = json.loads(daemon_config or "{}").get("id", "")
+            except ValueError:
+                blob_id = ""
+            if blob_id:
+                self.bound_blobs.add(blob_id)
+
+    def unbind_blob(self, domain_id: str, blob_id: str) -> None:
+        with self._lock:
+            self.bound_blobs.discard(blob_id)
 
     def _push_state_async(self) -> None:
         """Keep the supervisor's saved session current after every mount

@@ -1,0 +1,5 @@
+"""L3 filesystem abstraction (reference pkg/filesystem)."""
+
+from nydus_snapshotter_tpu.filesystem.fs import Filesystem
+
+__all__ = ["Filesystem"]

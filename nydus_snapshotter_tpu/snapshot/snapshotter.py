@@ -421,6 +421,7 @@ class Snapshotter:
         base_labels = dict(snap_labels or {})
         td = tempfile.mkdtemp(prefix="new-", dir=self.snapshot_root())
         path = ""
+        s: Optional[Snapshot] = None
         try:
             os.makedirs(os.path.join(td, "fs"), exist_ok=True)
             if kind == ms.KIND_ACTIVE:
@@ -435,6 +436,15 @@ class Snapshotter:
             path = self.snapshot_dir(s.id)
             os.rename(td, path)
             td = ""
+        except BaseException:
+            # Roll back the metastore row so a retried prepare(key) isn't
+            # poisoned with AlreadyExists (the reference's bolt txn rollback).
+            if s is not None:
+                try:
+                    self.ms.remove(key)
+                except errdefs.NydusError:
+                    pass
+            raise
         finally:
             if td:
                 shutil.rmtree(td, ignore_errors=True)

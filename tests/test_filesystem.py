@@ -1,0 +1,279 @@
+"""L3 filesystem facade + blob-cache manager tests.
+
+Covers the reference behaviors of pkg/filesystem/fs.go (mount/umount with
+shared and dedicated daemons, ref-counted teardown, wait-until-ready,
+extraoption assembly, startup recovery) and pkg/cache/manager.go (usage
+accounting and blob-cache removal) without kernel mounts — the daemon is
+the userspace nydusd-equivalent server.
+"""
+
+import io
+import json
+import os
+import signal
+import tarfile
+import time
+
+import pytest
+
+from nydus_snapshotter_tpu import constants as C
+from nydus_snapshotter_tpu.cache.manager import CacheManager
+from nydus_snapshotter_tpu.config.config import SnapshotterConfig
+from nydus_snapshotter_tpu.config.daemonconfig import DaemonRuntimeConfig
+from nydus_snapshotter_tpu.filesystem import Filesystem
+from nydus_snapshotter_tpu.manager.manager import Manager
+from nydus_snapshotter_tpu.store.database import Database
+from nydus_snapshotter_tpu.utils import errdefs
+
+
+def _mk_cfg(tmp_path) -> SnapshotterConfig:
+    root = str(tmp_path / "r")
+    os.makedirs(root, exist_ok=True)
+    cfg = SnapshotterConfig(root=root)
+    cfg.validate()
+    return cfg
+
+
+def _mk_fs(tmp_path, daemon_mode=C.DAEMON_MODE_SHARED) -> tuple[Filesystem, Manager]:
+    cfg = _mk_cfg(tmp_path)
+    cfg.daemon_mode = daemon_mode
+    db = Database(cfg.database_path)
+    mgr = Manager(cfg, db, fs_driver=C.FS_DRIVER_FUSEDEV)
+    fs = Filesystem(
+        managers={C.FS_DRIVER_FUSEDEV: mgr},
+        cache_mgr=CacheManager(cfg.cache_root),
+        root=cfg.root,
+        fs_driver=C.FS_DRIVER_FUSEDEV,
+        daemon_mode=daemon_mode,
+        daemon_config=DaemonRuntimeConfig.from_dict({}, C.FS_DRIVER_FUSEDEV),
+    )
+    return fs, mgr
+
+
+_BOOTSTRAP_CACHE: dict = {}
+
+
+def _tiny_bootstrap() -> bytes:
+    """One real (tiny) merged bootstrap, built once per test session."""
+    if "boot" not in _BOOTSTRAP_CACHE:
+        from nydus_snapshotter_tpu.converter import Merge, MergeOption, PackOption, pack_layer
+
+        out = io.BytesIO()
+        with tarfile.open(fileobj=out, mode="w:") as tf:
+            info = tarfile.TarInfo("etc/hello.txt")
+            data = b"hello\n"
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+        blob, _res = pack_layer(out.getvalue(), PackOption(chunk_size=0x1000, backend="numpy"))
+        merged = Merge([blob], MergeOption())
+        _BOOTSTRAP_CACHE["boot"] = merged.bootstrap
+    return _BOOTSTRAP_CACHE["boot"]
+
+
+def _mk_snapshot_dir(fs: Filesystem, snapshot_id: str) -> str:
+    snap_dir = os.path.join(fs.root, "snapshots", snapshot_id)
+    os.makedirs(os.path.join(snap_dir, "fs", "image"), exist_ok=True)
+    boot = os.path.join(snap_dir, "fs", "image", "image.boot")
+    with open(boot, "wb") as f:
+        f.write(_tiny_bootstrap())
+    return snap_dir
+
+
+LABELS = {C.CRI_IMAGE_REF: "registry.example/app:1", C.NYDUS_META_LAYER: "true"}
+
+
+class TestFilesystemSharedDaemon:
+    def test_mount_umount_refcount(self, tmp_path):
+        fs, mgr = _mk_fs(tmp_path)
+        try:
+            fs.startup()
+            shared = fs.get_shared_daemon(C.FS_DRIVER_FUSEDEV)
+            assert shared.ref_count() == 0
+
+            _mk_snapshot_dir(fs, "s1")
+            _mk_snapshot_dir(fs, "s2")
+            fs.mount("s1", dict(LABELS))
+            fs.mount("s2", dict(LABELS))
+            assert shared.ref_count() == 2
+            fs.wait_until_ready("s1")
+            assert fs.mount_point("s1").endswith("/mnt/s1")
+            assert fs.bootstrap_file("s1").endswith("image/image.boot")
+
+            # instance records persisted with increasing seq
+            recs = [rec for rec, _seq in mgr.db.walk_instances()]
+            assert [r["snapshot_id"] for r in recs] == ["s1", "s2"]
+
+            # double mount is a no-op
+            fs.mount("s1", dict(LABELS))
+            assert shared.ref_count() == 2
+
+            fs.umount("s1")
+            assert shared.ref_count() == 1
+            with pytest.raises(errdefs.NotFound):
+                fs.mount_point("s1")
+            # shared daemon survives while referenced
+            fs.try_stop_shared_daemon()
+            assert fs.shared_daemons
+
+            fs.umount("s2")
+            fs.try_stop_shared_daemon()
+            assert not fs.shared_daemons
+        finally:
+            fs.teardown()
+            mgr.stop()
+
+    def test_extra_option(self, tmp_path):
+        fs, mgr = _mk_fs(tmp_path)
+        try:
+            fs.startup()
+            _mk_snapshot_dir(fs, "s1")
+            fs.mount("s1", dict(LABELS))
+            eo = fs.get_instance_extra_option("s1")
+            assert eo is not None
+            assert eo.source.endswith("image/image.boot")
+            cfg = json.loads(eo.config)
+            assert cfg["device"]["backend"]["config"]["repo"] == "app"
+            assert eo.snapshotdir.endswith("/snapshots/s1")
+        finally:
+            fs.teardown()
+            mgr.stop()
+
+    def test_missing_image_ref_rejected(self, tmp_path):
+        fs, mgr = _mk_fs(tmp_path)
+        try:
+            fs.startup()
+            _mk_snapshot_dir(fs, "sX")
+            with pytest.raises(errdefs.InvalidArgument):
+                fs.mount("sX", {})
+        finally:
+            fs.teardown()
+            mgr.stop()
+
+    def test_startup_recovery_replays_mounts(self, tmp_path):
+        fs, mgr = _mk_fs(tmp_path)
+        fs.startup()
+        _mk_snapshot_dir(fs, "s1")
+        fs.mount("s1", dict(LABELS))
+        shared = fs.get_shared_daemon(C.FS_DRIVER_FUSEDEV)
+        pid = shared.pid
+        # hard-kill the daemon and forget everything in-process
+        os.kill(pid, signal.SIGKILL)
+        shared.wait(timeout=5)
+        mgr.stop()
+
+        # a fresh manager + facade over the same db recovers and replays
+        cfg = SnapshotterConfig(root=fs.root)
+        db2 = Database(cfg.database_path)
+        mgr2 = Manager(cfg, db2, fs_driver=C.FS_DRIVER_FUSEDEV)
+        fs2 = Filesystem(
+            managers={C.FS_DRIVER_FUSEDEV: mgr2},
+            cache_mgr=CacheManager(cfg.cache_root),
+            root=cfg.root,
+            fs_driver=C.FS_DRIVER_FUSEDEV,
+            daemon_mode=C.DAEMON_MODE_SHARED,
+            daemon_config=DaemonRuntimeConfig.from_dict({}, C.FS_DRIVER_FUSEDEV),
+        )
+        try:
+            fs2.startup()
+            # the instance is back and the daemon serves it
+            fs2.wait_until_ready("s1")
+            d = fs2.get_shared_daemon(C.FS_DRIVER_FUSEDEV)
+            assert d.ref_count() == 1
+        finally:
+            fs2.teardown()
+            mgr2.stop()
+
+
+class TestFilesystemDedicated:
+    def test_dedicated_daemon_per_snapshot(self, tmp_path):
+        fs, mgr = _mk_fs(tmp_path, daemon_mode=C.DAEMON_MODE_DEDICATED)
+        try:
+            fs.startup()
+            assert not fs.shared_daemons  # dedicated mode: no shared daemon
+            _mk_snapshot_dir(fs, "d1")
+            fs.mount("d1", dict(LABELS))
+            fs.wait_until_ready("d1")
+            rafs = fs.instances.get("d1")
+            assert rafs.daemon_id == "nydusd-d1"
+            assert fs.mount_point("d1").endswith("/snapshots/d1/mnt")
+            # umount destroys the dedicated daemon at refcount zero
+            fs.umount("d1")
+            assert mgr.get_by_daemon_id("nydusd-d1") is None
+        finally:
+            fs.teardown()
+            mgr.stop()
+
+
+class TestFilesystemProxyNodev:
+    def test_proxy_mode_annotations(self, tmp_path):
+        cfg = _mk_cfg(tmp_path)
+        fs = Filesystem(
+            managers={},
+            cache_mgr=CacheManager(cfg.cache_root),
+            root=cfg.root,
+            fs_driver=C.FS_DRIVER_PROXY,
+            daemon_mode=C.DAEMON_MODE_NONE,
+        )
+        labels = {
+            C.NYDUS_PROXY_MODE: "true",
+            C.CRI_LAYER_DIGEST: "sha256:" + "0" * 64,
+        }
+        fs.mount("p1", labels)
+        rafs = fs.instances.get("p1")
+        assert rafs.annotations[C.NYDUS_PROXY_MODE] == "true"
+        assert rafs.mountpoint.endswith("/snapshots/p1/fs")
+        fs.umount("p1")
+        assert fs.instances.get("p1") is None
+
+    def test_wait_until_ready_none_mode(self, tmp_path):
+        cfg = _mk_cfg(tmp_path)
+        fs = Filesystem(
+            managers={},
+            cache_mgr=CacheManager(cfg.cache_root),
+            root=cfg.root,
+            daemon_mode=C.DAEMON_MODE_NONE,
+        )
+        fs.wait_until_ready("missing")  # no-op in none mode
+        fs2 = Filesystem(
+            managers={},
+            cache_mgr=CacheManager(cfg.cache_root),
+            root=cfg.root,
+            daemon_mode=C.DAEMON_MODE_SHARED,
+        )
+        with pytest.raises(errdefs.NotFound):
+            fs2.wait_until_ready("missing")
+
+
+class TestCacheManager:
+    def test_usage_and_remove(self, tmp_path):
+        cm = CacheManager(str(tmp_path / "cache"))
+        blob_id = "a" * 64
+        for sfx, size in (("", 10), (".blob.data", 100), (".chunk_map", 5)):
+            with open(os.path.join(cm.cache_dir, blob_id + sfx), "wb") as f:
+                f.write(b"x" * size)
+        u = cm.cache_usage(blob_id)
+        assert u.size == 115 and u.inodes == 3
+        cm.remove_blob_cache(blob_id)
+        assert cm.cache_usage(blob_id).size == 0
+        assert cm.total_usage().inodes == 0
+
+    def test_gc_once(self, tmp_path):
+        cm = CacheManager(str(tmp_path / "cache"))
+        p = os.path.join(cm.cache_dir, "b" * 64 + ".blob.data")
+        with open(p, "wb") as f:
+            f.write(b"data")
+        old = time.time() - 3600
+        os.utime(p, (old, old))
+        removed = cm.gc_once(max_age_sec=60)
+        assert removed == [p]
+        assert not os.path.exists(p)
+
+    def test_fs_cache_usage_digest_validation(self, tmp_path):
+        cfg = _mk_cfg(tmp_path)
+        fs = Filesystem(
+            managers={}, cache_mgr=CacheManager(cfg.cache_root), root=cfg.root
+        )
+        with pytest.raises(errdefs.InvalidArgument):
+            fs.cache_usage("not-a-digest")
+        u = fs.cache_usage("sha256:" + "c" * 64)
+        assert u.size == 0
