@@ -1,0 +1,1 @@
+"""gRPC API surface (containerd snapshots.v1-compatible)."""

@@ -1,0 +1,179 @@
+"""Snapshotter process entry (reference cmd/containerd-nydus-grpc).
+
+Flow mirrors main.go:25-81 + snapshotter.go:30-94: parse flags, layer them
+over the TOML config and defaults, validate, set up logging, assemble the
+stack (store → managers → filesystem → snapshotter), then serve the
+containerd snapshots.v1 gRPC API on a UDS until SIGTERM/SIGINT.
+
+Run: ``python -m nydus_snapshotter_tpu.cmd.snapshotter --root <dir>
+--address <dir>/grpc.sock``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+from nydus_snapshotter_tpu import constants as C
+from nydus_snapshotter_tpu.api import service as grpc_service
+from nydus_snapshotter_tpu.cache.manager import CacheManager
+from nydus_snapshotter_tpu.config.config import SnapshotterConfig, load_config
+from nydus_snapshotter_tpu.config.daemonconfig import DaemonRuntimeConfig
+from nydus_snapshotter_tpu.filesystem import Filesystem
+from nydus_snapshotter_tpu.manager.manager import Manager
+from nydus_snapshotter_tpu.snapshot.snapshotter import Snapshotter
+from nydus_snapshotter_tpu.store.database import Database
+
+logger = logging.getLogger("nydus-snapshotter-tpu")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    # Flag surface mirrors internal/flags/flags.go:36-107.
+    p = argparse.ArgumentParser(prog="containerd-nydus-grpc-tpu")
+    p.add_argument("--config", default="", help="path to TOML config")
+    p.add_argument("--root", default="", help="snapshotter state root directory")
+    p.add_argument("--address", default="", help="gRPC UDS path for containerd")
+    p.add_argument("--daemon-mode", default="", choices=["", "shared", "dedicated", "none"])
+    p.add_argument(
+        "--fs-driver", default="", choices=["", *C.FS_DRIVERS], help="filesystem driver"
+    )
+    p.add_argument(
+        "--recover-policy", default="", choices=["", "none", "restart", "failover"]
+    )
+    p.add_argument("--log-level", default="", help="trace|debug|info|warn|error")
+    p.add_argument("--log-to-stdout", action="store_true", default=None)
+    p.add_argument("--nydusd-config", default="", help="daemon config JSON template")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> SnapshotterConfig:
+    overrides: dict = {}
+    if args.root:
+        overrides["root"] = args.root
+    if args.address:
+        overrides["address"] = args.address
+    if args.daemon_mode:
+        overrides["daemon_mode"] = args.daemon_mode
+    daemon_over: dict = {}
+    if args.fs_driver:
+        daemon_over["fs_driver"] = args.fs_driver
+    if args.recover_policy:
+        daemon_over["recover_policy"] = args.recover_policy
+    if args.nydusd_config:
+        daemon_over["nydusd_config_path"] = args.nydusd_config
+    if daemon_over:
+        overrides["daemon"] = daemon_over
+    log_over: dict = {}
+    if args.log_level:
+        log_over["log_level"] = args.log_level
+    if args.log_to_stdout is not None:
+        log_over["log_to_stdout"] = args.log_to_stdout
+    if log_over:
+        overrides["log"] = log_over
+    return load_config(args.config or None, overrides)
+
+
+def setup_logging(cfg: SnapshotterConfig) -> None:
+    level = getattr(logging, cfg.log.log_level.upper(), logging.INFO)
+    handlers: list[logging.Handler] = []
+    if cfg.log.log_to_stdout:
+        handlers.append(logging.StreamHandler(sys.stderr))
+    if cfg.log.log_dir:
+        os.makedirs(cfg.log.log_dir, exist_ok=True)
+        from logging.handlers import RotatingFileHandler
+
+        handlers.append(
+            RotatingFileHandler(
+                os.path.join(cfg.log.log_dir, "nydus-snapshotter.log"),
+                maxBytes=cfg.log.rotate_log_max_size * (1 << 20),
+                backupCount=cfg.log.rotate_log_max_backups,
+            )
+        )
+    logging.basicConfig(
+        level=level,
+        handlers=handlers or None,
+        format="%(asctime)s %(levelname).1s %(name)s %(message)s",
+    )
+
+
+def build_stack(cfg: SnapshotterConfig):
+    """Assemble store → managers → filesystem → snapshotter
+    (reference snapshot.NewSnapshotter snapshot.go:64-299)."""
+    os.makedirs(cfg.root, exist_ok=True)
+    db = Database(cfg.database_path)
+
+    daemon_config = None
+    if os.path.exists(cfg.daemon.nydusd_config_path):
+        daemon_config = DaemonRuntimeConfig.from_template(
+            cfg.daemon.nydusd_config_path, cfg.daemon.fs_driver
+        )
+    else:
+        daemon_config = DaemonRuntimeConfig.from_dict({}, cfg.daemon.fs_driver)
+
+    managers: dict[str, Manager] = {}
+    if cfg.daemon.fs_driver in (C.FS_DRIVER_FUSEDEV, C.FS_DRIVER_FSCACHE):
+        mgr = Manager(cfg, db, fs_driver=cfg.daemon.fs_driver)
+        mgr.run_death_handler()
+        managers[cfg.daemon.fs_driver] = mgr
+
+    fs = Filesystem(
+        managers=managers,
+        cache_mgr=CacheManager(cfg.cache_root, enabled=cfg.cache_manager.enable),
+        root=cfg.root,
+        fs_driver=cfg.daemon.fs_driver,
+        daemon_mode=cfg.daemon_mode,
+        daemon_config=daemon_config,
+    )
+    fs.startup()
+
+    sn = Snapshotter(
+        root=cfg.root,
+        fs=fs,
+        fs_driver=cfg.daemon.fs_driver,
+        enable_nydus_overlayfs=cfg.snapshot.enable_nydus_overlayfs,
+        daemon_mode=cfg.daemon_mode,
+        sync_remove=cfg.snapshot.sync_remove,
+        cleanup_on_close=cfg.cleanup_on_close,
+    )
+    return sn, fs, managers, db
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    setup_logging(cfg)
+
+    sn, fs, managers, _db = build_stack(cfg)
+
+    address = cfg.address
+    os.makedirs(os.path.dirname(address) or ".", exist_ok=True)
+    if os.path.exists(address):
+        # ensureSocketNotExists (snapshotter.go:96-117)
+        os.unlink(address)
+    server = grpc_service.serve(sn, address)
+    logger.info("serving snapshots.v1 on unix:%s (driver=%s mode=%s)",
+                address, cfg.daemon.fs_driver, cfg.daemon_mode)
+
+    stop = threading.Event()
+
+    def _on_signal(_signum, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    try:
+        stop.wait()
+    finally:
+        server.stop(grace=2).wait()
+        sn.close()
+        for mgr in managers.values():
+            mgr.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -90,6 +90,7 @@ class Filesystem:
         shared daemon exists for shared-mode drivers."""
         for mgr in self.managers.values():
             live, dead = mgr.recover()
+            failed: set[str] = set()
             for d in live + dead:
                 if d.is_shared() or d.states.fs_driver == C.FS_DRIVER_FSCACHE:
                     self.shared_daemons.setdefault(d.states.fs_driver, d)
@@ -106,12 +107,27 @@ class Filesystem:
                     if self.shared_daemons.get(d.states.fs_driver) is d:
                         self.shared_daemons.pop(d.states.fs_driver, None)
                     mgr.remove_daemon(d.id)
+                    failed.add(d.id)
             for rafs_dict in self._walk_instances(mgr):
                 rafs = Rafs.from_dict(rafs_dict)
+                if rafs.daemon_id in failed:
+                    # No daemon serves this snapshot anymore; drop the record
+                    # so a later mount() re-creates it instead of silently
+                    # handing out a mountpoint nothing backs.
+                    mgr.db.delete_instance(rafs.snapshot_id)
+                    continue
                 self.instances.add(rafs)
-        if self.daemon_mode == C.DAEMON_MODE_SHARED and self.fs_driver in self.managers:
-            if self.fs_driver not in self.shared_daemons:
-                self.init_shared_daemon(self.managers[self.fs_driver])
+        # fscache always runs through one shared daemon (fs.go:102-121); for
+        # fusedev a shared daemon exists only in shared mode.
+        if C.FS_DRIVER_FSCACHE in self.managers and C.FS_DRIVER_FSCACHE not in self.shared_daemons:
+            self.init_shared_daemon(self.managers[C.FS_DRIVER_FSCACHE])
+        if (
+            self.daemon_mode == C.DAEMON_MODE_SHARED
+            and self.fs_driver == C.FS_DRIVER_FUSEDEV
+            and self.fs_driver in self.managers
+            and self.fs_driver not in self.shared_daemons
+        ):
+            self.init_shared_daemon(self.managers[self.fs_driver])
 
     def _walk_instances(self, mgr: Manager):
         """Yield persisted instance dicts in seq (replay) order."""
@@ -150,6 +166,10 @@ class Filesystem:
     def try_stop_shared_daemon(self) -> None:
         """Stop shared daemons not referenced by any snapshot
         (fs.go TryStopSharedDaemon)."""
+        with self._lock:
+            self._try_stop_shared_locked()
+
+    def _try_stop_shared_locked(self) -> None:
         for fs_driver, d in list(self.shared_daemons.items()):
             if d.ref_count() == 0:
                 mgr = self.managers.get(fs_driver)
@@ -184,6 +204,12 @@ class Filesystem:
     # -- mount/umount (fs.go:268-500) ----------------------------------------
 
     def mount(self, snapshot_id: str, snap_labels: dict, snapshot=None) -> None:
+        # Serialized: concurrent Prepare RPCs for one snapshot must not both
+        # pass the exists-check and race shared_mount/rollback.
+        with self._lock:
+            self._mount_locked(snapshot_id, snap_labels, snapshot)
+
+    def _mount_locked(self, snapshot_id: str, snap_labels: dict, snapshot=None) -> None:
         if self.instances.get(snapshot_id) is not None:
             return  # instance already exists
 
@@ -222,11 +248,17 @@ class Filesystem:
                 mgr = self.managers.get(rafs.fs_driver)
                 if mgr is not None:
                     orphan = mgr.get_by_daemon_id(rafs.daemon_id)
-                    if orphan is not None and orphan.ref_count() == 0:
-                        try:
-                            mgr.destroy_daemon(orphan)
-                        except Exception:
-                            logger.exception("failed to clean up daemon %s", rafs.daemon_id)
+                    if orphan is not None:
+                        # This mount's own instance may already be attached;
+                        # detach it so the refcount reflects other users only.
+                        orphan.remove_rafs_instance(snapshot_id)
+                        if orphan.ref_count() == 0:
+                            try:
+                                mgr.destroy_daemon(orphan)
+                            except Exception:
+                                logger.exception(
+                                    "failed to clean up daemon %s", rafs.daemon_id
+                                )
             raise
 
     def _mount_rafs(self, rafs, fs_driver, use_shared, snap_labels, snapshot) -> None:
@@ -303,6 +335,10 @@ class Filesystem:
             mgr.db.save_instance(rafs.snapshot_id, rafs.to_dict(), rafs.seq)
 
     def umount(self, snapshot_id: str) -> None:
+        with self._lock:
+            self._umount_locked(snapshot_id)
+
+    def _umount_locked(self, snapshot_id: str) -> None:
         rafs = self.instances.get(snapshot_id)
         if rafs is None:
             return
@@ -395,8 +431,9 @@ class Filesystem:
         blob_id = _digest_hex(blob_digest)
         fscache = self.shared_daemons.get(C.FS_DRIVER_FSCACHE)
         if fscache is not None:
+            # Unbind first so the daemon drops its handle, then reclaim the
+            # on-disk cache files.
             fscache.client().unbind_blob("", blob_id)
-            return
         self.cache_mgr.remove_blob_cache(blob_id)
 
     # -- teardown ------------------------------------------------------------

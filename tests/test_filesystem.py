@@ -204,6 +204,33 @@ class TestFilesystemDedicated:
             mgr.stop()
 
 
+class TestFilesystemFscache:
+    def test_fscache_always_gets_shared_daemon(self, tmp_path):
+        """fscache runs through one shared daemon even in dedicated mode
+        (fs.go:102-121)."""
+        cfg = _mk_cfg(tmp_path)
+        db = Database(cfg.database_path)
+        mgr = Manager(cfg, db, fs_driver=C.FS_DRIVER_FSCACHE)
+        fs = Filesystem(
+            managers={C.FS_DRIVER_FSCACHE: mgr},
+            cache_mgr=CacheManager(cfg.cache_root),
+            root=cfg.root,
+            fs_driver=C.FS_DRIVER_FSCACHE,
+            daemon_mode=C.DAEMON_MODE_DEDICATED,
+            daemon_config=DaemonRuntimeConfig.from_dict({}, C.FS_DRIVER_FSCACHE),
+        )
+        try:
+            fs.startup()
+            assert C.FS_DRIVER_FSCACHE in fs.shared_daemons
+            _mk_snapshot_dir(fs, "fc1")
+            fs.mount("fc1", dict(LABELS))
+            assert fs.get_shared_daemon(C.FS_DRIVER_FSCACHE).ref_count() == 1
+            fs.umount("fc1")
+        finally:
+            fs.teardown()
+            mgr.stop()
+
+
 class TestFilesystemProxyNodev:
     def test_proxy_mode_annotations(self, tmp_path):
         cfg = _mk_cfg(tmp_path)

@@ -1,0 +1,121 @@
+"""snapshots.v1 gRPC service tests: in-process server over a UDS, driven
+the way containerd's proxy plugin would (reference serves the same API via
+snapshotservice.FromSnapshotter, cmd/containerd-nydus-grpc/snapshotter.go).
+"""
+
+import os
+
+import grpc
+import pytest
+
+from nydus_snapshotter_tpu import constants as C
+from nydus_snapshotter_tpu.api import snapshots_pb2 as pb
+from nydus_snapshotter_tpu.api.client import SnapshotsClient
+from nydus_snapshotter_tpu.api.service import serve
+from nydus_snapshotter_tpu.snapshot.snapshotter import Snapshotter
+
+from tests.test_snapshotter import FakeFs
+
+
+@pytest.fixture
+def rig(tmp_path):
+    fs = FakeFs()
+    sn = Snapshotter(root=str(tmp_path / "root"), fs=fs)
+    sock = str(tmp_path / "grpc.sock")
+    server = serve(sn, sock)
+    client = SnapshotsClient(sock, timeout=10.0)
+    yield client, sn, fs
+    client.close()
+    server.stop(grace=None)
+    sn.close()
+
+
+class TestSnapshotsGrpc:
+    def test_prepare_commit_stat_list(self, rig):
+        client, sn, fs = rig
+        mounts = client.prepare("prep-1", "")
+        assert mounts[0].type == "bind" and "rw" in mounts[0].options
+
+        client.commit("layer-1", "prep-1", {"custom": "label"})
+        info = client.stat("layer-1")
+        assert info.kind == pb.COMMITTED
+        assert info.labels["custom"] == "label"
+        assert info.created_at.seconds > 0
+
+        names = {i.name for i in client.list()}
+        assert names == {"layer-1"}
+
+    def test_prepare_remote_snapshot_already_exists(self, rig):
+        client, sn, fs = rig
+        labels = {C.TARGET_SNAPSHOT_REF: "sha256:tgt", C.NYDUS_DATA_LAYER: "true"}
+        with pytest.raises(grpc.RpcError) as exc_info:
+            client.prepare("prep-data", "", labels)
+        assert exc_info.value.code() == grpc.StatusCode.ALREADY_EXISTS
+        # target got committed server-side
+        assert client.stat("sha256:tgt").kind == pb.COMMITTED
+
+    def test_mounts_and_usage(self, rig):
+        client, sn, fs = rig
+        client.prepare("active-1", "")
+        mounts = client.mounts("active-1")
+        assert mounts[0].type == "bind"
+        sid = sn.ms.get_snapshot("active-1").id
+        with open(os.path.join(sn.upper_path(sid), "blob"), "wb") as f:
+            f.write(b"z" * 512)
+        u = client.usage("active-1")
+        assert u.size == 512 and u.inodes == 1
+
+    def test_update_labels_with_field_mask(self, rig):
+        client, sn, fs = rig
+        client.prepare("u-1", "", {"a": "1"})
+        info = client.stat("u-1")
+        info.labels["b"] = "2"
+        out = client.update(info, "labels.b")
+        assert out.labels["a"] == "1" and out.labels["b"] == "2"
+
+    def test_remove_and_not_found(self, rig):
+        client, sn, fs = rig
+        client.prepare("gone", "")
+        client.remove("gone")
+        with pytest.raises(grpc.RpcError) as exc_info:
+            client.stat("gone")
+        assert exc_info.value.code() == grpc.StatusCode.NOT_FOUND
+        client.cleanup()  # orphan dir GC over gRPC
+
+    def test_view(self, rig):
+        client, sn, fs = rig
+        client.prepare("base-prep", "")
+        client.commit("base", "base-prep")
+        mounts = client.view("v-1", "base")
+        assert mounts[0].type == "bind" and "ro" in mounts[0].options
+
+
+class TestCliEntry:
+    def test_cli_builds_and_serves(self, tmp_path):
+        """Assemble the full stack through the CLI module (without exec)."""
+        from nydus_snapshotter_tpu.cmd.snapshotter import (
+            build_parser,
+            build_stack,
+            config_from_args,
+        )
+
+        root = str(tmp_path / "r")
+        args = build_parser().parse_args(
+            ["--root", root, "--address", str(tmp_path / "g.sock"),
+             "--daemon-mode", "none", "--fs-driver", "nodev", "--log-level", "warn"]
+        )
+        cfg = config_from_args(args)
+        assert cfg.root == root and cfg.daemon.fs_driver == "nodev"
+        sn, fs, managers, db = build_stack(cfg)
+        sock = str(tmp_path / "g.sock")
+        server = serve(sn, sock)
+        client = SnapshotsClient(sock, timeout=10.0)
+        try:
+            client.prepare("k1", "")
+            assert {i.name for i in client.list()} == {"k1"}
+        finally:
+            client.close()
+            server.stop(grace=None)
+            sn.close()
+            for m in managers.values():
+                m.stop()
