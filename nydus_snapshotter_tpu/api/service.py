@@ -17,6 +17,7 @@ import grpc
 from google.protobuf import empty_pb2
 
 from nydus_snapshotter_tpu.api import snapshots_pb2 as pb
+from nydus_snapshotter_tpu.api.filters import compile_filters
 from nydus_snapshotter_tpu.snapshot import metastore as ms
 from nydus_snapshotter_tpu.snapshot.metastore import Info, Usage
 from nydus_snapshotter_tpu.snapshot.snapshotter import Snapshotter
@@ -125,10 +126,10 @@ class SnapshotsService:
                 parent=req.info.parent,
                 labels=dict(req.info.labels),
             )
-            fieldpaths = [
-                p for p in req.update_mask.paths if p == "labels" or p.startswith("labels.")
-            ]
-            out = self.sn.update(info, *fieldpaths)
+            # Pass the mask through untouched: the metastore rejects
+            # unsupported paths with InvalidArgument; filtering here would
+            # turn an invalid mask into a destructive full replace.
+            out = self.sn.update(info, *req.update_mask.paths)
         except Exception as e:
             _abort_for(context, e)
         return pb.UpdateSnapshotResponse(info=_info_to_pb(out))
@@ -136,7 +137,10 @@ class SnapshotsService:
     def List(self, req: pb.ListSnapshotsRequest, context) -> Iterator[pb.ListSnapshotsResponse]:
         infos: list[pb.Info] = []
         try:
-            self.sn.walk(lambda _sid, info: infos.append(_info_to_pb(info)))
+            match = compile_filters(list(req.filters))
+            self.sn.walk(
+                lambda _sid, info: infos.append(_info_to_pb(info)) if match(info) else None
+            )
         except Exception as e:
             _abort_for(context, e)
         # containerd streams in batches; one batch is fine for our sizes.

@@ -1,0 +1,73 @@
+"""User/password keychain + the layered credential lookup.
+
+Reference pkg/auth/keychain.go:30-140.
+"""
+
+from __future__ import annotations
+
+import base64
+from dataclasses import dataclass
+from typing import Mapping, Optional
+
+from nydus_snapshotter_tpu import constants as C
+from nydus_snapshotter_tpu.remote.reference import parse_docker_ref
+
+
+@dataclass(frozen=True)
+class PassKeyChain:
+    username: str = ""
+    password: str = ""
+
+    def empty(self) -> bool:
+        return not self.username and not self.password
+
+    def token_base(self) -> bool:
+        """Token-based when only a password (= registry token) is present
+        (keychain.go:57-60)."""
+        return self.username == "" and self.password != ""
+
+    def to_base64(self) -> str:
+        if self.empty():
+            return ""
+        return base64.b64encode(f"{self.username}:{self.password}".encode()).decode()
+
+
+def from_base64(value: str) -> PassKeyChain:
+    decoded = base64.b64decode(value).decode()
+    pair = decoded.split(":")
+    if len(pair) != 2:
+        raise ValueError("invalid registry auth token")
+    return PassKeyChain(pair[0], pair[1])
+
+
+def from_labels(labels: Mapping[str, str]) -> Optional[PassKeyChain]:
+    """Image pull username/secret from snapshot labels
+    (keychain.go:63-80); None means nothing usable was passed."""
+    username = labels.get(C.NYDUS_IMAGE_PULL_USERNAME, "")
+    secret = labels.get(C.NYDUS_IMAGE_PULL_SECRET, "")
+    if not username or not secret:
+        return None
+    return PassKeyChain(username, secret)
+
+
+def get_registry_keychain(host: str, ref: str, labels: Mapping[str, str]) -> Optional[PassKeyChain]:
+    """Ordered lookup: labels, CRI proxy captures, docker config, k8s
+    secret store (keychain.go:85-105)."""
+    from nydus_snapshotter_tpu.auth import docker as docker_cfg
+    from nydus_snapshotter_tpu.auth import image_proxy, kubesecret
+
+    kc = from_labels(labels)
+    if kc is not None:
+        return kc
+    kc = image_proxy.from_cri(host, ref)
+    if kc is not None:
+        return kc
+    kc = docker_cfg.from_docker_config(host)
+    if kc is not None:
+        return kc
+    return kubesecret.from_kube_secret(host)
+
+
+def get_keychain_by_ref(ref: str, labels: Mapping[str, str]) -> Optional[PassKeyChain]:
+    parsed = parse_docker_ref(ref)
+    return get_registry_keychain(parsed.domain, ref, labels)

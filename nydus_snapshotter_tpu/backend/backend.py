@@ -1,0 +1,85 @@
+"""Backend interface + factory (reference pkg/backend/backend.go:31-57)."""
+
+from __future__ import annotations
+
+import json
+from abc import ABC, abstractmethod
+from typing import Union
+
+from nydus_snapshotter_tpu.utils import errdefs
+
+BACKEND_TYPE_OSS = "oss"
+BACKEND_TYPE_S3 = "s3"
+BACKEND_TYPE_LOCALFS = "localfs"
+
+# Default multipart part size (backend.go:24-28).
+MULTIPART_CHUNK_SIZE = 500 * 1024 * 1024
+
+BlobSource = Union[bytes, bytearray, str]  # raw bytes or a file path
+
+
+def _read_source(data: BlobSource) -> bytes:
+    if isinstance(data, (bytes, bytearray)):
+        return bytes(data)
+    with open(data, "rb") as f:
+        return f.read()
+
+
+def _source_size(data: BlobSource) -> int:
+    if isinstance(data, (bytes, bytearray)):
+        return len(data)
+    import os
+
+    return os.path.getsize(data)
+
+
+def _iter_parts(data: BlobSource, part_size: int):
+    """Yield part-sized byte chunks without loading file sources whole."""
+    if isinstance(data, (bytes, bytearray)):
+        for off in range(0, len(data), part_size):
+            yield bytes(data[off : off + part_size])
+        return
+    with open(data, "rb") as f:
+        while True:
+            part = f.read(part_size)
+            if not part:
+                return
+            yield part
+
+
+def digest_hex(digest: str) -> str:
+    return digest.split(":", 1)[-1]
+
+
+class Backend(ABC):
+    """Uploads conversion blobs to remote storage (backend.go:31-40)."""
+
+    @abstractmethod
+    def push(self, data: BlobSource, digest: str) -> None:
+        """Push blob content for ``digest`` (skip if present, unless
+        force_push)."""
+
+    @abstractmethod
+    def check(self, digest: str) -> str:
+        """Return the backend path/key if the blob exists; raise NotFound
+        otherwise."""
+
+    @abstractmethod
+    def type(self) -> str:
+        ...
+
+
+def new_backend(backend_type: str, config: bytes | str | dict, force_push: bool = False) -> Backend:
+    from nydus_snapshotter_tpu.backend.localfs import LocalFSBackend
+    from nydus_snapshotter_tpu.backend.oss import OSSBackend
+    from nydus_snapshotter_tpu.backend.s3 import S3Backend
+
+    if isinstance(config, (bytes, str)):
+        config = json.loads(config)
+    if backend_type == BACKEND_TYPE_OSS:
+        return OSSBackend(config, force_push)
+    if backend_type == BACKEND_TYPE_S3:
+        return S3Backend(config, force_push)
+    if backend_type == BACKEND_TYPE_LOCALFS:
+        return LocalFSBackend(config, force_push)
+    raise errdefs.InvalidArgument(f"unsupported backend type {backend_type}")

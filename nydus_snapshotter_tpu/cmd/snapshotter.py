@@ -81,7 +81,7 @@ def setup_logging(cfg: SnapshotterConfig) -> None:
     level = getattr(logging, cfg.log.log_level.upper(), logging.INFO)
     handlers: list[logging.Handler] = []
     if cfg.log.log_to_stdout:
-        handlers.append(logging.StreamHandler(sys.stderr))
+        handlers.append(logging.StreamHandler(sys.stdout))
     if cfg.log.log_dir:
         os.makedirs(cfg.log.log_dir, exist_ok=True)
         from logging.handlers import RotatingFileHandler

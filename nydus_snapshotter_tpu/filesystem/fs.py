@@ -81,7 +81,19 @@ class Filesystem:
         self._tarfs_export = tarfs_export
         self.instances = RafsCache()
         self.shared_daemons: dict[str, Daemon] = {}  # fs_driver -> shared daemon
-        self._lock = threading.RLock()
+        self._lock = threading.RLock()  # shared-daemon create/stop only
+        self._snap_locks: dict[str, threading.RLock] = {}
+        self._snap_locks_mu = threading.Lock()
+
+    def _snapshot_lock(self, snapshot_id: str) -> threading.RLock:
+        """Per-snapshot lock: concurrent Prepare/Remove for ONE snapshot
+        serialize, while mounts of unrelated snapshots proceed in parallel
+        (a slow daemon spawn must not stall every other RPC)."""
+        with self._snap_locks_mu:
+            lock = self._snap_locks.get(snapshot_id)
+            if lock is None:
+                lock = self._snap_locks[snapshot_id] = threading.RLock()
+            return lock
 
     # -- startup recovery (fs.go:58-194) -------------------------------------
 
@@ -204,9 +216,9 @@ class Filesystem:
     # -- mount/umount (fs.go:268-500) ----------------------------------------
 
     def mount(self, snapshot_id: str, snap_labels: dict, snapshot=None) -> None:
-        # Serialized: concurrent Prepare RPCs for one snapshot must not both
-        # pass the exists-check and race shared_mount/rollback.
-        with self._lock:
+        # Serialized per snapshot: concurrent Prepare RPCs for one snapshot
+        # must not both pass the exists-check and race shared_mount/rollback.
+        with self._snapshot_lock(snapshot_id):
             self._mount_locked(snapshot_id, snap_labels, snapshot)
 
     def _mount_locked(self, snapshot_id: str, snap_labels: dict, snapshot=None) -> None:
@@ -335,8 +347,10 @@ class Filesystem:
             mgr.db.save_instance(rafs.snapshot_id, rafs.to_dict(), rafs.seq)
 
     def umount(self, snapshot_id: str) -> None:
-        with self._lock:
+        with self._snapshot_lock(snapshot_id):
             self._umount_locked(snapshot_id)
+        with self._snap_locks_mu:
+            self._snap_locks.pop(snapshot_id, None)
 
     def _umount_locked(self, snapshot_id: str) -> None:
         rafs = self.instances.get(snapshot_id)

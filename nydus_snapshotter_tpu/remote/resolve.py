@@ -1,0 +1,32 @@
+"""High-level blob resolver: ref + digest + snapshot labels -> stream.
+
+Reference pkg/resolve/resolver.go:23-69: parse the ref, derive the
+keychain from labels/docker-config (auth.GetRegistryKeyChain), resolve an
+authenticated transport from the pool, GET the blob with retries.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+from nydus_snapshotter_tpu.remote.reference import parse_docker_ref
+from nydus_snapshotter_tpu.remote.transport import Pool
+from nydus_snapshotter_tpu.utils import retry as retry_lib
+
+
+class Resolver:
+    def __init__(self, plain_http: bool = False, insecure_tls: bool = False):
+        self._pool = Pool(plain_http=plain_http, insecure_tls=insecure_tls)
+
+    def resolve(self, ref: str, digest: str, labels: Optional[Mapping[str, str]] = None):
+        """Streaming reader over the blob ``digest`` of image ``ref``."""
+        from nydus_snapshotter_tpu.auth.keychain import get_registry_keychain
+
+        parsed = parse_docker_ref(ref)
+        keychain = get_registry_keychain(parsed.domain, ref, labels or {})
+
+        def fetch():
+            _, client = self._pool.resolve(parsed, digest, keychain)
+            return client.fetch_blob(parsed.path, digest)
+
+        return retry_lib.do(fetch, attempts=3, delay=0.2)

@@ -1,0 +1,74 @@
+"""Pooled authenticated blob transport with redirect probing.
+
+Reference pkg/utils/transport/pool.go:24-108: an LRU of authenticated
+clients keyed by image ref; ``resolve`` probes the blob endpoint with a
+``Range: bytes=0-0`` request, returning either the endpoint itself or the
+redirect target (CDN URL), evicting and re-authenticating on failure.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+from nydus_snapshotter_tpu.remote.reference import ParsedReference, registry_host
+from nydus_snapshotter_tpu.remote.registry import HTTPError, RegistryClient
+from nydus_snapshotter_tpu.utils import errdefs
+
+HTTP_CLIENT_TIMEOUT = 60.0
+_POOL_CAP = 3000
+
+
+class Pool:
+    def __init__(self, plain_http: bool = False, insecure_tls: bool = False):
+        self._lock = threading.Lock()
+        self._clients: OrderedDict[str, RegistryClient] = OrderedDict()
+        self.plain_http = plain_http
+        self.insecure_tls = insecure_tls
+
+    def _get(self, key: str) -> Optional[RegistryClient]:
+        with self._lock:
+            client = self._clients.get(key)
+            if client is not None:
+                self._clients.move_to_end(key)
+            return client
+
+    def _put(self, key: str, client: RegistryClient) -> None:
+        with self._lock:
+            self._clients[key] = client
+            self._clients.move_to_end(key)
+            while len(self._clients) > _POOL_CAP:
+                self._clients.popitem(last=False)
+
+    def _evict(self, key: str) -> None:
+        with self._lock:
+            self._clients.pop(key, None)
+
+    def _probe(self, client: RegistryClient, repo: str, digest: str) -> str:
+        """Range-probe the blob endpoint; return the final (possibly CDN)
+        URL serving it (pool.go redirect :72-108)."""
+        r = client.fetch_blob(repo, digest, byte_range=(0, 0))
+        try:
+            return r.url or f"/v2/{repo}/blobs/{digest}"
+        finally:
+            r.close()
+
+    def resolve(self, ref: ParsedReference, digest: str, keychain=None) -> tuple[str, RegistryClient]:
+        """(blob path, authenticated client) for ref@digest, reusing a
+        cached authenticated client when its token still works."""
+        key = ref.name
+        host = registry_host(ref.domain)
+        client = self._get(key)
+        if client is not None:
+            try:
+                return self._probe(client, ref.path, digest), client
+            except (HTTPError, errdefs.NydusError, OSError):
+                self._evict(key)
+        client = RegistryClient(
+            host, keychain=keychain, plain_http=self.plain_http,
+            insecure_tls=self.insecure_tls, timeout=HTTP_CLIENT_TIMEOUT,
+        )
+        url = self._probe(client, ref.path, digest)
+        self._put(key, client)
+        return url, client
