@@ -10,6 +10,7 @@ the wire format matches containerd's proxy-plugin expectation.
 from __future__ import annotations
 
 import logging
+import re
 from concurrent import futures
 from typing import Iterator
 
@@ -137,7 +138,11 @@ class SnapshotsService:
     def List(self, req: pb.ListSnapshotsRequest, context) -> Iterator[pb.ListSnapshotsResponse]:
         infos: list[pb.Info] = []
         try:
-            match = compile_filters(list(req.filters))
+            try:
+                match = compile_filters(list(req.filters))
+            except (ValueError, re.error) as e:
+                # A malformed filter is a caller error, not an internal one.
+                raise errdefs.InvalidArgument(f"invalid filter: {e}") from e
             self.sn.walk(
                 lambda _sid, info: infos.append(_info_to_pb(info)) if match(info) else None
             )

@@ -7,12 +7,11 @@ its config key `https://index.docker.io/v1/`.
 
 from __future__ import annotations
 
-import base64
 import json
 import os
 from typing import Optional
 
-from nydus_snapshotter_tpu.auth.keychain import PassKeyChain
+from nydus_snapshotter_tpu.auth.keychain import PassKeyChain, entry_keychain
 
 DOCKER_HUB_KEY = "https://index.docker.io/v1/"
 CONVERTED_DOCKER_HOST = "registry-1.docker.io"
@@ -21,21 +20,6 @@ CONVERTED_DOCKER_HOST = "registry-1.docker.io"
 def default_config_path() -> str:
     base = os.environ.get("DOCKER_CONFIG") or os.path.join(os.path.expanduser("~"), ".docker")
     return os.path.join(base, "config.json")
-
-
-def _entry_keychain(entry: dict) -> Optional[PassKeyChain]:
-    auth_b64 = entry.get("auth", "")
-    if auth_b64:
-        try:
-            user, _, pw = base64.b64decode(auth_b64).decode().partition(":")
-        except Exception:
-            return None
-        if user and pw:
-            return PassKeyChain(user, pw)
-    user, pw = entry.get("username", ""), entry.get("password", "")
-    if user and pw:
-        return PassKeyChain(user, pw)
-    return None
 
 
 def from_docker_config(host: str, config_path: Optional[str] = None) -> Optional[PassKeyChain]:
@@ -57,5 +41,5 @@ def from_docker_config(host: str, config_path: Optional[str] = None) -> Optional
             key_host = key_host.split("://", 1)[1]
         key_host = key_host.rstrip("/")
         if key == host or key_host == host or key_host.split("/")[0] == host:
-            return _entry_keychain(entry)
+            return entry_keychain(entry)
     return None

@@ -34,10 +34,30 @@ class PassKeyChain:
 
 def from_base64(value: str) -> PassKeyChain:
     decoded = base64.b64decode(value).decode()
-    pair = decoded.split(":")
-    if len(pair) != 2:
+    user, sep, password = decoded.partition(":")
+    # partition, not split: GCR-style passwords (JSON service-account keys)
+    # legitimately contain colons.
+    if not sep:
         raise ValueError("invalid registry auth token")
-    return PassKeyChain(pair[0], pair[1])
+    return PassKeyChain(user, password)
+
+
+def entry_keychain(entry: Mapping) -> Optional[PassKeyChain]:
+    """Decode one dockerconfig ``auths`` entry (base64 ``auth`` field with
+    username/password fallback); shared by the docker-config and
+    kube-secret lookups."""
+    auth_b64 = entry.get("auth", "")
+    if auth_b64:
+        try:
+            kc = from_base64(auth_b64)
+        except Exception:
+            kc = None
+        if kc is not None and kc.username and kc.password:
+            return kc
+    user, pw = entry.get("username", ""), entry.get("password", "")
+    if user and pw:
+        return PassKeyChain(user, pw)
+    return None
 
 
 def from_labels(labels: Mapping[str, str]) -> Optional[PassKeyChain]:

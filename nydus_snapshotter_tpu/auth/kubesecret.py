@@ -12,13 +12,12 @@ call on Add/Update events.
 
 from __future__ import annotations
 
-import base64
 import json
 import os
 import threading
 from typing import Optional
 
-from nydus_snapshotter_tpu.auth.keychain import PassKeyChain
+from nydus_snapshotter_tpu.auth.keychain import PassKeyChain, entry_keychain
 
 _lock = threading.Lock()
 _by_host: dict[str, PassKeyChain] = {}
@@ -35,16 +34,9 @@ def add_dockerconfigjson(doc: bytes | str) -> None:
     with _lock:
         for key, entry in (cfg.get("auths") or {}).items():
             host = key.split("://", 1)[-1].rstrip("/").split("/")[0]
-            auth_b64 = entry.get("auth", "")
-            if auth_b64:
-                try:
-                    user, _, pw = base64.b64decode(auth_b64).decode().partition(":")
-                except Exception:
-                    continue
-            else:
-                user, pw = entry.get("username", ""), entry.get("password", "")
-            if user and pw:
-                _by_host[host] = PassKeyChain(user, pw)
+            kc = entry_keychain(entry)
+            if kc is not None:
+                _by_host[host] = kc
 
 
 def load_secrets_dir(path: str) -> int:

@@ -51,6 +51,50 @@ def digest_hex(digest: str) -> str:
     return digest.split(":", 1)[-1]
 
 
+def multipart_upload(request, key: str, data: BlobSource, part_size: int,
+                     upload_id_tags: tuple[str, ...], service: str) -> None:
+    """Generic multipart-upload driver shared by S3 and OSS (both speak the
+    same initiate / per-part PUT / complete-XML / abort protocol).
+
+    ``request(method, key, query=None, body=b"")`` returns
+    ``(status, headers, body)``. Parts are streamed one at a time; the
+    session is aborted on failure so no orphaned parts accrue storage.
+    """
+    import xml.etree.ElementTree as ET
+
+    from nydus_snapshotter_tpu.utils import errdefs as _errdefs
+
+    status, _, body = request("POST", key, query={"uploads": ""})
+    if status // 100 != 2:
+        raise _errdefs.Unavailable(f"{service} InitiateMultipartUpload: HTTP {status}")
+    root = ET.fromstring(body)
+    upload_id = ""
+    for tag in upload_id_tags:
+        upload_id = root.findtext(tag) or upload_id
+    try:
+        etags: list[tuple[int, str]] = []
+        for idx, part in enumerate(_iter_parts(data, part_size), start=1):
+            status, hdrs, _ = request(
+                "PUT", key, query={"partNumber": str(idx), "uploadId": upload_id}, body=part
+            )
+            if status // 100 != 2:
+                raise _errdefs.Unavailable(f"{service} UploadPart {idx}: HTTP {status}")
+            etags.append((idx, {k.lower(): v for k, v in hdrs.items()}.get("etag", "")))
+        parts_xml = "".join(
+            f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>" for n, e in etags
+        )
+        complete = f"<CompleteMultipartUpload>{parts_xml}</CompleteMultipartUpload>".encode()
+        status, _, _ = request("POST", key, query={"uploadId": upload_id}, body=complete)
+        if status // 100 != 2:
+            raise _errdefs.Unavailable(f"{service} CompleteMultipartUpload: HTTP {status}")
+    except BaseException:
+        try:
+            request("DELETE", key, query={"uploadId": upload_id})
+        except Exception:
+            pass
+        raise
+
+
 class Backend(ABC):
     """Uploads conversion blobs to remote storage (backend.go:31-40)."""
 

@@ -20,10 +20,10 @@ from nydus_snapshotter_tpu.backend.backend import (
     MULTIPART_CHUNK_SIZE,
     Backend,
     BlobSource,
-    _iter_parts,
     _read_source,
     _source_size,
     digest_hex,
+    multipart_upload,
 )
 from nydus_snapshotter_tpu.utils import errdefs
 
@@ -97,42 +97,14 @@ class OSSBackend(Backend):
         if self._exists(key) and not self.force_push:
             return
         # The reference multipart-splits large blobs (oss.go:99-157); same
-        # threshold here, sequential parts streamed one at a time, with the
-        # session aborted on failure so no orphaned parts accrue.
+        # threshold here, via the shared streaming multipart driver.
         if _source_size(data) <= self.part_size:
             blob = _read_source(data)
             status, _, body = self._request("PUT", key, body=blob)
             if status // 100 != 2:
                 raise errdefs.Unavailable(f"OSS PUT {key}: HTTP {status} {body[:200]!r}")
             return
-        status, _, body = self._request("POST", key, query={"uploads": ""})
-        if status // 100 != 2:
-            raise errdefs.Unavailable(f"OSS InitiateMultipartUpload: HTTP {status}")
-        import xml.etree.ElementTree as ET
-
-        upload_id = ET.fromstring(body).findtext("UploadId") or ""
-        try:
-            etags = []
-            for idx, part in enumerate(_iter_parts(data, self.part_size), start=1):
-                status, hdrs, _ = self._request(
-                    "PUT", key, query={"partNumber": str(idx), "uploadId": upload_id}, body=part
-                )
-                if status // 100 != 2:
-                    raise errdefs.Unavailable(f"OSS UploadPart {idx}: HTTP {status}")
-                etags.append((idx, {k.lower(): v for k, v in hdrs.items()}.get("etag", "")))
-            parts_xml = "".join(f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>" for n, e in etags)
-            status, _, _ = self._request(
-                "POST", key, query={"uploadId": upload_id},
-                body=f"<CompleteMultipartUpload>{parts_xml}</CompleteMultipartUpload>".encode(),
-            )
-            if status // 100 != 2:
-                raise errdefs.Unavailable(f"OSS CompleteMultipartUpload: HTTP {status}")
-        except BaseException:
-            try:
-                self._request("DELETE", key, query={"uploadId": upload_id})
-            except Exception:
-                pass
-            raise
+        multipart_upload(self._request, key, data, self.part_size, ("UploadId",), "OSS")
 
     def check(self, digest: str) -> str:
         key = self._object_key(digest)

@@ -13,17 +13,16 @@ import hashlib
 import hmac
 import http.client
 import urllib.parse
-import xml.etree.ElementTree as ET
 from typing import Mapping, Optional
 
 from nydus_snapshotter_tpu.backend.backend import (
     MULTIPART_CHUNK_SIZE,
     Backend,
     BlobSource,
-    _iter_parts,
     _read_source,
     _source_size,
     digest_hex,
+    multipart_upload,
 )
 from nydus_snapshotter_tpu.utils import errdefs
 
@@ -141,40 +140,10 @@ class S3Backend(Backend):
             if status // 100 != 2:
                 raise errdefs.Unavailable(f"S3 PUT {key}: HTTP {status} {body[:200]!r}")
             return
-        self._multipart_upload(key, data)
-
-    def _multipart_upload(self, key: str, data: BlobSource) -> None:
-        """Streaming multipart: parts are read one at a time (file sources
-        never fully buffered); the session is aborted on failure so no
-        orphaned parts accrue storage."""
-        status, _, body = self._request("POST", key, query={"uploads": ""})
-        if status // 100 != 2:
-            raise errdefs.Unavailable(f"S3 CreateMultipartUpload: HTTP {status}")
-        ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
-        root = ET.fromstring(body)
-        upload_id = root.findtext("s3:UploadId", namespaces=ns) or root.findtext("UploadId") or ""
-        try:
-            etags: list[tuple[int, str]] = []
-            for idx, part in enumerate(_iter_parts(data, self.part_size), start=1):
-                status, hdrs, body = self._request(
-                    "PUT", key, query={"partNumber": str(idx), "uploadId": upload_id}, body=part
-                )
-                if status // 100 != 2:
-                    raise errdefs.Unavailable(f"S3 UploadPart {idx}: HTTP {status}")
-                etags.append((idx, {k.lower(): v for k, v in hdrs.items()}.get("etag", "")))
-            parts_xml = "".join(
-                f"<Part><PartNumber>{n}</PartNumber><ETag>{etag}</ETag></Part>" for n, etag in etags
-            )
-            complete = f"<CompleteMultipartUpload>{parts_xml}</CompleteMultipartUpload>".encode()
-            status, _, body = self._request("POST", key, query={"uploadId": upload_id}, body=complete)
-            if status // 100 != 2:
-                raise errdefs.Unavailable(f"S3 CompleteMultipartUpload: HTTP {status}")
-        except BaseException:
-            try:
-                self._request("DELETE", key, query={"uploadId": upload_id})
-            except Exception:
-                pass
-            raise
+        multipart_upload(
+            self._request, key, data, self.part_size,
+            ("{http://s3.amazonaws.com/doc/2006-03-01/}UploadId", "UploadId"), "S3",
+        )
 
     def check(self, digest: str) -> str:
         key = self._object_key(digest)

@@ -149,6 +149,33 @@ def main(argv=None) -> int:
 
     sn, fs, managers, _db = build_stack(cfg)
 
+    # Observability plane (snapshot.go:181-261): metrics exporter, system
+    # controller on UDS, optional profiling endpoint.
+    metrics_server = None
+    if cfg.metrics.address:
+        from nydus_snapshotter_tpu.metrics.serve import MetricsServer
+
+        metrics_server = MetricsServer(
+            managers=managers.values(), cache_dir=cfg.cache_root
+        )
+        metrics_server.serve(cfg.metrics.address)
+        metrics_server.start_collecting()
+        logger.info("metrics exporter on %s", cfg.metrics.address)
+    system_controller = None
+    if cfg.system.enable:
+        from nydus_snapshotter_tpu.system import SystemController
+
+        system_controller = SystemController(
+            fs=fs, managers=list(managers.values()), sock_path=cfg.system.address
+        )
+        system_controller.run()
+        logger.info("system controller on unix:%s", cfg.system.address)
+        if cfg.system.debug_pprof_address:
+            from nydus_snapshotter_tpu.pprof import new_pprof_http_listener
+
+            new_pprof_http_listener(cfg.system.debug_pprof_address)
+            logger.info("profiler on %s", cfg.system.debug_pprof_address)
+
     address = cfg.address
     os.makedirs(os.path.dirname(address) or ".", exist_ok=True)
     if os.path.exists(address):
@@ -169,6 +196,10 @@ def main(argv=None) -> int:
         stop.wait()
     finally:
         server.stop(grace=2).wait()
+        if metrics_server is not None:
+            metrics_server.stop()
+        if system_controller is not None:
+            system_controller.stop()
         sn.close()
         for mgr in managers.values():
             mgr.stop()

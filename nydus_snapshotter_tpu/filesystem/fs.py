@@ -20,6 +20,7 @@ The snapshotter only sees the duck type declared in
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -82,18 +83,31 @@ class Filesystem:
         self.instances = RafsCache()
         self.shared_daemons: dict[str, Daemon] = {}  # fs_driver -> shared daemon
         self._lock = threading.RLock()  # shared-daemon create/stop only
-        self._snap_locks: dict[str, threading.RLock] = {}
+        self._pending_mounts = 0  # in-flight mounts, guarded by _lock
+        self._snap_locks: dict[str, list] = {}  # sid -> [lock, waiter count]
         self._snap_locks_mu = threading.Lock()
 
-    def _snapshot_lock(self, snapshot_id: str) -> threading.RLock:
+    @contextlib.contextmanager
+    def _snapshot_lock(self, snapshot_id: str):
         """Per-snapshot lock: concurrent Prepare/Remove for ONE snapshot
         serialize, while mounts of unrelated snapshots proceed in parallel
-        (a slow daemon spawn must not stall every other RPC)."""
+        (a slow daemon spawn must not stall every other RPC). Entries are
+        refcounted so an entry is only dropped when no thread holds or
+        waits on it — a waiter must never be stranded on a popped lock."""
         with self._snap_locks_mu:
-            lock = self._snap_locks.get(snapshot_id)
-            if lock is None:
-                lock = self._snap_locks[snapshot_id] = threading.RLock()
-            return lock
+            entry = self._snap_locks.get(snapshot_id)
+            if entry is None:
+                entry = self._snap_locks[snapshot_id] = [threading.RLock(), 0]
+            entry[1] += 1
+        entry[0].acquire()
+        try:
+            yield
+        finally:
+            entry[0].release()
+            with self._snap_locks_mu:
+                entry[1] -= 1
+                if entry[1] == 0 and self._snap_locks.get(snapshot_id) is entry:
+                    self._snap_locks.pop(snapshot_id, None)
 
     # -- startup recovery (fs.go:58-194) -------------------------------------
 
@@ -182,6 +196,8 @@ class Filesystem:
             self._try_stop_shared_locked()
 
     def _try_stop_shared_locked(self) -> None:
+        if self._pending_mounts > 0:
+            return  # a mount may be about to attach to a shared daemon
         for fs_driver, d in list(self.shared_daemons.items()):
             if d.ref_count() == 0:
                 mgr = self.managers.get(fs_driver)
@@ -218,8 +234,17 @@ class Filesystem:
     def mount(self, snapshot_id: str, snap_labels: dict, snapshot=None) -> None:
         # Serialized per snapshot: concurrent Prepare RPCs for one snapshot
         # must not both pass the exists-check and race shared_mount/rollback.
-        with self._snapshot_lock(snapshot_id):
-            self._mount_locked(snapshot_id, snap_labels, snapshot)
+        # The pending-mount count keeps try_stop_shared_daemon from tearing
+        # the shared daemon down between get_shared_daemon and the refcount
+        # attach inside shared_mount.
+        with self._lock:
+            self._pending_mounts += 1
+        try:
+            with self._snapshot_lock(snapshot_id):
+                self._mount_locked(snapshot_id, snap_labels, snapshot)
+        finally:
+            with self._lock:
+                self._pending_mounts -= 1
 
     def _mount_locked(self, snapshot_id: str, snap_labels: dict, snapshot=None) -> None:
         if self.instances.get(snapshot_id) is not None:
@@ -349,8 +374,6 @@ class Filesystem:
     def umount(self, snapshot_id: str) -> None:
         with self._snapshot_lock(snapshot_id):
             self._umount_locked(snapshot_id)
-        with self._snap_locks_mu:
-            self._snap_locks.pop(snapshot_id, None)
 
     def _umount_locked(self, snapshot_id: str) -> None:
         rafs = self.instances.get(snapshot_id)

@@ -1,0 +1,28 @@
+"""Metrics subsystem (L10): Prometheus-format exporter + collectors.
+
+TPU-era equivalent of reference pkg/metrics: a dependency-free metric
+registry rendering the Prometheus text exposition format, periodic
+collectors for snapshotter self-resources / per-image FS metrics /
+inflight-hung IO / daemon events, and an HTTP listener serving
+``/v1/metrics`` (metrics/listener.go:32-53).
+"""
+
+from nydus_snapshotter_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    TTLGauge,
+    default_registry,
+)
+from nydus_snapshotter_tpu.metrics.serve import MetricsServer
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "TTLGauge",
+    "default_registry",
+    "MetricsServer",
+]

@@ -1,0 +1,3 @@
+from nydus_snapshotter_tpu.pprof.listener import new_pprof_http_listener
+
+__all__ = ["new_pprof_http_listener"]

@@ -1,0 +1,3 @@
+from nydus_snapshotter_tpu.prefetch.prefetch import Pm, PrefetchManager
+
+__all__ = ["Pm", "PrefetchManager"]

@@ -1,0 +1,43 @@
+"""Global image -> prefetch-file-list map (reference pkg/prefetch/prefetch.go).
+
+Fed by the prefetchfiles NRI plugin through the system controller's
+PUT /api/v1/prefetch; consumed as ``--prefetch-files`` when a daemon starts
+(daemon_adaptor.go:179-185).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+
+class PrefetchManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._map: dict[str, str] = {}
+
+    def set_prefetch_files(self, body: bytes | str) -> None:
+        """Parse ``[{"image": ..., "prefetch": ...}, ...]`` (prefetch.go:23-43)."""
+        if isinstance(body, (bytes, bytearray)):
+            body = body.decode()
+        msg = json.loads(body)
+        if not isinstance(msg, list):
+            raise ValueError("prefetch list must be a JSON array")
+        with self._lock:
+            for item in msg:
+                self._map[item["image"]] = item.get("prefetch", "")
+
+    def get_prefetch_info(self, image: str) -> str:
+        with self._lock:
+            return self._map.get(image, "")
+
+    def delete(self, image: str) -> None:
+        with self._lock:
+            self._map.pop(image, None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._map.clear()
+
+
+Pm = PrefetchManager()

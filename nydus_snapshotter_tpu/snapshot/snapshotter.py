@@ -14,6 +14,7 @@ daemons.
 
 from __future__ import annotations
 
+import functools
 import logging
 import os
 import shutil
@@ -32,7 +33,23 @@ from nydus_snapshotter_tpu.snapshot.mount import (
     overlay_mount,
     prepare_kata_virtual_volume,
 )
+from nydus_snapshotter_tpu.metrics.collector import snapshot_timer
 from nydus_snapshotter_tpu.utils import errdefs
+
+
+def _timed(operation: str):
+    """Method-latency histogram wrapper (reference snapshot.go:303-592
+    collector.NewSnapshotMetricsTimer around Mounts/Prepare/Remove/Cleanup)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with snapshot_timer(operation):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 logger = logging.getLogger(__name__)
 
@@ -149,6 +166,7 @@ class Snapshotter:
                 usage.add(self.fs.cache_usage(blob_digest))
         return usage
 
+    @_timed("mounts")
     def mounts(self, key: str) -> list[Mount]:
         need_remote = False
         meta_sid = ""
@@ -191,6 +209,7 @@ class Snapshotter:
             return self._mount_remote(info.labels, snap, meta_sid, key)
         return self._mount_native(info.labels, snap)
 
+    @_timed("prepare")
     def prepare(self, key: str, parent: str, snap_labels: Optional[dict] = None) -> list[Mount]:
         info, s = self._create_snapshot(ms.KIND_ACTIVE, key, parent, snap_labels)
         handler, target = self._choose_processor(s, key, parent, info.labels)
@@ -240,6 +259,7 @@ class Snapshotter:
             new_info.labels.update(snap_labels)
             self.ms.update_info(new_info)
 
+    @_timed("remove")
     def remove(self, key: str) -> None:
         sid, info, _ = self.ms.get_info(key)
         if info.kind == ms.KIND_COMMITTED:
@@ -256,6 +276,7 @@ class Snapshotter:
     def walk(self, fn: Callable[[str, Info], None]) -> None:
         self.ms.walk(fn)
 
+    @_timed("cleanup")
     def cleanup(self) -> None:
         for d in self._get_cleanup_directories():
             self._cleanup_snapshot_directory(d)

@@ -1,0 +1,3 @@
+from nydus_snapshotter_tpu.system.system import SystemController
+
+__all__ = ["SystemController"]

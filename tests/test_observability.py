@@ -1,0 +1,324 @@
+"""L10 observability tests: metric primitives + text format, /proc tool,
+collectors over a fake manager, metrics HTTP listener, system controller
+REST over UDS, prefetch manager, pprof listener.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import socket
+import time
+
+import pytest
+
+from nydus_snapshotter_tpu.metrics import data
+from nydus_snapshotter_tpu.metrics import tool as mtool
+from nydus_snapshotter_tpu.metrics.collector import (
+    DaemonResourceCollector,
+    SnapshotterMetricsCollector,
+    record_daemon_event,
+    snapshot_timer,
+)
+from nydus_snapshotter_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    TTLGauge,
+)
+from nydus_snapshotter_tpu.metrics.serve import MetricsServer
+from nydus_snapshotter_tpu.prefetch import Pm
+from nydus_snapshotter_tpu.system.system import SystemController
+
+
+# ------------------------------------------------------------------ primitives
+
+
+def test_counter_render():
+    r = Registry()
+    c = r.register(Counter("events_total", "Events.", ("kind",)))
+    c.labels("start").inc()
+    c.labels("start").inc(2)
+    c.labels("stop").inc()
+    text = r.render()
+    assert '# TYPE events_total counter' in text
+    assert 'events_total{kind="start"} 3' in text
+    assert 'events_total{kind="stop"} 1' in text
+
+
+def test_gauge_set_and_remove():
+    g = Gauge("g", "G.", ("image",))
+    g.labels("a").set(1.5)
+    assert g.value("a") == 1.5
+    g.remove("a")
+    assert g.value("a") is None
+
+
+def test_ttl_gauge_expiry():
+    clock = [0.0]
+    g = TTLGauge("t", "T.", ("id",), ttl_sec=10.0, clock=lambda: clock[0])
+    g.labels("d1").set(1)
+    assert 't{id="d1"} 1' in g.render()
+    clock[0] = 11.0
+    assert 'd1' not in g.render()
+
+
+def test_histogram_buckets_and_timer():
+    h = Histogram("lat_ms", "Latency.", ("op",), buckets=(1, 10, 100))
+    h.labels("prepare").observe(5)
+    h.labels("prepare").observe(50)
+    text = h.render()
+    assert 'lat_ms_bucket{op="prepare",le="1"} 0' in text
+    assert 'lat_ms_bucket{op="prepare",le="10"} 1' in text
+    assert 'lat_ms_bucket{op="prepare",le="100"} 2' in text
+    assert 'lat_ms_bucket{op="prepare",le="+Inf"} 2' in text
+    assert 'lat_ms_count{op="prepare"} 2' in text
+    with h.labels("remove").time_ms():
+        pass
+    assert 'lat_ms_count{op="remove"} 1' in h.render()
+
+
+def test_snapshot_timer_records():
+    with snapshot_timer("prepare"):
+        pass
+    assert "snapshotter_snapshot_operation_elapsed_milliseconds" in (
+        data.SnapshotEventElapsedHists.render()
+    )
+
+
+# ----------------------------------------------------------------- /proc tools
+
+
+def test_proc_stat_self():
+    st = mtool.read_process_stat(os.getpid())
+    assert st.threads >= 1
+    assert st.utime >= 0
+    assert mtool.get_process_memory_rss_kb(os.getpid()) > 1000
+    assert mtool.get_fd_count(os.getpid()) > 0
+    assert mtool.run_time_seconds(os.getpid()) >= 0
+
+
+def test_cpu_sampler():
+    s = mtool.CPUSampler(os.getpid())
+    s.sample()
+    sum(i * i for i in range(200000))  # burn some cpu
+    util = s.sample()
+    assert util >= 0.0
+
+
+# ------------------------------------------------------------------ collectors
+
+
+class _FakeDaemonStates:
+    api_socket = "/tmp/api.sock"
+    supervisor_path = ""
+    config_path = ""
+    fs_driver = "fusedev"
+
+
+class _FakeDaemon:
+    def __init__(self, id_="d1"):
+        self.id = id_
+        self.states = _FakeDaemonStates()
+
+        class _Instances:
+            @staticmethod
+            def list():
+                return []
+
+        self.instances = _Instances()
+
+    def pid(self):
+        return os.getpid()
+
+    def state(self):
+        from nydus_snapshotter_tpu.daemon.types import DaemonState
+
+        return DaemonState.RUNNING
+
+    def ref_count(self):
+        return 0
+
+    def client(self):
+        raise ConnectionError("no daemon in tests")
+
+
+class _FakeManager:
+    def __init__(self):
+        self._daemons = [_FakeDaemon()]
+
+    def list_daemons(self):
+        return self._daemons
+
+    def get_by_daemon_id(self, daemon_id):
+        for d in self._daemons:
+            if d.id == daemon_id:
+                return d
+        return None
+
+
+def test_snapshotter_collector(tmp_path):
+    (tmp_path / "blob1").write_bytes(b"x" * 2048)
+    c = SnapshotterMetricsCollector(str(tmp_path))
+    c.collect()
+    assert data.CacheUsage.value() == 2.0  # KiB
+    assert data.MemoryUsage.value() > 0
+
+
+def test_daemon_resource_collector():
+    DaemonResourceCollector([_FakeManager()]).collect()
+    assert data.DaemonCount.value() == 1
+    assert data.DaemonRSS.value("d1") > 0
+
+
+def test_record_daemon_event():
+    record_daemon_event("d9", "start")
+    assert data.DaemonEvent.value("d9", "start") is not None
+
+
+# -------------------------------------------------------------- HTTP listeners
+
+
+def test_metrics_http_listener(tmp_path):
+    server = MetricsServer(managers=[_FakeManager()], cache_dir=str(tmp_path))
+    server.serve("127.0.0.1:0")
+    try:
+        server.collect_once()
+        host, port = server._httpd.server_address[:2]
+        conn = http.client.HTTPConnection(host, port, timeout=5)
+        conn.request("GET", "/v1/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.status == 200
+        assert "snapshotter_memory_usage_kilobytes" in body
+        assert "nydusd_counts" in body
+        conn.request("GET", "/nope")
+        assert conn.getresponse().status == 404
+        conn.close()
+    finally:
+        server.stop()
+
+
+def _uds_request(sock_path: str, method: str, path: str, body: bytes = b"") -> tuple[int, bytes]:
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(5)
+    s.connect(sock_path)
+    req = f"{method} {path} HTTP/1.1\r\nHost: uds\r\nContent-Length: {len(body)}\r\n\r\n".encode() + body
+    s.sendall(req)
+    resp = b""
+    while True:
+        chunk = s.recv(65536)
+        if not chunk:
+            break
+        resp += chunk
+        if b"\r\n\r\n" in resp:
+            head, _, rest = resp.partition(b"\r\n\r\n")
+            for line in head.split(b"\r\n"):
+                if line.lower().startswith(b"content-length:"):
+                    want = int(line.split(b":")[1])
+                    if len(rest) >= want:
+                        s.close()
+                        return int(head.split()[1]), rest[:want]
+    s.close()
+    status = int(resp.split()[1]) if resp else 0
+    return status, b""
+
+
+def test_system_controller(tmp_path):
+    sock = str(tmp_path / "system.sock")
+    sc = SystemController(managers=[_FakeManager()], sock_path=sock)
+    sc.run()
+    try:
+        status, body = _uds_request(sock, "GET", "/api/v1/daemons")
+        assert status == 200
+        daemons = json.loads(body)
+        assert daemons[0]["id"] == "d1"
+        assert daemons[0]["memory_rss_kb"] > 0
+        assert daemons[0]["pid"] == os.getpid()
+
+        # prefetch PUT feeds the global map
+        Pm.reset()
+        payload = json.dumps([{"image": "ghcr.io/a/b:v1", "prefetch": "/bin;/usr/bin"}]).encode()
+        status, _ = _uds_request(sock, "PUT", "/api/v1/prefetch", payload)
+        assert status == 200
+        assert Pm.get_prefetch_info("ghcr.io/a/b:v1") == "/bin;/usr/bin"
+
+        # bad prefetch body -> 400
+        status, _ = _uds_request(sock, "PUT", "/api/v1/prefetch", b"{not json")
+        assert status == 400
+
+        # backend of unknown daemon -> 404
+        status, _ = _uds_request(sock, "GET", "/api/v1/daemons/nope/backend")
+        assert status == 404
+        # backend of known daemon (no config file) -> empty backend
+        status, body = _uds_request(sock, "GET", "/api/v1/daemons/d1/backend")
+        assert status == 200 and json.loads(body)["config"] == {}
+
+        # upgrade with a bad binary path -> 404
+        status, _ = _uds_request(
+            sock, "PUT", "/api/v1/daemons/upgrade",
+            json.dumps({"nydusd_path": "/no/such/bin"}).encode(),
+        )
+        assert status == 404
+    finally:
+        sc.stop()
+        Pm.reset()
+
+
+def test_backend_secret_filtering(tmp_path):
+    from nydus_snapshotter_tpu.config.daemonconfig import DaemonRuntimeConfig
+
+    cfg = DaemonRuntimeConfig.from_dict(
+        {"device": {"backend": {"type": "registry", "config": {
+            "auth": "c2VjcmV0", "scheme": "https", "host": "reg.example.com"}}}},
+        "fusedev",
+    )
+    cfg_path = str(tmp_path / "cfg.json")
+    cfg.dump(cfg_path)
+
+    mgr = _FakeManager()
+    mgr._daemons[0].states.config_path = cfg_path
+    sock = str(tmp_path / "system2.sock")
+    sc = SystemController(managers=[mgr], sock_path=sock)
+    sc.run()
+    try:
+        status, body = _uds_request(sock, "GET", "/api/v1/daemons/d1/backend")
+        assert status == 200
+        assert b"c2VjcmV0" not in body  # secret scrubbed
+        assert b"reg.example.com" in body
+    finally:
+        sc.stop()
+
+
+def test_prefetch_manager():
+    Pm.reset()
+    Pm.set_prefetch_files(json.dumps([{"image": "x", "prefetch": "/a"}]))
+    assert Pm.get_prefetch_info("x") == "/a"
+    assert Pm.get_prefetch_info("y") == ""
+    Pm.delete("x")
+    assert Pm.get_prefetch_info("x") == ""
+    with pytest.raises((ValueError, KeyError)):
+        Pm.set_prefetch_files(b"{}")
+    Pm.reset()
+
+
+def test_pprof_listener():
+    from nydus_snapshotter_tpu.pprof import new_pprof_http_listener
+
+    httpd = new_pprof_http_listener("127.0.0.1:0")
+    try:
+        host, port = httpd.server_address[:2]
+        conn = http.client.HTTPConnection(host, port, timeout=5)
+        conn.request("GET", "/debug/pprof/threads")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert b"thread " in resp.read()
+        conn.request("GET", "/debug/pprof/heap")
+        resp = conn.getresponse()
+        assert resp.status == 200 and b"gc_counts" in resp.read()
+        conn.close()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

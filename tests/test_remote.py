@@ -493,6 +493,40 @@ def test_s3_backend_config_validation():
     assert b.type() == "s3"
 
 
+def test_multipart_upload_streams_and_aborts():
+    from nydus_snapshotter_tpu.backend.backend import multipart_upload
+
+    calls = []
+
+    def ok_request(method, key, query=None, body=b""):
+        calls.append((method, dict(query or {}), len(body)))
+        if query and "uploads" in query:
+            return 200, {}, b"<R><UploadId>uid-1</UploadId></R>"
+        if query and "partNumber" in query:
+            return 200, {"ETag": f'"{query["partNumber"]}"'}, b""
+        return 200, {}, b""
+
+    multipart_upload(ok_request, "k", b"x" * 10, part_size=4, upload_id_tags=("UploadId",), service="S3")
+    parts = [c for c in calls if "partNumber" in c[1]]
+    assert [p[2] for p in parts] == [4, 4, 2]  # streamed in part-size chunks
+    assert calls[-1][0] == "POST" and calls[-1][1] == {"uploadId": "uid-1"}
+
+    # Failure mid-part aborts the session (DELETE uploadId).
+    calls.clear()
+
+    def bad_request(method, key, query=None, body=b""):
+        calls.append((method, dict(query or {})))
+        if query and "uploads" in query:
+            return 200, {}, b"<R><UploadId>uid-2</UploadId></R>"
+        if query and query.get("partNumber") == "2":
+            return 500, {}, b""
+        return 200, {}, b""
+
+    with pytest.raises(errdefs.Unavailable):
+        multipart_upload(bad_request, "k", b"x" * 10, part_size=4, upload_id_tags=("UploadId",), service="S3")
+    assert calls[-1] == ("DELETE", {"uploadId": "uid-2"})
+
+
 def test_oss_backend_config_validation():
     from nydus_snapshotter_tpu.backend.oss import OSSBackend
 
