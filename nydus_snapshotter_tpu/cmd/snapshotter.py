@@ -120,13 +120,37 @@ def build_stack(cfg: SnapshotterConfig):
         mgr.run_death_handler()
         managers[cfg.daemon.fs_driver] = mgr
 
+    cache_mgr = CacheManager(cfg.cache_root, enabled=cfg.cache_manager.enable)
+
+    # Optional lazy-pull adaptors (fs.go:58-194 wiring of stargz/referrer).
+    stargz_resolver = None
+    stargz_adaptor = None
+    if cfg.experimental.enable_stargz:
+        from nydus_snapshotter_tpu.snapshot.snapshotter import upper_path
+        from nydus_snapshotter_tpu.stargz import Resolver, StargzAdaptor
+
+        stargz_resolver = Resolver()
+        stargz_adaptor = StargzAdaptor(
+            lambda sid: upper_path(cfg.root, sid),
+            cache_dir=cfg.cache_root,
+            fs_driver=cfg.daemon.fs_driver,
+        )
+    referrer_mgr = None
+    if cfg.experimental.enable_referrer_detect:
+        from nydus_snapshotter_tpu.referrer import ReferrerManager
+
+        referrer_mgr = ReferrerManager()
+
     fs = Filesystem(
         managers=managers,
-        cache_mgr=CacheManager(cfg.cache_root, enabled=cfg.cache_manager.enable),
+        cache_mgr=cache_mgr,
         root=cfg.root,
         fs_driver=cfg.daemon.fs_driver,
         daemon_mode=cfg.daemon_mode,
         daemon_config=daemon_config,
+        stargz_resolver=stargz_resolver,
+        stargz_adaptor=stargz_adaptor,
+        referrer_mgr=referrer_mgr,
     )
     fs.startup()
 

@@ -114,4 +114,5 @@ CHUNK_SIZE_DEFAULT = 0x100000  # 1 MiB, nydus default
 COMPRESSOR_NONE = 0x0000_0001
 COMPRESSOR_ZSTD = 0x0000_0002
 COMPRESSOR_LZ4_BLOCK = 0x0000_0004
+COMPRESSOR_GZIP = 0x0000_0008  # estargz chunks stay gzip streams in-place
 COMPRESSOR_MASK = 0x0000_000F

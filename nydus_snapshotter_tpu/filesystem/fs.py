@@ -558,9 +558,13 @@ class Filesystem:
         except Exception:
             return False
 
-    def try_fetch_metadata(self, snap_labels: dict, meta_path: str) -> None:
+    def try_fetch_metadata(self, snap_labels: dict, metadata_path: str) -> None:
+        """Pull the companion image's bootstrap next to the snapshot
+        (referer_adaptor.go:41-60)."""
         if self.referrer_mgr is None:
-            raise errdefs.Unavailable("referrer detection is not enabled")
+            raise errdefs.Unavailable("referrer detect is not enabled")
         ref = snap_labels.get(C.CRI_IMAGE_REF, "")
         manifest_digest = snap_labels.get(C.CRI_MANIFEST_DIGEST, "")
-        self.referrer_mgr.try_fetch_metadata(ref, manifest_digest, meta_path)
+        if not ref or not manifest_digest:
+            raise errdefs.InvalidArgument("missing image ref / manifest digest labels")
+        self.referrer_mgr.try_fetch_metadata(ref, manifest_digest, metadata_path)

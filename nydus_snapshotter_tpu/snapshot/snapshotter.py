@@ -37,6 +37,12 @@ from nydus_snapshotter_tpu.metrics.collector import snapshot_timer
 from nydus_snapshotter_tpu.utils import errdefs
 
 
+def upper_path(root: str, sid: str) -> str:
+    """Canonical upper-dir layout ``<root>/snapshots/<sid>/fs`` — the single
+    encoding shared by the snapshotter and the adaptor wiring."""
+    return os.path.join(root, "snapshots", sid, "fs")
+
+
 def _timed(operation: str):
     """Method-latency histogram wrapper (reference snapshot.go:303-592
     collector.NewSnapshotMetricsTimer around Mounts/Prepare/Remove/Cleanup)."""
@@ -132,7 +138,7 @@ class Snapshotter:
         return os.path.join(self.snapshot_root(), sid)
 
     def upper_path(self, sid: str) -> str:
-        return os.path.join(self.root, "snapshots", sid, "fs")
+        return upper_path(self.root, sid)
 
     def work_path(self, sid: str) -> str:
         return os.path.join(self.root, "snapshots", sid, "work")

@@ -1,0 +1,218 @@
+"""referrer package tests against the in-process fake registry.
+
+Mirrors reference pkg/referrer behavior: referrers-API lookup, nydus
+manifest validation, LRU + singleflight, metadata layer unpack.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import tarfile
+import threading
+
+import pytest
+
+from nydus_snapshotter_tpu import constants
+from nydus_snapshotter_tpu.referrer import (
+    METADATA_NAME_IN_LAYER,
+    Referrer,
+    ReferrerManager,
+)
+from nydus_snapshotter_tpu.remote.remote import Remote
+from nydus_snapshotter_tpu.remote.unpack import unpack
+from nydus_snapshotter_tpu.utils import errdefs, singleflight
+
+from tests.test_remote import FakeRegistry
+
+
+@pytest.fixture()
+def registry():
+    reg = FakeRegistry(require_auth=False)
+    yield reg
+    reg.close()
+
+
+@pytest.fixture(autouse=True)
+def plain_http(monkeypatch):
+    orig = Remote.__init__
+
+    def patched(self, keychain=None, insecure=False):
+        orig(self, keychain=keychain, insecure=insecure)
+        self.with_plain_http = True
+
+    monkeypatch.setattr(Remote, "__init__", patched)
+
+
+def _bootstrap_layer_blob(content: bytes = b"bootstrap-bytes") -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:") as tf:
+        info = tarfile.TarInfo(METADATA_NAME_IN_LAYER)
+        info.size = len(content)
+        tf.addfile(info, io.BytesIO(content))
+    return gzip.compress(buf.getvalue())
+
+
+def _setup_referrer(reg: FakeRegistry, with_annotation: bool = True):
+    """Publish: image digest D → referrer manifest M whose last layer is a
+    nydus bootstrap layer."""
+    layer_blob = _bootstrap_layer_blob()
+    layer_digest = reg.add_blob(layer_blob)
+    annos = (
+        {constants.LAYER_ANNOTATION_NYDUS_BOOTSTRAP: "true"}
+        if with_annotation
+        else {}
+    )
+    manifest = {
+        "schemaVersion": 2,
+        "layers": [
+            {
+                "mediaType": "application/vnd.oci.image.layer.v1.tar+gzip",
+                "digest": layer_digest,
+                "size": len(layer_blob),
+                "annotations": annos,
+            }
+        ],
+    }
+    mbody = json.dumps(manifest).encode()
+    mdigest = reg.add_blob(mbody)  # fetch_by_digest hits the blob endpoint
+    image_digest = "sha256:" + hashlib.sha256(b"the-oci-image").hexdigest()
+    reg.referrers[image_digest] = [
+        {
+            "mediaType": "application/vnd.oci.image.manifest.v1+json",
+            "digest": mdigest,
+            "size": len(mbody),
+        }
+    ]
+    return image_digest, layer_digest
+
+
+class TestReferrer:
+    def test_check_referrer_finds_meta_layer(self, registry):
+        image_digest, layer_digest = _setup_referrer(registry)
+        ref = f"{registry.host}/library/app:latest"
+        desc = Referrer().check_referrer(ref, image_digest)
+        assert desc.digest == layer_digest
+        assert constants.LAYER_ANNOTATION_NYDUS_BOOTSTRAP in desc.annotations
+
+    def test_no_referrers_raises(self, registry):
+        ref = f"{registry.host}/library/app:latest"
+        digest = "sha256:" + "9" * 64
+        registry.referrers[digest] = []
+        with pytest.raises(Exception):
+            Referrer().check_referrer(ref, digest)
+
+    def test_missing_annotation_rejected(self, registry):
+        image_digest, _ = _setup_referrer(registry, with_annotation=False)
+        ref = f"{registry.host}/library/app:latest"
+        with pytest.raises(errdefs.InvalidArgument):
+            Referrer().check_referrer(ref, image_digest)
+
+    def test_fetch_metadata_unpacks_bootstrap(self, registry, tmp_path):
+        image_digest, _ = _setup_referrer(registry)
+        ref = f"{registry.host}/library/app:latest"
+        referrer = Referrer()
+        desc = referrer.check_referrer(ref, image_digest)
+        out = tmp_path / "image.boot"
+        referrer.fetch_metadata(ref, desc, str(out))
+        assert out.read_bytes() == b"bootstrap-bytes"
+
+
+class TestManager:
+    def test_lru_cache_avoids_refetch(self, registry):
+        image_digest, layer_digest = _setup_referrer(registry)
+        ref = f"{registry.host}/library/app:latest"
+        mgr = ReferrerManager()
+        assert mgr.check_referrer(ref, image_digest).digest == layer_digest
+        before = len(registry.requests)
+        assert mgr.check_referrer(ref, image_digest).digest == layer_digest
+        assert len(registry.requests) == before  # served from cache
+
+    def test_try_fetch_metadata(self, registry, tmp_path):
+        image_digest, _ = _setup_referrer(registry)
+        ref = f"{registry.host}/library/app:latest"
+        out = tmp_path / "boot"
+        ReferrerManager().try_fetch_metadata(ref, image_digest, str(out))
+        assert out.read_bytes() == b"bootstrap-bytes"
+
+
+class TestSingleflight:
+    def test_shares_one_flight(self):
+        g = singleflight.Group()
+        calls = []
+        gate = threading.Event()
+        results = []
+
+        def slow():
+            gate.wait(2)
+            calls.append(1)
+            return "value"
+
+        def run():
+            results.append(g.do("k", slow))
+
+        threads = [threading.Thread(target=run) for _ in range(5)]
+        for t in threads:
+            t.start()
+        gate.set()
+        for t in threads:
+            t.join()
+        assert len(calls) == 1
+        assert all(r[0] == "value" for r in results)
+        assert sum(1 for r in results if r[1]) == 4  # four piggybacked
+
+    def test_exception_propagates_to_all(self):
+        g = singleflight.Group()
+        gate = threading.Event()
+        errors = []
+
+        def boom():
+            gate.wait(2)
+            raise RuntimeError("nope")
+
+        def run():
+            try:
+                g.do("k", boom)
+            except RuntimeError as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=run) for _ in range(3)]
+        for t in threads:
+            t.start()
+        gate.set()
+        for t in threads:
+            t.join()
+        assert len(errors) == 3
+
+    def test_different_keys_run_independently(self):
+        g = singleflight.Group()
+        assert g.do("a", lambda: 1)[0] == 1
+        assert g.do("b", lambda: 2)[0] == 2
+
+
+class TestUnpack:
+    def test_unpack_plain_tar(self, tmp_path):
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:") as tf:
+            info = tarfile.TarInfo("dir/file.txt")
+            info.size = 5
+            tf.addfile(info, io.BytesIO(b"hello"))
+        out = tmp_path / "x"
+        unpack(buf.getvalue(), "dir/file.txt", str(out))
+        assert out.read_bytes() == b"hello"
+
+    def test_unpack_gzip_tar(self, tmp_path):
+        out = tmp_path / "boot"
+        unpack(_bootstrap_layer_blob(b"data123"), METADATA_NAME_IN_LAYER, str(out))
+        assert out.read_bytes() == b"data123"
+
+    def test_unpack_missing_member_raises(self, tmp_path):
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:") as tf:
+            info = tarfile.TarInfo("other")
+            info.size = 0
+            tf.addfile(info, io.BytesIO(b""))
+        with pytest.raises(errdefs.NotFound):
+            unpack(buf.getvalue(), "missing", str(tmp_path / "y"))

@@ -72,13 +72,17 @@ class FakeRegistry:
                     return
                 rng = self.headers.get("Range")
                 status, body = 200, data
+                content_range = ""
                 if rng and rng.startswith("bytes="):
                     lo, hi = rng[6:].split("-")
                     lo, hi = int(lo), int(hi or len(data) - 1)
                     body = data[lo : hi + 1]
                     status = 206
+                    content_range = f"bytes {lo}-{hi}/{len(data)}"
                 self.send_response(status)
                 self.send_header("Content-Length", str(len(body)))
+                if content_range:
+                    self.send_header("Content-Range", content_range)
                 self.send_header("Docker-Content-Digest", digest)
                 self.end_headers()
                 if not head:

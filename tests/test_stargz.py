@@ -1,0 +1,382 @@
+"""stargz package tests: footer parse, TOC reads, index build, adaptor.
+
+Mirrors reference pkg/stargz tests (footer/TOC fixtures) but builds the
+estargz blobs synthetically in-memory.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import struct
+import tarfile
+import zlib
+
+import pytest
+
+from nydus_snapshotter_tpu import constants
+from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
+from nydus_snapshotter_tpu.stargz import (
+    ESTARGZ_FOOTER_SIZE,
+    FOOTER_SIZE,
+    TOC_FILENAME,
+    Blob,
+    StargzAdaptor,
+    StargzError,
+    bootstrap_from_toc,
+    parse_footer,
+)
+
+# ---------------------------------------------------------------------------
+# synthetic estargz builder
+# ---------------------------------------------------------------------------
+
+
+def _gzip_member(data: bytes, extra: bytes = b"") -> bytes:
+    flg = 0x04 if extra else 0x00
+    head = bytes([0x1F, 0x8B, 0x08, flg, 0, 0, 0, 0, 0, 0xFF])
+    if extra:
+        head += struct.pack("<H", len(extra)) + extra
+    if data:
+        comp = zlib.compressobj(9, zlib.DEFLATED, -15)
+        body = comp.compress(data) + comp.flush()
+    else:
+        body = b"\x01\x00\x00\xff\xff"  # final stored empty block
+    tail = struct.pack("<II", zlib.crc32(data) & 0xFFFFFFFF, len(data) & 0xFFFFFFFF)
+    return head + body + tail
+
+
+def _footer(toc_offset: int, legacy: bool) -> bytes:
+    payload = b"%016x" % toc_offset + b"STARGZ"
+    if legacy:
+        extra = payload
+    else:
+        extra = b"SG" + struct.pack("<H", len(payload)) + payload
+    f = _gzip_member(b"", extra=extra)
+    assert len(f) == (FOOTER_SIZE if legacy else ESTARGZ_FOOTER_SIZE)
+    return f
+
+
+def _tar_member(name: str, data: bytes) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:", format=tarfile.GNU_FORMAT) as tf:
+        info = tarfile.TarInfo(name)
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+    raw = buf.getvalue()
+    # strip the two 512-byte zero end-blocks so members concatenate
+    while raw.endswith(b"\x00" * 512):
+        raw = raw[:-512]
+    return raw
+
+
+def build_estargz(files: dict[str, bytes], legacy_footer: bool = False) -> bytes:
+    """files: path -> content. One gzip member per file, then TOC, footer."""
+    out = io.BytesIO()
+    entries = [{"name": "", "type": "dir", "mode": 0o755}]
+    entries[0]["name"] = "./"
+    for name, data in files.items():
+        offset = out.tell()
+        out.write(_gzip_member(_tar_member(name, data)))
+        entries.append(
+            {
+                "name": name,
+                "type": "reg",
+                "size": len(data),
+                "mode": 0o644,
+                "offset": offset,
+                "chunkDigest": "sha256:" + hashlib.sha256(data).hexdigest(),
+                "digest": "sha256:" + hashlib.sha256(data).hexdigest(),
+            }
+        )
+    toc_offset = out.tell()
+    toc_json = json.dumps({"version": 1, "entries": entries}).encode()
+    out.write(_gzip_member(_tar_member(TOC_FILENAME, toc_json)))
+    out.write(_footer(toc_offset, legacy_footer))
+    return out.getvalue()
+
+
+def mem_blob(raw: bytes, digest: str = "", ref: str = "example.com/repo:tag") -> Blob:
+    digest = digest or "sha256:" + hashlib.sha256(raw).hexdigest()
+    return Blob(ref, digest, lambda off, ln: raw[off : off + ln], len(raw))
+
+
+# ---------------------------------------------------------------------------
+# footer / TOC
+# ---------------------------------------------------------------------------
+
+
+class TestFooter:
+    def test_legacy_footer_roundtrip(self):
+        off, ok = parse_footer(_footer(0xDEAD, legacy=True))
+        assert ok and off == 0xDEAD
+
+    def test_estargz_footer_roundtrip(self):
+        off, ok = parse_footer(_footer(0xBEEF, legacy=False))
+        assert ok and off == 0xBEEF
+
+    def test_plain_gzip_is_not_a_footer(self):
+        _, ok = parse_footer(_gzip_member(b"data"))
+        assert not ok
+
+    def test_garbage_is_not_a_footer(self):
+        _, ok = parse_footer(b"\x00" * FOOTER_SIZE)
+        assert not ok
+
+    @pytest.mark.parametrize("legacy", [True, False])
+    def test_blob_toc_offset(self, legacy):
+        raw = build_estargz({"etc/hosts": b"localhost\n"}, legacy_footer=legacy)
+        blob = mem_blob(raw)
+        off = blob.get_toc_offset()
+        assert 0 < off < len(raw)
+
+    def test_read_toc(self):
+        raw = build_estargz({"bin/sh": b"#!/bin/sh\n", "etc/os": b"linux"})
+        toc = json.loads(mem_blob(raw).read_toc())
+        names = [e["name"] for e in toc["entries"]]
+        assert "bin/sh" in names and "etc/os" in names
+
+    def test_non_stargz_blob_raises(self):
+        with pytest.raises(StargzError):
+            mem_blob(b"not a stargz blob at all, too short" * 4).get_toc_offset()
+
+
+# ---------------------------------------------------------------------------
+# TOC -> bootstrap
+# ---------------------------------------------------------------------------
+
+
+class TestIndexBuild:
+    def toc(self, files):
+        raw = build_estargz(files)
+        return json.loads(mem_blob(raw).read_toc()), raw
+
+    def test_bootstrap_paths_and_digests(self):
+        files = {"etc/hosts": b"localhost\n", "usr/bin/true": b"\x7fELF"}
+        toc, raw = self.toc(files)
+        bs = bootstrap_from_toc(toc, "ab" * 32, blob_compressed_size=len(raw))
+        paths = {i.path for i in bs.inodes}
+        assert {"/", "/etc", "/etc/hosts", "/usr", "/usr/bin", "/usr/bin/true"} <= paths
+        assert len(bs.chunks) == 2
+        digests = {c.digest for c in bs.chunks}
+        assert hashlib.sha256(b"localhost\n").digest() in digests
+        assert all(c.flags & constants.COMPRESSOR_GZIP for c in bs.chunks)
+
+    def test_compressed_sizes_from_offset_deltas(self):
+        toc, raw = self.toc({"a": b"A" * 100, "b": b"B" * 200})
+        bs = bootstrap_from_toc(toc, "cd" * 32, blob_compressed_size=len(raw))
+        by_off = sorted(bs.chunks, key=lambda c: c.compressed_offset)
+        assert by_off[0].compressed_size == by_off[1].compressed_offset - by_off[0].compressed_offset
+        assert by_off[1].compressed_size > 0  # bounded by blob size
+
+    def test_special_entries(self):
+        toc = {
+            "version": 1,
+            "entries": [
+                {"name": "dev", "type": "dir", "mode": 0o755},
+                {"name": "dev/null", "type": "char", "mode": 0o666, "devMajor": 1, "devMinor": 3},
+                {"name": "lnk", "type": "symlink", "linkName": "dev/null", "mode": 0o777},
+                {"name": "fifo", "type": "fifo", "mode": 0o600},
+            ],
+        }
+        bs = bootstrap_from_toc(toc, "ef" * 32)
+        by_path = {i.path: i for i in bs.inodes}
+        assert by_path["/dev/null"].rdev == os.makedev(1, 3)
+        assert by_path["/lnk"].symlink_target == "dev/null"
+
+    def test_go_mode_setuid_translated(self):
+        toc = {
+            "version": 1,
+            "entries": [
+                {
+                    "name": "usr/bin/sudo",
+                    "type": "reg",
+                    "size": 4,
+                    "offset": 0,
+                    # Go os.FileMode: ModeSetuid (1<<23) | 0755
+                    "mode": (1 << 23) | 0o755,
+                    "chunkDigest": "sha256:" + "a" * 64,
+                },
+            ],
+        }
+        bs = bootstrap_from_toc(toc, "bb" * 32)
+        sudo = next(i for i in bs.inodes if i.path == "/usr/bin/sudo")
+        import stat
+
+        assert sudo.mode & stat.S_ISUID
+        assert stat.S_IMODE(sudo.mode) == 0o4755
+
+    def test_chunked_file(self):
+        toc = {
+            "version": 1,
+            "entries": [
+                {
+                    "name": "big",
+                    "type": "reg",
+                    "size": 8 << 20,
+                    "offset": 0,
+                    "chunkSize": 4 << 20,
+                    "chunkDigest": "sha256:" + "0" * 64,
+                },
+                {
+                    "name": "big",
+                    "type": "chunk",
+                    "offset": 1000,
+                    "chunkOffset": 4 << 20,
+                    "chunkSize": 4 << 20,
+                    "chunkDigest": "sha256:" + "1" * 64,
+                },
+            ],
+        }
+        bs = bootstrap_from_toc(toc, "aa" * 32)
+        big = next(i for i in bs.inodes if i.path == "/big")
+        assert big.chunk_count == 2
+        assert bs.chunks[1].uncompressed_offset == 4 << 20
+
+    def test_serialized_roundtrip(self):
+        toc, raw = self.toc({"x/y/z": b"payload"})
+        bs = bootstrap_from_toc(toc, "12" * 32, blob_compressed_size=len(raw))
+        again = Bootstrap.from_bytes(bs.to_bytes())
+        assert {i.path for i in again.inodes} == {i.path for i in bs.inodes}
+        assert again.chunks[0].digest == bs.chunks[0].digest
+
+    def test_bad_version_rejected(self):
+        with pytest.raises(Exception):
+            bootstrap_from_toc({"version": 2, "entries": []}, "ab" * 32)
+
+
+# ---------------------------------------------------------------------------
+# adaptor
+# ---------------------------------------------------------------------------
+
+
+class _Snap:
+    def __init__(self, parent_ids):
+        self.parent_ids = parent_ids
+
+
+class TestAdaptor:
+    def _adaptor(self, tmp_path):
+        snapdir = tmp_path / "snapshots"
+        cache = tmp_path / "cache"
+        snapdir.mkdir()
+        cache.mkdir()
+        return (
+            StargzAdaptor(
+                lambda sid: str(snapdir / sid / "fs"), cache_dir=str(cache)
+            ),
+            snapdir,
+            cache,
+        )
+
+    def test_prepare_writes_bootstrap_toc_and_meta(self, tmp_path):
+        adaptor, snapdir, cache = self._adaptor(tmp_path)
+        raw = build_estargz({"app/run.sh": b"echo hi\n"})
+        blob = mem_blob(raw)
+        hexd = blob.digest.split(":")[1]
+        storage = snapdir / "1" / "fs"
+        storage.mkdir(parents=True)
+        adaptor.prepare_meta_layer(blob, str(storage), {})
+        assert (storage / hexd).exists()
+        assert (storage / TOC_FILENAME).exists()
+        assert (cache / f"{hexd}.blob.meta").exists()
+        bs = Bootstrap.from_bytes((storage / hexd).read_bytes())
+        assert "/app/run.sh" in {i.path for i in bs.inodes}
+
+    def test_prepare_is_idempotent(self, tmp_path):
+        adaptor, snapdir, _ = self._adaptor(tmp_path)
+        raw = build_estargz({"f": b"data"})
+        blob = mem_blob(raw)
+        storage = snapdir / "1" / "fs"
+        storage.mkdir(parents=True)
+        adaptor.prepare_meta_layer(blob, str(storage), {})
+        first = (storage / blob.digest.split(":")[1]).read_bytes()
+        adaptor.prepare_meta_layer(blob, str(storage), {})
+        assert (storage / blob.digest.split(":")[1]).read_bytes() == first
+
+    def test_merge_two_layers(self, tmp_path):
+        adaptor, snapdir, _ = self._adaptor(tmp_path)
+        # lower layer = snapshot "2" (deeper in parent_ids), upper = "1"
+        layers = {
+            "2": {"etc/lower": b"lower data"},
+            "1": {"etc/upper": b"upper data"},
+        }
+        for sid, files in layers.items():
+            raw = build_estargz(files)
+            blob = mem_blob(raw)
+            storage = snapdir / sid / "fs"
+            storage.mkdir(parents=True)
+            adaptor.prepare_meta_layer(blob, str(storage), {})
+        adaptor.merge_meta_layer(_Snap(["1", "2"]))
+        merged = snapdir / "1" / "fs" / "image.boot"
+        assert merged.exists()
+        bs = Bootstrap.from_bytes(merged.read_bytes())
+        paths = {i.path for i in bs.inodes}
+        assert "/etc/lower" in paths and "/etc/upper" in paths
+        # both source blobs referenced
+        assert len(bs.blobs) == 2
+
+    def test_merge_single_layer_copies(self, tmp_path):
+        adaptor, snapdir, _ = self._adaptor(tmp_path)
+        raw = build_estargz({"only": b"one"})
+        blob = mem_blob(raw)
+        storage = snapdir / "9" / "fs"
+        storage.mkdir(parents=True)
+        adaptor.prepare_meta_layer(blob, str(storage), {})
+        adaptor.merge_meta_layer(_Snap(["9"]))
+        assert (storage / "image.boot").exists()
+
+    def test_merge_missing_bootstrap_raises(self, tmp_path):
+        adaptor, snapdir, _ = self._adaptor(tmp_path)
+        (snapdir / "5" / "fs").mkdir(parents=True)
+        with pytest.raises(Exception):
+            adaptor.merge_meta_layer(_Snap(["5"]))
+
+
+def test_resolver_get_blob_live():
+    """Resolver.get_blob against the fake registry: footer verified at
+    resolve time, TOC readable over real HTTP ranges; a plain OCI layer is
+    rejected at get_blob (stargz detection, fs.go IsStargzDataLayer)."""
+    from nydus_snapshotter_tpu.remote.transport import Pool
+    from nydus_snapshotter_tpu.stargz.resolver import Resolver
+
+    from tests.test_remote import FakeRegistry
+
+    reg = FakeRegistry(require_auth=False)
+    try:
+        raw = build_estargz({"etc/app.conf": b"key=val\n"})
+        digest = reg.add_blob(raw)
+        plain = reg.add_blob(b"just a plain layer " * 100)
+        resolver = Resolver(pool=Pool(plain_http=True))
+        ref = f"{reg.host}/library/app:latest"
+        blob = resolver.get_blob(ref, digest)
+        assert blob.size == len(raw)
+        toc = json.loads(blob.read_toc())
+        assert any(e["name"] == "etc/app.conf" for e in toc["entries"])
+        with pytest.raises(StargzError):
+            resolver.get_blob(ref, plain)
+    finally:
+        reg.close()
+
+
+def test_blob_size_probe():
+    """_blob_size parses Content-Range from a 0-0 range probe."""
+    from nydus_snapshotter_tpu.stargz.resolver import _blob_size
+
+    class FakeResp:
+        headers = {"content-range": "bytes 0-0/12345"}
+
+        def read(self):
+            return b"x"
+
+        def close(self):
+            pass
+
+    class FakeClient:
+        def fetch_blob(self, repo, digest, byte_range=None):
+            assert byte_range == (0, 0)
+            return FakeResp()
+
+    assert _blob_size(FakeClient(), "library/app", "sha256:" + "0" * 64) == 12345
