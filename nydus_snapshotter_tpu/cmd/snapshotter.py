@@ -140,6 +140,21 @@ def build_stack(cfg: SnapshotterConfig):
         from nydus_snapshotter_tpu.referrer import ReferrerManager
 
         referrer_mgr = ReferrerManager()
+    tarfs_mgr = None
+    if cfg.experimental.tarfs_enable:
+        from nydus_snapshotter_tpu.ops.chunker import ChunkDigestEngine
+        from nydus_snapshotter_tpu.tarfs import DEFAULT_CHUNK_SIZE
+        from nydus_snapshotter_tpu.tarfs import Manager as TarfsManager
+
+        tarfs_mgr = TarfsManager(
+            cache_dir_path=cfg.cache_root,
+            mount_on_host=cfg.experimental.tarfs_mount_on_host,
+            export_mode=cfg.experimental.tarfs_export_mode,
+            max_concurrent_process=cfg.experimental.tarfs_max_concurrent_proc,
+            # tarfs boundaries come from the tar layout (fixed regions);
+            # the batched device SHA-256 path digests them
+            engine=ChunkDigestEngine(chunk_size=DEFAULT_CHUNK_SIZE, mode="fixed"),
+        )
 
     fs = Filesystem(
         managers=managers,
@@ -151,6 +166,8 @@ def build_stack(cfg: SnapshotterConfig):
         stargz_resolver=stargz_resolver,
         stargz_adaptor=stargz_adaptor,
         referrer_mgr=referrer_mgr,
+        tarfs_mgr=tarfs_mgr,
+        tarfs_export=cfg.experimental.tarfs_export_mode != "",
     )
     fs.startup()
 

@@ -101,7 +101,7 @@ def tree_from_tar(fileobj: BinaryIO | bytes) -> list[FileEntry]:
                     flags=INODE_FLAG_WHITEOUT,
                 )
                 continue
-            entry = _entry_from_tarinfo(tf, info, path)
+            entry = entry_from_tarinfo(tf, info, path)
             entries[path] = entry
     for d in opaque_dirs:
         if d not in entries:
@@ -111,7 +111,9 @@ def tree_from_tar(fileobj: BinaryIO | bytes) -> list[FileEntry]:
     return sorted(entries.values(), key=lambda e: e.path)
 
 
-def _entry_from_tarinfo(tf: tarfile.TarFile, info: tarfile.TarInfo, path: str) -> FileEntry:
+def entry_from_tarinfo(
+    tf: tarfile.TarFile, info: tarfile.TarInfo, path: str, with_data: bool = True
+) -> FileEntry:
     # tarfile decodes pax values as utf-8 with surrogateescape; encoding back
     # the same way round-trips arbitrary binary xattrs (e.g. the
     # security.capability on ping/sudo) losslessly.
@@ -150,8 +152,9 @@ def _entry_from_tarinfo(tf: tarfile.TarFile, info: tarfile.TarInfo, path: str) -
         e.mode = stat.S_IFIFO | perm
     elif info.isreg():
         e.mode = stat.S_IFREG | perm
-        f = tf.extractfile(info)
-        e.data = f.read() if f is not None else b""
+        if with_data:
+            f = tf.extractfile(info)
+            e.data = f.read() if f is not None else b""
     else:
         raise FsTreeError(f"unsupported tar entry type {info.type!r} at {path}")
     return e

@@ -198,6 +198,23 @@ class ChunkDigestEngine:
                 out[i] = sha256.digest_to_bytes(states[row])
         return out  # type: ignore[return-value]
 
+    def digest_many(self, datas: list[bytes]) -> list[bytes]:
+        """Batched digests of pre-delimited chunks (no CDC) — the tarfs /
+        index build sources, where boundaries come from the tar layout."""
+        if not datas:
+            return []
+        if self.digest_backend == "numpy":
+            import hashlib
+
+            return [hashlib.sha256(d).digest() for d in datas]
+        arr = np.frombuffer(b"".join(datas), dtype=np.uint8)
+        extents = []
+        off = 0
+        for d in datas:
+            extents.append((off, len(d)))
+            off += len(d)
+        return self._digests_bucketed(arr, extents)
+
     # -- end to end ---------------------------------------------------------
 
     def process(self, data: bytes | np.ndarray) -> list[ChunkMeta]:

@@ -1,0 +1,115 @@
+"""Loop-device attach/detach (reference tarfs.go:754-760 via go-losetup).
+
+Implemented against the kernel loop-control API directly (LOOP_CTL_GET_FREE
++ LOOP_CONFIGURE/LOOP_SET_FD), with a ``losetup(8)`` CLI fallback. All entry
+points honor a module-level ``backend`` hook so unit tests can substitute a
+fake (mounting needs root, CI has none).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import struct
+import subprocess
+from dataclasses import dataclass
+from typing import Optional
+
+from nydus_snapshotter_tpu.utils import errdefs
+
+LOOP_CTL_GET_FREE = 0x4C82
+LOOP_SET_FD = 0x4C00
+LOOP_CLR_FD = 0x4C01
+LOOP_SET_STATUS64 = 0x4C04
+LOOP_CONTROL = "/dev/loop-control"
+
+LO_FLAGS_READ_ONLY = 1
+LO_FLAGS_AUTOCLEAR = 4
+
+
+@dataclass
+class LoopDevice:
+    index: int
+
+    @property
+    def path(self) -> str:
+        return f"/dev/loop{self.index}"
+
+    def detach(self) -> None:
+        backend.detach(self)
+
+
+class KernelBackend:
+    """ioctl-based loop management (what go-losetup does)."""
+
+    def attach(self, blob_path: str, offset: int = 0, ro: bool = True) -> LoopDevice:
+        with open(LOOP_CONTROL, "rb") as ctl:
+            index = fcntl.ioctl(ctl.fileno(), LOOP_CTL_GET_FREE)
+        dev = LoopDevice(index)
+        flags = os.O_RDONLY if ro else os.O_RDWR
+        blob_fd = os.open(blob_path, flags)
+        try:
+            dev_fd = os.open(dev.path, flags)
+            try:
+                fcntl.ioctl(dev_fd, LOOP_SET_FD, blob_fd)
+                # struct loop_info64: lo_device@0, lo_inode@8, lo_rdevice@16,
+                # lo_offset@24, ..., lo_flags@52, lo_file_name@56
+                info = bytearray(232)
+                struct.pack_into("<Q", info, 24, offset)  # lo_offset
+                struct.pack_into(
+                    "<I", info, 52, LO_FLAGS_READ_ONLY if ro else 0
+                )  # lo_flags
+                name = blob_path.encode()[:63]
+                info[56 : 56 + len(name)] = name  # lo_file_name
+                fcntl.ioctl(dev_fd, LOOP_SET_STATUS64, bytes(info))
+            finally:
+                os.close(dev_fd)
+        finally:
+            os.close(blob_fd)
+        return dev
+
+    def detach(self, dev: LoopDevice) -> None:
+        fd = os.open(dev.path, os.O_RDONLY)
+        try:
+            fcntl.ioctl(fd, LOOP_CLR_FD, 0)
+        finally:
+            os.close(fd)
+
+
+class CliBackend:
+    """losetup(8) fallback."""
+
+    def attach(self, blob_path: str, offset: int = 0, ro: bool = True) -> LoopDevice:
+        cmd = ["losetup", "--find", "--show"]
+        if ro:
+            cmd.append("--read-only")
+        if offset:
+            cmd += ["--offset", str(offset)]
+        cmd.append(blob_path)
+        out = subprocess.run(cmd, capture_output=True, text=True, check=True)
+        path = out.stdout.strip()
+        if not path.startswith("/dev/loop"):
+            raise errdefs.Unavailable(f"losetup returned {path!r}")
+        return LoopDevice(int(path[len("/dev/loop") :]))
+
+    def detach(self, dev: LoopDevice) -> None:
+        subprocess.run(["losetup", "--detach", dev.path], check=True)
+
+
+backend = KernelBackend()
+
+
+def attach(blob_path: str, offset: int = 0, ro: bool = True) -> LoopDevice:
+    """Attach ``blob_path`` to a free loop device (thread-safety is the
+    caller's job — reference holds mutexLoopDev, tarfs.go:754-760)."""
+    try:
+        return backend.attach(blob_path, offset=offset, ro=ro)
+    except (PermissionError, FileNotFoundError) as e:
+        raise errdefs.Unavailable(f"loop attach of {blob_path} failed: {e}") from e
+
+
+def detach(dev: LoopDevice) -> None:
+    try:
+        backend.detach(dev)
+    except (PermissionError, FileNotFoundError) as e:
+        raise errdefs.Unavailable(f"loop detach of {dev.path} failed: {e}") from e

@@ -1,0 +1,445 @@
+"""tarfs package tests: tar indexing, verity trees, manager lifecycle.
+
+Mirrors the reference integration scenarios (tarfs blob process, merge,
+export, mount) with the OS backends faked and an in-process fake registry.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import os
+import tarfile
+import threading
+
+import pytest
+
+from nydus_snapshotter_tpu import constants as C
+from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
+from nydus_snapshotter_tpu.remote.remote import Remote
+from nydus_snapshotter_tpu.tarfs import (
+    ExportFlags,
+    Manager,
+    tarfs_bootstrap_from_tar,
+    verity,
+)
+from nydus_snapshotter_tpu.utils import errdefs, losetup
+from nydus_snapshotter_tpu.utils import mount as mount_utils
+
+from tests.test_remote import FakeRegistry
+
+
+def make_tar(files: dict[str, bytes]) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:", format=tarfile.GNU_FORMAT) as tf:
+        for name, data in files.items():
+            if name.endswith("/"):
+                info = tarfile.TarInfo(name.rstrip("/"))
+                info.type = tarfile.DIRTYPE
+                info.mode = 0o755
+                tf.addfile(info)
+            else:
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                info.mode = 0o644
+                tf.addfile(info, io.BytesIO(data))
+    return buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# tar-tarfs bootstrap
+# ---------------------------------------------------------------------------
+
+
+class TestTarfsBootstrap:
+    def test_chunks_point_into_tar(self):
+        files = {"etc/": b"", "etc/hosts": b"127.0.0.1 localhost\n", "big": b"Z" * 5000}
+        raw = make_tar(files)
+        bs = tarfs_bootstrap_from_tar(io.BytesIO(raw), "ab" * 32)
+        by_path = {i.path: i for i in bs.inodes}
+        hosts = by_path["/etc/hosts"]
+        assert hosts.chunk_count == 1
+        chunk = bs.chunks[hosts.chunk_index]
+        # the chunk's offset indexes the file data inside the tar itself
+        assert raw[chunk.uncompressed_offset : chunk.uncompressed_offset + chunk.uncompressed_size] == files["etc/hosts"]
+        assert chunk.digest == hashlib.sha256(files["etc/hosts"]).digest()
+
+    def test_large_file_split_by_chunk_size(self):
+        data = bytes(range(256)) * 64  # 16 KiB
+        raw = make_tar({"blob": data})
+        bs = tarfs_bootstrap_from_tar(io.BytesIO(raw), "cd" * 32, chunk_size=4096)
+        blob_inode = next(i for i in bs.inodes if i.path == "/blob")
+        assert blob_inode.chunk_count == 4
+        assert blob_inode.size == len(data)
+        # regions reassemble exactly
+        got = b"".join(
+            raw[c.uncompressed_offset : c.uncompressed_offset + c.uncompressed_size]
+            for c in bs.chunks[blob_inode.chunk_index : blob_inode.chunk_index + 4]
+        )
+        assert got == data
+
+    def test_whiteout_normalization(self):
+        raw = make_tar({"dir/": b"", "dir/.wh.gone": b"", "dir/.wh..wh..opq": b""})
+        bs = tarfs_bootstrap_from_tar(io.BytesIO(raw), "ef" * 32)
+        by_path = {i.path: i for i in bs.inodes}
+        from nydus_snapshotter_tpu.models.bootstrap import (
+            INODE_FLAG_OPAQUE,
+            INODE_FLAG_WHITEOUT,
+        )
+
+        assert by_path["/dir/gone"].flags & INODE_FLAG_WHITEOUT
+        assert by_path["/dir"].flags & INODE_FLAG_OPAQUE
+
+    def test_duplicate_member_last_wins_without_stale_chunks(self):
+        # same path twice: first a 5 KiB file, then a zero-size replacement
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:", format=tarfile.GNU_FORMAT) as tf:
+            info = tarfile.TarInfo("foo")
+            info.size = 5120
+            tf.addfile(info, io.BytesIO(b"A" * 5120))
+            info2 = tarfile.TarInfo("foo")
+            info2.size = 0
+            tf.addfile(info2)
+        bs = tarfs_bootstrap_from_tar(io.BytesIO(buf.getvalue()), "aa" * 32)
+        foo = next(i for i in bs.inodes if i.path == "/foo")
+        assert foo.chunk_count == 0 and foo.size == 0
+
+    def test_serialized_roundtrip(self):
+        raw = make_tar({"a/b/c": b"deep"})
+        bs = tarfs_bootstrap_from_tar(io.BytesIO(raw), "12" * 32)
+        again = Bootstrap.from_bytes(bs.to_bytes())
+        assert {i.path for i in again.inodes} == {i.path for i in bs.inodes}
+        assert again.blobs[0].uncompressed_size == len(raw)
+
+
+# ---------------------------------------------------------------------------
+# dm-verity
+# ---------------------------------------------------------------------------
+
+
+class TestVerity:
+    def test_tree_roundtrip(self):
+        data = os.urandom(512 * 300)
+        tree, info = verity.build_tree(data)
+        assert info.data_blocks == 300
+        assert verity.verify(data, info, tree)
+
+    def test_tamper_detected(self):
+        data = bytearray(os.urandom(512 * 64))
+        tree, info = verity.build_tree(bytes(data))
+        data[100] ^= 0xFF
+        assert not verity.verify(bytes(data), info, tree)
+
+    def test_multi_level_tree(self):
+        # >128 blocks forces a second level; >16384 a third
+        data = b"\xAA" * (512 * 200)
+        tree, info = verity.build_tree(data)
+        # level0: 200 digests -> 2 hash blocks; level1: 1 block
+        assert len(tree) == 3 * verity.HASH_BLOCK_SIZE
+        assert verity.verify(data, info, tree)
+
+    def test_unaligned_rejected(self):
+        with pytest.raises(ValueError):
+            verity.build_tree(b"x" * 777)
+
+    def test_block_info_label_roundtrip(self):
+        info = verity.VerityInfo(123, 4096, "ab" * 32)
+        parsed = verity.parse_block_info_label(info.block_info_label())
+        assert parsed == info
+
+    def test_export_flags_modes(self):
+        assert ExportFlags.from_mode("image_block_with_verity") == ExportFlags(True, True, True)
+        assert ExportFlags.from_mode("layer_verity_only") == ExportFlags(False, False, True)
+        with pytest.raises(errdefs.InvalidArgument):
+            ExportFlags.from_mode("bogus")
+
+
+# ---------------------------------------------------------------------------
+# manager lifecycle against the fake registry
+# ---------------------------------------------------------------------------
+
+
+class FakeLoopBackend:
+    def __init__(self):
+        self.attached: dict[int, str] = {}
+        self._next = 0
+
+    def attach(self, blob_path, offset=0, ro=True):
+        dev = losetup.LoopDevice(self._next)
+        self.attached[self._next] = blob_path
+        self._next += 1
+        return dev
+
+    def detach(self, dev):
+        self.attached.pop(dev.index, None)
+
+
+class FakeMounter:
+    def __init__(self):
+        self.mounts: dict[str, tuple[str, str, str]] = {}
+
+    def mount(self, source, target, fstype, options=""):
+        self.mounts[target] = (source, fstype, options)
+
+    def umount(self, target, flags=0):
+        self.mounts.pop(target, None)
+
+
+@pytest.fixture()
+def fake_os(monkeypatch):
+    loop = FakeLoopBackend()
+    mounter = FakeMounter()
+    monkeypatch.setattr(losetup, "backend", loop)
+    monkeypatch.setattr(mount_utils, "backend", mounter)
+    return loop, mounter
+
+
+@pytest.fixture(autouse=True)
+def plain_http(monkeypatch):
+    orig = Remote.__init__
+
+    def patched(self, keychain=None, insecure=False):
+        orig(self, keychain=keychain, insecure=insecure)
+        self.with_plain_http = True
+
+    monkeypatch.setattr(Remote, "__init__", patched)
+
+
+@pytest.fixture()
+def registry():
+    reg = FakeRegistry(require_auth=False)
+    yield reg
+    reg.close()
+
+
+def publish_image(reg: FakeRegistry, layers: list[dict[str, bytes]], tarfs_hint=None):
+    """Push gzip layer blobs + manifest + config; returns (ref labels list)."""
+    layer_descs = []
+    diff_ids = []
+    for files in layers:
+        tar = make_tar(files)
+        blob = gzip.compress(tar)
+        digest = reg.add_blob(blob)
+        layer_descs.append(
+            {"mediaType": "application/vnd.oci.image.layer.v1.tar+gzip",
+             "digest": digest, "size": len(blob)}
+        )
+        diff_ids.append("sha256:" + hashlib.sha256(tar).hexdigest())
+    config = {"rootfs": {"type": "layers", "diff_ids": diff_ids}}
+    cfg_body = json.dumps(config).encode()
+    cfg_digest = reg.add_blob(cfg_body)
+    manifest = {
+        "schemaVersion": 2,
+        "config": {"mediaType": "application/vnd.oci.image.config.v1+json",
+                   "digest": cfg_digest, "size": len(cfg_body)},
+        "layers": layer_descs,
+    }
+    if tarfs_hint is not None:
+        manifest["annotations"] = {C.TARFS_HINT: tarfs_hint}
+    mbody = json.dumps(manifest).encode()
+    mdigest = reg.add_blob(mbody)
+    return mdigest, [d["digest"] for d in layer_descs]
+
+
+def snap_labels(reg, manifest_digest, layer_digest):
+    return {
+        C.CRI_IMAGE_REF: f"{reg.host}/library/app:latest",
+        C.CRI_MANIFEST_DIGEST: manifest_digest,
+        C.CRI_LAYER_DIGEST: layer_digest,
+    }
+
+
+class _Snap:
+    def __init__(self, sid, parent_ids):
+        self.id = sid
+        self.parent_ids = parent_ids
+
+
+class TestManager:
+    def _mgr(self, tmp_path, **kw):
+        return Manager(cache_dir_path=str(tmp_path / "cache"), **kw)
+
+    def test_prepare_and_ready(self, registry, tmp_path):
+        mdigest, layer_digests = publish_image(
+            registry, [{"etc/a": b"data-a"}]
+        )
+        mgr = self._mgr(tmp_path)
+        upper = tmp_path / "snap" / "1" / "fs"
+        upper.mkdir(parents=True)
+        mgr.prepare_layer(snap_labels(registry, mdigest, layer_digests[0]), "1", str(upper))
+        mgr.wait_layer_ready("1")
+        blob_id = layer_digests[0].split(":")[1]
+        assert os.path.exists(mgr.layer_tar_file_path(blob_id))
+        assert os.path.exists(mgr.layer_meta_file_path(str(upper)))
+        bs = Bootstrap.from_bytes(open(mgr.layer_meta_file_path(str(upper)), "rb").read())
+        assert "/etc/a" in {i.path for i in bs.inodes}
+
+    def test_diff_id_mismatch_fails(self, registry, tmp_path):
+        # publish layer whose diffID in config is wrong
+        tar = make_tar({"f": b"x"})
+        blob = gzip.compress(tar)
+        digest = registry.add_blob(blob)
+        config = {"rootfs": {"type": "layers", "diff_ids": ["sha256:" + "0" * 64]}}
+        cfg_body = json.dumps(config).encode()
+        cfg_digest = registry.add_blob(cfg_body)
+        manifest = {"schemaVersion": 2,
+                    "config": {"digest": cfg_digest, "size": len(cfg_body)},
+                    "layers": [{"digest": digest, "size": len(blob)}]}
+        mdigest = registry.add_blob(json.dumps(manifest).encode())
+        mgr = self._mgr(tmp_path)
+        upper = tmp_path / "s" / "fs"
+        upper.mkdir(parents=True)
+        mgr.prepare_layer(snap_labels(registry, mdigest, digest), "1", str(upper))
+        with pytest.raises(errdefs.Unavailable):
+            mgr.wait_layer_ready("1")
+
+    def test_duplicate_prepare_rejected(self, registry, tmp_path):
+        mdigest, layer_digests = publish_image(registry, [{"a": b"1"}])
+        mgr = self._mgr(tmp_path)
+        upper = tmp_path / "s" / "fs"
+        upper.mkdir(parents=True)
+        labels = snap_labels(registry, mdigest, layer_digests[0])
+        mgr.prepare_layer(labels, "1", str(upper))
+        with pytest.raises(errdefs.AlreadyExists):
+            mgr.prepare_layer(labels, "1", str(upper))
+        mgr.wait_layer_ready("1")
+
+    def test_tarfs_hint_annotation(self, registry, tmp_path):
+        mdigest, _ = publish_image(registry, [{"a": b"1"}], tarfs_hint="true")
+        mgr = self._mgr(tmp_path, check_tarfs_hint=True)
+        ref = f"{registry.host}/library/app:latest"
+        assert mgr.check_tarfs_hint_annotation(ref, mdigest) is True
+        # cached second call
+        assert mgr.check_tarfs_hint_annotation(ref, mdigest) is True
+        # hint disabled -> always true
+        mgr2 = self._mgr(tmp_path / "m2")
+        assert mgr2.check_tarfs_hint_annotation(ref, "sha256:" + "1" * 64) is True
+
+    def _prepare_two_layers(self, registry, tmp_path):
+        mdigest, layer_digests = publish_image(
+            registry,
+            [{"etc/lower": b"lower"}, {"etc/upper": b"upper"}],
+        )
+        mgr = self._mgr(tmp_path)
+        uppers = {}
+        # snapshot ids: layer 0 -> "2" (bottom), layer 1 -> "1"
+        for sid, ld in zip(["2", "1"], layer_digests):
+            upper = tmp_path / "snap" / sid / "fs"
+            upper.mkdir(parents=True)
+            uppers[sid] = str(upper)
+            mgr.prepare_layer(snap_labels(registry, mdigest, ld), sid, str(upper))
+            mgr.wait_layer_ready(sid)
+        return mgr, uppers, layer_digests
+
+    def test_merge_layers(self, registry, tmp_path):
+        mgr, uppers, _ = self._prepare_two_layers(registry, tmp_path)
+        snap = _Snap("0", ["1", "2"])
+        mgr.merge_layers(snap, lambda sid: uppers[sid])
+        merged = mgr.image_meta_file_path(uppers["1"])
+        bs = Bootstrap.from_bytes(open(merged, "rb").read())
+        paths = {i.path for i in bs.inodes}
+        assert "/etc/lower" in paths and "/etc/upper" in paths
+        assert len(bs.blobs) == 2
+
+    def test_export_block_data_with_verity(self, registry, tmp_path):
+        mgr, uppers, layer_digests = self._prepare_two_layers(registry, tmp_path)
+        mgr.export_flags = ExportFlags.from_mode("image_block_with_verity")
+        snap = _Snap("0", ["1", "2"])
+        mgr.merge_layers(snap, lambda sid: uppers[sid])
+        blob_id = layer_digests[1].split(":")[1]
+        labels = {C.NYDUS_TARFS_LAYER: blob_id}
+        fields = mgr.export_block_data(snap, False, labels, lambda sid: uppers[sid])
+        assert fields == ["labels." + C.NYDUS_IMAGE_BLOCK_INFO]
+        info = verity.parse_block_info_label(labels[C.NYDUS_IMAGE_BLOCK_INFO])
+        disk = mgr.image_disk_file_path(blob_id)
+        assert os.path.exists(disk)
+        # verify the tree embedded in the exported image
+        with open(disk, "rb") as f:
+            img = f.read()
+        data = img[: info.data_blocks * verity.DATA_BLOCK_SIZE]
+        tree = img[info.hash_offset :]
+        assert verity.verify(data, info, tree)
+
+    def test_export_reuses_verity_info_for_existing_disk(self, registry, tmp_path):
+        mgr, uppers, layer_digests = self._prepare_two_layers(registry, tmp_path)
+        mgr.export_flags = ExportFlags.from_mode("image_block_with_verity")
+        snap = _Snap("0", ["1", "2"])
+        mgr.merge_layers(snap, lambda sid: uppers[sid])
+        blob_id = layer_digests[1].split(":")[1]
+        first = {C.NYDUS_TARFS_LAYER: blob_id}
+        mgr.export_block_data(snap, False, first, lambda sid: uppers[sid])
+        # second snapshot of the same image: disk exists, info must be reused
+        second = {C.NYDUS_TARFS_LAYER: blob_id}
+        mgr.export_block_data(snap, False, second, lambda sid: uppers[sid])
+        assert second[C.NYDUS_IMAGE_BLOCK_INFO] == first[C.NYDUS_IMAGE_BLOCK_INFO]
+        assert second[C.NYDUS_IMAGE_BLOCK_INFO] != ""
+
+    def test_remount_is_idempotent_and_sets_mountpoint(self, registry, tmp_path, fake_os):
+        mgr, uppers, _ = self._prepare_two_layers(registry, tmp_path)
+        mgr.mount_on_host = True
+
+        class R:
+            snapshot_dir = str(tmp_path / "snap" / "1")
+            mountpoint = ""
+            annotations = {}
+
+        snap = _Snap("0", ["1", "2"])
+        mgr.merge_layers(snap, lambda sid: uppers[sid])
+        mgr.mount_tar_erofs("1", snap, {}, R)
+        first = R.mountpoint
+        R.mountpoint = ""
+        mgr.mount_tar_erofs("1", snap, {}, R)  # replay
+        assert R.mountpoint == first != ""
+
+    def test_export_disabled_is_noop(self, registry, tmp_path):
+        mgr = self._mgr(tmp_path)
+        assert mgr.export_block_data(_Snap("0", ["1"]), False, {}, lambda s: "") == []
+
+    def test_mount_without_host_mount_uses_upper(self, registry, tmp_path, fake_os):
+        mgr, uppers, _ = self._prepare_two_layers(registry, tmp_path)
+
+        class R:
+            snapshot_dir = str(tmp_path / "snap" / "1")
+            mountpoint = ""
+            annotations = {}
+
+        snap = _Snap("0", ["1", "2"])
+        mgr.merge_layers(snap, lambda sid: uppers[sid])
+        mgr.mount_tar_erofs("1", snap, {C.NYDUS_TARFS_LAYER: "xyz"}, R)
+        assert R.mountpoint == uppers["1"]
+        assert R.annotations[C.NYDUS_TARFS_LAYER] == "xyz"
+
+    def test_mount_on_host_loops_and_mounts(self, registry, tmp_path, fake_os):
+        loop, mounter = fake_os
+        mgr, uppers, _ = self._prepare_two_layers(registry, tmp_path)
+        mgr.mount_on_host = True
+
+        class R:
+            snapshot_dir = str(tmp_path / "snap" / "1")
+            mountpoint = ""
+            annotations = {}
+
+        snap = _Snap("0", ["1", "2"])
+        mgr.merge_layers(snap, lambda sid: uppers[sid])
+        mgr.mount_tar_erofs("1", snap, {}, R)
+        mnt = os.path.join(str(tmp_path / "snap" / "1"), "mnt")
+        assert R.mountpoint == mnt
+        src, fstype, opts = mounter.mounts[mnt]
+        assert fstype == "erofs"
+        assert opts.count("device=") == 2  # both layer tars attached
+        assert len(loop.attached) == 3  # 2 data + 1 meta
+        # umount + detach
+        mgr.umount_tar_erofs("1")
+        assert mnt not in mounter.mounts
+        mgr.detach_layer("1")
+        mgr.detach_layer("2")
+        assert len(loop.attached) == 0
+
+    def test_concurrent_limiter_per_ref(self, tmp_path):
+        mgr = self._mgr(tmp_path, max_concurrent_process=2)
+        l1 = mgr.get_concurrent_limiter("ref-a")
+        assert l1 is mgr.get_concurrent_limiter("ref-a")
+        assert l1 is not mgr.get_concurrent_limiter("ref-b")
+        assert self._mgr(tmp_path / "x", max_concurrent_process=0).get_concurrent_limiter("r") is None
