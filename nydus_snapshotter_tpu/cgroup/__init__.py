@@ -1,0 +1,11 @@
+"""Daemon cgroup management (reference pkg/cgroup)."""
+
+from nydus_snapshotter_tpu.cgroup.cgroup import (
+    Config,
+    Manager,
+    Mode,
+    CgroupNotSupported,
+    detect_mode,
+)
+
+__all__ = ["CgroupNotSupported", "Config", "Manager", "Mode", "detect_mode"]

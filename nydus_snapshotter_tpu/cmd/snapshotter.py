@@ -100,6 +100,21 @@ def setup_logging(cfg: SnapshotterConfig) -> None:
     )
 
 
+def _parse_size(value: str) -> int:
+    """'512MB' / '1GiB' / '1073741824' → bytes; empty → -1 (unlimited)."""
+    value = value.strip()
+    if not value:
+        return -1
+    units = {"kb": 1000, "mb": 1000**2, "gb": 1000**3,
+             "kib": 1024, "mib": 1024**2, "gib": 1024**3,
+             "k": 1024, "m": 1024**2, "g": 1024**3, "b": 1}
+    lower = value.lower()
+    for suffix in sorted(units, key=len, reverse=True):
+        if lower.endswith(suffix):
+            return int(float(lower[: -len(suffix)]) * units[suffix])
+    return int(value)
+
+
 def build_stack(cfg: SnapshotterConfig):
     """Assemble store → managers → filesystem → snapshotter
     (reference snapshot.NewSnapshotter snapshot.go:64-299)."""
@@ -121,6 +136,31 @@ def build_stack(cfg: SnapshotterConfig):
         managers[cfg.daemon.fs_driver] = mgr
 
     cache_mgr = CacheManager(cfg.cache_root, enabled=cfg.cache_manager.enable)
+
+    # Bootstrap signature verifier (snapshot.go:65) + daemon cgroup
+    # (snapshot.go:88); both optional and config-gated.
+    verifier = None
+    if cfg.image.validate_signature:
+        from nydus_snapshotter_tpu.signature import Verifier
+
+        verifier = Verifier(
+            public_key_file=cfg.image.public_key_file,
+            validate_signature=cfg.image.validate_signature,
+        )
+    cgroup_mgr = None
+    if cfg.cgroup.enable:
+        from nydus_snapshotter_tpu.cgroup import CgroupNotSupported
+        from nydus_snapshotter_tpu.cgroup import Config as CgroupCfg
+        from nydus_snapshotter_tpu.cgroup import Manager as CgroupManager
+
+        try:
+            cgroup_mgr = CgroupManager(
+                "nydusd",
+                CgroupCfg(memory_limit_in_bytes=_parse_size(cfg.cgroup.memory_limit)),
+            )
+        except (CgroupNotSupported, OSError, ValueError) as e:
+            # cgroup problems degrade to a warning, never block startup
+            logger.warning("cgroup disabled: %s", e)
 
     # Optional lazy-pull adaptors (fs.go:58-194 wiring of stargz/referrer).
     stargz_resolver = None
@@ -163,12 +203,15 @@ def build_stack(cfg: SnapshotterConfig):
         fs_driver=cfg.daemon.fs_driver,
         daemon_mode=cfg.daemon_mode,
         daemon_config=daemon_config,
+        verifier=verifier,
         stargz_resolver=stargz_resolver,
         stargz_adaptor=stargz_adaptor,
         referrer_mgr=referrer_mgr,
         tarfs_mgr=tarfs_mgr,
         tarfs_export=cfg.experimental.tarfs_export_mode != "",
     )
+    for mgr in managers.values():
+        mgr.cgroup_mgr = cgroup_mgr
     fs.startup()
 
     sn = Snapshotter(

@@ -103,6 +103,7 @@ class CacheManagerConfig:
 @dataclass
 class ImageConfig:
     public_key_file: str = ""
+    validate_signature: bool = False
     check_pause_image: bool = False
 
 

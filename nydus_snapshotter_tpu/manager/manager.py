@@ -10,6 +10,7 @@ via takeover (SURVEY §3.4). The same takeover dance powers live upgrade.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -22,6 +23,8 @@ from nydus_snapshotter_tpu.daemon.types import DaemonState
 from nydus_snapshotter_tpu.manager.monitor import DeathEvent, LivenessMonitor
 from nydus_snapshotter_tpu.rafs.rafs import Rafs
 from nydus_snapshotter_tpu.store.database import Database
+
+logger = logging.getLogger(__name__)
 from nydus_snapshotter_tpu.supervisor.supervisor import SupervisorSet
 from nydus_snapshotter_tpu.utils import errdefs
 
@@ -45,6 +48,7 @@ class Manager:
         self._event_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.on_death: Optional[Callable[[DeathEvent], None]] = None  # test hook
+        self.cgroup_mgr = None  # optional pkg/cgroup Manager (daemon_adaptor.go:74-86)
 
     # -- daemon book-keeping -------------------------------------------------
 
@@ -105,6 +109,13 @@ class Manager:
         """Spawn + wait READY + subscribe liveness
         (reference daemon_adaptor.go:38-120)."""
         daemon.spawn(upgrade=upgrade)
+        # Corral the daemon into the dedicated cgroup when one is managed
+        # (daemon_adaptor.go:74-86).
+        if self.cgroup_mgr is not None and daemon.pid:
+            try:
+                self.cgroup_mgr.add_proc(daemon.pid)
+            except OSError as e:
+                logger.warning("add daemon %s to cgroup: %s", daemon.id, e)
         daemon.client().wait_until_socket_exists()
         if not upgrade:
             daemon.wait_until_state(DaemonState.READY)

@@ -1,0 +1,5 @@
+"""Bootstrap signature verification (reference pkg/signature)."""
+
+from nydus_snapshotter_tpu.signature.signature import Verifier
+
+__all__ = ["Verifier"]

@@ -1,0 +1,267 @@
+"""signature, encryption, and cgroup package tests
+(reference pkg/signature, pkg/encryption, pkg/cgroup)."""
+
+from __future__ import annotations
+
+import base64
+import os
+
+import pytest
+
+from nydus_snapshotter_tpu import constants as C
+from nydus_snapshotter_tpu.cgroup import (
+    CgroupNotSupported,
+    Config as CgroupConfig,
+    Manager as CgroupManager,
+    Mode,
+    detect_mode,
+)
+from nydus_snapshotter_tpu.converter.content import LocalContentStore
+from nydus_snapshotter_tpu.encryption import (
+    ANNOTATION_ENC_KEYS_JWE,
+    MEDIA_TYPE_LAYER_GZIP_ENC,
+    decrypt_layer,
+    decrypt_nydus_bootstrap,
+    encrypt_layer,
+    encrypt_nydus_bootstrap,
+    filter_out_annotations,
+)
+from nydus_snapshotter_tpu.encryption.encryption import EncryptionError
+from nydus_snapshotter_tpu.remote.registry import Descriptor
+from nydus_snapshotter_tpu.signature import Verifier
+from nydus_snapshotter_tpu.utils import errdefs
+from nydus_snapshotter_tpu.utils.signer import (
+    SignatureError,
+    Signer,
+    generate_keypair,
+    sign,
+)
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    return generate_keypair(2048)
+
+
+# ---------------------------------------------------------------------------
+# signer / signature
+# ---------------------------------------------------------------------------
+
+
+class TestSigner:
+    def test_sign_verify_roundtrip(self, keypair):
+        priv, pub = keypair
+        payload = b"bootstrap contents" * 100
+        sig = sign(priv, payload)
+        Signer(pub).verify(payload, sig)  # no raise
+
+    def test_tampered_payload_rejected(self, keypair):
+        priv, pub = keypair
+        sig = sign(priv, b"real data")
+        with pytest.raises(SignatureError):
+            Signer(pub).verify(b"fake data", sig)
+
+    def test_garbage_key_rejected(self):
+        with pytest.raises(SignatureError):
+            Signer(b"not a pem key")
+
+
+class TestVerifier:
+    def test_verify_with_label(self, keypair, tmp_path):
+        priv, pub = keypair
+        pub_file = tmp_path / "pub.pem"
+        pub_file.write_bytes(pub)
+        boot = tmp_path / "image.boot"
+        boot.write_bytes(b"bootstrap-bytes")
+        sig = sign(priv, b"bootstrap-bytes")
+        labels = {C.NYDUS_SIGNATURE: base64.b64encode(sig).decode()}
+        Verifier(str(pub_file), validate_signature=True).verify(labels, str(boot))
+
+    def test_force_mode_requires_signature(self, keypair, tmp_path):
+        _, pub = keypair
+        pub_file = tmp_path / "pub.pem"
+        pub_file.write_bytes(pub)
+        boot = tmp_path / "b"
+        boot.write_bytes(b"x")
+        with pytest.raises(SignatureError):
+            Verifier(str(pub_file), validate_signature=True).verify({}, str(boot))
+
+    def test_lax_mode_allows_missing_signature(self, tmp_path):
+        boot = tmp_path / "b"
+        boot.write_bytes(b"x")
+        Verifier(validate_signature=False).verify({}, str(boot))
+
+    def test_force_mode_requires_key_file(self):
+        with pytest.raises(errdefs.InvalidArgument):
+            Verifier("", validate_signature=True)
+
+    def test_wrong_signature_rejected(self, keypair, tmp_path):
+        priv, pub = keypair
+        pub_file = tmp_path / "pub.pem"
+        pub_file.write_bytes(pub)
+        boot = tmp_path / "b"
+        boot.write_bytes(b"actual")
+        sig = sign(priv, b"different content")
+        labels = {C.NYDUS_SIGNATURE: base64.b64encode(sig).decode()}
+        with pytest.raises(SignatureError):
+            Verifier(str(pub_file), validate_signature=True).verify(labels, str(boot))
+
+
+# ---------------------------------------------------------------------------
+# encryption
+# ---------------------------------------------------------------------------
+
+
+def _desc(data: bytes, media="application/vnd.oci.image.layer.v1.tar+gzip"):
+    import hashlib
+
+    return Descriptor(
+        media_type=media,
+        digest="sha256:" + hashlib.sha256(data).hexdigest(),
+        size=len(data),
+        annotations={C.LAYER_ANNOTATION_NYDUS_BOOTSTRAP: "true"},
+    )
+
+
+class TestEncryption:
+    def test_encrypt_decrypt_roundtrip(self, keypair):
+        priv, pub = keypair
+        data = b"the nydus bootstrap layer" * 50
+        desc = _desc(data)
+        enc_desc, ciphertext = encrypt_layer(data, desc, [pub])
+        assert enc_desc.media_type == MEDIA_TYPE_LAYER_GZIP_ENC
+        assert ANNOTATION_ENC_KEYS_JWE in enc_desc.annotations
+        assert ciphertext != data
+        plain_desc, plaintext = decrypt_layer(ciphertext, enc_desc, [priv])
+        assert plaintext == data
+        assert plain_desc.digest == desc.digest
+
+    def test_multiple_recipients(self):
+        priv1, pub1 = generate_keypair()
+        priv2, pub2 = generate_keypair()
+        data = b"secret"
+        enc_desc, ciphertext = encrypt_layer(data, _desc(data), [pub1, pub2])
+        for priv in (priv1, priv2):
+            _, plaintext = decrypt_layer(ciphertext, enc_desc, [priv])
+            assert plaintext == data
+
+    def test_wrong_key_rejected(self, keypair):
+        _, pub = keypair
+        wrong_priv, _ = generate_keypair()
+        data = b"secret"
+        enc_desc, ciphertext = encrypt_layer(data, _desc(data), [pub])
+        with pytest.raises(EncryptionError):
+            decrypt_layer(ciphertext, enc_desc, [wrong_priv])
+
+    def test_unwrap_only_does_not_decrypt(self, keypair):
+        priv, pub = keypair
+        data = b"secret"
+        enc_desc, ciphertext = encrypt_layer(data, _desc(data), [pub])
+        new_desc, plaintext = decrypt_layer(ciphertext, enc_desc, [priv], unwrap_only=True)
+        assert new_desc is None and plaintext is None
+
+    def test_unsupported_media_type(self, keypair):
+        _, pub = keypair
+        with pytest.raises(EncryptionError):
+            encrypt_layer(b"x", _desc(b"x", media="application/weird"), [pub])
+
+    def test_filter_out_annotations(self):
+        annos = {
+            "org.opencontainers.image.enc.keys.jwe": "x",
+            "org.opencontainers.image.enc.pubopts": "y",
+            "other": "keep",
+        }
+        assert filter_out_annotations(annos) == {"other": "keep"}
+
+    def test_content_store_flow(self, keypair, tmp_path):
+        priv, pub = keypair
+        cs = LocalContentStore(str(tmp_path))
+        data = b"bootstrap in the content store"
+        info = cs.write_blob(data)
+        desc = _desc(data)
+        enc_desc = encrypt_nydus_bootstrap(cs, desc, [pub])
+        assert cs.exists(enc_desc.digest)
+        plain_desc = decrypt_nydus_bootstrap(cs, enc_desc, [priv])
+        assert cs.read(plain_desc.digest) == data
+        assert plain_desc.digest == info.digest
+
+
+# ---------------------------------------------------------------------------
+# content store
+# ---------------------------------------------------------------------------
+
+
+class TestContentStore:
+    def test_write_read_labels(self, tmp_path):
+        cs = LocalContentStore(str(tmp_path))
+        info = cs.write_blob(b"hello", labels={"a": "1"})
+        assert cs.read(info.digest) == b"hello"
+        cs.update_labels(info.digest, {"b": "2"})
+        assert cs.info(info.digest).labels == {"a": "1", "b": "2"}
+
+    def test_digest_mismatch_rejected(self, tmp_path):
+        cs = LocalContentStore(str(tmp_path))
+        with pytest.raises(errdefs.InvalidArgument):
+            cs.write_blob(b"data", expected_digest="sha256:" + "0" * 64)
+
+    def test_missing_blob_raises(self, tmp_path):
+        cs = LocalContentStore(str(tmp_path))
+        with pytest.raises(errdefs.NotFound):
+            cs.read("sha256:" + "1" * 64)
+
+    def test_walk_and_delete(self, tmp_path):
+        cs = LocalContentStore(str(tmp_path))
+        a = cs.write_blob(b"a")
+        b = cs.write_blob(b"b", labels={"x": "y"})
+        assert {i.digest for i in cs.walk()} == {a.digest, b.digest}
+        cs.delete(a.digest)
+        assert {i.digest for i in cs.walk()} == {b.digest}
+
+
+# ---------------------------------------------------------------------------
+# cgroup (against a tmpdir root)
+# ---------------------------------------------------------------------------
+
+
+class TestCgroup:
+    def _v2_root(self, tmp_path):
+        root = tmp_path / "cgroup"
+        root.mkdir()
+        (root / "cgroup.controllers").write_text("cpu memory")
+        return str(root)
+
+    def _v1_root(self, tmp_path):
+        root = tmp_path / "cgroup"
+        (root / "memory").mkdir(parents=True)
+        return str(root)
+
+    def test_mode_detection(self, tmp_path):
+        assert detect_mode(str(tmp_path / "nope")) is Mode.UNAVAILABLE
+        assert detect_mode(self._v2_root(tmp_path)) is Mode.UNIFIED
+
+    def test_v2_memory_limit_and_procs(self, tmp_path):
+        root = self._v2_root(tmp_path)
+        mgr = CgroupManager("nydusd", CgroupConfig(memory_limit_in_bytes=1 << 30), root=root)
+        cg = os.path.join(root, "system.slice", "nydusd")
+        assert open(os.path.join(cg, "memory.max")).read() == str(1 << 30)
+        mgr.add_proc(1234)
+        assert "1234" in open(os.path.join(cg, "cgroup.procs")).read()
+        mgr.delete()  # best-effort; procs file means rmdir fails, logged
+
+    def test_v1_layout(self, tmp_path):
+        root = self._v1_root(tmp_path)
+        CgroupManager("nydusd", CgroupConfig(memory_limit_in_bytes=512 << 20), root=root)
+        cg = os.path.join(root, "memory", "system.slice", "nydusd")
+        assert open(os.path.join(cg, "memory.limit_in_bytes")).read() == str(512 << 20)
+
+    def test_unavailable_raises(self, tmp_path):
+        with pytest.raises(CgroupNotSupported):
+            CgroupManager("nydusd", root=str(tmp_path / "missing"))
+
+    def test_parse_size(self):
+        from nydus_snapshotter_tpu.cmd.snapshotter import _parse_size
+
+        assert _parse_size("") == -1
+        assert _parse_size("1073741824") == 1 << 30
+        assert _parse_size("512MB") == 512 * 1000**2
+        assert _parse_size("1GiB") == 1 << 30
