@@ -1,0 +1,249 @@
+"""fanotify tracer, overlayfs helper, and NRI plugin tests.
+
+The native optimizer-server is exercised LIVE when the binary exists and
+the kernel grants fanotify (we run as root in CI); otherwise those tests
+skip. Everything else runs hermetically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+
+import pytest
+
+from nydus_snapshotter_tpu.cmd import nydus_overlayfs
+from nydus_snapshotter_tpu.cmd.optimizer_nri import (
+    OptimizerPlugin,
+    PluginConfig,
+    get_image_name,
+)
+from nydus_snapshotter_tpu.cmd.prefetchfiles_nri import (
+    NYDUS_PREFETCH_ANNOTATION,
+    PrefetchPlugin,
+    send_data_over_http,
+)
+from nydus_snapshotter_tpu.fanotify import EventInfo, Server, default_binary_path
+from nydus_snapshotter_tpu.utils import display
+
+BINARY = default_binary_path()
+
+
+# ---------------------------------------------------------------------------
+# native tracer (live)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(
+    not os.path.exists(BINARY) or os.geteuid() != 0,
+    reason="optimizer-server binary missing or not root",
+)
+class TestLiveTracer:
+    def test_trace_and_persist(self, tmp_path):
+        persist = tmp_path / "results" / "app:latest"
+        server = Server(
+            binary_path=BINARY,
+            container_pid=0,  # no setns: trace our own mount ns
+            image_name="app:latest",
+            persist_file=str(persist),
+            readable=False,
+            overwrite=True,
+        )
+        server.run_server()
+        try:
+            time.sleep(0.3)
+            # touch a file on / mount so fanotify sees an open
+            victim = "/etc/hostname"
+            with open(victim, "rb") as f:
+                f.read()
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if persist.exists() and victim in persist.read_text():
+                    break
+                time.sleep(0.1)
+        finally:
+            server.stop_server()
+        content = persist.read_text()
+        assert victim in content
+        csv_text = (tmp_path / "results" / "app:latest.csv").read_text()
+        assert csv_text.startswith("path,size,elapsed")
+        assert victim in csv_text
+
+    def test_sigterm_stops_promptly(self, tmp_path):
+        server = Server(
+            binary_path=BINARY, container_pid=0, image_name="x",
+            persist_file=str(tmp_path / "out"), overwrite=True,
+        )
+        server.run_server()
+        time.sleep(0.2)
+        t0 = time.time()
+        server.stop_server()
+        assert time.time() - t0 < 5
+        assert server.proc is None  # reaped and cleared
+
+
+class TestEventInfo:
+    def test_parse(self):
+        info = EventInfo.from_json_line(b'{"path":"/bin/sh","size":10,"elapsed":55}\n')
+        assert info == EventInfo("/bin/sh", 10, 55)
+
+    def test_bad_line_raises(self):
+        with pytest.raises(Exception):
+            EventInfo.from_json_line(b"not json\n")
+
+
+class TestDisplay:
+    def test_bytes(self):
+        assert display.byte_to_readable_iec(100) == "100 B"
+        assert display.byte_to_readable_iec(1536) == "1.5 KiB"
+        assert display.byte_to_readable_iec(3 << 20) == "3.0 MiB"
+
+    def test_elapsed(self):
+        assert display.microsecond_to_readable(500) == "500 us"
+        assert display.microsecond_to_readable(1500) == "1.5 ms"
+        assert display.microsecond_to_readable(2_500_000) == "2.5 s"
+
+
+# ---------------------------------------------------------------------------
+# nydus-overlayfs helper
+# ---------------------------------------------------------------------------
+
+
+class TestOverlayfsHelper:
+    def test_parse_args_filters_nydus_options(self):
+        margs = nydus_overlayfs.parse_args(
+            [
+                "overlay",
+                "/mnt/target",
+                "-o",
+                "lowerdir=/l2:/l1,upperdir=/u,workdir=/w,"
+                "extraoption=eyJzb3VyY2UiOiJ4In0=,io.katacontainers.volume=abc,dev,suid",
+            ]
+        )
+        assert margs.fs_type == "overlay"
+        assert margs.target == "/mnt/target"
+        assert "dev" in margs.options and "suid" in margs.options
+        assert not any("extraoption" in o or "katacontainers" in o for o in margs.options)
+
+    def test_parse_args_rejects_non_overlay(self):
+        with pytest.raises(ValueError):
+            nydus_overlayfs.parse_args(["ext4", "/mnt", "-o", "ro"])
+
+    def test_parse_args_rejects_empty_options(self):
+        with pytest.raises(ValueError):
+            nydus_overlayfs.parse_args(
+                ["overlay", "/mnt", "-o", "extraoption=x"]
+            )
+
+    def test_parse_options_flags_and_data(self):
+        flags, data = nydus_overlayfs.parse_options(
+            ["ro", "nosuid", "lowerdir=/a", "upperdir=/b"]
+        )
+        assert flags == nydus_overlayfs.MS_RDONLY | nydus_overlayfs.MS_NOSUID
+        assert data == "lowerdir=/a,upperdir=/b"
+
+    def test_run_invokes_mount(self):
+        calls = []
+
+        def fake_mount(source, target, fstype, flags, data):
+            calls.append((source, target, fstype, flags, data))
+
+        nydus_overlayfs.run(
+            ["overlay", "/mnt/x", "-o", "lowerdir=/a,extraoption=zzz,ro"],
+            mount_fn=fake_mount,
+        )
+        assert calls == [("overlay", "/mnt/x", "overlay", nydus_overlayfs.MS_RDONLY, "lowerdir=/a")]
+
+    def test_main_error_exit_code(self):
+        assert nydus_overlayfs.main(["bogus"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# NRI plugins
+# ---------------------------------------------------------------------------
+
+
+class TestOptimizerPlugin:
+    def test_get_image_name(self):
+        annos = {"io.kubernetes.cri.image-name": "ghcr.io/dragonflyoss/nginx:1.21"}
+        dirname, image = get_image_name(annos)
+        assert dirname == "dragonflyoss"
+        assert image == "nginx:1.21"
+
+    def test_start_stop_container(self, tmp_path, monkeypatch):
+        started, stopped = [], []
+
+        class FakeServer:
+            def __init__(self, **kw):
+                self.kw = kw
+
+            def run_server(self):
+                started.append(self.kw)
+
+            def stop_server(self):
+                stopped.append(self.kw["image_name"])
+
+        monkeypatch.setattr(
+            "nydus_snapshotter_tpu.cmd.optimizer_nri.Server", FakeServer
+        )
+        plugin = OptimizerPlugin(
+            PluginConfig(persist_dir=str(tmp_path), timeout=30)
+        )
+        container = {
+            "pid": 4242,
+            "annotations": {"io.kubernetes.cri.image-name": "docker.io/library/redis:7"},
+        }
+        plugin.handle_event({"event": "StartContainer", "container": container})
+        assert len(started) == 1
+        assert started[0]["container_pid"] == 4242
+        assert started[0]["persist_file"].endswith("redis:7.timeout30s")
+        assert "/library/" in started[0]["persist_file"]
+        plugin.handle_event({"event": "StopContainer", "container": container})
+        assert stopped == ["redis:7"]
+
+    def test_stop_unknown_container_raises(self):
+        plugin = OptimizerPlugin(PluginConfig())
+        with pytest.raises(KeyError):
+            plugin.stop_container(
+                {"annotations": {"io.kubernetes.cri.image-name": "a.io/x/y:1"}}
+            )
+
+
+class TestPrefetchPlugin:
+    def test_run_pod_sandbox_puts_to_system_sock(self, tmp_path):
+        # spin the real system controller on a UDS
+        from nydus_snapshotter_tpu.prefetch import Pm
+        from nydus_snapshotter_tpu.system import SystemController
+
+        sock = str(tmp_path / "system.sock")
+        ctl = SystemController(sock_path=sock)
+        ctl.run()
+        try:
+            plugin = PrefetchPlugin(socket_path=sock)
+            prefetch = json.dumps(
+                [{"image": "docker.io/library/nginx:latest",
+                  "prefetch": "/usr/bin/nginx,/etc/nginx/nginx.conf"}]
+            )
+            plugin.handle_event(
+                {
+                    "event": "RunPodSandbox",
+                    "pod": {"annotations": {NYDUS_PREFETCH_ANNOTATION: prefetch}},
+                }
+            )
+            # landed in the global prefetch manager
+            assert (
+                Pm.get_prefetch_info("docker.io/library/nginx:latest")
+                == "/usr/bin/nginx,/etc/nginx/nginx.conf"
+            )
+        finally:
+            ctl.stop()
+
+    def test_pod_without_annotation_is_noop(self, tmp_path):
+        plugin = PrefetchPlugin(socket_path=str(tmp_path / "nonexistent.sock"))
+        plugin.handle_event({"event": "RunPodSandbox", "pod": {"annotations": {}}})
+
+    def test_http_error_raises(self, tmp_path):
+        with pytest.raises(OSError):
+            send_data_over_http("x", "/api/v1/prefetch", str(tmp_path / "no.sock"))
