@@ -19,6 +19,7 @@ from __future__ import annotations
 import hashlib
 import io
 import stat
+import tarfile
 from dataclasses import dataclass, field
 from typing import BinaryIO, Callable, Optional
 
@@ -325,6 +326,23 @@ def bootstrap_from_layer_blob(blob: bytes) -> Bootstrap:
     return Bootstrap.from_bytes(blob[off : off + size])
 
 
+def bootstrap_from_bootstrap_layer(data: bytes) -> Bootstrap:
+    """Extract the image bootstrap from a (decompressed) bootstrap *layer*:
+    a standard tar carrying ``image/image.boot``
+    (constant.go BootstrapFileNameInLayer, written by packToTar)."""
+    try:
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r:") as tf:
+            for member in tf:
+                if member.name in (layout.BOOTSTRAP_FILE, "./" + layout.BOOTSTRAP_FILE):
+                    extracted = tf.extractfile(member)
+                    if extracted is None:
+                        break
+                    return Bootstrap.from_bytes(extracted.read())
+    except (tarfile.TarError, OSError) as e:
+        raise ConvertError(f"bad bootstrap layer tar: {e}") from e
+    raise ConvertError("bootstrap layer carries no image/image.boot")
+
+
 def Merge(
     layers: list[bytes | Bootstrap],
     opt: MergeOption,
@@ -421,8 +439,15 @@ def Merge(
     )
     boot_bytes = bootstrap.to_bytes()
     if opt.with_tar:
+        # Standard forward tar carrying image/image.boot — the bootstrap
+        # *layer* format every consumer expects (reference packToTar;
+        # referrer fetch unpacks it with plain tar, unpack.go:20-56).
         out = io.BytesIO()
-        nydus_tar.append_entry(out, layout.BOOTSTRAP_FILE, boot_bytes)
+        with tarfile.open(fileobj=out, mode="w:", format=tarfile.GNU_FORMAT) as tf:
+            info = tarfile.TarInfo(layout.BOOTSTRAP_FILE)
+            info.size = len(boot_bytes)
+            info.mode = 0o444
+            tf.addfile(info, io.BytesIO(boot_bytes))
         boot_bytes = out.getvalue()
     return MergeResult(
         bootstrap=boot_bytes,

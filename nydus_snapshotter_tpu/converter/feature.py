@@ -1,0 +1,64 @@
+"""Engine feature detection (reference pkg/converter/tool/feature.go).
+
+The reference probes the external ``nydus-image`` binary once by parsing
+``create -h`` output (feature.go:116-146) and gates tar-rafs / batch-size /
+encrypt paths on the result. Here the "builder" is the in-process engine,
+so detection inspects the installed engine + environment instead — but the
+same Feature surface and one-shot caching semantics are kept so converter
+call-sites stay shaped like the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from enum import Enum
+from typing import Optional
+
+
+class Feature(str, Enum):
+    TAR_RAFS = "--type tar-rafs"  # feature.go:25-38
+    BATCH_SIZE = "--batch-size"
+    ENCRYPT = "--encrypt"
+    CDC_CHUNKING = "--chunking cdc"  # accel-only: content-defined chunking
+    DEVICE_DIGEST = "--digest-device"  # batched SHA-256 on device
+
+
+class Features:
+    def __init__(self, features: set[Feature]):
+        self._features = features
+
+    def contains(self, feature: Feature) -> bool:
+        return feature in self._features
+
+    def __iter__(self):
+        return iter(self._features)
+
+
+_lock = threading.Lock()
+_detected: Optional[Features] = None
+
+
+def detect_features(force: bool = False) -> Features:
+    """One-shot probe, cached like tool.DetectFeatures (feature.go:116)."""
+    global _detected
+    with _lock:
+        if _detected is not None and not force:
+            return _detected
+        feats = {Feature.TAR_RAFS, Feature.CDC_CHUNKING}
+        try:
+            import jax
+
+            jax.devices()
+            feats.add(Feature.DEVICE_DIGEST)
+        except Exception:  # no usable device backend: host digests only
+            pass
+        try:
+            from cryptography.hazmat.primitives.ciphers.aead import AESGCM  # noqa: F401
+
+            feats.add(Feature.ENCRYPT)
+        except ImportError:
+            pass
+        # batch (chunk-merging) packing is not implemented yet — mirrors a
+        # builder without --batch-size support
+        _detected = Features(feats)
+        return _detected

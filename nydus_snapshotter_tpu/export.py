@@ -1,0 +1,8 @@
+"""Re-export shim for embedding the snapshotter in other programs
+(reference export/snapshotter/snapshotter.go)."""
+
+from nydus_snapshotter_tpu.cmd.snapshotter import build_stack
+from nydus_snapshotter_tpu.config.config import SnapshotterConfig, load_config
+from nydus_snapshotter_tpu.snapshot.snapshotter import Snapshotter
+
+__all__ = ["SnapshotterConfig", "Snapshotter", "build_stack", "load_config"]

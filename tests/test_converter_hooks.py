@@ -1,0 +1,255 @@
+"""converter hooks tests: layer conversion, manifest rewrite, cs proxy,
+feature detection (reference convert_unix.go:822-1219, cs_proxy_unix.go,
+tool/feature.go)."""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import tarfile
+import urllib.request
+
+import pytest
+
+from nydus_snapshotter_tpu import constants as C
+from nydus_snapshotter_tpu.converter import convert
+from nydus_snapshotter_tpu.converter.content import LocalContentStore
+from nydus_snapshotter_tpu.converter.cs_proxy import ContentStoreProxy
+from nydus_snapshotter_tpu.converter.feature import Feature, detect_features
+from nydus_snapshotter_tpu.converter.hooks import (
+    convert_image,
+    is_nydus_blob,
+    is_nydus_bootstrap,
+    is_nydus_image,
+    layer_convert_func,
+    merge_layers,
+)
+from nydus_snapshotter_tpu.converter.types import MergeOption, PackOption
+from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
+from nydus_snapshotter_tpu.remote.registry import Descriptor
+
+
+def make_layer_tar(files: dict[str, bytes]) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:", format=tarfile.GNU_FORMAT) as tf:
+        for name, data in files.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mode = 0o644
+            tf.addfile(info, io.BytesIO(data))
+    return buf.getvalue()
+
+
+def publish_oci_image(cs: LocalContentStore, layer_files: list[dict[str, bytes]]):
+    """Write layers (gzip), config, manifest into the content store."""
+    layers = []
+    diff_ids = []
+    for files in layer_files:
+        tar = make_layer_tar(files)
+        blob = gzip.compress(tar, mtime=0)
+        info = cs.write_blob(blob)
+        layers.append(
+            {
+                "mediaType": "application/vnd.oci.image.layer.v1.tar+gzip",
+                "digest": info.digest,
+                "size": info.size,
+            }
+        )
+        diff_ids.append("sha256:" + hashlib.sha256(tar).hexdigest())
+    config = {
+        "architecture": "amd64",
+        "os": "linux",
+        "rootfs": {"type": "layers", "diff_ids": diff_ids},
+        "history": [{"created_by": f"layer {i}"} for i in range(len(layers))],
+    }
+    cfg_body = json.dumps(config).encode()
+    cfg_info = cs.write_blob(cfg_body)
+    manifest = {
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.oci.image.manifest.v1+json",
+        "config": {
+            "mediaType": "application/vnd.oci.image.config.v1+json",
+            "digest": cfg_info.digest,
+            "size": cfg_info.size,
+        },
+        "layers": layers,
+    }
+    body = json.dumps(manifest).encode()
+    info = cs.write_blob(body)
+    return Descriptor(
+        media_type="application/vnd.oci.image.manifest.v1+json",
+        digest=info.digest,
+        size=info.size,
+    )
+
+
+@pytest.fixture()
+def cs(tmp_path):
+    return LocalContentStore(str(tmp_path / "content"))
+
+
+def _pack_opt():
+    return PackOption(backend="numpy", compressor="none", chunking="fixed")
+
+
+class TestLayerConvert:
+    def test_converts_oci_layer_to_nydus_blob(self, cs):
+        tar = make_layer_tar({"etc/app": b"config"})
+        blob = gzip.compress(tar, mtime=0)
+        info = cs.write_blob(blob)
+        desc = Descriptor(
+            media_type="application/vnd.oci.image.layer.v1.tar+gzip",
+            digest=info.digest,
+            size=info.size,
+        )
+        new_desc = layer_convert_func(_pack_opt())(cs, desc)
+        assert new_desc is not None
+        assert new_desc.media_type == C.MEDIA_TYPE_NYDUS_BLOB
+        assert is_nydus_blob(new_desc)
+        assert cs.exists(new_desc.digest)
+        # conversion cache label left on the source
+        assert cs.info(desc.digest).labels[C.LAYER_ANNOTATION_NYDUS_TARGET_DIGEST] == new_desc.digest
+
+    def test_conversion_cache_is_a_noop(self, cs):
+        tar = make_layer_tar({"f": b"x"})
+        blob = gzip.compress(tar, mtime=0)
+        info = cs.write_blob(blob)
+        desc = Descriptor("application/vnd.oci.image.layer.v1.tar+gzip", info.digest, info.size)
+        fn = layer_convert_func(_pack_opt())
+        first = fn(cs, desc)
+        count_before = len(list(cs.walk()))
+        second = fn(cs, desc)
+        assert second.digest == first.digest
+        assert len(list(cs.walk())) == count_before  # nothing new written
+
+    def test_skips_non_layer_and_nydus_types(self, cs):
+        fn = layer_convert_func(_pack_opt())
+        assert fn(cs, Descriptor("application/weird", "sha256:" + "0" * 64, 1)) is None
+        nydus = Descriptor(
+            C.MEDIA_TYPE_NYDUS_BLOB, "sha256:" + "0" * 64, 1,
+            annotations={C.LAYER_ANNOTATION_NYDUS_BLOB: "true"},
+        )
+        assert fn(cs, nydus) is None
+
+
+class TestConvertImage:
+    def test_full_image_conversion(self, cs):
+        manifest_desc = publish_oci_image(
+            cs,
+            [{"bin/sh": b"#!/bin/sh", "etc/one": b"1"}, {"etc/two": b"2"}],
+        )
+        new_desc = convert_image(
+            cs, manifest_desc, _pack_opt(), MergeOption(oci=True)
+        )
+        manifest = json.loads(cs.read(new_desc.digest))
+        assert is_nydus_image(manifest)
+        layers = [Descriptor.from_json(o) for o in manifest["layers"]]
+        assert all(is_nydus_blob(d) for d in layers[:-1])
+        boot_desc = layers[-1]
+        assert is_nydus_bootstrap(boot_desc)
+        assert boot_desc.annotations[C.LAYER_ANNOTATION_FS_VERSION] == "6"
+        # bootstrap layer is a gzip'd nydus-tar stream carrying the bootstrap
+        # (convert_manifest forces with_tar, convert_unix.go:956)
+        boot_gz = cs.read(boot_desc.digest)
+        bs = convert.bootstrap_from_bootstrap_layer(gzip.decompress(boot_gz))
+        paths = {i.path for i in bs.inodes}
+        assert {"/bin/sh", "/etc/one", "/etc/two"} <= paths
+        # config diffIDs rewritten: one per layer incl. bootstrap
+        config = json.loads(cs.read(manifest["config"]["digest"]))
+        assert len(config["rootfs"]["diff_ids"]) == len(manifest["layers"])
+        assert config["history"][-1]["comment"] == "Nydus Bootstrap Layer"
+        # GC labels on the manifest
+        labels = cs.info(new_desc.digest).labels
+        assert any(k.startswith("containerd.io/gc.ref.content.l.") for k in labels)
+
+    def test_already_nydus_image_untouched(self, cs):
+        manifest_desc = publish_oci_image(cs, [{"a": b"1"}])
+        once = convert_image(cs, manifest_desc, _pack_opt(), MergeOption(oci=True))
+        twice = convert_image(cs, once, _pack_opt(), MergeOption(oci=True))
+        assert twice.digest == once.digest
+
+
+class TestMergeLayers:
+    def test_bootstrap_and_blob_descs(self, cs):
+        opt = _pack_opt()
+        descs = []
+        for files in ({"x": b"x" * 100}, {"y": b"y" * 100}):
+            tar = make_layer_tar(files)
+            stream, result = convert.pack_layer(tar, opt)
+            info = cs.write_blob(stream)
+            descs.append(
+                Descriptor(
+                    C.MEDIA_TYPE_NYDUS_BLOB, info.digest, info.size,
+                    annotations={C.LAYER_ANNOTATION_NYDUS_BLOB: "true"},
+                )
+            )
+        boot_desc, blob_descs = merge_layers(cs, descs, MergeOption(with_tar=False, oci=True))
+        assert boot_desc.media_type == "application/vnd.oci.image.layer.v1.tar+gzip"
+        assert is_nydus_bootstrap(boot_desc)
+        assert len(blob_descs) == 2
+        assert all(d.media_type == C.MEDIA_TYPE_NYDUS_BLOB for d in blob_descs)
+
+
+class TestContentStoreProxy:
+    def test_serves_blob_ranges(self, cs):
+        info = cs.write_blob(b"0123456789abcdef")
+        proxy = ContentStoreProxy(cs)
+        proxy.start()
+        try:
+            url = proxy.blob_url(info.digest, offset=4, size=6)
+            with urllib.request.urlopen(url) as r:
+                assert r.read() == b"456789"
+            with urllib.request.urlopen(proxy.blob_url(info.digest)) as r:
+                assert r.read() == b"0123456789abcdef"
+        finally:
+            proxy.stop()
+
+    def test_unknown_blob_404(self, cs):
+        proxy = ContentStoreProxy(cs)
+        proxy.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(proxy.blob_url("sha256:" + "9" * 64))
+        finally:
+            proxy.stop()
+
+
+class TestFeatures:
+    def test_detect_features_cached(self):
+        f1 = detect_features(force=True)
+        f2 = detect_features()
+        assert f1 is f2
+        assert f1.contains(Feature.TAR_RAFS)
+        assert f1.contains(Feature.CDC_CHUNKING)
+        assert f1.contains(Feature.ENCRYPT)  # cryptography is available
+        assert not f1.contains(Feature.BATCH_SIZE)
+
+
+class TestSmallUtils:
+    def test_reflink_auto_falls_back_to_copy(self, tmp_path):
+        from nydus_snapshotter_tpu.utils.reflink import auto
+
+        src = tmp_path / "src"
+        src.write_bytes(b"payload")
+        dst = tmp_path / "dst"
+        auto(str(src), str(dst))
+        assert dst.read_bytes() == b"payload"
+
+    def test_sysinfo(self):
+        from nydus_snapshotter_tpu.utils import sysinfo
+
+        assert sysinfo.get_memory_bytes() > 0
+        assert sysinfo.kernel_at_least(3, 0)
+        assert not sysinfo.kernel_at_least(99, 0)
+
+    def test_version(self):
+        from nydus_snapshotter_tpu import version
+
+        assert version.VERSION in version.pretty()
+
+    def test_export_shim(self):
+        from nydus_snapshotter_tpu import export
+
+        assert callable(export.build_stack)
