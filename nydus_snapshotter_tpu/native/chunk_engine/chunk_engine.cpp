@@ -1,0 +1,90 @@
+// chunk_engine: sequential FastCDC gear chunker, bit-identical to the
+// framework's Python/JAX chunking semantics (ops/cdc.py
+// chunk_sequential_reference / resolve_cuts).
+//
+// This is the host arm of the hybrid conversion engine: content-defined
+// boundaries are latency-bound and branchy — a poor fit for wide vector
+// hardware at small batch — so the native path handles streams below the
+// device crossover while the TPU two-phase kernel handles bulk batches.
+// Called via ctypes (which drops the GIL), so Python threads chunk many
+// layer streams in parallel.
+
+#include <cstdint>
+
+extern "C" {
+
+// Returns the number of cut offsets written to cuts_out (exclusive chunk
+// ends, final == n). cuts_cap is the capacity of cuts_out; on overflow the
+// function returns -1. table is the caller's 256-entry gear table.
+int64_t ntpu_cdc_chunk(const uint8_t *data, int64_t n,
+                       const uint32_t *table,
+                       uint32_t mask_small, uint32_t mask_large,
+                       int64_t min_size, int64_t normal_size,
+                       int64_t max_size,
+                       int64_t *cuts_out, int64_t cuts_cap) {
+  int64_t n_cuts = 0;
+  int64_t start = 0;
+  while (n - start > min_size) {
+    uint32_t h = 0;
+    int64_t end = -1;
+    const int64_t scan_end = (start + max_size < n) ? start + max_size : n;
+    // a length of exactly normal_size is judged with the LARGE mask
+    // (cdc.py resolve_cuts: small range is [min-1, normal-1))
+    const int64_t normal_end =
+        (start + normal_size - 1 < scan_end) ? start + normal_size - 1 : scan_end;
+    // Judgement starts at judge_from; a 32-bit gear hash only retains the
+    // last 32 bytes (one bit of history per shift), so hashing can begin
+    // 32 bytes before it — the bytes in [start, judge_from-31) can never
+    // influence a judged value. Skipping them is bit-exact and saves
+    // min_size-32 table ops per chunk.
+    const int64_t judge_from = start + min_size - 1;
+    int64_t i = judge_from - 31;
+    if (i < start) i = start;
+    for (; i < judge_from && i < scan_end; ++i) {
+      h = (h << 1) + table[data[i]];
+    }
+    // small-mask region: [min_size, normal_size)
+    for (; i < normal_end; ++i) {
+      h = (h << 1) + table[data[i]];
+      if ((h & mask_small) == 0) {
+        end = i + 1;
+        break;
+      }
+    }
+    if (end < 0) {
+      // large-mask region: [normal_size, max_size)
+      for (; i < scan_end; ++i) {
+        h = (h << 1) + table[data[i]];
+        if ((h & mask_large) == 0) {
+          end = i + 1;
+          break;
+        }
+      }
+    }
+    if (end < 0) {
+      end = (scan_end == start + max_size) ? start + max_size : n;
+    }
+    if (n_cuts >= cuts_cap) return -1;
+    cuts_out[n_cuts++] = end;
+    start = end;
+  }
+  if (n > start) {
+    if (n_cuts >= cuts_cap) return -1;
+    cuts_out[n_cuts++] = n;
+  }
+  return n_cuts;
+}
+
+// Position-parallel gear hash of every byte position (the same
+// h_i = sum G[x_{i-k}] << k decomposition the TPU kernel uses) — useful
+// for differential testing the device bitmaps from C++.
+void ntpu_gear_hashes(const uint8_t *data, int64_t n,
+                      const uint32_t *table, uint32_t *out) {
+  uint32_t h = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    h = (h << 1) + table[data[i]];
+    out[i] = h;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,103 @@
+"""ctypes bridge to the native chunk engine (native/chunk_engine).
+
+The sequential gear chunker is the host arm of the hybrid conversion
+engine: ctypes calls release the GIL, so a thread pool chunks many layer
+streams concurrently while the TPU handles digest batches and dict probes.
+Cut points are bit-identical to ops/cdc.py's resolution (differential-
+tested in tests/test_chunk_engine.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from nydus_snapshotter_tpu.ops import cdc, gear
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_missing = False
+
+
+def _lib_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(__file__)), "native", "bin", "libchunk_engine.so"
+    )
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, or None when not built (make -C native)."""
+    global _lib, _lib_missing
+    with _lib_lock:
+        if _lib is not None or _lib_missing:
+            return _lib
+        path = _lib_path()
+        if not os.path.exists(path):
+            _lib_missing = True
+            return None
+        lib = ctypes.CDLL(path)
+        lib.ntpu_cdc_chunk.restype = ctypes.c_int64
+        lib.ntpu_cdc_chunk.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,  # data, n
+            ctypes.c_void_p,                  # table
+            ctypes.c_uint32, ctypes.c_uint32,  # masks
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # min/normal/max
+            ctypes.c_void_p, ctypes.c_int64,  # cuts_out, cap
+        ]
+        lib.ntpu_gear_hashes.restype = None
+        lib.ntpu_gear_hashes.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def chunk_data_native(data: bytes | np.ndarray, params: cdc.CDCParams) -> np.ndarray:
+    """Cut offsets via the native chunker (drop-in for cdc.chunk_data_np)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("libchunk_engine.so not built (make -C nydus_snapshotter_tpu/native)")
+    arr = (
+        np.frombuffer(data, dtype=np.uint8)
+        if isinstance(data, (bytes, bytearray))
+        else np.ascontiguousarray(data, dtype=np.uint8)
+    )
+    if arr.size == 0:
+        return np.asarray([], dtype=np.int64)
+    table = np.ascontiguousarray(gear.gear_table())
+    cap = arr.size // max(1, params.min_size) + 2
+    cuts = np.empty(cap, dtype=np.int64)
+    n = lib.ntpu_cdc_chunk(
+        arr.ctypes.data, arr.size,
+        table.ctypes.data,
+        np.uint32(params.mask_small), np.uint32(params.mask_large),
+        params.min_size, params.normal_size, params.max_size,
+        cuts.ctypes.data, cap,
+    )
+    if n < 0:
+        raise RuntimeError("native chunker cut buffer overflow")
+    return cuts[:n].copy()
+
+
+def gear_hashes_native(data: bytes | np.ndarray) -> np.ndarray:
+    """Per-position gear hashes (differential-test aid)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("libchunk_engine.so not built")
+    arr = (
+        np.frombuffer(data, dtype=np.uint8)
+        if isinstance(data, (bytes, bytearray))
+        else np.ascontiguousarray(data, dtype=np.uint8)
+    )
+    table = np.ascontiguousarray(gear.gear_table())
+    out = np.empty(arr.size, dtype=np.uint32)
+    lib.ntpu_gear_hashes(arr.ctypes.data, arr.size, table.ctypes.data, out.ctypes.data)
+    return out
