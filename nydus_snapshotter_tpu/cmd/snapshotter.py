@@ -209,6 +209,7 @@ def build_stack(cfg: SnapshotterConfig):
         referrer_mgr=referrer_mgr,
         tarfs_mgr=tarfs_mgr,
         tarfs_export=cfg.experimental.tarfs_export_mode != "",
+        mirrors_config_dir=cfg.remote.mirrors_config_dir,
     )
     for mgr in managers.values():
         mgr.cgroup_mgr = cgroup_mgr

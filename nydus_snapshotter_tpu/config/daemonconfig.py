@@ -163,12 +163,21 @@ class DaemonRuntimeConfig:
         auth: str = "",
         work_dir: str = "",
         prefetch_files: Optional[list[str]] = None,
+        mirrors_config_dir: str = "",
     ) -> None:
         """Per-mount supplementation (reference daemonconfig.go:150-189)."""
         if image_ref:
             host, _, repo = image_ref.partition("/")
             self.backend.host = host
             self.backend.repo = repo.split(":")[0].split("@")[0]
+            if mirrors_config_dir:
+                # per-host mirror dirs à la containerd certs.d
+                # (daemonconfig.go:165-171 + mirrors.go)
+                from nydus_snapshotter_tpu.config.mirrors import load_mirrors_config
+
+                mirrors = load_mirrors_config(mirrors_config_dir, host)
+                if mirrors:
+                    self.backend.mirrors = mirrors
         if auth:
             self.backend.auth = auth
         if work_dir:

@@ -66,6 +66,7 @@ class Filesystem:
         referrer_mgr=None,
         root_mountpoint: str = "",
         tarfs_export: bool = False,
+        mirrors_config_dir: str = "",
     ):
         self.managers = managers
         self.cache_mgr = cache_mgr
@@ -80,6 +81,7 @@ class Filesystem:
         self.referrer_mgr = referrer_mgr
         self.root_mountpoint = root_mountpoint or os.path.join(root, "mnt")
         self._tarfs_export = tarfs_export
+        self.mirrors_config_dir = mirrors_config_dir
         self.instances = RafsCache()
         self.shared_daemons: dict[str, Daemon] = {}  # fs_driver -> shared daemon
         self._lock = threading.RLock()  # shared-daemon create/stop only
@@ -327,6 +329,7 @@ class Filesystem:
                     image_ref=rafs.image_id,
                     auth=snap_labels.get(C.NYDUS_IMAGE_PULL_SECRET, ""),
                     work_dir=rafs.fscache_work_dir(),
+                    mirrors_config_dir=self.mirrors_config_dir,
                 )
                 # Blob caches live in the cache manager's dir, so the daemon
                 # knows where to find them (fs.go:335-338).

@@ -1,0 +1,94 @@
+"""Per-registry mirrors directory tests (reference
+config/daemonconfig/mirrors.go + mirrors_test.go)."""
+
+from __future__ import annotations
+
+import pytest
+
+from nydus_snapshotter_tpu.config.daemonconfig import DaemonRuntimeConfig
+from nydus_snapshotter_tpu.config.mirrors import (
+    host_dir_from_root,
+    host_directory,
+    host_paths,
+    load_mirrors_config,
+    parse_hosts_file,
+)
+from nydus_snapshotter_tpu.utils import errdefs
+
+HOSTS_TOML = b"""
+[host."https://mirror-a.example.com"]
+ping_url = "https://mirror-a.example.com/v2"
+health_check_interval = 10
+failure_limit = 3
+  [host."https://mirror-a.example.com".header]
+  X-Registry = "docker.io"
+  Multi = ["a", "b"]
+
+[host."mirror-b.example.com:5000"]
+"""
+
+
+class TestHostDirs:
+    def test_host_directory_mangling(self):
+        assert host_directory("registry:5000") == "registry_5000_"
+        assert host_directory("docker.io") == "docker.io"
+
+    def test_host_paths_order(self, tmp_path):
+        paths = host_paths(str(tmp_path), "reg:5000")
+        assert [p.rsplit("/", 1)[1] for p in paths] == ["reg_5000_", "reg:5000", "_default"]
+
+    def test_host_dir_from_root_prefers_specific(self, tmp_path):
+        (tmp_path / "docker.io").mkdir()
+        (tmp_path / "_default").mkdir()
+        assert host_dir_from_root(str(tmp_path), "docker.io").endswith("docker.io")
+        assert host_dir_from_root(str(tmp_path), "other.io").endswith("_default")
+        assert host_dir_from_root(str(tmp_path / "none"), "x") == ""
+
+
+class TestHostsFile:
+    def test_parse_ordered_hosts(self):
+        mirrors = parse_hosts_file(HOSTS_TOML)
+        assert [m.host for m in mirrors] == [
+            "https://mirror-a.example.com",
+            "https://mirror-b.example.com:5000",
+        ]
+        a = mirrors[0]
+        assert a.ping_url == "https://mirror-a.example.com/v2"
+        assert a.health_check_interval == 10
+        assert a.failure_limit == 3
+        assert a.headers["X-Registry"] == "docker.io"
+        assert a.headers["Multi"] == "a, b"
+
+    def test_bad_toml_rejected(self):
+        with pytest.raises(errdefs.InvalidArgument):
+            parse_hosts_file(b"not [valid toml")
+
+    def test_missing_host_tree_rejected(self):
+        with pytest.raises(errdefs.InvalidArgument):
+            parse_hosts_file(b"x = 1")
+
+
+class TestLoadMirrors:
+    def test_load_for_registry(self, tmp_path):
+        d = tmp_path / "docker.io"
+        d.mkdir()
+        (d / "hosts.toml").write_bytes(HOSTS_TOML)
+        mirrors = load_mirrors_config(str(tmp_path), "docker.io")
+        assert len(mirrors) == 2
+
+    def test_no_dir_is_empty(self, tmp_path):
+        assert load_mirrors_config(str(tmp_path), "unknown.io") == []
+        assert load_mirrors_config("", "docker.io") == []
+
+    def test_supplement_wires_mirrors(self, tmp_path):
+        d = tmp_path / "ghcr.io"
+        d.mkdir()
+        (d / "hosts.toml").write_bytes(HOSTS_TOML)
+        cfg = DaemonRuntimeConfig.from_dict({}, "fusedev")
+        cfg.supplement(
+            image_ref="ghcr.io/org/app:latest",
+            mirrors_config_dir=str(tmp_path),
+        )
+        assert cfg.backend.host == "ghcr.io"
+        assert len(cfg.backend.mirrors) == 2
+        assert cfg.backend.mirrors[0].host == "https://mirror-a.example.com"
