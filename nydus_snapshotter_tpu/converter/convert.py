@@ -20,23 +20,29 @@ import hashlib
 import io
 import stat
 import tarfile
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import BinaryIO, Callable, Optional
 
 import zstandard
 
 from nydus_snapshotter_tpu import constants
+from nydus_snapshotter_tpu.converter import crypto
 from nydus_snapshotter_tpu.converter.types import ConvertError, MergeOption, PackOption, UnpackOption
 from nydus_snapshotter_tpu.models import fstree, layout, nydus_tar, toc
 from nydus_snapshotter_tpu.models.bootstrap import (
+    CHUNK_FLAG_BATCH,
+    BatchRecord,
     BlobRecord,
     Bootstrap,
     ChunkDict,
     ChunkRecord,
+    CipherRecord,
     Inode,
     parse_chunk_dict_arg,
 )
 from nydus_snapshotter_tpu.ops.chunker import ChunkDigestEngine
+from nydus_snapshotter_tpu.utils import lz4
 
 _ZSTD_LEVEL = 3
 
@@ -61,6 +67,8 @@ def _make_compressor(compressor: str):
     if compressor == "zstd":
         ctx = zstandard.ZstdCompressor(level=_ZSTD_LEVEL)
         return lambda data: (ctx.compress(data), constants.COMPRESSOR_ZSTD)
+    if compressor == "lz4_block":
+        return lambda data: (lz4.compress_block(data), constants.COMPRESSOR_LZ4_BLOCK)
     return lambda data: (data, constants.COMPRESSOR_NONE)
 
 
@@ -68,9 +76,97 @@ def _decompress_chunk(data: bytes, flags: int, expect_size: int) -> bytes:
     comp = flags & constants.COMPRESSOR_MASK
     if comp == constants.COMPRESSOR_ZSTD:
         return zstandard.ZstdDecompressor().decompress(data, max_output_size=max(expect_size, 1))
+    if comp == constants.COMPRESSOR_LZ4_BLOCK:
+        return lz4.decompress_block(data, expect_size)
     if comp in (constants.COMPRESSOR_NONE, 0):
         return data
     raise ConvertError(f"unsupported chunk compressor flags {flags:#x}")
+
+
+class BlobReader:
+    """Random-access chunk reads from one blob's data section.
+
+    Centralizes the three storage transforms a chunk record can carry —
+    per-chunk compression, batch packing (CHUNK_FLAG_BATCH: several small
+    chunks share one compressed extent), and blob encryption (seekable
+    AES-CTR, converter/crypto.py) — so Unpack and the lazy-read daemon
+    resolve chunks through identical logic.
+
+    ``read_at(offset, size)`` returns raw (still-encrypted) blob bytes.
+    """
+
+    # Decompressed batches kept hot per reader — bounded so a long-lived
+    # daemon doesn't pin every batch it ever read.
+    BATCH_CACHE_BYTES = 32 << 20
+
+    def __init__(
+        self,
+        bootstrap: Bootstrap,
+        blob_index: int,
+        read_at: Callable[[int, int], bytes],
+        batch_map: Optional[dict[tuple[int, int], tuple[int, int]]] = None,
+    ):
+        self.bootstrap = bootstrap
+        self.blob_index = blob_index
+        self.read_at = read_at
+        self.cipher = bootstrap.cipher_for(blob_index)
+        if self.cipher is not None and self.cipher.algo != crypto.CIPHER_AES_256_CTR:
+            raise ConvertError(f"unsupported blob cipher algo {self.cipher.algo}")
+        # (blob_index, compressed_offset) -> (uncompressed_base, size), from
+        # the bootstrap's batch table. Callers constructing several readers
+        # can share one batch_map to avoid rebuilding it per blob.
+        self._batch_map = bootstrap.batch_map() if batch_map is None else batch_map
+        self._batch_cache: "OrderedDict[int, bytes]" = OrderedDict()
+        self._batch_cache_bytes = 0
+
+    def _read_plain(self, offset: int, size: int) -> bytes:
+        raw = self.read_at(offset, size)
+        if len(raw) != size:
+            raise ConvertError(
+                f"blob {self.blob_index}: short read at {offset} "
+                f"({len(raw)} of {size} bytes)"
+            )
+        if self.cipher is not None:
+            raw = crypto.decrypt_range(raw, offset, self.cipher.key, self.cipher.iv)
+        return raw
+
+    def chunk_data(self, rec: ChunkRecord) -> bytes:
+        """The uncompressed data of one chunk record."""
+        if rec.blob_index != self.blob_index:
+            raise ConvertError("chunk record belongs to a different blob")
+        if rec.flags & CHUNK_FLAG_BATCH:
+            extent = self._batch_map.get((self.blob_index, rec.compressed_offset))
+            if extent is None:
+                raise ConvertError(
+                    f"batched chunk at blob {self.blob_index} offset "
+                    f"{rec.compressed_offset} has no batch-table entry"
+                )
+            base, usize = extent
+            batch = self._batch_cache.get(rec.compressed_offset)
+            if batch is None:
+                raw = self._read_plain(rec.compressed_offset, rec.compressed_size)
+                batch = _decompress_chunk(raw, rec.flags, usize)
+                self._batch_cache[rec.compressed_offset] = batch
+                self._batch_cache_bytes += len(batch)
+                while self._batch_cache_bytes > self.BATCH_CACHE_BYTES and len(self._batch_cache) > 1:
+                    _, evicted = self._batch_cache.popitem(last=False)
+                    self._batch_cache_bytes -= len(evicted)
+            else:
+                self._batch_cache.move_to_end(rec.compressed_offset)
+            inner = rec.uncompressed_offset - base
+            if inner < 0 or inner + rec.uncompressed_size > len(batch):
+                raise ConvertError("batch chunk slice overflows its batch")
+            return batch[inner : inner + rec.uncompressed_size]
+        raw = self._read_plain(rec.compressed_offset, rec.compressed_size)
+        return _decompress_chunk(raw, rec.flags, rec.uncompressed_size)
+
+
+def make_bytes_reader(
+    bootstrap: Bootstrap, blob_index: int, blob: bytes, batch_map=None
+) -> BlobReader:
+    return BlobReader(
+        bootstrap, blob_index, lambda off, size: blob[off : off + size], batch_map=batch_map
+    )
 
 
 def _make_engine(opt: PackOption) -> ChunkDigestEngine:
@@ -92,10 +188,6 @@ def Pack(dest: BinaryIO, src_tar: BinaryIO | bytes, opt: PackOption) -> PackResu
     only referenced.
     """
     opt.validate()
-    if opt.batch_size:
-        raise ConvertError("batch chunk packing is not supported yet")
-    if opt.encrypt:
-        raise ConvertError("blob encryption is not supported yet")
 
     entries = fstree.ensure_parents(fstree.tree_from_tar(src_tar))
     chunk_dict = (
@@ -132,31 +224,78 @@ def Pack(dest: BinaryIO, src_tar: BinaryIO | bytes, opt: PackOption) -> PackResu
                 own_chunks[m.digest] = len(unique_data)
                 unique_data.append(e.data[m.offset : m.offset + m.size])
 
-    # Compress unique chunks, lay out the blob data section.
+    # Compress unique chunks, lay out the blob data section. Chunks smaller
+    # than ``batch_size`` are packed into shared batch extents compressed as
+    # one unit (reference --batch-size, tool/builder.go:131-134); a batch
+    # only spans a *run* of consecutive small chunks so its members stay
+    # contiguous in the blob's uncompressed address space (which is what
+    # lets BlobReader slice the decompressed batch by uncompressed offsets).
     align = 4096 if (opt.aligned_chunk and opt.fs_version == layout.RAFS_V5) else 1
     compress = _make_compressor(opt.compressor)
     blob_parts: list[bytes] = []
-    comp_extents: list[tuple[int, int, int]] = []  # (offset, csize, flags)
+    comp_extents: list[Optional[tuple[int, int, int]]] = [None] * len(unique_data)
     uncomp_offsets: list[int] = []
-    coff = 0
     uoff = 0
     for data in unique_data:
-        comp, cflag = compress(data)
+        uncomp_offsets.append(uoff)
+        uoff += len(data)
+    coff = 0
+
+    def _emit(comp: bytes) -> int:
+        nonlocal coff
         pad = (-coff) % align
         if pad:
             blob_parts.append(b"\x00" * pad)
             coff += pad
+        start = coff
         blob_parts.append(comp)
-        comp_extents.append((coff, len(comp), cflag))
-        uncomp_offsets.append(uoff)
         coff += len(comp)
-        uoff += len(data)
+        return start
+
+    pending: list[int] = []  # unique-chunk indices of the open batch
+    pending_bytes = 0
+    own_batches: list[tuple[int, int, int]] = []  # (coff, uncomp_base, usize)
+
+    def _flush_batch() -> None:
+        nonlocal pending, pending_bytes
+        if not pending:
+            return
+        comp, cflag = compress(b"".join(unique_data[i] for i in pending))
+        start = _emit(comp)
+        for i in pending:
+            comp_extents[i] = (start, len(comp), cflag | CHUNK_FLAG_BATCH)
+        own_batches.append((start, uncomp_offsets[pending[0]], pending_bytes))
+        pending = []
+        pending_bytes = 0
+
+    for i, data in enumerate(unique_data):
+        if opt.batch_size and len(data) < opt.batch_size:
+            if pending_bytes + len(data) > opt.batch_size:
+                _flush_batch()
+            pending.append(i)
+            pending_bytes += len(data)
+        else:
+            _flush_batch()
+            comp, cflag = compress(data)
+            comp_extents[i] = (_emit(comp), len(comp), cflag)
+    _flush_batch()
+
     blob_data = b"".join(blob_parts)
+    blob_cipher: Optional[CipherRecord] = None
+    if opt.encrypt and blob_data:
+        key, iv = crypto.generate_context()
+        blob_data = crypto.encrypt(blob_data, key, iv)
+        blob_cipher = CipherRecord(algo=crypto.CIPHER_AES_256_CTR, key=key, iv=iv)
     blob_sha = hashlib.sha256(blob_data) if blob_data else None
     blob_id = blob_sha.hexdigest() if blob_sha else ""
 
     # Blob table: own blob first (if it stores anything), then dict blobs.
+    # Cipher and batch tables follow the blob table: dict blobs carry their
+    # cipher context and batch extents over from the dict bootstrap, so
+    # partial references into a foreign batch stay resolvable.
     blob_table: list[BlobRecord] = []
+    cipher_table: list[CipherRecord] = []
+    batch_table: list[BatchRecord] = []
     blob_index_of: dict[str, int] = {}
     if blob_data:
         blob_index_of[blob_id] = 0
@@ -168,9 +307,15 @@ def Pack(dest: BinaryIO, src_tar: BinaryIO | bytes, opt: PackOption) -> PackResu
                 chunk_count=len(unique_data),
             )
         )
+        cipher_table.append(blob_cipher or CipherRecord())
+        for coff_b, base, usize in own_batches:
+            batch_table.append(BatchRecord(0, coff_b, base, usize))
     for bid in dict_blobs_used:
-        blob_index_of[bid] = len(blob_table)
-        dict_rec = next(b for b in chunk_dict.bootstrap.blobs if b.blob_id == bid)
+        new_idx = len(blob_table)
+        blob_index_of[bid] = new_idx
+        dict_idx, dict_rec = next(
+            (i, b) for i, b in enumerate(chunk_dict.bootstrap.blobs) if b.blob_id == bid
+        )
         blob_table.append(
             BlobRecord(
                 blob_id=bid,
@@ -180,6 +325,12 @@ def Pack(dest: BinaryIO, src_tar: BinaryIO | bytes, opt: PackOption) -> PackResu
                 flags=dict_rec.flags,
             )
         )
+        cipher_table.append(chunk_dict.bootstrap.cipher_for(dict_idx) or CipherRecord())
+        for b in chunk_dict.bootstrap.batches:
+            if b.blob_index == dict_idx:
+                batch_table.append(
+                    BatchRecord(new_idx, b.compressed_offset, b.uncompressed_base, b.uncompressed_size)
+                )
 
     # Second pass: emit inodes + chunk records.
     file_meta = {id(e): m for e, m in zip(files, metas_per_file)}
@@ -222,6 +373,8 @@ def Pack(dest: BinaryIO, src_tar: BinaryIO | bytes, opt: PackOption) -> PackResu
         inodes=inodes,
         chunks=chunk_records,
         blobs=blob_table,
+        ciphers=cipher_table if any(c.algo for c in cipher_table) else [],
+        batches=batch_table,
     )
     boot_bytes = bootstrap.to_bytes()
 
@@ -387,12 +540,22 @@ def Merge(
     chunk_records: list[ChunkRecord] = []
     blob_index_of: dict[str, int] = {}
     blob_records: dict[str, BlobRecord] = {}
-    for b in boots:
-        for rec in b.blobs:
+    blob_ciphers: dict[str, CipherRecord] = {}
+    blob_batches: dict[tuple[str, int], tuple[int, int]] = {}
+    source_boots = boots + ([chunk_dict.bootstrap] if chunk_dict is not None else [])
+    for b in source_boots:
+        for i, rec in enumerate(b.blobs):
             blob_records.setdefault(rec.blob_id, rec)
-    if chunk_dict is not None:
-        for rec in chunk_dict.bootstrap.blobs:
-            blob_records.setdefault(rec.blob_id, rec)
+            cipher = b.cipher_for(i)
+            if cipher is not None:
+                blob_ciphers.setdefault(rec.blob_id, cipher)
+        ids = [r.blob_id for r in b.blobs]
+        for br in b.batches:
+            if br.blob_index < len(ids):
+                blob_batches.setdefault(
+                    (ids[br.blob_index], br.compressed_offset),
+                    (br.uncompressed_base, br.uncompressed_size),
+                )
 
     def blob_index(bid: str) -> int:
         if bid not in blob_index_of:
@@ -424,11 +587,21 @@ def Merge(
         inodes.append(inode)
 
     blob_table = []
+    cipher_table = []
     for bid, _idx in sorted(blob_index_of.items(), key=lambda kv: kv[1]):
         base = blob_records.get(bid)
         if base is None:
             raise ConvertError(f"chunk references unknown blob {bid}")
         blob_table.append(base)
+        cipher_table.append(blob_ciphers.get(bid) or CipherRecord())
+    batch_table = sorted(
+        (
+            BatchRecord(blob_index_of[bid], coff, u_base, usize)
+            for (bid, coff), (u_base, usize) in blob_batches.items()
+            if bid in blob_index_of
+        ),
+        key=lambda b: (b.blob_index, b.compressed_offset),
+    )
 
     bootstrap = Bootstrap(
         version=version,
@@ -436,6 +609,8 @@ def Merge(
         inodes=inodes,
         chunks=chunk_records,
         blobs=blob_table,
+        ciphers=cipher_table if any(c.algo for c in cipher_table) else [],
+        batches=batch_table,
     )
     boot_bytes = bootstrap.to_bytes()
     if opt.with_tar:
@@ -473,12 +648,14 @@ def Unpack(
     """
     bs = bootstrap if isinstance(bootstrap, Bootstrap) else Bootstrap.from_bytes(bootstrap)
     provider = blob_provider.__getitem__ if isinstance(blob_provider, dict) else blob_provider
-    blob_cache: dict[str, bytes] = {}
+    readers: dict[int, BlobReader] = {}
+    batch_map = bs.batch_map()
 
-    def blob_bytes(bid: str) -> bytes:
-        if bid not in blob_cache:
-            blob_cache[bid] = provider(bid)
-        return blob_cache[bid]
+    def reader_for(blob_index: int) -> BlobReader:
+        if blob_index not in readers:
+            blob = provider(bs.blobs[blob_index].blob_id)
+            readers[blob_index] = make_bytes_reader(bs, blob_index, blob, batch_map)
+        return readers[blob_index]
 
     entries: list[fstree.FileEntry] = []
     for inode in bs.inodes:
@@ -486,9 +663,7 @@ def Unpack(
         if stat.S_ISREG(inode.mode) and inode.chunk_count and not inode.hardlink_target:
             parts = []
             for rec in bs.chunks[inode.chunk_index : inode.chunk_index + inode.chunk_count]:
-                blob = blob_bytes(bs.blobs[rec.blob_index].blob_id)
-                raw = blob[rec.compressed_offset : rec.compressed_offset + rec.compressed_size]
-                parts.append(_decompress_chunk(raw, rec.flags, rec.uncompressed_size))
+                parts.append(reader_for(rec.blob_index).chunk_data(rec))
             data = b"".join(parts)
             if len(data) != inode.size:
                 raise ConvertError(

@@ -26,7 +26,7 @@ class PackOption:
     fs_version: str = layout.RAFS_V6
     chunk_dict_path: str = ""
     prefetch_patterns: str = ""
-    compressor: str = "zstd"  # "none" | "zstd" (lz4_block: no codec in env)
+    compressor: str = "zstd"  # "none" | "zstd" | "lz4_block"
     oci_ref: bool = False
     aligned_chunk: bool = False
     chunk_size: int = constants.CHUNK_SIZE_DEFAULT
@@ -41,12 +41,22 @@ class PackOption:
     def validate(self) -> None:
         if self.fs_version not in (layout.RAFS_V5, layout.RAFS_V6):
             raise ConvertError(f"invalid fs version {self.fs_version!r}")
-        if self.compressor not in ("none", "zstd"):
+        if self.compressor not in ("none", "zstd", "lz4_block"):
             raise ConvertError(f"unsupported compressor {self.compressor!r}")
         cs = self.chunk_size
         if cs & (cs - 1) or not (constants.CHUNK_SIZE_MIN <= cs <= constants.CHUNK_SIZE_MAX):
             raise ConvertError(
                 f"chunk size must be power of two in "
+                f"[{constants.CHUNK_SIZE_MIN:#x}, {constants.CHUNK_SIZE_MAX:#x}]"
+            )
+        bs = self.batch_size
+        # Reference bound (types.go:78-79): power of two in 0x1000-0x1000000
+        # or zero (disabled).
+        if bs and (
+            bs & (bs - 1) or not (constants.CHUNK_SIZE_MIN <= bs <= constants.CHUNK_SIZE_MAX)
+        ):
+            raise ConvertError(
+                f"batch size must be zero or a power of two in "
                 f"[{constants.CHUNK_SIZE_MIN:#x}, {constants.CHUNK_SIZE_MAX:#x}]"
             )
 

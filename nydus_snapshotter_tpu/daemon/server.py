@@ -30,7 +30,7 @@ from http.server import BaseHTTPRequestHandler
 from typing import Any, Optional
 
 from nydus_snapshotter_tpu import constants
-from nydus_snapshotter_tpu.converter.convert import _decompress_chunk
+from nydus_snapshotter_tpu.converter.convert import BlobReader
 from nydus_snapshotter_tpu.daemon.types import DaemonState, FsMetrics
 from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
 
@@ -48,6 +48,48 @@ class _Instance:
             self.bootstrap = Bootstrap.from_bytes(f.read())
         self.by_path = self.bootstrap.inode_by_path()
         self.metrics = FsMetrics()
+        # Per-blob readers with open fds — the per-chunk open() of the naive
+        # path made every read O(chunks) syscalls.
+        self._batch_map = self.bootstrap.batch_map()
+        self._readers: dict[int, BlobReader] = {}
+        self._files: dict[int, Any] = {}
+        self._io_lock = threading.Lock()
+        self._closed = False
+
+    def close(self) -> None:
+        with self._io_lock:
+            self._closed = True
+            for f in self._files.values():
+                try:
+                    f.close()
+                except OSError:
+                    pass
+            self._files.clear()
+            self._readers.clear()
+
+    def _reader(self, blob_index: int, blob_dir: str) -> BlobReader:
+        with self._io_lock:
+            if self._closed:
+                # A read racing a legitimate unmount: fail instead of
+                # leaking a fresh fd into the discarded instance.
+                raise FileNotFoundError(self.mountpoint)
+            reader = self._readers.get(blob_index)
+            if reader is None:
+                blob_id = self.bootstrap.blobs[blob_index].blob_id
+                f = open(os.path.join(blob_dir, blob_id), "rb")
+                self._files[blob_index] = f
+                lock = self._io_lock
+
+                def read_at(off: int, size: int, _f=f, _lock=lock) -> bytes:
+                    with _lock:
+                        _f.seek(off)
+                        return _f.read(size)
+
+                reader = BlobReader(
+                    self.bootstrap, blob_index, read_at, batch_map=self._batch_map
+                )
+                self._readers[blob_index] = reader
+        return reader
 
     def blob_dir(self, default_dir: str) -> str:
         try:
@@ -77,12 +119,7 @@ class _Instance:
                 continue
             if pos >= end:
                 break
-            blob_id = self.bootstrap.blobs[rec.blob_index].blob_id
-            blob_path = os.path.join(blob_dir, blob_id)
-            with open(blob_path, "rb") as f:
-                f.seek(rec.compressed_offset)
-                raw = f.read(rec.compressed_size)
-            data = _decompress_chunk(raw, rec.flags, clen)
+            data = self._reader(rec.blob_index, blob_dir).chunk_data(rec)
             lo = max(0, offset - pos)
             hi = min(clen, end - pos)
             out += data[lo:hi]
@@ -382,7 +419,8 @@ class DaemonServer:
 
     def umount(self, mountpoint: str) -> None:
         with self._lock:
-            del self.instances[mountpoint]
+            inst = self.instances.pop(mountpoint)
+        inst.close()
         self._push_state_async()
 
     # -- fscache v2 blobs (reference nydusd /api/v2/blobs) -------------------
@@ -457,7 +495,14 @@ def main(argv=None) -> int:
         workdir=args.workdir,
         upgrade=args.upgrade,
     )
-    signal.signal(signal.SIGTERM, lambda *_: server.shutdown())
+    # shutdown() must not run on the main (serve_forever) thread: the signal
+    # handler interrupts serve_forever's select, and BaseServer.shutdown()
+    # then waits for a loop exit that can never happen — deadlock, daemon
+    # survives SIGTERM. Hand it to a helper thread instead.
+    signal.signal(
+        signal.SIGTERM,
+        lambda *_: threading.Thread(target=server.shutdown, daemon=True).start(),
+    )
     try:
         server.serve_forever()
     finally:

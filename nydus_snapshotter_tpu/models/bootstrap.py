@@ -48,6 +48,20 @@ _SB_STRUCT = struct.Struct("<IIIIIIQQQQQQQQ")
 _SB_SIZE = 128
 assert _SB_STRUCT.size <= _SB_SIZE
 
+# Optional table pointers live in the superblock's spare region (directly
+# after _SB_STRUCT): cipher_table_off u64 | cipher_count u64 |
+# batch_table_off u64 | batch_count u64. Each pair is meaningful only when
+# its feature bit is set; zero in older bootstraps.
+_SB_CIPHER_STRUCT = struct.Struct("<QQ")
+_SB_CIPHER_OFF = _SB_STRUCT.size
+_SB_BATCH_STRUCT = struct.Struct("<QQ")
+_SB_BATCH_OFF = _SB_CIPHER_OFF + _SB_CIPHER_STRUCT.size
+assert _SB_BATCH_OFF + _SB_BATCH_STRUCT.size <= _SB_SIZE
+
+# Feature bits (superblock ``features`` field).
+FEATURE_CIPHER_TABLE = 0x1
+FEATURE_BATCH_TABLE = 0x2
+
 _V5_HEADER_SIZE = 8 * 1024  # reference: v5 = 8K superblock region
 _V6_HEADER_SIZE = layout.RAFS_V6_SUPER_BLOCK_SIZE  # 1024 + 128 + 256
 
@@ -79,6 +93,24 @@ SUPER_VERSION_V6 = 0x600
 # Chunk flags: low nibble carries the compressor bits (constants.COMPRESSOR_*).
 CHUNK_FLAG_COMPRESSED_ZSTD = constants.COMPRESSOR_ZSTD
 CHUNK_FLAG_FROM_DICT = 0x100
+# Batched chunk (reference ``--batch-size``, tool/builder.go:131-134): several
+# small chunks compressed as one unit. ``compressed_offset/size`` describe the
+# shared batch extent in the blob; the batch's uncompressed base and size live
+# in the bootstrap's batch table keyed by (blob_index, compressed_offset), so
+# a bootstrap referencing only *some* members of a foreign (chunk-dict) batch
+# still resolves them correctly.
+CHUNK_FLAG_BATCH = 0x200
+
+# Cipher record: algo u32 | reserved u32 | key 32s | iv 16s | pad to 64.
+_CIPHER_STRUCT = struct.Struct("<II32s16s")
+CIPHER_SIZE_BYTES = 64
+assert _CIPHER_STRUCT.size <= CIPHER_SIZE_BYTES
+
+# Batch record: blob_index u32 | reserved u32 | compressed_offset u64 |
+# uncompressed_base u64 | uncompressed_size u64 = 32 bytes.
+_BATCH_STRUCT = struct.Struct("<IIQQQ")
+BATCH_SIZE_BYTES = 32
+assert _BATCH_STRUCT.size == BATCH_SIZE_BYTES
 
 
 class BootstrapError(ValueError):
@@ -139,6 +171,57 @@ class BlobRecord:
     def unpack(cls, buf: bytes) -> "BlobRecord":
         raw, csize, usize, count, flags = _BLOB_STRUCT.unpack(buf[: _BLOB_STRUCT.size])
         return cls(raw.hex(), csize, usize, count, flags)
+
+
+@dataclass
+class CipherRecord:
+    """Per-blob cipher context (reference ``--encrypt``: blob data is
+    encrypted with the context stored in image metadata, key protection
+    coming from ocicrypt-encrypting the bootstrap layer itself,
+    pkg/encryption/encryption.go:143-253)."""
+
+    algo: int = 0  # converter/crypto.CIPHER_* (0 = blob not encrypted)
+    key: bytes = b""
+    iv: bytes = b""
+
+    def pack(self) -> bytes:
+        if self.algo and (len(self.key) != 32 or len(self.iv) != 16):
+            raise BootstrapError("cipher context needs a 32-byte key and 16-byte iv")
+        return _CIPHER_STRUCT.pack(
+            self.algo, 0, self.key.ljust(32, b"\x00"), self.iv.ljust(16, b"\x00")
+        ).ljust(CIPHER_SIZE_BYTES, b"\x00")
+
+    @classmethod
+    def unpack(cls, buf: bytes) -> "CipherRecord":
+        algo, _reserved, key, iv = _CIPHER_STRUCT.unpack(buf[: _CIPHER_STRUCT.size])
+        if not algo:
+            return cls()
+        return cls(algo=algo, key=key, iv=iv)
+
+
+@dataclass
+class BatchRecord:
+    """One batch extent: which blob it lives in, where its compressed bytes
+    are, and the uncompressed address range its members cover."""
+
+    blob_index: int
+    compressed_offset: int
+    uncompressed_base: int
+    uncompressed_size: int
+
+    def pack(self) -> bytes:
+        return _BATCH_STRUCT.pack(
+            self.blob_index,
+            0,
+            self.compressed_offset,
+            self.uncompressed_base,
+            self.uncompressed_size,
+        )
+
+    @classmethod
+    def unpack(cls, buf: bytes) -> "BatchRecord":
+        bi, _reserved, coff, base, usize = _BATCH_STRUCT.unpack(buf[: _BATCH_STRUCT.size])
+        return cls(bi, coff, base, usize)
 
 
 # Inode flags
@@ -203,6 +286,24 @@ class Bootstrap:
     inodes: list[Inode] = field(default_factory=list)
     chunks: list[ChunkRecord] = field(default_factory=list)
     blobs: list[BlobRecord] = field(default_factory=list)
+    # Parallel to ``blobs`` when any blob is encrypted (algo 0 entries for
+    # plaintext blobs); empty when no encryption is in play.
+    ciphers: list[CipherRecord] = field(default_factory=list)
+    # Batch extents for CHUNK_FLAG_BATCH chunks; empty without batching.
+    batches: list[BatchRecord] = field(default_factory=list)
+
+    def cipher_for(self, blob_index: int) -> Optional[CipherRecord]:
+        """The cipher context of blob ``blob_index`` (None = plaintext)."""
+        if blob_index < len(self.ciphers) and self.ciphers[blob_index].algo:
+            return self.ciphers[blob_index]
+        return None
+
+    def batch_map(self) -> dict[tuple[int, int], tuple[int, int]]:
+        """(blob_index, compressed_offset) -> (uncompressed_base, size)."""
+        return {
+            (b.blob_index, b.compressed_offset): (b.uncompressed_base, b.uncompressed_size)
+            for b in self.batches
+        }
 
     # -- serialization ------------------------------------------------------
 
@@ -274,10 +375,21 @@ class Bootstrap:
         chunk_buf = b"".join(c.pack() for c in self.chunks)
         blob_buf = b"".join(b.pack() for b in self.blobs)
 
+        if self.ciphers and len(self.ciphers) != len(self.blobs):
+            raise BootstrapError(
+                f"cipher table has {len(self.ciphers)} entries for "
+                f"{len(self.blobs)} blobs"
+            )
+        has_ciphers = any(c.algo for c in self.ciphers)
+        cipher_buf = b"".join(c.pack() for c in self.ciphers) if has_ciphers else b""
+        batch_buf = b"".join(b.pack() for b in self.batches)
+
         inode_table_off = header_size
         chunk_table_off = inode_table_off + len(inode_buf)
         blob_table_off = chunk_table_off + len(chunk_buf)
-        heap_off = blob_table_off + len(blob_buf)
+        cipher_table_off = blob_table_off + len(blob_buf)
+        batch_table_off = cipher_table_off + len(cipher_buf)
+        heap_off = batch_table_off + len(batch_buf)
 
         magic = (
             layout.RAFS_V5_SUPER_MAGIC
@@ -285,10 +397,13 @@ class Bootstrap:
             else layout.RAFS_V6_SUPER_MAGIC
         )
         sb_version = SUPER_VERSION_V5 if self.version == layout.RAFS_V5 else SUPER_VERSION_V6
+        features = (FEATURE_CIPHER_TABLE if has_ciphers else 0) | (
+            FEATURE_BATCH_TABLE if self.batches else 0
+        )
         sb = _SB_STRUCT.pack(
             magic,
             sb_version,
-            0,
+            features,
             4096,
             self.chunk_size,
             0,
@@ -301,6 +416,18 @@ class Bootstrap:
             heap_off,
             len(heap),
         ).ljust(_SB_SIZE, b"\x00")
+        if has_ciphers:
+            sb = (
+                sb[:_SB_CIPHER_OFF]
+                + _SB_CIPHER_STRUCT.pack(cipher_table_off, len(self.ciphers))
+                + sb[_SB_CIPHER_OFF + _SB_CIPHER_STRUCT.size :]
+            )
+        if self.batches:
+            sb = (
+                sb[:_SB_BATCH_OFF]
+                + _SB_BATCH_STRUCT.pack(batch_table_off, len(self.batches))
+                + sb[_SB_BATCH_OFF + _SB_BATCH_STRUCT.size :]
+            )
 
         header = bytearray(header_size)
         if self.version == layout.RAFS_V5:
@@ -309,7 +436,15 @@ class Bootstrap:
             # v6: EROFS-style — superblock region at offset 1024.
             header[layout.RAFS_V6_SUPER_BLOCK_OFFSET : layout.RAFS_V6_SUPER_BLOCK_OFFSET + _SB_SIZE] = sb
 
-        return bytes(header) + bytes(inode_buf) + chunk_buf + blob_buf + bytes(heap)
+        return (
+            bytes(header)
+            + bytes(inode_buf)
+            + chunk_buf
+            + blob_buf
+            + cipher_buf
+            + batch_buf
+            + bytes(heap)
+        )
 
     @classmethod
     def from_bytes(cls, buf: bytes) -> "Bootstrap":
@@ -318,7 +453,7 @@ class Bootstrap:
         (
             _magic,
             sb_version,
-            _features,
+            features,
             _block_size,
             chunk_size,
             _flags,
@@ -331,6 +466,20 @@ class Bootstrap:
             heap_off,
             heap_size,
         ) = _SB_STRUCT.unpack_from(buf, sb_off)
+        cipher_table_off = cipher_count = 0
+        if features & FEATURE_CIPHER_TABLE:
+            cipher_table_off, cipher_count = _SB_CIPHER_STRUCT.unpack_from(
+                buf, sb_off + _SB_CIPHER_OFF
+            )
+            if cipher_count != blob_count:
+                raise BootstrapError(
+                    f"cipher table has {cipher_count} entries for {blob_count} blobs"
+                )
+        batch_table_off = batch_count = 0
+        if features & FEATURE_BATCH_TABLE:
+            batch_table_off, batch_count = _SB_BATCH_STRUCT.unpack_from(
+                buf, sb_off + _SB_BATCH_OFF
+            )
 
         # A foreign bootstrap (e.g. one written by the Rust nydus-image) or a
         # truncated file can share the magic while carrying garbage fields —
@@ -345,6 +494,8 @@ class Bootstrap:
             ("inode", inode_table_off, inode_count, INODE_SIZE),
             ("chunk", chunk_table_off, chunk_count, CHUNK_SIZE_BYTES),
             ("blob", blob_table_off, blob_count, BLOB_SIZE_BYTES),
+            ("cipher", cipher_table_off, cipher_count, CIPHER_SIZE_BYTES),
+            ("batch", batch_table_off, batch_count, BATCH_SIZE_BYTES),
             ("heap", heap_off, heap_size, 1),
         ):
             if off + count * rec_size > len(buf):
@@ -442,7 +593,27 @@ class Bootstrap:
             )
             for i in range(blob_count)
         ]
-        return cls(version=version, chunk_size=chunk_size, inodes=inodes, chunks=chunks, blobs=blobs)
+        ciphers = [
+            CipherRecord.unpack(
+                buf[cipher_table_off + i * CIPHER_SIZE_BYTES : cipher_table_off + (i + 1) * CIPHER_SIZE_BYTES]
+            )
+            for i in range(cipher_count)
+        ]
+        batches = [
+            BatchRecord.unpack(
+                buf[batch_table_off + i * BATCH_SIZE_BYTES : batch_table_off + (i + 1) * BATCH_SIZE_BYTES]
+            )
+            for i in range(batch_count)
+        ]
+        return cls(
+            version=version,
+            chunk_size=chunk_size,
+            inodes=inodes,
+            chunks=chunks,
+            blobs=blobs,
+            ciphers=ciphers,
+            batches=batches,
+        )
 
     # -- views --------------------------------------------------------------
 
