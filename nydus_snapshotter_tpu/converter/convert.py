@@ -20,6 +20,7 @@ import hashlib
 import io
 import stat
 import tarfile
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import BinaryIO, Callable, Optional
@@ -116,6 +117,8 @@ class BlobReader:
         # the bootstrap's batch table. Callers constructing several readers
         # can share one batch_map to avoid rebuilding it per blob.
         self._batch_map = bootstrap.batch_map() if batch_map is None else batch_map
+        # The daemon shares one reader per blob across request threads.
+        self._batch_lock = threading.Lock()
         self._batch_cache: "OrderedDict[int, bytes]" = OrderedDict()
         self._batch_cache_bytes = 0
 
@@ -142,17 +145,23 @@ class BlobReader:
                     f"{rec.compressed_offset} has no batch-table entry"
                 )
             base, usize = extent
-            batch = self._batch_cache.get(rec.compressed_offset)
+            with self._batch_lock:
+                batch = self._batch_cache.get(rec.compressed_offset)
+                if batch is not None:
+                    self._batch_cache.move_to_end(rec.compressed_offset)
             if batch is None:
                 raw = self._read_plain(rec.compressed_offset, rec.compressed_size)
                 batch = _decompress_chunk(raw, rec.flags, usize)
-                self._batch_cache[rec.compressed_offset] = batch
-                self._batch_cache_bytes += len(batch)
-                while self._batch_cache_bytes > self.BATCH_CACHE_BYTES and len(self._batch_cache) > 1:
-                    _, evicted = self._batch_cache.popitem(last=False)
-                    self._batch_cache_bytes -= len(evicted)
-            else:
-                self._batch_cache.move_to_end(rec.compressed_offset)
+                with self._batch_lock:
+                    if rec.compressed_offset not in self._batch_cache:
+                        self._batch_cache[rec.compressed_offset] = batch
+                        self._batch_cache_bytes += len(batch)
+                    while (
+                        self._batch_cache_bytes > self.BATCH_CACHE_BYTES
+                        and len(self._batch_cache) > 1
+                    ):
+                        _, evicted = self._batch_cache.popitem(last=False)
+                        self._batch_cache_bytes -= len(evicted)
             inner = rec.uncompressed_offset - base
             if inner < 0 or inner + rec.uncompressed_size > len(batch):
                 raise ConvertError("batch chunk slice overflows its batch")

@@ -52,38 +52,33 @@ class _Instance:
         # path made every read O(chunks) syscalls.
         self._batch_map = self.bootstrap.batch_map()
         self._readers: dict[int, BlobReader] = {}
-        self._files: dict[int, Any] = {}
-        self._io_lock = threading.Lock()
+        self._reader_lock = threading.Lock()
         self._closed = False
 
     def close(self) -> None:
-        with self._io_lock:
+        # Drop the readers; each blob file closes when its last in-flight
+        # read releases the closure reference (no explicit close — closing
+        # under a racing read would either raise on a closed file or, worse,
+        # pread a recycled fd).
+        with self._reader_lock:
             self._closed = True
-            for f in self._files.values():
-                try:
-                    f.close()
-                except OSError:
-                    pass
-            self._files.clear()
             self._readers.clear()
 
     def _reader(self, blob_index: int, blob_dir: str) -> BlobReader:
-        with self._io_lock:
+        with self._reader_lock:
             if self._closed:
                 # A read racing a legitimate unmount: fail instead of
-                # leaking a fresh fd into the discarded instance.
+                # resurrecting a reader for the discarded instance.
                 raise FileNotFoundError(self.mountpoint)
             reader = self._readers.get(blob_index)
             if reader is None:
                 blob_id = self.bootstrap.blobs[blob_index].blob_id
                 f = open(os.path.join(blob_dir, blob_id), "rb")
-                self._files[blob_index] = f
-                lock = self._io_lock
 
-                def read_at(off: int, size: int, _f=f, _lock=lock) -> bytes:
-                    with _lock:
-                        _f.seek(off)
-                        return _f.read(size)
+                def read_at(off: int, size: int, _f=f) -> bytes:
+                    # pread is positional: no seek state, no lock, one
+                    # syscall; _f in the closure keeps the fd alive.
+                    return os.pread(_f.fileno(), size, off)
 
                 reader = BlobReader(
                     self.bootstrap, blob_index, read_at, batch_map=self._batch_map

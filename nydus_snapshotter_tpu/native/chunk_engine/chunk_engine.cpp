@@ -10,6 +10,7 @@
 // layer streams in parallel.
 
 #include <cstdint>
+#include <cstring>
 
 extern "C" {
 
@@ -73,6 +74,43 @@ int64_t ntpu_cdc_chunk(const uint8_t *data, int64_t n,
     cuts_out[n_cuts++] = n;
   }
   return n_cuts;
+}
+
+// Open-addressing chunk-dict table build: sequential first-wins insertion
+// (the host arm of parallel/sharded_dict.py's table builder — single-pass
+// sequential insertion beats any vectorized lockstep scheme on the
+// memory-bound path, and ctypes drops the GIL for the call).
+//
+// digests: u32[n][8] raw SHA-256 keys. keys: u32[n_shards*cap][8] and
+// values: i32[n_shards*cap] must arrive zeroed (0 = empty slot). Shard =
+// word0 % n_shards, slot base = word1 & (cap-1), linear probing. A probe
+// hitting an equal key is a duplicate: dropped, first insertion wins.
+// Returns 0 on success, -1 when a probe chain exceeded max_probe (caller
+// grows cap and retries).
+int64_t ntpu_dict_build(const uint32_t *digests, int64_t n,
+                        int64_t n_shards, int64_t cap, int64_t max_probe,
+                        uint32_t *keys, int32_t *values) {
+  for (int64_t idx = 0; idx < n; ++idx) {
+    const uint32_t *d = digests + idx * 8;
+    const uint64_t shard = d[0] % (uint64_t)n_shards;
+    const uint64_t base = d[1] & (uint64_t)(cap - 1);
+    bool placed = false;
+    for (int64_t j = 0; j < max_probe; ++j) {
+      const uint64_t lin = shard * (uint64_t)cap + ((base + j) & (uint64_t)(cap - 1));
+      if (values[lin] == 0) {
+        std::memcpy(keys + lin * 8, d, 32);
+        values[lin] = (int32_t)(idx + 1);
+        placed = true;
+        break;
+      }
+      if (std::memcmp(keys + lin * 8, d, 32) == 0) {
+        placed = true;  // duplicate digest: first insertion wins
+        break;
+      }
+    }
+    if (!placed) return -1;
+  }
+  return 0;
 }
 
 // Position-parallel gear hash of every byte position (the same

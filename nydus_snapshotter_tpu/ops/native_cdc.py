@@ -52,6 +52,13 @@ def load() -> Optional[ctypes.CDLL]:
         lib.ntpu_gear_hashes.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p
         ]
+        if hasattr(lib, "ntpu_dict_build"):
+            lib.ntpu_dict_build.restype = ctypes.c_int64
+            lib.ntpu_dict_build.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,  # digests, n
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # shards, cap, max_probe
+                ctypes.c_void_p, ctypes.c_void_p,  # keys, values
+            ]
         _lib = lib
         return _lib
 
@@ -85,6 +92,33 @@ def chunk_data_native(data: bytes | np.ndarray, params: cdc.CDCParams) -> np.nda
     if n < 0:
         raise RuntimeError("native chunker cut buffer overflow")
     return cuts[:n].copy()
+
+
+def dict_build_available() -> bool:
+    lib = load()
+    return lib is not None and hasattr(lib, "ntpu_dict_build")
+
+
+def dict_build_native(
+    digests: np.ndarray, n_shards: int, cap: int, max_probe: int,
+    keys: np.ndarray, values: np.ndarray,
+) -> bool:
+    """Sequential first-wins table build into caller-zeroed keys/values.
+
+    Returns False when a probe chain overflowed max_probe (grow cap and
+    retry). Arrays must be C-contiguous with the documented dtypes.
+    """
+    lib = load()
+    if lib is None or not hasattr(lib, "ntpu_dict_build"):
+        raise RuntimeError("libchunk_engine.so not built or too old")
+    assert digests.dtype == np.uint32 and digests.flags.c_contiguous
+    assert keys.dtype == np.uint32 and keys.flags.c_contiguous
+    assert values.dtype == np.int32 and values.flags.c_contiguous
+    rc = lib.ntpu_dict_build(
+        digests.ctypes.data, len(digests), n_shards, cap, max_probe,
+        keys.ctypes.data, values.ctypes.data,
+    )
+    return rc == 0
 
 
 def gear_hashes_native(data: bytes | np.ndarray) -> np.ndarray:
