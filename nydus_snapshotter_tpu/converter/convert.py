@@ -16,7 +16,6 @@ bootstrap) | ``rafs.blob.toc``.
 
 from __future__ import annotations
 
-import hashlib
 import io
 import stat
 import tarfile
@@ -42,7 +41,6 @@ from nydus_snapshotter_tpu.models.bootstrap import (
     Inode,
     parse_chunk_dict_arg,
 )
-from nydus_snapshotter_tpu.ops.chunker import ChunkDigestEngine
 from nydus_snapshotter_tpu.utils import lz4
 
 _ZSTD_LEVEL = 3
@@ -178,12 +176,6 @@ def make_bytes_reader(
     )
 
 
-def _make_engine(opt: PackOption) -> ChunkDigestEngine:
-    return ChunkDigestEngine(
-        chunk_size=opt.chunk_size, mode=opt.chunking, backend=opt.backend
-    )
-
-
 # ---------------------------------------------------------------------------
 # Pack
 # ---------------------------------------------------------------------------
@@ -194,238 +186,14 @@ def Pack(dest: BinaryIO, src_tar: BinaryIO | bytes, opt: PackOption) -> PackResu
 
     Reference semantics (convert_unix.go:325-539): stream in an uncompressed
     layer tar, emit the tar-like nydus blob; chunk-dict hits are not stored,
-    only referenced.
+    only referenced. Implementation: the bounded-memory streaming pipeline
+    in converter/stream.py (tar stream -> incremental CDC -> batched
+    digests -> dedup -> compress -> dest), shared by in-memory and
+    streaming callers alike.
     """
-    opt.validate()
+    from nydus_snapshotter_tpu.converter.stream import pack_stream
 
-    entries = fstree.ensure_parents(fstree.tree_from_tar(src_tar))
-    chunk_dict = (
-        ChunkDict.from_path(parse_chunk_dict_arg(opt.chunk_dict_path))
-        if opt.chunk_dict_path
-        else None
-    )
-    engine = _make_engine(opt)
-
-    inodes: list[Inode] = []
-    chunk_records: list[ChunkRecord] = []  # global table, per-inode slices
-    per_file_chunks: list[tuple[Inode, list]] = []
-
-    # Chunk+digest every regular file (per-file chunking, as the reference
-    # builder does — dedup needs file-aligned chunk starts).
-    files = [e for e in entries if e.is_regular]
-    metas_per_file = engine.process_many([e.data for e in files])
-
-    # First pass: intra-layer + dict dedup bookkeeping.
-    own_chunks: dict[bytes, int] = {}  # digest -> unique index in this blob
-    unique_data: list[bytes] = []
-    dict_blobs_used: list[str] = []  # dict blob ids in first-use order
-    dict_hits: dict[bytes, ChunkRecord] = {}
-    for e, metas in zip(files, metas_per_file):
-        for m in metas:
-            if chunk_dict is not None and m.digest not in dict_hits:
-                hit = chunk_dict.get(m.digest)
-                if hit is not None:
-                    dict_hits[m.digest] = hit
-                    bid = chunk_dict.blob_id_for(hit)
-                    if bid not in dict_blobs_used:
-                        dict_blobs_used.append(bid)
-            if m.digest not in dict_hits and m.digest not in own_chunks:
-                own_chunks[m.digest] = len(unique_data)
-                unique_data.append(e.data[m.offset : m.offset + m.size])
-
-    # Compress unique chunks, lay out the blob data section. Chunks smaller
-    # than ``batch_size`` are packed into shared batch extents compressed as
-    # one unit (reference --batch-size, tool/builder.go:131-134); a batch
-    # only spans a *run* of consecutive small chunks so its members stay
-    # contiguous in the blob's uncompressed address space (which is what
-    # lets BlobReader slice the decompressed batch by uncompressed offsets).
-    align = 4096 if (opt.aligned_chunk and opt.fs_version == layout.RAFS_V5) else 1
-    compress = _make_compressor(opt.compressor)
-    blob_parts: list[bytes] = []
-    comp_extents: list[Optional[tuple[int, int, int]]] = [None] * len(unique_data)
-    uncomp_offsets: list[int] = []
-    uoff = 0
-    for data in unique_data:
-        uncomp_offsets.append(uoff)
-        uoff += len(data)
-    coff = 0
-
-    def _emit(comp: bytes) -> int:
-        nonlocal coff
-        pad = (-coff) % align
-        if pad:
-            blob_parts.append(b"\x00" * pad)
-            coff += pad
-        start = coff
-        blob_parts.append(comp)
-        coff += len(comp)
-        return start
-
-    pending: list[int] = []  # unique-chunk indices of the open batch
-    pending_bytes = 0
-    own_batches: list[tuple[int, int, int]] = []  # (coff, uncomp_base, usize)
-
-    def _flush_batch() -> None:
-        nonlocal pending, pending_bytes
-        if not pending:
-            return
-        comp, cflag = compress(b"".join(unique_data[i] for i in pending))
-        start = _emit(comp)
-        for i in pending:
-            comp_extents[i] = (start, len(comp), cflag | CHUNK_FLAG_BATCH)
-        own_batches.append((start, uncomp_offsets[pending[0]], pending_bytes))
-        pending = []
-        pending_bytes = 0
-
-    for i, data in enumerate(unique_data):
-        if opt.batch_size and len(data) < opt.batch_size:
-            if pending_bytes + len(data) > opt.batch_size:
-                _flush_batch()
-            pending.append(i)
-            pending_bytes += len(data)
-        else:
-            _flush_batch()
-            comp, cflag = compress(data)
-            comp_extents[i] = (_emit(comp), len(comp), cflag)
-    _flush_batch()
-
-    blob_data = b"".join(blob_parts)
-    blob_cipher: Optional[CipherRecord] = None
-    if opt.encrypt and blob_data:
-        key, iv = crypto.generate_context()
-        blob_data = crypto.encrypt(blob_data, key, iv)
-        blob_cipher = CipherRecord(algo=crypto.CIPHER_AES_256_CTR, key=key, iv=iv)
-    blob_sha = hashlib.sha256(blob_data) if blob_data else None
-    blob_id = blob_sha.hexdigest() if blob_sha else ""
-
-    # Blob table: own blob first (if it stores anything), then dict blobs.
-    # Cipher and batch tables follow the blob table: dict blobs carry their
-    # cipher context and batch extents over from the dict bootstrap, so
-    # partial references into a foreign batch stay resolvable.
-    blob_table: list[BlobRecord] = []
-    cipher_table: list[CipherRecord] = []
-    batch_table: list[BatchRecord] = []
-    blob_index_of: dict[str, int] = {}
-    if blob_data:
-        blob_index_of[blob_id] = 0
-        blob_table.append(
-            BlobRecord(
-                blob_id=blob_id,
-                compressed_size=len(blob_data),
-                uncompressed_size=uoff,
-                chunk_count=len(unique_data),
-            )
-        )
-        cipher_table.append(blob_cipher or CipherRecord())
-        for coff_b, base, usize in own_batches:
-            batch_table.append(BatchRecord(0, coff_b, base, usize))
-    for bid in dict_blobs_used:
-        new_idx = len(blob_table)
-        blob_index_of[bid] = new_idx
-        dict_idx, dict_rec = next(
-            (i, b) for i, b in enumerate(chunk_dict.bootstrap.blobs) if b.blob_id == bid
-        )
-        blob_table.append(
-            BlobRecord(
-                blob_id=bid,
-                compressed_size=dict_rec.compressed_size,
-                uncompressed_size=dict_rec.uncompressed_size,
-                chunk_count=dict_rec.chunk_count,
-                flags=dict_rec.flags,
-            )
-        )
-        cipher_table.append(chunk_dict.bootstrap.cipher_for(dict_idx) or CipherRecord())
-        for b in chunk_dict.bootstrap.batches:
-            if b.blob_index == dict_idx:
-                batch_table.append(
-                    BatchRecord(new_idx, b.compressed_offset, b.uncompressed_base, b.uncompressed_size)
-                )
-
-    # Second pass: emit inodes + chunk records.
-    file_meta = {id(e): m for e, m in zip(files, metas_per_file)}
-    for e in entries:
-        inode = fstree.entry_to_inode(e)
-        if e.is_regular and e.data:
-            metas = file_meta[id(e)]
-            inode.chunk_index = len(chunk_records)
-            inode.chunk_count = len(metas)
-            for m in metas:
-                hit = dict_hits.get(m.digest)
-                if hit is not None:
-                    rec = ChunkRecord(
-                        digest=m.digest,
-                        blob_index=blob_index_of[chunk_dict.blob_id_for(hit)],
-                        flags=hit.flags,
-                        uncompressed_offset=hit.uncompressed_offset,
-                        compressed_offset=hit.compressed_offset,
-                        uncompressed_size=hit.uncompressed_size,
-                        compressed_size=hit.compressed_size,
-                    )
-                else:
-                    ui = own_chunks[m.digest]
-                    off, csize, cflag = comp_extents[ui]
-                    rec = ChunkRecord(
-                        digest=m.digest,
-                        blob_index=blob_index_of[blob_id],
-                        flags=cflag,
-                        uncompressed_offset=uncomp_offsets[ui],
-                        compressed_offset=off,
-                        uncompressed_size=m.size,
-                        compressed_size=csize,
-                    )
-                chunk_records.append(rec)
-        inodes.append(inode)
-
-    bootstrap = Bootstrap(
-        version=opt.fs_version,
-        chunk_size=opt.chunk_size,
-        inodes=inodes,
-        chunks=chunk_records,
-        blobs=blob_table,
-        ciphers=cipher_table if any(c.algo for c in cipher_table) else [],
-        batches=batch_table,
-    )
-    boot_bytes = bootstrap.to_bytes()
-
-    # Frame the output stream + trailing TOC.
-    toc_entries = []
-    sections: list[tuple[str, bytes]] = []
-    if blob_data:
-        sections.append((toc.ENTRY_BLOB_DATA, blob_data))
-        toc_entries.append(
-            toc.TOCEntry(
-                name=toc.ENTRY_BLOB_DATA,
-                flags=constants.COMPRESSOR_NONE,
-                uncompressed_digest=blob_sha.digest(),
-                compressed_size=len(blob_data),
-                uncompressed_size=len(blob_data),
-            )
-        )
-    sections.append((toc.ENTRY_BOOTSTRAP, boot_bytes))
-    toc_entries.append(
-        toc.TOCEntry(
-            name=toc.ENTRY_BOOTSTRAP,
-            flags=constants.COMPRESSOR_NONE,
-            uncompressed_digest=hashlib.sha256(boot_bytes).digest(),
-            compressed_size=len(boot_bytes),
-            uncompressed_size=len(boot_bytes),
-        )
-    )
-
-    offset = 0
-    for name, data in sections:
-        o, _ = nydus_tar.append_entry(dest, name, data)
-        for t in toc_entries:
-            if t.name == name:
-                t.compressed_offset = o
-    nydus_tar.append_entry(dest, toc.ENTRY_BLOB_TOC, toc.pack_toc(toc_entries))
-
-    return PackResult(
-        blob_id=blob_id,
-        blob_size=len(blob_data),
-        bootstrap=boot_bytes,
-        referenced_blob_ids=[b.blob_id for b in blob_table],
-    )
+    return pack_stream(dest, src_tar, opt)
 
 
 def pack_layer(src_tar: bytes, opt: PackOption) -> tuple[bytes, PackResult]:

@@ -47,6 +47,14 @@ def encrypt(data: bytes, key: bytes, iv: bytes) -> bytes:
     return enc.update(data) + enc.finalize()
 
 
+def stream_encryptor(key: bytes, iv: bytes):
+    """Incremental encryptor positioned at offset 0 — feed section bytes in
+    order via .update(); byte-identical to ``encrypt`` over the whole
+    section, and the single definition the seekable ``decrypt_range``
+    counter layout is guaranteed against."""
+    return _ctr_at(key, iv, 0).encryptor()
+
+
 def decrypt_range(data: bytes, offset: int, key: bytes, iv: bytes) -> bytes:
     """Decrypt ``data`` that was taken from absolute blob ``offset``.
 

@@ -1,0 +1,544 @@
+"""Streaming Pack: bounded-memory OCI-tar → nydus-blob conversion.
+
+The reference streams a layer through 1 MiB FIFO buffers into the builder
+process (pkg/converter/convert_unix.go:56-61,443-539) so conversion memory
+is independent of layer size. This module is that discipline rebuilt around
+the in-process engine:
+
+    tar stream → per-file incremental CDC (bounded carry) → digest batches
+    (device-dispatched double-buffered, or host thread pool) → dedup →
+    compress/batch-pack → encrypt → dest
+
+Nothing holds the whole layer: the chunker carries at most ``max_size`` of
+lookahead per file, digests travel in fixed-budget batches (one in flight on
+device while the host reads the next — JAX's async dispatch is the double
+buffer), and blob bytes stream straight to ``dest`` because the nydus
+framing puts each tar header *after* its data (models/nydus_tar.py). Only
+metadata (inodes + chunk records) accumulates, O(files + chunks).
+
+``converter.convert.Pack`` delegates here — this is the only Pack
+implementation, so in-memory and streaming callers share one code path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import stat
+import tarfile
+from dataclasses import dataclass, field
+from typing import BinaryIO, Optional
+
+import numpy as np
+
+from nydus_snapshotter_tpu import constants
+from nydus_snapshotter_tpu.converter import crypto
+from nydus_snapshotter_tpu.converter.types import ConvertError, PackOption
+from nydus_snapshotter_tpu.models import fstree, layout, nydus_tar, toc
+from nydus_snapshotter_tpu.models.bootstrap import (
+    CHUNK_FLAG_BATCH,
+    BatchRecord,
+    BlobRecord,
+    Bootstrap,
+    ChunkDict,
+    ChunkRecord,
+    CipherRecord,
+    Inode,
+    parse_chunk_dict_arg,
+)
+from nydus_snapshotter_tpu.ops import cdc
+
+SEGMENT_BYTES = 4 << 20  # tar read granularity
+DIGEST_BATCH_BYTES = 32 << 20  # chunk bytes per digest batch
+
+
+class _CountingWriter:
+    """Tracks the write position so ``dest`` needn't be seekable."""
+
+    def __init__(self, f: BinaryIO):
+        self.f = f
+        self.pos = 0
+
+    def write(self, b: bytes) -> int:
+        self.f.write(b)
+        self.pos += len(b)
+        return len(b)
+
+    def tell(self) -> int:
+        return self.pos
+
+
+class IncrementalChunker:
+    """Per-file CDC with bounded carry.
+
+    A FastCDC cut ending the chunk that starts at ``s`` depends only on
+    bytes ``[s, s + max_size)``, so any cut whose chunk start has a full
+    ``max_size`` of lookahead in the buffer is final; the rest is carried.
+    Produces exactly the cuts a whole-stream run produces (ops/cdc.py
+    resolution, native or numpy backend).
+    """
+
+    def __init__(self, opt: PackOption):
+        from nydus_snapshotter_tpu.ops.chunker import ChunkDigestEngine
+
+        # One backend-selection policy: boundaries go through the engine
+        # (jax = device two-phase candidates, hybrid = native, numpy = host).
+        self._engine = ChunkDigestEngine(
+            chunk_size=opt.chunk_size, mode=opt.chunking, backend=opt.backend
+        )
+        self.lookahead = (
+            self._engine.params.max_size if self._engine.params else opt.chunk_size
+        )
+        self._buf = bytearray()
+
+    def _boundaries(self, arr: np.ndarray) -> np.ndarray:
+        return self._engine.boundaries(arr)
+
+    def feed(self, seg: bytes) -> list[bytes]:
+        self._buf += seg
+        if len(self._buf) < 2 * self.lookahead:
+            return []
+        return self._drain(final=False)
+
+    def finish(self) -> list[bytes]:
+        out = self._drain(final=True)
+        self._buf = bytearray()
+        return out
+
+    def _drain(self, final: bool) -> list[bytes]:
+        buf = self._buf
+        if not buf:
+            return []
+        cuts = self._boundaries(np.frombuffer(bytes(buf), dtype=np.uint8))
+        out: list[bytes] = []
+        s = 0
+        for c in cuts:
+            c = int(c)
+            if not final and s + self.lookahead > len(buf):
+                break
+            out.append(bytes(buf[s:c]))
+            s = c
+        self._buf = bytearray(buf[s:]) if not final else bytearray()
+        return out
+
+
+class _HostDigester:
+    """Synchronous batch digests on the host thread pool."""
+
+    def submit(self, datas: list[bytes]):
+        from nydus_snapshotter_tpu.ops.chunker import _host_digests
+
+        return _host_digests([(np.frombuffer(d, dtype=np.uint8), 0, len(d)) for d in datas])
+
+    def collect(self, handle) -> list[bytes]:
+        return handle
+
+
+class _DeviceDigester:
+    """Async device digests: submit dispatches (JAX async), collect blocks.
+
+    Holding exactly one batch in flight while the host reads/chunks the next
+    is the double-buffered infeed — device SHA-256 overlaps tar ingest.
+    """
+
+    def __init__(self, max_chunk: int):
+        # Padded-block bucket clamp at the engine's true max chunk size
+        # (a max-size chunk is one block over a power of two; rounding up
+        # would double the scan — same reasoning as
+        # ops/chunker._digests_bucketed).
+        from nydus_snapshotter_tpu.ops import sha256
+
+        self._max_blocks = sha256.n_padded_blocks(max_chunk)
+
+    def submit(self, datas: list[bytes]):
+        import jax.numpy as jnp
+
+        from nydus_snapshotter_tpu.ops import sha256
+        from nydus_snapshotter_tpu.ops.chunker import _pow2_ceil
+
+        max_blocks = self._max_blocks
+        buckets: dict[int, list[int]] = {}
+        for i, d in enumerate(datas):
+            nb = sha256.n_padded_blocks(len(d))
+            cap = min(1 << (nb - 1).bit_length() if nb > 1 else 1, max_blocks)
+            buckets.setdefault(cap, []).append(i)
+        parts = []
+        for cap, idxs in sorted(buckets.items()):
+            blocks, counts = sha256.pack_messages_np([datas[i] for i in idxs], block_capacity=cap)
+            m_pad = _pow2_ceil(len(idxs)) - len(idxs)
+            if m_pad:
+                blocks = np.concatenate([blocks, np.zeros((m_pad, cap, 16), np.uint32)])
+                counts = np.concatenate([counts, np.zeros(m_pad, np.int32)])
+            states = sha256.sha256_batch(jnp.asarray(blocks), jnp.asarray(counts))
+            parts.append((idxs, states))
+        return (len(datas), parts)
+
+    def collect(self, handle) -> list[bytes]:
+        import jax
+
+        from nydus_snapshotter_tpu.ops import sha256
+
+        n, parts = handle
+        out: list[Optional[bytes]] = [None] * n
+        for idxs, states in parts:
+            host = np.asarray(jax.device_get(states))
+            for row, i in enumerate(idxs):
+                out[i] = sha256.digest_to_bytes(host[row])
+        return out  # type: ignore[return-value]
+
+
+class _SectionWriter:
+    """Streams the image.blob data section: alignment, batch packing,
+    compression, encryption, hashing, extent accounting."""
+
+    def __init__(self, out: _CountingWriter, opt: PackOption, compress):
+        self.out = out
+        self.compress = compress
+        self.align = 4096 if (opt.aligned_chunk and opt.fs_version == layout.RAFS_V5) else 1
+        self.batch_size = opt.batch_size
+        self.hasher = hashlib.sha256()
+        self.cipher: Optional[CipherRecord] = None
+        self._encryptor = None
+        if opt.encrypt:
+            key, iv = crypto.generate_context()
+            self.cipher = CipherRecord(algo=crypto.CIPHER_AES_256_CTR, key=key, iv=iv)
+            self._encryptor = crypto.stream_encryptor(key, iv)
+        self.coff = 0  # current offset within the data section
+        self.extents: list[Optional[tuple[int, int, int]]] = []  # per unique chunk
+        self.batches: list[tuple[int, int, int]] = []  # (coff, uncomp_base, usize)
+        self._pending: list[tuple[int, bytes, int]] = []  # (uniq_idx, data, uoff)
+        self._pending_bytes = 0
+
+    def _write_raw(self, b: bytes) -> None:
+        if self._encryptor is not None:
+            b = self._encryptor.update(b)
+        self.hasher.update(b)
+        self.out.write(b)
+        self.coff += len(b)
+
+    def _emit(self, comp: bytes) -> int:
+        pad = (-self.coff) % self.align
+        if pad:
+            self._write_raw(b"\x00" * pad)
+        start = self.coff
+        self._write_raw(comp)
+        return start
+
+    def _flush_batch(self) -> None:
+        if not self._pending:
+            return
+        comp, cflag = self.compress(b"".join(d for _, d, _ in self._pending))
+        start = self._emit(comp)
+        for idx, _d, _u in self._pending:
+            self.extents[idx] = (start, len(comp), cflag | CHUNK_FLAG_BATCH)
+        self.batches.append((start, self._pending[0][2], self._pending_bytes))
+        self._pending = []
+        self._pending_bytes = 0
+
+    def add(self, uniq_idx: int, data: bytes, uoff: int) -> None:
+        assert uniq_idx == len(self.extents)
+        self.extents.append(None)
+        if self.batch_size and len(data) < self.batch_size:
+            if self._pending_bytes + len(data) > self.batch_size:
+                self._flush_batch()
+            self._pending.append((uniq_idx, data, uoff))
+            self._pending_bytes += len(data)
+        else:
+            self._flush_batch()
+            comp, cflag = self.compress(data)
+            self.extents[uniq_idx] = (self._emit(comp), len(comp), cflag)
+
+    def finish(self) -> None:
+        self._flush_batch()
+        if self._encryptor is not None:
+            tail = self._encryptor.finalize()
+            if tail:
+                self.hasher.update(tail)
+                self.out.write(tail)
+                self.coff += len(tail)
+
+
+@dataclass
+class _ChunkRef:
+    """A file-extent's chunk before final record materialization."""
+
+    digest: bytes
+    size: int
+    uniq_idx: int = -1  # index into the own-blob unique table
+    dict_hit: Optional[ChunkRecord] = None
+
+
+@dataclass
+class _Meta:
+    entry: fstree.FileEntry
+    size: int = 0
+    chunks: list[_ChunkRef] = field(default_factory=list)
+
+
+def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption):
+    """Stream one OCI layer tar into a nydus blob written to ``dest``.
+
+    Reference semantics (convert_unix.go:325-539): uncompressed layer tar
+    in, tar-like nydus blob out; chunk-dict hits are referenced, not stored.
+    """
+    import io
+
+    opt.validate()
+    if isinstance(src_tar, (bytes, bytearray)):
+        src_tar = io.BytesIO(src_tar)
+
+    chunk_dict = (
+        ChunkDict.from_path(parse_chunk_dict_arg(opt.chunk_dict_path))
+        if opt.chunk_dict_path
+        else None
+    )
+    from nydus_snapshotter_tpu.converter.convert import _make_compressor
+
+    out = _CountingWriter(dest)
+    section = _SectionWriter(out, opt, _make_compressor(opt.compressor))
+    max_chunk = cdc.CDCParams(opt.chunk_size).max_size if opt.chunking == "cdc" else opt.chunk_size
+    digester = _DeviceDigester(max_chunk) if opt.backend == "jax" else _HostDigester()
+
+    metas: dict[str, _Meta] = {}
+    opaque_dirs: list[str] = []
+
+    # Dedup state (chunk order = tar order; deterministic).
+    own_chunks: dict[bytes, int] = {}
+    uncomp_offsets: list[int] = []
+    uoff = 0
+    dict_hits: dict[bytes, ChunkRecord] = {}
+    dict_blobs_used: list[str] = []
+
+    # One digest batch in flight: (handle, [(meta, data)]) pairs.
+    pending: list[tuple[_Meta, bytes]] = []
+    pending_bytes = 0
+    in_flight: Optional[tuple[object, list[tuple[_Meta, bytes]]]] = None
+
+    def _process(batch: list[tuple[_Meta, bytes]], digests: list[bytes]) -> None:
+        nonlocal uoff
+        for (meta, data), digest in zip(batch, digests):
+            ref = _ChunkRef(digest=digest, size=len(data))
+            if chunk_dict is not None and digest not in dict_hits and digest not in own_chunks:
+                hit = chunk_dict.get(digest)
+                if hit is not None:
+                    dict_hits[digest] = hit
+                    bid = chunk_dict.blob_id_for(hit)
+                    if bid not in dict_blobs_used:
+                        dict_blobs_used.append(bid)
+            if digest in dict_hits:
+                ref.dict_hit = dict_hits[digest]
+            else:
+                idx = own_chunks.get(digest)
+                if idx is None:
+                    idx = len(uncomp_offsets)
+                    own_chunks[digest] = idx
+                    uncomp_offsets.append(uoff)
+                    section.add(idx, data, uoff)
+                    uoff += len(data)
+                ref.uniq_idx = idx
+            meta.chunks.append(ref)
+
+    def _dispatch() -> None:
+        nonlocal pending, pending_bytes, in_flight
+        if in_flight is not None:
+            handle, batch = in_flight
+            _process(batch, digester.collect(handle))
+            in_flight = None
+        if pending:
+            in_flight = (digester.submit([d for _, d in pending]), pending)
+            pending = []
+            pending_bytes = 0
+
+    def _drain_all() -> None:
+        _dispatch()  # collects old, dispatches remainder
+        _dispatch()  # collects remainder
+
+    def _add_chunk(meta: _Meta, data: bytes) -> None:
+        nonlocal pending_bytes
+        pending.append((meta, data))
+        pending_bytes += len(data)
+        if pending_bytes >= DIGEST_BATCH_BYTES:
+            _dispatch()
+
+    try:
+        tf = tarfile.open(fileobj=src_tar, mode="r|")
+    except tarfile.TarError as e:
+        raise ConvertError(f"bad layer tar: {e}") from e
+    with tf:
+        try:
+            for info in tf:
+                path = fstree.norm_path(info.name)
+                special = fstree.classify_special(path)
+                if special is not None:
+                    kind, target = special
+                    if kind == "opaque":
+                        opaque_dirs.append(target)
+                    else:
+                        metas[target] = _Meta(entry=fstree.whiteout_entry(target))
+                    continue
+                entry = fstree.entry_from_tarinfo(tf, info, path, with_data=False)
+                meta = _Meta(entry=entry)
+                # A path repeated in the tar: last entry wins (as in a real
+                # extraction); chunks already written for the earlier one
+                # stay in the blob as dead bytes.
+                metas[path] = meta
+                if entry.is_regular and info.size > 0:
+                    meta.size = info.size
+                    f = tf.extractfile(info)
+                    if f is None:
+                        raise ConvertError(f"tar member {path!r} has no data stream")
+                    chunker = IncrementalChunker(opt)
+                    while True:
+                        seg = f.read(SEGMENT_BYTES)
+                        if not seg:
+                            break
+                        for chunk in chunker.feed(seg):
+                            _add_chunk(meta, chunk)
+                    for chunk in chunker.finish():
+                        _add_chunk(meta, chunk)
+        except tarfile.TarError as e:
+            raise ConvertError(f"bad layer tar: {e}") from e
+    _drain_all()
+    section.finish()
+
+    blob_size = section.coff
+    blob_id = section.hasher.hexdigest() if blob_size else ""
+    if blob_size:
+        out.write(nydus_tar.make_header(toc.ENTRY_BLOB_DATA, blob_size))
+
+    # Synthesize root + missing parents (metadata only).
+    for p in fstree.missing_parents(metas):
+        metas[p] = _Meta(entry=fstree.FileEntry(path=p, mode=stat.S_IFDIR | 0o755))
+    for d in opaque_dirs:
+        if d not in metas:
+            metas[d] = _Meta(entry=fstree.FileEntry(path=d, mode=stat.S_IFDIR | 0o755))
+        metas[d].entry.flags |= fstree.INODE_FLAG_OPAQUE
+        metas[d].entry.xattrs[fstree.OPAQUE_XATTR] = b"y"
+
+    # Blob + cipher + batch tables (own blob first, then dict blobs).
+    blob_table: list[BlobRecord] = []
+    cipher_table: list[CipherRecord] = []
+    batch_table: list[BatchRecord] = []
+    blob_index_of: dict[str, int] = {}
+    if blob_size:
+        blob_index_of[blob_id] = 0
+        blob_table.append(
+            BlobRecord(
+                blob_id=blob_id,
+                compressed_size=blob_size,
+                uncompressed_size=uoff,
+                chunk_count=len(uncomp_offsets),
+            )
+        )
+        cipher_table.append(section.cipher or CipherRecord())
+        for coff_b, base_u, usize in section.batches:
+            batch_table.append(BatchRecord(0, coff_b, base_u, usize))
+    for bid in dict_blobs_used:
+        new_idx = len(blob_table)
+        blob_index_of[bid] = new_idx
+        dict_idx, dict_rec = next(
+            (i, b) for i, b in enumerate(chunk_dict.bootstrap.blobs) if b.blob_id == bid
+        )
+        blob_table.append(
+            BlobRecord(
+                blob_id=bid,
+                compressed_size=dict_rec.compressed_size,
+                uncompressed_size=dict_rec.uncompressed_size,
+                chunk_count=dict_rec.chunk_count,
+                flags=dict_rec.flags,
+            )
+        )
+        cipher_table.append(chunk_dict.bootstrap.cipher_for(dict_idx) or CipherRecord())
+        for b in chunk_dict.bootstrap.batches:
+            if b.blob_index == dict_idx:
+                batch_table.append(
+                    BatchRecord(new_idx, b.compressed_offset, b.uncompressed_base, b.uncompressed_size)
+                )
+
+    # Inodes + chunk table in path-sorted order (bootstrap serialization
+    # order), records resolved against the final extent table.
+    inodes: list[Inode] = []
+    chunk_records: list[ChunkRecord] = []
+    for path in sorted(metas):
+        meta = metas[path]
+        inode = fstree.entry_to_inode(meta.entry)
+        inode.size = meta.size
+        if meta.chunks:
+            inode.chunk_index = len(chunk_records)
+            inode.chunk_count = len(meta.chunks)
+            for ref in meta.chunks:
+                if ref.dict_hit is not None:
+                    hit = ref.dict_hit
+                    chunk_records.append(
+                        ChunkRecord(
+                            digest=ref.digest,
+                            blob_index=blob_index_of[chunk_dict.blob_id_for(hit)],
+                            flags=hit.flags,
+                            uncompressed_offset=hit.uncompressed_offset,
+                            compressed_offset=hit.compressed_offset,
+                            uncompressed_size=hit.uncompressed_size,
+                            compressed_size=hit.compressed_size,
+                        )
+                    )
+                else:
+                    coff_c, csize, cflag = section.extents[ref.uniq_idx]
+                    chunk_records.append(
+                        ChunkRecord(
+                            digest=ref.digest,
+                            blob_index=blob_index_of[blob_id],
+                            flags=cflag,
+                            uncompressed_offset=uncomp_offsets[ref.uniq_idx],
+                            compressed_offset=coff_c,
+                            uncompressed_size=ref.size,
+                            compressed_size=csize,
+                        )
+                    )
+        inodes.append(inode)
+
+    bootstrap = Bootstrap(
+        version=opt.fs_version,
+        chunk_size=opt.chunk_size,
+        inodes=inodes,
+        chunks=chunk_records,
+        blobs=blob_table,
+        ciphers=cipher_table if any(c.algo for c in cipher_table) else [],
+        batches=batch_table,
+    )
+    boot_bytes = bootstrap.to_bytes()
+
+    toc_entries = []
+    if blob_size:
+        toc_entries.append(
+            toc.TOCEntry(
+                name=toc.ENTRY_BLOB_DATA,
+                flags=constants.COMPRESSOR_NONE,
+                uncompressed_digest=section.hasher.digest(),
+                compressed_offset=0,
+                compressed_size=blob_size,
+                uncompressed_size=blob_size,
+            )
+        )
+    boot_off = out.tell()
+    out.write(boot_bytes)
+    out.write(nydus_tar.make_header(toc.ENTRY_BOOTSTRAP, len(boot_bytes)))
+    toc_entries.append(
+        toc.TOCEntry(
+            name=toc.ENTRY_BOOTSTRAP,
+            flags=constants.COMPRESSOR_NONE,
+            uncompressed_digest=hashlib.sha256(boot_bytes).digest(),
+            compressed_offset=boot_off,
+            compressed_size=len(boot_bytes),
+            uncompressed_size=len(boot_bytes),
+        )
+    )
+    toc_bytes = toc.pack_toc(toc_entries)
+    out.write(toc_bytes)
+    out.write(nydus_tar.make_header(toc.ENTRY_BLOB_TOC, len(toc_bytes)))
+
+    from nydus_snapshotter_tpu.converter.convert import PackResult
+
+    return PackResult(
+        blob_id=blob_id,
+        blob_size=blob_size,
+        bootstrap=boot_bytes,
+        referenced_blob_ids=[b.blob_id for b in blob_table],
+    )

@@ -72,6 +72,47 @@ def _norm(name: str) -> str:
     return name if name != "//" else "/"
 
 
+# Public alias: the streaming Pack normalizes paths the same way.
+norm_path = _norm
+
+
+def classify_special(path: str) -> Optional[tuple[str, str]]:
+    """OCI special-marker classification for one normalized member path.
+
+    Returns ("opaque", dir_path) for ``.wh..wh..opq`` markers,
+    ("whiteout", target_path) for ``.wh.<name>`` markers, None for regular
+    members — the single definition of whiteout naming shared by
+    ``tree_from_tar`` and the streaming Pack.
+    """
+    base = path.rsplit("/", 1)[1] if path != "/" else "/"
+    if base == OPAQUE_MARKER:
+        return ("opaque", path.rsplit("/", 1)[0] or "/")
+    if base.startswith(WHITEOUT_PREFIX):
+        target = _norm(path.rsplit("/", 1)[0] + "/" + base[len(WHITEOUT_PREFIX):])
+        return ("whiteout", target)
+    return None
+
+
+def whiteout_entry(target: str) -> FileEntry:
+    """The RAFS/overlayfs form of a whiteout: a char-0:0 node."""
+    return FileEntry(path=target, mode=stat.S_IFCHR, rdev=0, flags=INODE_FLAG_WHITEOUT)
+
+
+def missing_parents(paths: Iterable[str]) -> list[str]:
+    """Directories (incl. root) a path set references but does not contain."""
+    have = set(paths)
+    missing: set[str] = set()
+    for p in have:
+        q = p
+        while q != "/":
+            q = q.rsplit("/", 1)[0] or "/"
+            if q not in have:
+                missing.add(q)
+    if "/" not in have:
+        missing.add("/")
+    return sorted(missing)
+
+
 def tree_from_tar(fileobj: BinaryIO | bytes) -> list[FileEntry]:
     """Parse an (uncompressed) OCI layer tar into file entries.
 
@@ -87,19 +128,13 @@ def tree_from_tar(fileobj: BinaryIO | bytes) -> list[FileEntry]:
     with tarfile.open(fileobj=fileobj, mode="r:") as tf:
         for info in tf:
             path = _norm(info.name)
-            base = path.rsplit("/", 1)[1] if path != "/" else "/"
-            if base == OPAQUE_MARKER:
-                opaque_dirs.append(path.rsplit("/", 1)[0] or "/")
-                continue
-            if base.startswith(WHITEOUT_PREFIX):
-                target = path.rsplit("/", 1)[0] + "/" + base[len(WHITEOUT_PREFIX) :]
-                target = _norm(target)
-                entries[target] = FileEntry(
-                    path=target,
-                    mode=stat.S_IFCHR,
-                    rdev=0,
-                    flags=INODE_FLAG_WHITEOUT,
-                )
+            special = classify_special(path)
+            if special is not None:
+                kind, target = special
+                if kind == "opaque":
+                    opaque_dirs.append(target)
+                else:
+                    entries[target] = whiteout_entry(target)
                 continue
             entry = entry_from_tarinfo(tf, info, path)
             entries[path] = entry
@@ -163,14 +198,8 @@ def entry_from_tarinfo(
 def ensure_parents(entries: list[FileEntry]) -> list[FileEntry]:
     """Synthesize the root and any parent directories a tar omitted."""
     by_path = {e.path: e for e in entries}
-    for e in list(by_path.values()):
-        p = e.path
-        while p != "/":
-            p = p.rsplit("/", 1)[0] or "/"
-            if p not in by_path:
-                by_path[p] = FileEntry(path=p, mode=stat.S_IFDIR | 0o755)
-    if "/" not in by_path:
-        by_path["/"] = FileEntry(path="/", mode=stat.S_IFDIR | 0o755)
+    for p in missing_parents(by_path):
+        by_path[p] = FileEntry(path=p, mode=stat.S_IFDIR | 0o755)
     return sorted(by_path.values(), key=lambda e: e.path)
 
 

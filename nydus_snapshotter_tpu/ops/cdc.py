@@ -155,13 +155,34 @@ def cuts_to_extents(cuts: np.ndarray) -> list[tuple[int, int]]:
 # ---------------------------------------------------------------------------
 
 
+_NP_WINDOW = 1 << 20
+
+
 def chunk_data_np(data: bytes | np.ndarray, params: CDCParams) -> np.ndarray:
-    """CPU path: cut offsets for a whole in-memory stream."""
+    """CPU path: cut offsets for a whole in-memory stream.
+
+    Hashes are computed per 1 MiB window with the 31-byte tail carried
+    across seams (bit-identical to whole-stream hashing) so peak memory is
+    a few MiB regardless of stream length — this is the streaming Pack's
+    fallback when the native chunker isn't built.
+    """
     arr = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else data
     if arr.size == 0:
         return np.asarray([], dtype=np.int64)
-    hashes = gear.gear_hashes_np(arr)
-    cand_s, cand_l = candidates_from_hashes(hashes, params)
+    parts_s, parts_l = [], []
+    for lo in range(0, arr.size, _NP_WINDOW):
+        hi = min(lo + _NP_WINDOW, arr.size)
+        tail = arr[max(0, lo - (gear.GEAR_WINDOW - 1)) : lo]
+        if len(tail) < gear.GEAR_WINDOW - 1:
+            tail = np.concatenate(
+                [np.zeros(gear.GEAR_WINDOW - 1 - len(tail), dtype=np.uint8), tail]
+            )
+        h = gear.gear_hashes_np(arr[lo:hi], prev_tail=tail)
+        cs, cl = candidates_from_hashes(h, params)
+        parts_s.append(cs + lo)
+        parts_l.append(cl + lo)
+    cand_s = np.concatenate(parts_s)
+    cand_l = np.concatenate(parts_l)
     return resolve_cuts(cand_s, cand_l, arr.size, params)
 
 

@@ -58,13 +58,16 @@ def gear_hashes_np(data: np.ndarray, prev_tail: np.ndarray | None = None) -> np.
     if len(prev_tail) != GEAR_WINDOW - 1:
         raise ValueError(f"prev_tail must be {GEAR_WINDOW - 1} bytes")
     n = len(data)
-    x = np.concatenate([prev_tail, data]).astype(np.int64)
-    g = gear_table()[x]
-    h = np.zeros(n, dtype=np.uint64)
+    x = np.concatenate([prev_tail, np.asarray(data, dtype=np.uint8)])
+    g = gear_table()[x]  # uint32[n + 31]
+    # All arithmetic stays uint32: shifts drop high bits and adds wrap, which
+    # IS the mod-2^32 gear semantics — no 8-byte temporaries (this path also
+    # serves the streaming chunker's fallback, where peak RSS matters).
+    h = np.zeros(n, dtype=np.uint32)
     for k in range(GEAR_WINDOW):
         start = GEAR_WINDOW - 1 - k
-        h += g[start : start + n].astype(np.uint64) << k
-    return h.astype(np.uint32)
+        h += g[start : start + n] << np.uint32(k)
+    return h
 
 
 @functools.partial(jax.jit, static_argnames=("n",))
