@@ -54,8 +54,45 @@ class _Instance:
         self._readers: dict[int, BlobReader] = {}
         self._reader_lock = threading.Lock()
         self._closed = False
+        self.fuse = None  # FuseSession when a kernel mount is being served
 
-    def close(self) -> None:
+    def start_fuse(self, default_blob_dir: str, fd: Optional[int] = None) -> bool:
+        """Serve this instance's mountpoint as a real kernel filesystem.
+
+        Returns False (API-only serving remains) when /dev/fuse is
+        unavailable, the mountpoint isn't a directory, or FUSE is disabled
+        via NTPU_DISABLE_FUSE. ``fd`` adopts an existing session after a
+        failover/upgrade takeover instead of mounting fresh.
+        """
+        if os.environ.get("NTPU_DISABLE_FUSE"):
+            return False
+        from nydus_snapshotter_tpu.fusedev.session import (
+            FuseSession,
+            RafsFuseOps,
+            fuse_available,
+        )
+
+        if fd is None and not (fuse_available() and os.path.isdir(self.mountpoint)):
+            return False
+        blob_dir = self.blob_dir(default_blob_dir)
+        ops = RafsFuseOps(
+            self.bootstrap, lambda p, off, size: self.read(p, off, size, blob_dir)
+        )
+        session = FuseSession(ops, self.mountpoint)
+        try:
+            if fd is None:
+                session.mount()
+            else:
+                session.attach(fd)
+        except Exception:
+            return False
+        self.fuse = session
+        return True
+
+    def close(self, unmount: bool = True) -> None:
+        if self.fuse is not None:
+            self.fuse.close(unmount=unmount)
+            self.fuse = None
         # Drop the readers; each blob file closes when its last in-flight
         # read releases the closure reference (no explicit close — closing
         # under a racing read would either raise on a closed file or, worse,
@@ -149,68 +186,98 @@ class DaemonServer:
 
     # -- state snapshot for failover/upgrade -------------------------------
 
-    def snapshot_state(self) -> bytes:
+    def snapshot_state(self) -> tuple[bytes, list[int]]:
+        """(state JSON, live FUSE session fds). Each instance's ``fuse_fd``
+        field is a 1-based index into the fd array that accompanies the
+        state on the supervisor socket (slot 0 is the state memfd)."""
         with self._lock:
-            return json.dumps(
-                {
-                    "id": self.id,
-                    "instances": [
-                        {
-                            "mountpoint": i.mountpoint,
-                            "source": i.source,
-                            "config": i.config_json,
-                        }
-                        for i in self.instances.values()
-                    ],
-                },
-                sort_keys=True,
-            ).encode()
+            fds: list[int] = []
+            instances = []
+            for i in self.instances.values():
+                rec = {
+                    "mountpoint": i.mountpoint,
+                    "source": i.source,
+                    "config": i.config_json,
+                }
+                if i.fuse is not None and i.fuse.fd >= 0:
+                    fds.append(i.fuse.fd)
+                    rec["fuse_fd"] = len(fds)  # 1-based: memfd occupies slot 0
+                instances.append(rec)
+            state = json.dumps({"id": self.id, "instances": instances}, sort_keys=True)
+            return state.encode(), fds
 
-    def restore_state(self, blob: bytes) -> None:
+    def restore_state(self, blob: bytes, fds: Optional[list[int]] = None) -> None:
         data = json.loads(blob)
+        fds = fds or []
         with self._lock:
-            for inst in data.get("instances", []):
-                self.instances[inst["mountpoint"]] = _Instance(
-                    inst["mountpoint"], inst["source"], inst["config"]
-                )
+            for rec in data.get("instances", []):
+                inst = _Instance(rec["mountpoint"], rec["source"], rec["config"])
+                self.instances[rec["mountpoint"]] = inst
+                idx = rec.get("fuse_fd")
+                if idx and 0 < idx < len(fds):
+                    # Adopt the live kernel session: the mount survived the
+                    # previous daemon, reads resume as soon as we attach.
+                    inst.start_fuse(self.workdir, fd=fds[idx])
             self.state = DaemonState.READY
 
     # -- supervisor interaction (SCM_RIGHTS fd passing) ---------------------
 
-    def send_states_to_supervisor(self) -> None:
-        """PUT .../sendfd handler body: push state + session fd to the
-        supervisor socket (reference supervisor.go:107-178 receiver side)."""
+    def send_states_to_supervisor(self, handoff: bool = False) -> None:
+        """Push state + live session fds to the supervisor socket (reference
+        supervisor.go:107-178 receiver side). ``handoff=True`` is the
+        explicit sendfd API: after pushing, this daemon stops serving its
+        FUSE sessions (keeping the mounts alive) so the successor that
+        takes the fds over is the only reader."""
         if not self.supervisor:
             raise RuntimeError("daemon started without --supervisor")
-        state = self.snapshot_state()
+        state, session_fds = self.snapshot_state()
         fd = os.memfd_create(f"nydus-session-{self.id}")
         try:
             os.write(fd, state)
             with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
                 s.connect(self.supervisor)
-                socket.send_fds(s, [state], [fd])
+                socket.send_fds(s, [state], [fd] + session_fds)
         finally:
             os.close(fd)
+        if handoff:
+            with self._lock:
+                for inst in self.instances.values():
+                    if inst.fuse is not None:
+                        # Stop serving but leave the kernel mount alive for
+                        # the successor; forget the session so a later
+                        # close()/umount here can't tear the mount down
+                        # under the new daemon.
+                        inst.fuse.close(unmount=False)
+                        inst.fuse = None
 
     def takeover_from_supervisor(self) -> None:
-        """PUT .../takeover: pull state + fd back and restore mounts."""
+        """PUT .../takeover: pull state + fds back and restore mounts."""
         if not self.supervisor:
             raise RuntimeError("daemon started without --supervisor")
         with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
             s.connect(self.supervisor)
             # Announce we want the saved session back.
             s.sendall(b"TAKEOVER")
-            msg, fds, _flags, _addr = socket.recv_fds(s, 1 << 20, 4)
+            msg, fds, _flags, _addr = socket.recv_fds(s, 1 << 20, 16)
+        consumed: set[int] = set()
         try:
             state = msg
             if fds:
                 size = os.fstat(fds[0]).st_size
                 os.lseek(fds[0], 0, os.SEEK_SET)
                 state = os.read(fds[0], size)
-            self.restore_state(state)
+                consumed.add(0)
+            self.restore_state(state, fds)
+            for inst in self.instances.values():
+                if inst.fuse is not None:
+                    consumed.add(fds.index(inst.fuse.fd))
         finally:
-            for fd in fds:
-                os.close(fd)
+            for i, fd in enumerate(fds):
+                if i not in consumed:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
 
     # -- http server --------------------------------------------------------
 
@@ -332,7 +399,9 @@ class DaemonServer:
                     threading.Thread(target=daemon.shutdown, daemon=True).start()
                 elif u.path in ("/api/v1/daemon/fuse/sendfd", "/api/v1/daemon/fscache/sendfd"):
                     try:
-                        daemon.send_states_to_supervisor()
+                        # Explicit sendfd = upgrade/failover handoff: stop
+                        # serving the sessions after passing them on.
+                        daemon.send_states_to_supervisor(handoff=True)
                         self._reply(204)
                     except Exception as e:
                         self._reply(500, {"error": str(e)})
@@ -409,7 +478,10 @@ class DaemonServer:
                 raise RuntimeError(f"daemon in state {self.state}, cannot mount")
             if mountpoint in self.instances:
                 raise FileExistsError(mountpoint)
-            self.instances[mountpoint] = _Instance(mountpoint, source, config)
+            inst = _Instance(mountpoint, source, config)
+            self.instances[mountpoint] = inst
+        # Kernel mount when the environment allows it; API-only otherwise.
+        inst.start_fuse(self.workdir)
         self._push_state_async()
 
     def umount(self, mountpoint: str) -> None:
@@ -466,6 +538,11 @@ class DaemonServer:
     def shutdown(self) -> None:
         with self._lock:
             self.state = DaemonState.DESTROYED
+            instances = list(self.instances.values())
+        # Graceful exit tears down kernel mounts this daemon still serves
+        # (handed-off sessions were already forgotten and stay alive).
+        for inst in instances:
+            inst.close(unmount=True)
         if self._httpd is not None:
             self._httpd.shutdown()
 

@@ -1,0 +1,3 @@
+from nydus_snapshotter_tpu.fusedev.session import FuseError, FuseSession, fuse_available
+
+__all__ = ["FuseSession", "FuseError", "fuse_available"]

@@ -19,3 +19,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Unit tests exercise the daemon's API read plane deterministically; real
+# kernel FUSE mounts are covered by tests/test_fusedev.py, which re-enables
+# this in its subprocess daemons.
+os.environ.setdefault("NTPU_DISABLE_FUSE", "1")
