@@ -113,6 +113,33 @@ int64_t ntpu_dict_build(const uint32_t *digests, int64_t n,
   return 0;
 }
 
+// Probe a batch of digests against a built table (same layout as
+// ntpu_dict_build). Writes the stored value-1 (= dict chunk index) per
+// query, or -1 on miss. This is the single-node latency arm of the dedup
+// probe: XLA TPU gathers execute element-serially (~1 µs/element measured
+// on v5e), so host probing wins until the dict is sharded across chips
+// (parallel/sharded_dict.py's all_to_all path).
+void ntpu_dict_probe(const uint32_t *queries, int64_t m,
+                     const uint32_t *keys, const int32_t *values,
+                     int64_t n_shards, int64_t cap, int64_t max_probe,
+                     int64_t *out) {
+  for (int64_t i = 0; i < m; ++i) {
+    const uint32_t *q = queries + i * 8;
+    const uint64_t shard = q[0] % (uint64_t)n_shards;
+    const uint64_t base = q[1] & (uint64_t)(cap - 1);
+    int64_t ans = -1;
+    for (int64_t j = 0; j < max_probe; ++j) {
+      const uint64_t lin = shard * (uint64_t)cap + ((base + j) & (uint64_t)(cap - 1));
+      if (values[lin] == 0) break;  // empty slot terminates the chain
+      if (std::memcmp(keys + lin * 8, q, 32) == 0) {
+        ans = (int64_t)values[lin] - 1;
+        break;
+      }
+    }
+    out[i] = ans;
+  }
+}
+
 // Position-parallel gear hash of every byte position (the same
 // h_i = sum G[x_{i-k}] << k decomposition the TPU kernel uses) — useful
 // for differential testing the device bitmaps from C++.

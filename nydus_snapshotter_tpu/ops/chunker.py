@@ -53,23 +53,21 @@ def _hash_bitmaps_kernel(x: jax.Array, mask_s: jax.Array, mask_l: jax.Array, n: 
 
     x: uint8[B, n + GEAR_WINDOW - 1] (window prefixed by its 31-byte tail)
     returns (uint32[B, n//32], uint32[B, n//32]) for the two masks.
+
+    Gather-free: the gear table value of every byte is computed elementwise
+    (gear.mix32_jnp — TPU VPUs have no per-lane table lookup; the measured
+    gathered variant ran at 0.1 GiB/s on a v5e chip) and the 32-tap window
+    sum runs as 5 log-doubling shifted adds (gear.windowed_gear_sum).
     """
-    table = jnp.asarray(gear.gear_table())
+    h = gear.windowed_gear_sum(gear.mix32_jnp(x))[:, gear.GEAR_WINDOW - 1 :]
+    lanes = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
 
-    def one(row):
-        g = table[row.astype(jnp.int32)]
-        h = jnp.zeros(n, dtype=jnp.uint32)
-        for k in range(gear.GEAR_WINDOW):
-            start = gear.GEAR_WINDOW - 1 - k
-            h = h + (jax.lax.dynamic_slice(g, (start,), (n,)) << np.uint32(k))
-        lanes = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    def pack(bits):
+        return jnp.sum(
+            bits.reshape(-1, n // 32, 32).astype(jnp.uint32) * lanes, axis=-1
+        )
 
-        def pack(bits):
-            return jnp.sum(bits.reshape(n // 32, 32).astype(jnp.uint32) * lanes, axis=-1)
-
-        return pack((h & mask_s) == 0), pack((h & mask_l) == 0)
-
-    return jax.vmap(one)(x)
+    return pack((h & mask_s) == 0), pack((h & mask_l) == 0)
 
 
 def _unpack_positions(words: np.ndarray, valid_len: int) -> np.ndarray:
@@ -176,12 +174,19 @@ class ChunkDigestEngine:
             rows[i, tail_len : tail_len + hi - lo] = arr[lo:hi]
             if lo:
                 rows[i, :tail_len] = arr[lo - tail_len : lo]
-        bm_s, bm_l = _hash_bitmaps_kernel(
-            jnp.asarray(rows),
-            jnp.uint32(self.params.mask_small),
-            jnp.uint32(self.params.mask_large),
-            w,
-        )
+        from nydus_snapshotter_tpu.ops import gear_pallas
+
+        if gear_pallas.supported(w):
+            bm_s, bm_l = gear_pallas.gear_bitmaps(
+                jnp.asarray(rows), self.params.mask_small, self.params.mask_large, w
+            )
+        else:
+            bm_s, bm_l = _hash_bitmaps_kernel(
+                jnp.asarray(rows),
+                jnp.uint32(self.params.mask_small),
+                jnp.uint32(self.params.mask_large),
+                w,
+            )
         bm_s, bm_l = np.asarray(jax.device_get(bm_s)), np.asarray(jax.device_get(bm_l))
         parts_s, parts_l = [], []
         for i in range(n_windows):

@@ -21,7 +21,6 @@ still produce exactly the boundaries the sequential CPU reference produces
 from __future__ import annotations
 
 import functools
-import hashlib
 
 import jax
 import jax.numpy as jnp
@@ -30,21 +29,55 @@ import numpy as np
 # Effective window of a 32-bit gear hash: one byte of history per shift.
 GEAR_WINDOW = 32
 
-_GEAR_SEED = b"nydus-tpu-gear-v1"
+# fmix32 constants (MurmurHash3 finalizer — full avalanche in 5 steps).
+_MIX_C0 = np.uint32(0x9E3779B1)  # golden-ratio odd multiplier, lifts 0..255
+_MIX_C1 = np.uint32(0x85EBCA6B)
+_MIX_C2 = np.uint32(0xC2B2AE35)
+
+
+def mix32_np(x: np.ndarray) -> np.ndarray:
+    """The gear mixing function: uint32 -> uint32, elementwise.
+
+    This IS the table derivation ("gear-v2"): ``gear_table()[b] ==
+    mix32(b)``. It is arithmetic on purpose — TPU vector units have no
+    per-lane table gather, so the device path computes the table value of
+    every byte elementwise (6 VPU ops) while CPU paths (numpy/C++) keep the
+    precomputed 256-entry table with *identical contents*. Cut points stay
+    reproducible across every backend.
+    """
+    x = x.astype(np.uint32)
+    with np.errstate(over="ignore"):
+        x = (x + np.uint32(1)) * _MIX_C0
+        x ^= x >> np.uint32(16)
+        x *= _MIX_C1
+        x ^= x >> np.uint32(13)
+        x *= _MIX_C2
+        x ^= x >> np.uint32(16)
+    return x
+
+
+def mix32_jnp(x: jax.Array) -> jax.Array:
+    """mix32 on device lanes (same math as mix32_np, uint32 wraparound)."""
+    x = x.astype(jnp.uint32)
+    x = (x + np.uint32(1)) * _MIX_C0
+    x = x ^ (x >> np.uint32(16))
+    x = x * _MIX_C1
+    x = x ^ (x >> np.uint32(13))
+    x = x * _MIX_C2
+    x = x ^ (x >> np.uint32(16))
+    return x
 
 
 @functools.cache
 def gear_table() -> np.ndarray:
-    """The 256-entry gear table, deterministically derived from a fixed seed.
+    """The 256-entry gear table: ``table[b] = mix32(b)``.
 
-    Any implementation (numpy, jnp, pallas, C++) regenerates the identical
-    table, so cut points are reproducible across hosts and backends.
+    Derived arithmetically (not from a seed file) so device kernels can
+    compute entries inline instead of gathering; any implementation
+    (numpy, jnp, pallas, C++) regenerates the identical table, so cut
+    points are reproducible across hosts and backends.
     """
-    out = np.empty(256, dtype=np.uint32)
-    for i in range(256):
-        digest = hashlib.sha256(_GEAR_SEED + bytes([i])).digest()
-        out[i] = np.frombuffer(digest[:4], dtype="<u4")[0]
-    return out
+    return mix32_np(np.arange(256, dtype=np.uint32))
 
 
 def gear_hashes_np(data: np.ndarray, prev_tail: np.ndarray | None = None) -> np.ndarray:
@@ -70,22 +103,33 @@ def gear_hashes_np(data: np.ndarray, prev_tail: np.ndarray | None = None) -> np.
     return h
 
 
+def windowed_gear_sum(g: jax.Array) -> jax.Array:
+    """h[i] = sum_{k=0}^{31} g[i-k] << k over the last axis (zeros off the
+    left edge), via log-doubling: S_1 = g, S_2m[i] = S_m[i] + S_m[i-m] << m
+    — 5 shifted-add passes instead of 32 (the window sum is an associative
+    prefix over a fixed 32-tap geometric kernel)."""
+    s = g
+    m = 1
+    while m < GEAR_WINDOW:
+        pad = [(0, 0)] * (s.ndim - 1) + [(m, 0)]
+        shifted = jnp.pad(s, pad)[..., : s.shape[-1]]
+        s = s + (shifted << np.uint32(m))
+        m *= 2
+    return s
+
+
 @functools.partial(jax.jit, static_argnames=("n",))
 def _gear_hashes_jit(x: jax.Array, n: int) -> jax.Array:
-    g = jnp.asarray(gear_table())[x.astype(jnp.int32)]
-    h = jnp.zeros(n, dtype=jnp.uint32)
-    for k in range(GEAR_WINDOW):
-        start = GEAR_WINDOW - 1 - k
-        h = h + (jax.lax.dynamic_slice(g, (start,), (n,)) << np.uint32(k))
-    return h
+    h = windowed_gear_sum(mix32_jnp(x))
+    return jax.lax.dynamic_slice(h, (GEAR_WINDOW - 1,), (n,))
 
 
 def gear_hashes_jax(data, prev_tail=None) -> jax.Array:
     """Device path: hash at every position (uint8[N] -> uint32[N]).
 
-    32 shifted adds + one 256-entry gather; XLA fuses the adds into a few
-    vector passes. Shapes are static per window size, so each window size
-    compiles once.
+    Elementwise mix32 (no gather — TPU VPUs have no per-lane table lookup)
+    followed by the log-doubling windowed sum. Shapes are static per window
+    size, so each window size compiles once.
     """
     data = jnp.asarray(data, dtype=jnp.uint8)
     if prev_tail is None:

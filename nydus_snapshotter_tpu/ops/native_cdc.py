@@ -59,6 +59,14 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # shards, cap, max_probe
                 ctypes.c_void_p, ctypes.c_void_p,  # keys, values
             ]
+        if hasattr(lib, "ntpu_dict_probe"):
+            lib.ntpu_dict_probe.restype = None
+            lib.ntpu_dict_probe.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,  # queries, m
+                ctypes.c_void_p, ctypes.c_void_p,  # keys, values
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # shards, cap, max_probe
+                ctypes.c_void_p,  # out
+            ]
         _lib = lib
         return _lib
 
@@ -119,6 +127,35 @@ def dict_build_native(
         keys.ctypes.data, values.ctypes.data,
     )
     return rc == 0
+
+
+def dict_probe_available() -> bool:
+    lib = load()
+    return lib is not None and hasattr(lib, "ntpu_dict_probe")
+
+
+def dict_probe_native(
+    queries: np.ndarray, keys: np.ndarray, values: np.ndarray,
+    n_shards: int, cap: int, max_probe: int,
+) -> np.ndarray:
+    """Probe u32[M,8] queries against a built table -> i64[M] dict indices
+    (-1 = miss). The single-node latency arm of the dedup probe: XLA TPU
+    gathers are element-serial (~1 µs/element measured), so the host wins
+    until the dict is sharded across chips."""
+    lib = load()
+    if lib is None or not hasattr(lib, "ntpu_dict_probe"):
+        raise RuntimeError("libchunk_engine.so not built or too old")
+    queries = np.ascontiguousarray(queries, dtype=np.uint32)
+    assert keys.dtype == np.uint32 and keys.flags.c_contiguous
+    assert values.dtype == np.int32 and values.flags.c_contiguous
+    out = np.empty(len(queries), dtype=np.int64)
+    lib.ntpu_dict_probe(
+        queries.ctypes.data, len(queries),
+        keys.ctypes.data, values.ctypes.data,
+        n_shards, cap, max_probe,
+        out.ctypes.data,
+    )
+    return out
 
 
 def gear_hashes_native(data: bytes | np.ndarray) -> np.ndarray:

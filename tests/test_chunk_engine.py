@@ -35,10 +35,20 @@ class TestGear:
     def test_table_deterministic(self):
         t = gear.gear_table()
         assert t.shape == (256,) and t.dtype == np.uint32
-        # pinned first entry: regenerating anywhere must give identical cuts
-        assert t[0] == np.frombuffer(
-            hashlib.sha256(b"nydus-tpu-gear-v1\x00").digest()[:4], dtype="<u4"
-        )
+        # pinned entries: gear-v2 is fmix32(b+1); regenerating anywhere
+        # (numpy, C++, device lanes) must give identical cuts
+        def fmix32(x):
+            x = ((x + 1) * 0x9E3779B1) & 0xFFFFFFFF
+            x ^= x >> 16
+            x = (x * 0x85EBCA6B) & 0xFFFFFFFF
+            x ^= x >> 13
+            x = (x * 0xC2B2AE35) & 0xFFFFFFFF
+            x ^= x >> 16
+            return x
+
+        assert t[0] == fmix32(0)
+        assert t[255] == fmix32(255)
+        assert np.array_equal(t, gear.mix32_np(np.arange(256, dtype=np.uint32)))
 
     def test_np_equals_jax(self):
         data = RNG.integers(0, 256, 50_000, dtype=np.uint8)

@@ -170,3 +170,39 @@ class TestBuildBackends:
             [d[:64], d[[5, 2, 19_999, 0]], RNG.integers(0, 2**32, (16, 8), dtype=np.uint32)]
         )
         assert probe_host(k1, v1, q) == probe_host(k2, v2, q)
+
+
+class TestProbeBackends:
+    def test_host_and_device_probes_agree(self, mesh, dict_digests):
+        # The native host probe is the single-node crossover arm of the
+        # same table (XLA gathers are element-serial on TPU); both arms
+        # must answer identically, including duplicate and miss queries.
+        from nydus_snapshotter_tpu.ops import native_cdc
+
+        if not native_cdc.dict_probe_available():
+            pytest.skip("native library not built")
+        sd_dev = ShardedChunkDict(dict_digests, mesh, probe_backend="device")
+        sd_host = ShardedChunkDict(dict_digests, mesh, probe_backend="host")
+        q = np.concatenate(
+            [
+                dict_digests[::211],
+                dict_digests[[7, 7, 7]],
+                RNG.integers(0, 2**32, (33, 8), dtype=np.uint32),
+            ]
+        )
+        a_dev = sd_dev.lookup_u32(q)
+        a_host = sd_host.lookup_u32(q)
+        assert np.array_equal(a_dev, a_host)
+        assert np.array_equal(a_host[: len(dict_digests[::211])], np.arange(0, len(dict_digests), 211))
+
+    def test_auto_uses_host_on_single_shard(self, dict_digests):
+        from nydus_snapshotter_tpu.ops import native_cdc
+
+        if not native_cdc.dict_probe_available():
+            pytest.skip("native library not built")
+        single = mesh_lib.make_mesh(1)
+        sd = ShardedChunkDict(dict_digests, single)
+        assert sd._use_host_probe()
+        assert np.array_equal(
+            sd.lookup_u32(dict_digests[:17]), np.arange(17, dtype=np.int64)
+        )
