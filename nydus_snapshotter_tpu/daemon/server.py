@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
 import os
 import signal
 import socket
@@ -35,6 +36,8 @@ from nydus_snapshotter_tpu.daemon.types import DaemonState, FsMetrics
 from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
 
 __version__ = "0.1.0"
+
+logger = logging.getLogger(__name__)
 
 
 class _Instance:
@@ -218,6 +221,15 @@ class DaemonServer:
                     # Adopt the live kernel session: the mount survived the
                     # previous daemon, reads resume as soon as we attach.
                     inst.start_fuse(self.workdir, fd=fds[idx])
+                elif idx:
+                    # A recorded session fd that did not arrive means the
+                    # kernel mount now has no reader — every access hangs.
+                    # Loud beats silent; the operator must remount.
+                    logger.error(
+                        "takeover state references session fd %d for %s but "
+                        "only %d fds arrived; kernel mount is orphaned",
+                        idx, rec["mountpoint"], len(fds),
+                    )
             self.state = DaemonState.READY
 
     # -- supervisor interaction (SCM_RIGHTS fd passing) ---------------------
@@ -258,7 +270,9 @@ class DaemonServer:
             s.connect(self.supervisor)
             # Announce we want the saved session back.
             s.sendall(b"TAKEOVER")
-            msg, fds, _flags, _addr = socket.recv_fds(s, 1 << 20, 16)
+            # 253 = SCM_MAX_FD (kernel per-message ceiling); matches the
+            # supervisor's receive cap so no session fd is ever truncated.
+            msg, fds, _flags, _addr = socket.recv_fds(s, 1 << 20, 253)
         consumed: set[int] = set()
         try:
             state = msg
@@ -480,8 +494,16 @@ class DaemonServer:
                 raise FileExistsError(mountpoint)
             inst = _Instance(mountpoint, source, config)
             self.instances[mountpoint] = inst
-        # Kernel mount when the environment allows it; API-only otherwise.
-        inst.start_fuse(self.workdir)
+            # Kernel mount when the environment allows it; API-only
+            # otherwise. Under the lock: a concurrent umount() popping the
+            # half-mounted instance would otherwise leave an orphaned kernel
+            # mount no API call can ever tear down. (mount(2) itself is
+            # fast; FUSE INIT is answered async by the serve thread.)
+            try:
+                inst.start_fuse(self.workdir)
+            except Exception:
+                self.instances.pop(mountpoint, None)
+                raise
         self._push_state_async()
 
     def umount(self, mountpoint: str) -> None:

@@ -111,6 +111,7 @@ class FuseSession:
         self._owns_mount = False
         self._thread: Optional[threading.Thread] = None
         self._closed = threading.Event()
+        self._wake_r = self._wake_w = -1
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -142,41 +143,66 @@ class FuseSession:
 
     def _start(self) -> None:
         self._closed.clear()
+        # Self-pipe: close() writes a byte so a serve thread parked in
+        # select() wakes immediately. Closing the session fd alone cannot
+        # interrupt a read that is already blocked in the kernel (and during
+        # handoff the open file description stays alive via the successor's
+        # dup, so a stolen read would silently swallow a request).
+        self._wake_r, self._wake_w = os.pipe()
         self._thread = threading.Thread(
             target=self._serve, name=f"fuse:{self.mountpoint}", daemon=True
         )
         self._thread.start()
 
     def close(self, unmount: bool = True) -> None:
+        """Stop serving; optionally tear down the kernel mount.
+
+        ``unmount=False`` is the handoff mode: the serve thread is stopped
+        *before* the fd is closed, so any request the kernel has queued
+        stays queued for the successor that adopted the fd."""
+        self._closed.set()
+        try:
+            os.write(self._wake_w, b"x")
+        except OSError:
+            pass
+        if self._thread is not None and self._thread is not threading.current_thread():
+            self._thread.join(timeout=2)
         if unmount and self._owns_mount:
             with self._MOUNT_LOCK:
                 _libc().umount2(self.mountpoint.encode(), MNT_DETACH)
-        self._closed.set()
         if self.fd >= 0:
             try:
                 os.close(self.fd)
             except OSError:
                 pass
             self.fd = -1
-        if self._thread is not None and self._thread is not threading.current_thread():
-            self._thread.join(timeout=2)
-
-    def release_fd(self) -> int:
-        """Detach the session fd without closing it (failover handoff):
-        stops serving and returns the fd for SCM_RIGHTS transfer."""
-        fd = self.fd
-        self.fd = -1
-        self._closed.set()
-        return fd
+        for p in (self._wake_r, self._wake_w):
+            try:
+                os.close(p)
+            except OSError:
+                pass
 
     # -- server loop --------------------------------------------------------
 
     def _serve(self) -> None:
+        import select
+
         bufsize = fp.MAX_WRITE + 8192
         while not self._closed.is_set():
             fd = self.fd
             if fd < 0:
                 return
+            try:
+                ready, _, _ = select.select([fd, self._wake_r], [], [])
+            except (OSError, ValueError):
+                return
+            # Re-check before reading: on handoff the pending request must
+            # be left in the kernel queue for the successor, not consumed
+            # by a daemon that can no longer reply.
+            if self._closed.is_set():
+                return
+            if fd not in ready:
+                continue
             try:
                 req = os.read(fd, bufsize)
             except OSError as e:

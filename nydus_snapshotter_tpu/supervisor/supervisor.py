@@ -14,14 +14,21 @@ connection):
 
 from __future__ import annotations
 
+import logging
 import os
 import socket
 import threading
 from typing import Optional
 
+logger = logging.getLogger(__name__)
+
 TAKEOVER_MAGIC = b"TAKEOVER"
 _MAX_STATE = 1 << 22  # 4 MiB of serialized mount state
-_MAX_FDS = 8
+# SCM_RIGHTS receive cap: 1 state memfd + one live FUSE session fd per
+# mounted instance. The kernel silently closes fds beyond the cap, which
+# would strand those kernel mounts with no reader after a failover — so the
+# cap is high and _handle logs when it is hit.
+_MAX_FDS = 253  # SCM_MAX_FD, the kernel's own per-message ceiling
 
 
 class Supervisor:
@@ -77,6 +84,11 @@ class Supervisor:
 
     def _handle(self, conn: socket.socket) -> None:
         msg, fds, _flags, _addr = socket.recv_fds(conn, _MAX_STATE, _MAX_FDS)
+        if len(fds) >= _MAX_FDS:
+            logger.error(
+                "supervisor %s: SCM_RIGHTS message hit the %d-fd cap; "
+                "session fds may have been truncated", self.sock_path, _MAX_FDS,
+            )
         if msg == TAKEOVER_MAGIC and not fds:
             with self._lock:
                 state = self._state or b""

@@ -182,3 +182,41 @@ class TestFuseMount:
                 proc2.wait(timeout=10)
         finally:
             sup.stop()
+
+
+def test_close_wakes_blocked_serve_thread():
+    """close(unmount=False) must stop a serve thread parked waiting for
+    requests BEFORE the fd is closed (handoff mode): a thread still blocked
+    in read would later steal a request meant for the successor and drop it
+    (its _reply no-ops once fd == -1). No /dev/fuse needed — any pollable
+    fd with no data reproduces the parked state."""
+    import threading
+    import time
+
+    from nydus_snapshotter_tpu.fusedev.session import FuseSession
+
+    r, w = os.pipe()
+    try:
+        sess = FuseSession.__new__(FuseSession)
+        sess.ops = None
+        sess.mountpoint = "/nonexistent-test"
+        sess.fd = -1
+        sess._owns_mount = False
+        sess._thread = None
+        sess._closed = threading.Event()
+        sess._wake_r = sess._wake_w = -1
+        sess.fd = r
+        sess._owns_mount = False  # nothing to unmount
+        sess._start()
+        time.sleep(0.1)
+        assert sess._thread.is_alive()
+        t0 = time.time()
+        sess.close(unmount=False)
+        assert time.time() - t0 < 1.5, "close had to wait out the join timeout"
+        assert not sess._thread.is_alive(), "serve thread still parked in read"
+    finally:
+        for fd in (w,):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
