@@ -77,6 +77,26 @@ def _decompress_chunk(data: bytes, flags: int, expect_size: int) -> bytes:
         return zstandard.ZstdDecompressor().decompress(data, max_output_size=max(expect_size, 1))
     if comp == constants.COMPRESSOR_LZ4_BLOCK:
         return lz4.decompress_block(data, expect_size)
+    if comp == constants.COMPRESSOR_GZIP:
+        # estargz chunks are whole gzip members left in place by the index
+        # builder (stargz/index.py) — the lazy read path inflates them here.
+        # The member carries tar padding (and possibly the next entry's
+        # header member), so longer-than-expected output is normal and
+        # truncated; SHORTER output means a corrupt blob.
+        import gzip
+        import zlib
+
+        try:
+            out = gzip.decompress(data)
+        except (OSError, EOFError, zlib.error) as e:
+            raise ConvertError(f"corrupt gzip chunk: {e}") from e
+        if expect_size:
+            if len(out) < expect_size:
+                raise ConvertError(
+                    f"gzip chunk inflated to {len(out)} bytes < expected {expect_size}"
+                )
+            return out[:expect_size]
+        return out
     if comp in (constants.COMPRESSOR_NONE, 0):
         return data
     raise ConvertError(f"unsupported chunk compressor flags {flags:#x}")

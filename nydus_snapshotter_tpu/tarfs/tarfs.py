@@ -312,6 +312,13 @@ class Manager:
         except BaseException as e:
             self._epilog(snapshot_id, blob_id, e,
                          f"prepare tarfs layer for snapshot {snapshot_id}")
+        finally:
+            # Missing this release deadlocked every ref after
+            # max_concurrent_process layers (caught by
+            # tests/test_concurrency_stress.py; reference releases via
+            # defer, tarfs.go:309-333).
+            if limiter is not None:
+                limiter.release()
 
     def _generate_bootstrap(
         self, tar_bytes: bytes, snapshot_id: str, layer_blob_id: str, upper_dir_path: str

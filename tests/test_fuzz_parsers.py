@@ -1,0 +1,222 @@
+"""Corpus-mutation fuzzing of the untrusted-input parsers.
+
+The reference fuzzes its registry fetcher/converter with go-fuzz harnesses
+(pkg/remote/remotes/docker/fetcher_fuzz.go); these parsers consume the same
+classes of untrusted bytes — registry manifests, estargz footers/TOCs, and
+bootstrap/layer blobs that may come from any registry — so every surface
+here must satisfy one contract under arbitrary mutation:
+
+    parse(mutated_bytes) either returns a value or raises ValueError
+    (every parser error class derives from it). Anything else —
+    KeyError, IndexError, struct.error, UnicodeDecodeError, OverflowError,
+    MemoryError from attacker-controlled lengths, or a hang — is a bug.
+
+Mutations are seeded and deterministic: truncations, byte flips, splices,
+length-field inflations, and pure garbage. Small corpora keep this inside
+unit-test time.
+"""
+
+import io
+import json
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu.converter.convert import (
+    bootstrap_from_layer_blob,
+    pack_layer,
+)
+from nydus_snapshotter_tpu.converter.types import PackOption
+from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
+from nydus_snapshotter_tpu.remote.reference import InvalidReference, parse_docker_ref
+from nydus_snapshotter_tpu.remote.registry import Descriptor, parse_www_authenticate
+from nydus_snapshotter_tpu.stargz.index import parse_toc
+from nydus_snapshotter_tpu.stargz.resolver import parse_footer
+
+RNG = np.random.default_rng(0xF12E)
+N_MUTATIONS = 300
+
+
+def build_tar(files):
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for name, data in files:
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+    return buf.getvalue()
+
+
+def mutations(base: bytes, n: int):
+    """Deterministic mutation stream over a valid corpus item."""
+    size = len(base)
+    for i in range(n):
+        arr = bytearray(base)
+        kind = i % 5
+        if kind == 0 and size:  # truncate
+            yield bytes(arr[: int(RNG.integers(0, size))])
+        elif kind == 1 and size:  # flip 1-8 bytes
+            for _ in range(int(RNG.integers(1, 9))):
+                arr[int(RNG.integers(0, size))] = int(RNG.integers(0, 256))
+            yield bytes(arr)
+        elif kind == 2 and size >= 8:  # inflate a length-looking field
+            off = int(RNG.integers(0, size - 8))
+            struct.pack_into("<Q", arr, off, int(RNG.integers(0, 2**63)))
+            yield bytes(arr)
+        elif kind == 3 and size:  # splice a random window elsewhere
+            a, b = sorted(RNG.integers(0, size, 2).tolist())
+            dst = int(RNG.integers(0, size))
+            chunk = arr[a:b]
+            arr[dst : dst + len(chunk)] = chunk
+            yield bytes(arr)
+        else:  # pure garbage of assorted sizes
+            yield RNG.integers(0, 256, int(RNG.integers(0, 4096)), dtype=np.uint8).tobytes()
+
+
+def assert_contract(fn, corpus_item: bytes, n=N_MUTATIONS):
+    for mut in mutations(corpus_item, n):
+        try:
+            fn(mut)
+        except ValueError:
+            pass  # every parser error class derives from ValueError
+        # anything else propagates and fails the test with the mutation's
+        # exception — exactly what we want to see in CI
+
+
+class TestBootstrapFuzz:
+    @pytest.fixture(scope="class")
+    def valid_bootstrap(self):
+        src = build_tar(
+            [("a/big.bin", RNG.integers(0, 256, 200_000, dtype=np.uint8).tobytes()),
+             ("a/s.txt", b"x" * 100)]
+        )
+        _, res = pack_layer(src, PackOption(chunk_size=0x1000))
+        return res.bootstrap
+
+    def test_bootstrap_parse_contract(self, valid_bootstrap):
+        assert_contract(Bootstrap.from_bytes, valid_bootstrap)
+
+    def test_bootstrap_parse_garbage_magics(self):
+        # All-zeros, each known magic with garbage body, huge count fields.
+        for blob in (
+            b"", bytes(64), bytes(8192),
+            b"\x53\x46\x41\x52" + bytes(4096),  # v5 magic-ish
+            bytes(1024) + b"\xe2\xe1\xf5\xe0" + bytes(4096),  # v6 magic at 1024
+        ):
+            try:
+                Bootstrap.from_bytes(blob)
+            except ValueError:
+                pass
+
+    def test_v6_superblock_field_inflation(self):
+        src = build_tar([("f", b"data" * 1000)])
+        _, res = pack_layer(src, PackOption(chunk_size=0x1000))
+        base = bytearray(res.bootstrap)
+        # Hammer the superblock region (first 128 bytes) with giant values:
+        # counts/offsets must be bounds-checked against the actual size, not
+        # trusted into a multi-GiB allocation.
+        for off in range(0, 120, 4):
+            arr = bytearray(base)
+            struct.pack_into("<I", arr, off, 0x7FFFFFFF)
+            try:
+                Bootstrap.from_bytes(bytes(arr))
+            except ValueError:
+                pass
+
+
+class TestLayerBlobFuzz:
+    @pytest.fixture(scope="class")
+    def valid_blob(self):
+        src = build_tar([("x/data", RNG.integers(0, 256, 80_000, dtype=np.uint8).tobytes())])
+        blob, _ = pack_layer(src, PackOption(chunk_size=0x1000))
+        return blob
+
+    def test_layer_blob_contract(self, valid_blob):
+        assert_contract(bootstrap_from_layer_blob, valid_blob)
+
+
+class TestStargzFuzz:
+    @pytest.fixture(scope="class")
+    def valid_footer(self):
+        import gzip
+
+        # estargz footer: gzip member whose extra field is SG + "%016xSTARGZ"
+        payload = b"%016x" % 1234 + b"STARGZ"
+        extra = b"SG" + struct.pack("<H", len(payload)) + payload
+        buf = io.BytesIO()
+        # hand-build: gzip header with FEXTRA
+        buf.write(b"\x1f\x8b\x08\x04\x00\x00\x00\x00\x00\xff")
+        buf.write(struct.pack("<H", len(extra)))
+        buf.write(extra)
+        body = gzip.compress(b"")[10:]
+        buf.write(body)
+        return buf.getvalue()
+
+    def test_footer_never_raises(self, valid_footer):
+        # parse_footer's contract is even stricter: it returns (0, False)
+        # on anything unrecognized and must never raise at all.
+        off, ok = parse_footer(valid_footer)
+        assert ok and off == 1234
+        for mut in mutations(valid_footer, N_MUTATIONS):
+            parse_footer(mut)
+
+    def test_toc_json_contract(self):
+        toc = {
+            "version": 1,
+            "entries": [
+                {"name": "a/", "type": "dir", "mode": 0o755},
+                {"name": "a/f", "type": "reg", "size": 10, "offset": 123,
+                 "chunkSize": 4096, "digest": "sha256:" + "0" * 64},
+                {"name": "a/l", "type": "symlink", "linkName": "f"},
+            ],
+        }
+        base = json.dumps(toc).encode()
+
+        def parse(mut: bytes):
+            try:
+                obj = json.loads(mut)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                return  # upstream rejects non-JSON before parse_toc
+            parse_toc(obj)
+
+        assert_contract(parse, base)
+
+
+class TestRegistryFuzz:
+    def test_descriptor_from_json_contract(self):
+        base = json.dumps(
+            {"mediaType": "application/vnd.oci.image.manifest.v1+json",
+             "digest": "sha256:" + "a" * 64, "size": 1234,
+             "annotations": {"k": "v"}}
+        ).encode()
+
+        def parse(mut: bytes):
+            try:
+                obj = json.loads(mut)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                return
+            if not isinstance(obj, dict):
+                return
+            Descriptor.from_json(obj)
+
+        assert_contract(parse, base)
+
+    def test_www_authenticate_contract(self):
+        base = (
+            'Bearer realm="https://auth.example.com/token",'
+            'service="registry.example.com",scope="repository:lib/img:pull"'
+        )
+        for mut in mutations(base.encode(), N_MUTATIONS):
+            try:
+                parse_www_authenticate(mut.decode("latin-1"))
+            except ValueError:
+                pass
+
+    def test_reference_parse_contract(self):
+        for mut in mutations(b"registry.example.com:5000/ns/img:tag", 200):
+            try:
+                parse_docker_ref(mut.decode("latin-1"))
+            except InvalidReference:
+                pass

@@ -1,0 +1,193 @@
+"""Registry-integration e2e: resolve → lazy pull → stargz/referrer paths.
+
+Echoes the reference's containerd-in-the-loop scenarios
+(/root/reference/integration/entrypoint.sh:39-567) with the in-process OCI
+registry fixture (tests/test_remote.FakeRegistry): every byte a component
+consumes here travelled through real HTTP — token auth, ranged GETs,
+referrers API — not through a handed-in buffer.
+
+Scenarios:
+- estargz lazy pull: footer discovery over Range requests, TOC extract,
+  TOC→bootstrap index build, then byte-exact chunk reads *through the
+  bootstrap* with ranged registry fetches as the backing store (the
+  stargz runtime read path, stargz_adaptor.go:227-264 semantics).
+- referrer detection: companion-image discovery via the referrers API and
+  bootstrap fetch from the referrer manifest.
+- conversion from a registry-pulled OCI layer, mounted and walked through
+  the kernel when FUSE is available (OCI→RAFS→mount, the lazy-pull
+  endgame).
+"""
+
+import io
+import json
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu.converter.convert import (
+    BlobReader,
+    blob_data_from_layer_blob,
+    bootstrap_from_layer_blob,
+    pack_layer,
+)
+from nydus_snapshotter_tpu.converter.types import PackOption
+from nydus_snapshotter_tpu.remote import transport
+from nydus_snapshotter_tpu.remote.remote import Remote
+from nydus_snapshotter_tpu.stargz.index import bootstrap_from_toc
+from nydus_snapshotter_tpu.stargz.resolver import Resolver
+
+from tests.test_remote import FakeRegistry
+from tests.test_stargz import build_estargz
+
+RNG = np.random.default_rng(0xE2E)
+
+
+@pytest.fixture()
+def registry():
+    reg = FakeRegistry(require_auth=True)
+    yield reg
+    reg.close()
+
+
+@pytest.fixture(autouse=True)
+def plain_http(monkeypatch):
+    # The fixture registry speaks plain HTTP on localhost.
+    orig = Remote.__init__
+
+    def patched(self, keychain=None, insecure=False):
+        orig(self, keychain=keychain, insecure=insecure)
+        self.with_plain_http = True
+
+    monkeypatch.setattr(Remote, "__init__", patched)
+
+
+class TestStargzLazyPull:
+    FILES = {
+        "etc/hosts": b"127.0.0.1 localhost\n",
+        "bin/app": RNG.integers(0, 256, 150_000, dtype=np.uint8).tobytes(),
+        "usr/share/doc": b"docs " * 1000,
+    }
+
+    def test_footer_toc_bootstrap_and_ranged_reads(self, registry):
+        raw = build_estargz(self.FILES)
+        digest = registry.add_blob(raw)
+
+        resolver = Resolver(pool=transport.Pool(plain_http=True))
+        ref = f"{registry.host}/lazy/img:latest"
+        blob = resolver.get_blob(ref, digest)
+
+        # Footer discovered over HTTP Range requests only.
+        toc = blob.toc()
+        names = {e["name"].rstrip("/") for e in toc["entries"]}
+        assert names >= set(self.FILES)
+
+        # TOC -> bootstrap, then read every file back THROUGH the bootstrap
+        # with the registry as the backing store (the lazy runtime path).
+        bs = bootstrap_from_toc(
+            toc, blob_id=digest.split(":")[1], blob_compressed_size=len(raw)
+        )
+        by_path = bs.inode_by_path()
+        reader = BlobReader(bs, 0, lambda off, size: blob.read_at(off, size))
+        ranged_before = sum("blobs" in r for r in registry.requests)
+        for name, want in self.FILES.items():
+            ino = by_path["/" + name]
+            got = bytearray()
+            for ch in bs.chunks[ino.chunk_index : ino.chunk_index + ino.chunk_count]:
+                got += reader.chunk_data(ch)
+            assert bytes(got) == want, name
+        assert sum("blobs" in r for r in registry.requests) > ranged_before
+
+    def test_token_auth_was_exercised(self, registry):
+        raw = build_estargz({"f": b"x" * 100})
+        digest = registry.add_blob(raw)
+        resolver = Resolver(pool=transport.Pool(plain_http=True))
+        blob = resolver.get_blob(f"{registry.host}/authed/img:v1", digest)
+        assert blob.toc()["entries"]
+        assert any("/token" in r for r in registry.requests), (
+            "bearer dance never happened"
+        )
+
+
+class TestReferrerPath:
+    def test_detect_and_fetch_metadata(self, registry, tmp_path):
+        from tests.test_referrer import _setup_referrer
+        from nydus_snapshotter_tpu.referrer.referrer import Referrer
+
+        image_digest, layer_digest = _setup_referrer(registry)
+        ref = f"{registry.host}/library/app:latest"
+        r = Referrer()
+        desc = r.check_referrer(ref, image_digest)
+        assert desc.digest == layer_digest
+        out = tmp_path / "image.boot"
+        r.fetch_metadata(ref, desc, str(out))
+        assert out.exists() and out.stat().st_size > 0
+
+
+class TestConvertFromRegistry:
+    def _build_oci_layer(self) -> tuple[bytes, dict[str, bytes]]:
+        files = {
+            "app/main.bin": RNG.integers(0, 256, 300_000, dtype=np.uint8).tobytes(),
+            "app/conf.txt": b"key=value\n",
+        }
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            ti = tarfile.TarInfo("app")
+            ti.type = tarfile.DIRTYPE
+            ti.mode = 0o755
+            tf.addfile(ti)
+            for name, data in files.items():
+                ti = tarfile.TarInfo(name)
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        return buf.getvalue(), files
+
+    def test_pull_convert_read(self, registry, tmp_path):
+        import gzip
+
+        from nydus_snapshotter_tpu.remote.registry import RegistryClient
+
+        layer_tar, files = self._build_oci_layer()
+        compressed = gzip.compress(layer_tar)
+        digest = registry.add_blob(compressed)
+
+        client = RegistryClient(registry.host, plain_http=True)
+        resp = client.fetch_blob("conv/img", digest)
+        pulled = resp.read()
+        resp.close()
+        assert pulled == compressed
+
+        blob, res = pack_layer(
+            gzip.decompress(pulled),
+            PackOption(chunk_size=0x1000, chunking="cdc", backend="hybrid"),
+        )
+        bs = bootstrap_from_layer_blob(blob)
+        assert {i.path for i in bs.inodes} >= {"/app/main.bin", "/app/conf.txt"}
+
+        # Mount through the kernel when the environment allows; otherwise
+        # the converted image is still verified via the parsed model above.
+        from tests.test_fusedev import _probe_fuse_mount, _spawn_daemon
+
+        if not _probe_fuse_mount():
+            pytest.skip("environment cannot mount FUSE")
+        blob_dir = tmp_path / "blobs"
+        blob_dir.mkdir()
+        (blob_dir / res.blob_id).write_bytes(blob_data_from_layer_blob(blob))
+        boot = tmp_path / "image.boot"
+        boot.write_bytes(res.bootstrap)
+        mp = tmp_path / "mnt"
+        mp.mkdir()
+        proc, cli = _spawn_daemon(str(tmp_path), "reg-e2e")
+        try:
+            cfg = json.dumps(
+                {"device": {"backend": {"config": {"blob_dir": str(blob_dir)}}}}
+            )
+            cli.mount(str(mp), str(boot), cfg)
+            for name, want in files.items():
+                with open(os.path.join(mp, name), "rb") as f:
+                    assert f.read() == want, name
+            cli.umount(str(mp))
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)

@@ -72,14 +72,31 @@ def _tar_member(name: str, data: bytes) -> bytes:
     return raw
 
 
+def _tar_header(name: str, size: int) -> bytes:
+    """Just the tar header block(s) for a regular file of ``size`` bytes.
+
+    Built with non-zero filler so _tar_member's end-block stripping can't
+    eat data blocks; the header is whatever precedes the (padded) data."""
+    full = _tar_member(name, b"\xaa" * size)
+    pad = (-size) % 512
+    header = full[: len(full) - size - pad]
+    assert header and len(header) % 512 == 0
+    return header
+
+
 def build_estargz(files: dict[str, bytes], legacy_footer: bool = False) -> bytes:
-    """files: path -> content. One gzip member per file, then TOC, footer."""
+    """files: path -> content, spec-shaped: each regular file's tar HEADER
+    ends one gzip member and its DATA starts a fresh member, so a TOC
+    entry's ``offset`` decompresses straight to file bytes (this is what
+    lets estargz readers serve ranged reads without tar parsing)."""
     out = io.BytesIO()
     entries = [{"name": "", "type": "dir", "mode": 0o755}]
     entries[0]["name"] = "./"
     for name, data in files.items():
-        offset = out.tell()
-        out.write(_gzip_member(_tar_member(name, data)))
+        out.write(_gzip_member(_tar_header(name, len(data))))
+        offset = out.tell()  # data member start — the TOC offset contract
+        pad = (-len(data)) % 512
+        out.write(_gzip_member(data + b"\0" * pad))
         entries.append(
             {
                 "name": name,
