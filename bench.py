@@ -5,18 +5,27 @@ content-defined chunking + SHA-256 chunk digesting + chunk-dict dedup probe
 over a synthetic layer corpus (mixed random/duplicated content, like the
 reference smoke corpus, tests/converter_test.go:177-225).
 
-The engine is a crossover hybrid (SURVEY §7 hard-part #3): native C++
-chunker + host SHA on the latency arm, device kernels on the batch arm; a
-short calibration pass picks the digest backend, and the HBM chunk-dict
-probe always runs on device in one batched launch.
+Engine selection is measured, not assumed (SURVEY §7 hard-part #3):
+
+- **Boundaries**: the Pallas gear-bitmap kernel (ops/gear_pallas.py —
+  gather-free mix32 + log-doubling window sum in VMEM) when a TPU answers,
+  else the native C++ chunker / numpy windowed fallback.
+- **Digests**: host (threaded hashlib) vs device (bucketed uint32-lane
+  SHA-256) raced on a calibration slice; winner takes the corpus.
+- **Dict probe**: native C++ open-addressing probe on a single chip (XLA
+  TPU gathers are element-serial, measured ~1 µs/element), the sharded
+  all_to_all path on multi-chip meshes.
 
 Prints ONE JSON line: metric, value (GiB/s on this chip), unit, vs_baseline
-(fraction of the 2.5 GiB/s per-chip share of the 20 GiB/s v5e-8 target).
+(fraction of the 2.5 GiB/s per-chip share of the 20 GiB/s v5e-8 target),
+and a per-stage breakdown (boundaries / digest / probe wall seconds) so a
+regression is attributable to a stage, not vibes.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -24,10 +33,11 @@ import numpy as np
 
 PER_CHIP_TARGET_GIBPS = 20.0 / 8.0  # north-star 20 GiB/s on a v5e-8
 
-CORPUS_MIB = 192
+CORPUS_MIB = int(os.environ.get("NTPU_BENCH_MIB", "384"))
 CHUNK_SIZE = 0x10000  # 64 KiB average: matches dedup-grade chunking
 N_FILES = 24
 CALIBRATE_MIB = 16
+REPS = 3
 
 
 def build_corpus(total_mib: int, n_files: int) -> list[bytes]:
@@ -62,14 +72,15 @@ print(time.time() - t)
 
 def calibrate_digest_backend(
     engine_cls, chunk_size: int, repo: str
-) -> tuple[str, bool]:
-    """(digest backend, device_executes) — race host vs device digesting on
-    a small slice. The device probe runs in a SUBPROCESS with a hard
-    timeout so a hostile backend (slow compile, wedged device tunnel)
-    degrades to the host arm instead of hanging the bench; the persistent
-    JAX compile cache carries the child's compilation over to this process.
-    ``device_executes`` is False when the probe failed outright (not merely
-    lost the race) — the device must then not be used for anything."""
+) -> tuple[str, bool, dict]:
+    """(digest backend, device_executes, timings) — race host vs device
+    digesting on a small slice. The device probe runs in a SUBPROCESS with
+    a hard timeout so a hostile backend (slow compile, wedged device
+    tunnel) degrades to the host arm instead of hanging the bench; the
+    persistent JAX compile cache carries the child's compilation over to
+    this process. ``device_executes`` is False when the probe failed
+    outright (not merely lost the race) — the device must then not be
+    used for anything."""
     import subprocess
 
     rng = np.random.default_rng(7)
@@ -87,11 +98,12 @@ def calibrate_digest_backend(
             [sys.executable, "-c", child], capture_output=True, text=True, timeout=240,
         )
         if out.returncode != 0:
-            return "host", False
+            return "host", False, {"host_s": round(host_t, 3)}
         dev_t = float(out.stdout.strip().splitlines()[-1])
     except (subprocess.TimeoutExpired, ValueError, IndexError):
-        return "host", False
-    return ("jax" if dev_t < host_t else "host"), True
+        return "host", False, {"host_s": round(host_t, 3)}
+    timings = {"host_s": round(host_t, 3), "device_s": round(dev_t, 3)}
+    return ("jax" if dev_t < host_t else "host"), True, timings
 
 
 def _device_available(repo: str, timeout: float = 120.0) -> bool:
@@ -115,11 +127,10 @@ def _device_available(repo: str, timeout: float = 120.0) -> bool:
 
 
 def main() -> None:
-    import os
-
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/ntpu_jax_cache")
     repo = os.path.dirname(os.path.abspath(__file__))
 
+    from nydus_snapshotter_tpu.ops import native_cdc
     from nydus_snapshotter_tpu.ops.chunker import ChunkDigestEngine
     from nydus_snapshotter_tpu.parallel import mesh as mesh_lib
     from nydus_snapshotter_tpu.parallel.sharded_dict import ShardedChunkDict
@@ -128,8 +139,9 @@ def main() -> None:
     total_bytes = sum(len(f) for f in files)
 
     device_ok = _device_available(repo)
+    cal = {}
     if device_ok:
-        digest_backend, device_ok = calibrate_digest_backend(
+        digest_backend, device_ok, cal = calibrate_digest_backend(
             ChunkDigestEngine, CHUNK_SIZE, repo
         )
     else:
@@ -139,35 +151,105 @@ def main() -> None:
         digest_backend=digest_backend,
     )
 
+    # Boundary backend: Pallas gear kernel when the device answers and the
+    # window shape supports it; else the hybrid native/numpy host arm.
+    gear_kernel = "host-native" if native_cdc.available() else "host-numpy"
+    if device_ok:
+        from nydus_snapshotter_tpu.ops import gear_pallas
+
+        dev_engine = ChunkDigestEngine(
+            chunk_size=CHUNK_SIZE, mode="cdc", backend="jax",
+            digest_backend=digest_backend,
+        )
+        if gear_pallas.supported(dev_engine.window):
+            gear_kernel = "pallas"
+        else:
+            gear_kernel = "xla"
+
     # Build the chunk dict from a warm-up slice and force compilation of
-    # the probe before timing. Device-resident (HBM, one batched launch)
-    # when a device answers; host hash-set otherwise.
+    # the probe before timing. Probe arm: native host table on one chip
+    # (device gathers are element-serial), sharded all_to_all on meshes.
     warm_metas = engine.process_many(build_corpus(CALIBRATE_MIB, 2))
     warm_digest_bytes = b"".join(m.digest for metas in warm_metas for m in metas)
+    dict_digests = (
+        np.frombuffer(warm_digest_bytes, dtype="<u4").reshape(-1, 8)
+        if warm_digest_bytes
+        else np.zeros((0, 8), np.uint32)
+    )
     if device_ok:
-        mesh = mesh_lib.make_mesh(1)
-        dict_digests = np.frombuffer(warm_digest_bytes, dtype="<u4").reshape(-1, 8)
-        sdict = ShardedChunkDict(dict_digests, mesh)
-        sdict.lookup_u32(dict_digests[: max(1, len(dict_digests) // 2)])
+        # Single-shard dict on the chip's mesh; _use_host_probe routes
+        # lookups to the native C++ arm (device gathers are element-serial
+        # on one chip), keeping the device path for real meshes.
+        sdict = ShardedChunkDict(dict_digests, mesh_lib.make_mesh(1))
+        sdict.lookup_digests([warm_digest_bytes[:32]] if warm_digest_bytes else [])
         probe = sdict.lookup_digests
+        probe_arm = "host-native" if sdict._use_host_probe() else "device"
+    elif native_cdc.dict_probe_available():
+        # No device: native table without touching jax backend init (a
+        # wedged tunnel must not hang the bench).
+        from nydus_snapshotter_tpu.parallel.sharded_dict import (
+            MAX_PROBE,
+            _build_host_tables,
+        )
+
+        keys, values = _build_host_tables(dict_digests, 1)
+        probe_arm = "host-native"
+
+        def probe(digests):
+            q = np.frombuffer(b"".join(digests), dtype="<u4").reshape(-1, 8)
+            return native_cdc.dict_probe_native(
+                q, keys.reshape(-1, 8), values.reshape(-1), 1, keys.shape[1], MAX_PROBE
+            )
     else:
-        dict_set = {warm_digest_bytes[i : i + 32] for i in range(0, len(warm_digest_bytes), 32)}
+        dict_set = {warm_digest_bytes[i: i + 32] for i in range(0, len(warm_digest_bytes), 32)}
+        probe_arm = "host-set"
 
         def probe(digests):
             return np.asarray([d in dict_set for d in digests])
 
-    if digest_backend == "jax":
-        # compile the full-corpus global-batch shapes before timing
-        engine.process_many(files)
+    use_device_boundaries = device_ok and gear_kernel in ("pallas", "xla")
+    bench_engine = dev_engine if use_device_boundaries else engine
 
-    t0 = time.time()
-    metas = engine.process_many(files)
-    all_digests = [m.digest for file_metas in metas for m in file_metas]
-    hits = probe(all_digests)  # one batched probe
-    elapsed = time.time() - t0
+    # Warm every compiled shape before timing.
+    bench_engine.process_many(files)
 
-    n_chunks = len(all_digests)
-    gibps = total_bytes / elapsed / (1 << 30)
+    from nydus_snapshotter_tpu.ops import cdc
+
+    best = None
+    for _ in range(REPS):
+        t0 = time.time()
+        t_b0 = time.time()
+        arrs = [np.frombuffer(f, dtype=np.uint8) for f in files]
+        if bench_engine.backend == "hybrid" and len(arrs) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(32, os.cpu_count() or 4)) as pool:
+                all_cuts = list(pool.map(bench_engine.boundaries, arrs))
+        else:
+            all_cuts = [bench_engine.boundaries(a) for a in arrs]
+        t_boundaries = time.time() - t_b0
+
+        t_d0 = time.time()
+        per_file_extents = [cdc.cuts_to_extents(c) for c in all_cuts]
+        all_digests = bench_engine.digest_all(arrs, per_file_extents)
+        t_digest = time.time() - t_d0
+
+        t_p0 = time.time()
+        hits = np.asarray(probe(all_digests))  # one batched probe
+        t_probe = time.time() - t_p0
+        elapsed = time.time() - t0
+        n_hits = int(hits.sum() if hits.dtype == bool else (hits >= 0).sum())
+        if best is None or elapsed < best["elapsed"]:
+            best = {
+                "elapsed": elapsed,
+                "boundaries_s": t_boundaries,
+                "digest_s": t_digest,
+                "probe_s": t_probe,
+                "n_chunks": len(all_digests),
+                "hits": n_hits,
+            }
+
+    gibps = total_bytes / best["elapsed"] / (1 << 30)
     print(
         json.dumps(
             {
@@ -178,11 +260,19 @@ def main() -> None:
                 "detail": {
                     "corpus_mib": CORPUS_MIB,
                     "chunk_size": CHUNK_SIZE,
-                    "n_chunks": n_chunks,
-                    "dict_probes": int(len(hits)),
+                    "n_chunks": best["n_chunks"],
+                    "dict_hits": best["hits"],
                     "digest_backend": digest_backend,
+                    "gear_kernel": gear_kernel,
+                    "probe_arm": probe_arm,
                     "device": device_ok,
-                    "elapsed_s": round(elapsed, 2),
+                    "elapsed_s": round(best["elapsed"], 3),
+                    "stages_s": {
+                        "boundaries": round(best["boundaries_s"], 3),
+                        "digest": round(best["digest_s"], 3),
+                        "probe": round(best["probe_s"], 3),
+                    },
+                    "calibration": cal,
                 },
             }
         )

@@ -242,6 +242,46 @@ class ChunkDigestEngine:
                 out[i] = sha256.digest_to_bytes(states[row])
         return out  # type: ignore[return-value]
 
+    def digest_all(
+        self,
+        arrs: list[np.ndarray],
+        per_file_extents: list[list[tuple[int, int]]],
+    ) -> list[bytes]:
+        """Flat digests for pre-computed per-file extents, in file order.
+
+        One global pass across every file — a single bucketed device batch
+        or one host thread-pool sweep, instead of a tiny batch per file.
+        """
+        if self.digest_backend == "host":
+            return _host_digests(
+                [
+                    (arr, o, s)
+                    for arr, extents in zip(arrs, per_file_extents)
+                    for o, s in extents
+                ]
+            )
+        if self.digest_backend == "numpy":
+            import hashlib
+
+            return [
+                hashlib.sha256(arr[o : o + s].tobytes()).digest()
+                for arr, extents in zip(arrs, per_file_extents)
+                for o, s in extents
+            ]
+        # one global bucketed device batch across every file
+        offsets = []
+        total = 0
+        for arr in arrs:
+            offsets.append(total)
+            total += arr.size
+        joined = np.concatenate(arrs) if len(arrs) > 1 else arrs[0]
+        flat_extents = [
+            (off + o, s)
+            for off, extents in zip(offsets, per_file_extents)
+            for o, s in extents
+        ]
+        return self._digests_bucketed(joined, flat_extents)
+
     def digest_many(self, datas: list[bytes]) -> list[bytes]:
         """Batched digests of pre-delimited chunks (no CDC) — the tarfs /
         index build sources, where boundaries come from the tar layout."""
@@ -298,36 +338,7 @@ class ChunkDigestEngine:
             all_cuts = [self.boundaries(a) for a in arrs]
 
         per_file_extents = [cdc.cuts_to_extents(c) for c in all_cuts]
-        if self.digest_backend == "host":
-            flat = [
-                (arr, o, s)
-                for arr, extents in zip(arrs, per_file_extents)
-                for o, s in extents
-            ]
-            flat_digests = _host_digests(flat)
-        elif self.digest_backend == "numpy":
-            import hashlib
-
-            flat_digests = [
-                hashlib.sha256(arr[o : o + s].tobytes()).digest()
-                for arr, extents in zip(arrs, per_file_extents)
-                for o, s in extents
-            ]
-        else:
-            # one global bucketed device batch across every file
-            offsets = []
-            total = 0
-            for arr in arrs:
-                offsets.append(total)
-                total += arr.size
-            joined = np.concatenate(arrs) if len(arrs) > 1 else arrs[0]
-            flat_extents = [
-                (off + o, s)
-                for off, extents in zip(offsets, per_file_extents)
-                for o, s in extents
-            ]
-            flat_digests = self._digests_bucketed(joined, flat_extents)
-
+        flat_digests = self.digest_all(arrs, per_file_extents)
         out: list[list[ChunkMeta]] = []
         pos = 0
         for extents in per_file_extents:
