@@ -201,7 +201,9 @@ def make_bytes_reader(
 # ---------------------------------------------------------------------------
 
 
-def Pack(dest: BinaryIO, src_tar: BinaryIO | bytes, opt: PackOption) -> PackResult:
+def Pack(
+    dest: BinaryIO, src_tar: BinaryIO | bytes, opt: PackOption, chunk_dict=None
+) -> PackResult:
     """Convert one OCI layer tar into a nydus blob stream written to dest.
 
     Reference semantics (convert_unix.go:325-539): stream in an uncompressed
@@ -213,13 +215,15 @@ def Pack(dest: BinaryIO, src_tar: BinaryIO | bytes, opt: PackOption) -> PackResu
     """
     from nydus_snapshotter_tpu.converter.stream import pack_stream
 
-    return pack_stream(dest, src_tar, opt)
+    return pack_stream(dest, src_tar, opt, chunk_dict=chunk_dict)
 
 
-def pack_layer(src_tar: bytes, opt: PackOption) -> tuple[bytes, PackResult]:
+def pack_layer(
+    src_tar: bytes, opt: PackOption, chunk_dict=None
+) -> tuple[bytes, PackResult]:
     """Convenience: Pack to bytes."""
     out = io.BytesIO()
-    res = Pack(out, src_tar, opt)
+    res = Pack(out, src_tar, opt, chunk_dict=chunk_dict)
     return out.getvalue(), res
 
 
@@ -296,6 +300,7 @@ def bootstrap_from_bootstrap_layer(data: bytes) -> Bootstrap:
 def Merge(
     layers: list[bytes | Bootstrap],
     opt: MergeOption,
+    chunk_dict=None,
 ) -> MergeResult:
     """Merge per-layer bootstraps into one image bootstrap.
 
@@ -303,15 +308,13 @@ def Merge(
     first. Returns the image bootstrap plus the dedup result: the blob ids
     actually referenced (reference Merge surface convert_unix.go:560-666,
     whose blob-digest list comes from merge-output.json,
-    tool/builder.go:278-294).
+    tool/builder.go:278-294). ``chunk_dict`` passes an already-loaded dict
+    object (batch conversion); ``opt.chunk_dict_path`` is the file fallback.
     """
     if not layers:
         raise ConvertError("merge needs at least one layer")
-    chunk_dict = (
-        ChunkDict.from_path(parse_chunk_dict_arg(opt.chunk_dict_path))
-        if opt.chunk_dict_path
-        else None
-    )
+    if chunk_dict is None and opt.chunk_dict_path:
+        chunk_dict = ChunkDict.from_path(parse_chunk_dict_arg(opt.chunk_dict_path))
     parent: Optional[Bootstrap] = None
     if opt.parent_bootstrap_path:
         with open(opt.parent_bootstrap_path, "rb") as f:

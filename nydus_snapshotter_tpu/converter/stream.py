@@ -274,11 +274,15 @@ class _Meta:
     chunks: list[_ChunkRef] = field(default_factory=list)
 
 
-def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption):
+def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, chunk_dict=None):
     """Stream one OCI layer tar into a nydus blob written to ``dest``.
 
     Reference semantics (convert_unix.go:325-539): uncompressed layer tar
     in, tar-like nydus blob out; chunk-dict hits are referenced, not stored.
+    ``chunk_dict`` passes an already-loaded dict object (anything with the
+    ChunkDict get/blob_id_for/bootstrap interface) so batch conversion can
+    reuse one growing dict without re-parsing a bootstrap per layer;
+    ``opt.chunk_dict_path`` is the file-based fallback.
     """
     import io
 
@@ -286,11 +290,8 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption):
     if isinstance(src_tar, (bytes, bytearray)):
         src_tar = io.BytesIO(src_tar)
 
-    chunk_dict = (
-        ChunkDict.from_path(parse_chunk_dict_arg(opt.chunk_dict_path))
-        if opt.chunk_dict_path
-        else None
-    )
+    if chunk_dict is None and opt.chunk_dict_path:
+        chunk_dict = ChunkDict.from_path(parse_chunk_dict_arg(opt.chunk_dict_path))
     from nydus_snapshotter_tpu.converter.convert import _make_compressor
 
     out = _CountingWriter(dest)

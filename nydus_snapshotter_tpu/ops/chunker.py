@@ -242,6 +242,16 @@ class ChunkDigestEngine:
                 out[i] = sha256.digest_to_bytes(states[row])
         return out  # type: ignore[return-value]
 
+    def boundaries_many(self, arrs: list[np.ndarray]) -> list[np.ndarray]:
+        """Per-stream cut offsets for many streams (thread-parallel on the
+        hybrid backend: the native chunker drops the GIL)."""
+        if self.backend == "hybrid" and len(arrs) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(32, _cpu_count())) as pool:
+                return list(pool.map(self.boundaries, arrs))
+        return [self.boundaries(a) for a in arrs]
+
     def digest_all(
         self,
         arrs: list[np.ndarray],
@@ -252,6 +262,8 @@ class ChunkDigestEngine:
         One global pass across every file — a single bucketed device batch
         or one host thread-pool sweep, instead of a tiny batch per file.
         """
+        if not arrs:
+            return []
         if self.digest_backend == "host":
             return _host_digests(
                 [
@@ -329,13 +341,7 @@ class ChunkDigestEngine:
             np.frombuffer(s, dtype=np.uint8) if isinstance(s, (bytes, bytearray)) else s
             for s in streams
         ]
-        if self.backend == "hybrid" and len(arrs) > 1:
-            from concurrent.futures import ThreadPoolExecutor
-
-            with ThreadPoolExecutor(max_workers=min(32, _cpu_count())) as pool:
-                all_cuts = list(pool.map(self.boundaries, arrs))
-        else:
-            all_cuts = [self.boundaries(a) for a in arrs]
+        all_cuts = self.boundaries_many(arrs)
 
         per_file_extents = [cdc.cuts_to_extents(c) for c in all_cuts]
         flat_digests = self.digest_all(arrs, per_file_extents)

@@ -1,0 +1,71 @@
+"""Multi-host batch coordination over DCN (SURVEY §7.7, §2.3).
+
+The reference scales conversion by running independent converters against
+the shared registry (the storage boundary); there is no inter-converter
+state. The TPU rebuild keeps that property: hosts coordinate *membership*
+through ``jax.distributed`` (DCN), partition the image list
+deterministically, and convert their slice against their own growing dict
+(converter/batch.py) — the registry/blob store remains the merge point, so
+no conversion state crosses hosts. ICI collectives stay inside each host's
+mesh (parallel/sharded_dict.py); DCN carries only control.
+
+Everything here is usable without a cluster: ``runtime()`` degrades to a
+single-process view when no coordinator is configured, which is exactly
+how the unit tests drive the partition logic (the reference's tests keep
+all distribution behind the registry boundary the same way, SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+
+@dataclass(frozen=True)
+class HostRuntime:
+    """This process's place in the batch-conversion fleet."""
+
+    index: int
+    count: int
+
+    def shard(self, items: Sequence) -> list:
+        """Deterministic strided partition of ``items`` for this host.
+
+        Strided (not contiguous) so differently-sized images spread evenly;
+        stable for a fixed item order, which callers provide by sorting —
+        every host computes the same global assignment with no exchange.
+        """
+        return list(items[self.index :: self.count])
+
+
+def runtime(
+    coordinator: Optional[str] = None,
+    process_id: Optional[int] = None,
+    num_processes: Optional[int] = None,
+) -> HostRuntime:
+    """Resolve this host's (index, count), initializing jax.distributed
+    when a coordinator is configured (args or JAX_COORDINATOR_ADDRESS /
+    JAX_PROCESS_ID / JAX_NUM_PROCESSES env), else a single-host view.
+    """
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator:
+        import jax
+
+        pid = process_id if process_id is not None else int(os.environ.get("JAX_PROCESS_ID", "0"))
+        n = num_processes if num_processes is not None else int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator, num_processes=n, process_id=pid
+            )
+        except RuntimeError as e:
+            # Only idempotent re-entry is benign. A genuine join failure
+            # (coordinator unreachable, id clash) must NOT degrade to a
+            # (0,1) singleton — that host would silently re-convert the
+            # whole image list and break the deterministic partition.
+            if "already initialized" not in str(e).lower():
+                raise
+        return HostRuntime(index=jax.process_index(), count=jax.process_count())
+    if process_id is not None and num_processes is not None:
+        return HostRuntime(index=process_id, count=num_processes)
+    return HostRuntime(index=0, count=1)
