@@ -291,6 +291,47 @@ class RegistryClient:
             r.close()
         return Descriptor(media_type=media, digest=digest, size=len(body)), body
 
+    def fetch_manifest_oci(
+        self, repo: str, tag_or_digest: str
+    ) -> tuple[Descriptor, dict, Optional[bytes]]:
+        """fetch_manifest with transparent legacy-schema1 conversion.
+
+        Returns (descriptor, manifest dict in OCI shape, synthesized config
+        bytes). The config is None for native v2/OCI manifests (fetch it by
+        digest as usual); for schema1 it is the synthesized config whose
+        digest the converted manifest references (reference
+        schema1/converter.go semantics).
+        """
+        from nydus_snapshotter_tpu.remote import schema1
+
+        desc, body = self.fetch_manifest(repo, tag_or_digest)
+        try:
+            manifest = json.loads(body)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise ValueError(f"manifest {desc.digest} is not JSON: {e}") from e
+        if not isinstance(manifest, dict):
+            raise ValueError(f"manifest {desc.digest} is not an object")
+        # Content-Type alone is unreliable: old registries serve schema1 as
+        # application/json (or no header, which fetch_manifest defaults to
+        # the OCI type) — the body shape is the authority.
+        if schema1.is_schema1(desc.media_type) or schema1.looks_like_schema1(manifest):
+            oci_manifest, config = schema1.convert_schema1(
+                body, lambda d: self.fetch_by_digest(repo, d)
+            )
+            # Signed manifests' registry identity is the signature-stripped
+            # canonical digest; the full-body fallback hash would never
+            # match a later fetch-by-digest.
+            desc = Descriptor(
+                media_type=desc.media_type,
+                digest=schema1.canonical_digest(body),
+                size=desc.size,
+                annotations=desc.annotations,
+                urls=desc.urls,
+                platform=desc.platform,
+            )
+            return desc, oci_manifest, config
+        return desc, manifest, None
+
     def fetch_blob(self, repo: str, digest: str, byte_range: Optional[tuple[int, int]] = None):
         """Streaming blob fetch; ``byte_range`` is an inclusive (start, end)
         pair mapped to an HTTP Range header (stargz range reads)."""

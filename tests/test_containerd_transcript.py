@@ -1,0 +1,134 @@
+"""Containerd wire-transcript: the exact gRPC sequence a CRI lazy pull
+drives through the snapshotter.
+
+The reference proves this surface with containerd+nerdctl in a privileged
+container (integration/entrypoint.sh:39-567); absent a containerd binary,
+this replays the recorded message order containerd emits for a 3-layer
+nydus image pull + container start + removal, over the real gRPC service
+on a real UDS — Stat-miss → Prepare(extract snapshot) per layer bottom-up
+with CRI labels → data layers answered ErrAlreadyExists (the "skip
+download" contract, snapshot/process.go:82-84) → Commit of the meta
+layer → writable container snapshot → Mounts (overlay with nydus
+lowerdir) → teardown in reverse. Message shapes follow
+containerd/snapshots/proxy.go; label keys follow pkg/label/label.go.
+"""
+
+import grpc
+import pytest
+
+from nydus_snapshotter_tpu import constants as C
+from nydus_snapshotter_tpu.api import snapshots_pb2 as pb
+from nydus_snapshotter_tpu.api.client import SnapshotsClient
+from nydus_snapshotter_tpu.api.service import serve
+from nydus_snapshotter_tpu.snapshot.snapshotter import Snapshotter
+
+from tests.test_snapshotter import FakeFs
+
+LAYERS = [
+    # (chain_id, layer digest, is_nydus_data)
+    ("sha256:c1", "sha256:l1", True),
+    ("sha256:c2", "sha256:l2", True),
+    ("sha256:c3", "sha256:l3", False),  # top layer: nydus meta (bootstrap)
+]
+IMAGE_REF = "registry.example.com/library/app:latest"
+
+
+@pytest.fixture
+def rig(tmp_path):
+    fs = FakeFs()
+    sn = Snapshotter(root=str(tmp_path / "root"), fs=fs)
+    sock = str(tmp_path / "grpc.sock")
+    server = serve(sn, sock)
+    client = SnapshotsClient(sock, timeout=10.0)
+    yield client, sn, fs
+    client.close()
+    server.stop(grace=None)
+    sn.close()
+
+
+def cri_labels(chain_id: str, layer_digest: str, data: bool) -> dict:
+    labels = {
+        "containerd.io/snapshot/cri.image-ref": IMAGE_REF,
+        "containerd.io/snapshot/cri.layer-digest": layer_digest,
+        "containerd.io/snapshot/cri.image-layers": ",".join(d for _, d, _ in LAYERS),
+        "containerd.io/snapshot.ref": chain_id,
+    }
+    if data:
+        labels[C.NYDUS_DATA_LAYER] = "true"
+    else:
+        labels[C.NYDUS_META_LAYER] = "true"
+    return labels
+
+
+class TestCriPullTranscript:
+    def test_full_pull_run_remove_sequence(self, rig):
+        client, sn, fs = rig
+
+        # -- image pull: per layer, containerd first Stats the chain id,
+        # then Prepares an extract snapshot with the CRI labels.
+        parent = ""
+        committed = []
+        for chain_id, layer_digest, data in LAYERS:
+            with pytest.raises(grpc.RpcError) as ei:
+                client.stat(chain_id)
+            assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+            key = f"extract-123456 {chain_id}"
+            labels = cri_labels(chain_id, layer_digest, data)
+            if data:
+                # Data layers: the snapshotter commits the placeholder
+                # itself and answers AlreadyExists — containerd skips the
+                # download entirely (lazy pull).
+                with pytest.raises(grpc.RpcError) as ei:
+                    client.prepare(key, parent=parent, labels=labels)
+                assert ei.value.code() == grpc.StatusCode.ALREADY_EXISTS
+            else:
+                mounts = client.prepare(key, parent=parent, labels=labels)
+                assert mounts, "meta layer prepare must return mounts"
+                client.commit(chain_id, key)
+            info = client.stat(chain_id)
+            assert info.name == chain_id
+            assert info.kind == pb.COMMITTED
+            committed.append(chain_id)
+            parent = chain_id
+
+        # -- container start: writable snapshot on the full chain.
+        ctr_key = "default/1/ctr-app"
+        mounts = client.prepare(ctr_key, parent=committed[-1])
+        assert mounts
+        m0 = mounts[0]
+        joined = " ".join([m0.type] + list(m0.options))
+        # The rootfs must be an overlay (or bind on flat chains) whose
+        # options reference the nydus mountpoint the fs facade exposes.
+        assert any(
+            f"/mnt/nydus/" in opt for opt in m0.options
+        ) or m0.source.startswith("/mnt/nydus/"), joined
+        remounts = client.mounts(ctr_key)
+        assert [(m.type, tuple(m.options)) for m in remounts] == [
+            (m.type, tuple(m.options)) for m in mounts
+        ]
+
+        # -- kubelet stats the running container's usage.
+        u = client.usage(ctr_key)
+        assert u.size >= 0
+
+        # -- teardown: container snapshot first, then layers top-down
+        # (containerd's GC order).
+        client.remove(ctr_key)
+        for chain_id in reversed(committed):
+            client.remove(chain_id)
+        with pytest.raises(grpc.RpcError) as ei:
+            client.stat(committed[0])
+        assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+        # async-remove semantics (reference snapshot.go:590-658): the rafs
+        # umount happens when containerd issues the Cleanup RPC.
+        client.cleanup()
+        assert not fs.mounted
+
+    def test_walk_matches_containerd_list_semantics(self, rig):
+        client, sn, fs = rig
+        client.prepare("extract-1 sha256:x", labels=cri_labels("sha256:x", "sha256:lx", False))
+        client.commit("sha256:x", "extract-1 sha256:x")
+        client.prepare("active-1", parent="sha256:x")
+        names = {i.name for i in client.list()}
+        assert {"sha256:x", "active-1"} <= names
