@@ -316,14 +316,14 @@ class RegistryClient:
         # the OCI type) — the body shape is the authority.
         if schema1.is_schema1(desc.media_type) or schema1.looks_like_schema1(manifest):
             oci_manifest, config = schema1.convert_schema1(
-                body, lambda d: self.fetch_by_digest(repo, d)
+                body, lambda d: self.fetch_by_digest(repo, d), parsed=manifest
             )
             # Signed manifests' registry identity is the signature-stripped
             # canonical digest; the full-body fallback hash would never
             # match a later fetch-by-digest.
             desc = Descriptor(
                 media_type=desc.media_type,
-                digest=schema1.canonical_digest(body),
+                digest=schema1.canonical_digest(body, parsed=manifest),
                 size=desc.size,
                 annotations=desc.annotations,
                 urls=desc.urls,

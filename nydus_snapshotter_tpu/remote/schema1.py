@@ -57,19 +57,22 @@ def _b64url(data: str) -> bytes:
         raise Schema1Error(f"bad JWS base64: {e}") from e
 
 
-def canonical_digest(body: bytes) -> str:
+def canonical_digest(body: bytes, parsed: dict | None = None) -> str:
     """The registry-canonical digest of a schema1 manifest body.
 
     Signed (+prettyjws) manifests are digested over the JWS payload with
     signatures stripped — ``body[:formatLength] + formatTail`` from the
     first signature's protected header (docker/libtrust semantics; the
     reference inherits this via containerd's schema1 DigestFromManifest).
-    Unsigned bodies digest as-is.
+    Unsigned bodies digest as-is. ``parsed`` passes an already-loaded body.
     """
-    try:
-        m = json.loads(body)
-    except (json.JSONDecodeError, UnicodeDecodeError):
-        m = None
+    if parsed is not None:
+        m = parsed
+    else:
+        try:
+            m = json.loads(body)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            m = None
     sigs = m.get("signatures") if isinstance(m, dict) else None
     if isinstance(sigs, list) and sigs and isinstance(sigs[0], dict):
         protected_b64 = sigs[0].get("protected")
@@ -103,19 +106,25 @@ def _decompress_layer(blob: bytes) -> bytes:
 
 
 def convert_schema1(
-    body: bytes, fetch_blob: Callable[[str], bytes]
+    body: bytes, fetch_blob: Callable[[str], bytes], parsed: dict | None = None
 ) -> tuple[dict, bytes]:
     """Convert a schema1 manifest body into (OCI manifest dict, config bytes).
 
     ``fetch_blob(digest)`` must return the raw layer blob — needed to
-    compute diff_ids for the synthesized config. Signed (+prettyjws)
-    manifests are accepted; signatures are not verified (parity with the
-    reference converter, which relies on digest pinning instead).
+    compute diff_ids for the synthesized config; each fetched blob is
+    verified against its blobSum before its hash enters the synthesized
+    manifest (the reference gets the same guarantee from content-store
+    ingest). Signed (+prettyjws) manifests are accepted; signatures are not
+    verified (parity with the reference converter, which relies on digest
+    pinning instead). ``parsed`` passes an already-json.loads'd body.
     """
-    try:
-        m = json.loads(body)
-    except (json.JSONDecodeError, UnicodeDecodeError) as e:
-        raise Schema1Error(f"schema1 manifest is not JSON: {e}") from e
+    if parsed is not None:
+        m = parsed
+    else:
+        try:
+            m = json.loads(body)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise Schema1Error(f"schema1 manifest is not JSON: {e}") from e
     if not isinstance(m, dict):
         raise Schema1Error("schema1 manifest is not an object")
     if m.get("schemaVersion") != 1:
@@ -168,6 +177,12 @@ def convert_schema1(
             raise Schema1Error("schema1 fsLayer missing blobSum")
         if digest not in seen:
             blob = fetch_blob(digest)
+            actual = "sha256:" + hashlib.sha256(blob).hexdigest()
+            if digest.startswith("sha256:") and actual != digest:
+                raise Schema1Error(
+                    f"layer blob digest mismatch: manifest says {digest}, "
+                    f"fetched {actual}"
+                )
             seen[digest] = (
                 len(blob),
                 "sha256:" + hashlib.sha256(_decompress_layer(blob)).hexdigest(),

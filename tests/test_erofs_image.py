@@ -1,0 +1,264 @@
+"""EROFS image writer: the kernel is the format oracle.
+
+The produced image is loop-attached and mounted with the in-kernel erofs
+driver (the reference's blockdev path, pkg/utils/erofs/erofs.go:18-47 +
+pkg/tarfs loop attach :754), then walked byte-for-byte. Pure-python
+structural assertions run everywhere; the mount tests skip where loop
+devices / mount(2) are unavailable.
+"""
+
+import ctypes
+import os
+import stat as statmod
+import struct
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu.models.erofs_image import (
+    BLKSZ,
+    EROFS_MAGIC,
+    ErofsError,
+    build_erofs,
+)
+from nydus_snapshotter_tpu.models.fstree import FileEntry
+
+RNG = np.random.default_rng(0xE20F5)
+
+
+def entry(path, mode=0o644, data=b"", **kw):
+    return FileEntry(path=path, mode=mode, data=data, **kw)
+
+
+def sample_entries():
+    big = RNG.integers(0, 256, 150_000, dtype=np.uint8).tobytes()
+    return [
+        entry("/etc", statmod.S_IFDIR | 0o755),
+        entry("/etc/hosts", statmod.S_IFREG | 0o644, b"127.0.0.1 localhost\n"),
+        entry("/etc/empty", statmod.S_IFREG | 0o600, b""),
+        entry("/bin", statmod.S_IFDIR | 0o755),
+        entry("/bin/app", statmod.S_IFREG | 0o755, big),
+        entry("/bin/link", statmod.S_IFLNK | 0o777, symlink_target="app"),
+        entry("/bin/hard", statmod.S_IFREG | 0o755, hardlink_target="/bin/app"),
+        entry("/deep", statmod.S_IFDIR | 0o755),
+        entry("/deep/a", statmod.S_IFDIR | 0o755),
+        entry("/deep/a/b", statmod.S_IFDIR | 0o755),
+        entry("/deep/a/b/leaf", statmod.S_IFREG | 0o644, b"leaf-data"),
+    ], big
+
+
+class TestStructure:
+    def test_superblock_fields(self):
+        entries, _ = sample_entries()
+        img = build_erofs(entries)
+        assert len(img) % BLKSZ == 0
+        magic, _cs, _fc, blkszbits = struct.unpack_from("<IIIB", img, 1024)
+        assert magic == EROFS_MAGIC
+        assert blkszbits == 12
+        # pkg/layout's v6 detection must recognize it
+        from nydus_snapshotter_tpu.models import layout
+
+        assert layout.detect_fs_version(img) == layout.RAFS_V6
+
+    def test_many_files_multiblock_dir(self):
+        entries = [entry("/d", statmod.S_IFDIR | 0o755)] + [
+            entry(f"/d/file-{i:04d}", statmod.S_IFREG | 0o644, bytes([i % 256]) * 10)
+            for i in range(600)  # > one 4K dirent block
+        ]
+        img = build_erofs(entries)
+        assert len(img) % BLKSZ == 0
+
+    def test_hardlink_to_missing_target_rejected(self):
+        with pytest.raises(ErofsError):
+            build_erofs([entry("/x", statmod.S_IFREG | 0o644, hardlink_target="/gone")])
+
+    def test_long_name_rejected(self):
+        with pytest.raises(ErofsError):
+            build_erofs([entry("/" + "n" * 300, statmod.S_IFREG | 0o644)])
+
+
+def _mount_available() -> bool:
+    if os.geteuid() != 0 or not os.path.exists("/dev/loop-control"):
+        return False
+    try:
+        with open("/proc/filesystems") as f:
+            return "\terofs" in f.read()
+    except OSError:
+        return False
+
+
+requires_erofs = pytest.mark.skipif(
+    not _mount_available(), reason="need root + loop devices + erofs kernel driver"
+)
+
+
+class _Mounted:
+    """losetup + mount -t erofs via util-linux (what the reference shells
+    into through pkg/tarfs attachLoopdev + erofs.Mount)."""
+
+    def __init__(self, image_path: str, mountpoint: str):
+        self.image_path = image_path
+        self.mountpoint = mountpoint
+        self.loop = None
+
+    def __enter__(self):
+        out = subprocess.run(
+            ["losetup", "--find", "--show", "--read-only", self.image_path],
+            capture_output=True, text=True, check=True,
+        )
+        self.loop = out.stdout.strip()
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        rc = libc.mount(
+            self.loop.encode(), self.mountpoint.encode(), b"erofs", 1, b""
+        )
+        if rc != 0:
+            err = os.strerror(ctypes.get_errno())
+            subprocess.run(["losetup", "-d", self.loop], check=False)
+            raise RuntimeError(f"mount -t erofs failed: {err}")
+        return self
+
+    def __exit__(self, *exc):
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        libc.umount2(self.mountpoint.encode(), 2)
+        if self.loop:
+            subprocess.run(["losetup", "-d", self.loop], check=False)
+
+
+@requires_erofs
+class TestKernelMount:
+    def test_mount_walk_byte_for_byte(self, tmp_path):
+        entries, big = sample_entries()
+        img = build_erofs(entries)
+        image_path = str(tmp_path / "img.erofs")
+        with open(image_path, "wb") as f:
+            f.write(img)
+        mp = str(tmp_path / "mnt")
+        os.mkdir(mp)
+        with _Mounted(image_path, mp):
+            with open(os.path.join(mp, "etc/hosts"), "rb") as f:
+                assert f.read() == b"127.0.0.1 localhost\n"
+            with open(os.path.join(mp, "bin/app"), "rb") as f:
+                assert f.read() == big
+            with open(os.path.join(mp, "bin/app"), "rb") as f:
+                f.seek(70_000)
+                assert f.read(100) == big[70_000:70_100]
+            assert os.readlink(os.path.join(mp, "bin/link")) == "app"
+            with open(os.path.join(mp, "bin/hard"), "rb") as f:
+                assert f.read() == big
+            st = os.stat(os.path.join(mp, "bin/app"))
+            assert st.st_nlink == 2  # hardlink counted
+            assert st.st_mode & 0o777 == 0o755
+            assert os.stat(os.path.join(mp, "etc/empty")).st_size == 0
+            with open(os.path.join(mp, "deep/a/b/leaf"), "rb") as f:
+                assert f.read() == b"leaf-data"
+            assert sorted(os.listdir(os.path.join(mp, "bin"))) == [
+                "app", "hard", "link",
+            ]
+            assert sorted(os.listdir(mp)) == ["bin", "deep", "etc"]
+
+    def test_mount_600_entry_directory(self, tmp_path):
+        n = 600
+        entries = [entry("/d", statmod.S_IFDIR | 0o755)] + [
+            entry(f"/d/file-{i:04d}", statmod.S_IFREG | 0o644, b"%d" % i)
+            for i in range(n)
+        ]
+        img = build_erofs(entries)
+        image_path = str(tmp_path / "big.erofs")
+        with open(image_path, "wb") as f:
+            f.write(img)
+        mp = str(tmp_path / "mnt")
+        os.mkdir(mp)
+        with _Mounted(image_path, mp):
+            names = os.listdir(os.path.join(mp, "d"))
+            assert len(names) == n
+            # lookups hit the kernel's binary search across dirent blocks
+            for i in (0, 1, 299, 300, 598, 599):
+                with open(os.path.join(mp, "d", f"file-{i:04d}"), "rb") as f:
+                    assert f.read() == b"%d" % i
+            assert not os.path.exists(os.path.join(mp, "d", "file-9999"))
+
+    def test_converted_layer_to_erofs_mount(self, tmp_path):
+        """OCI tar -> pack -> unpack tree -> EROFS image -> kernel mount:
+        the blockdev-mode endgame without any external builder."""
+        import io
+        import tarfile
+
+        from nydus_snapshotter_tpu.converter.convert import (
+            blob_data_from_layer_blob,
+            bootstrap_from_layer_blob,
+            make_bytes_reader,
+            pack_layer,
+        )
+        from nydus_snapshotter_tpu.converter.types import PackOption
+
+        payload = RNG.integers(0, 256, 90_000, dtype=np.uint8).tobytes()
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            ti = tarfile.TarInfo("app")
+            ti.type = tarfile.DIRTYPE
+            tf.addfile(ti)
+            ti = tarfile.TarInfo("app/data.bin")
+            ti.size = len(payload)
+            tf.addfile(ti, io.BytesIO(payload))
+        blob, res = pack_layer(buf.getvalue(), PackOption(chunk_size=0x1000))
+        bs = bootstrap_from_layer_blob(blob)
+        reader = make_bytes_reader(bs, 0, blob_data_from_layer_blob(blob))
+
+        from nydus_snapshotter_tpu.models import fstree
+
+        entries = []
+        for inode in bs.inodes:
+            data = b""
+            if statmod.S_ISREG(inode.mode) and inode.chunk_count and not inode.hardlink_target:
+                data = b"".join(
+                    reader.chunk_data(c)
+                    for c in bs.chunks[
+                        inode.chunk_index : inode.chunk_index + inode.chunk_count
+                    ]
+                )
+            entries.append(fstree.inode_to_entry(inode, data))
+        img = build_erofs(entries)
+        image_path = str(tmp_path / "layer.erofs")
+        with open(image_path, "wb") as f:
+            f.write(img)
+        mp = str(tmp_path / "mnt")
+        os.mkdir(mp)
+        with _Mounted(image_path, mp):
+            with open(os.path.join(mp, "app/data.bin"), "rb") as f:
+                assert f.read() == payload
+
+
+class TestHardlinkChains:
+    def test_chained_hardlink_resolves_to_real_inode(self):
+        entries = [
+            entry("/c", statmod.S_IFREG | 0o644, b"real-data"),
+            entry("/b", statmod.S_IFREG | 0o644, hardlink_target="/c"),
+            entry("/a", statmod.S_IFREG | 0o644, hardlink_target="/b"),
+        ]
+        img = build_erofs(entries)  # must not point /a at nid 0
+        if _mount_available():
+            with tempfile.TemporaryDirectory() as d:
+                image_path = os.path.join(d, "img")
+                with open(image_path, "wb") as f:
+                    f.write(img)
+                mp = os.path.join(d, "mnt")
+                os.mkdir(mp)
+                with _Mounted(image_path, mp):
+                    for name in ("a", "b", "c"):
+                        with open(os.path.join(mp, name), "rb") as f:
+                            assert f.read() == b"real-data", name
+                    assert os.stat(os.path.join(mp, "c")).st_nlink == 3
+
+    def test_hardlink_cycle_rejected(self):
+        entries = [
+            entry("/a", statmod.S_IFREG | 0o644, hardlink_target="/b"),
+            entry("/b", statmod.S_IFREG | 0o644, hardlink_target="/a"),
+        ]
+        with pytest.raises(ErofsError):
+            build_erofs(entries)
+
+    def test_oversized_metadata_rejected(self):
+        with pytest.raises(ErofsError):
+            build_erofs([entry("/u", statmod.S_IFREG | 0o644, uid=70_000)])

@@ -275,3 +275,14 @@ class TestCanonicalDigest:
         manifest, _ = convert_schema1(body, fetch)
         assert len(manifest["layers"]) == 3
         assert calls == [digest], "duplicate blobSum must fetch once"
+
+
+def test_layer_digest_mismatch_rejected():
+    g0, _ = mk_layer({"a": b"x"})
+    body, blobs = mk_schema1([g0])
+
+    def evil_fetch(d):
+        return b"not the right bytes"
+
+    with pytest.raises(Schema1Error):
+        convert_schema1(body, evil_fetch)
