@@ -5,25 +5,34 @@ The reference's blockdev/tarfs modes hand the kernel a *real* EROFS image
 produced by ``nydus-image export --block`` (invoked at
 pkg/tarfs/tarfs.go:525-541, mounted with ``mount -t erofs`` at :573-662 via
 pkg/utils/erofs). This module is the native equivalent: it serializes a
-file tree into the EROFS on-disk format (uncompressed, compact inodes,
-flat-plain data) that the in-kernel erofs driver mounts directly — no
-external mkfs.erofs, no FUSE in the read path. The kernel is the format
-oracle: tests loop-attach the produced image, mount it, and compare the
-tree byte-for-byte.
+file tree into the EROFS on-disk format that the in-kernel erofs driver
+mounts directly — no external mkfs.erofs, no FUSE in the read path. The
+kernel is the format oracle: tests loop-attach the produced images, mount
+them, and compare the tree byte-for-byte.
+
+Two shapes:
+- **Self-contained** (``build_erofs``): file data inline in the image,
+  FLAT_PLAIN layout — the blockdev whole-image export.
+- **Chunk-based with a device table** (``chunk_map`` + ``device``): regular
+  files become CHUNK_BASED inodes whose 8-byte chunk indexes point into an
+  *external blob device* (the uncompressed layer tar, loop-attached and
+  passed to the kernel via ``mount -o device=``). This is the tarfs shape:
+  the tar IS the data plane, the EROFS image holds only metadata — chunk
+  reads go straight from the kernel to the tar with zero copies. Tar file
+  data is 512-aligned, so these images use 512-byte blocks (sub-page block
+  support, Linux 6.3+).
 
 Format notes (Linux fs/erofs/erofs_fs.h):
-- 4 KiB blocks; superblock at offset 1024 (magic 0xE0F5E1E2 — the same
-  magic pkg/layout detects at that offset).
+- Superblock at offset 1024 (magic 0xE0F5E1E2 — the same magic pkg/layout
+  detects at that offset).
 - Compact (32-byte) inodes in a metadata area starting at
-  ``meta_blkaddr``; an inode's nid is its 32-byte slot index.
-- FLAT_PLAIN data layout everywhere: file/dir/symlink bytes live in whole
-  blocks at ``raw_blkaddr``; the tail block is zero-padded on disk.
+  ``meta_blkaddr``; an inode's nid is its 32-byte slot index. Chunk
+  indexes follow their inode in the slot array.
 - Directories are arrays of 12-byte dirents per block, names packed after
   the dirent array, entries sorted bytewise (the kernel binary-searches,
   both across blocks by first-name and within a block).
-- No xattrs/compression/chunk inodes yet: feature_compat = 0 keeps the
-  checksum optional, feature_incompat = 0 keeps every consumer kernel
-  compatible.
+- No xattrs/compression: feature_compat = 0 keeps the checksum optional;
+  feature_incompat carries only CHUNKED_FILE|DEVICE_TABLE when used.
 """
 
 from __future__ import annotations
@@ -42,8 +51,16 @@ BLKSZBITS = 12
 EROFS_MAGIC = 0xE0F5E1E2
 SB_OFFSET = 1024
 
-# i_format: bit0 = 0 (compact inode), datalayout in bits 1..3
+# datalayout values (i_format bits 1..3; bit 0 = 0 for compact inodes)
 _LAYOUT_FLAT_PLAIN = 0
+_LAYOUT_CHUNK_BASED = 4
+
+_CHUNK_FORMAT_INDEXES = 0x0020
+_FEATURE_INCOMPAT_CHUNKED_FILE = 0x00000004
+_FEATURE_INCOMPAT_DEVICE_TABLE = 0x00000008
+
+_DEVT_SLOT_SIZE = 128
+_DEVT_SLOTOFF = (SB_OFFSET + 128) // _DEVT_SLOT_SIZE  # right after the sb
 
 _FT_OF_MODE = [
     (statmod.S_ISREG, 1),
@@ -59,10 +76,22 @@ _SB = struct.Struct("<IIIBBHQQIIII16s16sIHHHBBIQB23s")
 assert _SB.size == 128, _SB.size
 _INODE_COMPACT = struct.Struct("<HHHHIIIIHHI")
 _DIRENT = struct.Struct("<QHBB")
+_CHUNK_INDEX = struct.Struct("<HHI")  # advise, device_id, blkaddr
+_DEVICE_SLOT = struct.Struct("<64sII56s")
+assert _DEVICE_SLOT.size == _DEVT_SLOT_SIZE
 
 
 class ErofsError(ValueError):
     pass
+
+
+@dataclass(frozen=True)
+class ChunkedData:
+    """External-device extents for one regular file (tarfs shape)."""
+
+    size: int
+    chunk_size: int  # power of two, >= block size
+    offsets: list[int]  # byte offset of each chunk on the blob device
 
 
 def _file_type(mode: int) -> int:
@@ -79,12 +108,20 @@ class _Node:
     ino: int = 0
     nlink: int = 1
     data: bytes = b""
+    size: int = 0
     raw_blkaddr: int = 0
+    chunked: Optional[ChunkedData] = None
     children: dict[bytes, "_Node"] = field(default_factory=dict)
     parent: Optional["_Node"] = None
 
+    def slots(self) -> int:
+        if self.chunked is None:
+            return 1
+        idx_bytes = _CHUNK_INDEX.size * len(self.chunked.offsets)
+        return 1 + -(-idx_bytes // _INODE_COMPACT.size)
 
-def _build_tree(entries: list[FileEntry]) -> _Node:
+
+def _build_tree(entries: list[FileEntry]) -> tuple[_Node, dict[str, "_Node"]]:
     root_entry = FileEntry(path="/", mode=statmod.S_IFDIR | 0o755)
     root = _Node(entry=root_entry)
     by_path: dict[str, _Node] = {"/": root}
@@ -118,10 +155,10 @@ def _build_tree(entries: list[FileEntry]) -> _Node:
         node.parent = parent
         parent.children[name.encode()] = node
         by_path[e.path] = node
-    return root
+    return root, by_path
 
 
-def _dir_blocks(node: _Node, nid_of: dict[int, int]) -> bytes:
+def _dir_blocks(node: _Node, nid_of: dict[int, int], blksz: int) -> bytes:
     """Serialize one directory's dirent blocks (kernel-sorted)."""
     items: list[tuple[bytes, int, int]] = [
         (b".", id(node), _file_type(node.entry.mode)),
@@ -131,21 +168,23 @@ def _dir_blocks(node: _Node, nid_of: dict[int, int]) -> bytes:
         items.append((name, id(child), _file_type(child.entry.mode)))
     items.sort(key=lambda t: t[0])
 
-    blocks: list[tuple[list[tuple[bytes, int, int]], int]] = []
+    blocks: list[list[tuple[bytes, int, int]]] = []
     cur: list[tuple[bytes, int, int]] = []
     used = 0
     for name, key, ft in items:
         cost = _DIRENT.size + len(name)
-        if cur and used + cost > BLKSZ:
-            blocks.append((cur, used))
+        if cost > blksz:
+            raise ErofsError(f"dirent {name!r} exceeds block size {blksz}")
+        if cur and used + cost > blksz:
+            blocks.append(cur)
             cur, used = [], 0
         cur.append((name, key, ft))
         used += cost
     if cur:
-        blocks.append((cur, used))
+        blocks.append(cur)
 
     out = io.BytesIO()
-    for i, (ents, used) in enumerate(blocks):
+    for i, ents in enumerate(blocks):
         base = out.tell()
         nameoff = len(ents) * _DIRENT.size
         names = io.BytesIO()
@@ -154,28 +193,37 @@ def _dir_blocks(node: _Node, nid_of: dict[int, int]) -> bytes:
             names.write(name)
         out.write(names.getvalue())
         if i < len(blocks) - 1:
-            out.write(b"\0" * (base + BLKSZ - out.tell()))
+            out.write(b"\0" * (base + blksz - out.tell()))
     return out.getvalue()
 
 
-def build_erofs(entries: list[FileEntry], volume_name: bytes = b"ntpu-erofs") -> bytes:
+def build_erofs(
+    entries: list[FileEntry],
+    volume_name: bytes = b"ntpu-erofs",
+    blkszbits: int = BLKSZBITS,
+    chunk_map: Optional[dict[str, ChunkedData]] = None,
+    device: Optional[tuple[bytes, int]] = None,
+) -> bytes:
     """Serialize ``entries`` into a mountable EROFS image.
 
     Hardlinks (``entry.hardlink_target``) share the target's inode and bump
     its nlink. Whiteouts are callers' business (overlay semantics live a
     layer up); xattrs are not yet emitted.
+
+    ``chunk_map`` maps paths of regular files to external-device extents
+    (CHUNK_BASED inodes, data read from the blob device); ``device`` is the
+    (tag, size_bytes) of that blob device, passed to the kernel at mount
+    time via ``-o device=``. Chunk offsets must be block-aligned — tarfs
+    callers use ``blkszbits=9`` so 512-aligned tar data qualifies.
     """
-    root = _build_tree(entries)
+    chunk_map = chunk_map or {}
+    if chunk_map and device is None:
+        raise ErofsError("chunk_map requires a blob device")
+    if not 9 <= blkszbits <= 12:
+        raise ErofsError(f"blkszbits {blkszbits} outside the supported 9..12")
+    blksz = 1 << blkszbits
 
-    # Resolve hardlinks to their target node.
-    by_path: dict[str, _Node] = {}
-
-    def index(node: _Node):
-        by_path[node.entry.path] = node
-        for child in node.children.values():
-            index(child)
-
-    index(root)
+    root, by_path = _build_tree(entries)
     alias_of: dict[int, _Node] = {}
     order: list[_Node] = []
 
@@ -216,11 +264,49 @@ def build_erofs(entries: list[FileEntry], volume_name: bytes = b"ntpu-erofs") ->
                 1 for c in node.children.values() if statmod.S_ISDIR(c.entry.mode)
             )
 
-    # Assign nids: compact inodes are 32 bytes; slot index == nid.
-    meta_blkaddr = 1
-    for i, node in enumerate(real_nodes):
-        node.nid = i
-        node.ino = i + 1
+    # Attach chunked extents and validate them.
+    for node in real_nodes:
+        cd = chunk_map.get(node.entry.path)
+        if cd is None:
+            continue
+        if not statmod.S_ISREG(node.entry.mode):
+            raise ErofsError(f"chunk_map path {node.entry.path} is not a regular file")
+        if cd.chunk_size < blksz or cd.chunk_size & (cd.chunk_size - 1):
+            raise ErofsError(
+                f"chunk size {cd.chunk_size:#x} must be a power of two >= {blksz}"
+            )
+        expected = max(0, -(-cd.size // cd.chunk_size))
+        if len(cd.offsets) != expected:
+            raise ErofsError(
+                f"{node.entry.path}: {len(cd.offsets)} chunk offsets for "
+                f"size {cd.size} (expected {expected})"
+            )
+        dev_size = device[1] if device else 0
+        for k, off in enumerate(cd.offsets):
+            if off % blksz:
+                raise ErofsError(
+                    f"{node.entry.path}: chunk offset {off:#x} not {blksz}-aligned"
+                )
+            extent = min(cd.chunk_size, cd.size - k * cd.chunk_size)
+            if off + extent > dev_size:
+                raise ErofsError(
+                    f"{node.entry.path}: chunk [{off:#x}, {off + extent:#x}) "
+                    f"outside the {dev_size}-byte blob device"
+                )
+        node.chunked = cd
+
+    # Assign nids: slot index in the 32-byte-unit metadata area; chunk
+    # indexes occupy the slots right after their inode.
+    meta_blkaddr_bytes = SB_OFFSET + 128
+    if device is not None:
+        meta_blkaddr_bytes = _DEVT_SLOTOFF * _DEVT_SLOT_SIZE + _DEVT_SLOT_SIZE
+    meta_blkaddr = -(-meta_blkaddr_bytes // blksz)
+    slot = 0
+    for node in real_nodes:
+        node.nid = slot
+        node.ino = slot + 1
+        slot += node.slots()
+    total_slots = slot
     nid_of: dict[int, int] = {}
     for node in order:
         target = alias_of.get(id(node))
@@ -229,62 +315,66 @@ def build_erofs(entries: list[FileEntry], volume_name: bytes = b"ntpu-erofs") ->
     if root_nid > 0xFFFF:
         raise ErofsError("root nid exceeds the superblock's le16 field")
 
-    # Metadata area size -> first data block.
-    meta_bytes = len(real_nodes) * _INODE_COMPACT.size
-    meta_blocks = max(1, -(-meta_bytes // BLKSZ))
+    meta_bytes = total_slots * _INODE_COMPACT.size
+    meta_blocks = max(1, -(-meta_bytes // blksz))
     data_blkaddr = meta_blkaddr + meta_blocks
 
-    # Lay out data: directories then files, in nid order.
+    # Lay out data: in nid order.
     data = io.BytesIO()
 
     def alloc(payload: bytes) -> int:
         if not payload:
             return 0
-        addr = data_blkaddr + data.tell() // BLKSZ
+        addr = data_blkaddr + data.tell() // blksz
         data.write(payload)
-        pad = -len(payload) % BLKSZ
-        data.write(b"\0" * pad)
+        data.write(b"\0" * (-len(payload) % blksz))
         return addr
 
     for node in real_nodes:
         e = node.entry
         if statmod.S_ISDIR(e.mode):
-            node.data = _dir_blocks(node, nid_of)
+            node.data = _dir_blocks(node, nid_of, blksz)
         elif statmod.S_ISLNK(e.mode):
             node.data = e.symlink_target.encode()
-        elif statmod.S_ISREG(e.mode):
+        elif statmod.S_ISREG(e.mode) and node.chunked is None:
             node.data = e.data
         else:
             node.data = b""
+        node.size = node.chunked.size if node.chunked else len(node.data)
         node.raw_blkaddr = alloc(node.data)
 
-    # Inode table.
+    # Inode table (+ inline chunk indexes).
     meta = io.BytesIO()
     for node in real_nodes:
         e = node.entry
-        i_format = (_LAYOUT_FLAT_PLAIN << 1) | 0
-        if statmod.S_ISCHR(e.mode) or statmod.S_ISBLK(e.mode):
+        if node.chunked is not None:
+            layout = _LAYOUT_CHUNK_BASED
+            chunkbits = node.chunked.chunk_size.bit_length() - 1 - blkszbits
+            i_u = _CHUNK_FORMAT_INDEXES | chunkbits
+        elif statmod.S_ISCHR(e.mode) or statmod.S_ISBLK(e.mode):
+            layout = _LAYOUT_FLAT_PLAIN
             # kernel new_encode_dev(): minor low byte | major << 8 | rest of
             # minor << 12
             major, minor = os.major(e.rdev), os.minor(e.rdev)
             i_u = (minor & 0xFF) | (major << 8) | ((minor & ~0xFF) << 12)
         else:
+            layout = _LAYOUT_FLAT_PLAIN
             i_u = node.raw_blkaddr
         # Compact (32-byte) inodes cannot represent these; wrapping would
         # produce a silently-corrupt mount, so reject loudly.
-        if len(node.data) > 0xFFFFFFFF:
-            raise ErofsError(f"{e.path}: size {len(node.data)} exceeds compact inode")
+        if node.size > 0xFFFFFFFF:
+            raise ErofsError(f"{e.path}: size {node.size} exceeds compact inode")
         if node.nlink > 0xFFFF:
             raise ErofsError(f"{e.path}: nlink {node.nlink} exceeds compact inode")
         if e.uid > 0xFFFF or e.gid > 0xFFFF:
             raise ErofsError(f"{e.path}: uid/gid exceed compact inode 16-bit fields")
         meta.write(
             _INODE_COMPACT.pack(
-                i_format,
+                (layout << 1) | 0,
                 0,  # no xattrs
                 e.mode & 0xFFFF,
                 node.nlink,
-                len(node.data),
+                node.size,
                 0,
                 i_u,
                 node.ino,
@@ -293,17 +383,31 @@ def build_erofs(entries: list[FileEntry], volume_name: bytes = b"ntpu-erofs") ->
                 0,
             )
         )
+        if node.chunked is not None:
+            for off in node.chunked.offsets:
+                meta.write(_CHUNK_INDEX.pack(0, 1, off >> blkszbits))
+            meta.write(b"\0" * (-(_CHUNK_INDEX.size * len(node.chunked.offsets)) % _INODE_COMPACT.size))
     meta_payload = meta.getvalue()
-    meta_payload += b"\0" * (meta_blocks * BLKSZ - len(meta_payload))
+    meta_payload += b"\0" * (meta_blocks * blksz - len(meta_payload))
 
     data_payload = data.getvalue()
-    total_blocks = data_blkaddr + len(data_payload) // BLKSZ
+    total_blocks = data_blkaddr + len(data_payload) // blksz
+
+    feature_incompat = 0
+    extra_devices = 0
+    devt_slotoff = 0
+    if device is not None:
+        extra_devices = 1
+        devt_slotoff = _DEVT_SLOTOFF
+        feature_incompat |= _FEATURE_INCOMPAT_DEVICE_TABLE
+    if chunk_map:
+        feature_incompat |= _FEATURE_INCOMPAT_CHUNKED_FILE
 
     sb = _SB.pack(
         EROFS_MAGIC,
         0,  # checksum (feature_compat bit unset -> not verified)
         0,  # feature_compat
-        BLKSZBITS,
+        blkszbits,
         0,  # sb_extslots
         root_nid,
         len(real_nodes),  # inos
@@ -314,10 +418,10 @@ def build_erofs(entries: list[FileEntry], volume_name: bytes = b"ntpu-erofs") ->
         0,  # xattr_blkaddr
         b"\0" * 16,  # uuid
         volume_name[:16].ljust(16, b"\0"),
-        0,  # feature_incompat
+        feature_incompat,
         0,  # u1 (compression info)
-        0,  # extra_devices
-        0,  # devt_slotoff
+        extra_devices,
+        devt_slotoff,
         0,  # dirblkbits
         0,  # xattr_prefix_count
         0,  # xattr_prefix_start
@@ -325,7 +429,62 @@ def build_erofs(entries: list[FileEntry], volume_name: bytes = b"ntpu-erofs") ->
         0,  # xattr_filter_reserved
         b"\0" * 23,
     )
-    header = bytearray(BLKSZ)
+    header = bytearray(meta_blkaddr * blksz)
     header[SB_OFFSET : SB_OFFSET + len(sb)] = sb
+    if device is not None:
+        tag, size_bytes = device
+        slot_off = _DEVT_SLOTOFF * _DEVT_SLOT_SIZE
+        header[slot_off : slot_off + _DEVT_SLOT_SIZE] = _DEVICE_SLOT.pack(
+            tag[:64].ljust(64, b"\0"),
+            -(-size_bytes // blksz),
+            0,  # mapped_blkaddr: unused with explicit chunk device ids
+            b"\0" * 56,
+        )
 
     return bytes(header) + meta_payload + data_payload
+
+
+def erofs_from_rafs(bootstrap, device_tag: bytes = b"") -> bytes:
+    """RAFS bootstrap whose chunks index an uncompressed blob (the tarfs
+    shape, tarfs/bootstrap.py) → kernel-mountable EROFS meta image with
+    that blob as device 1.
+
+    This replaces the reference's ``nydus-image export --block`` for the
+    tarfs path (tarfs.go:525-541): mount the returned image with
+    ``-o device=<loop of the tar>`` and the kernel reads file bytes
+    straight from the tar. Chunks must be identity-mapped
+    (uncompressed == compressed offsets) and 512-aligned, which tarfs
+    bootstraps are by construction. Opaque-directory xattrs are not yet
+    emitted (whiteout char devices pass through and work under overlayfs).
+    """
+    from nydus_snapshotter_tpu.models import fstree
+
+    if len(bootstrap.blobs) != 1:
+        raise ErofsError(
+            f"tarfs export expects exactly one blob, got {len(bootstrap.blobs)}"
+        )
+    blob = bootstrap.blobs[0]
+    entries: list[FileEntry] = []
+    chunk_map: dict[str, ChunkedData] = {}
+    for inode in bootstrap.inodes:
+        entries.append(fstree.inode_to_entry(inode, b""))
+        if not statmod.S_ISREG(inode.mode) or inode.hardlink_target or not inode.chunk_count:
+            continue
+        recs = bootstrap.chunks[inode.chunk_index : inode.chunk_index + inode.chunk_count]
+        for rec in recs:
+            if rec.uncompressed_offset != rec.compressed_offset:
+                raise ErofsError(
+                    f"{inode.path}: chunk not identity-mapped; "
+                    "only tarfs bootstraps export to EROFS"
+                )
+        chunk_map[inode.path] = ChunkedData(
+            size=inode.size,
+            chunk_size=bootstrap.chunk_size,
+            offsets=[rec.uncompressed_offset for rec in recs],
+        )
+    return build_erofs(
+        entries,
+        blkszbits=9,
+        chunk_map=chunk_map,
+        device=(device_tag or blob.blob_id.encode(), blob.compressed_size),
+    )

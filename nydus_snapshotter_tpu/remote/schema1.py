@@ -176,9 +176,14 @@ def convert_schema1(
         if not isinstance(digest, str) or not digest:
             raise Schema1Error("schema1 fsLayer missing blobSum")
         if digest not in seen:
+            if not digest.startswith("sha256:"):
+                # Docker schema1 only ever produced sha256 blobSums; an
+                # unknown algorithm would mean skipping verification, and
+                # unverified bytes must not enter the synthesized manifest.
+                raise Schema1Error(f"unsupported blobSum algorithm: {digest}")
             blob = fetch_blob(digest)
             actual = "sha256:" + hashlib.sha256(blob).hexdigest()
-            if digest.startswith("sha256:") and actual != digest:
+            if actual != digest:
                 raise Schema1Error(
                     f"layer blob digest mismatch: manifest says {digest}, "
                     f"fetched {actual}"
