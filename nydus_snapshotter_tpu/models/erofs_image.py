@@ -31,8 +31,10 @@ Format notes (Linux fs/erofs/erofs_fs.h):
 - Directories are arrays of 12-byte dirents per block, names packed after
   the dirent array, entries sorted bytewise (the kernel binary-searches,
   both across blocks by first-name and within a block).
-- No xattrs/compression: feature_compat = 0 keeps the checksum optional;
-  feature_incompat carries only CHUNKED_FILE|DEVICE_TABLE when used.
+- Inline xattrs (prefix-indexed entries after the inode; POSIX ACL names
+  as exact-match indexes); no compression. feature_compat = 0 keeps the
+  checksum optional; feature_incompat carries only
+  CHUNKED_FILE|DEVICE_TABLE when used.
 """
 
 from __future__ import annotations
@@ -79,6 +81,51 @@ _DIRENT = struct.Struct("<QHBB")
 _CHUNK_INDEX = struct.Struct("<HHI")  # advise, device_id, blkaddr
 _DEVICE_SLOT = struct.Struct("<64sII56s")
 assert _DEVICE_SLOT.size == _DEVT_SLOT_SIZE
+_XATTR_IBODY_HEADER = struct.Struct("<IB7s")  # name_filter, shared_count, pad
+_XATTR_ENTRY = struct.Struct("<BBH")  # name_len, name_index, value_size
+
+# Well-known xattr name prefixes (erofs_fs.h EROFS_XATTR_INDEX_*). The
+# POSIX ACL names are exact matches encoded as an index with an EMPTY
+# remaining name.
+_XATTR_EXACT = {
+    "system.posix_acl_access": 2,
+    "system.posix_acl_default": 3,
+}
+_XATTR_PREFIXES = [
+    ("user.", 1),
+    ("trusted.", 4),
+    ("security.", 6),
+]
+
+
+def _encode_xattrs(xattrs: dict[str, bytes]) -> bytes:
+    """Inline xattr ibody: header + 4-aligned entries, sorted for
+    determinism. Returns b'' when there are none. Names outside the EROFS
+    prefix registry are rejected — index 0 entries would be unreadable on
+    the mounted filesystem, a silent data loss."""
+    if not xattrs:
+        return b""
+    body = io.BytesIO()
+    body.write(_XATTR_IBODY_HEADER.pack(0, 0, b"\0" * 7))
+    for key in sorted(xattrs):
+        value = xattrs[key]
+        if key in _XATTR_EXACT:
+            index, name = _XATTR_EXACT[key], ""
+        else:
+            for prefix, idx in _XATTR_PREFIXES:
+                if key.startswith(prefix) and len(key) > len(prefix):
+                    index, name = idx, key[len(prefix) :]
+                    break
+            else:
+                raise ErofsError(f"xattr namespace not representable: {key!r}")
+        nb = name.encode()
+        if len(nb) > 0xFF or len(value) > 0xFFFF:
+            raise ErofsError(f"xattr {key!r} name/value too large")
+        body.write(_XATTR_ENTRY.pack(len(nb), index, len(value)))
+        body.write(nb)
+        body.write(value)
+        body.write(b"\0" * (-(_XATTR_ENTRY.size + len(nb) + len(value)) % 4))
+    return body.getvalue()
 
 
 class ErofsError(ValueError):
@@ -111,14 +158,26 @@ class _Node:
     size: int = 0
     raw_blkaddr: int = 0
     chunked: Optional[ChunkedData] = None
+    xattr_body: bytes = b""
     children: dict[bytes, "_Node"] = field(default_factory=dict)
     parent: Optional["_Node"] = None
 
-    def slots(self) -> int:
-        if self.chunked is None:
-            return 1
-        idx_bytes = _CHUNK_INDEX.size * len(self.chunked.offsets)
-        return 1 + -(-idx_bytes // _INODE_COMPACT.size)
+    def meta_bytes(self, blkszbits: int) -> bytes:
+        """Everything after the 32-byte inode struct in this inode's slot
+        run: xattr ibody, then 8-aligned chunk indexes (the kernel reads
+        them at ALIGN(iloc + inode_size + xattr_isize, 8))."""
+        out = io.BytesIO()
+        out.write(self.xattr_body)
+        if self.chunked is not None:
+            pos = _INODE_COMPACT.size + out.tell()
+            out.write(b"\0" * (-pos % 8))
+            for off in self.chunked.offsets:
+                out.write(_CHUNK_INDEX.pack(0, 1, off >> blkszbits))
+        return out.getvalue()
+
+    def slots(self, blkszbits: int) -> int:
+        total = _INODE_COMPACT.size + len(self.meta_bytes(blkszbits))
+        return -(-total // _INODE_COMPACT.size)
 
 
 def _build_tree(entries: list[FileEntry]) -> tuple[_Node, dict[str, "_Node"]]:
@@ -208,7 +267,8 @@ def build_erofs(
 
     Hardlinks (``entry.hardlink_target``) share the target's inode and bump
     its nlink. Whiteouts are callers' business (overlay semantics live a
-    layer up); xattrs are not yet emitted.
+    layer up); xattrs are emitted inline (user./trusted./security.
+    prefixes and POSIX ACL names — anything else raises).
 
     ``chunk_map`` maps paths of regular files to external-device extents
     (CHUNK_BASED inodes, data read from the blob device); ``device`` is the
@@ -295,17 +355,21 @@ def build_erofs(
                 )
         node.chunked = cd
 
-    # Assign nids: slot index in the 32-byte-unit metadata area; chunk
-    # indexes occupy the slots right after their inode.
+    # Assign nids: slot index in the 32-byte-unit metadata area; xattrs and
+    # chunk indexes occupy the slots right after their inode.
     meta_blkaddr_bytes = SB_OFFSET + 128
     if device is not None:
         meta_blkaddr_bytes = _DEVT_SLOTOFF * _DEVT_SLOT_SIZE + _DEVT_SLOT_SIZE
     meta_blkaddr = -(-meta_blkaddr_bytes // blksz)
+    orphans = set(chunk_map) - set(by_path)
+    if orphans:
+        raise ErofsError(f"chunk_map paths not in entries: {sorted(orphans)[:3]}")
     slot = 0
     for node in real_nodes:
+        node.xattr_body = _encode_xattrs(node.entry.xattrs)
         node.nid = slot
         node.ino = slot + 1
-        slot += node.slots()
+        slot += node.slots(blkszbits)
     total_slots = slot
     nid_of: dict[int, int] = {}
     for node in order:
@@ -368,10 +432,16 @@ def build_erofs(
             raise ErofsError(f"{e.path}: nlink {node.nlink} exceeds compact inode")
         if e.uid > 0xFFFF or e.gid > 0xFFFF:
             raise ErofsError(f"{e.path}: uid/gid exceed compact inode 16-bit fields")
+        # i_xattr_icount: ibody bytes = 12 + 4*(icount-1) (erofs_fs.h).
+        xattr_icount = (
+            1 + (len(node.xattr_body) - _XATTR_IBODY_HEADER.size) // 4
+            if node.xattr_body
+            else 0
+        )
         meta.write(
             _INODE_COMPACT.pack(
                 (layout << 1) | 0,
-                0,  # no xattrs
+                xattr_icount,
                 e.mode & 0xFFFF,
                 node.nlink,
                 node.size,
@@ -383,10 +453,9 @@ def build_erofs(
                 0,
             )
         )
-        if node.chunked is not None:
-            for off in node.chunked.offsets:
-                meta.write(_CHUNK_INDEX.pack(0, 1, off >> blkszbits))
-            meta.write(b"\0" * (-(_CHUNK_INDEX.size * len(node.chunked.offsets)) % _INODE_COMPACT.size))
+        body = node.meta_bytes(blkszbits)
+        meta.write(body)
+        meta.write(b"\0" * (-(_INODE_COMPACT.size + len(body)) % _INODE_COMPACT.size))
     meta_payload = meta.getvalue()
     meta_payload += b"\0" * (meta_blocks * blksz - len(meta_payload))
 
@@ -454,8 +523,9 @@ def erofs_from_rafs(bootstrap, device_tag: bytes = b"") -> bytes:
     ``-o device=<loop of the tar>`` and the kernel reads file bytes
     straight from the tar. Chunks must be identity-mapped
     (uncompressed == compressed offsets) and 512-aligned, which tarfs
-    bootstraps are by construction. Opaque-directory xattrs are not yet
-    emitted (whiteout char devices pass through and work under overlayfs).
+    bootstraps are by construction. Opaque-directory xattrs
+    (trusted.overlay.opaque) and whiteout char devices both carry through,
+    so overlayfs layering over the mount behaves like the reference's.
     """
     from nydus_snapshotter_tpu.models import fstree
 

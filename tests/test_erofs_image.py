@@ -445,3 +445,70 @@ class TestTarfsBootstrapExport:
             with open(os.path.join(mp, "usr/hello"), "rb") as f:
                 assert f.read() == b"tarfs-hello\n"
             assert os.readlink(os.path.join(mp, "usr/ln")) == "hello"
+
+
+@requires_erofs
+class TestXattrs:
+    def test_xattrs_visible_through_kernel(self, tmp_path):
+        entries = [
+            entry("/opq", statmod.S_IFDIR | 0o755,
+                  xattrs={"trusted.overlay.opaque": b"y"}),
+            entry("/opq/f", statmod.S_IFREG | 0o644, b"inside"),
+            entry("/tagged", statmod.S_IFREG | 0o644, b"data",
+                  xattrs={"user.color": b"blue", "user.size": b"xl"}),
+        ]
+        img = build_erofs(entries)
+        image_path = str(tmp_path / "x.erofs")
+        with open(image_path, "wb") as f:
+            f.write(img)
+        mp = str(tmp_path / "mnt")
+        os.mkdir(mp)
+        with _Mounted(image_path, mp):
+            assert os.getxattr(os.path.join(mp, "opq"), "trusted.overlay.opaque") == b"y"
+            assert os.getxattr(os.path.join(mp, "tagged"), "user.color") == b"blue"
+            assert os.getxattr(os.path.join(mp, "tagged"), "user.size") == b"xl"
+            assert sorted(os.listxattr(os.path.join(mp, "tagged"))) == [
+                "user.color", "user.size",
+            ]
+            with open(os.path.join(mp, "opq/f"), "rb") as f:
+                assert f.read() == b"inside"
+            # file data after an xattr-carrying inode still reads correctly
+            with open(os.path.join(mp, "tagged"), "rb") as f:
+                assert f.read() == b"data"
+
+    def test_tarfs_opaque_dirs_export(self, tmp_path):
+        """tarfs bootstraps mark opaque dirs; the EROFS export must carry
+        the overlay xattr so overlayfs honors opacity."""
+        import io
+        import tarfile
+
+        from nydus_snapshotter_tpu.models.erofs_image import erofs_from_rafs
+        from nydus_snapshotter_tpu.tarfs.bootstrap import tarfs_bootstrap_from_tar
+
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+            ti = tarfile.TarInfo("d")
+            ti.type = tarfile.DIRTYPE
+            tf.addfile(ti)
+            ti = tarfile.TarInfo("d/.wh..wh..opq")  # opaque marker
+            ti.size = 0
+            tf.addfile(ti, io.BytesIO(b""))
+            ti = tarfile.TarInfo("d/keep")
+            ti.size = 4
+            tf.addfile(ti, io.BytesIO(b"keep"))
+        tar_bytes = buf.getvalue()
+        bs = tarfs_bootstrap_from_tar(io.BytesIO(tar_bytes), blob_id="t")
+        img = erofs_from_rafs(bs)
+        image_path = str(tmp_path / "m.erofs")
+        blob_path = str(tmp_path / "t.tar")
+        with open(image_path, "wb") as f:
+            f.write(img)
+        with open(blob_path, "wb") as f:
+            f.write(tar_bytes)
+            f.write(b"\0" * (-len(tar_bytes) % 512))
+        mp = str(tmp_path / "mnt")
+        os.mkdir(mp)
+        with _MountedWithDevice(image_path, blob_path, mp):
+            assert os.getxattr(os.path.join(mp, "d"), "trusted.overlay.opaque") == b"y"
+            with open(os.path.join(mp, "d/keep"), "rb") as f:
+                assert f.read() == b"keep"
