@@ -512,3 +512,64 @@ class TestXattrs:
             assert os.getxattr(os.path.join(mp, "d"), "trusted.overlay.opaque") == b"y"
             with open(os.path.join(mp, "d/keep"), "rb") as f:
                 assert f.read() == b"keep"
+
+
+@requires_erofs
+class TestOverlayOverErofs:
+    def test_two_erofs_layers_under_overlayfs(self, tmp_path):
+        """The snapshotter's runtime shape: overlayfs whose lowerdirs are
+        kernel-mounted EROFS layers (reference mountRemote overlay
+        synthesis, snapshot.go:901-952) — upper-wins, whiteouts delete,
+        opaque dirs hide lower contents."""
+        lower1 = [
+            entry("/app", statmod.S_IFDIR | 0o755),
+            entry("/app/keep.txt", statmod.S_IFREG | 0o644, b"from-lower"),
+            entry("/app/replaced.txt", statmod.S_IFREG | 0o644, b"old"),
+            entry("/app/deleted.txt", statmod.S_IFREG | 0o644, b"bye"),
+            entry("/shadowed", statmod.S_IFDIR | 0o755),
+            entry("/shadowed/old.txt", statmod.S_IFREG | 0o644, b"hidden"),
+        ]
+        lower2 = [
+            entry("/app", statmod.S_IFDIR | 0o755),
+            entry("/app/replaced.txt", statmod.S_IFREG | 0o644, b"new"),
+            # whiteout: char dev 0:0 (overlayfs deletion marker)
+            entry("/app/deleted.txt", statmod.S_IFCHR, rdev=0),
+            # opaque dir: hides /shadowed contents from lower1
+            entry("/shadowed", statmod.S_IFDIR | 0o755,
+                  xattrs={"trusted.overlay.opaque": b"y"}),
+            entry("/shadowed/fresh.txt", statmod.S_IFREG | 0o644, b"visible"),
+        ]
+        mounts = []
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        try:
+            lowers = []
+            for i, entries in enumerate((lower1, lower2)):
+                img_path = str(tmp_path / f"l{i}.erofs")
+                with open(img_path, "wb") as f:
+                    f.write(build_erofs(entries))
+                mp = str(tmp_path / f"l{i}")
+                os.mkdir(mp)
+                m = _Mounted(img_path, mp)
+                m.__enter__()
+                mounts.append(m)
+                lowers.append(mp)
+            merged = str(tmp_path / "merged")
+            os.mkdir(merged)
+            # upper layer last in the overlay chain = first in lowerdir
+            opts = f"lowerdir={lowers[1]}:{lowers[0]}"
+            rc = libc.mount(b"overlay", merged.encode(), b"overlay", 1, opts.encode())
+            assert rc == 0, os.strerror(ctypes.get_errno())
+            try:
+                with open(os.path.join(merged, "app/keep.txt"), "rb") as f:
+                    assert f.read() == b"from-lower"
+                with open(os.path.join(merged, "app/replaced.txt"), "rb") as f:
+                    assert f.read() == b"new"
+                assert not os.path.exists(os.path.join(merged, "app/deleted.txt"))
+                assert sorted(os.listdir(os.path.join(merged, "shadowed"))) == [
+                    "fresh.txt"
+                ], "opaque dir must hide lower contents"
+            finally:
+                libc.umount2(merged.encode(), 2)
+        finally:
+            for m in mounts:
+                m.__exit__(None, None, None)
