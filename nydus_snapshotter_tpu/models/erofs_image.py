@@ -134,11 +134,17 @@ class ErofsError(ValueError):
 
 @dataclass(frozen=True)
 class ChunkedData:
-    """External-device extents for one regular file (tarfs shape)."""
+    """Chunk extents for one regular file (tarfs / block-disk shapes).
+
+    ``device_id`` 0 addresses the primary device (the image itself — the
+    self-contained disk layout where tar data is appended after the
+    metadata); 1 addresses the first extra device (the loop-attached tar).
+    """
 
     size: int
     chunk_size: int  # power of two, >= block size
-    offsets: list[int]  # byte offset of each chunk on the blob device
+    offsets: list[int]  # byte offset of each chunk on the target device
+    device_id: int = 1
 
 
 def _file_type(mode: int) -> int:
@@ -172,7 +178,9 @@ class _Node:
             pos = _INODE_COMPACT.size + out.tell()
             out.write(b"\0" * (-pos % 8))
             for off in self.chunked.offsets:
-                out.write(_CHUNK_INDEX.pack(0, 1, off >> blkszbits))
+                out.write(
+                    _CHUNK_INDEX.pack(0, self.chunked.device_id, off >> blkszbits)
+                )
         return out.getvalue()
 
     def slots(self, blkszbits: int) -> int:
@@ -262,6 +270,7 @@ def build_erofs(
     blkszbits: int = BLKSZBITS,
     chunk_map: Optional[dict[str, ChunkedData]] = None,
     device: Optional[tuple[bytes, int]] = None,
+    total_size: Optional[int] = None,
 ) -> bytes:
     """Serialize ``entries`` into a mountable EROFS image.
 
@@ -277,8 +286,8 @@ def build_erofs(
     callers use ``blkszbits=9`` so 512-aligned tar data qualifies.
     """
     chunk_map = chunk_map or {}
-    if chunk_map and device is None:
-        raise ErofsError("chunk_map requires a blob device")
+    if device is None and any(cd.device_id != 0 for cd in chunk_map.values()):
+        raise ErofsError("chunk_map with extra-device extents requires a blob device")
     if not 9 <= blkszbits <= 12:
         raise ErofsError(f"blkszbits {blkszbits} outside the supported 9..12")
     blksz = 1 << blkszbits
@@ -341,7 +350,18 @@ def build_erofs(
                 f"{node.entry.path}: {len(cd.offsets)} chunk offsets for "
                 f"size {cd.size} (expected {expected})"
             )
-        dev_size = device[1] if device else 0
+        if cd.device_id == 0:
+            # Primary-device extents live in this image past the metadata;
+            # bounds come from the declared final image size.
+            if total_size is None:
+                raise ErofsError(
+                    f"{node.entry.path}: primary-device chunks need total_size"
+                )
+            dev_size = total_size
+        else:
+            # device is not None here: the guard above rejected extra-device
+            # extents without a blob device.
+            dev_size = device[1]
         for k, off in enumerate(cd.offsets):
             if off % blksz:
                 raise ErofsError(
@@ -351,7 +371,7 @@ def build_erofs(
             if off + extent > dev_size:
                 raise ErofsError(
                     f"{node.entry.path}: chunk [{off:#x}, {off + extent:#x}) "
-                    f"outside the {dev_size}-byte blob device"
+                    f"outside the {dev_size}-byte device"
                 )
         node.chunked = cd
 
@@ -461,6 +481,14 @@ def build_erofs(
 
     data_payload = data.getvalue()
     total_blocks = data_blkaddr + len(data_payload) // blksz
+    if total_size is not None:
+        if total_size % blksz:
+            raise ErofsError(f"total_size {total_size} not block-aligned")
+        if total_size // blksz < total_blocks:
+            raise ErofsError(
+                f"total_size {total_size} smaller than the metadata+data area"
+            )
+        total_blocks = total_size // blksz
 
     feature_incompat = 0
     extra_devices = 0
@@ -511,6 +539,100 @@ def build_erofs(
         )
 
     return bytes(header) + meta_payload + data_payload
+
+
+def write_erofs_disk(bootstrap, tar_path_of, out) -> int:
+    """Self-contained block image: EROFS metadata + the referenced tar
+    blobs appended, chunks addressing the PRIMARY device — one image,
+    mountable alone (the reference's ``nydus-image export --block`` whole
+    -image shape, tarfs.go:466-571; Kata direct-block volumes consume it).
+
+    ``tar_path_of(blob_id)`` locates each referenced layer tar on disk;
+    ``out`` is a seekable binary stream. Returns the data size written
+    (the dm-verity tree, if any, is appended by the caller after this).
+    """
+    import shutil
+
+    if not bootstrap.blobs:
+        raise ErofsError("bootstrap references no blobs")
+
+    from nydus_snapshotter_tpu.models import fstree
+
+    entries: list[FileEntry] = []
+    file_chunks: dict[str, list] = {}
+    for inode in bootstrap.inodes:
+        entries.append(fstree.inode_to_entry(inode, b""))
+        if statmod.S_ISREG(inode.mode) and not inode.hardlink_target and inode.chunk_count:
+            recs = bootstrap.chunks[
+                inode.chunk_index : inode.chunk_index + inode.chunk_count
+            ]
+            for rec in recs:
+                if rec.uncompressed_offset != rec.compressed_offset:
+                    raise ErofsError(
+                        f"{inode.path}: chunk not identity-mapped; only tarfs "
+                        "bootstraps (the tar is the uncompressed blob) can "
+                        "export to a block disk"
+                    )
+            file_chunks[inode.path] = recs
+
+    def chunk_map_with(blob_base: list[int]) -> dict[str, ChunkedData]:
+        cm: dict[str, ChunkedData] = {}
+        for path, recs in file_chunks.items():
+            size = sum(r.uncompressed_size for r in recs)
+            cm[path] = ChunkedData(
+                size=size,
+                chunk_size=bootstrap.chunk_size,
+                offsets=[blob_base[r.blob_index] + r.uncompressed_offset for r in recs],
+                device_id=0,
+            )
+        return cm
+
+    blob_sizes = []
+    for blob in bootstrap.blobs:
+        blob_sizes.append(os.path.getsize(tar_path_of(blob.blob_id)))
+
+    # Pass 1: probe the metadata area size with zero offsets (same chunk
+    # counts -> identical meta layout), then place the tars after it.
+    # Probe bound: large enough for any real disk, small enough for the
+    # le32 sb.blocks field (2^40 bytes / 512 = 2^31 blocks).
+    probe_bound = 1 << 40
+    zero_base = [0] * len(bootstrap.blobs)
+    probe = build_erofs(
+        entries,
+        blkszbits=9,
+        chunk_map=chunk_map_with(zero_base),
+        total_size=probe_bound,
+    )
+    meta_size = len(probe)
+    blob_base = []
+    pos = meta_size
+    for size in blob_sizes:
+        pos += -pos % 512
+        blob_base.append(pos)
+        pos += size
+    total = pos + (-pos % 512)
+
+    img = build_erofs(
+        entries,
+        blkszbits=9,
+        chunk_map=chunk_map_with(blob_base),
+        total_size=total,
+    )
+    if len(img) != meta_size:
+        raise ErofsError("metadata size changed between layout passes")
+    start = out.tell()
+    out.write(img)
+    for blob, size, base in zip(bootstrap.blobs, blob_sizes, blob_base):
+        out.write(b"\0" * (start + base - out.tell()))
+        with open(tar_path_of(blob.blob_id), "rb") as tf:
+            shutil.copyfileobj(tf, out, 1 << 20)
+        if out.tell() != start + base + size:
+            raise ErofsError(
+                f"blob {blob.blob_id} wrote {out.tell() - start - base} bytes, "
+                f"probed {size} — file changed during export"
+            )
+    out.write(b"\0" * (start + total - out.tell()))
+    return total
 
 
 def erofs_from_rafs(bootstrap, device_tag: bytes = b"") -> bytes:

@@ -56,9 +56,6 @@ IMAGE_BOOTSTRAP_NAME = "image.boot"
 LAYER_DISK_NAME = "layer.disk"
 IMAGE_DISK_NAME = "image.disk"
 
-# Export block-image layout: 4 KiB header, bootstrap, 512-aligned tar data.
-_DISK_MAGIC = b"NTPUBLK1"
-_DISK_HEADER_SIZE = 4096
 
 
 @dataclass
@@ -456,35 +453,20 @@ class Manager:
     def _export_disk(
         self, meta_file: str, disk_file: str, with_verity: bool
     ) -> Optional[verity.VerityInfo]:
-        """``nydus-image export --block [--verity]`` equivalent: assemble
-        header + bootstrap + referenced tar blobs into one 512-aligned
-        image, then append the dm-verity tree."""
+        """``nydus-image export --block [--verity]`` equivalent: one
+        self-contained, kernel-mountable EROFS image — metadata plus the
+        referenced tar blobs, chunks addressing the primary device
+        (models/erofs_image.write_erofs_disk) — then the dm-verity tree."""
+        from nydus_snapshotter_tpu.models.erofs_image import write_erofs_disk
+
         with open(meta_file, "rb") as f:
-            boot_bytes = f.read()
-        bootstrap = Bootstrap.from_bytes(boot_bytes)
+            bootstrap = Bootstrap.from_bytes(f.read())
         tmp = disk_file + ".tarfs.tmp"
         try:
             with open(tmp, "w+b") as img:
-                header = bytearray(_DISK_HEADER_SIZE)
-                header[: len(_DISK_MAGIC)] = _DISK_MAGIC
-                import struct as _struct
-
-                _struct.pack_into("<QI", header, 8, len(boot_bytes), len(bootstrap.blobs))
-                img.write(header)
-                img.write(boot_bytes)
-                pad = (-img.tell()) % verity.DATA_BLOCK_SIZE
-                img.write(b"\x00" * pad)
-                for blob in bootstrap.blobs:
-                    tar_path = self.layer_tar_file_path(blob.blob_id)
-                    with open(tar_path, "rb") as tf:
-                        while True:
-                            buf = tf.read(1 << 20)
-                            if not buf:
-                                break
-                            img.write(buf)
-                    pad = (-img.tell()) % verity.DATA_BLOCK_SIZE
-                    img.write(b"\x00" * pad)
-                data_size = img.tell()
+                data_size = write_erofs_disk(
+                    bootstrap, self.layer_tar_file_path, img
+                )
                 info = verity.append_tree(img, data_size) if with_verity else None
             if info is not None:
                 with open(disk_file + ".verity.json", "w") as f:

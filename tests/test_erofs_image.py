@@ -573,3 +573,47 @@ class TestOverlayOverErofs:
         finally:
             for m in mounts:
                 m.__exit__(None, None, None)
+
+
+@requires_erofs
+class TestSelfContainedDisk:
+    def test_whole_image_disk_mounts_alone(self, tmp_path):
+        """write_erofs_disk: one image = metadata + appended tars, chunks
+        addressing the primary device — mountable with a single loop
+        device (the Kata direct-block shape, tarfs.go:466-571)."""
+        import io
+        import tarfile
+
+        from nydus_snapshotter_tpu.models.erofs_image import write_erofs_disk
+        from nydus_snapshotter_tpu.tarfs.bootstrap import tarfs_bootstrap_from_tar
+
+        payload = RNG.integers(0, 256, 3_000_000, dtype=np.uint8).tobytes()
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+            ti = tarfile.TarInfo("data")
+            ti.type = tarfile.DIRTYPE
+            tf.addfile(ti)
+            ti = tarfile.TarInfo("data/blob.bin")
+            ti.size = len(payload)
+            tf.addfile(ti, io.BytesIO(payload))
+            ti = tarfile.TarInfo("data/note")
+            ti.size = 5
+            tf.addfile(ti, io.BytesIO(b"hello"))
+        tar_bytes = buf.getvalue()
+        tar_path = str(tmp_path / "layer.tar")
+        with open(tar_path, "wb") as f:
+            f.write(tar_bytes)
+
+        bs = tarfs_bootstrap_from_tar(io.BytesIO(tar_bytes), blob_id="b0")
+        disk_path = str(tmp_path / "whole.erofs")
+        with open(disk_path, "w+b") as out:
+            data_size = write_erofs_disk(bs, lambda _bid: tar_path, out)
+        assert os.path.getsize(disk_path) == data_size
+
+        mp = str(tmp_path / "mnt")
+        os.mkdir(mp)
+        with _Mounted(disk_path, mp):  # single device, no -o device=
+            with open(os.path.join(mp, "data/blob.bin"), "rb") as f:
+                assert f.read() == payload
+            with open(os.path.join(mp, "data/note"), "rb") as f:
+                assert f.read() == b"hello"
