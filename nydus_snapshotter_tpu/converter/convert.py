@@ -297,6 +297,30 @@ def bootstrap_from_bootstrap_layer(data: bytes) -> Bootstrap:
     raise ConvertError("bootstrap layer carries no image/image.boot")
 
 
+def match_prefetch_paths(inodes, patterns: str) -> list[str]:
+    """Resolve prefetch patterns to regular-file inode paths, hint order.
+
+    Reference semantics (--prefetch-files, one path per line,
+    daemon_adaptor.go:179-185): each line names a file or a directory
+    prefix; directories expand to every regular file beneath them. Unknown
+    patterns are skipped (hints, not requirements).
+    """
+    import stat as _stat
+
+    wanted: list[str] = []
+    seen: set[str] = set()
+    lines = [ln.strip() for ln in patterns.splitlines() if ln.strip()]
+    reg_paths = [i.path for i in inodes if _stat.S_ISREG(i.mode)]
+    for line in lines:
+        norm = "/" + line.strip("/") if line != "/" else "/"
+        prefix = norm if norm == "/" else norm + "/"
+        for path in reg_paths:
+            if (path == norm or path.startswith(prefix)) and path not in seen:
+                seen.add(path)
+                wanted.append(path)
+    return wanted
+
+
 def Merge(
     layers: list[bytes | Bootstrap],
     opt: MergeOption,
@@ -411,6 +435,9 @@ def Merge(
         blobs=blob_table,
         ciphers=cipher_table if any(c.algo for c in cipher_table) else [],
         batches=batch_table,
+        prefetch=match_prefetch_paths(inodes, opt.prefetch_patterns)
+        if opt.prefetch_patterns
+        else [],
     )
     boot_bytes = bootstrap.to_bytes()
     if opt.with_tar:

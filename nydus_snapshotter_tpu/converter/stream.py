@@ -495,6 +495,8 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
                     )
         inodes.append(inode)
 
+    from nydus_snapshotter_tpu.converter.convert import match_prefetch_paths
+
     bootstrap = Bootstrap(
         version=opt.fs_version,
         chunk_size=opt.chunk_size,
@@ -503,6 +505,9 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
         blobs=blob_table,
         ciphers=cipher_table if any(c.algo for c in cipher_table) else [],
         batches=batch_table,
+        prefetch=match_prefetch_paths(inodes, opt.prefetch_patterns)
+        if opt.prefetch_patterns
+        else [],
     )
     boot_bytes = bootstrap.to_bytes()
 

@@ -57,6 +57,7 @@ class _Instance:
         self._readers: dict[int, BlobReader] = {}
         self._reader_lock = threading.Lock()
         self._closed = False
+        self.prefetched_bytes = 0
         self.fuse = None  # FuseSession when a kernel mount is being served
 
     def start_fuse(self, default_blob_dir: str, fd: Optional[int] = None) -> bool:
@@ -134,6 +135,33 @@ class _Instance:
         be = ((cfg.get("device") or {}).get("backend") or {}).get("config") or {}
         return be.get("blob_dir") or default_dir
 
+    def prefetch(self, default_blob_dir: str) -> int:
+        """Warm the bootstrap's prefetch-table files (reference nydusd's
+        --prefetch-files behavior): pull each hinted file's chunks through
+        the blob readers so their caches are hot before first access.
+        Returns bytes warmed. Errors are contained per file (hints, not
+        requirements), warming counts only into prefetch_data_amount — not
+        the fs read metrics, which track client traffic."""
+        blob_dir = self.blob_dir(default_blob_dir)
+        warmed = 0
+        for path in self.bootstrap.prefetch:
+            inode = self.by_path.get(path)
+            if inode is None:
+                continue
+            if inode.hardlink_target:
+                inode = self.by_path.get(inode.hardlink_target) or inode
+            try:
+                for rec in self.bootstrap.chunks[
+                    inode.chunk_index : inode.chunk_index + inode.chunk_count
+                ]:
+                    n = len(self._reader(rec.blob_index, blob_dir).chunk_data(rec))
+                    warmed += n
+                    self.prefetched_bytes += n
+            except Exception:  # noqa: BLE001 — any one bad hint must not
+                # abandon the rest of the table
+                logger.warning("prefetch of %s failed", path, exc_info=True)
+        return warmed
+
     def read(self, path: str, offset: int, size: int, blob_dir: str) -> bytes:
         inode = self.by_path.get(path)
         if inode is None:
@@ -201,6 +229,7 @@ class DaemonServer:
                     "mountpoint": i.mountpoint,
                     "source": i.source,
                     "config": i.config_json,
+                    "prefetched": i.prefetched_bytes,
                 }
                 if i.fuse is not None and i.fuse.fd >= 0:
                     fds.append(i.fuse.fd)
@@ -215,6 +244,9 @@ class DaemonServer:
         with self._lock:
             for rec in data.get("instances", []):
                 inst = _Instance(rec["mountpoint"], rec["source"], rec["config"])
+                # Metric continuity across failover/upgrade: already-warmed
+                # bytes stay reported (the successor does not re-prefetch).
+                inst.prefetched_bytes = int(rec.get("prefetched", 0))
                 self.instances[rec["mountpoint"]] = inst
                 idx = rec.get("fuse_fd")
                 if idx and 0 < idx < len(fds):
@@ -336,7 +368,11 @@ class DaemonServer:
                     mp = q.get("id", [""])[0]
                     self._reply(200, daemon.fs_metrics(mp))
                 elif u.path == "/api/v1/metrics/blobcache":
-                    self._reply(200, {"prefetch_data_amount": 0})
+                    with daemon._lock:
+                        amount = sum(
+                            i.prefetched_bytes for i in daemon.instances.values()
+                        )
+                    self._reply(200, {"prefetch_data_amount": amount})
                 elif u.path == "/api/v1/metrics/inflight":
                     self._reply(200, [])
                 elif u.path == "/api/v1/fs":
@@ -504,6 +540,11 @@ class DaemonServer:
             except Exception:
                 self.instances.pop(mountpoint, None)
                 raise
+        if inst.bootstrap.prefetch:
+            threading.Thread(
+                target=inst.prefetch, args=(self.workdir,),
+                name=f"prefetch:{mountpoint}", daemon=True,
+            ).start()
         self._push_state_async()
 
     def umount(self, mountpoint: str) -> None:

@@ -57,10 +57,17 @@ _SB_CIPHER_OFF = _SB_STRUCT.size
 _SB_BATCH_STRUCT = struct.Struct("<QQ")
 _SB_BATCH_OFF = _SB_CIPHER_OFF + _SB_CIPHER_STRUCT.size
 assert _SB_BATCH_OFF + _SB_BATCH_STRUCT.size <= _SB_SIZE
+# Prefetch table pointer: u32 offset + u32 count packs into the superblock's
+# last spare 8 bytes (entries are u32 inode numbers, hint order preserved —
+# the reference's --prefetch-files table, consumed by nydusd at mount).
+_SB_PREFETCH_STRUCT = struct.Struct("<II")
+_SB_PREFETCH_OFF = _SB_BATCH_OFF + _SB_BATCH_STRUCT.size
+assert _SB_PREFETCH_OFF + _SB_PREFETCH_STRUCT.size <= _SB_SIZE
 
 # Feature bits (superblock ``features`` field).
 FEATURE_CIPHER_TABLE = 0x1
 FEATURE_BATCH_TABLE = 0x2
+FEATURE_PREFETCH_TABLE = 0x4
 
 _V5_HEADER_SIZE = 8 * 1024  # reference: v5 = 8K superblock region
 _V6_HEADER_SIZE = layout.RAFS_V6_SUPER_BLOCK_SIZE  # 1024 + 128 + 256
@@ -291,6 +298,9 @@ class Bootstrap:
     ciphers: list[CipherRecord] = field(default_factory=list)
     # Batch extents for CHUNK_FLAG_BATCH chunks; empty without batching.
     batches: list[BatchRecord] = field(default_factory=list)
+    # Prefetch hints: inode paths in priority order (serialized as inode
+    # numbers; the runtime warms these before first access).
+    prefetch: list[str] = field(default_factory=list)
 
     def cipher_for(self, blob_index: int) -> Optional[CipherRecord]:
         """The cipher context of blob ``blob_index`` (None = plaintext)."""
@@ -375,6 +385,13 @@ class Bootstrap:
         chunk_buf = b"".join(c.pack() for c in self.chunks)
         blob_buf = b"".join(b.pack() for b in self.blobs)
 
+        prefetch_buf = b""
+        for path in self.prefetch:
+            ino = ino_by_path.get(path)
+            if ino is None:
+                raise BootstrapError(f"prefetch path {path!r} not in tree")
+            prefetch_buf += struct.pack("<I", ino)
+
         if self.ciphers and len(self.ciphers) != len(self.blobs):
             raise BootstrapError(
                 f"cipher table has {len(self.ciphers)} entries for "
@@ -389,7 +406,8 @@ class Bootstrap:
         blob_table_off = chunk_table_off + len(chunk_buf)
         cipher_table_off = blob_table_off + len(blob_buf)
         batch_table_off = cipher_table_off + len(cipher_buf)
-        heap_off = batch_table_off + len(batch_buf)
+        prefetch_table_off = batch_table_off + len(batch_buf)
+        heap_off = prefetch_table_off + len(prefetch_buf)
 
         magic = (
             layout.RAFS_V5_SUPER_MAGIC
@@ -397,8 +415,10 @@ class Bootstrap:
             else layout.RAFS_V6_SUPER_MAGIC
         )
         sb_version = SUPER_VERSION_V5 if self.version == layout.RAFS_V5 else SUPER_VERSION_V6
-        features = (FEATURE_CIPHER_TABLE if has_ciphers else 0) | (
-            FEATURE_BATCH_TABLE if self.batches else 0
+        features = (
+            (FEATURE_CIPHER_TABLE if has_ciphers else 0)
+            | (FEATURE_BATCH_TABLE if self.batches else 0)
+            | (FEATURE_PREFETCH_TABLE if self.prefetch else 0)
         )
         sb = _SB_STRUCT.pack(
             magic,
@@ -428,6 +448,12 @@ class Bootstrap:
                 + _SB_BATCH_STRUCT.pack(batch_table_off, len(self.batches))
                 + sb[_SB_BATCH_OFF + _SB_BATCH_STRUCT.size :]
             )
+        if self.prefetch:
+            sb = (
+                sb[:_SB_PREFETCH_OFF]
+                + _SB_PREFETCH_STRUCT.pack(prefetch_table_off, len(self.prefetch))
+                + sb[_SB_PREFETCH_OFF + _SB_PREFETCH_STRUCT.size :]
+            )
 
         header = bytearray(header_size)
         if self.version == layout.RAFS_V5:
@@ -443,6 +469,7 @@ class Bootstrap:
             + blob_buf
             + cipher_buf
             + batch_buf
+            + prefetch_buf
             + bytes(heap)
         )
 
@@ -480,6 +507,11 @@ class Bootstrap:
             batch_table_off, batch_count = _SB_BATCH_STRUCT.unpack_from(
                 buf, sb_off + _SB_BATCH_OFF
             )
+        prefetch_table_off = prefetch_count = 0
+        if features & FEATURE_PREFETCH_TABLE:
+            prefetch_table_off, prefetch_count = _SB_PREFETCH_STRUCT.unpack_from(
+                buf, sb_off + _SB_PREFETCH_OFF
+            )
 
         # A foreign bootstrap (e.g. one written by the Rust nydus-image) or a
         # truncated file can share the magic while carrying garbage fields —
@@ -496,6 +528,7 @@ class Bootstrap:
             ("blob", blob_table_off, blob_count, BLOB_SIZE_BYTES),
             ("cipher", cipher_table_off, cipher_count, CIPHER_SIZE_BYTES),
             ("batch", batch_table_off, batch_count, BATCH_SIZE_BYTES),
+            ("prefetch", prefetch_table_off, prefetch_count, 4),
             ("heap", heap_off, heap_size, 1),
         ):
             if off + count * rec_size > len(buf):
@@ -605,6 +638,13 @@ class Bootstrap:
             )
             for i in range(batch_count)
         ]
+        prefetch: list[str] = []
+        for i in range(prefetch_count):
+            (ino,) = struct.unpack_from("<I", buf, prefetch_table_off + i * 4)
+            path = paths_by_ino.get(ino)
+            if not path:
+                raise BootstrapError(f"prefetch entry references unknown inode {ino}")
+            prefetch.append(path)
         return cls(
             version=version,
             chunk_size=chunk_size,
@@ -613,6 +653,7 @@ class Bootstrap:
             blobs=blobs,
             ciphers=ciphers,
             batches=batches,
+            prefetch=prefetch,
         )
 
     # -- views --------------------------------------------------------------

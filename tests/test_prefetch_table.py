@@ -1,0 +1,122 @@
+"""Prefetch tables: pattern matching at pack time, warm-up at mount time.
+
+Reference flow: access traces feed ``--prefetch-files`` into the builder
+(docs/optimize_nydus_image.md), the bootstrap carries a prefetch table, and
+nydusd warms those files at mount (daemon_adaptor.go:179-185 passes the
+list; the blobcache metric reports prefetch_data_amount)."""
+
+import io
+import json
+import os
+import tarfile
+import time
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu.converter.convert import (
+    Merge,
+    match_prefetch_paths,
+    pack_layer,
+)
+from nydus_snapshotter_tpu.converter.types import MergeOption, PackOption
+from nydus_snapshotter_tpu.models.bootstrap import Bootstrap, BootstrapError
+
+from tests.test_converter import build_tar, _rand
+
+RNG = np.random.default_rng(0xFE7C)
+
+
+class TestBootstrapTable:
+    def test_roundtrip_preserves_order(self):
+        src = build_tar(
+            [("bin/app", _rand(10_000)), ("etc/conf", b"k=v"), ("var/log", b"x")],
+            dirs=["bin", "etc", "var"],
+        )
+        opt = PackOption(chunk_size=0x1000, prefetch_patterns="etc\nbin/app\n")
+        _, res = pack_layer(src, opt)
+        bs = Bootstrap.from_bytes(res.bootstrap)
+        assert bs.prefetch == ["/etc/conf", "/bin/app"]
+        # re-serialize: identical table
+        assert Bootstrap.from_bytes(bs.to_bytes()).prefetch == bs.prefetch
+
+    def test_directory_pattern_expands_to_files(self):
+        inodes = Bootstrap.from_bytes(
+            pack_layer(
+                build_tar(
+                    [("app/a", b"1"), ("app/sub/b", b"2"), ("other/c", b"3")],
+                    dirs=["app", "app/sub", "other"],
+                ),
+                PackOption(chunk_size=0x1000),
+            )[1].bootstrap
+        ).inodes
+        assert match_prefetch_paths(inodes, "app") == ["/app/a", "/app/sub/b"]
+        assert match_prefetch_paths(inodes, "/") == ["/app/a", "/app/sub/b", "/other/c"]
+        assert match_prefetch_paths(inodes, "missing\napp/sub/") == ["/app/sub/b"]
+
+    def test_unknown_prefetch_inode_rejected_on_parse(self):
+        src = build_tar([("f", b"data")])
+        _, res = pack_layer(src, PackOption(chunk_size=0x1000, prefetch_patterns="f"))
+        buf = bytearray(res.bootstrap)
+        # find the prefetch table: single u32 entry; corrupt it to a huge ino
+        bs = Bootstrap.from_bytes(bytes(buf))
+        assert bs.prefetch == ["/f"]
+        import struct
+
+        # superblock at 1024 for v6; prefetch off/count at _SB offset 120
+        off, count = struct.unpack_from("<II", buf, 1024 + 120)
+        assert count == 1
+        struct.pack_into("<I", buf, off, 9999)
+        with pytest.raises(BootstrapError):
+            Bootstrap.from_bytes(bytes(buf))
+
+    def test_merge_carries_patterns(self):
+        b1, _ = pack_layer(build_tar([("a/x", _rand(5000))], dirs=["a"]),
+                           PackOption(chunk_size=0x1000))
+        b2, _ = pack_layer(build_tar([("b/y", _rand(5000))], dirs=["b"]),
+                           PackOption(chunk_size=0x1000))
+        m = Merge([b1, b2], MergeOption(prefetch_patterns="b\na/x"))
+        bs = Bootstrap.from_bytes(m.bootstrap)
+        assert bs.prefetch == ["/b/y", "/a/x"]
+
+
+class TestDaemonWarmup:
+    def test_mount_warms_prefetch_and_reports_amount(self, tmp_path):
+        from nydus_snapshotter_tpu.converter.convert import blob_data_from_layer_blob
+        from tests.test_fusedev import _spawn_daemon
+
+        payload = RNG.integers(0, 256, 300_000, dtype=np.uint8).tobytes()
+        src = build_tar(
+            [("warm/data.bin", payload), ("cold/other.bin", _rand(100_000))],
+            dirs=["warm", "cold"],
+        )
+        blob, res = pack_layer(
+            src, PackOption(chunk_size=0x1000, prefetch_patterns="warm\n")
+        )
+        blob_dir = tmp_path / "blobs"
+        blob_dir.mkdir()
+        (blob_dir / res.blob_id).write_bytes(blob_data_from_layer_blob(blob))
+        boot = tmp_path / "image.boot"
+        boot.write_bytes(res.bootstrap)
+        mp = tmp_path / "mnt"
+        mp.mkdir()
+
+        proc, cli = _spawn_daemon(str(tmp_path), "prefetch-d")
+        try:
+            cfg = json.dumps(
+                {"device": {"backend": {"config": {"blob_dir": str(blob_dir)}}}}
+            )
+            cli.mount(str(mp), str(boot), cfg)
+            deadline = time.time() + 10
+            amount = 0
+            while time.time() < deadline:
+                amount = cli.cache_metrics().get("prefetch_data_amount", 0)
+                if amount >= len(payload):
+                    break
+                time.sleep(0.1)
+            assert amount == len(payload), (
+                f"prefetch warmed {amount} bytes, wanted {len(payload)}"
+            )
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
