@@ -41,3 +41,32 @@ class PrefetchManager:
 
 
 Pm = PrefetchManager()
+
+
+def patterns_from_trace(trace_path: str, strip_prefix: str = "") -> str:
+    """Turn an optimizer access trace (one accessed path per line, the
+    fanotify receiver's persist file) into converter prefetch patterns.
+
+    This closes the reference's optimization loop (optimizer-nri-plugin →
+    accessed-file list → ``nydus-image --prefetch-files``,
+    docs/optimize_nydus_image.md): feed the result to
+    ``PackOption.prefetch_patterns`` / ``MergeOption.prefetch_patterns``.
+    Order is preserved (first access first — that IS the prefetch
+    priority), duplicates dropped, ``strip_prefix`` removes a container
+    rootfs mount prefix so paths are image-relative.
+    """
+    seen: set[str] = set()
+    out: list[str] = []
+    with open(trace_path) as f:
+        for line in f:
+            path = line.strip()
+            if not path:
+                continue
+            if strip_prefix and path.startswith(strip_prefix):
+                path = path[len(strip_prefix):] or "/"
+            if not path.startswith("/"):
+                path = "/" + path
+            if path not in seen:
+                seen.add(path)
+                out.append(path)
+    return "\n".join(out)

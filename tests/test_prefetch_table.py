@@ -120,3 +120,28 @@ class TestDaemonWarmup:
         finally:
             proc.terminate()
             proc.wait(timeout=10)
+
+
+class TestTraceToPatterns:
+    def test_trace_file_closes_the_optimizer_loop(self, tmp_path):
+        """fanotify trace -> prefetch patterns -> packed table, end to end
+        (the reference's optimize_nydus_image.md flow)."""
+        from nydus_snapshotter_tpu.prefetch.prefetch import patterns_from_trace
+
+        trace = tmp_path / "app:latest"
+        trace.write_text(
+            "/rootfs/bin/app\n/rootfs/etc/conf\n/rootfs/bin/app\n\n/rootfs/lib/so\n"
+        )
+        patterns = patterns_from_trace(str(trace), strip_prefix="/rootfs")
+        assert patterns == "/bin/app\n/etc/conf\n/lib/so"
+
+        src = build_tar(
+            [("bin/app", _rand(4000)), ("etc/conf", b"k=v"), ("lib/so", _rand(2000)),
+             ("bin/unused", b"cold")],
+            dirs=["bin", "etc", "lib"],
+        )
+        _, res = pack_layer(
+            src, PackOption(chunk_size=0x1000, prefetch_patterns=patterns)
+        )
+        bs = Bootstrap.from_bytes(res.bootstrap)
+        assert bs.prefetch == ["/bin/app", "/etc/conf", "/lib/so"]
