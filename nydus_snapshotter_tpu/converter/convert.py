@@ -139,6 +139,9 @@ class BlobReader:
         self._batch_lock = threading.Lock()
         self._batch_cache: "OrderedDict[int, bytes]" = OrderedDict()
         self._batch_cache_bytes = 0
+        # OCIRef blobs: a checkpointed cursor into the original gzip stream.
+        self._gzip_stream = None
+        self._gzip_lock = threading.Lock()
 
     def _read_plain(self, offset: int, size: int) -> bytes:
         raw = self.read_at(offset, size)
@@ -155,6 +158,23 @@ class BlobReader:
         """The uncompressed data of one chunk record."""
         if rec.blob_index != self.blob_index:
             raise ConvertError("chunk record belongs to a different blob")
+        from nydus_snapshotter_tpu.converter.zran import (
+            CHUNK_FLAG_GZIP_STREAM,
+            GzipStreamReader,
+        )
+
+        if rec.flags & CHUNK_FLAG_GZIP_STREAM:
+            # OCIRef: offsets address the decompressed stream of the
+            # original .tar.gz blob (converter/zran.py).
+            with self._gzip_lock:
+                if self._gzip_stream is None:
+                    self._gzip_stream = GzipStreamReader(
+                        self._read_plain,
+                        self.bootstrap.blobs[self.blob_index].compressed_size,
+                    )
+                return self._gzip_stream.read_range(
+                    rec.uncompressed_offset, rec.uncompressed_size
+                )
         if rec.flags & CHUNK_FLAG_BATCH:
             extent = self._batch_map.get((self.blob_index, rec.compressed_offset))
             if extent is None:

@@ -124,8 +124,37 @@ def layer_convert_func(
             return make_blob_desc(cs, opt, desc.digest, cached)
 
         raw = cs.read(desc.digest)
-        tar_bytes = raw if opt.oci_ref else decompress_stream(raw)
-        blob_stream, _result = convert.pack_layer(tar_bytes, opt)
+        if opt.oci_ref:
+            # zran shape (create --type targz-ref, builder.go:180-218): the
+            # original .tar.gz stays the only data artifact; the converted
+            # "blob" is a bootstrap-only stream indexing its decompressed
+            # content (converter/zran.py).
+            from nydus_snapshotter_tpu.converter import zran
+            from nydus_snapshotter_tpu.models import nydus_tar, toc as toc_mod
+
+            bs = zran.pack_gzip_layer(raw, opt)
+            boot_bytes = bs.to_bytes()
+            toc_bytes = toc_mod.pack_toc(
+                [
+                    toc_mod.TOCEntry(
+                        name=toc_mod.ENTRY_BOOTSTRAP,
+                        flags=C.COMPRESSOR_NONE,
+                        uncompressed_digest=hashlib.sha256(boot_bytes).digest(),
+                        compressed_offset=0,
+                        compressed_size=len(boot_bytes),
+                        uncompressed_size=len(boot_bytes),
+                    )
+                ]
+            )
+            blob_stream = nydus_tar.pack_entries(
+                [
+                    (toc_mod.ENTRY_BOOTSTRAP, boot_bytes),
+                    (toc_mod.ENTRY_BLOB_TOC, toc_bytes),
+                ]
+            )
+        else:
+            tar_bytes = decompress_stream(raw)
+            blob_stream, _result = convert.pack_layer(tar_bytes, opt)
         blob_digest = "sha256:" + hashlib.sha256(blob_stream).hexdigest()
         cs.write_blob(blob_stream, expected_digest=blob_digest)
         cs.update_labels(

@@ -62,8 +62,12 @@ def patterns_from_trace(trace_path: str, strip_prefix: str = "") -> str:
             path = line.strip()
             if not path:
                 continue
-            if strip_prefix and path.startswith(strip_prefix):
-                path = path[len(strip_prefix):] or "/"
+            if strip_prefix:
+                # Path-boundary-aware: "/rootfs" must not mangle "/rootfs2".
+                if path == strip_prefix:
+                    path = "/"
+                elif path.startswith(strip_prefix + "/"):
+                    path = path[len(strip_prefix):]
             if not path.startswith("/"):
                 path = "/" + path
             if path not in seen:

@@ -1,0 +1,233 @@
+"""OCIRef ("zran") conversion: index the original tar.gz, store nothing.
+
+Reference surface: ``PackOption.OCIRef`` → ``create --type targz-ref``
+(tool/builder.go:180-218), smoke TestPackRef. The original compressed
+layer stays the only data artifact; the bootstrap indexes the decompressed
+content and the runtime reads lazily out of the gzip stream."""
+
+import gzip
+import hashlib
+import io
+import tarfile
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu.converter.convert import BlobReader, Unpack
+from nydus_snapshotter_tpu.converter.types import ConvertError, PackOption
+from nydus_snapshotter_tpu.converter.zran import (
+    GzipStreamReader,
+    pack_gzip_layer,
+)
+from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
+
+RNG = np.random.default_rng(0x02A4)
+
+
+def mk_targz(files: dict[str, bytes]) -> tuple[bytes, bytes]:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+        for name, data in files.items():
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+    tar = buf.getvalue()
+    return gzip.compress(tar), tar
+
+
+class TestGzipStreamReader:
+    def test_random_access_matches_plain_decompress(self):
+        plain = RNG.integers(0, 256, 3_000_000, dtype=np.uint8).tobytes()
+        comp = gzip.compress(plain)
+        r = GzipStreamReader(lambda o, s: comp[o : o + s], len(comp))
+        # touch out of order: end, start, middle, across checkpoint steps
+        for off, size in [
+            (len(plain) - 500, 500),
+            (0, 1000),
+            (1_500_000, 10_000),
+            (2_999_000, 1000),
+            (100, 64),
+        ]:
+            assert r.read_range(off, size) == plain[off : off + size], (off, size)
+
+    def test_checkpoints_make_rereads_cheap(self):
+        plain = RNG.integers(0, 256, 20_000_000, dtype=np.uint8).tobytes()
+        comp = gzip.compress(plain, compresslevel=1)
+        calls = []
+
+        def read_at(o, s):
+            calls.append((o, s))
+            return comp[o : o + s]
+
+        r = GzipStreamReader(read_at, len(comp))
+        r.read_range(19_000_000, 1000)  # first touch: full scan
+        first_scan = len(calls)
+        calls.clear()
+        r.read_range(18_900_000, 1000)  # near a checkpoint now
+        assert len(calls) < first_scan / 4, (len(calls), first_scan)
+
+    def test_out_of_range_raises(self):
+        comp = gzip.compress(b"short")
+        r = GzipStreamReader(lambda o, s: comp[o : o + s], len(comp))
+        with pytest.raises(ConvertError):
+            r.read_range(3, 100)
+
+
+class TestPackGzipLayer:
+    FILES = {
+        "app/big.bin": RNG.integers(0, 256, 2_500_000, dtype=np.uint8).tobytes(),
+        "app/small.txt": b"ref layer\n",
+        "etc/conf": b"a=b\n",
+    }
+
+    def test_bootstrap_references_original_blob(self):
+        raw, tar = mk_targz(self.FILES)
+        bs = pack_gzip_layer(raw, PackOption(chunk_size=0x100000, oci_ref=True))
+        assert len(bs.blobs) == 1
+        assert bs.blobs[0].blob_id == hashlib.sha256(raw).hexdigest()
+        assert bs.blobs[0].compressed_size == len(raw)
+        assert bs.blobs[0].uncompressed_size == len(tar)
+        # round-trips through serialization
+        bs2 = Bootstrap.from_bytes(bs.to_bytes())
+        assert {i.path for i in bs2.inodes} >= {"/app/big.bin", "/etc/conf"}
+
+    def test_lazy_reads_through_blob_reader(self):
+        raw, _ = mk_targz(self.FILES)
+        bs = pack_gzip_layer(raw, PackOption(chunk_size=0x100000, oci_ref=True))
+        reader = BlobReader(bs, 0, lambda o, s: raw[o : o + s])
+        by_path = bs.inode_by_path()
+        for name, want in self.FILES.items():
+            ino = by_path["/" + name]
+            got = b"".join(
+                reader.chunk_data(c)
+                for c in bs.chunks[ino.chunk_index : ino.chunk_index + ino.chunk_count]
+            )
+            assert got == want, name
+
+    def test_unpack_rebuilds_the_tar_content(self):
+        raw, _ = mk_targz(self.FILES)
+        bs = pack_gzip_layer(raw, PackOption(chunk_size=0x100000, oci_ref=True))
+        out = Unpack(bs, {bs.blobs[0].blob_id: raw})
+        with tarfile.open(fileobj=io.BytesIO(out)) as tf:
+            for name, want in self.FILES.items():
+                assert tf.extractfile(name).read() == want, name
+
+    def test_not_gzip_rejected(self):
+        with pytest.raises(ConvertError):
+            pack_gzip_layer(b"plain tar, not gzip", PackOption(chunk_size=0x1000))
+
+    def test_chunk_digests_cover_decompressed_content(self):
+        raw, tar = mk_targz(self.FILES)
+        bs = pack_gzip_layer(raw, PackOption(chunk_size=0x100000, oci_ref=True))
+        by_path = bs.inode_by_path()
+        ino = by_path["/app/small.txt"]
+        rec = bs.chunks[ino.chunk_index]
+        assert rec.digest == hashlib.sha256(b"ref layer\n").digest()
+
+
+class TestHooksOciRef:
+    def test_layer_convert_keeps_original_and_emits_ref_layer(self, tmp_path):
+        from nydus_snapshotter_tpu import constants as C
+        from nydus_snapshotter_tpu.converter.content import LocalContentStore
+        from nydus_snapshotter_tpu.converter.convert import bootstrap_from_layer_blob
+        from nydus_snapshotter_tpu.converter.hooks import layer_convert_func
+        from nydus_snapshotter_tpu.remote.registry import Descriptor
+
+        raw, _ = mk_targz(TestPackGzipLayer.FILES)
+        cs = LocalContentStore(str(tmp_path))
+        digest = "sha256:" + hashlib.sha256(raw).hexdigest()
+        cs.write_blob(raw, expected_digest=digest)
+        desc = Descriptor(
+            media_type="application/vnd.oci.image.layer.v1.tar+gzip",
+            digest=digest,
+            size=len(raw),
+        )
+        fn = layer_convert_func(PackOption(chunk_size=0x100000, oci_ref=True))
+        new_desc = fn(cs, desc)
+        assert new_desc is not None
+        assert new_desc.annotations[C.NYDUS_REF_LAYER] == digest
+        stream = cs.read(new_desc.digest)
+        bs = bootstrap_from_layer_blob(stream)
+        # the converted stream is metadata-only: it references the ORIGINAL
+        # layer digest, and stores no data section of its own
+        assert bs.blobs[0].blob_id == digest.split(":")[1]
+        assert len(stream) < len(raw) / 2, "oci_ref must not re-store data"
+
+
+class TestMultiMemberAndDuplicates:
+    def test_multi_member_gzip_reads_past_first_member(self):
+        """pigz/eStargz-style concatenated gzip members: chunks span the
+        joined decompressed stream and reads must cross member boundaries."""
+        a = RNG.integers(0, 256, 120_000, dtype=np.uint8).tobytes()
+        b = RNG.integers(0, 256, 130_000, dtype=np.uint8).tobytes()
+        comp = gzip.compress(a) + gzip.compress(b)
+        plain = a + b
+        r = GzipStreamReader(lambda o, s: comp[o : o + s], len(comp))
+        for off, size in [
+            (len(a) - 50, 100),       # straddles the member boundary
+            (len(a) + 1000, 5000),    # entirely in member 2
+            (len(plain) - 10, 10),
+            (0, 64),
+        ]:
+            assert r.read_range(off, size) == plain[off : off + size], (off, size)
+
+    def test_multi_member_layer_packs_and_reads(self):
+        tar_a = io.BytesIO()
+        with tarfile.open(fileobj=tar_a, mode="w", format=tarfile.GNU_FORMAT) as tf:
+            ti = tarfile.TarInfo("first.bin")
+            data1 = RNG.integers(0, 256, 90_000, dtype=np.uint8).tobytes()
+            ti.size = len(data1)
+            tf.addfile(ti, io.BytesIO(data1))
+            ti = tarfile.TarInfo("second.bin")
+            data2 = RNG.integers(0, 256, 70_000, dtype=np.uint8).tobytes()
+            ti.size = len(data2)
+            tf.addfile(ti, io.BytesIO(data2))
+        whole = tar_a.getvalue()
+        # split the compressed form into two members mid-stream
+        comp = gzip.compress(whole[:100_000]) + gzip.compress(whole[100_000:])
+        bs = pack_gzip_layer(comp, PackOption(chunk_size=0x10000, oci_ref=True))
+        reader = BlobReader(bs, 0, lambda o, s: comp[o : o + s])
+        by_path = bs.inode_by_path()
+        for name, want in (("/first.bin", data1), ("/second.bin", data2)):
+            ino = by_path[name]
+            got = b"".join(
+                reader.chunk_data(c)
+                for c in bs.chunks[ino.chunk_index : ino.chunk_index + ino.chunk_count]
+            )
+            assert got == want, name
+
+    def test_duplicate_tar_path_last_wins(self):
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+            for payload in (b"OLDOLDOLD", b"NEW"):
+                ti = tarfile.TarInfo("a/f")
+                ti.size = len(payload)
+                tf.addfile(ti, io.BytesIO(payload))
+        raw = gzip.compress(buf.getvalue())
+        bs = pack_gzip_layer(raw, PackOption(chunk_size=0x1000, oci_ref=True))
+        ino = bs.inode_by_path()["/a/f"]
+        assert ino.size == 3
+        reader = BlobReader(bs, 0, lambda o, s: raw[o : o + s])
+        got = b"".join(
+            reader.chunk_data(c)
+            for c in bs.chunks[ino.chunk_index : ino.chunk_index + ino.chunk_count]
+        )
+        assert got == b"NEW"
+
+    def test_zran_carries_prefetch_patterns(self):
+        raw, _ = mk_targz(TestPackGzipLayer.FILES)
+        bs = pack_gzip_layer(
+            raw,
+            PackOption(chunk_size=0x100000, oci_ref=True, prefetch_patterns="app\n"),
+        )
+        assert bs.prefetch == ["/app/big.bin", "/app/small.txt"]
+
+
+def test_strip_prefix_is_path_boundary_aware(tmp_path):
+    from nydus_snapshotter_tpu.prefetch.prefetch import patterns_from_trace
+
+    trace = tmp_path / "t"
+    trace.write_text("/rootfs/bin/app\n/rootfs2/evil\n/rootfs\n")
+    assert patterns_from_trace(str(trace), strip_prefix="/rootfs") == (
+        "/bin/app\n/rootfs2/evil\n/"
+    )
