@@ -520,6 +520,29 @@ def Unpack(
     return fstree.tar_from_tree(entries)
 
 
+def frame_bootstrap_only(boot_bytes: bytes) -> bytes:
+    """Frame a metadata-only layer stream (image.boot + TOC, no data
+    section) — the OCIRef/zran layer shape, consumable by Merge like any
+    packed layer."""
+    import hashlib as _hashlib
+
+    toc_bytes = toc.pack_toc(
+        [
+            toc.TOCEntry(
+                name=toc.ENTRY_BOOTSTRAP,
+                flags=constants.COMPRESSOR_NONE,
+                uncompressed_digest=_hashlib.sha256(boot_bytes).digest(),
+                compressed_offset=0,
+                compressed_size=len(boot_bytes),
+                uncompressed_size=len(boot_bytes),
+            )
+        ]
+    )
+    return nydus_tar.pack_entries(
+        [(toc.ENTRY_BOOTSTRAP, boot_bytes), (toc.ENTRY_BLOB_TOC, toc_bytes)]
+    )
+
+
 def blob_data_from_layer_blob(blob: bytes) -> bytes:
     """Extract the image.blob section from a packed layer stream ('' if none)."""
     f = io.BytesIO(blob)

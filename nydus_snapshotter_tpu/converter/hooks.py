@@ -130,28 +130,9 @@ def layer_convert_func(
             # "blob" is a bootstrap-only stream indexing its decompressed
             # content (converter/zran.py).
             from nydus_snapshotter_tpu.converter import zran
-            from nydus_snapshotter_tpu.models import nydus_tar, toc as toc_mod
 
             bs = zran.pack_gzip_layer(raw, opt)
-            boot_bytes = bs.to_bytes()
-            toc_bytes = toc_mod.pack_toc(
-                [
-                    toc_mod.TOCEntry(
-                        name=toc_mod.ENTRY_BOOTSTRAP,
-                        flags=C.COMPRESSOR_NONE,
-                        uncompressed_digest=hashlib.sha256(boot_bytes).digest(),
-                        compressed_offset=0,
-                        compressed_size=len(boot_bytes),
-                        uncompressed_size=len(boot_bytes),
-                    )
-                ]
-            )
-            blob_stream = nydus_tar.pack_entries(
-                [
-                    (toc_mod.ENTRY_BOOTSTRAP, boot_bytes),
-                    (toc_mod.ENTRY_BLOB_TOC, toc_bytes),
-                ]
-            )
+            blob_stream = convert.frame_bootstrap_only(bs.to_bytes())
         else:
             tar_bytes = decompress_stream(raw)
             blob_stream, _result = convert.pack_layer(tar_bytes, opt)

@@ -77,7 +77,6 @@ class GzipStreamReader:
         upos, cpos, d, pending = self._best_checkpoint(offset)
         out = bytearray()
         last_checkpoint = upos - (upos % _CHECKPOINT_STEP)
-        stalled = 0
         while upos < offset + size:
             if d.eof:
                 # Multi-member gzip (pigz, eStargz, concatenated members):
@@ -105,11 +104,7 @@ class GzipStreamReader:
                 except zlib.error as e:
                     raise ConvertError(f"corrupt gzip stream: {e}") from e
             if not chunk:
-                stalled += 1
-                if stalled > 4 and not pending and cpos >= self._csize and not d.eof:
-                    break  # truncated stream: nothing more will come
                 continue
-            stalled = 0
             lo = max(0, offset - upos)
             hi = min(len(chunk), offset + size - upos)
             if hi > lo:
@@ -128,16 +123,21 @@ class GzipStreamReader:
         return bytes(out)
 
 
-def pack_gzip_layer(raw_gzip: bytes, opt: PackOption, engine=None):
+def pack_gzip_layer(raw_gzip: bytes, opt: PackOption, engine=None) -> Bootstrap:
     """Index an original ``.tar.gz`` layer without re-storing its data.
 
-    Returns (bootstrap, PackResult-shape fields) where the bootstrap's
-    single blob IS the original compressed layer (blob id = its sha256).
-    The decompressed stream is chunked per-file (the reference's targz-ref
-    chunks the uncompressed content) and digested through ``engine`` when
-    supplied (batched/device) or hashlib otherwise.
+    Returns the layer Bootstrap, whose single blob IS the original
+    compressed layer (blob id = its sha256). The decompressed stream is
+    chunked per-file (the reference's targz-ref chunks the uncompressed
+    content) and digested through ``engine`` when supplied
+    (batched/device) or hashlib otherwise.
     """
     opt.validate()
+    if opt.encrypt:
+        # The original registry blob stays authoritative and plaintext;
+        # claiming encryption would mislabel it (hooks annotates encrypted
+        # blobs) and consumers would decrypt plaintext into garbage.
+        raise ConvertError("oci_ref cannot be combined with encrypt")
     try:
         tar_bytes = gzip.decompress(raw_gzip)
     except (OSError, EOFError, zlib.error) as e:
@@ -151,9 +151,32 @@ def pack_gzip_layer(raw_gzip: bytes, opt: PackOption, engine=None):
     spans: dict[str, tuple[int, int]] = {}
     import tarfile as tarfile_mod
 
+    opaque_dirs: list[str] = []
     tf = tarfile_mod.open(fileobj=io.BytesIO(tar_bytes), mode="r:")
     for info in tf:
         path = fstree._norm(info.name)
+        base = path.rsplit("/", 1)[1] if path != "/" else "/"
+        # Overlay markers get the same RAFS normalization as every other
+        # pack path (fstree.tree_from_tar / tarfs/bootstrap.py) — literal
+        # .wh. files would resurrect deleted content after Merge.
+        if base == fstree.OPAQUE_MARKER:
+            opaque_dirs.append(path.rsplit("/", 1)[0] or "/")
+            continue
+        if base.startswith(fstree.WHITEOUT_PREFIX):
+            target = fstree._norm(
+                path.rsplit("/", 1)[0] + "/" + base[len(fstree.WHITEOUT_PREFIX):]
+            )
+            entries[target] = fstree.FileEntry(
+                path=target, mode=0o020000, flags=fstree.INODE_FLAG_WHITEOUT
+            )
+            spans.pop(target, None)
+            continue
+        if getattr(info, "sparse", None):
+            # GNU sparse members store only the compacted data region; the
+            # in-place chunk extents would read neighbouring tar bytes.
+            raise ConvertError(
+                f"sparse tar member {info.name!r} cannot be indexed in place"
+            )
         entry = fstree.entry_from_tarinfo(tf, info, path, with_data=False)
         entries[path] = entry
         spans.pop(path, None)
@@ -167,6 +190,12 @@ def pack_gzip_layer(raw_gzip: bytes, opt: PackOption, engine=None):
                 off += step
                 remaining -= step
             spans[path] = (start, len(chunk_meta) - start)
+
+    for d in opaque_dirs:
+        if d not in entries:
+            entries[d] = fstree.FileEntry(path=d, mode=0o040755)
+        entries[d].flags |= fstree.INODE_FLAG_OPAQUE
+        entries[d].xattrs[fstree.OPAQUE_XATTR] = b"y"
 
     ordered = fstree.ensure_parents(sorted(entries.values(), key=lambda e: e.path))
 

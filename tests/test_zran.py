@@ -231,3 +231,60 @@ def test_strip_prefix_is_path_boundary_aware(tmp_path):
     assert patterns_from_trace(str(trace), strip_prefix="/rootfs") == (
         "/bin/app\n/rootfs2/evil\n/"
     )
+
+
+class TestZranOverlaySemantics:
+    def test_whiteouts_and_opaque_normalized(self):
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+            for name, data in (
+                ("app/keep", b"k"),
+                ("app/.wh.deleted", b""),
+                ("app/.wh..wh..opq", b""),
+            ):
+                ti = tarfile.TarInfo(name)
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        raw = gzip.compress(buf.getvalue())
+        bs = pack_gzip_layer(raw, PackOption(chunk_size=0x1000, oci_ref=True))
+        from nydus_snapshotter_tpu.models.fstree import (
+            INODE_FLAG_OPAQUE,
+            INODE_FLAG_WHITEOUT,
+        )
+
+        by_path = bs.inode_by_path()
+        assert "/app/.wh.deleted" not in by_path
+        assert "/app/.wh..wh..opq" not in by_path
+        assert by_path["/app/deleted"].flags & INODE_FLAG_WHITEOUT
+        assert by_path["/app"].flags & INODE_FLAG_OPAQUE
+
+    def test_sparse_member_rejected(self):
+        import struct as structmod
+
+        # hand-build a GNU sparse header (type 'S')
+        name = b"sparse.bin".ljust(100, b"\0")
+        hdr = bytearray(512)
+        hdr[0:100] = name
+        hdr[100:108] = b"0000644\x00"
+        hdr[108:116] = b"0000000\x00"
+        hdr[116:124] = b"0000000\x00"
+        hdr[124:136] = b"00000000100\x00"  # 64 bytes of stored data
+        hdr[136:148] = b"00000000000\x00"
+        hdr[156] = ord("S")  # GNUTYPE_SPARSE
+        hdr[257:265] = b"ustar  \x00"
+        # sparse map: one region (offset 0, numbytes 64), realsize 1MB
+        hdr[386:398] = b"00000000000\x00"
+        hdr[398:410] = b"00000000100\x00"
+        hdr[483:495] = b"00004000000\x00"  # realsize
+        chksum = sum(hdr) - sum(hdr[148:156]) + 8 * 0x20
+        hdr[148:156] = ("%06o\0 " % chksum).encode()
+        tar = bytes(hdr) + b"x" * 64 + b"\0" * (512 - 64) + b"\0" * 1024
+        with pytest.raises(ConvertError):
+            pack_gzip_layer(gzip.compress(tar), PackOption(chunk_size=0x1000))
+
+    def test_encrypt_rejected(self):
+        raw, _ = mk_targz({"f": b"x"})
+        with pytest.raises(ConvertError):
+            pack_gzip_layer(
+                raw, PackOption(chunk_size=0x1000, oci_ref=True, encrypt=True)
+            )
