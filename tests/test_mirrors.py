@@ -92,3 +92,20 @@ class TestLoadMirrors:
         assert cfg.backend.host == "ghcr.io"
         assert len(cfg.backend.mirrors) == 2
         assert cfg.backend.mirrors[0].host == "https://mirror-a.example.com"
+
+
+def test_shipped_example_configs_parse():
+    """misc/snapshotter configs must never rot out of sync with the
+    parser (the reference ships the same artifacts)."""
+    import os
+
+    from nydus_snapshotter_tpu.config.config import load_config
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for name, driver in (
+        ("config.toml", "fusedev"),
+        ("config-tarfs.toml", "blockdev"),
+    ):
+        cfg = load_config(os.path.join(repo, "misc", "snapshotter", name))
+        cfg.validate()
+        assert cfg.daemon.fs_driver == driver
