@@ -131,7 +131,20 @@ def _sha256_batch_jit(blocks: jax.Array, nblocks: jax.Array, unroll: bool) -> ja
 
 
 def sha256_batch(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
-    """Digest a batch: blocks u32[M,B,16], nblocks i32[M] -> u32[M,8]."""
+    """Digest a batch: blocks u32[M,B,16], nblocks i32[M] -> u32[M,8].
+
+    ``NTPU_SHA_PALLAS=1`` routes large TPU batches through the Pallas
+    kernel (ops/sha256_pallas.py) — opt-in until its throughput is
+    measured against the XLA scan on real hardware (tools/devbench.py
+    --stage sha measures both).
+    """
+    import os
+
+    if os.environ.get("NTPU_SHA_PALLAS"):
+        from nydus_snapshotter_tpu.ops import sha256_pallas
+
+        if sha256_pallas.supported(blocks.shape[0]):
+            return sha256_pallas.sha256_batch_pallas(blocks, nblocks)
     unroll = jax.default_backend() != "cpu"
     return _sha256_batch_jit(blocks, nblocks, unroll)
 

@@ -112,6 +112,33 @@ def bench_sha(total_mib: int, chunk_kib: int = 64):
     }
 
 
+def bench_sha_pallas(total_mib: int, chunk_kib: int = 64):
+    import jax
+    import jax.numpy as jnp
+
+    from nydus_snapshotter_tpu.ops import sha256, sha256_pallas
+
+    chunk = chunk_kib << 10
+    m = max(1024, (total_mib << 20) // chunk)
+    cap = sha256.n_padded_blocks(chunk)
+    rng = np.random.default_rng(1)
+    blocks = rng.integers(0, 2**32, (m, cap, 16), dtype=np.uint32)
+    blocks2 = rng.integers(0, 2**32, (m, cap, 16), dtype=np.uint32)
+    counts = np.full(m, cap, dtype=np.int32)
+    bj, cj = jnp.asarray(blocks), jnp.asarray(counts)
+    bj2 = jnp.asarray(blocks2)
+
+    dt, _ = timeit(sha256_pallas.sha256_batch_pallas, (bj, cj), (bj2, cj))
+    nbytes = m * chunk
+    return {
+        "stage": "sha256-pallas",
+        "gibps": round(nbytes / dt / (1 << 30), 3),
+        "ms": round(dt * 1e3, 2),
+        "shape": [m, cap, 16],
+        "backend": jax.default_backend(),
+    }
+
+
 def bench_probe(n_dict: int = 1 << 20, n_query: int = 1 << 16):
     import jax
 
@@ -151,6 +178,8 @@ def main():
         print(json.dumps(bench_gear(args.mib)), flush=True)
     if args.stage in ("all", "sha"):
         print(json.dumps(bench_sha(args.mib)), flush=True)
+    if args.stage in ("all", "sha-pallas"):
+        print(json.dumps(bench_sha_pallas(args.mib)), flush=True)
     if args.stage in ("all", "probe"):
         print(json.dumps(bench_probe()), flush=True)
 
