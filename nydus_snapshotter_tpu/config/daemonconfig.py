@@ -105,6 +105,21 @@ class DaemonRuntimeConfig:
             json_key = {"backend_type": "type"}.get(f_.name, f_.name)
             if json_key in bcfg:
                 setattr(cfg.backend, f_.name, bcfg[json_key])
+        # Mirrors arrive as JSON objects; normalize to MirrorConfig records
+        # (unknown keys dropped) so consumers get attribute access.
+        cfg.backend.mirrors = [
+            m
+            if isinstance(m, MirrorConfig)
+            else MirrorConfig(
+                **{
+                    k: v
+                    for k, v in m.items()
+                    if k in {f.name for f in fields(MirrorConfig)}
+                }
+            )
+            for m in cfg.backend.mirrors
+            if isinstance(m, (dict, MirrorConfig))
+        ]
         cache = device.get("cache", {})
         cfg.cache.cache_type = cache.get("type", cfg.cache.cache_type)
         ccfg = cache.get("config", {})

@@ -140,7 +140,7 @@ def sha256_batch(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
     """
     import os
 
-    if os.environ.get("NTPU_SHA_PALLAS"):
+    if os.environ.get("NTPU_SHA_PALLAS", "") not in ("", "0"):
         from nydus_snapshotter_tpu.ops import sha256_pallas
 
         if sha256_pallas.supported(blocks.shape[0]):

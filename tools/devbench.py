@@ -168,6 +168,12 @@ def bench_probe(n_dict: int = 1 << 20, n_query: int = 1 << 16):
     }
 
 
+def _sha_pallas_ok() -> bool:
+    from nydus_snapshotter_tpu.ops import sha256_pallas
+
+    return sha256_pallas.supported(sha256_pallas.GROUP)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mib", type=int, default=256)
@@ -178,7 +184,7 @@ def main():
         print(json.dumps(bench_gear(args.mib)), flush=True)
     if args.stage in ("all", "sha"):
         print(json.dumps(bench_sha(args.mib)), flush=True)
-    if args.stage in ("all", "sha-pallas"):
+    if args.stage == "sha-pallas" or (args.stage == "all" and _sha_pallas_ok()):
         print(json.dumps(bench_sha_pallas(args.mib)), flush=True)
     if args.stage in ("all", "probe"):
         print(json.dumps(bench_probe()), flush=True)
