@@ -287,3 +287,53 @@ class TestTarfsParallelPrepare:
                 assert os.path.exists(mgr.layer_tar_file_path(ld.split(":")[1])), i
         finally:
             reg.close()
+
+
+class TestBlobCacheRace:
+    def test_parallel_reads_during_close(self, tmp_path):
+        """Readers hammer a CachedBlob while it is closed mid-flight: every
+        read either returns correct bytes or raises OSError — never EBADF
+        crashes on recycled fds, never wrong data."""
+        from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+
+        blob = RNG.integers(0, 256, 2_000_000, dtype=np.uint8).tobytes()
+
+        def fetch(off, size):
+            time.sleep(0.001)  # widen the race window
+            return blob[off : off + size]
+
+        for round_no in range(5):
+            cached = CachedBlob(str(tmp_path / f"c{round_no}"), "ab" * 32, fetch)
+            errors = []
+            wrong = []
+            stop = threading.Event()
+
+            def reader(tid):
+                rng = np.random.default_rng(tid)
+                while not stop.is_set():
+                    off = int(rng.integers(0, len(blob) - 4096))
+                    try:
+                        got = cached.read_at(off, 4096)
+                        if got != blob[off : off + 4096]:
+                            wrong.append((tid, off))
+                            return
+                    except OSError:
+                        return  # closed underneath us: the designed outcome
+                    except BaseException as e:  # noqa: BLE001
+                        errors.append(e)
+                        return
+
+            threads = [
+                threading.Thread(target=reader, args=(i,), daemon=True)
+                for i in range(6)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)
+            cached.close()
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+                assert not t.is_alive()
+            assert not errors, errors[:2]
+            assert not wrong, wrong[:2]
