@@ -158,8 +158,16 @@ class ChunkDigestEngine:
         cand_s, cand_l = self._candidates_windowed(arr)
         return cdc.resolve_cuts(cand_s, cand_l, arr.size, self.params)
 
+    # Smallest device window: the Pallas kernel's lane*tile granularity
+    # (ops/gear_pallas.py); also bounds distinct compiled shapes.
+    MIN_WINDOW = 1 << 19
+
     def _candidates_windowed(self, arr: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        w = self.window
+        # Shrink the window for small streams: a 512 KiB buffer hashed in a
+        # fixed 4 MiB window wastes 8x device compute on zero padding (the
+        # streaming pack drains ~2*max_size buffers). Power-of-two windows
+        # in [MIN_WINDOW, self.window] keep the compile count logarithmic.
+        w = min(self.window, max(self.MIN_WINDOW, _pow2_ceil(max(1, arr.size))))
         tail_len = gear.GEAR_WINDOW - 1
         n_windows = (arr.size + w - 1) // w
         # Window rows prefixed with the previous window's 31-byte tail; the
