@@ -288,3 +288,49 @@ class TestZranOverlaySemantics:
             pack_gzip_layer(
                 raw, PackOption(chunk_size=0x1000, oci_ref=True, encrypt=True)
             )
+
+
+def test_merge_mixes_zran_and_packed_layers():
+    """An image whose lower layer is OCIRef (original tar.gz authoritative)
+    and whose upper layer is a normal packed blob: Merge unifies the blob
+    tables and Unpack reads each chunk through its own transform."""
+    from nydus_snapshotter_tpu.converter.convert import (
+        Merge,
+        Unpack,
+        blob_data_from_layer_blob,
+        frame_bootstrap_only,
+        pack_layer,
+    )
+    from nydus_snapshotter_tpu.converter.types import MergeOption
+
+    shared = RNG.integers(0, 256, 300_000, dtype=np.uint8).tobytes()
+    raw_gz, _ = mk_targz({"base/data.bin": shared, "base/low.txt": b"lower\n"})
+    zran_bs = pack_gzip_layer(raw_gz, PackOption(chunk_size=0x10000, oci_ref=True))
+    zran_stream = frame_bootstrap_only(zran_bs.to_bytes())
+
+    upper_tar_files = {"base/low.txt": b"UPPER\n", "top/new.bin": b"n" * 5000}
+    import io as io_mod
+    import tarfile as tarfile_mod
+
+    buf = io_mod.BytesIO()
+    with tarfile_mod.open(fileobj=buf, mode="w") as tf:
+        for name, data in upper_tar_files.items():
+            ti = tarfile_mod.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io_mod.BytesIO(data))
+    upper_blob, upper_res = pack_layer(buf.getvalue(), PackOption(chunk_size=0x1000))
+
+    merged = Merge([zran_stream, upper_blob], MergeOption())
+    assert set(merged.blob_digests) == {
+        zran_bs.blobs[0].blob_id,
+        upper_res.blob_id,
+    }
+    provider = {
+        zran_bs.blobs[0].blob_id: raw_gz,  # the original compressed layer
+        upper_res.blob_id: blob_data_from_layer_blob(upper_blob),
+    }
+    out = Unpack(merged.bootstrap, provider)
+    with tarfile_mod.open(fileobj=io_mod.BytesIO(out)) as tf:
+        assert tf.extractfile("base/data.bin").read() == shared
+        assert tf.extractfile("base/low.txt").read() == b"UPPER\n"  # overlay
+        assert tf.extractfile("top/new.bin").read() == b"n" * 5000
