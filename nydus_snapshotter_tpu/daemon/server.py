@@ -59,6 +59,13 @@ class _Instance:
         self._closed = False
         self.prefetched_bytes = 0
         self._cached_blobs: list = []  # CachedBlob instances (registry backend)
+        # In-flight data-plane requests (API and FUSE reads both funnel
+        # through read()); the inflight metrics endpoint snapshots this so
+        # the collector's hung-IO gauge sees real request ages
+        # (reference nydusd inflight metrics, client.go:31-58).
+        self._inflight: dict[int, dict] = {}
+        self._inflight_seq = 0
+        self._inflight_lock = threading.Lock()
         self.fuse = None  # FuseSession when a kernel mount is being served
 
     def start_fuse(self, default_blob_dir: str, fd: Optional[int] = None) -> bool:
@@ -200,7 +207,29 @@ class _Instance:
                 logger.warning("prefetch of %s failed", path, exc_info=True)
         return warmed
 
+    def inflight_snapshot(self) -> list[dict]:
+        with self._inflight_lock:
+            return [dict(v) for v in self._inflight.values()]
+
     def read(self, path: str, offset: int, size: int, blob_dir: str) -> bytes:
+        import time as time_mod
+
+        with self._inflight_lock:
+            self._inflight_seq += 1
+            token = self._inflight_seq
+            self._inflight[token] = {
+                "opcode": "Read",
+                "inode": path,
+                "unique": token,
+                "timestamp_secs": time_mod.time(),
+            }
+        try:
+            return self._read_locked_out(path, offset, size, blob_dir)
+        finally:
+            with self._inflight_lock:
+                self._inflight.pop(token, None)
+
+    def _read_locked_out(self, path: str, offset: int, size: int, blob_dir: str) -> bytes:
         inode = self.by_path.get(path)
         if inode is None:
             raise FileNotFoundError(path)
@@ -412,7 +441,12 @@ class DaemonServer:
                         )
                     self._reply(200, {"prefetch_data_amount": amount})
                 elif u.path == "/api/v1/metrics/inflight":
-                    self._reply(200, [])
+                    with daemon._lock:
+                        instances = list(daemon.instances.values())
+                    ops = [
+                        op for inst in instances for op in inst.inflight_snapshot()
+                    ]
+                    self._reply(200, ops)
                 elif u.path == "/api/v1/fs":
                     try:
                         self._handle_fs(q)

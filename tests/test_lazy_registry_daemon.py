@@ -181,3 +181,56 @@ class TestKernelLazyPull:
         finally:
             proc.terminate()
             proc.wait(timeout=10)
+
+
+def test_inflight_metrics_expose_stuck_reads(registry, tmp_path):
+    """A read blocked on a dead-slow backend shows up in the inflight
+    endpoint with its age (the hung-IO signal the metrics collector polls,
+    reference nydusd inflight metrics)."""
+    import threading
+
+    import socket as socketmod
+
+    payload, _blob_id, boot = _publish_image(registry, tmp_path)
+    mp = str(tmp_path / "mnt")
+    os.makedirs(mp)
+    # Tarpit: accepts connections and never answers, so the daemon's read
+    # genuinely blocks inside the HTTP fetch.
+    tarpit = socketmod.socket()
+    tarpit.bind(("127.0.0.1", 0))
+    tarpit.listen(8)
+    tarpit_host = "127.0.0.1:%d" % tarpit.getsockname()[1]
+    os.environ["NTPU_DISABLE_FUSE"] = "1"
+    try:
+        proc, cli = _spawn_daemon(str(tmp_path), "lazy-hang")
+        try:
+            cli.mount(mp, boot, _registry_config(tarpit_host, str(tmp_path / "c")))
+
+            def slow_read():
+                try:
+                    cli.read_file(mp, "/app/data.bin")
+                except Exception:
+                    pass
+
+            t = threading.Thread(target=slow_read, daemon=True)
+            t.start()
+            deadline = time.time() + 5
+            seen = []
+            while time.time() < deadline:
+                seen = cli.inflight_metrics()
+                if seen:
+                    break
+                time.sleep(0.02)
+            assert seen, "in-flight read never appeared in the metrics"
+            assert seen[0]["opcode"] == "Read"
+            assert "timestamp_secs" in seen[0]
+            tarpit.close()  # unblock the fetch
+            t.join(timeout=30)
+            # once done, the list drains
+            assert cli.inflight_metrics() == []
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+    finally:
+        tarpit.close()
+        os.environ.pop("NTPU_DISABLE_FUSE", None)
