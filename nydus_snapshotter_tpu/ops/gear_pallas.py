@@ -38,7 +38,12 @@ PAD = 32  # top pad rows per tile: TAIL carry rows + 1 zero row for 8-row
 #          DMA alignment (Mosaic requires sublane slices aligned to 8; the
 #          zero row sits 32 positions back and can never reach a valid hash)
 LANES = 128
-ROWS_PER_TILE = 4096  # output rows per grid step; VMEM ~ 3 u32 tiles of this
+# Output rows per grid step. Tunable via NTPU_GEAR_TILE for hardware
+# sweeps (suspected VMEM-pressure bound at 4096: ~6 live u32[rows,128]
+# temporaries; 1024 keeps them ~3 MB total).
+import os as _os
+
+ROWS_PER_TILE = int(_os.environ.get("NTPU_GEAR_TILE", "1024"))
 
 
 def _kernel(y_ref, out_s_ref, out_l_ref, scratch, sem, *, mask_s: int, mask_l: int):

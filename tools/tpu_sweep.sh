@@ -1,0 +1,25 @@
+#!/bin/bash
+# One-shot TPU measurement sweep: run when the device tunnel answers.
+# Produces per-stage numbers that decide the kernel defaults
+# (gear tile size, pallas-vs-XLA SHA, digest crossover).
+set -u
+cd "$(dirname "$0")/.."
+export JAX_COMPILATION_CACHE_DIR=/tmp/ntpu_jax_cache
+
+echo "== device probe =="
+timeout 120 python -c "import jax; print(jax.devices())" || { echo "tunnel down"; exit 1; }
+
+echo "== gear tile sweep =="
+for R in 512 1024 2048 4096; do
+  NTPU_GEAR_TILE=$R timeout 400 python tools/devbench.py --mib 256 --stage gear 2>/dev/null | tail -1
+done
+
+echo "== sha: xla vs pallas =="
+timeout 400 python tools/devbench.py --mib 256 --stage sha 2>/dev/null | tail -1
+timeout 600 python tools/devbench.py --mib 256 --stage sha-pallas 2>/dev/null | tail -1
+
+echo "== dict probe (device arm) =="
+timeout 400 python tools/devbench.py --stage probe 2>/dev/null | tail -1
+
+echo "== end-to-end bench =="
+timeout 1200 python bench.py 2>/dev/null | tail -1
