@@ -84,8 +84,8 @@ def _kernel(y_ref, out_s_ref, out_l_ref, scratch, sem, *, mask_s: int, mask_l: i
     out_l_ref[:] = pack(((h & np.uint32(mask_l)) == 0).astype(jnp.int32))
 
 
-@functools.partial(jax.jit, static_argnames=("mask_s", "mask_l"))
-def _bitmaps_lanes(y: jax.Array, mask_s: int, mask_l: int):
+@functools.partial(jax.jit, static_argnames=("mask_s", "mask_l", "interpret"))
+def _bitmaps_lanes(y: jax.Array, mask_s: int, mask_l: int, interpret: bool = False):
     """y: u8[B, SW+32, 128] (lane-major substreams; 1 zero row + 31 tail
     rows on top) -> (u32[B, SW/32, 128], u32[B, SW/32, 128]) packed per
     substream."""
@@ -122,11 +122,12 @@ def _bitmaps_lanes(y: jax.Array, mask_s: int, mask_l: int):
             pltpu.VMEM((ROWS_PER_TILE + PAD, LANES), jnp.uint8),
             pltpu.SemaphoreType.DMA(()),
         ],
+        interpret=interpret,
     )(y)
 
 
-@functools.partial(jax.jit, static_argnames=("mask_s", "mask_l", "n"))
-def gear_bitmaps(x: jax.Array, mask_s: int, mask_l: int, n: int):
+@functools.partial(jax.jit, static_argnames=("mask_s", "mask_l", "n", "interpret"))
+def gear_bitmaps(x: jax.Array, mask_s: int, mask_l: int, n: int, interpret: bool = False):
     """Drop-in device path for ops/chunker._hash_bitmaps_kernel.
 
     x: u8[B, n+31] stream-order windows with 31-byte tail prefix.
@@ -141,7 +142,7 @@ def gear_bitmaps(x: jax.Array, mask_s: int, mask_l: int, n: int):
     )  # [B, 31, 128]: 31 bytes preceding each substream
     zrow = jnp.zeros((bsz, 1, LANES), jnp.uint8)
     y = jnp.concatenate([zrow, tails, seg], axis=1)  # [B, SW+32, 128]
-    bm_s, bm_l = _bitmaps_lanes(y, mask_s, mask_l)
+    bm_s, bm_l = _bitmaps_lanes(y, mask_s, mask_l, interpret=interpret)
     # substream-major words -> stream order: [B, SW/32, 128] -> [B, n/32]
     return (
         bm_s.transpose(0, 2, 1).reshape(bsz, n // 32),

@@ -184,3 +184,23 @@ class TestSha256Pallas:
 
         for i, m in enumerate(msgs):
             assert sref.digest_to_bytes(got[i]) == hashlib.sha256(m).digest()
+
+
+class TestGearPallas:
+    def test_bitmaps_match_xla_kernel(self):
+        """Pallas gear bitmaps (interpret mode on CPU) are bit-identical to
+        the XLA kernel — guards the DMA/tile math for whatever
+        NTPU_GEAR_TILE is in effect."""
+        import jax.numpy as jnp
+
+        from nydus_snapshotter_tpu.ops import gear_pallas
+        from nydus_snapshotter_tpu.ops.chunker import _hash_bitmaps_kernel
+
+        n = gear_pallas.LANES * gear_pallas.ROWS_PER_TILE * 2  # two grid steps
+        x = RNG.integers(0, 256, (2, n + 31), dtype=np.uint8)
+        xj = jnp.asarray(x)
+        ms, ml = 0x3FFF, 0x3FF
+        ps, pl_ = gear_pallas.gear_bitmaps(xj, ms, ml, n, interpret=True)
+        rs, rl = _hash_bitmaps_kernel(xj, jnp.uint32(ms), jnp.uint32(ml), n)
+        assert np.array_equal(np.asarray(ps), np.asarray(rs))
+        assert np.array_equal(np.asarray(pl_), np.asarray(rl))
