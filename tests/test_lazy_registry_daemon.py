@@ -234,3 +234,73 @@ def test_inflight_metrics_expose_stuck_reads(registry, tmp_path):
     finally:
         tarpit.close()
         os.environ.pop("NTPU_DISABLE_FUSE", None)
+
+
+class TestFullStackLazyPull:
+    def test_filesystem_mount_supplements_registry_and_reads_lazily(
+        self, registry, tmp_path
+    ):
+        """The whole reference flow in-process: Filesystem.mount with CRI
+        labels supplements the daemon config from the image ref
+        (daemonconfig.go:150-189), the spawned daemon lazily pulls chunks
+        from the registry, and reads come back byte-exact."""
+        from nydus_snapshotter_tpu import constants as C
+        from nydus_snapshotter_tpu.cache.manager import CacheManager
+        from nydus_snapshotter_tpu.config.daemonconfig import DaemonRuntimeConfig
+        from nydus_snapshotter_tpu.filesystem import Filesystem
+        from nydus_snapshotter_tpu.manager.manager import Manager
+        from nydus_snapshotter_tpu.store.database import Database
+
+        from tests.test_filesystem import _mk_cfg
+
+        payload, blob_id, boot = _publish_image(registry, tmp_path)
+
+        cfg = _mk_cfg(tmp_path)
+        db = Database(cfg.database_path)
+        mgr = Manager(cfg, db, fs_driver=C.FS_DRIVER_FUSEDEV)
+        template = DaemonRuntimeConfig.from_dict(
+            {"device": {"backend": {"type": "registry",
+                                    "config": {"scheme": "http"}}}},
+            C.FS_DRIVER_FUSEDEV,
+        )
+        fs = Filesystem(
+            managers={C.FS_DRIVER_FUSEDEV: mgr},
+            cache_mgr=CacheManager(cfg.cache_root),
+            root=cfg.root,
+            fs_driver=C.FS_DRIVER_FUSEDEV,
+            daemon_mode=C.DAEMON_MODE_SHARED,
+            daemon_config=template,
+        )
+        os.environ["NTPU_DISABLE_FUSE"] = "1"
+        try:
+            fs.startup()
+            sid = "lazy-snap"
+            snap_dir = os.path.join(fs.root, "snapshots", sid)
+            os.makedirs(os.path.join(snap_dir, "fs", "image"), exist_ok=True)
+            with open(boot, "rb") as f:
+                boot_bytes = f.read()
+            with open(os.path.join(snap_dir, "fs", "image", "image.boot"), "wb") as f:
+                f.write(boot_bytes)
+            labels = {
+                C.CRI_IMAGE_REF: f"{registry.host}/library/lazy:1",
+                C.NYDUS_META_LAYER: "true",
+            }
+            fs.mount(sid, labels)
+            try:
+                fs.wait_until_ready(sid)
+                daemons = mgr.list_daemons()
+                assert daemons, "no daemon spawned"
+                d = daemons[0]
+                before = len(registry.requests)
+                rafs_mp = fs.instances.get(sid).relative_mountpoint()
+                got = d.client().read_file(rafs_mp, "/app/data.bin")
+                assert got == payload
+                assert len(registry.requests) > before, "read did not hit HTTP"
+            finally:
+                fs.umount(sid)
+        finally:
+            os.environ.pop("NTPU_DISABLE_FUSE", None)
+            try:
+                mgr.stop()
+            except Exception:
+                pass
