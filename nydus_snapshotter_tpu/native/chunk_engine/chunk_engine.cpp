@@ -10,7 +10,202 @@
 // layer streams in parallel.
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+
+#include "sha256.h"
+
+#if defined(__x86_64__) || defined(_M_X64)
+#include <immintrin.h>
+#define NTPU_X86 1
+#endif
+
+namespace {
+
+// ---- Position-parallel gear candidate bitmaps (the TPU kernel's
+// log-doubling identity on host SIMD) ----------------------------------
+//
+// h_i = sum_{k=0}^{31} G[x_{i-k}] << k is position-independent, so every
+// byte's hash is computed in parallel: mix32 per byte, then 5 log-doubling
+// shifted adds (m = 1,2,4,8,16) over a tile. Judged positions always sit
+// >= min_size >= 1024 bytes past their chunk start, so the 32-byte window
+// is chunk-interior and bitmap candidates are bit-identical to the
+// sequential per-chunk hash (same argument as ops/gear.py docstring).
+// G here is gear-v2 (mix32 arithmetic), computed inline — no table gather.
+
+constexpr int64_t TILE = 2048;  // positions per tile; buffers stay in L1
+constexpr uint32_t MIX_C0 = 0x9E3779B1u;
+constexpr uint32_t MIX_C1 = 0x85EBCA6Bu;
+constexpr uint32_t MIX_C2 = 0xC2B2AE35u;
+
+inline uint32_t mix32(uint32_t x) {
+  x = (x + 1u) * MIX_C0;
+  x ^= x >> 16;
+  x *= MIX_C1;
+  x ^= x >> 13;
+  x *= MIX_C2;
+  x ^= x >> 16;
+  return x;
+}
+
+#ifdef NTPU_X86
+__attribute__((target("avx2")))
+void gear_bitmaps_avx2(const uint8_t *data, int64_t n, uint32_t mask_s,
+                       uint32_t mask_l, uint64_t *bm_s, uint64_t *bm_l) {
+  alignas(32) uint32_t bufa[TILE + 32], bufb[TILE + 32];
+  const __m256i c0 = _mm256_set1_epi32((int)MIX_C0);
+  const __m256i c1 = _mm256_set1_epi32((int)MIX_C1);
+  const __m256i c2 = _mm256_set1_epi32((int)MIX_C2);
+  const __m256i one = _mm256_set1_epi32(1);
+  const __m256i vms = _mm256_set1_epi32((int)mask_s);
+  const __m256i vml = _mm256_set1_epi32((int)mask_l);
+  const __m256i vzero = _mm256_setzero_si256();
+
+  for (int64_t p0 = 0; p0 < n; p0 += TILE) {
+    const int64_t count = (p0 + TILE <= n) ? TILE : n - p0;
+    const int64_t len = count + 31;
+    uint32_t *a = bufa, *b = bufb;
+
+    // mix32 of the tile bytes + 31-byte history (head clamped to zero)
+    int64_t j = 0;
+    const int64_t base = p0 - 31;
+    while (j < len && base + j < 0) a[j++] = 0u;
+    for (; j + 8 <= len; j += 8) {
+      const __m128i raw =
+          _mm_loadl_epi64((const __m128i *)(data + base + j));
+      __m256i x = _mm256_cvtepu8_epi32(raw);
+      x = _mm256_mullo_epi32(_mm256_add_epi32(x, one), c0);
+      x = _mm256_xor_si256(x, _mm256_srli_epi32(x, 16));
+      x = _mm256_mullo_epi32(x, c1);
+      x = _mm256_xor_si256(x, _mm256_srli_epi32(x, 13));
+      x = _mm256_mullo_epi32(x, c2);
+      x = _mm256_xor_si256(x, _mm256_srli_epi32(x, 16));
+      _mm256_storeu_si256((__m256i *)(a + j), x);
+    }
+    for (; j < len; ++j) a[j] = mix32(data[base + j]);
+
+    // 5 log-doubling shifted adds
+    for (int m = 1; m <= 16; m *= 2) {
+      int64_t k = m;
+      for (; k + 8 <= len; k += 8) {
+        const __m256i cur = _mm256_loadu_si256((const __m256i *)(a + k));
+        const __m256i prev =
+            _mm256_loadu_si256((const __m256i *)(a + k - m));
+        _mm256_storeu_si256(
+            (__m256i *)(b + k),
+            _mm256_add_epi32(cur, _mm256_slli_epi32(prev, m)));
+      }
+      for (; k < len; ++k) b[k] = a[k] + (a[k - m] << m);
+      for (int64_t h = 0; h < m; ++h) b[h] = a[h];
+      uint32_t *t = a;
+      a = b;
+      b = t;
+    }
+
+    // bit tests -> packed words (p0 is a multiple of 64: whole words)
+    const uint32_t *s = a + 31;
+    int64_t i = 0;
+    for (; i + 64 <= count; i += 64) {
+      uint64_t ws = 0, wl = 0;
+      for (int64_t q = 0; q < 64; q += 8) {
+        const __m256i v = _mm256_loadu_si256((const __m256i *)(s + i + q));
+        const uint64_t ms = (uint32_t)_mm256_movemask_ps(_mm256_castsi256_ps(
+            _mm256_cmpeq_epi32(_mm256_and_si256(v, vms), vzero)));
+        const uint64_t ml = (uint32_t)_mm256_movemask_ps(_mm256_castsi256_ps(
+            _mm256_cmpeq_epi32(_mm256_and_si256(v, vml), vzero)));
+        ws |= ms << q;
+        wl |= ml << q;
+      }
+      bm_s[(p0 + i) >> 6] = ws;
+      bm_l[(p0 + i) >> 6] = wl;
+    }
+    if (i < count) {
+      uint64_t ws = 0, wl = 0;
+      for (int64_t q = i; q < count; ++q) {
+        if ((s[q] & mask_s) == 0) ws |= 1ULL << (q - i);
+        if ((s[q] & mask_l) == 0) wl |= 1ULL << (q - i);
+      }
+      bm_s[(p0 + i) >> 6] = ws;
+      bm_l[(p0 + i) >> 6] = wl;
+    }
+  }
+}
+#endif  // NTPU_X86
+
+void gear_bitmaps_scalar(const uint8_t *data, int64_t n, uint32_t mask_s,
+                         uint32_t mask_l, uint64_t *bm_s, uint64_t *bm_l) {
+  const int64_t words = (n + 63) >> 6;
+  std::memset(bm_s, 0, (size_t)words * 8);
+  std::memset(bm_l, 0, (size_t)words * 8);
+  uint32_t h = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    h = (h << 1) + mix32(data[i]);
+    if ((h & mask_s) == 0) bm_s[i >> 6] |= 1ULL << (i & 63);
+    if ((h & mask_l) == 0) bm_l[i >> 6] |= 1ULL << (i & 63);
+  }
+}
+
+void gear_bitmaps(const uint8_t *data, int64_t n, uint32_t mask_s,
+                  uint32_t mask_l, uint64_t *bm_s, uint64_t *bm_l) {
+#ifdef NTPU_X86
+  if (__builtin_cpu_supports("avx2")) {
+    gear_bitmaps_avx2(data, n, mask_s, mask_l, bm_s, bm_l);
+    return;
+  }
+#endif
+  gear_bitmaps_scalar(data, n, mask_s, mask_l, bm_s, bm_l);
+}
+
+// First set bit in [lo, hi) of an LSB-first word bitmap, or -1.
+inline int64_t find_first_set(const uint64_t *bm, int64_t lo, int64_t hi) {
+  if (lo >= hi) return -1;
+  int64_t w = lo >> 6;
+  const int64_t wend = (hi + 63) >> 6;
+  uint64_t word = bm[w] & (~0ULL << (lo & 63));
+  for (;;) {
+    if (word) {
+      const int64_t bit = (w << 6) + __builtin_ctzll(word);
+      return bit < hi ? bit : -1;
+    }
+    if (++w >= wend) return -1;
+    word = bm[w];
+  }
+}
+
+// Cut resolution over candidate bitmaps — the exact region/judgement
+// semantics of ntpu_cdc_chunk below (differential-tested equal).
+int64_t resolve_bitmap_cuts(const uint64_t *bm_s, const uint64_t *bm_l,
+                            int64_t n, int64_t min_size, int64_t normal_size,
+                            int64_t max_size, int64_t *cuts_out,
+                            int64_t cuts_cap) {
+  int64_t n_cuts = 0;
+  int64_t start = 0;
+  while (n - start > min_size) {
+    const int64_t scan_end = (start + max_size < n) ? start + max_size : n;
+    const int64_t normal_end =
+        (start + normal_size - 1 < scan_end) ? start + normal_size - 1
+                                             : scan_end;
+    const int64_t judge_from = start + min_size - 1;
+    int64_t end = -1;
+    int64_t i = find_first_set(bm_s, judge_from, normal_end);
+    if (i >= 0) end = i + 1;
+    if (end < 0) {
+      i = find_first_set(bm_l, normal_end, scan_end);
+      if (i >= 0) end = i + 1;
+    }
+    if (end < 0) end = (scan_end == start + max_size) ? scan_end : n;
+    if (n_cuts >= cuts_cap) return -1;
+    cuts_out[n_cuts++] = end;
+    start = end;
+  }
+  if (n > start) {
+    if (n_cuts >= cuts_cap) return -1;
+    cuts_out[n_cuts++] = n;
+  }
+  return n_cuts;
+}
+
+}  // namespace
 
 extern "C" {
 
@@ -150,6 +345,67 @@ void ntpu_gear_hashes(const uint8_t *data, int64_t n,
     h = (h << 1) + table[data[i]];
     out[i] = h;
   }
+}
+
+// Position-parallel candidate bitmaps (gear-v2 mix32 computed inline, no
+// table: identical contents to ops/gear.gear_table() by construction).
+// bm_s/bm_l are caller buffers of (n+63)/64 u64 words, LSB-first.
+void ntpu_gear_bitmaps(const uint8_t *data, int64_t n, uint32_t mask_small,
+                       uint32_t mask_large, uint64_t *bm_s, uint64_t *bm_l) {
+  gear_bitmaps(data, n, mask_small, mask_large, bm_s, bm_l);
+}
+
+// Cut resolution over candidate bitmaps; same contract as ntpu_cdc_chunk.
+int64_t ntpu_resolve_bitmap_cuts(const uint64_t *bm_s, const uint64_t *bm_l,
+                                 int64_t n, int64_t min_size,
+                                 int64_t normal_size, int64_t max_size,
+                                 int64_t *cuts_out, int64_t cuts_cap) {
+  return resolve_bitmap_cuts(bm_s, bm_l, n, min_size, normal_size, max_size,
+                             cuts_out, cuts_cap);
+}
+
+// SHA-256 of m extents of data; extents are (offset, size) i64 pairs,
+// digests_out gets 32 bytes per extent. SHA-NI when the CPU has it.
+void ntpu_sha256_many(const uint8_t *data, const int64_t *extents, int64_t m,
+                      uint8_t *digests_out) {
+  for (int64_t i = 0; i < m; ++i) {
+    ntpu_sha::sha256(data + extents[2 * i], (uint64_t)extents[2 * i + 1],
+                     digests_out + 32 * i);
+  }
+}
+
+// Fused single-pass chunk + digest: SIMD candidate bitmaps -> cut
+// resolution -> per-chunk SHA-256 while the bytes are cache-warm. This is
+// the host latency arm's fast path, replacing the separate
+// boundaries/digest sweeps (the reference does all of this inside one
+// `nydus-image create` process, pkg/converter/tool/builder.go:148-178).
+// Hashing is gear-v2 arithmetic (mix32); callers that pass a custom gear
+// table must use ntpu_cdc_chunk instead. digests_out may be null for a
+// boundaries-only pass. Returns the number of cuts (= digests) written,
+// or -1 on cuts_cap overflow / allocation failure.
+int64_t ntpu_chunk_digest(const uint8_t *data, int64_t n,
+                          uint32_t mask_small, uint32_t mask_large,
+                          int64_t min_size, int64_t normal_size,
+                          int64_t max_size, int64_t *cuts_out,
+                          int64_t cuts_cap, uint8_t *digests_out) {
+  const int64_t words = (n + 63) >> 6;
+  uint64_t *bm = (uint64_t *)std::malloc((size_t)words * 16);
+  if (bm == nullptr) return -1;
+  uint64_t *bm_s = bm, *bm_l = bm + words;
+  gear_bitmaps(data, n, mask_small, mask_large, bm_s, bm_l);
+  const int64_t n_cuts = resolve_bitmap_cuts(
+      bm_s, bm_l, n, min_size, normal_size, max_size, cuts_out, cuts_cap);
+  std::free(bm);
+  if (n_cuts < 0) return -1;
+  if (digests_out != nullptr) {
+    int64_t start = 0;
+    for (int64_t i = 0; i < n_cuts; ++i) {
+      ntpu_sha::sha256(data + start, (uint64_t)(cuts_out[i] - start),
+                       digests_out + 32 * i);
+      start = cuts_out[i];
+    }
+  }
+  return n_cuts;
 }
 
 }  // extern "C"

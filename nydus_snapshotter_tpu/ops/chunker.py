@@ -83,24 +83,56 @@ def _cpu_count() -> int:
     return os.cpu_count() or 4
 
 
+def _map_threads(fn, items: list, min_batch: int = 2) -> list:
+    """Thread-pool map for GIL-dropping work (native ctypes calls, hashlib
+    over large buffers); sequential below ``min_batch``."""
+    if len(items) < min_batch:
+        return [fn(i) for i in items]
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=min(32, _cpu_count())) as pool:
+        return list(pool.map(fn, items))
+
+
 def _host_digests(items: list[tuple[np.ndarray, int, int]]) -> list[bytes]:
     """Threaded host SHA-256 over (array, offset, size) extents.
 
-    hashlib releases the GIL for buffers > 2 KiB and memoryviews avoid
-    copies, so this scales across cores (the crossover arm for small
-    batches where the device scan is latency-bound).
+    Routes through the native SHA-NI batch call when the engine is built
+    (one GIL-dropping call per source array); hashlib otherwise — which
+    also releases the GIL for buffers > 2 KiB, so both arms scale across
+    cores (the crossover arm for small batches where the device scan is
+    latency-bound).
     """
     import hashlib
-    from concurrent.futures import ThreadPoolExecutor
+
+    from nydus_snapshotter_tpu.ops import native_cdc
+
+    lib = native_cdc.load()
+    if lib is not None and hasattr(lib, "ntpu_sha256_many") and len(items) >= 8:
+        # Group runs of extents sharing a source array: one native call each.
+        groups: list[tuple[np.ndarray, list[tuple[int, int]]]] = []
+        for arr, off, size in items:
+            if groups and groups[-1][0] is arr:
+                groups[-1][1].append((off, size))
+            else:
+                groups.append((arr, [(off, size)]))
+        flat = _map_threads(
+            lambda g: native_cdc.sha256_many_native(
+                g[0], np.asarray(g[1], dtype=np.int64)
+            ),
+            groups,
+        )
+        return [
+            blob[32 * i : 32 * (i + 1)]
+            for blob in flat
+            for i in range(len(blob) // 32)
+        ]
 
     def one(item: tuple[np.ndarray, int, int]) -> bytes:
         arr, off, size = item
         return hashlib.sha256(memoryview(arr)[off : off + size]).digest()
 
-    if len(items) < 8:
-        return [one(i) for i in items]
-    with ThreadPoolExecutor(max_workers=min(32, _cpu_count())) as pool:
-        return list(pool.map(one, items))
+    return _map_threads(one, items, min_batch=8)
 
 
 class ChunkDigestEngine:
@@ -253,11 +285,8 @@ class ChunkDigestEngine:
     def boundaries_many(self, arrs: list[np.ndarray]) -> list[np.ndarray]:
         """Per-stream cut offsets for many streams (thread-parallel on the
         hybrid backend: the native chunker drops the GIL)."""
-        if self.backend == "hybrid" and len(arrs) > 1:
-            from concurrent.futures import ThreadPoolExecutor
-
-            with ThreadPoolExecutor(max_workers=min(32, _cpu_count())) as pool:
-                return list(pool.map(self.boundaries, arrs))
+        if self.backend == "hybrid":
+            return _map_threads(self.boundaries, arrs)
         return [self.boundaries(a) for a in arrs]
 
     def digest_all(
@@ -349,6 +378,8 @@ class ChunkDigestEngine:
             np.frombuffer(s, dtype=np.uint8) if isinstance(s, (bytes, bytearray)) else s
             for s in streams
         ]
+        if self._fused_available():
+            return self._process_many_fused(arrs)
         all_cuts = self.boundaries_many(arrs)
 
         per_file_extents = [cdc.cuts_to_extents(c) for c in all_cuts]
@@ -363,3 +394,37 @@ class ChunkDigestEngine:
             pos += len(extents)
             out.append(metas)
         return out
+
+    def _fused_available(self) -> bool:
+        """Single-pass native chunk+digest (SIMD bitmaps + SHA-NI): the
+        host latency arm's fast path — chunk bytes digested cache-warm,
+        one GIL-dropping call per stream."""
+        if not (
+            self.mode == "cdc"
+            and self.backend == "hybrid"
+            and self.digest_backend == "host"
+        ):
+            return False
+        from nydus_snapshotter_tpu.ops import native_cdc
+
+        return native_cdc.chunk_digest_available()
+
+    def _process_many_fused(self, arrs: list[np.ndarray]) -> list[list[ChunkMeta]]:
+        from nydus_snapshotter_tpu.ops import native_cdc
+
+        def one(arr: np.ndarray) -> list[ChunkMeta]:
+            cuts, digests = native_cdc.chunk_digest_native(arr, self.params)
+            start = 0
+            metas = []
+            for i, c in enumerate(cuts):
+                metas.append(
+                    ChunkMeta(
+                        offset=start,
+                        size=int(c) - start,
+                        digest=digests[32 * i : 32 * (i + 1)],
+                    )
+                )
+                start = int(c)
+            return metas
+
+        return _map_threads(one, arrs)

@@ -29,17 +29,103 @@ def _lib_path() -> str:
     )
 
 
+def _src_stamp(path: str) -> str:
+    """Newest source mtime under chunk_engine/ ('' when unreadable)."""
+    src_dir = os.path.join(os.path.dirname(os.path.dirname(path)), "chunk_engine")
+    try:
+        return str(
+            max(
+                os.path.getmtime(os.path.join(src_dir, f))
+                for f in os.listdir(src_dir)
+            )
+        )
+    except (OSError, ValueError):
+        return ""
+
+
+def _sources_newer(path: str) -> bool:
+    try:
+        stamp = _src_stamp(path)
+        return bool(stamp) and float(stamp) > os.path.getmtime(path)
+    except OSError:
+        return False
+
+
+def _try_build() -> bool:
+    """Best-effort build of libchunk_engine.so: build artifacts are
+    git-ignored, so a fresh checkout starts without the .so — and a stale
+    .so (older than its sources) must never be dlopen'd. The build goes to
+    a private temp dir and lands via atomic rename, so concurrent
+    processes never dlopen a half-written file. A missing compiler or a
+    failed build silently degrades to the numpy arm; the failure is
+    remembered on disk (keyed on source mtimes) so other processes don't
+    each re-pay a doomed compile."""
+    import shutil
+    import subprocess
+
+    path = _lib_path()
+    if os.path.exists(path) and not _sources_newer(path):
+        return True
+    native_dir = os.path.dirname(os.path.dirname(path))
+    marker = os.path.join(native_dir, "bin", ".build_failed")
+    stamp = _src_stamp(path)
+    try:
+        with open(marker) as fp:
+            if fp.read() == stamp:
+                return False  # this exact source state already failed
+    except OSError:
+        pass
+    if not shutil.which("make") or not shutil.which("g++"):
+        return False
+    tmp = f"bin.build.{os.getpid()}"
+    try:
+        # Only the chunk-engine target: an unrelated target failing (e.g.
+        # optimizer-server in a stripped install) must not disable this arm.
+        ok = (
+            subprocess.run(
+                ["make", "-C", native_dir, f"{tmp}/libchunk_engine.so",
+                 f"BIN_DIR={tmp}"],
+                capture_output=True,
+                timeout=120,
+            ).returncode
+            == 0
+        )
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        if ok:
+            os.replace(os.path.join(native_dir, tmp, "libchunk_engine.so"), path)
+            try:
+                os.unlink(marker)
+            except OSError:
+                pass
+        else:
+            with open(marker, "w") as fp:
+                fp.write(stamp)
+        return ok
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        shutil.rmtree(os.path.join(native_dir, tmp), ignore_errors=True)
+
+
 def load() -> Optional[ctypes.CDLL]:
-    """The shared library, or None when not built (make -C native)."""
+    """The shared library; built (or rebuilt if sources changed) on first
+    use per process. None when unbuildable — including when an EXISTING
+    .so is stale against edited sources and the rebuild failed (loading it
+    would silently diverge from the Python reference semantics)."""
     global _lib, _lib_missing
     with _lib_lock:
         if _lib is not None or _lib_missing:
             return _lib
         path = _lib_path()
-        if not os.path.exists(path):
+        built = _try_build()
+        if not os.path.exists(path) or (not built and _sources_newer(path)):
             _lib_missing = True
             return None
-        lib = ctypes.CDLL(path)
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            _lib_missing = True
+            return None
         lib.ntpu_cdc_chunk.restype = ctypes.c_int64
         lib.ntpu_cdc_chunk.argtypes = [
             ctypes.c_void_p, ctypes.c_int64,  # data, n
@@ -66,6 +152,21 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_void_p,  # keys, values
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # shards, cap, max_probe
                 ctypes.c_void_p,  # out
+            ]
+        if hasattr(lib, "ntpu_chunk_digest"):
+            lib.ntpu_chunk_digest.restype = ctypes.c_int64
+            lib.ntpu_chunk_digest.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,  # data, n
+                ctypes.c_uint32, ctypes.c_uint32,  # masks
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # min/normal/max
+                ctypes.c_void_p, ctypes.c_int64,  # cuts_out, cap
+                ctypes.c_void_p,  # digests_out (nullable)
+            ]
+        if hasattr(lib, "ntpu_sha256_many"):
+            lib.ntpu_sha256_many.restype = None
+            lib.ntpu_sha256_many.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p,  # data, extents (i64 pairs)
+                ctypes.c_int64, ctypes.c_void_p,   # m, digests_out
             ]
         _lib = lib
         return _lib
@@ -100,6 +201,71 @@ def chunk_data_native(data: bytes | np.ndarray, params: cdc.CDCParams) -> np.nda
     if n < 0:
         raise RuntimeError("native chunker cut buffer overflow")
     return cuts[:n].copy()
+
+
+def chunk_digest_available() -> bool:
+    """The fused single-pass chunk+digest arm (SIMD bitmaps + SHA-NI)."""
+    lib = load()
+    return lib is not None and hasattr(lib, "ntpu_chunk_digest")
+
+
+def chunk_digest_native(
+    data: bytes | np.ndarray,
+    params: cdc.CDCParams,
+    want_digests: bool = True,
+) -> tuple[np.ndarray, bytes]:
+    """One native pass: cut offsets + per-chunk SHA-256 digests.
+
+    The fused host arm — AVX2 position-parallel gear candidate bitmaps
+    (the TPU kernel's log-doubling identity on host SIMD), bitmap cut
+    resolution, then SHA-NI digests while the bytes are cache-warm. Cut
+    points are bit-identical to chunk_data_native / cdc.chunk_data_np
+    (differential-tested); digests are standard SHA-256. Uses the gear-v2
+    table only (mix32 computed inline).
+    """
+    lib = load()
+    if lib is None or not hasattr(lib, "ntpu_chunk_digest"):
+        raise RuntimeError("fused chunk+digest not available in libchunk_engine.so")
+    arr = (
+        np.frombuffer(data, dtype=np.uint8)
+        if isinstance(data, (bytes, bytearray))
+        else np.ascontiguousarray(data, dtype=np.uint8)
+    )
+    if arr.size == 0:
+        return np.asarray([], dtype=np.int64), b""
+    cap = arr.size // max(1, params.min_size) + 2
+    cuts = np.empty(cap, dtype=np.int64)
+    digests = np.empty(cap * 32, dtype=np.uint8) if want_digests else None
+    n = lib.ntpu_chunk_digest(
+        arr.ctypes.data, arr.size,
+        np.uint32(params.mask_small), np.uint32(params.mask_large),
+        params.min_size, params.normal_size, params.max_size,
+        cuts.ctypes.data, cap,
+        digests.ctypes.data if digests is not None else None,
+    )
+    if n < 0:
+        raise RuntimeError("native fused chunker failed (cut overflow or OOM)")
+    return (
+        cuts[:n].copy(),
+        digests[: n * 32].tobytes() if digests is not None else b"",
+    )
+
+
+def sha256_many_native(data: np.ndarray, extents: np.ndarray) -> bytes:
+    """SHA-256 of m (offset, size) extents of data in one GIL-dropping call.
+
+    extents: i64[m, 2]. Returns 32*m digest bytes (SHA-NI when the CPU has
+    it, scalar otherwise — always standard SHA-256).
+    """
+    lib = load()
+    if lib is None or not hasattr(lib, "ntpu_sha256_many"):
+        raise RuntimeError("ntpu_sha256_many not available in libchunk_engine.so")
+    arr = np.ascontiguousarray(data, dtype=np.uint8)
+    ext = np.ascontiguousarray(extents, dtype=np.int64)
+    m = ext.shape[0] if ext.ndim == 2 else len(ext) // 2
+    out = np.empty(m * 32, dtype=np.uint8)
+    lib.ntpu_sha256_many(arr.ctypes.data, ext.ctypes.data, m, out.ctypes.data)
+    return out.tobytes()
 
 
 def dict_build_available() -> bool:

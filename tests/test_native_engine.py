@@ -94,3 +94,63 @@ class TestHybridEngine:
         eng = ChunkDigestEngine(chunk_size=0x10000, backend="hybrid")
         assert eng.process_many([b""]) == [[]]
         assert eng.process_many([]) == []
+
+
+class TestFusedChunkDigest:
+    """The single-pass SIMD-bitmap + SHA-NI arm (ntpu_chunk_digest)."""
+
+    pytestmark = pytest.mark.skipif(
+        not native_cdc.chunk_digest_available(),
+        reason="fused chunk+digest not in libchunk_engine.so",
+    )
+
+    @pytest.mark.parametrize(
+        "size", [0, 1, 100, PARAMS.min_size, PARAMS.max_size, 1 << 20, (1 << 21) + 777]
+    )
+    def test_cuts_match_scalar_chunker(self, size):
+        data = _data(size, seed=21)
+        cuts, _ = native_cdc.chunk_digest_native(data, PARAMS, want_digests=False)
+        assert np.array_equal(cuts, native_cdc.chunk_data_native(data, PARAMS))
+
+    def test_digests_match_hashlib(self):
+        data = _data((1 << 21) + 4321, seed=22)
+        cuts, digests = native_cdc.chunk_digest_native(data, PARAMS)
+        start = 0
+        for i, c in enumerate(cuts):
+            want = hashlib.sha256(data[start:c]).digest()
+            assert digests[32 * i : 32 * (i + 1)] == want
+            start = int(c)
+
+    def test_sha256_many_matches_hashlib(self):
+        rng = np.random.default_rng(23)
+        data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+        # lengths straddling SHA block/pad edges plus random sizes
+        lens = [0, 1, 55, 56, 63, 64, 65, 119, 120, 128] + list(
+            rng.integers(0, 70000, 40)
+        )
+        exts = np.asarray(
+            [
+                (0 if n == 0 else int(rng.integers(0, data.size - n + 1)), int(n))
+                for n in lens
+            ],
+            dtype=np.int64,
+        )
+        out = native_cdc.sha256_many_native(data, exts)
+        for i, (o, n) in enumerate(exts):
+            assert (
+                out[32 * i : 32 * (i + 1)]
+                == hashlib.sha256(data[o : o + n].tobytes()).digest()
+            )
+
+    def test_engine_fused_path_equals_split_path(self):
+        files = [_data(600_000, seed=s) for s in (31, 32, 33)]
+        fused = ChunkDigestEngine(chunk_size=0x10000, mode="cdc", backend="hybrid")
+        assert fused._fused_available()
+        split = ChunkDigestEngine(
+            chunk_size=0x10000, mode="cdc", backend="numpy", digest_backend="numpy"
+        )
+        got = fused.process_many(files)
+        want = split.process_many(files)
+        assert [[(m.offset, m.size, m.digest) for m in f] for f in got] == [
+            [(m.offset, m.size, m.digest) for m in f] for f in want
+        ]
