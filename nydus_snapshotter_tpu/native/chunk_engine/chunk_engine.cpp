@@ -130,6 +130,85 @@ void gear_bitmaps_avx2(const uint8_t *data, int64_t n, uint32_t mask_s,
     }
   }
 }
+// GCC-12 false positives: maskless AVX-512 intrinsics expand through
+// _mm512_undefined_epi32 dummies that trip -Wmaybe-uninitialized.
+#pragma GCC diagnostic push
+#pragma GCC diagnostic ignored "-Wmaybe-uninitialized"
+__attribute__((target("avx512f,avx512bw")))
+void gear_bitmaps_avx512(const uint8_t *data, int64_t n, uint32_t mask_s,
+                         uint32_t mask_l, uint64_t *bm_s, uint64_t *bm_l) {
+  alignas(64) uint32_t bufa[TILE + 32], bufb[TILE + 32];
+  const __m512i c0 = _mm512_set1_epi32((int)MIX_C0);
+  const __m512i c1 = _mm512_set1_epi32((int)MIX_C1);
+  const __m512i c2 = _mm512_set1_epi32((int)MIX_C2);
+  const __m512i one = _mm512_set1_epi32(1);
+  const __m512i vms = _mm512_set1_epi32((int)mask_s);
+  const __m512i vml = _mm512_set1_epi32((int)mask_l);
+
+  for (int64_t p0 = 0; p0 < n; p0 += TILE) {
+    const int64_t count = (p0 + TILE <= n) ? TILE : n - p0;
+    const int64_t len = count + 31;
+    uint32_t *a = bufa, *b = bufb;
+
+    int64_t j = 0;
+    const int64_t base = p0 - 31;
+    while (j < len && base + j < 0) a[j++] = 0u;
+    for (; j + 16 <= len; j += 16) {
+      const __m128i raw =
+          _mm_loadu_si128((const __m128i *)(data + base + j));
+      __m512i x = _mm512_cvtepu8_epi32(raw);
+      x = _mm512_mullo_epi32(_mm512_add_epi32(x, one), c0);
+      x = _mm512_xor_si512(x, _mm512_srli_epi32(x, 16));
+      x = _mm512_mullo_epi32(x, c1);
+      x = _mm512_xor_si512(x, _mm512_srli_epi32(x, 13));
+      x = _mm512_mullo_epi32(x, c2);
+      x = _mm512_xor_si512(x, _mm512_srli_epi32(x, 16));
+      _mm512_storeu_si512((void *)(a + j), x);
+    }
+    for (; j < len; ++j) a[j] = mix32(data[base + j]);
+
+    for (int m = 1; m <= 16; m *= 2) {
+      int64_t k = m;
+      for (; k + 16 <= len; k += 16) {
+        const __m512i cur = _mm512_loadu_si512((const void *)(a + k));
+        const __m512i prev =
+            _mm512_loadu_si512((const void *)(a + k - m));
+        _mm512_storeu_si512(
+            (__m512i *)(b + k),
+            _mm512_add_epi32(cur, _mm512_slli_epi32(prev, m)));
+      }
+      for (; k < len; ++k) b[k] = a[k] + (a[k - m] << m);
+      for (int64_t h = 0; h < m; ++h) b[h] = a[h];
+      uint32_t *t = a;
+      a = b;
+      b = t;
+    }
+
+    // testn mask: 1 exactly where (h & mask) == 0 — the candidate bit
+    const uint32_t *s = a + 31;
+    int64_t i = 0;
+    for (; i + 64 <= count; i += 64) {
+      uint64_t ws = 0, wl = 0;
+      for (int64_t q = 0; q < 64; q += 16) {
+        const __m512i v = _mm512_loadu_si512((const void *)(s + i + q));
+        ws |= (uint64_t)_mm512_testn_epi32_mask(v, vms) << q;
+        wl |= (uint64_t)_mm512_testn_epi32_mask(v, vml) << q;
+      }
+      bm_s[(p0 + i) >> 6] = ws;
+      bm_l[(p0 + i) >> 6] = wl;
+    }
+    if (i < count) {
+      uint64_t ws = 0, wl = 0;
+      for (int64_t q = i; q < count; ++q) {
+        if ((s[q] & mask_s) == 0) ws |= 1ULL << (q - i);
+        if ((s[q] & mask_l) == 0) wl |= 1ULL << (q - i);
+      }
+      bm_s[(p0 + i) >> 6] = ws;
+      bm_l[(p0 + i) >> 6] = wl;
+    }
+  }
+}
+#pragma GCC diagnostic pop
 #endif  // NTPU_X86
 
 void gear_bitmaps_scalar(const uint8_t *data, int64_t n, uint32_t mask_s,
@@ -148,6 +227,11 @@ void gear_bitmaps_scalar(const uint8_t *data, int64_t n, uint32_t mask_s,
 void gear_bitmaps(const uint8_t *data, int64_t n, uint32_t mask_s,
                   uint32_t mask_l, uint64_t *bm_s, uint64_t *bm_l) {
 #ifdef NTPU_X86
+  if (__builtin_cpu_supports("avx512f") &&
+      __builtin_cpu_supports("avx512bw")) {
+    gear_bitmaps_avx512(data, n, mask_s, mask_l, bm_s, bm_l);
+    return;
+  }
   if (__builtin_cpu_supports("avx2")) {
     gear_bitmaps_avx2(data, n, mask_s, mask_l, bm_s, bm_l);
     return;
@@ -347,28 +431,19 @@ void ntpu_gear_hashes(const uint8_t *data, int64_t n,
   }
 }
 
-// Position-parallel candidate bitmaps (gear-v2 mix32 computed inline, no
-// table: identical contents to ops/gear.gear_table() by construction).
-// bm_s/bm_l are caller buffers of (n+63)/64 u64 words, LSB-first.
-void ntpu_gear_bitmaps(const uint8_t *data, int64_t n, uint32_t mask_small,
-                       uint32_t mask_large, uint64_t *bm_s, uint64_t *bm_l) {
-  gear_bitmaps(data, n, mask_small, mask_large, bm_s, bm_l);
-}
-
-// Cut resolution over candidate bitmaps; same contract as ntpu_cdc_chunk.
-int64_t ntpu_resolve_bitmap_cuts(const uint64_t *bm_s, const uint64_t *bm_l,
-                                 int64_t n, int64_t min_size,
-                                 int64_t normal_size, int64_t max_size,
-                                 int64_t *cuts_out, int64_t cuts_cap) {
-  return resolve_bitmap_cuts(bm_s, bm_l, n, min_size, normal_size, max_size,
-                             cuts_out, cuts_cap);
-}
-
 // SHA-256 of m extents of data; extents are (offset, size) i64 pairs,
 // digests_out gets 32 bytes per extent. SHA-NI when the CPU has it.
 void ntpu_sha256_many(const uint8_t *data, const int64_t *extents, int64_t m,
                       uint8_t *digests_out) {
-  for (int64_t i = 0; i < m; ++i) {
+  int64_t i = 0;
+  for (; i + 2 <= m; i += 2) {
+    ntpu_sha::sha256_pair(
+        data + extents[2 * i], (uint64_t)extents[2 * i + 1],
+        digests_out + 32 * i,
+        data + extents[2 * i + 2], (uint64_t)extents[2 * i + 3],
+        digests_out + 32 * (i + 1));
+  }
+  if (i < m) {
     ntpu_sha::sha256(data + extents[2 * i], (uint64_t)extents[2 * i + 1],
                      digests_out + 32 * i);
   }
@@ -398,11 +473,18 @@ int64_t ntpu_chunk_digest(const uint8_t *data, int64_t n,
   std::free(bm);
   if (n_cuts < 0) return -1;
   if (digests_out != nullptr) {
+    int64_t i = 0;
     int64_t start = 0;
-    for (int64_t i = 0; i < n_cuts; ++i) {
+    for (; i + 2 <= n_cuts; i += 2) {
+      const int64_t mid = cuts_out[i], end = cuts_out[i + 1];
+      ntpu_sha::sha256_pair(data + start, (uint64_t)(mid - start),
+                            digests_out + 32 * i, data + mid,
+                            (uint64_t)(end - mid), digests_out + 32 * (i + 1));
+      start = end;
+    }
+    if (i < n_cuts) {
       ntpu_sha::sha256(data + start, (uint64_t)(cuts_out[i] - start),
                        digests_out + 32 * i);
-      start = cuts_out[i];
     }
   }
   return n_cuts;

@@ -102,6 +102,14 @@ def _try_build() -> bool:
                 fp.write(stamp)
         return ok
     except (OSError, subprocess.TimeoutExpired):
+        # Remember exception-path failures (wedged compiler, timeout) too,
+        # so other processes degrade instantly instead of re-paying this.
+        try:
+            os.makedirs(os.path.dirname(marker), exist_ok=True)
+            with open(marker, "w") as fp:
+                fp.write(stamp)
+        except OSError:
+            pass
         return False
     finally:
         shutil.rmtree(os.path.join(native_dir, tmp), ignore_errors=True)
