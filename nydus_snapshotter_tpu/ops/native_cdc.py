@@ -81,35 +81,39 @@ def _try_build() -> bool:
     try:
         # Only the chunk-engine target: an unrelated target failing (e.g.
         # optimizer-server in a stripped install) must not disable this arm.
-        ok = (
-            subprocess.run(
-                ["make", "-C", native_dir, f"{tmp}/libchunk_engine.so",
-                 f"BIN_DIR={tmp}"],
-                capture_output=True,
-                timeout=120,
-            ).returncode
-            == 0
-        )
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        if ok:
-            os.replace(os.path.join(native_dir, tmp, "libchunk_engine.so"), path)
+        try:
+            ok = (
+                subprocess.run(
+                    ["make", "-C", native_dir, f"{tmp}/libchunk_engine.so",
+                     f"BIN_DIR={tmp}"],
+                    capture_output=True,
+                    timeout=120,
+                ).returncode
+                == 0
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            ok = False
+        if not ok:
+            # Remember BUILD failures (incl. wedged compiler/timeout) on
+            # disk so other processes degrade instantly instead of each
+            # re-paying a doomed compile. Post-build filesystem errors
+            # below deliberately leave no marker: the toolchain works, so
+            # the next process should simply retry.
             try:
-                os.unlink(marker)
+                os.makedirs(os.path.dirname(marker), exist_ok=True)
+                with open(marker, "w") as fp:
+                    fp.write(stamp)
             except OSError:
                 pass
-        else:
-            with open(marker, "w") as fp:
-                fp.write(stamp)
-        return ok
-    except (OSError, subprocess.TimeoutExpired):
-        # Remember exception-path failures (wedged compiler, timeout) too,
-        # so other processes degrade instantly instead of re-paying this.
+            return False
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        os.replace(os.path.join(native_dir, tmp, "libchunk_engine.so"), path)
         try:
-            os.makedirs(os.path.dirname(marker), exist_ok=True)
-            with open(marker, "w") as fp:
-                fp.write(stamp)
+            os.unlink(marker)
         except OSError:
             pass
+        return True
+    except OSError:
         return False
     finally:
         shutil.rmtree(os.path.join(native_dir, tmp), ignore_errors=True)

@@ -301,6 +301,10 @@ class TestFullStackLazyPull:
         finally:
             os.environ.pop("NTPU_DISABLE_FUSE", None)
             try:
+                fs.teardown()  # destroys the spawned shared daemon process
+            except Exception:
+                pass
+            try:
                 mgr.stop()
             except Exception:
                 pass
