@@ -127,6 +127,123 @@ def calibrate_engine(chunk_size: int, repo: str, device_ok: bool):
     return winner, device_executes, {k: round(v, 3) for k, v in times.items()}
 
 
+def build_probe(dict_digest_bytes: bytes, device_ok: bool):
+    """(probe fn, arm name) for a chunk dict of raw 32-byte digests.
+
+    Probe arm: native host table on one chip (device gathers are
+    element-serial), sharded all_to_all on real meshes; pure-python set as
+    the last resort. Never touches jax backend init unless the device
+    already answered (a wedged tunnel must not hang the bench).
+    """
+    from nydus_snapshotter_tpu.ops import native_cdc
+    from nydus_snapshotter_tpu.parallel import mesh as mesh_lib
+    from nydus_snapshotter_tpu.parallel.sharded_dict import ShardedChunkDict
+
+    dict_digests = (
+        np.frombuffer(dict_digest_bytes, dtype="<u4").reshape(-1, 8)
+        if dict_digest_bytes
+        else np.zeros((0, 8), np.uint32)
+    )
+    if device_ok:
+        sdict = ShardedChunkDict(dict_digests, mesh_lib.make_mesh(1))
+        sdict.lookup_digests([dict_digest_bytes[:32]] if dict_digest_bytes else [])
+        return sdict.lookup_digests, (
+            "host-native" if sdict._use_host_probe() else "device"
+        )
+    if native_cdc.dict_probe_available():
+        from nydus_snapshotter_tpu.parallel.sharded_dict import (
+            MAX_PROBE,
+            _build_host_tables,
+        )
+
+        keys, values = _build_host_tables(dict_digests, 1)
+
+        def probe(digests):
+            q = np.frombuffer(b"".join(digests), dtype="<u4").reshape(-1, 8)
+            return native_cdc.dict_probe_native(
+                q, keys.reshape(-1, 8), values.reshape(-1), 1, keys.shape[1], MAX_PROBE
+            )
+
+        return probe, "host-native"
+
+    dict_set = {
+        dict_digest_bytes[i : i + 32] for i in range(0, len(dict_digest_bytes), 32)
+    }
+    return (lambda digests: np.asarray([d in dict_set for d in digests])), "host-set"
+
+
+def build_layered_images(total_mib: int):
+    """Two synthetic multi-layer images with real cross-image overlap —
+    the BASELINE config #2/#3 shape (node:21-with-chunk-dict, batch vs
+    shared dict) without network access. Image A is the dict source;
+    image B re-uses ~half of A's content blocks, so dedup hits are
+    meaningful, not incidental."""
+    rng = np.random.default_rng(1234)
+    n_layers = 6
+    per_image = total_mib * (1 << 20) // 2
+    # log-spread layer sizes like real images (one big rootfs layer, small
+    # config/app layers), normalized to per_image bytes
+    weights = np.asarray([32.0, 16.0, 8.0, 4.0, 2.0, 2.0])
+    sizes = (weights / weights.sum() * per_image).astype(np.int64)
+    pool = rng.integers(0, 256, per_image, dtype=np.uint8)  # shared content pool
+
+    def make_layers(reuse_fraction: float) -> list[bytes]:
+        layers = []
+        for s in sizes:
+            n_reuse = int(s * reuse_fraction)
+            fresh = rng.integers(0, 256, s - n_reuse, dtype=np.uint8)
+            off = int(rng.integers(0, max(1, pool.size - n_reuse)))
+            layers.append(
+                np.concatenate([pool[off : off + n_reuse], fresh]).tobytes()
+            )
+        return layers
+
+    return make_layers(1.0), make_layers(0.5)
+
+
+def baseline_shaped_run(engine, device_ok: bool) -> dict:
+    """Convert image A (builds the chunk dict), then image B against it;
+    report per-image engine throughput and the measured dedup ratio."""
+    image_a, image_b = build_layered_images(total_mib=min(CORPUS_MIB, 256))
+
+    warm_digests_b = None
+    if engine.backend == "jax" or engine.digest_backend == "jax":
+        # Device arms compile per shape; the layered sizes are new shapes,
+        # so warm them (and the probe batch, below) outside the timers or
+        # the numbers measure XLA compilation, not conversion.
+        engine.process_many(image_a)
+        warm_b = engine.process_many(image_b)
+        warm_digests_b = [m.digest for layer in warm_b for m in layer]
+
+    t0 = time.time()
+    metas_a = engine.process_many(image_a)
+    t_a = time.time() - t0
+    dict_bytes = b"".join(m.digest for layer in metas_a for m in layer)
+    probe, _arm = build_probe(dict_bytes, device_ok)
+    if warm_digests_b is not None:
+        probe(warm_digests_b)  # compile the probe's real batch shape
+
+    t1 = time.time()
+    metas_b = engine.process_many(image_b)
+    flat_b = [m.digest for layer in metas_b for m in layer]
+    hits = np.asarray(probe(flat_b))
+    t_b = time.time() - t1
+
+    bytes_a = sum(len(x) for x in image_a)
+    bytes_b = sum(len(x) for x in image_b)
+    hit_mask = hits if hits.dtype == bool else hits >= 0
+    sizes_b = np.asarray([m.size for layer in metas_b for m in layer])
+    dedup_bytes = int(sizes_b[hit_mask].sum())
+    return {
+        "image_mib": round(bytes_a / (1 << 20)),
+        "layers": len(image_a),
+        "dict_chunks": len(dict_bytes) // 32,
+        "build_dict_gibps": round(bytes_a / t_a / (1 << 30), 4),
+        "convert_vs_dict_gibps": round(bytes_b / t_b / (1 << 30), 4),
+        "dedup_ratio": round(dedup_bytes / bytes_b, 4),
+    }
+
+
 def _device_available(repo: str, timeout: float = 120.0) -> bool:
     """Probe jax.devices() in a subprocess: a wedged device tunnel must
     degrade the bench to the host arm, not hang it."""
@@ -153,8 +270,6 @@ def main() -> None:
 
     from nydus_snapshotter_tpu.ops import native_cdc
     from nydus_snapshotter_tpu.ops.chunker import ChunkDigestEngine
-    from nydus_snapshotter_tpu.parallel import mesh as mesh_lib
-    from nydus_snapshotter_tpu.parallel.sharded_dict import ShardedChunkDict
 
     files = build_corpus(CORPUS_MIB, N_FILES)
     total_bytes = sum(len(f) for f in files)
@@ -186,41 +301,7 @@ def main() -> None:
     # (device gathers are element-serial), sharded all_to_all on meshes.
     warm_metas = engine.process_many(build_corpus(CALIBRATE_MIB, 2))
     warm_digest_bytes = b"".join(m.digest for metas in warm_metas for m in metas)
-    dict_digests = (
-        np.frombuffer(warm_digest_bytes, dtype="<u4").reshape(-1, 8)
-        if warm_digest_bytes
-        else np.zeros((0, 8), np.uint32)
-    )
-    if device_ok:
-        # Single-shard dict on the chip's mesh; _use_host_probe routes
-        # lookups to the native C++ arm (device gathers are element-serial
-        # on one chip), keeping the device path for real meshes.
-        sdict = ShardedChunkDict(dict_digests, mesh_lib.make_mesh(1))
-        sdict.lookup_digests([warm_digest_bytes[:32]] if warm_digest_bytes else [])
-        probe = sdict.lookup_digests
-        probe_arm = "host-native" if sdict._use_host_probe() else "device"
-    elif native_cdc.dict_probe_available():
-        # No device: native table without touching jax backend init (a
-        # wedged tunnel must not hang the bench).
-        from nydus_snapshotter_tpu.parallel.sharded_dict import (
-            MAX_PROBE,
-            _build_host_tables,
-        )
-
-        keys, values = _build_host_tables(dict_digests, 1)
-        probe_arm = "host-native"
-
-        def probe(digests):
-            q = np.frombuffer(b"".join(digests), dtype="<u4").reshape(-1, 8)
-            return native_cdc.dict_probe_native(
-                q, keys.reshape(-1, 8), values.reshape(-1), 1, keys.shape[1], MAX_PROBE
-            )
-    else:
-        dict_set = {warm_digest_bytes[i: i + 32] for i in range(0, len(warm_digest_bytes), 32)}
-        probe_arm = "host-set"
-
-        def probe(digests):
-            return np.asarray([d in dict_set for d in digests])
+    probe, probe_arm = build_probe(warm_digest_bytes, device_ok)
 
     if winner != "host":
         # Warm every compiled shape before timing (host arms have nothing
@@ -266,6 +347,10 @@ def main() -> None:
                 "hits": n_hits,
             }
 
+    # BASELINE-shaped slice: layered image pair with cross-image dict
+    # dedup (configs #2/#3) — reported alongside the flat-corpus metric.
+    shaped = baseline_shaped_run(bench_engine, device_ok)
+
     gibps = total_bytes / best["elapsed"] / (1 << 30)
     print(
         json.dumps(
@@ -298,6 +383,7 @@ def main() -> None:
                         }
                     ),
                     "calibration": cal,
+                    "baseline_shaped": shaped,
                 },
             }
         )

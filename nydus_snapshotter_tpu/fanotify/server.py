@@ -40,10 +40,15 @@ class EventInfo:
 
 
 def default_binary_path() -> str:
-    """The in-tree native build output (make -C nydus_snapshotter_tpu/native)."""
-    return os.path.join(
-        os.path.dirname(os.path.dirname(__file__)), "native", "bin", "optimizer-server"
-    )
+    """The in-tree native build output, built on demand when missing or
+    stale (build artifacts are git-ignored, so a fresh checkout has
+    none). utils.native_build gives the atomic-rename + failure-memo
+    discipline, so concurrent NRI events never exec a half-written
+    binary and a doomed compile is paid once per source state."""
+    from nydus_snapshotter_tpu.utils import native_build
+
+    native_build.ensure_built("optimizer-server", "optimizer_server")
+    return native_build.target_path("optimizer-server")
 
 
 class Server:

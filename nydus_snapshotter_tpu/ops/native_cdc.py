@@ -29,108 +29,23 @@ def _lib_path() -> str:
     )
 
 
-def _src_stamp(path: str) -> str:
-    """Newest source mtime under chunk_engine/ ('' when unreadable)."""
-    src_dir = os.path.join(os.path.dirname(os.path.dirname(path)), "chunk_engine")
-    try:
-        return str(
-            max(
-                os.path.getmtime(os.path.join(src_dir, f))
-                for f in os.listdir(src_dir)
-            )
-        )
-    except (OSError, ValueError):
-        return ""
-
-
-def _sources_newer(path: str) -> bool:
-    try:
-        stamp = _src_stamp(path)
-        return bool(stamp) and float(stamp) > os.path.getmtime(path)
-    except OSError:
-        return False
-
-
-def _try_build() -> bool:
-    """Best-effort build of libchunk_engine.so: build artifacts are
-    git-ignored, so a fresh checkout starts without the .so — and a stale
-    .so (older than its sources) must never be dlopen'd. The build goes to
-    a private temp dir and lands via atomic rename, so concurrent
-    processes never dlopen a half-written file. A missing compiler or a
-    failed build silently degrades to the numpy arm; the failure is
-    remembered on disk (keyed on source mtimes) so other processes don't
-    each re-pay a doomed compile."""
-    import shutil
-    import subprocess
-
-    path = _lib_path()
-    if os.path.exists(path) and not _sources_newer(path):
-        return True
-    native_dir = os.path.dirname(os.path.dirname(path))
-    marker = os.path.join(native_dir, "bin", ".build_failed")
-    stamp = _src_stamp(path)
-    try:
-        with open(marker) as fp:
-            if fp.read() == stamp:
-                return False  # this exact source state already failed
-    except OSError:
-        pass
-    if not shutil.which("make") or not shutil.which("g++"):
-        return False
-    tmp = f"bin.build.{os.getpid()}"
-    try:
-        # Only the chunk-engine target: an unrelated target failing (e.g.
-        # optimizer-server in a stripped install) must not disable this arm.
-        try:
-            ok = (
-                subprocess.run(
-                    ["make", "-C", native_dir, f"{tmp}/libchunk_engine.so",
-                     f"BIN_DIR={tmp}"],
-                    capture_output=True,
-                    timeout=120,
-                ).returncode
-                == 0
-            )
-        except (OSError, subprocess.TimeoutExpired):
-            ok = False
-        if not ok:
-            # Remember BUILD failures (incl. wedged compiler/timeout) on
-            # disk so other processes degrade instantly instead of each
-            # re-paying a doomed compile. Post-build filesystem errors
-            # below deliberately leave no marker: the toolchain works, so
-            # the next process should simply retry.
-            try:
-                os.makedirs(os.path.dirname(marker), exist_ok=True)
-                with open(marker, "w") as fp:
-                    fp.write(stamp)
-            except OSError:
-                pass
-            return False
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        os.replace(os.path.join(native_dir, tmp, "libchunk_engine.so"), path)
-        try:
-            os.unlink(marker)
-        except OSError:
-            pass
-        return True
-    except OSError:
-        return False
-    finally:
-        shutil.rmtree(os.path.join(native_dir, tmp), ignore_errors=True)
-
-
 def load() -> Optional[ctypes.CDLL]:
     """The shared library; built (or rebuilt if sources changed) on first
-    use per process. None when unbuildable — including when an EXISTING
+    use per process via utils.native_build (atomic rename + on-disk
+    failure memo). None when unbuildable — including when an EXISTING
     .so is stale against edited sources and the rebuild failed (loading it
     would silently diverge from the Python reference semantics)."""
+    from nydus_snapshotter_tpu.utils import native_build
+
     global _lib, _lib_missing
     with _lib_lock:
         if _lib is not None or _lib_missing:
             return _lib
         path = _lib_path()
-        built = _try_build()
-        if not os.path.exists(path) or (not built and _sources_newer(path)):
+        built = native_build.ensure_built("libchunk_engine.so", "chunk_engine")
+        if not os.path.exists(path) or (
+            not built and native_build.sources_newer("libchunk_engine.so", "chunk_engine")
+        ):
             _lib_missing = True
             return None
         try:

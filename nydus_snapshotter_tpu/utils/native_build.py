@@ -1,0 +1,96 @@
+"""On-demand builder for the in-tree C++ targets (native/bin/*).
+
+Build artifacts are git-ignored, so a fresh checkout has none; consumers
+(ops/native_cdc.py for libchunk_engine.so, fanotify/server.py for
+optimizer-server) call :func:`ensure_built` on first use. Discipline:
+
+- build into a private temp dir and land via atomic ``os.replace`` so a
+  concurrent process never dlopens/execs a half-written file;
+- refuse nothing here — staleness policy is the caller's (native_cdc
+  refuses a stale .so; a stale tracer binary is rebuilt below);
+- remember build FAILURES on disk keyed on source mtimes, so other
+  processes degrade instantly instead of each re-paying a doomed
+  compile. Post-build filesystem errors leave no memo: the toolchain
+  works, the next process should simply retry.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+
+
+def src_stamp(src_subdir: str) -> str:
+    """Newest source mtime under native/<src_subdir> ('' when unreadable)."""
+    src_dir = os.path.join(_NATIVE_DIR, src_subdir)
+    try:
+        return str(
+            max(os.path.getmtime(os.path.join(src_dir, f)) for f in os.listdir(src_dir))
+        )
+    except (OSError, ValueError):
+        return ""
+
+
+def target_path(target: str) -> str:
+    return os.path.join(_NATIVE_DIR, "bin", target)
+
+
+def sources_newer(target: str, src_subdir: str) -> bool:
+    stamp = src_stamp(src_subdir)
+    try:
+        return bool(stamp) and float(stamp) > os.path.getmtime(target_path(target))
+    except OSError:
+        return False
+
+
+def ensure_built(target: str, src_subdir: str) -> bool:
+    """Build native/bin/<target> if missing or stale. True when the
+    artifact is present and current afterwards."""
+    path = target_path(target)
+    if os.path.exists(path) and not sources_newer(target, src_subdir):
+        return True
+    marker = os.path.join(_NATIVE_DIR, "bin", f".build_failed.{target}")
+    stamp = src_stamp(src_subdir)
+    try:
+        with open(marker) as fp:
+            if fp.read() == stamp:
+                return False  # this exact source state already failed
+    except OSError:
+        pass
+    if not shutil.which("make") or not shutil.which("g++"):
+        return False
+    tmp = f"bin.build.{target}.{os.getpid()}"
+    try:
+        try:
+            ok = (
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR, f"{tmp}/{target}", f"BIN_DIR={tmp}"],
+                    capture_output=True,
+                    timeout=120,
+                ).returncode
+                == 0
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            ok = False
+        if not ok:
+            try:
+                os.makedirs(os.path.dirname(marker), exist_ok=True)
+                with open(marker, "w") as fp:
+                    fp.write(stamp)
+            except OSError:
+                pass
+            return False
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        os.replace(os.path.join(_NATIVE_DIR, tmp, target), path)
+        try:
+            os.unlink(marker)
+        except OSError:
+            pass
+        return True
+    except OSError:
+        return False
+    finally:
+        shutil.rmtree(os.path.join(_NATIVE_DIR, tmp), ignore_errors=True)

@@ -9,6 +9,9 @@ export JAX_COMPILATION_CACHE_DIR=/tmp/ntpu_jax_cache
 echo "== device probe =="
 timeout 120 python -c "import jax; print(jax.devices())" || { echo "tunnel down"; exit 1; }
 
+echo "== host fused arm =="
+timeout 200 python tools/devbench.py --mib 256 --stage fused 2>/dev/null | tail -1
+
 echo "== gear tile sweep =="
 for R in 512 1024 2048 4096; do
   NTPU_GEAR_TILE=$R timeout 400 python tools/devbench.py --mib 256 --stage gear 2>/dev/null | tail -1
