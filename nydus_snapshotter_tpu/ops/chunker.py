@@ -194,7 +194,13 @@ class ChunkDigestEngine:
     # (ops/gear_pallas.py); also bounds distinct compiled shapes.
     MIN_WINDOW = 1 << 19
 
-    def _candidates_windowed(self, arr: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    def _dispatch_windows(self, arr: np.ndarray):
+        """Enqueue the device hash of one stream; returns an opaque handle
+        for :meth:`_collect_windows`. Dispatch is ASYNC (jax queues the
+        upload + kernel), so callers can enqueue stream i+1 before
+        collecting stream i — the double-buffered infeed discipline: the
+        device crunches the next stream while the host unpacks/resolves
+        the previous one."""
         # Shrink the window for small streams: a 512 KiB buffer hashed in a
         # fixed 4 MiB window wastes 8x device compute on zero padding (the
         # streaming pack drains ~2*max_size buffers). Power-of-two windows
@@ -227,6 +233,12 @@ class ChunkDigestEngine:
                 jnp.uint32(self.params.mask_large),
                 w,
             )
+        return bm_s, bm_l, w, n_windows
+
+    def _collect_windows(
+        self, handle, arr: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        bm_s, bm_l, w, n_windows = handle
         bm_s, bm_l = np.asarray(jax.device_get(bm_s)), np.asarray(jax.device_get(bm_l))
         parts_s, parts_l = [], []
         for i in range(n_windows):
@@ -234,6 +246,9 @@ class ChunkDigestEngine:
             parts_s.append(_unpack_positions(bm_s[i], valid) + i * w)
             parts_l.append(_unpack_positions(bm_l[i], valid) + i * w)
         return np.concatenate(parts_s), np.concatenate(parts_l)
+
+    def _candidates_windowed(self, arr: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        return self._collect_windows(self._dispatch_windows(arr), arr)
 
     # -- digesting ----------------------------------------------------------
 
@@ -287,6 +302,28 @@ class ChunkDigestEngine:
         hybrid backend: the native chunker drops the GIL)."""
         if self.backend == "hybrid":
             return _map_threads(self.boundaries, arrs)
+        if self.backend == "jax" and self.mode == "cdc":
+            # Double-buffered device sweep: keep at most DEPTH streams
+            # in flight (async dispatch), collecting/resolving in order —
+            # the device works on stream i+1 while the host resolves
+            # stream i, with device/host memory bounded at DEPTH streams
+            # instead of the whole batch.
+            DEPTH = 2
+            from collections import deque
+
+            nonempty = deque((i, a) for i, a in enumerate(arrs) if a.size)
+            inflight: deque = deque()
+            out: list[np.ndarray] = [
+                np.asarray([], dtype=np.int64) for _ in arrs
+            ]
+            while nonempty or inflight:
+                while nonempty and len(inflight) < DEPTH:
+                    i, a = nonempty.popleft()
+                    inflight.append((i, a, self._dispatch_windows(a)))
+                i, a, h = inflight.popleft()
+                cand_s, cand_l = self._collect_windows(h, a)
+                out[i] = cdc.resolve_cuts(cand_s, cand_l, a.size, self.params)
+            return out
         return [self.boundaries(a) for a in arrs]
 
     def digest_all(

@@ -204,3 +204,26 @@ class TestGearPallas:
         rs, rl = _hash_bitmaps_kernel(xj, jnp.uint32(ms), jnp.uint32(ml), n)
         assert np.array_equal(np.asarray(ps), np.asarray(rs))
         assert np.array_equal(np.asarray(pl_), np.asarray(rl))
+
+
+class TestPipelinedBoundaries:
+    """boundaries_many on the jax backend enqueues every stream before
+    collecting any (async double-buffered sweep); cuts must equal the
+    sequential per-stream path and the numpy reference exactly."""
+
+    def test_pipelined_equals_reference(self):
+        rng = np.random.default_rng(41)
+        arrs = [
+            np.frombuffer(
+                rng.integers(0, 256, (1 << 19) + 777 * i, dtype=np.uint8).tobytes(),
+                dtype=np.uint8,
+            )
+            for i in range(4)
+        ] + [np.asarray([], dtype=np.uint8)]
+        dev = ChunkDigestEngine(chunk_size=0x1000, backend="jax")
+        ref = ChunkDigestEngine(chunk_size=0x1000, backend="numpy")
+        got = dev.boundaries_many(arrs)
+        want = ref.boundaries_many(arrs)
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            assert np.array_equal(g, w)
