@@ -122,12 +122,26 @@ class IncrementalChunker:
 
 
 class _HostDigester:
-    """Synchronous batch digests on the host thread pool."""
+    """Synchronous batch digests on the host.
+
+    Chunks arrive as separate byte strings; packing them into one buffer
+    + extent list lets the native SHA-NI arm digest the whole batch in a
+    single GIL-dropping call with its pairwise chain interleaving —
+    per-chunk calls would forfeit both. hashlib thread pool otherwise.
+    """
 
     def submit(self, datas: list[bytes]):
         from nydus_snapshotter_tpu.ops.chunker import _host_digests
 
-        return _host_digests([(np.frombuffer(d, dtype=np.uint8), 0, len(d)) for d in datas])
+        # One shared buffer so _host_digests' same-source-array grouping
+        # makes a single native call for the whole batch.
+        buf = np.frombuffer(b"".join(datas), dtype=np.uint8)
+        items = []
+        off = 0
+        for d in datas:
+            items.append((buf, off, len(d)))
+            off += len(d)
+        return _host_digests(items)
 
     def collect(self, handle) -> list[bytes]:
         return handle

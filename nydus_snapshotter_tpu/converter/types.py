@@ -33,9 +33,12 @@ class PackOption:
     batch_size: int = 0
     timeout: Optional[float] = None
     encrypt: bool = False
-    # Engine selection (replaces BuilderPath): jax = TPU data plane,
+    # Engine selection (replaces BuilderPath): hybrid = the fused native
+    # host arm (SIMD bitmaps + SHA-NI) — the default, like the reference
+    # defaulting to its production builder; jax = force the TPU batch arm
+    # (callers such as bench.py race the arms and pick per measurement);
     # numpy = host differential path.
-    backend: str = "jax"
+    backend: str = "hybrid"
     chunking: str = "cdc"  # "cdc" | "fixed"
 
     def validate(self) -> None:

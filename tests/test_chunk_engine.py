@@ -207,9 +207,10 @@ class TestGearPallas:
 
 
 class TestPipelinedBoundaries:
-    """boundaries_many on the jax backend enqueues every stream before
-    collecting any (async double-buffered sweep); cuts must equal the
-    sequential per-stream path and the numpy reference exactly."""
+    """boundaries_many on the jax backend keeps a bounded number of
+    streams in flight (async double-buffered sweep, depth 2); cuts must
+    equal the sequential per-stream path and the numpy reference
+    exactly."""
 
     def test_pipelined_equals_reference(self):
         rng = np.random.default_rng(41)
