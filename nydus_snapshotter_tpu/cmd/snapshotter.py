@@ -192,15 +192,18 @@ def build_stack(cfg: SnapshotterConfig):
             export_mode=cfg.experimental.tarfs_export_mode,
             max_concurrent_process=cfg.experimental.tarfs_max_concurrent_proc,
             # tarfs boundaries come from the tar layout (fixed regions);
-            # digests go through the host crossover arm — the control
-            # plane must never block on device/tunnel init (the jax arm
-            # stays an explicit accel_backend opt-in)
-            engine=ChunkDigestEngine(
-                chunk_size=DEFAULT_CHUNK_SIZE,
-                mode="fixed",
-                backend=cfg.daemon.accel_backend
-                if cfg.daemon.accel_backend in ("hybrid", "numpy", "jax")
-                else "hybrid",
+            # digests go through the configured arm (validated in
+            # Config.validate; default hybrid — the control plane must
+            # never block on device/tunnel init unless jax is opted in),
+            # or hashlib when acceleration is disabled outright.
+            engine=(
+                ChunkDigestEngine(
+                    chunk_size=DEFAULT_CHUNK_SIZE,
+                    mode="fixed",
+                    backend=cfg.daemon.accel_backend,
+                )
+                if cfg.daemon.accel_enable
+                else None
             ),
         )
 

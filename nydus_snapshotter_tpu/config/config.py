@@ -185,6 +185,8 @@ class SnapshotterConfig:
             constants.RECOVER_POLICY_FAILOVER,
         ):
             raise ConfigError(f"invalid recover policy {self.daemon.recover_policy!r}")
+        if self.daemon.accel_backend not in ("hybrid", "jax", "numpy"):
+            raise ConfigError(f"invalid accel backend {self.daemon.accel_backend!r}")
         if self.daemon.fs_driver in (constants.FS_DRIVER_BLOCKDEV, constants.FS_DRIVER_PROXY):
             # Proxy/blockdev modes run without nydusd daemons
             # (reference config.go:300-311 forces daemon_mode none).

@@ -255,6 +255,8 @@ class TestConfig:
         with pytest.raises(ConfigError):
             load_config(overrides={"daemon": {"fs_driver": "warpdrive"}})
         with pytest.raises(ConfigError):
+            load_config(overrides={"daemon": {"accel_backend": "jaxx"}})
+        with pytest.raises(ConfigError):
             load_config(overrides={"nope": 1})
 
     def test_blockdev_forces_none_mode(self):
