@@ -108,7 +108,9 @@ class IncrementalChunker:
         buf = self._buf
         if not buf:
             return []
-        cuts = self._boundaries(np.frombuffer(bytes(buf), dtype=np.uint8))
+        # frombuffer over the bytearray shares memory — no copy; the
+        # native chunker only reads it and finishes before we mutate.
+        cuts = self._boundaries(np.frombuffer(buf, dtype=np.uint8))
         out: list[bytes] = []
         s = 0
         for c in cuts:
