@@ -90,8 +90,8 @@ class IncrementalChunker:
         )
         self._buf = bytearray()
 
-    def _boundaries(self, arr: np.ndarray) -> np.ndarray:
-        return self._engine.boundaries(arr)
+    def _boundaries(self, data: "bytes | bytearray | np.ndarray") -> np.ndarray:
+        return self._engine.boundaries(data)
 
     def feed(self, seg: bytes) -> list[bytes]:
         self._buf += seg
@@ -108,9 +108,10 @@ class IncrementalChunker:
         buf = self._buf
         if not buf:
             return []
-        # frombuffer over the bytearray shares memory — no copy; the
-        # native chunker only reads it and finishes before we mutate.
-        cuts = self._boundaries(np.frombuffer(buf, dtype=np.uint8))
+        # The engine converts bytes/bytearray via a shared-memory
+        # frombuffer view — no copy; boundaries are computed before any
+        # mutation of the buffer.
+        cuts = self._boundaries(buf)
         out: list[bytes] = []
         s = 0
         for c in cuts:
