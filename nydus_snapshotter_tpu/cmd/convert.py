@@ -203,7 +203,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     def common(sp, dict_opt=True):
         sp.add_argument("--fs-version", default="v6", choices=("v5", "v6"))
-        sp.add_argument("--compressor", default="zstd",
+        sp.add_argument("--compressor", default="lz4_block",
                         choices=("none", "zstd", "lz4_block"))
         sp.add_argument("--chunk-size", type=lambda v: int(v, 0), default=0x100000)
         sp.add_argument("--batch-size", type=lambda v: int(v, 0), default=0)

@@ -26,7 +26,9 @@ class PackOption:
     fs_version: str = layout.RAFS_V6
     chunk_dict_path: str = ""
     prefetch_patterns: str = ""
-    compressor: str = "zstd"  # "none" | "zstd" | "lz4_block"
+    # lz4_block is the reference's default chunk codec (fast, modest
+    # ratio); zstd opts into better ratio at ~2x the pack cost.
+    compressor: str = "lz4_block"  # "none" | "zstd" | "lz4_block"
     oci_ref: bool = False
     aligned_chunk: bool = False
     chunk_size: int = constants.CHUNK_SIZE_DEFAULT
