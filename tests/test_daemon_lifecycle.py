@@ -262,3 +262,46 @@ class TestConfig:
     def test_blockdev_forces_none_mode(self):
         cfg = load_config(overrides={"daemon": {"fs_driver": "blockdev"}})
         assert cfg.daemon_mode == constants.DAEMON_MODE_NONE
+
+
+class TestRollingUpgrade:
+    """Rolling live-upgrade happy path: a real daemon with a live mount is
+    upgraded through the system controller's REST route; the replacement
+    process takes over the supervisor session and serves the same reads
+    (reference system.go:309-446 + daemon_event.go:141-218)."""
+
+    def test_rest_upgrade_preserves_reads(self, tmp_path, image):
+        from nydus_snapshotter_tpu.system.system import SystemController
+        from tests.test_observability import _uds_request
+
+        boot, blob_dir, files = image
+        cfg = _mk_config(tmp_path, policy=constants.RECOVER_POLICY_FAILOVER)
+        mgr = Manager(cfg, Database(cfg.database_path))
+        daemon = mgr.new_daemon("up1")
+        mgr.add_daemon(daemon)
+        sock = str(tmp_path / "system.sock")
+        sc = SystemController(managers=[mgr], sock_path=sock)
+        sc.run()
+        try:
+            mgr.start_daemon(daemon)
+            rafs = Rafs(snapshot_id="s", daemon_id="up1")
+            daemon.shared_mount(rafs, boot, _daemon_config_json(blob_dir))
+            sup = mgr.supervisors.get("up1")
+            assert sup.wait_for_state(timeout=5)
+            old_pid = daemon.pid
+            assert daemon.client().read_file("/s", "/app/hello.txt") == files["/app/hello.txt"]
+
+            status, _ = _uds_request(
+                sock, "PUT", "/api/v1/daemons/upgrade", json.dumps({}).encode()
+            )
+            assert status == 200
+
+            # a NEW process serves the SAME mount, state intact
+            assert daemon.pid != old_pid
+            assert daemon.state() == DaemonState.RUNNING
+            assert daemon.client().read_file("/s", "/app/hello.txt") == files["/app/hello.txt"]
+            assert daemon.client().read_file("/s", "/app/data.bin") == files["/app/data.bin"]
+        finally:
+            sc.stop()
+            mgr.destroy_daemon(daemon)
+            mgr.stop()
