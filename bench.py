@@ -244,24 +244,33 @@ def baseline_shaped_run(engine, device_ok: bool) -> dict:
     }
 
 
-def _device_available(repo: str, timeout: float = 120.0) -> bool:
-    """Probe jax.devices() in a subprocess: a wedged device tunnel must
-    degrade the bench to the host arm, not hang it."""
+def _device_available(repo: str, timeout: float = 120.0) -> tuple[bool, str]:
+    """(ok, note) — probe jax.devices() in a subprocess: a wedged device
+    tunnel must degrade the bench to the host arm, not hang it. The note
+    records WHY the device was not engaged so a host-arm result is
+    attributable (wedged tunnel vs lost race vs import failure)."""
     import subprocess
 
     child = (
         "import os, sys; os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',"
         " '/tmp/ntpu_jax_cache'); sys.path.insert(0, %r);"
-        " import jax; jax.devices(); print('ok')" % repo
+        " import jax; print([d.platform for d in jax.devices()])" % repo
     )
     try:
         out = subprocess.run(
             [sys.executable, "-c", child], capture_output=True, text=True,
             timeout=timeout,
         )
-        return out.returncode == 0 and "ok" in out.stdout
+        if out.returncode == 0 and out.stdout.strip():
+            platforms = out.stdout.strip().splitlines()[-1]
+            if "'cpu'" in platforms and "tpu" not in platforms:
+                # jax silently fell back to host CPU: that is NOT a device
+                return False, f"jax fell back to CPU-only ({platforms})"
+            return True, f"devices: {platforms}"
+        err = out.stderr.strip().splitlines()[-1] if out.stderr.strip() else ""
+        return False, f"device probe exited rc={out.returncode}: {err}"[:200]
     except subprocess.TimeoutExpired:
-        return False
+        return False, f"device probe hung >{timeout:.0f}s (wedged tunnel)"
 
 
 def main() -> None:
@@ -274,8 +283,12 @@ def main() -> None:
     files = build_corpus(CORPUS_MIB, N_FILES)
     total_bytes = sum(len(f) for f in files)
 
-    device_ok = _device_available(repo)
+    device_ok, device_note = _device_available(repo)
     winner, device_executes, cal = calibrate_engine(CHUNK_SIZE, repo, device_ok)
+    if device_ok and not device_executes:
+        device_note += "; every device arm failed calibration"
+    elif device_ok and winner == "host":
+        device_note += "; device arms lost the end-to-end race"
     device_ok = device_ok and device_executes
     bench_engine = ChunkDigestEngine(
         chunk_size=CHUNK_SIZE, mode="cdc", **ENGINE_ARMS[winner]
@@ -369,6 +382,7 @@ def main() -> None:
                     "gear_kernel": "host-fused" if fused else gear_kernel,
                     "probe_arm": probe_arm,
                     "device": device_ok,
+                    "device_note": device_note,
                     "elapsed_s": round(best["elapsed"], 3),
                     "stages_s": (
                         {
