@@ -7,6 +7,7 @@ API client, and vestige cleanup after crashes.
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import subprocess
@@ -20,6 +21,7 @@ from nydus_snapshotter_tpu.daemon.command import DaemonCommand
 from nydus_snapshotter_tpu.daemon.types import DaemonState
 from nydus_snapshotter_tpu.rafs.rafs import Rafs, RafsCache
 from nydus_snapshotter_tpu.utils import errdefs
+from nydus_snapshotter_tpu.utils import mount as mount_utils
 
 SHARED_DAEMON_ID = "shared_daemon"
 
@@ -169,12 +171,68 @@ class Daemon:
 
     def shared_mount(self, rafs: Rafs, bootstrap: str, config_json: str) -> None:
         """Attach one RAFS instance to a running daemon via the API
-        (reference daemon.go:229-273)."""
+        (reference daemon.go:229-273). The fscache driver's in-kernel
+        EROFS attach is the explicit :meth:`shared_erofs_mount` — it
+        requires a cachefiles-capable daemon, which the bundled userspace
+        daemon is not (it serves FUSE and API reads)."""
         self.client().mount(rafs.relative_mountpoint(), bootstrap, config_json)
         self.add_rafs_instance(rafs)
 
     def shared_umount(self, rafs: Rafs) -> None:
         self.client().umount(rafs.relative_mountpoint())
+        self.remove_rafs_instance(rafs.snapshot_id)
+
+    # Annotation key remembering which blob a snapshot's erofs mount bound,
+    # so umount can unbind exactly it.
+    _EROFS_BLOB_ANNO = "nydus.erofs.blob_id"
+
+    def shared_erofs_mount(
+        self, rafs: Rafs, bootstrap: str, config_json: str, mounter=None
+    ) -> None:
+        """fscache arm (reference daemon.go:275-324): PUT the blob config
+        to the daemon's v2 API (a cachefiles-capable daemon opens the
+        kernel session), then mount in-kernel EROFS over fscache at the
+        snapshot mountpoint. ``mounter`` injects the mount(2) step for
+        tests — kernel fscache support isn't universal.
+
+        This is an EXPLICIT surface for cachefiles-capable daemons: the
+        Filesystem facade routes the fscache driver through shared_mount
+        (API reads) because the bundled userspace daemon serves FUSE and
+        API reads, not cachefiles. Do not mix the two surfaces for one
+        instance — their teardowns differ.
+        """
+        self.client().bind_blob(config_json)
+        try:
+            blob_id = json.loads(config_json or "{}").get("id", "")
+        except ValueError:
+            blob_id = ""
+        mp = rafs.mountpoint or os.path.join(
+            self.states.workdir, "erofs", rafs.snapshot_id
+        )
+        fscache_id = mount_utils.erofs_fscache_id(rafs.snapshot_id)
+        try:
+            os.makedirs(mp, exist_ok=True)
+            (mounter or mount_utils.erofs_mount)(bootstrap, fscache_id, fscache_id, mp)
+        except Exception:
+            # roll the bind back: nothing else will ever unbind it
+            try:
+                self.client().unbind_blob(fscache_id, blob_id)
+            except (OSError, errdefs.NydusError):
+                pass
+            raise
+        rafs.mountpoint = mp
+        if blob_id:
+            rafs.annotations[self._EROFS_BLOB_ANNO] = blob_id
+        self.add_rafs_instance(rafs)
+
+    def shared_erofs_umount(self, rafs: Rafs, umounter=None) -> None:
+        if rafs.mountpoint:
+            (umounter or mount_utils.erofs_umount)(rafs.mountpoint)
+        blob_id = rafs.annotations.pop(self._EROFS_BLOB_ANNO, "")
+        if blob_id:
+            self.client().unbind_blob(
+                mount_utils.erofs_fscache_id(rafs.snapshot_id), blob_id
+            )
         self.remove_rafs_instance(rafs.snapshot_id)
 
     def recover_rafs_instances(self, instances: list[Rafs], configs: dict[str, str]) -> None:

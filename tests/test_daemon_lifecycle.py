@@ -305,3 +305,66 @@ class TestRollingUpgrade:
             sc.stop()
             mgr.destroy_daemon(daemon)
             mgr.stop()
+
+
+class TestSharedErofsMount:
+    """fscache attach surface: blob bind over the v2 API + in-kernel EROFS
+    mount with the reference's domain/fsid derivation (daemon.go:275-324,
+    erofs.go:18-46). The mount(2) step is injected — the bundled daemon
+    serves FUSE/API reads, not cachefiles, so the kernel mount needs a
+    cachefiles-capable daemon in production."""
+
+    def test_bind_then_mount_with_reference_fsid(self, tmp_path, image):
+        import hashlib as _hashlib
+
+        boot, blob_dir, files = image
+        cfg = _mk_config(tmp_path)
+        mgr = Manager(cfg, Database(cfg.database_path))
+        daemon = mgr.new_daemon("fc1")
+        daemon.states.fs_driver = constants.FS_DRIVER_FSCACHE
+        mgr.add_daemon(daemon)
+        mounts, umounts, unbinds = [], [], []
+        try:
+            mgr.start_daemon(daemon)
+            rafs = Rafs(snapshot_id="s9", daemon_id="fc1")
+            cfg_json = json.dumps(
+                {
+                    "id": "blob-s9",
+                    "device": {
+                        "backend": {"type": "localfs", "config": {"blob_dir": blob_dir}}
+                    },
+                }
+            )
+            daemon.shared_erofs_mount(
+                rafs, boot, cfg_json, mounter=lambda *a: mounts.append(a)
+            )
+            assert daemon.ref_count() == 1
+            ((bootstrap, domain_id, fscache_id, mp),) = mounts
+            assert bootstrap == boot
+            want = _hashlib.sha256(b"nydus-snapshot-s9").hexdigest()
+            assert domain_id == fscache_id == want
+            assert rafs.mountpoint == mp and os.path.isdir(mp)
+            # umount unbinds exactly the blob the mount bound
+            cl = daemon.client()
+            orig_unbind = cl.unbind_blob
+            cl.unbind_blob = lambda d, b: (unbinds.append((d, b)), orig_unbind(d, b))
+            daemon.shared_erofs_umount(rafs, umounter=lambda m: umounts.append(m))
+            assert umounts == [mp]
+            assert unbinds == [(want, "blob-s9")]
+            assert daemon.ref_count() == 0
+
+            # a failed kernel mount rolls its bind back
+            def boom(*a):
+                raise OSError("no fscache support")
+
+            unbinds.clear()
+            with pytest.raises(OSError):
+                daemon.shared_erofs_mount(
+                    Rafs(snapshot_id="s10", daemon_id="fc1"), boot, cfg_json,
+                    mounter=boom,
+                )
+            assert [b for _, b in unbinds] == ["blob-s9"]
+            assert daemon.ref_count() == 0
+        finally:
+            mgr.destroy_daemon(daemon)
+            mgr.stop()
