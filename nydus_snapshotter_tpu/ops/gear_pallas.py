@@ -116,7 +116,7 @@ def _bitmaps_lanes(y: jax.Array, mask_s: int, mask_l: int, interpret: bool = Fal
         kernel_squeezed,
         grid=grid,
         out_shape=(out_shape, out_shape),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=(out_spec, out_spec),
         scratch_shapes=[
             pltpu.VMEM((ROWS_PER_TILE + PAD, LANES), jnp.uint8),
