@@ -88,37 +88,53 @@ class IncrementalChunker:
         self.lookahead = (
             self._engine.params.max_size if self._engine.params else opt.chunk_size
         )
+        # Fused single-pass chunk+digest (native SIMD bitmaps + SHA-NI):
+        # when the engine's fused arm is available, each drain yields
+        # (chunk, digest) pairs directly — no separate digest sweep, no
+        # per-chunk batching copies. Digests of carried-over chunks are
+        # recomputed next drain (a few % of bytes at the drain cadence).
+        self.fused = self._engine._fused_available()
         self._buf = bytearray()
 
     def _boundaries(self, data: "bytes | bytearray | np.ndarray") -> np.ndarray:
         return self._engine.boundaries(data)
 
-    def feed(self, seg: bytes) -> list[bytes]:
+    def feed(self, seg: bytes) -> list[tuple[bytes, Optional[bytes]]]:
         self._buf += seg
         if len(self._buf) < 2 * self.lookahead:
             return []
         return self._drain(final=False)
 
-    def finish(self) -> list[bytes]:
+    def finish(self) -> list[tuple[bytes, Optional[bytes]]]:
         out = self._drain(final=True)
         self._buf = bytearray()
         return out
 
-    def _drain(self, final: bool) -> list[bytes]:
+    def _drain(self, final: bool) -> list[tuple[bytes, Optional[bytes]]]:
         buf = self._buf
         if not buf:
             return []
         # The engine converts bytes/bytearray via a shared-memory
-        # frombuffer view — no copy; boundaries are computed before any
-        # mutation of the buffer.
-        cuts = self._boundaries(buf)
-        out: list[bytes] = []
+        # frombuffer view — no copy; boundaries (and fused digests) are
+        # computed before any mutation of the buffer.
+        if self.fused:
+            from nydus_snapshotter_tpu.ops import native_cdc
+
+            cuts, digests = native_cdc.chunk_digest_native(buf, self._engine.params)
+        else:
+            cuts, digests = self._boundaries(buf), None
+        out: list[tuple[bytes, Optional[bytes]]] = []
         s = 0
-        for c in cuts:
+        for i, c in enumerate(cuts):
             c = int(c)
             if not final and s + self.lookahead > len(buf):
                 break
-            out.append(bytes(buf[s:c]))
+            out.append(
+                (
+                    bytes(buf[s:c]),
+                    digests[32 * i : 32 * (i + 1)] if digests is not None else None,
+                )
+            )
             s = c
         self._buf = bytearray(buf[s:]) if not final else bytearray()
         return out
@@ -370,8 +386,13 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
         _dispatch()  # collects old, dispatches remainder
         _dispatch()  # collects remainder
 
-    def _add_chunk(meta: _Meta, data: bytes) -> None:
+    def _add_chunk(meta: _Meta, data: bytes, digest: Optional[bytes] = None) -> None:
         nonlocal pending_bytes
+        if digest is not None:
+            # the fused chunker already digested this chunk (cache-warm,
+            # single native pass); dedup/write it immediately, in order
+            _process([(meta, data)], [digest])
+            return
         pending.append((meta, data))
         pending_bytes += len(data)
         if pending_bytes >= DIGEST_BATCH_BYTES:
@@ -409,10 +430,10 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
                         seg = f.read(SEGMENT_BYTES)
                         if not seg:
                             break
-                        for chunk in chunker.feed(seg):
-                            _add_chunk(meta, chunk)
-                    for chunk in chunker.finish():
-                        _add_chunk(meta, chunk)
+                        for chunk, digest in chunker.feed(seg):
+                            _add_chunk(meta, chunk, digest)
+                    for chunk, digest in chunker.finish():
+                        _add_chunk(meta, chunk, digest)
         except tarfile.TarError as e:
             raise ConvertError(f"bad layer tar: {e}") from e
     _drain_all()

@@ -36,14 +36,37 @@ class TestIncrementalChunker:
         data = RNG.integers(0, 256, 3_000_000, dtype=np.uint8).tobytes()
         opt = PackOption(chunk_size=0x10000, backend="numpy")
         ch = IncrementalChunker(opt)
-        chunks = []
+        pairs = []
         for off in range(0, len(data), seg):
-            chunks.extend(ch.feed(data[off : off + seg]))
-        chunks.extend(ch.finish())
+            pairs.extend(ch.feed(data[off : off + seg]))
+        pairs.extend(ch.finish())
+        chunks = [c for c, _ in pairs]
         assert b"".join(chunks) == data
         sizes = np.cumsum([len(c) for c in chunks])
         want = cdc.chunk_data_np(np.frombuffer(data, np.uint8), cdc.CDCParams(0x10000))
         assert np.array_equal(sizes, want)
+        assert all(d is None for _, d in pairs)  # numpy backend never fuses
+
+    def test_fused_hybrid_matches_numpy_and_hashlib(self):
+        import hashlib
+
+        from nydus_snapshotter_tpu.ops import native_cdc
+
+        if not native_cdc.chunk_digest_available():
+            pytest.skip("fused native arm unavailable")
+        data = RNG.integers(0, 256, 2_500_000, dtype=np.uint8).tobytes()
+        ch = IncrementalChunker(PackOption(chunk_size=0x10000, backend="hybrid"))
+        assert ch.fused
+        pairs = []
+        for off in range(0, len(data), 1 << 18):
+            pairs.extend(ch.feed(data[off : off + (1 << 18)]))
+        pairs.extend(ch.finish())
+        chunks = [c for c, _ in pairs]
+        assert b"".join(chunks) == data
+        sizes = np.cumsum([len(c) for c in chunks])
+        want = cdc.chunk_data_np(np.frombuffer(data, np.uint8), cdc.CDCParams(0x10000))
+        assert np.array_equal(sizes, want)
+        assert all(d == hashlib.sha256(c).digest() for c, d in pairs)
 
     def test_fixed_matches_whole_stream(self):
         data = RNG.integers(0, 256, 1_000_001, dtype=np.uint8).tobytes()
@@ -51,8 +74,8 @@ class TestIncrementalChunker:
         ch = IncrementalChunker(opt)
         chunks = []
         for off in range(0, len(data), 70_000):
-            chunks.extend(ch.feed(data[off : off + 70_000]))
-        chunks.extend(ch.finish())
+            chunks.extend(c for c, _ in ch.feed(data[off : off + 70_000]))
+        chunks.extend(c for c, _ in ch.finish())
         assert b"".join(chunks) == data
         assert all(len(c) == 0x10000 for c in chunks[:-1])
 
@@ -63,7 +86,7 @@ class TestIncrementalChunker:
         assert ch.finish() == []
         ch = IncrementalChunker(opt)
         assert ch.feed(b"abc") == []
-        assert ch.finish() == [b"abc"]
+        assert [c for c, _ in ch.finish()] == [b"abc"]
 
 
 class TestStreamPack:
