@@ -48,10 +48,18 @@ inline uint32_t mix32(uint32_t x) {
   return x;
 }
 
+// All three arms compute candidate bitmaps for the position range
+// [lo, hi) only — lo must be TILE-aligned (whole bitmap words, and each
+// tile re-derives its own 31-byte seam from the bytes before it), so
+// disjoint ranges compose bit-identically with a whole-stream pass. The
+// fused pass exploits this: positions inside [chunk_start,
+// judge_from - 31) can never influence a judged hash and are simply never
+// computed (~min_size/avg_size of all bytes skipped).
 #ifdef NTPU_X86
 __attribute__((target("avx2")))
-void gear_bitmaps_avx2(const uint8_t *data, int64_t n, uint32_t mask_s,
-                       uint32_t mask_l, uint64_t *bm_s, uint64_t *bm_l) {
+void gear_bitmaps_avx2(const uint8_t *data, int64_t lo, int64_t hi,
+                       uint32_t mask_s, uint32_t mask_l, uint64_t *bm_s,
+                       uint64_t *bm_l) {
   alignas(32) uint32_t bufa[TILE + 32], bufb[TILE + 32];
   const __m256i c0 = _mm256_set1_epi32((int)MIX_C0);
   const __m256i c1 = _mm256_set1_epi32((int)MIX_C1);
@@ -61,8 +69,8 @@ void gear_bitmaps_avx2(const uint8_t *data, int64_t n, uint32_t mask_s,
   const __m256i vml = _mm256_set1_epi32((int)mask_l);
   const __m256i vzero = _mm256_setzero_si256();
 
-  for (int64_t p0 = 0; p0 < n; p0 += TILE) {
-    const int64_t count = (p0 + TILE <= n) ? TILE : n - p0;
+  for (int64_t p0 = lo; p0 < hi; p0 += TILE) {
+    const int64_t count = (p0 + TILE <= hi) ? TILE : hi - p0;
     const int64_t len = count + 31;
     uint32_t *a = bufa, *b = bufb;
 
@@ -134,110 +142,148 @@ void gear_bitmaps_avx2(const uint8_t *data, int64_t n, uint32_t mask_s,
 // _mm512_undefined_epi32 dummies that trip -Wmaybe-uninitialized.
 #pragma GCC diagnostic push
 #pragma GCC diagnostic ignored "-Wmaybe-uninitialized"
-__attribute__((target("avx512f,avx512bw")))
-void gear_bitmaps_avx512(const uint8_t *data, int64_t n, uint32_t mask_s,
-                         uint32_t mask_l, uint64_t *bm_s, uint64_t *bm_l) {
-  alignas(64) uint32_t bufa[TILE + 32], bufb[TILE + 32];
+// Register-resident rolling formulation: the 5 log-doubling levels never
+// touch memory. Each 16-position step keeps the previous step's vector at
+// every level (pg, p1, p2, p4, p8) live in zmm registers; the
+// position-m shift is a valignd against that rolling state. The buffered
+// variant (see gear_bitmaps_avx2) bounces every level through L1
+// (store->load per position per level), which caps it ~1.3 GiB/s; this
+// one is pure ALU.
+//
+// Mirrors the mix32 + shifted-add identity of the Pallas kernel
+// (ops/gear_pallas.py) — same math, lane-rotation instead of sublane
+// slices.
+
+#define NTPU_GEAR_MIX(x)                                                     \
+  x = _mm512_mullo_epi32(_mm512_add_epi32(x, one), c0);                      \
+  x = _mm512_xor_si512(x, _mm512_srli_epi32(x, 16));                         \
+  x = _mm512_mullo_epi32(x, c1);                                             \
+  x = _mm512_xor_si512(x, _mm512_srli_epi32(x, 13));                         \
+  x = _mm512_mullo_epi32(x, c2);                                             \
+  x = _mm512_xor_si512(x, _mm512_srli_epi32(x, 16));
+
+// One 16-position step through level 4 (s8 = sum of the last 16 weighted
+// mix values per position). The final level is intentionally NOT
+// computed here: the <<16 completion term cannot touch bits 0..15 of the
+// full hash, so a single testn against (mask_s & mask_l & 0xFFFF)
+// decides — almost always negatively (~16/2^14 of vectors at default
+// masks) — whether any lane can be a candidate under either mask; the
+// caller runs the s16 completion + both final tests only on that rare
+// hit. (Pushing the early-out down to s4 was tried and measured slower:
+// the extra rolling register plus a 1/16-taken branch cost more than the
+// saved level.)
+#define NTPU_GEAR_STEP8(raw128)                                              \
+  __m512i g = _mm512_cvtepu8_epi32(raw128);                                  \
+  NTPU_GEAR_MIX(g)                                                           \
+  const __m512i s1 = _mm512_add_epi32(                                       \
+      g, _mm512_slli_epi32(_mm512_alignr_epi32(g, pg, 15), 1));              \
+  const __m512i s2 = _mm512_add_epi32(                                       \
+      s1, _mm512_slli_epi32(_mm512_alignr_epi32(s1, p1, 14), 2));            \
+  const __m512i s4 = _mm512_add_epi32(                                       \
+      s2, _mm512_slli_epi32(_mm512_alignr_epi32(s2, p2, 12), 4));            \
+  const __m512i s8v = _mm512_add_epi32(                                      \
+      s4, _mm512_slli_epi32(_mm512_alignr_epi32(s4, p4, 8), 8));             \
+  const __m512i oldp8 = p8;                                                  \
+  (void)oldp8;                                                               \
+  pg = g;                                                                    \
+  p1 = s1;                                                                   \
+  p2 = s2;                                                                   \
+  p4 = s4;                                                                   \
+  p8 = s8v;
+
+__attribute__((target("avx512f,avx512bw,avx512vl")))
+void gear_bitmaps_avx512(const uint8_t *data, int64_t lo, int64_t hi,
+                         uint32_t mask_s, uint32_t mask_l, uint64_t *bm_s,
+                         uint64_t *bm_l) {
   const __m512i c0 = _mm512_set1_epi32((int)MIX_C0);
   const __m512i c1 = _mm512_set1_epi32((int)MIX_C1);
   const __m512i c2 = _mm512_set1_epi32((int)MIX_C2);
   const __m512i one = _mm512_set1_epi32(1);
   const __m512i vms = _mm512_set1_epi32((int)mask_s);
   const __m512i vml = _mm512_set1_epi32((int)mask_l);
+  // Necessary-condition mask for the early-out (see NTPU_GEAR_STEP8). An
+  // all-zero vpre makes testn return all-ones — i.e. the early-out simply
+  // never fires and every vector takes the full path; still correct.
+  const __m512i vpre = _mm512_set1_epi32((int)(mask_s & mask_l & 0xFFFFu));
 
-  for (int64_t p0 = 0; p0 < n; p0 += TILE) {
-    const int64_t count = (p0 + TILE <= n) ? TILE : n - p0;
-    const int64_t len = count + 31;
-    uint32_t *a = bufa, *b = bufb;
+  __m512i pg = _mm512_setzero_si512(), p1 = pg, p2 = pg, p4 = pg, p8 = pg;
 
-    int64_t j = 0;
-    const int64_t base = p0 - 31;
-    while (j < len && base + j < 0) a[j++] = 0u;
-    for (; j + 16 <= len; j += 16) {
-      const __m128i raw =
-          _mm_loadu_si128((const __m128i *)(data + base + j));
-      __m512i x = _mm512_cvtepu8_epi32(raw);
-      x = _mm512_mullo_epi32(_mm512_add_epi32(x, one), c0);
-      x = _mm512_xor_si512(x, _mm512_srli_epi32(x, 16));
-      x = _mm512_mullo_epi32(x, c1);
-      x = _mm512_xor_si512(x, _mm512_srli_epi32(x, 13));
-      x = _mm512_mullo_epi32(x, c2);
-      x = _mm512_xor_si512(x, _mm512_srli_epi32(x, 16));
-      _mm512_storeu_si512((void *)(a + j), x);
-    }
-    for (; j < len; ++j) a[j] = mix32(data[base + j]);
+  // Warm the rolling state from the 32 bytes of history so position lo's
+  // hash is whole-stream-identical (a 32-bit gear hash retains exactly 32
+  // bytes). At the stream head the zero state IS the history (h starts
+  // at 0). Callers keep lo tile-aligned, so lo is 0 or >= 32.
+  if (lo >= 32) {
+    { NTPU_GEAR_STEP8(_mm_loadu_si128((const __m128i *)(data + lo - 32))) }
+    { NTPU_GEAR_STEP8(_mm_loadu_si128((const __m128i *)(data + lo - 16))) }
+  }
 
-    for (int m = 1; m <= 16; m *= 2) {
-      int64_t k = m;
-      for (; k + 16 <= len; k += 16) {
-        const __m512i cur = _mm512_loadu_si512((const void *)(a + k));
-        const __m512i prev =
-            _mm512_loadu_si512((const void *)(a + k - m));
-        _mm512_storeu_si512(
-            (__m512i *)(b + k),
-            _mm512_add_epi32(cur, _mm512_slli_epi32(prev, m)));
+  for (int64_t w = lo; w < hi; w += 64) {
+    uint64_t ws = 0, wl = 0;
+    const int64_t wend = (w + 64 <= hi) ? w + 64 : hi;
+    int shift = 0;
+    for (int64_t pos = w; pos < wend; pos += 16, shift += 16) {
+      const int64_t rem = wend - pos;
+      if (rem >= 16) {
+        NTPU_GEAR_STEP8(_mm_loadu_si128((const __m128i *)(data + pos)))
+        if (_mm512_testn_epi32_mask(s8v, vpre)) {
+          const __m512i s16 =
+              _mm512_add_epi32(s8v, _mm512_slli_epi32(oldp8, 16));
+          ws |= (uint64_t)_mm512_testn_epi32_mask(s16, vms) << shift;
+          wl |= (uint64_t)_mm512_testn_epi32_mask(s16, vml) << shift;
+        }
+      } else {
+        const __mmask16 live = (__mmask16)((1u << rem) - 1);
+        NTPU_GEAR_STEP8(_mm_maskz_loadu_epi8(live, (const void *)(data + pos)))
+        const __m512i s16 =
+            _mm512_add_epi32(s8v, _mm512_slli_epi32(oldp8, 16));
+        ws |= (uint64_t)(_mm512_testn_epi32_mask(s16, vms) & live) << shift;
+        wl |= (uint64_t)(_mm512_testn_epi32_mask(s16, vml) & live) << shift;
       }
-      for (; k < len; ++k) b[k] = a[k] + (a[k - m] << m);
-      for (int64_t h = 0; h < m; ++h) b[h] = a[h];
-      uint32_t *t = a;
-      a = b;
-      b = t;
     }
-
-    // testn mask: 1 exactly where (h & mask) == 0 — the candidate bit
-    const uint32_t *s = a + 31;
-    int64_t i = 0;
-    for (; i + 64 <= count; i += 64) {
-      uint64_t ws = 0, wl = 0;
-      for (int64_t q = 0; q < 64; q += 16) {
-        const __m512i v = _mm512_loadu_si512((const void *)(s + i + q));
-        ws |= (uint64_t)_mm512_testn_epi32_mask(v, vms) << q;
-        wl |= (uint64_t)_mm512_testn_epi32_mask(v, vml) << q;
-      }
-      bm_s[(p0 + i) >> 6] = ws;
-      bm_l[(p0 + i) >> 6] = wl;
-    }
-    if (i < count) {
-      uint64_t ws = 0, wl = 0;
-      for (int64_t q = i; q < count; ++q) {
-        if ((s[q] & mask_s) == 0) ws |= 1ULL << (q - i);
-        if ((s[q] & mask_l) == 0) wl |= 1ULL << (q - i);
-      }
-      bm_s[(p0 + i) >> 6] = ws;
-      bm_l[(p0 + i) >> 6] = wl;
-    }
+    bm_s[w >> 6] = ws;
+    bm_l[w >> 6] = wl;
   }
 }
+#undef NTPU_GEAR_STEP8
+#undef NTPU_GEAR_MIX
 #pragma GCC diagnostic pop
 #endif  // NTPU_X86
 
-void gear_bitmaps_scalar(const uint8_t *data, int64_t n, uint32_t mask_s,
-                         uint32_t mask_l, uint64_t *bm_s, uint64_t *bm_l) {
-  const int64_t words = (n + 63) >> 6;
-  std::memset(bm_s, 0, (size_t)words * 8);
-  std::memset(bm_l, 0, (size_t)words * 8);
+void gear_bitmaps_scalar(const uint8_t *data, int64_t lo, int64_t hi,
+                         uint32_t mask_s, uint32_t mask_l, uint64_t *bm_s,
+                         uint64_t *bm_l) {
+  const int64_t w0 = lo >> 6, w1 = (hi + 63) >> 6;
+  std::memset(bm_s + w0, 0, (size_t)(w1 - w0) * 8);
+  std::memset(bm_l + w0, 0, (size_t)(w1 - w0) * 8);
   uint32_t h = 0;
-  for (int64_t i = 0; i < n; ++i) {
+  // A 32-bit gear hash only retains 32 bytes of history: warming up from
+  // lo-31 makes h at every position >= lo whole-stream-identical.
+  int64_t i = lo - 31;
+  if (i < 0) i = 0;
+  for (; i < hi; ++i) {
     h = (h << 1) + mix32(data[i]);
+    if (i < lo) continue;
     if ((h & mask_s) == 0) bm_s[i >> 6] |= 1ULL << (i & 63);
     if ((h & mask_l) == 0) bm_l[i >> 6] |= 1ULL << (i & 63);
   }
 }
 
-void gear_bitmaps(const uint8_t *data, int64_t n, uint32_t mask_s,
-                  uint32_t mask_l, uint64_t *bm_s, uint64_t *bm_l) {
+void gear_bitmaps_range(const uint8_t *data, int64_t lo, int64_t hi,
+                        uint32_t mask_s, uint32_t mask_l, uint64_t *bm_s,
+                        uint64_t *bm_l) {
 #ifdef NTPU_X86
   if (__builtin_cpu_supports("avx512f") &&
-      __builtin_cpu_supports("avx512bw")) {
-    gear_bitmaps_avx512(data, n, mask_s, mask_l, bm_s, bm_l);
+      __builtin_cpu_supports("avx512bw") &&
+      __builtin_cpu_supports("avx512vl")) {
+    gear_bitmaps_avx512(data, lo, hi, mask_s, mask_l, bm_s, bm_l);
     return;
   }
   if (__builtin_cpu_supports("avx2")) {
-    gear_bitmaps_avx2(data, n, mask_s, mask_l, bm_s, bm_l);
+    gear_bitmaps_avx2(data, lo, hi, mask_s, mask_l, bm_s, bm_l);
     return;
   }
 #endif
-  gear_bitmaps_scalar(data, n, mask_s, mask_l, bm_s, bm_l);
+  gear_bitmaps_scalar(data, lo, hi, mask_s, mask_l, bm_s, bm_l);
 }
 
 // First set bit in [lo, hi) of an LSB-first word bitmap, or -1.
@@ -254,39 +300,6 @@ inline int64_t find_first_set(const uint64_t *bm, int64_t lo, int64_t hi) {
     if (++w >= wend) return -1;
     word = bm[w];
   }
-}
-
-// Cut resolution over candidate bitmaps — the exact region/judgement
-// semantics of ntpu_cdc_chunk below (differential-tested equal).
-int64_t resolve_bitmap_cuts(const uint64_t *bm_s, const uint64_t *bm_l,
-                            int64_t n, int64_t min_size, int64_t normal_size,
-                            int64_t max_size, int64_t *cuts_out,
-                            int64_t cuts_cap) {
-  int64_t n_cuts = 0;
-  int64_t start = 0;
-  while (n - start > min_size) {
-    const int64_t scan_end = (start + max_size < n) ? start + max_size : n;
-    const int64_t normal_end =
-        (start + normal_size - 1 < scan_end) ? start + normal_size - 1
-                                             : scan_end;
-    const int64_t judge_from = start + min_size - 1;
-    int64_t end = -1;
-    int64_t i = find_first_set(bm_s, judge_from, normal_end);
-    if (i >= 0) end = i + 1;
-    if (end < 0) {
-      i = find_first_set(bm_l, normal_end, scan_end);
-      if (i >= 0) end = i + 1;
-    }
-    if (end < 0) end = (scan_end == start + max_size) ? scan_end : n;
-    if (n_cuts >= cuts_cap) return -1;
-    cuts_out[n_cuts++] = end;
-    start = end;
-  }
-  if (n > start) {
-    if (n_cuts >= cuts_cap) return -1;
-    cuts_out[n_cuts++] = n;
-  }
-  return n_cuts;
 }
 
 }  // namespace
@@ -432,21 +445,11 @@ void ntpu_gear_hashes(const uint8_t *data, int64_t n,
 }
 
 // SHA-256 of m extents of data; extents are (offset, size) i64 pairs,
-// digests_out gets 32 bytes per extent. SHA-NI when the CPU has it.
+// digests_out gets 32 bytes per extent. The batch scheduler keeps three
+// SHA-NI chains busy regardless of per-extent length imbalance.
 void ntpu_sha256_many(const uint8_t *data, const int64_t *extents, int64_t m,
                       uint8_t *digests_out) {
-  int64_t i = 0;
-  for (; i + 2 <= m; i += 2) {
-    ntpu_sha::sha256_pair(
-        data + extents[2 * i], (uint64_t)extents[2 * i + 1],
-        digests_out + 32 * i,
-        data + extents[2 * i + 2], (uint64_t)extents[2 * i + 3],
-        digests_out + 32 * (i + 1));
-  }
-  if (i < m) {
-    ntpu_sha::sha256(data + extents[2 * i], (uint64_t)extents[2 * i + 1],
-                     digests_out + 32 * i);
-  }
+  ntpu_sha::sha256_extents(data, extents, m, digests_out);
 }
 
 // Fused single-pass chunk + digest: SIMD candidate bitmaps -> cut
@@ -463,29 +466,84 @@ int64_t ntpu_chunk_digest(const uint8_t *data, int64_t n,
                           int64_t min_size, int64_t normal_size,
                           int64_t max_size, int64_t *cuts_out,
                           int64_t cuts_cap, uint8_t *digests_out) {
+  if (n <= 0) return 0;  // malloc(0) may return NULL; empty input is 0 cuts
   const int64_t words = (n + 63) >> 6;
   uint64_t *bm = (uint64_t *)std::malloc((size_t)words * 16);
   if (bm == nullptr) return -1;
   uint64_t *bm_s = bm, *bm_l = bm + words;
-  gear_bitmaps(data, n, mask_small, mask_large, bm_s, bm_l);
-  const int64_t n_cuts = resolve_bitmap_cuts(
-      bm_s, bm_l, n, min_size, normal_size, max_size, cuts_out, cuts_cap);
+
+  // Lazy tile hashing: bitmap tiles are computed only when the resolution
+  // scan first touches them. Scans advance strictly forward (each chunk's
+  // judge window starts min_size-1 past the previous cut), so a single
+  // watermark suffices and the skipped gaps — [cut, cut + min_size - 32)
+  // of every chunk, ~min/avg of all bytes — are never hashed at all.
+  int64_t hashed_until = 0;
+  const auto ensure_tile = [&](int64_t pos) {
+    const int64_t t0 = pos & ~(TILE - 1);
+    if (t0 < hashed_until) return;
+    const int64_t t1 = (t0 + TILE < n) ? t0 + TILE : n;
+    gear_bitmaps_range(data, t0, t1, mask_small, mask_large, bm_s, bm_l);
+    hashed_until = t0 + TILE;
+  };
+  // First candidate position in [lo, hi) of bitmap bmx, or -1.
+  const auto scan = [&](const uint64_t *bmx, int64_t lo, int64_t hi) {
+    int64_t pos = lo;
+    while (pos < hi) {
+      ensure_tile(pos);
+      int64_t te = (pos & ~(TILE - 1)) + TILE;
+      if (te > hi) te = hi;
+      const int64_t i = find_first_set(bmx, pos, te);
+      if (i >= 0) return i;
+      pos = te;
+    }
+    return (int64_t)-1;
+  };
+
+  // Same region/judgement semantics as ntpu_cdc_chunk (differential-
+  // tested equal in tests/test_native_engine.py).
+  int64_t n_cuts = 0;
+  int64_t start = 0;
+  while (n - start > min_size) {
+    const int64_t scan_end = (start + max_size < n) ? start + max_size : n;
+    const int64_t normal_end =
+        (start + normal_size - 1 < scan_end) ? start + normal_size - 1
+                                             : scan_end;
+    const int64_t judge_from = start + min_size - 1;
+    int64_t end = -1;
+    int64_t i = scan(bm_s, judge_from, normal_end);
+    if (i >= 0) end = i + 1;
+    if (end < 0) {
+      i = scan(bm_l, normal_end, scan_end);
+      if (i >= 0) end = i + 1;
+    }
+    if (end < 0) end = (scan_end == start + max_size) ? scan_end : n;
+    if (n_cuts >= cuts_cap) {
+      std::free(bm);
+      return -1;
+    }
+    cuts_out[n_cuts++] = end;
+    start = end;
+  }
+  if (n > start) {
+    if (n_cuts >= cuts_cap) {
+      std::free(bm);
+      return -1;
+    }
+    cuts_out[n_cuts++] = n;
+  }
   std::free(bm);
-  if (n_cuts < 0) return -1;
-  if (digests_out != nullptr) {
-    int64_t i = 0;
-    int64_t start = 0;
-    for (; i + 2 <= n_cuts; i += 2) {
-      const int64_t mid = cuts_out[i], end = cuts_out[i + 1];
-      ntpu_sha::sha256_pair(data + start, (uint64_t)(mid - start),
-                            digests_out + 32 * i, data + mid,
-                            (uint64_t)(end - mid), digests_out + 32 * (i + 1));
-      start = end;
+
+  if (digests_out != nullptr && n_cuts > 0) {
+    int64_t *ext = (int64_t *)std::malloc((size_t)n_cuts * 16);
+    if (ext == nullptr) return -1;
+    int64_t s = 0;
+    for (int64_t j = 0; j < n_cuts; ++j) {
+      ext[2 * j] = s;
+      ext[2 * j + 1] = cuts_out[j] - s;
+      s = cuts_out[j];
     }
-    if (i < n_cuts) {
-      ntpu_sha::sha256(data + start, (uint64_t)(cuts_out[i] - start),
-                       digests_out + 32 * i);
-    }
+    ntpu_sha::sha256_extents(data, ext, n_cuts, digests_out);
+    std::free(ext);
   }
   return n_cuts;
 }

@@ -98,7 +98,8 @@ def _host_digests(items: list[tuple[np.ndarray, int, int]]) -> list[bytes]:
     """Threaded host SHA-256 over (array, offset, size) extents.
 
     Routes through the native SHA-NI batch call when the engine is built
-    (one GIL-dropping call per source array); hashlib otherwise — which
+    (per-array extent runs split into ~cpu_count GIL-dropping native
+    calls); hashlib otherwise — which
     also releases the GIL for buffers > 2 KiB, so both arms scale across
     cores (the crossover arm for small batches where the device scan is
     latency-bound).
@@ -109,13 +110,24 @@ def _host_digests(items: list[tuple[np.ndarray, int, int]]) -> list[bytes]:
 
     lib = native_cdc.load()
     if lib is not None and hasattr(lib, "ntpu_sha256_many") and len(items) >= 8:
-        # Group runs of extents sharing a source array: one native call each.
+        # Group runs of extents sharing a source array, then split long runs
+        # into ~cpu_count sub-groups so one large stream still fans out
+        # across cores (each sub-group is an independent GIL-dropping
+        # native call; order-preserving concat keeps digest order).
         groups: list[tuple[np.ndarray, list[tuple[int, int]]]] = []
         for arr, off, size in items:
             if groups and groups[-1][0] is arr:
                 groups[-1][1].append((off, size))
             else:
                 groups.append((arr, [(off, size)]))
+        ncpu = _cpu_count()
+        if ncpu > 1 and len(groups) < ncpu:
+            per = max(8, -(-len(items) // ncpu))
+            groups = [
+                (arr, exts[i : i + per])
+                for arr, exts in groups
+                for i in range(0, len(exts), per)
+            ]
         flat = _map_threads(
             lambda g: native_cdc.sha256_many_native(
                 g[0], np.asarray(g[1], dtype=np.int64)

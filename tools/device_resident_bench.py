@@ -1,0 +1,177 @@
+"""Device-resident TPU kernel microbench — no bulk H2D on the timed path.
+
+The axon tunnel moves host->device data at ~10-50 MiB/s, so any benchmark
+that uploads its corpus measures the tunnel, not the chip.  Here every
+input is generated ON the device (jax.random.bits under jit), timing
+forces only an 8-element D2H readback per rep as the sync barrier, and
+each stage prints one JSON line: {stage, gibps, ms, shape, backend,
+kernel}.
+
+Replaces the chunking+digesting hot loop of the reference's external
+``nydus-image create`` (pkg/converter/tool/builder.go:148-178) with the
+repo's Pallas/XLA kernels; this script is the hardware evidence for them.
+
+Usage: python tools/device_resident_bench.py [--stage all|gear|gear-xla|sha|sha-pallas] [--mib N]
+Intended to be driven by tools/device_hunt.py inside a hard-timeout
+subprocess (a wedged tunnel hangs forever; see memory: axon-tunnel-wedges).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/ntpu_jax_cache")
+
+import numpy as np
+
+
+def _timeit(fn, argsets, reps=6):
+    """Min wall time over reps; forces an 8-element D2H readback per rep.
+
+    argsets are distinct on-device input tuples cycled across reps so a
+    result-caching backend can't fake the number.
+    """
+    import jax
+
+    def force(out):
+        leaves = jax.tree_util.tree_leaves(out)
+        return [np.asarray(jax.device_get(leaf.ravel()[:8])) for leaf in leaves]
+
+    force(fn(*argsets[0]))  # compile + warm-up
+    best = float("inf")
+    for i in range(reps):
+        args = argsets[i % len(argsets)]
+        t = time.perf_counter()
+        out = fn(*args)
+        force(out)
+        best = min(best, time.perf_counter() - t)
+    return best
+
+
+def _devgen_u8(shape, seed):
+    """uint8 random array generated on-device (jit'd, blocked)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def gen(key):
+        return jax.random.bits(key, shape, jnp.uint8)
+
+    x = gen(jax.random.key(seed))
+    x.block_until_ready()
+    return x
+
+
+def _devgen_u32(shape, seed):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def gen(key):
+        return jax.random.bits(key, shape, jnp.uint32)
+
+    x = gen(jax.random.key(seed))
+    x.block_until_ready()
+    return x
+
+
+def bench_gear(total_mib: int, force_xla: bool = False):
+    import jax
+    import jax.numpy as jnp
+
+    from nydus_snapshotter_tpu.ops import gear, gear_pallas
+    from nydus_snapshotter_tpu.ops.chunker import _hash_bitmaps_kernel
+
+    window = 1 << 22
+    n_windows = max(1, (total_mib << 20) // window)
+    tail = gear.GEAR_WINDOW - 1
+    shape = (n_windows, tail + window)
+    x = _devgen_u8(shape, 0)
+    x2 = _devgen_u8(shape, 1)
+    mask_s, mask_l = 0x3FFFF, 0x3FFF
+
+    use_pallas = gear_pallas.supported(window) and not force_xla
+    if use_pallas:
+        fn = lambda a: gear_pallas.gear_bitmaps(a, mask_s, mask_l, window)  # noqa: E731
+    else:
+        fn = lambda a: _hash_bitmaps_kernel(  # noqa: E731
+            a, jnp.uint32(mask_s), jnp.uint32(mask_l), window
+        )
+    dt = _timeit(fn, [(x,), (x2,)])
+    nbytes = n_windows * window
+    return {
+        "stage": "gear-bitmap",
+        "gibps": round(nbytes / dt / (1 << 30), 3),
+        "ms": round(dt * 1e3, 2),
+        "shape": list(shape),
+        "backend": jax.default_backend(),
+        "kernel": "pallas" if use_pallas else "xla",
+        "gear_tile": int(os.environ.get("NTPU_GEAR_TILE", "1024")),
+        "devgen": True,
+    }
+
+
+def bench_sha(total_mib: int, chunk_kib: int = 64, pallas: bool = False):
+    import jax
+
+    from nydus_snapshotter_tpu.ops import sha256, sha256_pallas
+
+    chunk = chunk_kib << 10
+    m = max(1024 if pallas else 1, (total_mib << 20) // chunk)
+    cap = sha256.n_padded_blocks(chunk)
+    shape = (m, cap, 16)
+    blocks = _devgen_u32(shape, 2)
+    blocks2 = _devgen_u32(shape, 3)
+    import jax.numpy as jnp
+
+    counts = jnp.full(m, cap, dtype=jnp.int32)
+
+    fn = sha256_pallas.sha256_batch_pallas if pallas else sha256.sha256_batch
+    dt = _timeit(fn, [(blocks, counts), (blocks2, counts)])
+    nbytes = m * chunk
+    return {
+        "stage": "sha256-pallas" if pallas else "sha256",
+        "gibps": round(nbytes / dt / (1 << 30), 3),
+        "ms": round(dt * 1e3, 2),
+        "shape": list(shape),
+        "backend": jax.default_backend(),
+        "devgen": True,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mib", type=int, default=64)
+    ap.add_argument("--stage", default="all")
+    args = ap.parse_args()
+
+    import jax
+
+    print(
+        json.dumps(
+            {
+                "event": "devices",
+                "backend": jax.default_backend(),
+                "devices": [str(d) for d in jax.devices()],
+            }
+        ),
+        flush=True,
+    )
+
+    if args.stage in ("all", "gear"):
+        print(json.dumps(bench_gear(args.mib)), flush=True)
+    if args.stage in ("all", "gear-xla"):
+        print(json.dumps(bench_gear(args.mib, force_xla=True)), flush=True)
+    if args.stage in ("all", "sha"):
+        print(json.dumps(bench_sha(args.mib)), flush=True)
+    if args.stage in ("all", "sha-pallas"):
+        print(json.dumps(bench_sha(args.mib, pallas=True)), flush=True)
+
+
+if __name__ == "__main__":
+    main()
