@@ -42,6 +42,10 @@ class PackOption:
     # numpy = host differential path.
     backend: str = "hybrid"
     chunking: str = "cdc"  # "cdc" | "fixed"
+    # "" = engine default for the backend; "jax" routes chunk digests
+    # through the device batch path while boundaries stay on the host
+    # (bench.py's device_digest arm).
+    digest_backend: str = ""
 
     def validate(self) -> None:
         if self.fs_version not in (layout.RAFS_V5, layout.RAFS_V6):
@@ -53,6 +57,10 @@ class PackOption:
             raise ConvertError(
                 f"chunk size must be power of two in "
                 f"[{constants.CHUNK_SIZE_MIN:#x}, {constants.CHUNK_SIZE_MAX:#x}]"
+            )
+        if self.digest_backend not in ("", "host", "jax"):
+            raise ConvertError(
+                f"unsupported digest backend {self.digest_backend!r}"
             )
         bs = self.batch_size
         # Reference bound (types.go:78-79): power of two in 0x1000-0x1000000

@@ -163,7 +163,9 @@ def entry_from_tarinfo(
         path=path,
         uid=info.uid,
         gid=info.gid,
-        mtime=int(info.mtime),
+        # RAFS stores mtime as u64; a pre-epoch (negative, GNU base-256)
+        # tar mtime clamps to the epoch rather than crashing serialization.
+        mtime=max(0, int(info.mtime)),
         xattrs=xattrs,
     )
     perm = info.mode & 0o7777

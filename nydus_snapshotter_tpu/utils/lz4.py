@@ -30,25 +30,9 @@ def _load_lib():
         except OSError:
             continue
         try:
-            lib.LZ4_compress_default.argtypes = [
-                ctypes.c_char_p,
-                ctypes.c_char_p,
-                ctypes.c_int,
-                ctypes.c_int,
-            ]
-            lib.LZ4_compress_default.restype = ctypes.c_int
-            lib.LZ4_decompress_safe.argtypes = [
-                ctypes.c_char_p,
-                ctypes.c_char_p,
-                ctypes.c_int,
-                ctypes.c_int,
-            ]
-            lib.LZ4_decompress_safe.restype = ctypes.c_int
-            lib.LZ4_compressBound.argtypes = [ctypes.c_int]
-            lib.LZ4_compressBound.restype = ctypes.c_int
+            return _wrap(lib)
         except AttributeError:
             continue
-        return lib
     found = ctypes.util.find_library("lz4")
     if found:
         try:
@@ -59,8 +43,26 @@ def _load_lib():
 
 
 def _wrap(lib):
+    """Single home for the ctypes signatures (both load paths share it).
+
+    src as c_void_p: accepts bytes directly AND raw addresses, so
+    memoryview/ndarray chunks compress without a bytes() copy.
+    """
+    lib.LZ4_compress_default.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
     lib.LZ4_compress_default.restype = ctypes.c_int
+    lib.LZ4_decompress_safe.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
     lib.LZ4_decompress_safe.restype = ctypes.c_int
+    lib.LZ4_compressBound.argtypes = [ctypes.c_int]
     lib.LZ4_compressBound.restype = ctypes.c_int
     return lib
 
@@ -69,25 +71,46 @@ _lib = _load_lib()
 
 _MAX_BLOCK = 0x7E000000  # LZ4_MAX_INPUT_SIZE
 
+import threading as _threading
+
+_tls = _threading.local()
+
 
 def native_available() -> bool:
     return _lib is not None
 
 
-def compress_block(data: bytes) -> bytes:
-    """LZ4 block compress (no frame header, like nydus per-chunk blocks)."""
-    if len(data) > _MAX_BLOCK:
-        raise LZ4Error(f"block of {len(data)} bytes exceeds LZ4 max input size")
-    if not data:
+def compress_block(data: "bytes | bytearray | memoryview") -> bytes:
+    """LZ4 block compress (no frame header, like nydus per-chunk blocks).
+
+    Accepts any contiguous buffer (memoryview chunk slices from the
+    streaming packer compress without a bytes() copy).
+    """
+    size = len(data)
+    if size > _MAX_BLOCK:
+        raise LZ4Error(f"block of {size} bytes exceeds LZ4 max input size")
+    if not size:
         return b""
     if _lib is None:
-        return _compress_literals(data)
-    bound = _lib.LZ4_compressBound(len(data))
-    dst = ctypes.create_string_buffer(bound)
-    n = _lib.LZ4_compress_default(data, dst, len(data), bound)
+        return _compress_literals(bytes(data))
+    if isinstance(data, bytes):
+        src: "bytes | int" = data
+    else:
+        import numpy as np
+
+        src = np.frombuffer(data, dtype=np.uint8).ctypes.data
+    bound = _lib.LZ4_compressBound(size)
+    # Reusable per-thread scratch: create_string_buffer zero-fills a fresh
+    # allocation per call, which costs more than the compression itself on
+    # 64 KiB chunks.
+    dst = getattr(_tls, "scratch", None)
+    if dst is None or ctypes.sizeof(dst) < bound:
+        dst = ctypes.create_string_buffer(max(bound, 1 << 20))
+        _tls.scratch = dst
+    n = _lib.LZ4_compress_default(src, dst, size, bound)
     if n <= 0:
-        raise LZ4Error(f"LZ4_compress_default failed on {len(data)}-byte block")
-    return dst.raw[:n]
+        raise LZ4Error(f"LZ4_compress_default failed on {size}-byte block")
+    return ctypes.string_at(dst, n)
 
 
 def decompress_block(data: bytes, uncompressed_size: int) -> bytes:

@@ -1,0 +1,177 @@
+"""Fast in-memory tar walk (converter/stream._fast_tar_members).
+
+The scanner replaces tarfile's per-member frombuf on the in-memory Pack
+fast path; these tests pin (a) metadata equivalence with tarfile, (b) the
+conservative bail-outs (pax, longname, corrupt checksum, truncation), and
+(c) that the bytes-input fast path and the file-like streaming path
+produce byte-identical blobs — the property that makes the fast path safe.
+"""
+
+import io
+import tarfile
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu.converter.convert import pack_layer
+from nydus_snapshotter_tpu.converter.stream import _fast_tar_members, pack_stream
+from nydus_snapshotter_tpu.converter.types import PackOption
+
+
+def _mk_tar(members, pax=False):
+    buf = io.BytesIO()
+    fmt = tarfile.PAX_FORMAT if pax else tarfile.GNU_FORMAT
+    with tarfile.open(fileobj=buf, mode="w", format=fmt) as tf:
+        for ti, data in members:
+            tf.addfile(ti, io.BytesIO(data) if data else None)
+    return buf.getvalue()
+
+
+def _basic_members():
+    rng = np.random.default_rng(5)
+    out = []
+    d = tarfile.TarInfo("dir")
+    d.type = tarfile.DIRTYPE
+    d.mode = 0o755
+    out.append((d, None))
+    for i, size in enumerate([0, 100, 511, 512, 513, 70_000]):
+        ti = tarfile.TarInfo(f"dir/f{i}")
+        ti.size = size
+        ti.mode = 0o644
+        ti.uid = 1000 + i
+        ti.gid = 7
+        ti.mtime = 1_700_000_000 + i
+        out.append((ti, rng.integers(0, 256, size, dtype=np.uint8).tobytes()))
+    ln = tarfile.TarInfo("dir/link")
+    ln.type = tarfile.SYMTYPE
+    ln.linkname = "f1"
+    out.append((ln, None))
+    hl = tarfile.TarInfo("dir/hard")
+    hl.type = tarfile.LNKTYPE
+    hl.linkname = "dir/f2"
+    out.append((hl, None))
+    return out
+
+
+def test_matches_tarfile_metadata():
+    raw = _mk_tar(_basic_members())
+    fast = _fast_tar_members(memoryview(raw))
+    assert fast is not None
+    with tarfile.open(fileobj=io.BytesIO(raw)) as tf:
+        ref = tf.getmembers()
+    assert len(fast) == len(ref)
+    for (fi, off), ri in zip(fast, ref):
+        assert fi.name == ri.name
+        assert fi.size == ri.size
+        assert fi.type == ri.type
+        assert fi.mode == ri.mode
+        assert fi.uid == ri.uid and fi.gid == ri.gid
+        assert int(fi.mtime) == int(ri.mtime)
+        assert fi.linkname == ri.linkname
+        assert off == ri.offset_data
+
+
+def test_pax_archive_bails():
+    # An actual pax 'x' member (extended header) needs tarfile's machinery.
+    # (PAX_FORMAT alone emits plain ustar members when nothing needs
+    # extension, which the fast scanner rightly handles.)
+    ti = tarfile.TarInfo("f")
+    ti.size = 4
+    ti.pax_headers = {"SCHILY.xattr.user.k": "v"}
+    raw = _mk_tar([(ti, b"data")], pax=True)
+    assert _fast_tar_members(memoryview(raw)) is None
+
+
+def test_gnu_longname_bails():
+    ti = tarfile.TarInfo("a/" + "x" * 150)  # forces an L member in GNU format
+    ti.size = 4
+    raw = _mk_tar([(ti, b"abcd")])
+    assert _fast_tar_members(memoryview(raw)) is None
+
+
+def test_corrupt_checksum_bails():
+    raw = bytearray(_mk_tar(_basic_members()))
+    raw[148] ^= 0x05  # smash the first member's checksum field
+    assert _fast_tar_members(memoryview(bytes(raw))) is None
+
+
+def test_truncated_data_bails():
+    raw = _mk_tar(_basic_members())
+    assert _fast_tar_members(memoryview(raw[: len(raw) // 2])) is None
+
+
+def test_garbage_input_bails_and_raises():
+    """Short garbage must NOT silently convert to an empty image: the
+    scanner bails (no end-of-archive marker) and tarfile raises."""
+    assert _fast_tar_members(memoryview(b"garbage")) is None
+    from nydus_snapshotter_tpu.converter.types import ConvertError
+
+    with pytest.raises(ConvertError):
+        pack_layer(b"garbage", PackOption(chunk_size=0x10000))
+
+
+def test_fast_and_streaming_paths_identical():
+    """bytes input (fast path) vs file-like input (streaming path) must
+    produce byte-identical blobs — chunk cuts, dedup order, framing."""
+    rng = np.random.default_rng(9)
+    members = []
+    for i in range(12):
+        size = int(rng.integers(10, 400_000))
+        ti = tarfile.TarInfo(f"p/q{i % 3}/f{i}")
+        ti.size = size
+        members.append((ti, rng.integers(0, 256, size, dtype=np.uint8).tobytes()))
+    raw = _mk_tar(members)
+    opt = PackOption(chunk_size=0x10000, chunking="cdc")
+
+    blob_fast, res_fast = pack_layer(raw, opt)
+
+    out = io.BytesIO()
+    pack_stream(out, io.BytesIO(raw), opt)  # file-like: streaming path
+    blob_stream = out.getvalue()
+
+    assert blob_fast == blob_stream
+    assert res_fast.blob_id
+
+
+def test_negative_mtime_base256():
+    """GNU base-256 negative mtime (leading 0xFF) must decode like
+    tarfile.nti, and the fast and streaming paths must agree."""
+    ti = tarfile.TarInfo("old")
+    ti.size = 4
+    ti.mtime = -100  # pre-epoch: GNU_FORMAT stores it base-256
+    raw = _mk_tar([(ti, b"data")])
+    fast = _fast_tar_members(memoryview(raw))
+    assert fast is not None  # the scanner must handle base-256 itself
+    with tarfile.open(fileobj=io.BytesIO(raw)) as tf:
+        ref = tf.getmembers()[0]
+    assert int(fast[0][0].mtime) == int(ref.mtime) == -100
+    opt = PackOption(chunk_size=0x10000)
+    blob_fast, _ = pack_layer(raw, opt)
+    out = io.BytesIO()
+    pack_stream(out, io.BytesIO(raw), opt)
+    assert blob_fast == out.getvalue()
+
+
+def test_pax_xattrs_still_roundtrip():
+    """A pax layer (fast path bails) still packs, preserving xattrs."""
+    ti = tarfile.TarInfo("bin/ping")
+    payload = b"\x01\x00\x00\x02\x00 \x00\x00\x00\x00\x00\x00"
+    ti.size = 8
+    ti.pax_headers = {
+        "SCHILY.xattr.security.capability": payload.decode(
+            "utf-8", "surrogateescape"
+        )
+    }
+    raw = _mk_tar([(ti, b"PINGPING")], pax=True)
+    blob, res = pack_layer(raw, PackOption(chunk_size=0x10000))
+    from nydus_snapshotter_tpu.converter.convert import bootstrap_from_layer_blob
+
+    bs = bootstrap_from_layer_blob(blob)
+    ino = next(i for i in bs.inodes if i.path.endswith("ping"))
+    assert ino.xattrs.get("security.capability") == payload
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -182,8 +182,6 @@ def main():
         if ok:
             got = run_stages(note)
             _log({"attempt": "window-summary", "stages_recorded": len(got)})
-            if got:
-                return  # evidence captured; later manual runs can add more
         if args.once:
             return
         time.sleep(args.interval)
