@@ -26,8 +26,10 @@ class PackOption:
     fs_version: str = layout.RAFS_V6
     chunk_dict_path: str = ""
     prefetch_patterns: str = ""
-    # lz4_block is the reference's default chunk codec (fast, modest
-    # ratio); zstd opts into better ratio at ~2x the pack cost.
+    # lz4_block matches the legacy v5 blob default; modern nydus-image
+    # defaults chunk compression to zstd. We default to lz4_block as a
+    # deliberate speed-over-ratio choice (zstd opts into better ratio at
+    # ~2x the pack cost).
     compressor: str = "lz4_block"  # "none" | "zstd" | "lz4_block"
     oci_ref: bool = False
     aligned_chunk: bool = False

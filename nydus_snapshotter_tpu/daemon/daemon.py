@@ -228,11 +228,19 @@ class Daemon:
     def shared_erofs_umount(self, rafs: Rafs, umounter=None) -> None:
         if rafs.mountpoint:
             (umounter or mount_utils.erofs_umount)(rafs.mountpoint)
+        # Mirror the mount-failure rollback (which unbinds unconditionally,
+        # tolerating failure): bind_blob was issued at mount time even when
+        # the config JSON had no id, so always attempt the unbind — but a
+        # server rejecting an empty-id unbind must not block instance
+        # removal after the kernel umount already succeeded.
         blob_id = rafs.annotations.pop(self._EROFS_BLOB_ANNO, "")
-        if blob_id:
+        try:
             self.client().unbind_blob(
                 mount_utils.erofs_fscache_id(rafs.snapshot_id), blob_id
             )
+        except (OSError, errdefs.NydusError):
+            if blob_id:
+                raise  # a real bound blob failing to unbind IS an error
         self.remove_rafs_instance(rafs.snapshot_id)
 
     def recover_rafs_instances(self, instances: list[Rafs], configs: dict[str, str]) -> None:

@@ -50,3 +50,29 @@ def detect_fs_version(header: bytes) -> str:
         if v6_magic == RAFS_V6_SUPER_MAGIC:
             return RAFS_V6
     raise LayoutError("unknown file system header")
+
+
+def validate_bootstrap_header(buf: bytes) -> str:
+    """Detect + sanity-check a real nydus bootstrap's superblock.
+
+    Works on actual reference-produced artifacts (the binary fixtures at
+    /root/reference/pkg/filesystem/testdata): v5 validates the declared
+    superblock size against the file; v6 validates the EROFS block-size
+    exponent. Raises LayoutError on anything malformed — the same
+    reject-bad-bootstraps posture as the reference's version sniffing +
+    mount validation (layout.go:60-76).
+    """
+    version = detect_fs_version(buf)
+    if version == RAFS_V5:
+        if len(buf) < 12:
+            raise LayoutError("v5 bootstrap truncated before superblock size")
+        _magic, _ver, sb_size = struct.unpack_from("<III", buf, 0)
+        if not 16 <= sb_size <= min(len(buf), MAX_SUPER_BLOCK_SIZE):
+            raise LayoutError(f"v5 superblock size {sb_size} out of range")
+    else:
+        if len(buf) < RAFS_V6_SUPER_BLOCK_OFFSET + 16:
+            raise LayoutError("v6 bootstrap truncated before superblock tail")
+        blkszbits = buf[RAFS_V6_SUPER_BLOCK_OFFSET + 12]
+        if not 9 <= blkszbits <= 12:
+            raise LayoutError(f"v6 blkszbits {blkszbits} outside 9..12")
+    return version

@@ -211,12 +211,18 @@ def bootstrap_from_toc(
             inode.rdev = os.makedev(e.dev_major, e.dev_minor)
         elif e.type == "reg" and e.size > 0:
             csize = e.chunk_size or e.size
+            # Legacy (pre-estargz) TOCs carry no per-chunk digests, only the
+            # whole-file digest; when the file is a single chunk the two are
+            # the same object, so the file digest IS the chunk digest.
+            digest_src = e.chunk_digest
+            if not digest_src and csize >= e.size:
+                digest_src = e.digest
             inode.chunk_index = len(chunks)
             inode.chunk_count = 1
             offsets.append((len(chunks), e.offset))
             chunks.append(
                 ChunkRecord(
-                    digest=_raw_digest(e.chunk_digest),
+                    digest=_raw_digest(digest_src),
                     flags=constants.COMPRESSOR_GZIP,
                     uncompressed_offset=uncompressed_pos,
                     compressed_offset=e.offset,

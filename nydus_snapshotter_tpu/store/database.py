@@ -164,3 +164,55 @@ class Database:
             ).fetchall()
         for value, seq in rows:
             yield json.loads(value), int(seq)
+
+    def import_legacy_bolt(self, path: str) -> tuple[int, int]:
+        """Import a reference-snapshotter bbolt database (nydus.db).
+
+        Handles both on-disk generations the reference migrates between
+        (database.go:147-188): the legacy top-level ``daemons`` bucket and
+        the ``v1`` hierarchy (v1/daemons + v1/instances). Values are the
+        reference's JSON records, stored verbatim so the daemon manager's
+        recovery can interpret them. Returns (daemons, instances) counts.
+        """
+        daemons, instances = load_legacy_bolt(path)
+        for rec in daemons:
+            did = rec.get("ID") or rec.get("id")
+            if not did:
+                continue
+            try:
+                self.save_daemon(did, rec)
+            except errdefs.AlreadyExists:
+                self.update_daemon(did, rec)
+        # Preserve the reference's recorded mount-replay order: its seq
+        # field (rafs.go:112-117), not bbolt's lexical key order, decides
+        # recovery order.
+        instances = sorted(instances, key=lambda r: r.get("Seq", r.get("seq", 0)))
+        for rec in instances:
+            sid = rec.get("SnapshotID") or rec.get("snapshot_id")
+            if not sid:
+                continue
+            try:
+                self.save_instance(sid, rec, self.next_instance_seq())
+            except errdefs.AlreadyExists:
+                pass  # idempotent re-import: the existing record wins
+        return len(daemons), len(instances)
+
+
+def load_legacy_bolt(path: str) -> tuple[list[dict], list[dict]]:
+    """(daemon records, instance records) from a reference bbolt file."""
+    from nydus_snapshotter_tpu.store.boltdb import BoltDB
+
+    db = BoltDB(path)
+    daemons_bucket = db.bucket(b"v1", b"daemons") or db.bucket(b"daemons")
+    instances_bucket = db.bucket(b"v1", b"instances")
+    daemons = (
+        [json.loads(v) for _k, v in daemons_bucket.items()]
+        if daemons_bucket is not None
+        else []
+    )
+    instances = (
+        [json.loads(v) for _k, v in instances_bucket.items()]
+        if instances_bucket is not None
+        else []
+    )
+    return daemons, instances
