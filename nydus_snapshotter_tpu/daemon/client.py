@@ -7,6 +7,7 @@ this framework's userspace read API.
 
 from __future__ import annotations
 
+import errno
 import http.client
 import json
 import os
@@ -31,7 +32,20 @@ class _UDSConnection(http.client.HTTPConnection):
     def connect(self):
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self.sock.settimeout(self.timeout)
-        self.sock.connect(self._sock_path)
+        # A full accept backlog surfaces as EAGAIN on UDS connect (it does
+        # not queue); retry briefly so a mount storm doesn't turn into
+        # spurious hard failures. ECONNREFUSED is NOT retried: it means no
+        # listener (daemon dead), and liveness polling/failover detection
+        # depends on that failing fast.
+        deadline = time.monotonic() + self.timeout
+        while True:
+            try:
+                self.sock.connect(self._sock_path)
+                return
+            except OSError as e:
+                if e.errno != errno.EAGAIN or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.01)
 
 
 class NydusdClient:

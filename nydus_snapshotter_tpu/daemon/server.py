@@ -564,6 +564,10 @@ class DaemonServer:
         class Server(socketserver.ThreadingMixIn, socketserver.UnixStreamServer):
             daemon_threads = True
             allow_reuse_address = True
+            # socketserver's default backlog of 5 overflows under connect
+            # storms (many snapshots mounting at once): excess UDS connects
+            # fail with EAGAIN instead of queueing.
+            request_queue_size = 128
 
             # BaseHTTPRequestHandler expects a (host, port) client address.
             def get_request(self):

@@ -109,11 +109,24 @@ class BoltDB:
             self._buf = f.read()
         if len(self._buf) < 2 * 4096:
             raise BoltError("file too small for a bolt database")
+        # Meta 0 sits at offset 0; meta 1 sits at offset pageSize, which
+        # bolt takes from os.Getpagesize() at creation (4 KiB on x86, but
+        # 16/64 KiB on some arm64/ppc64le hosts) — so meta 0's declared
+        # page size locates meta 1, with a scan over common sizes as the
+        # fallback when meta 0 itself is torn.
         metas = []
-        for page_id in (0, 1):
-            m = self._meta_at(page_id)
-            if m is not None:
-                metas.append(m)
+        m0 = self._meta_at(0)
+        if m0 is not None:
+            metas.append(m0)
+            m1 = self._meta_at(m0["page_size"])
+            if m1 is not None:
+                metas.append(m1)
+        else:
+            for ps in (4096, 8192, 16384, 32768, 65536):
+                m1 = self._meta_at(ps)
+                if m1 is not None:
+                    metas.append(m1)
+                    break
         if not metas:
             raise BoltError("no valid bolt meta page (bad magic/version/checksum)")
         # bolt keeps two meta pages and uses the valid one with max txid
@@ -121,10 +134,7 @@ class BoltDB:
         self.page_size = meta["page_size"]
         self._root = meta["root"]
 
-    def _meta_at(self, page_id: int):
-        # meta pages live in the first two 4096-byte slots regardless of
-        # the configured page size (bolt writes them before remapping)
-        base = page_id * 4096
+    def _meta_at(self, base: int):
         hdr = self._buf[base : base + 16]
         if len(hdr) < 16:
             return None

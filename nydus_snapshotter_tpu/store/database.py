@@ -175,6 +175,7 @@ class Database:
         recovery can interpret them. Returns (daemons, instances) counts.
         """
         daemons, instances = load_legacy_bolt(path)
+        n_daemons = n_instances = 0
         for rec in daemons:
             did = rec.get("ID") or rec.get("id")
             if not did:
@@ -183,6 +184,7 @@ class Database:
                 self.save_daemon(did, rec)
             except errdefs.AlreadyExists:
                 self.update_daemon(did, rec)
+            n_daemons += 1
         # Preserve the reference's recorded mount-replay order: its seq
         # field (rafs.go:112-117), not bbolt's lexical key order, decides
         # recovery order.
@@ -193,9 +195,10 @@ class Database:
                 continue
             try:
                 self.save_instance(sid, rec, self.next_instance_seq())
+                n_instances += 1
             except errdefs.AlreadyExists:
                 pass  # idempotent re-import: the existing record wins
-        return len(daemons), len(instances)
+        return n_daemons, n_instances
 
 
 def load_legacy_bolt(path: str) -> tuple[list[dict], list[dict]]:
