@@ -356,22 +356,54 @@ def _tar_num(field: memoryview) -> int:
 _TAR_PLAIN_TYPES = (b"0", b"\x00", b"1", b"2", b"3", b"4", b"5", b"6", b"7")
 
 
+def _parse_pax_records(data: bytes) -> "dict[str, str] | None":
+    """Decode a pax extended header block ("%d key=value\\n" records);
+    None on malformed framing. Values decode utf-8/surrogateescape — the
+    same round-trip tarfile uses, so binary xattrs survive."""
+    out: dict[str, str] = {}
+    pos = 0
+    n = len(data)
+    while pos < n:
+        if data[pos] == 0:
+            break  # zero padding after the last record
+        sp = data.find(b" ", pos, pos + 20)
+        if sp < 0:
+            return None
+        try:
+            length = int(data[pos:sp])
+        except ValueError:
+            return None
+        end = pos + length
+        if length < sp - pos + 3 or end > n or data[end - 1] != 0x0A:
+            return None
+        eq = data.find(b"=", sp + 1, end)
+        if eq < 0:
+            return None
+        key = data[sp + 1 : eq].decode("utf-8", "surrogateescape")
+        out[key] = data[eq + 1 : end - 1].decode("utf-8", "surrogateescape")
+        pos = end
+    return out
+
+
 def _fast_tar_members(raw: memoryview):
     """Header walk over an in-memory tar: [(TarInfo, data_offset)], or
     None when the archive needs tarfile's full machinery.
 
     tarfile.TarInfo.frombuf costs ~30 µs/member (field-by-field parse,
     encoding fallbacks) — ~20% of full-path convert on a node_modules-
-    shaped layer. This scanner handles plain ustar/GNU members (the vast
-    majority of real layers) with checksum verification and bails to
-    tarfile for anything else: pax (x/g), GNU longname/longlink (L/K),
-    sparse (S), non-ustar magic, truncated data, or a non-regular member
-    carrying data. A None return loses nothing but the speedup.
+    shaped layer. This scanner handles plain ustar/GNU members plus pax
+    ``x`` extended headers (Go's archive/tar — the writer behind real
+    docker layers — emits pax for xattrs/long names/big files) with
+    checksum verification, and bails to tarfile for anything else: pax
+    globals (g), GNU longname/longlink (L/K), sparse (S), non-ustar
+    magic, truncated data, or a non-regular member carrying data. A None
+    return loses nothing but the speedup.
     """
     out: list[tuple[tarfile.TarInfo, int]] = []
     pos = 0
     n = len(raw)
     saw_end = False
+    pending_pax: "dict[str, str] | None" = None
     while pos + 512 <= n:
         hdr = raw[pos : pos + 512]
         hb = bytes(hdr)
@@ -383,7 +415,7 @@ def _fast_tar_members(raw: memoryview):
         if hb[257:263] not in (b"ustar\x00", b"ustar "):
             return None
         typ = hb[156:157]
-        if typ not in _TAR_PLAIN_TYPES:
+        if typ not in _TAR_PLAIN_TYPES and typ != b"x":
             return None
         try:
             mode = _tar_num(hdr[100:108])
@@ -396,6 +428,22 @@ def _fast_tar_members(raw: memoryview):
             return None
         if chksum != sum(hb) - sum(hb[148:156]) + 8 * 0x20:
             return None
+        if typ == b"x":
+            # pax extended header: records apply to the NEXT member.
+            end = pos + 512 + size
+            if end > n:
+                return None
+            pax = _parse_pax_records(bytes(raw[pos + 512 : end]))
+            if pax is None:
+                return None
+            if any(k.startswith("GNU.sparse") for k in pax):
+                # pax-sparse members need tarfile's sparse-map handling
+                # (_proc_gnusparse_*): the data region is a packed map +
+                # holes, not the file bytes.
+                return None
+            pending_pax = pax
+            pos = pos + 512 + 512 * ((size + 511) // 512)
+            continue
         if typ not in (b"0", b"\x00", b"7"):
             if size != 0:
                 return None  # non-regular member carrying data: exotic
@@ -426,6 +474,32 @@ def _fast_tar_members(raw: memoryview):
         if typ in (b"3", b"4"):
             ti.devmajor = _tar_num(hdr[329:337])
             ti.devminor = _tar_num(hdr[337:345])
+        if pending_pax is not None:
+            # Apply overrides exactly as tarfile._apply_pax_info does for
+            # the fields this pipeline consumes.
+            p = pending_pax
+            try:
+                if "path" in p:
+                    # tarfile._apply_pax_info only rstrips; it never
+                    # retypes on a trailing slash (that V7 rule applies to
+                    # base-header names only).
+                    ti.name = p["path"].rstrip("/")
+                if "linkpath" in p:
+                    ti.linkname = p["linkpath"]
+                if "size" in p:
+                    ti.size = int(p["size"])
+                    if typ in (b"0", b"\x00", b"7"):
+                        data_size = ti.size
+                if "mtime" in p:
+                    ti.mtime = float(p["mtime"])
+                if "uid" in p:
+                    ti.uid = int(p["uid"])
+                if "gid" in p:
+                    ti.gid = int(p["gid"])
+            except ValueError:
+                return None
+            ti.pax_headers = p
+            pending_pax = None
         data_off = pos + 512
         pos = data_off + 512 * ((data_size + 511) // 512)
         if pos > n:

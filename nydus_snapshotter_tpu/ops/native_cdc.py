@@ -24,6 +24,11 @@ _lib_missing = False
 
 
 def _lib_path() -> str:
+    # Override hook for instrumented builds (e.g. the ASan/UBSan arm the
+    # sanitizer tests load in a child process with libasan preloaded).
+    override = os.environ.get("NTPU_CHUNK_ENGINE_SO")
+    if override:
+        return override
     return os.path.join(
         os.path.dirname(os.path.dirname(__file__)), "native", "bin", "libchunk_engine.so"
     )
@@ -42,12 +47,20 @@ def load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _lib_missing:
             return _lib
         path = _lib_path()
-        built = native_build.ensure_built("libchunk_engine.so", "chunk_engine")
-        if not os.path.exists(path) or (
-            not built and native_build.sources_newer("libchunk_engine.so", "chunk_engine")
-        ):
-            _lib_missing = True
-            return None
+        if os.environ.get("NTPU_CHUNK_ENGINE_SO"):
+            # Explicit artifact: the caller owns its build; the default
+            # engine's build/staleness gating must not veto it.
+            if not os.path.exists(path):
+                _lib_missing = True
+                return None
+        else:
+            built = native_build.ensure_built("libchunk_engine.so", "chunk_engine")
+            if not os.path.exists(path) or (
+                not built
+                and native_build.sources_newer("libchunk_engine.so", "chunk_engine")
+            ):
+                _lib_missing = True
+                return None
         try:
             lib = ctypes.CDLL(path)
         except OSError:

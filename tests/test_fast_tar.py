@@ -71,15 +71,59 @@ def test_matches_tarfile_metadata():
         assert off == ri.offset_data
 
 
-def test_pax_archive_bails():
-    # An actual pax 'x' member (extended header) needs tarfile's machinery.
-    # (PAX_FORMAT alone emits plain ustar members when nothing needs
-    # extension, which the fast scanner rightly handles.)
-    ti = tarfile.TarInfo("f")
-    ti.size = 4
-    ti.pax_headers = {"SCHILY.xattr.user.k": "v"}
-    raw = _mk_tar([(ti, b"data")], pax=True)
-    assert _fast_tar_members(memoryview(raw)) is None
+def test_pax_members_match_tarfile():
+    """pax 'x' extended headers (Go archive/tar emits them for xattrs and
+    long names — real docker layers) are parsed by the fast scanner and
+    must agree with tarfile, including pax_headers and overridden names."""
+    long_name = "deep/" + "n" * 180 + "/file.bin"
+    members = []
+    t1 = tarfile.TarInfo("bin/cap")
+    t1.size = 4
+    t1.pax_headers = {"SCHILY.xattr.user.k": "vé"}
+    members.append((t1, b"data"))
+    t2 = tarfile.TarInfo(long_name)
+    t2.size = 600
+    members.append((t2, b"z" * 600))
+    raw = _mk_tar(members, pax=True)
+    fast = _fast_tar_members(memoryview(raw))
+    assert fast is not None
+    with tarfile.open(fileobj=io.BytesIO(raw)) as tf:
+        ref = tf.getmembers()
+    assert len(fast) == len(ref)
+    for (fi, off), ri in zip(fast, ref):
+        assert fi.name == ri.name
+        assert fi.size == ri.size
+        assert off == ri.offset_data
+        for k, v in (ri.pax_headers or {}).items():
+            assert fi.pax_headers.get(k) == v, k
+
+    # End to end: fast path and streaming path produce identical blobs
+    # for the pax layer, and the xattr lands in the bootstrap.
+    opt = PackOption(chunk_size=0x10000)
+    blob_fast, res = pack_layer(raw, opt)
+    out = io.BytesIO()
+    pack_stream(out, io.BytesIO(raw), opt)
+    assert blob_fast == out.getvalue()
+    from nydus_snapshotter_tpu.converter.convert import bootstrap_from_layer_blob
+
+    bs = bootstrap_from_layer_blob(blob_fast)
+    ino = next(i for i in bs.inodes if i.path.endswith("cap"))
+    assert ino.xattrs.get("user.k") == "vé".encode()
+
+
+def test_pax_global_header_bails():
+    # pax 'g' (global) headers still need tarfile's machinery.
+    buf = io.BytesIO()
+    with tarfile.open(
+        fileobj=buf,
+        mode="w",
+        format=tarfile.PAX_FORMAT,
+        pax_headers={"comment": "global"},
+    ) as tf:
+        ti = tarfile.TarInfo("f")
+        ti.size = 4
+        tf.addfile(ti, io.BytesIO(b"data"))
+    assert _fast_tar_members(memoryview(buf.getvalue())) is None
 
 
 def test_gnu_longname_bails():

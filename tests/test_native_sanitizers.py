@@ -1,0 +1,118 @@
+"""Sanitizer pass over the native engine (SURVEY §5 race/sanitizer row).
+
+The reference runs its whole suite under ``go test -race``; the native
+C++ engine here is the code most exposed to memory errors, so this test
+builds it with AddressSanitizer + UBSan (``make san``) and replays the
+differential battery against the instrumented arm in a child process
+(libasan must be preloaded before CPython). Any OOB read/write, UB, or
+use-after-free in the gear kernels, the lazy-tile fused pass, the SHA-NI
+schedulers, or the dict table aborts the child — the test fails on any
+non-zero exit.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "nydus_snapshotter_tpu", "native")
+SAN_SO = os.path.join(NATIVE, "bin", "libchunk_engine_san.so")
+
+
+def _libasan_path() -> str:
+    out = subprocess.run(
+        ["g++", "-print-file-name=libasan.so"], capture_output=True, text=True
+    )
+    p = out.stdout.strip()
+    return p if p and os.path.sep in p else ""
+
+
+_CHILD = r"""
+import hashlib, os, sys
+sys.path.insert(0, os.environ["NTPU_REPO"])
+import numpy as np
+from nydus_snapshotter_tpu.ops import cdc, native_cdc
+
+lib = native_cdc.load()
+assert lib is not None, "sanitized engine failed to load"
+
+rng = np.random.default_rng(0xA5A)
+params = cdc.CDCParams(0x10000)
+
+# Fused chunk+digest across awkward sizes (tile edges, sub-min, huge).
+for size in (0, 1, 31, 32, 511, 2048, 2049, 65535, 65536 * 4 + 7, 1 << 22):
+    data = rng.integers(0, 256, size, dtype=np.uint8)
+    cap = size // max(1, params.min_size) + 2
+    cuts = np.empty(cap, dtype=np.int64)
+    digs = np.empty((cap, 32), dtype=np.uint8)
+    n = lib.ntpu_chunk_digest(
+        data.ctypes.data, size, 0x3FFFF, 0x3FFF,
+        params.min_size, params.normal_size, params.max_size,
+        cuts.ctypes.data, cap, digs.ctypes.data,
+    )
+    assert n >= 0, size
+    start = 0
+    for i in range(n):
+        end = int(cuts[i])
+        want = hashlib.sha256(data[start:end].tobytes()).digest()
+        assert digs[i].tobytes() == want, (size, i)
+        start = end
+    assert start == size
+
+# Batch SHA over ragged extents (exercises all three scheduler phases).
+data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+sizes = [0, 1, 55, 56, 63, 64, 65, 127, 128, 1000, 65536, 100000]
+ext = []
+off = 0
+for s in sizes:
+    ext.append((off, s))
+    off += s
+ext = np.asarray(ext, dtype=np.int64)
+out = np.empty((len(sizes), 32), dtype=np.uint8)
+lib.ntpu_sha256_many(data.ctypes.data, ext.ctypes.data, len(sizes), out.ctypes.data)
+for i, (o, s) in enumerate(ext):
+    assert out[i].tobytes() == hashlib.sha256(data[o:o+s].tobytes()).digest(), i
+
+# Dict build + probe (linear-probe chains, shard arithmetic).
+n = 100_000
+digests = rng.integers(0, 2**32, (n, 8), dtype=np.uint32)
+from nydus_snapshotter_tpu.parallel.sharded_dict import MAX_PROBE, _build_host_tables
+keys, values = _build_host_tables(digests, 4)
+q = np.concatenate([digests[:500], rng.integers(0, 2**32, (500, 8), dtype=np.uint32)])
+ans = native_cdc.dict_probe_native(
+    q, keys.reshape(-1, 8), values.reshape(-1), 4, keys.shape[1], MAX_PROBE
+)
+assert (ans[:500] == np.arange(500)).all()
+print("SANITIZED-ENGINE-OK")
+"""
+
+
+@pytest.mark.skipif(not _libasan_path(), reason="libasan not available")
+def test_engine_differentials_under_asan_ubsan():
+    build = subprocess.run(
+        ["make", "-C", NATIVE, "san"], capture_output=True, text=True
+    )
+    assert build.returncode == 0, build.stderr[-2000:]
+    env = dict(os.environ)
+    env["NTPU_REPO"] = REPO
+    env["NTPU_CHUNK_ENGINE_SO"] = SAN_SO
+    env["LD_PRELOAD"] = _libasan_path()
+    # CPython itself leaks happily; leak checking would drown real findings.
+    env["ASAN_OPTIONS"] = "detect_leaks=0,abort_on_error=1"
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-3000:])
+    assert "SANITIZED-ENGINE-OK" in out.stdout
+    assert "runtime error" not in out.stderr  # UBSan report marker
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))
