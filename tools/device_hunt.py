@@ -139,7 +139,17 @@ def run_stages(window_note: str) -> list[dict]:
             [sys.executable, drb, "--stage", "gear", "--mib", "64"],
             env={"NTPU_GEAR_TILE": tile},
         )
+    # A good window also deserves a full bench run: it records the arm
+    # race with the device actually answering (the driver's BENCH artifact
+    # may land in a wedged window; this one is insurance). Only when the
+    # window demonstrably survived the kernel stages — a re-wedged tunnel
+    # would just burn 30 minutes recording another host-arm run.
     if results:
+        stage(
+            "full-bench",
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            timeout=1800,
+        )
         _write_numbers(results, window_note)
     return results
 
