@@ -1,0 +1,172 @@
+"""Direct tests for infra utilities that everything else leans on:
+retry backoff semantics (reference pkg/utils/retry), the native
+self-build machinery (atomic rename, failure memo, staleness), and the
+FUSE wire-protocol struct layouts.
+"""
+
+import os
+import time
+
+import pytest
+
+from nydus_snapshotter_tpu.utils import native_build, retry
+
+
+class TestRetry:
+    def test_success_first_try_no_sleep(self):
+        sleeps = []
+        out = retry.do(lambda: 42, sleep=sleeps.append)
+        assert out == 42
+        assert sleeps == []
+
+    def test_backoff_sequence_and_cap(self):
+        sleeps = []
+        calls = [0]
+
+        def boom():
+            calls[0] += 1
+            raise ValueError("x")
+
+        with pytest.raises(retry.RetryError) as ei:
+            retry.do(
+                boom,
+                attempts=5,
+                delay=1.0,
+                backoff=3.0,
+                max_delay=4.0,
+                sleep=sleeps.append,
+            )
+        assert calls[0] == 5
+        # 1, 3, then capped at 4 (1*3=3, 3*3=9 -> 4, 9*3=27 -> 4)
+        assert sleeps == [1.0, 3.0, 4.0, 4.0]
+        assert ei.value.attempts == 5
+        assert isinstance(ei.value.last, ValueError)
+
+    def test_recovers_midway(self):
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise OSError("nope")
+            return "ok"
+
+        assert retry.do(flaky, attempts=5, sleep=lambda _d: None) == "ok"
+        assert calls[0] == 3
+
+    def test_non_matching_exception_escapes_immediately(self):
+        calls = [0]
+
+        def boom():
+            calls[0] += 1
+            raise KeyError("k")
+
+        with pytest.raises(KeyError):
+            retry.do(boom, retry_on=(OSError,), sleep=lambda _d: None)
+        assert calls[0] == 1
+
+    def test_attempts_validation(self):
+        with pytest.raises(ValueError):
+            retry.do(lambda: 1, attempts=0)
+
+
+class TestNativeBuild:
+    """Against the real source tree (the engine is already built by the
+    suite): staleness detection and the failure-memo contract."""
+
+    def test_built_artifact_is_current(self):
+        assert native_build.ensure_built("libchunk_engine.so", "chunk_engine")
+        assert not native_build.sources_newer("libchunk_engine.so", "chunk_engine")
+
+    def test_sources_newer_after_touch(self):
+        target = native_build.target_path("libchunk_engine.so")
+        src = os.path.join(
+            os.path.dirname(os.path.dirname(target)), "chunk_engine", "sha256.h"
+        )
+        old = os.path.getmtime(src)
+        try:
+            os.utime(src, (time.time() + 5, time.time() + 5))
+            assert native_build.sources_newer("libchunk_engine.so", "chunk_engine")
+        finally:
+            os.utime(src, (old, old))
+        # rebuild restores currency for later tests
+        assert native_build.ensure_built("libchunk_engine.so", "chunk_engine")
+
+    def test_failure_memo_blocks_only_same_stamp(self):
+        import shutil
+
+        if not (shutil.which("make") and shutil.which("g++")):
+            pytest.skip("no native toolchain: ensure_built degrades early")
+        target = "libnope.so"
+        marker = os.path.join(
+            os.path.dirname(native_build.target_path(target)),
+            f".build_failed.{target}",
+        )
+        stamp = native_build.src_stamp("chunk_engine")
+        try:
+            os.makedirs(os.path.dirname(marker), exist_ok=True)
+            with open(marker, "w") as f:
+                f.write(stamp)
+            # Same source state that "failed" before: refused without a
+            # make invocation (the memo short-circuit).
+            assert native_build.ensure_built(target, "chunk_engine") is False
+            # A different stamp must invalidate the memo and retry the
+            # build (which fails for real here: no such make target).
+            with open(marker, "w") as f:
+                f.write("0.0")
+            assert native_build.ensure_built(target, "chunk_engine") is False
+            with open(marker) as f:
+                assert f.read() == stamp  # memo refreshed to current stamp
+        finally:
+            try:
+                os.unlink(marker)
+            except OSError:
+                pass
+
+    def test_src_stamp_unreadable_dir(self):
+        assert native_build.src_stamp("no_such_dir") == ""
+        assert not native_build.sources_newer("libchunk_engine.so", "no_such_dir")
+
+
+class TestFuseProtocolLayouts:
+    """Wire layouts must match the kernel ABI (fuse_kernel.h)."""
+
+    def test_header_sizes(self):
+        from nydus_snapshotter_tpu.fusedev import protocol as p
+
+        # struct fuse_in_header / fuse_out_header are fixed by the kernel.
+        assert p.IN_HEADER.size == 40
+        assert p.OUT_HEADER.size == 16
+
+    def test_opcode_values_match_kernel(self):
+        from nydus_snapshotter_tpu.fusedev import protocol as p
+
+        # Spot anchors from fuse_kernel.h — renumbering would break the
+        # kernel conversation silently.
+        assert (p.LOOKUP, p.GETATTR, p.OPEN, p.READ, p.RELEASE) == (1, 3, 14, 15, 18)
+        assert (p.OPENDIR, p.READDIR, p.RELEASEDIR) == (27, 28, 29)
+        assert p.INIT == 26
+        assert p.DESTROY == 38
+
+    def test_attr_pack_roundtrip(self):
+        from nydus_snapshotter_tpu.fusedev import protocol as p
+
+        blob = p.pack_attr(
+            ino=7, size=1234, mode=0o100644, nlink=1, uid=3, gid=4,
+            rdev=0, blksize=4096, mtime=111,
+        )
+        assert len(blob) == p.ATTR.size  # struct fuse_attr, fixed by ABI
+        fields = p.ATTR.unpack(blob)
+        # ino, size, blocks, atime, mtime, ctime, ...ns..., mode, nlink,
+        # uid, gid, rdev, blksize — verify the load-bearing positions.
+        assert fields[0] == 7  # ino
+        assert fields[1] == 1234  # size
+        assert 0o100644 in fields and 4096 in fields
+        assert fields.count(3) >= 1 and fields.count(4) >= 1  # uid, gid
+        assert 111 in fields  # mtime seconds
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))
