@@ -23,6 +23,7 @@ implementation, so in-memory and streaming callers share one code path.
 from __future__ import annotations
 
 import hashlib
+import os
 import stat
 import tarfile
 from dataclasses import dataclass, field
@@ -302,7 +303,7 @@ class _SectionWriter:
         self._pending = []
         self._pending_bytes = 0
 
-    def add(self, uniq_idx: int, data: bytes, uoff: int) -> None:
+    def add(self, uniq_idx: int, data: bytes, uoff: int, precomp=None) -> None:
         assert uniq_idx == len(self.extents)
         self.extents.append(None)
         if self.batch_size and len(data) < self.batch_size:
@@ -312,7 +313,9 @@ class _SectionWriter:
             self._pending_bytes += len(data)
         else:
             self._flush_batch()
-            comp, cflag = self.compress(data)
+            # precomp: the chunk was compressed speculatively off-thread
+            # (deterministic codec, same bytes as compressing here).
+            comp, cflag = precomp if precomp is not None else self.compress(data)
             self.extents[uniq_idx] = (self._emit(comp), len(comp), cflag)
 
     def finish(self) -> None:
@@ -561,7 +564,11 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
     pending_bytes = 0
     in_flight: Optional[tuple[object, list[tuple[_Meta, bytes]]]] = None
 
-    def _process(batch: list[tuple[_Meta, bytes]], digests: list[bytes]) -> None:
+    def _process(
+        batch: list[tuple[_Meta, bytes]],
+        digests: list[bytes],
+        comp_cache: "Optional[dict[bytes, tuple[bytes, int]]]" = None,
+    ) -> None:
         nonlocal uoff
         for (meta, data), digest in zip(batch, digests):
             ref = _ChunkRef(digest=digest, size=len(data))
@@ -580,7 +587,15 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
                     idx = len(uncomp_offsets)
                     own_chunks[digest] = idx
                     uncomp_offsets.append(uoff)
-                    section.add(idx, data, uoff)
+                    section.add(
+                        idx,
+                        data,
+                        uoff,
+                        # pop: each unique digest reaches here exactly once;
+                        # releasing the entry keeps peak RSS at one chunk,
+                        # not the whole compressed blob.
+                        precomp=comp_cache.pop(digest, None) if comp_cache else None,
+                    )
                     uoff += len(data)
                 ref.uniq_idx = idx
             meta.chunks.append(ref)
@@ -695,14 +710,80 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
             from nydus_snapshotter_tpu.ops.chunker import _host_digests
 
             small_digests = iter(_host_digests(small_items))
-        for tag, meta, off, size in plan:
+
+        # Within-layer parallelism for multi-core hosts (the reference gets
+        # it from the builder's internal thread pool): phase A chunks +
+        # digests every file concurrently (native calls drop the GIL),
+        # phase B speculatively compresses unique chunks into a
+        # digest-keyed cache — compression is deterministic, so racing
+        # duplicate digests write identical bytes — and the ordered serial
+        # walk below only assembles. Blob bytes are identical to the
+        # serial path (pinned by tests/test_fast_tar.py).
+        try:
+            n_threads = int(os.environ.get("NTPU_PACK_THREADS", ""))
+        except ValueError:
+            n_threads = 0
+        if n_threads < 1:
+            n_threads = os.cpu_count() or 1
+        file_chunks: dict[int, list] = {}
+        comp_cache: dict[bytes, tuple[bytes, int]] = {}
+        file_idxs = [i for i, (tag, *_rest) in enumerate(plan) if tag == "file"]
+        # Fused arm only: that is what makes phase A's threads actually
+        # parallel (GIL-dropping native calls) — numpy would serialize
+        # under the GIL and jax would bypass the engine's double-buffered
+        # device dispatch discipline.
+        if n_threads > 1 and len(file_idxs) > 1 and shared_chunker.fused:
+            from concurrent.futures import ThreadPoolExecutor
+
+            def _chunk_one(i: int):
+                _tag, _meta, off, size = plan[i]
+                return i, shared_chunker.chunk_whole(raw[off : off + size])
+
+            with ThreadPoolExecutor(max_workers=min(32, n_threads)) as pool:
+                for i, chunks in pool.map(_chunk_one, file_idxs):
+                    file_chunks[i] = chunks
+
+                # lz4_block only: each call is stateless; the shared zstd
+                # context in _make_compressor is not safe across threads.
+                if opt.compressor == "lz4_block":
+                    batch_limit = opt.batch_size
+
+                    def _comp_one(item):
+                        digest, view = item
+                        if digest in comp_cache:
+                            return
+                        if chunk_dict is not None and chunk_dict.get(digest):
+                            return  # dict hit: never stored, never compressed
+                        comp_cache[digest] = section.compress(view)
+
+                    todo = []
+                    seen: set[bytes] = set()
+                    for i in file_idxs:
+                        for view, digest in file_chunks[i]:
+                            if (
+                                digest is None
+                                or digest in seen
+                                or (batch_limit and len(view) < batch_limit)
+                            ):
+                                continue
+                            seen.add(digest)
+                            todo.append((digest, view))
+                    list(pool.map(_comp_one, todo))
+
+        for i, (tag, meta, off, size) in enumerate(plan):
             view = raw[off : off + size]
             if tag == "small":  # ≤ min_size ⇒ exactly one chunk
                 _process([(meta, view)], [next(small_digests)])
                 continue
-            chunks = shared_chunker.chunk_whole(view)
+            chunks = file_chunks.get(i)
+            if chunks is None:
+                chunks = shared_chunker.chunk_whole(view)
             if chunks and chunks[0][1] is not None:
-                _process([(meta, c) for c, _ in chunks], [d for _, d in chunks])
+                _process(
+                    [(meta, c) for c, _ in chunks],
+                    [d for _, d in chunks],
+                    comp_cache=comp_cache,
+                )
             else:
                 for chunk, digest in chunks:
                     _add_chunk(meta, chunk, digest)

@@ -157,18 +157,32 @@ class TestFuseTakeoverStorm:
             time.sleep(0.5)
             open(stop_file, "w").close()
             results = []
+            stuck = 0
             for r, rf in zip(readers, result_files):
-                r.wait(timeout=30)
+                try:
+                    r.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    # A request the dying daemon had already CONSUMED is
+                    # pinned to the connection until abort — SIGALRM can't
+                    # break it (non-fatal signals only interrupt pending,
+                    # unread requests). Such a reader can never exit;
+                    # kill it and bound how many there are.
+                    r.kill()
+                    r.wait()  # reap: no zombies for the rest of the session
+                    stuck += 1
+                    continue
                 with open(rf) as f:
                     results.append(json.load(f))
+            assert results, "every reader got stuck"
             total_reads = sum(r["reads"] for r in results)
             total_hung = sum(r["hung"] for r in results)
             assert all(r["wrong"] == 0 for r in results), results
             assert all(r["oserrs"] == 0 for r in results), results
             assert total_reads > 20, f"only {total_reads} reads completed"
-            # At most one in-flight request per reader can be lost per kill
-            # (the one the dying daemon had consumed); anything more means
-            # the successor is dropping queued requests.
+            # At most one in-flight request per reader per kill can be
+            # consumed-and-lost; anything more means the successor is
+            # dropping queued requests.
+            assert stuck <= 3, f"{stuck} readers stuck (one per kill max)"
             assert total_hung <= 3 * len(readers), results
             cli.umount(mp)
         finally:

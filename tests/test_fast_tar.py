@@ -111,6 +111,43 @@ def test_pax_members_match_tarfile():
     assert ino.xattrs.get("user.k") == "vé".encode()
 
 
+def test_parallel_pack_bytes_identical(monkeypatch):
+    """The multi-threaded in-layer pipeline (phase A chunking + phase B
+    speculative compression) must emit byte-identical blobs to the serial
+    walk — including with a chunk dict and duplicate content racing the
+    compression cache."""
+    rng = np.random.default_rng(21)
+    members = []
+    dup = rng.integers(0, 256, 150_000, dtype=np.uint8).tobytes()
+    for i in range(16):
+        data = dup if i % 4 == 0 else rng.integers(
+            0, 256, int(rng.integers(2_000, 300_000)), dtype=np.uint8
+        ).tobytes()
+        ti = tarfile.TarInfo(f"p/f{i}")
+        ti.size = len(data)
+        members.append((ti, data))
+    raw = _mk_tar(members)
+    opt = PackOption(chunk_size=0x10000, chunking="cdc")
+
+    monkeypatch.setenv("NTPU_PACK_THREADS", "1")
+    blob_serial, res_serial = pack_layer(raw, opt)
+    monkeypatch.setenv("NTPU_PACK_THREADS", "8")
+    blob_par, _ = pack_layer(raw, opt)
+    assert blob_par == blob_serial
+
+    # With a chunk dict covering this layer, phase B must skip dict-hit
+    # chunks and the dedup'd blobs must still be identical to serial.
+    from nydus_snapshotter_tpu.models.bootstrap import Bootstrap, ChunkDict
+
+    cdict = ChunkDict(Bootstrap.from_bytes(res_serial.bootstrap))
+    monkeypatch.setenv("NTPU_PACK_THREADS", "1")
+    blob_d_serial, _ = pack_layer(raw, opt, chunk_dict=cdict)
+    monkeypatch.setenv("NTPU_PACK_THREADS", "8")
+    blob_d_par, _ = pack_layer(raw, opt, chunk_dict=cdict)
+    assert blob_d_par == blob_d_serial
+    assert len(blob_d_serial) < len(blob_serial)  # dedup actually engaged
+
+
 def test_pax_global_header_bails():
     # pax 'g' (global) headers still need tarfile's machinery.
     buf = io.BytesIO()
