@@ -82,6 +82,14 @@ with open(result_file, "w") as f:
 """
 
 
+def _is_mounted(mp: str) -> bool:
+    """Mount check via /proc/mounts — unlike os.path.ismount it issues NO
+    filesystem I/O on the mountpoint, so it cannot block on a FUSE session
+    that momentarily has no server."""
+    with open("/proc/mounts") as f:
+        return any(line.split()[1] == mp for line in f)
+
+
 @requires_fuse
 class TestFuseTakeoverStorm:
     def test_fuse_reads_inflight_across_sigkill_takeover_cycles(self, tmp_path):
@@ -149,7 +157,7 @@ class TestFuseTakeoverStorm:
                 )
                 cli.takeover()
                 cli.start()
-                assert os.path.ismount(mp), f"mount dropped on cycle {cycle}"
+                assert _is_mounted(mp), f"mount dropped on cycle {cycle}"
                 # The successor must re-push state+fd before the next kill:
                 # without it the supervisor would hand out a stale session
                 # on the following cycle.

@@ -122,7 +122,9 @@ def _spawn_daemon(d: str, name: str, sup_sock: str = "", upgrade: bool = False):
         cmd += ["--upgrade"]
     proc = subprocess.Popen(cmd, env=env, cwd="/root/repo")
     cli = NydusdClient(sock)
-    cli.wait_until_socket_exists(15)
+    # 30s: interpreter startup + imports on a loaded 1-core box under
+    # PYTHONDEVMODE can exceed 15s while stress readers are running.
+    cli.wait_until_socket_exists(30)
     return proc, cli
 
 
