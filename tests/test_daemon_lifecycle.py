@@ -117,6 +117,10 @@ class TestDaemonEndToEnd:
             assert event.daemon_id == "d2"
         finally:
             daemon.terminate()
+            try:
+                daemon.wait(timeout=5)  # reap: no zombie/ResourceWarning
+            except Exception:
+                pass
             mgr.stop()
 
     def test_restart_policy_recovers_mounts(self, tmp_path, image):

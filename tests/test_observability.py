@@ -203,27 +203,28 @@ def test_metrics_http_listener(tmp_path):
 
 def _uds_request(sock_path: str, method: str, path: str, body: bytes = b"") -> tuple[int, bytes]:
     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    s.settimeout(5)
-    s.connect(sock_path)
-    req = f"{method} {path} HTTP/1.1\r\nHost: uds\r\nContent-Length: {len(body)}\r\n\r\n".encode() + body
-    s.sendall(req)
-    resp = b""
-    while True:
-        chunk = s.recv(65536)
-        if not chunk:
-            break
-        resp += chunk
-        if b"\r\n\r\n" in resp:
-            head, _, rest = resp.partition(b"\r\n\r\n")
-            for line in head.split(b"\r\n"):
-                if line.lower().startswith(b"content-length:"):
-                    want = int(line.split(b":")[1])
-                    if len(rest) >= want:
-                        s.close()
-                        return int(head.split()[1]), rest[:want]
-    s.close()
-    status = int(resp.split()[1]) if resp else 0
-    return status, b""
+    try:
+        s.settimeout(5)
+        s.connect(sock_path)
+        req = f"{method} {path} HTTP/1.1\r\nHost: uds\r\nContent-Length: {len(body)}\r\n\r\n".encode() + body
+        s.sendall(req)
+        resp = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            resp += chunk
+            if b"\r\n\r\n" in resp:
+                head, _, rest = resp.partition(b"\r\n\r\n")
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        want = int(line.split(b":")[1])
+                        if len(rest) >= want:
+                            return int(head.split()[1]), rest[:want]
+        status = int(resp.split()[1]) if resp else 0
+        return status, b""
+    finally:
+        s.close()
 
 
 def test_system_controller(tmp_path):
