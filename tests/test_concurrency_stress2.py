@@ -108,8 +108,10 @@ class TestFuseTakeoverStorm:
         """
         # Watchdog: a wedge anywhere here (a FUSE op nobody can answer)
         # must dump stacks and kill the process instead of leaving a
-        # D-state pytest + live dead mount behind.
-        faulthandler.dump_traceback_later(180, exit=True)
+        # D-state pytest + live dead mount behind. Dump goes to a file so
+        # output-capturing runs still leave evidence.
+        self._watchdog_log = open("/tmp/ntpu_storm_watchdog.txt", "w")
+        faulthandler.dump_traceback_later(180, exit=True, file=self._watchdog_log)
         import hashlib
 
         boot, blob_dir = _build_image(str(tmp_path))
@@ -212,6 +214,7 @@ class TestFuseTakeoverStorm:
                     r.kill()
             subprocess.run(["umount", "-l", mp], capture_output=True, timeout=30)
             faulthandler.cancel_dump_traceback_later()
+            self._watchdog_log.close()
 
 
 def _spawn_nofuse_daemon(d: str, name: str):
