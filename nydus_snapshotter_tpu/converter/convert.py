@@ -71,6 +71,28 @@ def _make_compressor(compressor: str):
     return lambda data: (data, constants.COMPRESSOR_NONE)
 
 
+class ThreadSafeCompressor:
+    """Per-thread codec contexts for parallel speculative compression.
+
+    ZstdCompressor instances are not safe for concurrent calls; output is
+    still deterministic across contexts (same level, single-threaded
+    contexts), so racing threads produce identical bytes.
+    """
+
+    def __init__(self, compressor: str):
+        import threading
+
+        self._kind = compressor
+        self._tls = threading.local()
+
+    def __call__(self, data):
+        fn = getattr(self._tls, "fn", None)
+        if fn is None:
+            fn = _make_compressor(self._kind)
+            self._tls.fn = fn
+        return fn(data)
+
+
 def _decompress_chunk(data: bytes, flags: int, expect_size: int) -> bytes:
     comp = flags & constants.COMPRESSOR_MASK
     if comp == constants.COMPRESSOR_ZSTD:

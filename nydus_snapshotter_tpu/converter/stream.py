@@ -743,9 +743,16 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
                 for i, chunks in pool.map(_chunk_one, file_idxs):
                     file_chunks[i] = chunks
 
-                # lz4_block only: each call is stateless; the shared zstd
-                # context in _make_compressor is not safe across threads.
-                if opt.compressor == "lz4_block":
+                if opt.compressor in ("lz4_block", "zstd"):
+                    from nydus_snapshotter_tpu.converter.convert import (
+                        ThreadSafeCompressor,
+                    )
+
+                    # Per-thread codec contexts: lz4 calls are stateless,
+                    # zstd contexts are not thread-safe; both codecs are
+                    # deterministic, so racing duplicate digests write
+                    # identical bytes.
+                    ts_compress = ThreadSafeCompressor(opt.compressor)
                     batch_limit = opt.batch_size
 
                     def _comp_one(item):
@@ -754,7 +761,7 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
                             return
                         if chunk_dict is not None and chunk_dict.get(digest):
                             return  # dict hit: never stored, never compressed
-                        comp_cache[digest] = section.compress(view)
+                        comp_cache[digest] = ts_compress(view)
 
                     todo = []
                     seen: set[bytes] = set()

@@ -147,6 +147,14 @@ def test_parallel_pack_bytes_identical(monkeypatch):
     assert blob_d_par == blob_d_serial
     assert len(blob_d_serial) < len(blob_serial)  # dedup actually engaged
 
+    # zstd rides per-thread contexts; bytes must still be identical.
+    zopt = PackOption(chunk_size=0x10000, chunking="cdc", compressor="zstd")
+    monkeypatch.setenv("NTPU_PACK_THREADS", "1")
+    blob_z_serial, _ = pack_layer(raw, zopt)
+    monkeypatch.setenv("NTPU_PACK_THREADS", "8")
+    blob_z_par, _ = pack_layer(raw, zopt)
+    assert blob_z_par == blob_z_serial
+
 
 def test_pax_global_header_bails():
     # pax 'g' (global) headers still need tarfile's machinery.
