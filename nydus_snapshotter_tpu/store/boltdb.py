@@ -61,24 +61,42 @@ class Bucket:
         self._root = root
         self._inline = inline
 
-    def _walk(self, page: Optional[bytes] = None) -> Iterator[tuple[int, bytes, bytes]]:
-        """Yield (elem_flags, key, value) across the bucket's B+tree."""
+    def _walk(
+        self, page: Optional[bytes] = None, depth: int = 0
+    ) -> Iterator[tuple[int, bytes, bytes]]:
+        """Yield (elem_flags, key, value) across the bucket's B+tree.
+
+        Defensive against corrupt/crafted files (this reader ingests
+        untrusted legacy databases): element tables must fit the page and
+        branch depth is capped so a page cycle raises instead of
+        recursing forever.
+        """
+        if depth > 64:  # bolt trees are a few levels; a cycle is corruption
+            raise BoltError("branch chain exceeds max depth (page cycle?)")
         if page is None:
             page = self._inline if self._inline is not None else self._db._page(self._root)
+        if len(page) < 16:
+            raise BoltError("page shorter than its header")
         pid, flags, count, overflow = _PAGE_HDR.unpack_from(page, 0)
         if flags & FLAG_LEAF:
+            if 16 + count * _LEAF_ELEM.size > len(page):
+                raise BoltError(f"leaf page {pid}: element table beyond page")
             for i in range(count):
                 off = 16 + i * _LEAF_ELEM.size
                 eflags, pos, ksize, vsize = _LEAF_ELEM.unpack_from(page, off)
                 k0 = off + pos
+                if k0 + ksize + vsize > len(page):
+                    raise BoltError(f"leaf page {pid}: element data beyond page")
                 yield eflags, bytes(page[k0 : k0 + ksize]), bytes(
                     page[k0 + ksize : k0 + ksize + vsize]
                 )
         elif flags & FLAG_BRANCH:
+            if 16 + count * _BRANCH_ELEM.size > len(page):
+                raise BoltError(f"branch page {pid}: element table beyond page")
             for i in range(count):
                 off = 16 + i * _BRANCH_ELEM.size
                 _pos, _ksize, child = _BRANCH_ELEM.unpack_from(page, off)
-                yield from self._walk(self._db._page(child))
+                yield from self._walk(self._db._page(child), depth + 1)
         else:
             raise BoltError(f"page {pid} has unexpected flags {flags:#x}")
 

@@ -315,3 +315,90 @@ class TestFastTarScannerFuzz:
         # The fuzz must exercise both outcomes to mean anything.
         assert checked > 30, f"only {checked} archives compared"
         assert bails > 30, f"only {bails} bails"
+
+
+class TestBoltReaderFuzz:
+    """The read-only bbolt reader ingests untrusted legacy databases; on
+    ANY mutation of a real fixture it must either parse (possibly garbage
+    values — json decoding rejects those later) or raise BoltError /
+    ValueError. Never a crash class (RecursionError, MemoryError,
+    IndexError, struct.error) and never a hang."""
+
+    FIXTURE = "/root/reference/pkg/store/testdata/nydus_shared_compat.db"
+
+    def _walk_all(self, path):
+        from nydus_snapshotter_tpu.store.boltdb import BoltDB
+
+        db = BoltDB(path)
+
+        def rec(bucket, depth=0):
+            for _k, _v in bucket.items():
+                pass
+            if depth < 6:
+                for _k, sub in bucket.buckets():
+                    rec(sub, depth + 1)
+
+        rec(db.root())
+
+    def test_mutated_fixture_never_crashes(self, tmp_path):
+        import os
+
+        from nydus_snapshotter_tpu.store.boltdb import BoltError
+
+        if not os.path.exists(self.FIXTURE):
+            pytest.skip("reference tree not available")
+        raw = open(self.FIXTURE, "rb").read()
+        rng = np.random.default_rng(0xB017)
+        p = str(tmp_path / "m.db")
+        rejected = parsed = 0
+        for trial in range(500):
+            b = bytearray(raw)
+            if trial % 2:
+                # structural bytes: page headers + element tables live in
+                # the first 128 bytes of every 4 KiB page
+                for _ in range(int(rng.integers(1, 6))):
+                    page = int(rng.integers(0, len(b) // 4096))
+                    b[page * 4096 + int(rng.integers(0, 128))] = int(
+                        rng.integers(0, 256)
+                    )
+            else:
+                for _ in range(int(rng.integers(1, 12))):
+                    b[int(rng.integers(0, len(b)))] = int(rng.integers(0, 256))
+            with open(p, "wb") as f:
+                f.write(bytes(b))
+            try:
+                self._walk_all(p)
+                parsed += 1
+            except (BoltError, ValueError):
+                rejected += 1
+        assert parsed + rejected == 500
+        assert rejected > 10, "mutations never hit structure: fuzz too weak"
+
+    def test_page_cycle_rejected(self, tmp_path):
+        """A branch page pointing at itself must raise, not recurse."""
+        import struct as st
+
+        from nydus_snapshotter_tpu.store.boltdb import (
+            MAGIC,
+            VERSION,
+            BoltDB,
+            BoltError,
+            _fnv1a,
+        )
+
+        ps = 4096
+        buf = bytearray(ps * 4)
+        # meta page 0 -> root bucket at page 2
+        meta = st.pack("<IIII QQ Q Q Q", MAGIC, VERSION, ps, 0, 2, 0, 3, 4, 1)
+        meta += st.pack("<Q", _fnv1a(meta))
+        buf[0:16] = st.pack("<QHHI", 0, 0x04, 0, 0)
+        buf[16 : 16 + len(meta)] = meta
+        # page 2: branch page with one element pointing at page 2 (itself)
+        buf[2 * ps : 2 * ps + 16] = st.pack("<QHHI", 2, 0x01, 1, 0)
+        buf[2 * ps + 16 : 2 * ps + 32] = st.pack("<IIQ", 16, 0, 2)
+        p = str(tmp_path / "cycle.db")
+        with open(p, "wb") as f:
+            f.write(bytes(buf))
+        db = BoltDB(p)
+        with pytest.raises(BoltError):
+            list(db.root().items())
