@@ -62,17 +62,28 @@ class Bucket:
         self._inline = inline
 
     def _walk(
-        self, page: Optional[bytes] = None, depth: int = 0
+        self,
+        page: Optional[bytes] = None,
+        depth: int = 0,
+        budget: Optional[list[int]] = None,
     ) -> Iterator[tuple[int, bytes, bytes]]:
         """Yield (elem_flags, key, value) across the bucket's B+tree.
 
         Defensive against corrupt/crafted files (this reader ingests
-        untrusted legacy databases): element tables must fit the page and
-        branch depth is capped so a page cycle raises instead of
-        recursing forever.
+        untrusted legacy databases): element tables must fit the page,
+        branch depth is capped, and total pages visited per walk is
+        bounded by the file's page count — a legitimate tree visits each
+        page at most once, so a cycle (even a wide one whose path count
+        would explode combinatorially under a depth cap alone) raises
+        instead of hanging.
         """
         if depth > 64:  # bolt trees are a few levels; a cycle is corruption
             raise BoltError("branch chain exceeds max depth (page cycle?)")
+        if budget is None:
+            budget = [len(self._db._buf) // max(1, self._db.page_size) + 2]
+        budget[0] -= 1
+        if budget[0] < 0:
+            raise BoltError("walk visited more pages than the file holds (cycle?)")
         if page is None:
             page = self._inline if self._inline is not None else self._db._page(self._root)
         if len(page) < 16:
@@ -96,7 +107,7 @@ class Bucket:
             for i in range(count):
                 off = 16 + i * _BRANCH_ELEM.size
                 _pos, _ksize, child = _BRANCH_ELEM.unpack_from(page, off)
-                yield from self._walk(self._db._page(child), depth + 1)
+                yield from self._walk(self._db._page(child), depth + 1, budget)
         else:
             raise BoltError(f"page {pid} has unexpected flags {flags:#x}")
 

@@ -402,3 +402,39 @@ class TestBoltReaderFuzz:
         db = BoltDB(p)
         with pytest.raises(BoltError):
             list(db.root().items())
+
+    def test_wide_page_cycle_bounded(self, tmp_path):
+        """A 255-element self-referencing branch would explode to ~255^64
+        paths under a depth cap alone; the visited-page budget must raise
+        immediately instead of hanging."""
+        import struct as st
+        import time
+
+        from nydus_snapshotter_tpu.store.boltdb import (
+            MAGIC,
+            VERSION,
+            BoltDB,
+            BoltError,
+            _fnv1a,
+        )
+
+        ps = 4096
+        buf = bytearray(ps * 4)
+        meta = st.pack("<IIII QQ Q Q Q", MAGIC, VERSION, ps, 0, 2, 0, 3, 4, 1)
+        meta += st.pack("<Q", _fnv1a(meta))
+        buf[0:16] = st.pack("<QHHI", 0, 0x04, 0, 0)
+        buf[16 : 16 + len(meta)] = meta
+        n = 255
+        buf[2 * ps : 2 * ps + 16] = st.pack("<QHHI", 2, 0x01, n, 0)
+        for i in range(n):
+            buf[2 * ps + 16 + 16 * i : 2 * ps + 32 + 16 * i] = st.pack(
+                "<IIQ", 16, 0, 2
+            )
+        p = str(tmp_path / "wide.db")
+        with open(p, "wb") as f:
+            f.write(bytes(buf))
+        db = BoltDB(p)
+        t0 = time.perf_counter()
+        with pytest.raises(BoltError):
+            list(db.root().items())
+        assert time.perf_counter() - t0 < 1.0
