@@ -56,11 +56,51 @@ inline uint32_t mix32(uint32_t x) {
 // judge_from - 31) can never influence a judged hash and are simply never
 // computed (~min_size/avg_size of all bytes skipped).
 #ifdef NTPU_X86
+// AVX2 register-resident arm (8 u32 lanes/step): same rolling-state
+// formulation as the AVX-512 kernel — log-doubling levels never touch
+// memory — with the element shifts built from the permute2x128+alignr
+// carry idiom (AVX2's alignr is per-128-bit-lane). The s8-level early-out
+// applies unchanged: bits 0..15 of the final hash equal bits 0..15 of
+// s8, so one movemask decides whether the <<16 completion runs. This is
+// the fused pass's fast path on AVX2-only hosts (e.g. AMD Milan TPU
+// hosts).
+
+// value at position i-1 / i-2 / i-4, carrying from the previous register
+#define NTPU_G2_CARRY(cur, prev) _mm256_permute2x128_si256(prev, cur, 0x21)
+#define NTPU_G2_SHIFT1(cur, prev) \
+  _mm256_alignr_epi8(cur, NTPU_G2_CARRY(cur, prev), 12)
+#define NTPU_G2_SHIFT2(cur, prev) \
+  _mm256_alignr_epi8(cur, NTPU_G2_CARRY(cur, prev), 8)
+
+#define NTPU_G2_STEP8(raw64)                                                 \
+  __m256i g = _mm256_cvtepu8_epi32(raw64);                                   \
+  g = _mm256_mullo_epi32(_mm256_add_epi32(g, one), c0);                      \
+  g = _mm256_xor_si256(g, _mm256_srli_epi32(g, 16));                         \
+  g = _mm256_mullo_epi32(g, c1);                                             \
+  g = _mm256_xor_si256(g, _mm256_srli_epi32(g, 13));                         \
+  g = _mm256_mullo_epi32(g, c2);                                             \
+  g = _mm256_xor_si256(g, _mm256_srli_epi32(g, 16));                         \
+  const __m256i s1 =                                                         \
+      _mm256_add_epi32(g, _mm256_slli_epi32(NTPU_G2_SHIFT1(g, pg), 1));      \
+  const __m256i s2 =                                                         \
+      _mm256_add_epi32(s1, _mm256_slli_epi32(NTPU_G2_SHIFT2(s1, p1), 2));    \
+  const __m256i s4 =                                                         \
+      _mm256_add_epi32(s2, _mm256_slli_epi32(NTPU_G2_CARRY(s2, p2), 4));     \
+  const __m256i s8v =                                                        \
+      _mm256_add_epi32(s4, _mm256_slli_epi32(p4, 8));                        \
+  const __m256i oldpp8 = pp8;                                                \
+  (void)oldpp8;                                                              \
+  pg = g;                                                                    \
+  p1 = s1;                                                                   \
+  p2 = s2;                                                                   \
+  p4 = s4;                                                                   \
+  pp8 = p8;                                                                  \
+  p8 = s8v;
+
 __attribute__((target("avx2")))
 void gear_bitmaps_avx2(const uint8_t *data, int64_t lo, int64_t hi,
                        uint32_t mask_s, uint32_t mask_l, uint64_t *bm_s,
                        uint64_t *bm_l) {
-  alignas(32) uint32_t bufa[TILE + 32], bufb[TILE + 32];
   const __m256i c0 = _mm256_set1_epi32((int)MIX_C0);
   const __m256i c1 = _mm256_set1_epi32((int)MIX_C1);
   const __m256i c2 = _mm256_set1_epi32((int)MIX_C2);
@@ -68,76 +108,64 @@ void gear_bitmaps_avx2(const uint8_t *data, int64_t lo, int64_t hi,
   const __m256i vms = _mm256_set1_epi32((int)mask_s);
   const __m256i vml = _mm256_set1_epi32((int)mask_l);
   const __m256i vzero = _mm256_setzero_si256();
+  const __m256i vpre = _mm256_set1_epi32((int)(mask_s & mask_l & 0xFFFFu));
 
-  for (int64_t p0 = lo; p0 < hi; p0 += TILE) {
-    const int64_t count = (p0 + TILE <= hi) ? TILE : hi - p0;
-    const int64_t len = count + 31;
-    uint32_t *a = bufa, *b = bufb;
+  __m256i pg = _mm256_setzero_si256(), p1 = pg, p2 = pg, p4 = pg, p8 = pg,
+          pp8 = pg;
 
-    // mix32 of the tile bytes + 31-byte history (head clamped to zero)
-    int64_t j = 0;
-    const int64_t base = p0 - 31;
-    while (j < len && base + j < 0) a[j++] = 0u;
-    for (; j + 8 <= len; j += 8) {
-      const __m128i raw =
-          _mm_loadl_epi64((const __m128i *)(data + base + j));
-      __m256i x = _mm256_cvtepu8_epi32(raw);
-      x = _mm256_mullo_epi32(_mm256_add_epi32(x, one), c0);
-      x = _mm256_xor_si256(x, _mm256_srli_epi32(x, 16));
-      x = _mm256_mullo_epi32(x, c1);
-      x = _mm256_xor_si256(x, _mm256_srli_epi32(x, 13));
-      x = _mm256_mullo_epi32(x, c2);
-      x = _mm256_xor_si256(x, _mm256_srli_epi32(x, 16));
-      _mm256_storeu_si256((__m256i *)(a + j), x);
-    }
-    for (; j < len; ++j) a[j] = mix32(data[base + j]);
-
-    // 5 log-doubling shifted adds
-    for (int m = 1; m <= 16; m *= 2) {
-      int64_t k = m;
-      for (; k + 8 <= len; k += 8) {
-        const __m256i cur = _mm256_loadu_si256((const __m256i *)(a + k));
-        const __m256i prev =
-            _mm256_loadu_si256((const __m256i *)(a + k - m));
-        _mm256_storeu_si256(
-            (__m256i *)(b + k),
-            _mm256_add_epi32(cur, _mm256_slli_epi32(prev, m)));
-      }
-      for (; k < len; ++k) b[k] = a[k] + (a[k - m] << m);
-      for (int64_t h = 0; h < m; ++h) b[h] = a[h];
-      uint32_t *t = a;
-      a = b;
-      b = t;
-    }
-
-    // bit tests -> packed words (p0 is a multiple of 64: whole words)
-    const uint32_t *s = a + 31;
-    int64_t i = 0;
-    for (; i + 64 <= count; i += 64) {
-      uint64_t ws = 0, wl = 0;
-      for (int64_t q = 0; q < 64; q += 8) {
-        const __m256i v = _mm256_loadu_si256((const __m256i *)(s + i + q));
-        const uint64_t ms = (uint32_t)_mm256_movemask_ps(_mm256_castsi256_ps(
-            _mm256_cmpeq_epi32(_mm256_and_si256(v, vms), vzero)));
-        const uint64_t ml = (uint32_t)_mm256_movemask_ps(_mm256_castsi256_ps(
-            _mm256_cmpeq_epi32(_mm256_and_si256(v, vml), vzero)));
-        ws |= ms << q;
-        wl |= ml << q;
-      }
-      bm_s[(p0 + i) >> 6] = ws;
-      bm_l[(p0 + i) >> 6] = wl;
-    }
-    if (i < count) {
-      uint64_t ws = 0, wl = 0;
-      for (int64_t q = i; q < count; ++q) {
-        if ((s[q] & mask_s) == 0) ws |= 1ULL << (q - i);
-        if ((s[q] & mask_l) == 0) wl |= 1ULL << (q - i);
-      }
-      bm_s[(p0 + i) >> 6] = ws;
-      bm_l[(p0 + i) >> 6] = wl;
+  // Warm the rolling state from the 32 bytes of history (zero state IS
+  // the history at the stream head; callers keep lo 0 or >= 32).
+  if (lo >= 32) {
+    for (int w = 4; w >= 1; --w) {
+      NTPU_G2_STEP8(_mm_loadl_epi64((const __m128i *)(data + lo - 8 * w)))
+      (void)s8v;
     }
   }
+
+  for (int64_t w = lo; w < hi; w += 64) {
+    uint64_t ws = 0, wl = 0;
+    const int64_t wend = (w + 64 <= hi) ? w + 64 : hi;
+    int shift = 0;
+    for (int64_t pos = w; pos < wend; pos += 8, shift += 8) {
+      const int64_t rem = wend - pos;
+      if (rem >= 8) {
+        NTPU_G2_STEP8(_mm_loadl_epi64((const __m128i *)(data + pos)))
+        if (_mm256_movemask_ps(_mm256_castsi256_ps(_mm256_cmpeq_epi32(
+                _mm256_and_si256(s8v, vpre), vzero)))) {
+          const __m256i s16 =
+              _mm256_add_epi32(s8v, _mm256_slli_epi32(oldpp8, 16));
+          const uint64_t ms =
+              (uint32_t)_mm256_movemask_ps(_mm256_castsi256_ps(
+                  _mm256_cmpeq_epi32(_mm256_and_si256(s16, vms), vzero)));
+          const uint64_t ml =
+              (uint32_t)_mm256_movemask_ps(_mm256_castsi256_ps(
+                  _mm256_cmpeq_epi32(_mm256_and_si256(s16, vml), vzero)));
+          ws |= ms << shift;
+          wl |= ml << shift;
+        }
+      } else {
+        uint8_t tail[8] = {0};
+        std::memcpy(tail, data + pos, (size_t)rem);
+        NTPU_G2_STEP8(_mm_loadl_epi64((const __m128i *)tail))
+        const __m256i s16 =
+            _mm256_add_epi32(s8v, _mm256_slli_epi32(oldpp8, 16));
+        const uint64_t live = (1u << rem) - 1;
+        const uint64_t ms = (uint32_t)_mm256_movemask_ps(_mm256_castsi256_ps(
+            _mm256_cmpeq_epi32(_mm256_and_si256(s16, vms), vzero)));
+        const uint64_t ml = (uint32_t)_mm256_movemask_ps(_mm256_castsi256_ps(
+            _mm256_cmpeq_epi32(_mm256_and_si256(s16, vml), vzero)));
+        ws |= (ms & live) << shift;
+        wl |= (ml & live) << shift;
+      }
+    }
+    bm_s[w >> 6] = ws;
+    bm_l[w >> 6] = wl;
+  }
 }
+#undef NTPU_G2_STEP8
+#undef NTPU_G2_SHIFT2
+#undef NTPU_G2_SHIFT1
+#undef NTPU_G2_CARRY
 // GCC-12 false positives: maskless AVX-512 intrinsics expand through
 // _mm512_undefined_epi32 dummies that trip -Wmaybe-uninitialized.
 #pragma GCC diagnostic push
@@ -268,22 +296,53 @@ void gear_bitmaps_scalar(const uint8_t *data, int64_t lo, int64_t hi,
   }
 }
 
+// Test hook: NTPU_GEAR_FORCE_ISA=avx2|scalar pins the dispatch so the
+// narrower arms are differential-testable on wider hardware.
+int gear_forced_isa() {
+  static const int forced = [] {
+    const char *e = std::getenv("NTPU_GEAR_FORCE_ISA");
+    if (e == nullptr) return 0;
+    if (std::strcmp(e, "avx2") == 0) return 2;
+    if (std::strcmp(e, "scalar") == 0) return 1;
+    return 0;
+  }();
+  return forced;
+}
+
+// Which arm the dispatch actually selects (respecting the force hook):
+// 3 = avx512, 2 = avx2, 1 = scalar. Callers that pin an arm for
+// differential testing must assert on this instead of trusting the env
+// var (forcing avx2 on a non-AVX2 host falls back to scalar, which would
+// otherwise let a "differential" trivially compare scalar to scalar).
+int gear_active_isa_impl() {
+  const int forced = gear_forced_isa();
+  if (forced == 1) return 1;
+#ifdef NTPU_X86
+  if (forced != 2 && __builtin_cpu_supports("avx512f") &&
+      __builtin_cpu_supports("avx512bw") &&
+      __builtin_cpu_supports("avx512vl")) {
+    return 3;
+  }
+  if (__builtin_cpu_supports("avx2")) return 2;
+#endif
+  return 1;
+}
+
 void gear_bitmaps_range(const uint8_t *data, int64_t lo, int64_t hi,
                         uint32_t mask_s, uint32_t mask_l, uint64_t *bm_s,
                         uint64_t *bm_l) {
+  switch (gear_active_isa_impl()) {
 #ifdef NTPU_X86
-  if (__builtin_cpu_supports("avx512f") &&
-      __builtin_cpu_supports("avx512bw") &&
-      __builtin_cpu_supports("avx512vl")) {
-    gear_bitmaps_avx512(data, lo, hi, mask_s, mask_l, bm_s, bm_l);
-    return;
-  }
-  if (__builtin_cpu_supports("avx2")) {
-    gear_bitmaps_avx2(data, lo, hi, mask_s, mask_l, bm_s, bm_l);
-    return;
-  }
+    case 3:
+      gear_bitmaps_avx512(data, lo, hi, mask_s, mask_l, bm_s, bm_l);
+      return;
+    case 2:
+      gear_bitmaps_avx2(data, lo, hi, mask_s, mask_l, bm_s, bm_l);
+      return;
 #endif
-  gear_bitmaps_scalar(data, lo, hi, mask_s, mask_l, bm_s, bm_l);
+    default:
+      gear_bitmaps_scalar(data, lo, hi, mask_s, mask_l, bm_s, bm_l);
+  }
 }
 
 // First set bit in [lo, hi) of an LSB-first word bitmap, or -1.
@@ -305,6 +364,11 @@ inline int64_t find_first_set(const uint64_t *bm, int64_t lo, int64_t hi) {
 }  // namespace
 
 extern "C" {
+
+// Which gear arm the dispatch selects on this host + env (3 = avx512,
+// 2 = avx2, 1 = scalar) — lets the ISA differential tests assert the arm
+// they pinned actually runs.
+int64_t ntpu_gear_active_isa(void) { return gear_active_isa_impl(); }
 
 // Returns the number of cut offsets written to cuts_out (exclusive chunk
 // ends, final == n). cuts_cap is the capacity of cuts_out; on overflow the

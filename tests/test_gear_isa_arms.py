@@ -1,0 +1,84 @@
+"""Differential coverage for every gear ISA arm via NTPU_GEAR_FORCE_ISA.
+
+On AVX-512 hosts the suite's normal runs never execute the AVX2 register
+kernel; these tests pin each arm in a child process (the env hook is read
+once per process) and assert (a) the arm ACTUALLY ran — via
+ntpu_gear_active_isa, so a silent fallback can't fake a pass — and (b)
+its fused chunk+digest output is byte-identical to the host's default
+arm on the same inputs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import hashlib, json, os, sys
+sys.path.insert(0, os.environ["NTPU_REPO"])
+import numpy as np
+from nydus_snapshotter_tpu.ops import cdc, native_cdc
+
+lib = native_cdc.load()
+assert lib is not None
+lib.ntpu_gear_active_isa.restype = __import__("ctypes").c_int64
+isa = int(lib.ntpu_gear_active_isa())
+
+rng = np.random.default_rng(0x15A)
+params = cdc.CDCParams(0x10000)
+out = {"isa": isa, "runs": []}
+for size in (0, 1, 2047, 2048, 65536 * 3 + 5, 1 << 21):
+    data = rng.integers(0, 256, size, dtype=np.uint8)
+    cap = size // max(1, params.min_size) + 2
+    cuts = np.empty(cap, dtype=np.int64)
+    digs = np.empty((cap, 32), dtype=np.uint8)
+    n = lib.ntpu_chunk_digest(
+        data.ctypes.data, size, 0x3FFFF, 0x3FFF,
+        params.min_size, params.normal_size, params.max_size,
+        cuts.ctypes.data, cap, digs.ctypes.data,
+    )
+    h = hashlib.sha256()
+    h.update(cuts[:n].tobytes())
+    h.update(digs[:n].tobytes())
+    out["runs"].append({"size": size, "n": int(n), "sig": h.hexdigest()})
+print(json.dumps(out))
+"""
+
+
+def _run_arm(force: str | None) -> dict:
+    env = dict(os.environ)
+    env["NTPU_REPO"] = REPO
+    if force is None:
+        env.pop("NTPU_GEAR_FORCE_ISA", None)
+    else:
+        env["NTPU_GEAR_FORCE_ISA"] = force
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=REPO,
+        env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_all_arms_agree_and_actually_run():
+    default = _run_arm(None)
+    scalar = _run_arm("scalar")
+    assert scalar["isa"] == 1, "scalar pin did not take"
+    assert scalar["runs"] == default["runs"]
+
+    avx2 = _run_arm("avx2")
+    if avx2["isa"] != 2:
+        pytest.skip("host has no AVX2: the pin fell back (correctly reported)")
+    assert avx2["runs"] == default["runs"]
+    # On an AVX-512 host the default is the avx512 arm, so this comparison
+    # is a genuine cross-arm differential (3 vs 2 vs 1), not self-compare.
+    if default["isa"] == 3:
+        assert avx2["isa"] != default["isa"]
