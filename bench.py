@@ -338,7 +338,7 @@ def _pack_kwargs(winner: str) -> dict:
     return {"backend": "hybrid"}
 
 
-def _pack_layers(layers: list[bytes], opt, chunk_dict=None) -> list:
+def _pack_layers(layers: list[bytes], opt, chunk_dict=None, stats=None) -> list:
     """Pack an image's layers in parallel (ordered results) — the
     reference's per-layer parallelism (one nydus-image process per layer);
     here the native engine, liblz4, and hashlib all drop the GIL, so
@@ -348,13 +348,32 @@ def _pack_layers(layers: list[bytes], opt, chunk_dict=None) -> list:
     from nydus_snapshotter_tpu.converter.convert import pack_layer
 
     if len(layers) == 1:
-        return [pack_layer(layers[0], opt, chunk_dict=chunk_dict)]
+        return [pack_layer(layers[0], opt, chunk_dict=chunk_dict, stats=stats)]
+
+    def _one(t):
+        # Per-layer stats dict, merged after: the shared-dict accumulation
+        # inside pack_stream is not thread-safe.
+        st: dict = {}
+        r = pack_layer(t, opt, chunk_dict=chunk_dict, stats=st)
+        return r, st
+
     with ThreadPoolExecutor(max_workers=min(8, len(layers))) as pool:
-        return list(pool.map(lambda t: pack_layer(t, opt, chunk_dict=chunk_dict), layers))
+        results = list(pool.map(_one, layers))
+    if stats is not None:
+        for _r, st in results:
+            for k, v in st.items():
+                stats[k] = stats.get(k, 0.0) + v
+    return [r for r, _st in results]
 
 
-def full_path_run(layers: list[bytes], opt) -> tuple[float, list, list]:
-    """Best-of-REPS wall time converting every layer of the image."""
+def full_path_run(layers: list[bytes], opt) -> tuple[float, list, list, dict]:
+    """Best-of-REPS wall time converting every layer of the image; also
+    returns a per-stage wall breakdown (scan / chunk_digest / dedup /
+    assemble / bootstrap) measured on a SEPARATE layer-serial pass —
+    parallel-layer stage clocks would sum thread wall time (including
+    GIL/CPU contention) to more than the elapsed wall and mislead."""
+    from nydus_snapshotter_tpu.converter.convert import pack_layer
+
     total = sum(len(t) for t in layers)
     best = None
     out = None
@@ -365,9 +384,17 @@ def full_path_run(layers: list[bytes], opt) -> tuple[float, list, list]:
         if best is None or elapsed < best:
             best = elapsed
             out = packed
+    stats: dict = {}
+    t0 = time.time()
+    for t in layers:
+        pack_layer(t, opt, stats=stats)
+    serial_wall = time.time() - t0
     blobs = [b for b, _ in out]
     results = [r for _, r in out]
-    return total / best / (1 << 30), blobs, results
+    breakdown = {k: round(v, 4) for k, v in sorted(stats.items())}
+    breakdown["serial_wall"] = round(serial_wall, 4)
+    breakdown["parallel_wall"] = round(best, 4)
+    return total / best / (1 << 30), blobs, results, breakdown
 
 
 def dedup_shaped_run(opt, pool: list[bytes]) -> dict:
@@ -543,7 +570,7 @@ def main() -> None:
     # ---- headline: full-path convert of the node-shaped image ----
     opt = PackOption(chunk_size=CHUNK_SIZE, chunking="cdc", **_pack_kwargs(winner))
     layers, corpus_info = build_node_shaped_layers(IMAGE_MIB, seed=7)
-    full_gibps, blobs, results = full_path_run(layers, opt)
+    full_gibps, blobs, results, stage_breakdown = full_path_run(layers, opt)
     comp_bytes = sum(r.blob_size for r in results)
     corpus_info["compress_ratio"] = round(
         comp_bytes / max(1, sum(len(t) for t in layers)), 4
@@ -581,6 +608,7 @@ def main() -> None:
                     "device_note": device_note,
                     "calibration": cal,
                     "engine_flat": engine_detail,
+                    "stage_breakdown_s": stage_breakdown,
                     "baseline_shaped": shaped,
                     "stargz_zran": stargz_zran,
                     "host_cores": os.cpu_count(),

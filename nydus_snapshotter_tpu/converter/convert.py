@@ -60,14 +60,17 @@ class MergeResult:
     blob_digests: list[str]  # referenced blob ids after dedup, table order
 
 
-def _make_compressor(compressor: str):
+def _make_compressor(compressor: str, lz4_accel: int = 1):
     """One reusable codec per Pack — a fresh zstd context per chunk costs
     allocation/init for every one of the thousands of chunks in a layer."""
     if compressor == "zstd":
         ctx = zstandard.ZstdCompressor(level=_ZSTD_LEVEL)
         return lambda data: (ctx.compress(data), constants.COMPRESSOR_ZSTD)
     if compressor == "lz4_block":
-        return lambda data: (lz4.compress_block(data), constants.COMPRESSOR_LZ4_BLOCK)
+        return lambda data: (
+            lz4.compress_block(data, lz4_accel),
+            constants.COMPRESSOR_LZ4_BLOCK,
+        )
     return lambda data: (data, constants.COMPRESSOR_NONE)
 
 
@@ -79,16 +82,17 @@ class ThreadSafeCompressor:
     contexts), so racing threads produce identical bytes.
     """
 
-    def __init__(self, compressor: str):
+    def __init__(self, compressor: str, lz4_accel: int = 1):
         import threading
 
         self._kind = compressor
+        self._lz4_accel = lz4_accel
         self._tls = threading.local()
 
     def __call__(self, data):
         fn = getattr(self._tls, "fn", None)
         if fn is None:
-            fn = _make_compressor(self._kind)
+            fn = _make_compressor(self._kind, self._lz4_accel)
             self._tls.fn = fn
         return fn(data)
 
@@ -244,7 +248,11 @@ def make_bytes_reader(
 
 
 def Pack(
-    dest: BinaryIO, src_tar: BinaryIO | bytes, opt: PackOption, chunk_dict=None
+    dest: BinaryIO,
+    src_tar: BinaryIO | bytes,
+    opt: PackOption,
+    chunk_dict=None,
+    stats: dict | None = None,
 ) -> PackResult:
     """Convert one OCI layer tar into a nydus blob stream written to dest.
 
@@ -257,15 +265,15 @@ def Pack(
     """
     from nydus_snapshotter_tpu.converter.stream import pack_stream
 
-    return pack_stream(dest, src_tar, opt, chunk_dict=chunk_dict)
+    return pack_stream(dest, src_tar, opt, chunk_dict=chunk_dict, stats=stats)
 
 
 def pack_layer(
-    src_tar: bytes, opt: PackOption, chunk_dict=None
+    src_tar: bytes, opt: PackOption, chunk_dict=None, stats: dict | None = None
 ) -> tuple[bytes, PackResult]:
     """Convenience: Pack to bytes."""
     out = io.BytesIO()
-    res = Pack(out, src_tar, opt, chunk_dict=chunk_dict)
+    res = Pack(out, src_tar, opt, chunk_dict=chunk_dict, stats=stats)
     return out.getvalue(), res
 
 

@@ -23,6 +23,7 @@ implementation, so in-memory and streaming callers share one code path.
 from __future__ import annotations
 
 import hashlib
+import math
 import os
 import stat
 import tarfile
@@ -328,6 +329,109 @@ class _SectionWriter:
                 self.coff += len(tail)
 
 
+class _SectionDigest:
+    """hasher-shim over the digest the native pass computed."""
+
+    def __init__(self) -> None:
+        self._d = b""
+
+    def digest(self) -> bytes:
+        return self._d
+
+    def hexdigest(self) -> str:
+        return self._d.hex()
+
+
+class _DeferredSectionWriter:
+    """Blob data section assembled in ONE native pass at finish().
+
+    During the walk, add() only records each unique chunk's source extent
+    (zero-copy offsets into the caller's tar buffer; loose bytes go to a
+    side buffer). finish() hands the whole extent list to
+    ntpu_pack_section, which runs the per-chunk compress -> append loop
+    and the section SHA-256 natively — the reference keeps this exact
+    loop inside one `nydus-image create` process
+    (pkg/converter/tool/builder.go:148-178), and re-entering Python per
+    chunk was the dominant full-path overhead.
+
+    Only used for layouts it reproduces byte-identically to
+    _SectionWriter: chunks packed back-to-back (align 1, no batch
+    packing), no encryption, lz4_block/none compressor. If the native arm
+    is unavailable at finish() (e.g. liblz4 vanished), the recorded
+    extents replay through the Python codec — same bytes either way.
+    """
+
+    def __init__(self, out: _CountingWriter, opt: PackOption, compress, raw: memoryview):
+        self.out = out
+        self.compress = compress  # replay fallback only
+        self.hasher = _SectionDigest()
+        self.cipher = None
+        self.coff = 0
+        self.extents: list[Optional[tuple[int, int, int]]] = []
+        self.batches: list[tuple[int, int, int]] = []
+        self._kind = 1 if opt.compressor == "lz4_block" else 0
+        self._accel = opt.lz4_acceleration
+        self._cflag = (
+            constants.COMPRESSOR_LZ4_BLOCK
+            if opt.compressor == "lz4_block"
+            else constants.COMPRESSOR_NONE
+        )
+        self._raw_arr = np.frombuffer(raw, dtype=np.uint8)
+        self._base = self._raw_arr.ctypes.data
+        self._raw_len = len(raw)
+        self._items: list[tuple[int, int, int]] = []
+        self._side = bytearray()
+
+    def add(self, uniq_idx: int, data, uoff: int, precomp=None) -> None:
+        assert uniq_idx == len(self._items)
+        size = len(data)
+        if isinstance(data, memoryview):
+            off = np.frombuffer(data, dtype=np.uint8).ctypes.data - self._base
+            if 0 <= off and off + size <= self._raw_len:
+                self._items.append((0, off, size))
+                return
+            data = bytes(data)
+        self._items.append((1, len(self._side), size))
+        self._side += data
+
+    def finish(self) -> None:
+        from nydus_snapshotter_tpu.ops import native_cdc
+
+        m = len(self._items)
+        if m == 0:
+            return
+        ext = np.asarray(self._items, dtype=np.int64)
+        side = np.frombuffer(self._side, dtype=np.uint8) if self._side else np.empty(0, np.uint8)
+        n_threads = _pack_threads()
+        res = native_cdc.pack_section(
+            self._raw_arr, side, ext, self._kind, self._accel, n_threads
+        )
+        if res is None:
+            # Replay through the Python codec (identical bytes, slower).
+            hasher = hashlib.sha256()
+            for src, off, size in self._items:
+                buf = (
+                    self._raw_arr[off : off + size]
+                    if src == 0
+                    else side[off : off + size]
+                )
+                comp, cflag = self.compress(memoryview(buf))
+                self.extents.append((self.coff, len(comp), cflag))
+                hasher.update(comp)
+                self.out.write(comp)
+                self.coff += len(comp)
+            self.hasher._d = hasher.digest()
+            return
+        blob, comp_ext, digest = res
+        self.extents = [
+            (int(comp_ext[j, 0]), int(comp_ext[j, 1]), self._cflag)
+            for j in range(m)
+        ]
+        self.hasher._d = digest
+        self.out.write(memoryview(blob))
+        self.coff = int(blob.size)
+
+
 @dataclass
 class _ChunkRef:
     """A file-extent's chunk before final record materialization."""
@@ -345,15 +449,33 @@ class _Meta:
     chunks: list[_ChunkRef] = field(default_factory=list)
 
 
-def _tar_num(field: memoryview) -> int:
-    """Tar numeric field via tarfile's own decoder (octal + GNU base-256,
-    including 0xFF-lead negative values for pre-epoch mtimes) — one source
-    of truth; malformed fields raise ValueError so the fast scanner bails
-    to tarfile."""
+def _pack_threads() -> int:
+    """Worker count for the pack pipeline (NTPU_PACK_THREADS override)."""
     try:
-        return tarfile.nti(bytes(field))
-    except tarfile.InvalidHeaderError as e:
-        raise ValueError(str(e)) from e
+        n = int(os.environ.get("NTPU_PACK_THREADS", ""))
+    except ValueError:
+        n = 0
+    return n if n >= 1 else (os.cpu_count() or 1)
+
+
+def _tar_num(field: memoryview) -> int:
+    """Tar numeric field: octal decoded inline (the ~100% case — int(_, 8)
+    over the NUL-terminated, space-stripped text, exactly tarfile.nti's
+    octal branch), GNU base-256 (lead byte 0x80/0xFF, e.g. >8 GiB sizes or
+    pre-epoch mtimes) delegated to tarfile's decoder — one source of truth
+    for the exotic branch; malformed fields raise ValueError so the fast
+    scanner bails to tarfile."""
+    b = bytes(field)
+    if b and b[0] in (0x80, 0xFF):
+        try:
+            return tarfile.nti(b)
+        except tarfile.InvalidHeaderError as e:
+            raise ValueError(str(e)) from e
+    end = b.find(0)
+    s = (b if end < 0 else b[:end]).strip()
+    if not s:
+        return 0
+    return int(s, 8)  # ValueError on garbage, as tarfile.nti raises
 
 
 _TAR_PLAIN_TYPES = (b"0", b"\x00", b"1", b"2", b"3", b"4", b"5", b"6", b"7")
@@ -429,6 +551,11 @@ def _fast_tar_members(raw: memoryview):
             chksum = _tar_num(hdr[148:156])
         except ValueError:
             return None
+        if size < 0:
+            # GNU base-256 can encode negative values; a negative size
+            # would make the scan position stop advancing (infinite loop)
+            # — bail and let tarfile reject the archive.
+            return None
         if chksum != sum(hb) - sum(hb[148:156]) + 8 * 0x20:
             return None
         if typ == b"x":
@@ -475,8 +602,11 @@ def _fast_tar_members(raw: memoryview):
             "utf-8", "surrogateescape"
         )
         if typ in (b"3", b"4"):
-            ti.devmajor = _tar_num(hdr[329:337])
-            ti.devminor = _tar_num(hdr[337:345])
+            try:
+                ti.devmajor = _tar_num(hdr[329:337])
+                ti.devminor = _tar_num(hdr[337:345])
+            except ValueError:
+                return None  # malformed device numbers: let tarfile decide
         if pending_pax is not None:
             # Apply overrides exactly as tarfile._apply_pax_info does for
             # the fields this pipeline consumes.
@@ -491,10 +621,22 @@ def _fast_tar_members(raw: memoryview):
                     ti.linkname = p["linkpath"]
                 if "size" in p:
                     ti.size = int(p["size"])
+                    if ti.size < 0:
+                        # Bailing to tarfile is NOT safe here: tarfile
+                        # walks backwards off the member and silently
+                        # yields nothing more — a data-losing "valid"
+                        # image. Reject outright.
+                        raise ConvertError(
+                            f"bad layer tar: negative pax size for {ti.name!r}"
+                        )
                     if typ in (b"0", b"\x00", b"7"):
                         data_size = ti.size
                 if "mtime" in p:
                     ti.mtime = float(p["mtime"])
+                    if not math.isfinite(ti.mtime):
+                        # nan/inf would escape later as a bare ValueError
+                        # from int(mtime); bail to tarfile instead.
+                        return None
                 if "uid" in p:
                     ti.uid = int(p["uid"])
                 if "gid" in p:
@@ -514,7 +656,13 @@ def _fast_tar_members(raw: memoryview):
     return out if saw_end else None
 
 
-def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, chunk_dict=None):
+def pack_stream(
+    dest: BinaryIO,
+    src_tar: "BinaryIO | bytes",
+    opt: PackOption,
+    chunk_dict=None,
+    stats: "Optional[dict]" = None,
+):
     """Stream one OCI layer tar into a nydus blob written to ``dest``.
 
     Reference semantics (convert_unix.go:325-539): uncompressed layer tar
@@ -523,8 +671,18 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
     ChunkDict get/blob_id_for/bootstrap interface) so batch conversion can
     reuse one growing dict without re-parsing a bootstrap per layer;
     ``opt.chunk_dict_path`` is the file-based fallback.
+
+    ``stats``: optional dict that accumulates per-stage wall seconds
+    (in-memory fast-path semantics): ``scan`` tar walk + metadata,
+    ``chunk_digest`` CDC + chunk SHA-256, ``dedup`` dedup/bookkeeping,
+    ``assemble`` compression + blob append + blob digest,
+    ``bootstrap`` inode/chunk-table serialization.
     """
     import io
+    from time import perf_counter as _pc
+
+    _t_chunk = 0.0
+    _t_spec = 0.0  # speculative compression (counts toward 'assemble')
 
     opt.validate()
     # In-memory layers take the zero-copy path: random-access tar parse,
@@ -541,7 +699,21 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
     from nydus_snapshotter_tpu.converter.convert import _make_compressor
 
     out = _CountingWriter(dest)
-    section = _SectionWriter(out, opt, _make_compressor(opt.compressor))
+    from nydus_snapshotter_tpu.ops import native_cdc
+
+    compress = _make_compressor(opt.compressor, opt.lz4_acceleration)
+    align_needed = opt.aligned_chunk and opt.fs_version == layout.RAFS_V5
+    if (
+        raw is not None
+        and opt.compressor in ("none", "lz4_block")
+        and not opt.encrypt
+        and not opt.batch_size
+        and not align_needed
+        and native_cdc.pack_section_available()
+    ):
+        section: "object" = _DeferredSectionWriter(out, opt, compress, raw)
+    else:
+        section = _SectionWriter(out, opt, compress)
     max_chunk = cdc.CDCParams(opt.chunk_size).max_size if opt.chunking == "cdc" else opt.chunk_size
     digester = (
         _DeviceDigester(max_chunk)
@@ -678,6 +850,7 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
         for chunk, digest in chunker.finish():
             _add_chunk(meta, chunk, digest)
 
+    _t0 = _pc()
     members = _fast_tar_members(raw) if raw is not None else None
     if members is not None:
         for info, data_off in members:
@@ -701,6 +874,7 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
                     )
             except tarfile.TarError as e:
                 raise ConvertError(f"bad layer tar: {e}") from e
+    _t1 = _pc()
     if plan:
         arr_all = np.frombuffer(raw, dtype=np.uint8)
         small_items = [
@@ -709,7 +883,9 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
         if small_items:
             from nydus_snapshotter_tpu.ops.chunker import _host_digests
 
+            _tc = _pc()
             small_digests = iter(_host_digests(small_items))
+            _t_chunk += _pc() - _tc
 
         # Within-layer parallelism for multi-core hosts (the reference gets
         # it from the builder's internal thread pool): phase A chunks +
@@ -719,12 +895,7 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
         # duplicate digests write identical bytes — and the ordered serial
         # walk below only assembles. Blob bytes are identical to the
         # serial path (pinned by tests/test_fast_tar.py).
-        try:
-            n_threads = int(os.environ.get("NTPU_PACK_THREADS", ""))
-        except ValueError:
-            n_threads = 0
-        if n_threads < 1:
-            n_threads = os.cpu_count() or 1
+        n_threads = _pack_threads()
         file_chunks: dict[int, list] = {}
         comp_cache: dict[bytes, tuple[bytes, int]] = {}
         file_idxs = [i for i, (tag, *_rest) in enumerate(plan) if tag == "file"]
@@ -740,10 +911,17 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
                 return i, shared_chunker.chunk_whole(raw[off : off + size])
 
             with ThreadPoolExecutor(max_workers=min(32, n_threads)) as pool:
+                _tc = _pc()
                 for i, chunks in pool.map(_chunk_one, file_idxs):
                     file_chunks[i] = chunks
+                _t_chunk += _pc() - _tc
 
-                if opt.compressor in ("lz4_block", "zstd"):
+                if opt.compressor in ("lz4_block", "zstd") and not isinstance(
+                    section, _DeferredSectionWriter
+                ):
+                    # (Deferred sections compress inside the native pass
+                    # with their own thread fan-out — speculating here
+                    # would do the work twice.)
                     from nydus_snapshotter_tpu.converter.convert import (
                         ThreadSafeCompressor,
                     )
@@ -752,7 +930,9 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
                     # zstd contexts are not thread-safe; both codecs are
                     # deterministic, so racing duplicate digests write
                     # identical bytes.
-                    ts_compress = ThreadSafeCompressor(opt.compressor)
+                    ts_compress = ThreadSafeCompressor(
+                        opt.compressor, opt.lz4_acceleration
+                    )
                     batch_limit = opt.batch_size
 
                     def _comp_one(item):
@@ -775,7 +955,9 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
                                 continue
                             seen.add(digest)
                             todo.append((digest, view))
+                    _ts = _pc()
                     list(pool.map(_comp_one, todo))
+                    _t_spec += _pc() - _ts
 
         for i, (tag, meta, off, size) in enumerate(plan):
             view = raw[off : off + size]
@@ -784,7 +966,9 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
                 continue
             chunks = file_chunks.get(i)
             if chunks is None:
+                _tc = _pc()
                 chunks = shared_chunker.chunk_whole(view)
+                _t_chunk += _pc() - _tc
             if chunks and chunks[0][1] is not None:
                 _process(
                     [(meta, c) for c, _ in chunks],
@@ -794,8 +978,10 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
             else:
                 for chunk, digest in chunks:
                     _add_chunk(meta, chunk, digest)
+    _t2 = _pc()
     _drain_all()
     section.finish()
+    _t3 = _pc()
 
     blob_size = section.coff
     blob_id = section.hasher.hexdigest() if blob_size else ""
@@ -935,6 +1121,15 @@ def pack_stream(dest: BinaryIO, src_tar: "BinaryIO | bytes", opt: PackOption, ch
     toc_bytes = toc.pack_toc(toc_entries)
     out.write(toc_bytes)
     out.write(nydus_tar.make_header(toc.ENTRY_BLOB_TOC, len(toc_bytes)))
+
+    if stats is not None:
+        stats["scan"] = stats.get("scan", 0.0) + (_t1 - _t0)
+        stats["chunk_digest"] = stats.get("chunk_digest", 0.0) + _t_chunk
+        stats["dedup"] = stats.get("dedup", 0.0) + (
+            _t2 - _t1 - _t_chunk - _t_spec
+        )
+        stats["assemble"] = stats.get("assemble", 0.0) + (_t3 - _t2) + _t_spec
+        stats["bootstrap"] = stats.get("bootstrap", 0.0) + (_pc() - _t3)
 
     from nydus_snapshotter_tpu.converter.convert import PackResult
 

@@ -31,6 +31,11 @@ class PackOption:
     # deliberate speed-over-ratio choice (zstd opts into better ratio at
     # ~2x the pack cost).
     compressor: str = "lz4_block"  # "none" | "zstd" | "lz4_block"
+    # LZ4 acceleration (liblz4 LZ4_compress_fast): 1 = default-codec
+    # output (max ratio); each step up trades ratio for speed (~linear).
+    # Deterministic for a fixed value, so parallel/serial/native arms all
+    # produce identical bytes.
+    lz4_acceleration: int = 1
     oci_ref: bool = False
     aligned_chunk: bool = False
     chunk_size: int = constants.CHUNK_SIZE_DEFAULT
@@ -54,6 +59,10 @@ class PackOption:
             raise ConvertError(f"invalid fs version {self.fs_version!r}")
         if self.compressor not in ("none", "zstd", "lz4_block"):
             raise ConvertError(f"unsupported compressor {self.compressor!r}")
+        if not 1 <= self.lz4_acceleration <= 65537:
+            raise ConvertError(
+                f"lz4 acceleration {self.lz4_acceleration} out of range [1, 65537]"
+            )
         cs = self.chunk_size
         if cs & (cs - 1) or not (constants.CHUNK_SIZE_MIN <= cs <= constants.CHUNK_SIZE_MAX):
             raise ConvertError(

@@ -159,13 +159,26 @@ def entry_from_tarinfo(
         for k, v in (info.pax_headers or {}).items()
         if k.startswith("SCHILY.xattr.")
     }
+    try:
+        # RAFS stores mtime as u64; a pre-epoch (negative, GNU base-256)
+        # tar mtime clamps to the epoch rather than crashing serialization.
+        mtime = max(0, int(info.mtime))
+        if mtime > 0xFFFF_FFFF_FFFF_FFFF:
+            raise ValueError("mtime exceeds u64")
+    except (ValueError, OverflowError) as exc:
+        # pax can smuggle nan/inf/1e300 through float(); surface the
+        # documented conversion error type instead of a bare
+        # ValueError/struct.error downstream.
+        from nydus_snapshotter_tpu.converter.types import ConvertError
+
+        raise ConvertError(
+            f"tar member {path!r} has invalid mtime {info.mtime!r}"
+        ) from exc
     e = FileEntry(
         path=path,
         uid=info.uid,
         gid=info.gid,
-        # RAFS stores mtime as u64; a pre-epoch (negative, GNU base-256)
-        # tar mtime clamps to the epoch rather than crashing serialization.
-        mtime=max(0, int(info.mtime)),
+        mtime=mtime,
         xattrs=xattrs,
     )
     perm = info.mode & 0o7777

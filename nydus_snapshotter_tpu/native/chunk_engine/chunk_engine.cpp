@@ -13,6 +13,12 @@
 #include <cstdlib>
 #include <cstring>
 
+#include <atomic>
+#include <thread>
+#include <vector>
+
+#include <dlfcn.h>
+
 #include "sha256.h"
 
 #if defined(__x86_64__) || defined(_M_X64)
@@ -361,6 +367,26 @@ inline int64_t find_first_set(const uint64_t *bm, int64_t lo, int64_t hi) {
   }
 }
 
+// ---- LZ4 block codec (dlopen'd system liblz4; absent -> caller falls
+// back to its Python codec path) --------------------------------------
+
+typedef int (*lz4_fast_fn)(const char *, char *, int, int, int);
+
+lz4_fast_fn load_lz4(void) {
+  static lz4_fast_fn fn = [] {
+    void *h = dlopen("liblz4.so.1", RTLD_NOW);
+    if (h == nullptr) h = dlopen("liblz4.so", RTLD_NOW);
+    if (h == nullptr) return (lz4_fast_fn) nullptr;
+    return (lz4_fast_fn)dlsym(h, "LZ4_compress_fast");
+  }();
+  return fn;
+}
+
+// LZ4_compressBound, computable without the library.
+inline int64_t lz4_bound(int64_t n) { return n + n / 255 + 16; }
+
+constexpr int64_t LZ4_MAX_INPUT = 0x7E000000;
+
 }  // namespace
 
 extern "C" {
@@ -610,6 +636,125 @@ int64_t ntpu_chunk_digest(const uint8_t *data, int64_t n,
     std::free(ext);
   }
   return n_cuts;
+}
+
+// Fused blob-section assembly: the per-chunk compress -> append -> hash
+// loop of the data section in one native pass (the reference keeps this
+// whole loop inside one `nydus-image create` process,
+// pkg/converter/tool/builder.go:148-178; re-entering Python per chunk was
+// ~80% of full-path wall time).
+//
+// extents: m (src, off, size) i64 triples — src 0 reads from src0 (the
+// caller's tar buffer, zero-copy), src 1 from src1 (loose bytes the
+// caller staged). compressor: 0 = store raw, 1 = LZ4 block (accel >= 1;
+// 1 == LZ4_compress_default output). Chunks land back-to-back in out
+// (no alignment padding — the caller gates on that layout);
+// comp_extents gets (coff, csize) per chunk; blob_digest32 (nullable)
+// gets SHA-256 of the assembled section. n_threads > 1 compresses
+// chunks in parallel into a bound-spaced scratch then compacts —
+// output bytes are identical to the serial pass.
+//
+// Returns the section size, -1 on overflow/allocation/compress failure,
+// -2 when compressor needs liblz4 and it is unavailable.
+int64_t ntpu_pack_section(const uint8_t *src0, const uint8_t *src1,
+                          const int64_t *extents, int64_t m,
+                          int64_t compressor, int64_t accel,
+                          int64_t n_threads, uint8_t *out, int64_t out_cap,
+                          int64_t *comp_extents, uint8_t *blob_digest32) {
+  lz4_fast_fn lz4 = nullptr;
+  if (compressor == 1) {
+    lz4 = load_lz4();
+    if (lz4 == nullptr) return -2;
+  }
+  if (accel < 1) accel = 1;
+  int64_t coff = 0;
+  if (m > 0 && n_threads <= 1) {
+    for (int64_t j = 0; j < m; ++j) {
+      const uint8_t *base = extents[3 * j] == 0 ? src0 : src1;
+      const int64_t off = extents[3 * j + 1];
+      const int64_t size = extents[3 * j + 2];
+      int64_t csize;
+      if (compressor == 1) {
+        if (size > LZ4_MAX_INPUT || coff + lz4_bound(size) > out_cap)
+          return -1;
+        csize = lz4((const char *)(base + off), (char *)(out + coff),
+                    (int)size, (int)(out_cap - coff > LZ4_MAX_INPUT
+                                         ? LZ4_MAX_INPUT
+                                         : out_cap - coff),
+                    (int)accel);
+        if (csize <= 0) return -1;
+      } else {
+        if (coff + size > out_cap) return -1;
+        std::memcpy(out + coff, base + off, (size_t)size);
+        csize = size;
+      }
+      comp_extents[2 * j] = coff;
+      comp_extents[2 * j + 1] = csize;
+      coff += csize;
+    }
+  } else if (m > 0) {
+    // Parallel arm: workers compress straight into out at bound-spaced
+    // offsets (the caller allocates out to exactly this sum of bounds),
+    // then a serial pass compacts left in place — coff <= pre[j] always
+    // (every predecessor's csize <= its bound), so memmove suffices and
+    // no scratch allocation or second buffer is needed.
+    std::vector<int64_t> pre((size_t)m);
+    int64_t acc = 0;
+    for (int64_t j = 0; j < m; ++j) {
+      const int64_t size = extents[3 * j + 2];
+      if (size > LZ4_MAX_INPUT) return -1;
+      pre[(size_t)j] = acc;
+      acc += compressor == 1 ? lz4_bound(size) : size;
+    }
+    if (acc > out_cap) return -1;
+    std::atomic<int64_t> next{0};
+    std::atomic<bool> failed{false};
+    auto worker = [&]() {
+      constexpr int64_t GRAB = 32;  // chunks per work grab
+      for (;;) {
+        int64_t j = next.fetch_add(GRAB);
+        if (j >= m || failed.load(std::memory_order_relaxed)) return;
+        const int64_t jend = j + GRAB < m ? j + GRAB : m;
+        for (; j < jend; ++j) {
+          const uint8_t *base = extents[3 * j] == 0 ? src0 : src1;
+          const int64_t off = extents[3 * j + 1];
+          const int64_t size = extents[3 * j + 2];
+          int64_t csize;
+          if (compressor == 1) {
+            csize = lz4((const char *)(base + off),
+                        (char *)(out + pre[(size_t)j]), (int)size,
+                        (int)lz4_bound(size), (int)accel);
+            if (csize <= 0) {
+              failed.store(true, std::memory_order_relaxed);
+              return;
+            }
+          } else {
+            std::memcpy(out + pre[(size_t)j], base + off, (size_t)size);
+            csize = size;
+          }
+          comp_extents[2 * j + 1] = csize;
+        }
+      }
+    };
+    std::vector<std::thread> pool;
+    const int64_t nt = n_threads < m ? n_threads : m;
+    for (int64_t t = 1; t < nt; ++t) pool.emplace_back(worker);
+    worker();
+    for (auto &th : pool) th.join();
+    if (failed.load()) return -1;
+    for (int64_t j = 0; j < m; ++j) {
+      const int64_t csize = comp_extents[2 * j + 1];
+      if (coff != pre[(size_t)j])
+        std::memmove(out + coff, out + pre[(size_t)j], (size_t)csize);
+      comp_extents[2 * j] = coff;
+      coff += csize;
+    }
+  }
+  if (blob_digest32 != nullptr) {
+    const int64_t ext[2] = {0, coff};
+    ntpu_sha::sha256_extents(out, ext, 1, blob_digest32);
+  }
+  return coff;
 }
 
 }  // extern "C"

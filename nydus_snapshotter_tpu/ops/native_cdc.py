@@ -108,6 +108,15 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_void_p,  # data, extents (i64 pairs)
                 ctypes.c_int64, ctypes.c_void_p,   # m, digests_out
             ]
+        if hasattr(lib, "ntpu_pack_section"):
+            lib.ntpu_pack_section.restype = ctypes.c_int64
+            lib.ntpu_pack_section.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p,  # src0, src1
+                ctypes.c_void_p, ctypes.c_int64,   # extents (i64 triples), m
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # comp, accel, threads
+                ctypes.c_void_p, ctypes.c_int64,   # out, out_cap
+                ctypes.c_void_p, ctypes.c_void_p,  # comp_extents, blob_digest32
+            ]
         _lib = lib
         return _lib
 
@@ -206,6 +215,58 @@ def sha256_many_native(data: np.ndarray, extents: np.ndarray) -> bytes:
     out = np.empty(m * 32, dtype=np.uint8)
     lib.ntpu_sha256_many(arr.ctypes.data, ext.ctypes.data, m, out.ctypes.data)
     return out.tobytes()
+
+
+def pack_section_available() -> bool:
+    """The fused blob-section assembly arm (compress + append + hash)."""
+    lib = load()
+    return lib is not None and hasattr(lib, "ntpu_pack_section")
+
+
+def pack_section(
+    src0: np.ndarray,
+    src1: np.ndarray,
+    extents: np.ndarray,
+    compressor: int,
+    accel: int = 1,
+    n_threads: int = 1,
+) -> "tuple[np.ndarray, np.ndarray, bytes] | None":
+    """Assemble the blob data section in one native pass.
+
+    extents: i64[m, 3] of (src, off, size) — src 0 slices src0 (the tar
+    buffer, zero-copy), src 1 slices src1 (staged loose bytes).
+    compressor: 0 = store raw, 1 = LZ4 block (accel 1 == liblz4 default
+    output, byte-identical to utils.lz4.compress_block). Returns
+    (section_bytes, comp_extents i64[m, 2] of (coff, csize),
+    sha256_of_section) — or None when the native arm cannot run
+    (library/liblz4 missing), in which case the caller uses its Python
+    codec loop; both paths produce identical bytes.
+    """
+    lib = load()
+    if lib is None or not hasattr(lib, "ntpu_pack_section"):
+        return None
+    ext = np.ascontiguousarray(extents, dtype=np.int64)
+    m = ext.shape[0]
+    if m == 0:
+        return np.empty(0, dtype=np.uint8), np.empty((0, 2), dtype=np.int64), b""
+    sizes = ext[:, 2]
+    cap = int((sizes + sizes // 255 + 16).sum()) if compressor == 1 else int(sizes.sum())
+    out = np.empty(max(cap, 1), dtype=np.uint8)
+    comp = np.empty((m, 2), dtype=np.int64)
+    digest = np.empty(32, dtype=np.uint8)
+    total = lib.ntpu_pack_section(
+        src0.ctypes.data if src0.size else None,
+        src1.ctypes.data if src1.size else None,
+        ext.ctypes.data, m,
+        compressor, accel, max(1, n_threads),
+        out.ctypes.data, out.size,
+        comp.ctypes.data, digest.ctypes.data,
+    )
+    if total == -2:
+        return None  # liblz4 unavailable: caller's codec path takes over
+    if total < 0:
+        raise RuntimeError("native pack_section failed (overflow or OOM)")
+    return out[:total], comp, digest.tobytes()
 
 
 def dict_build_available() -> bool:

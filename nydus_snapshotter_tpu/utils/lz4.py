@@ -55,6 +55,14 @@ def _wrap(lib):
         ctypes.c_int,
     ]
     lib.LZ4_compress_default.restype = ctypes.c_int
+    lib.LZ4_compress_fast.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.LZ4_compress_fast.restype = ctypes.c_int
     lib.LZ4_decompress_safe.argtypes = [
         ctypes.c_char_p,
         ctypes.c_char_p,
@@ -80,11 +88,13 @@ def native_available() -> bool:
     return _lib is not None
 
 
-def compress_block(data: "bytes | bytearray | memoryview") -> bytes:
+def compress_block(data: "bytes | bytearray | memoryview", accel: int = 1) -> bytes:
     """LZ4 block compress (no frame header, like nydus per-chunk blocks).
 
     Accepts any contiguous buffer (memoryview chunk slices from the
-    streaming packer compress without a bytes() copy).
+    streaming packer compress without a bytes() copy). ``accel`` > 1 maps
+    to LZ4_compress_fast (accel 1 is bit-identical to the default codec);
+    the pure-Python fallback ignores it (literals-only either way).
     """
     size = len(data)
     if size > _MAX_BLOCK:
@@ -107,9 +117,12 @@ def compress_block(data: "bytes | bytearray | memoryview") -> bytes:
     if dst is None or ctypes.sizeof(dst) < bound:
         dst = ctypes.create_string_buffer(max(bound, 1 << 20))
         _tls.scratch = dst
-    n = _lib.LZ4_compress_default(src, dst, size, bound)
+    if accel > 1:
+        n = _lib.LZ4_compress_fast(src, dst, size, bound, accel)
+    else:
+        n = _lib.LZ4_compress_default(src, dst, size, bound)
     if n <= 0:
-        raise LZ4Error(f"LZ4_compress_default failed on {size}-byte block")
+        raise LZ4Error(f"LZ4 compress failed on {size}-byte block")
     return ctypes.string_at(dst, n)
 
 

@@ -260,6 +260,85 @@ def test_pax_xattrs_still_roundtrip():
     assert ino.xattrs.get("security.capability") == payload
 
 
+def _patch_size_base256(raw: bytes, value: int) -> bytes:
+    """Rewrite the first member's size field as GNU base-256 and fix the
+    checksum — tarfile never writes a negative size, so craft it."""
+    buf = bytearray(raw)
+    buf[124:136] = tarfile.itn(value, 12, tarfile.GNU_FORMAT)
+    buf[148:156] = b" " * 8
+    buf[148:156] = ("%06o\0 " % sum(buf[0:512])).encode("ascii")
+    return bytes(buf)
+
+
+def test_negative_size_base256_bails():
+    """A crafted base-256 negative size would stop the scan position from
+    advancing (infinite loop); the scanner must bail to tarfile."""
+    ti = tarfile.TarInfo("evil")
+    ti.size = 4
+    raw = _patch_size_base256(_mk_tar([(ti, b"data")]), -512)
+    assert _fast_tar_members(memoryview(raw)) is None
+
+
+def test_negative_pax_size_override_rejected():
+    """A negative pax 'size' record must be rejected outright — bailing to
+    tarfile would silently drop the member AND everything after it (a
+    data-losing but 'valid' image)."""
+    from nydus_snapshotter_tpu.converter.types import ConvertError
+
+    ti = tarfile.TarInfo("evil")
+    ti.size = 4
+    ti.pax_headers = {"size": "-512"}
+    ok = tarfile.TarInfo("ok")
+    ok.size = 4
+    raw = _mk_tar([(ti, b"data"), (ok, b"good")], pax=True)
+    with pytest.raises(ConvertError):
+        _fast_tar_members(memoryview(raw))
+    with pytest.raises(ConvertError):
+        pack_layer(raw, PackOption(chunk_size=0x10000))
+
+
+def test_huge_finite_pax_mtime_is_convert_error():
+    """mtime=1e300 passes isfinite and int() but overflows the u64 RAFS
+    field — must surface ConvertError, not struct.error."""
+    from nydus_snapshotter_tpu.converter.types import ConvertError
+
+    ti = tarfile.TarInfo("evil")
+    ti.size = 4
+    ti.pax_headers = {"mtime": "1e300"}
+    raw = _mk_tar([(ti, b"data")], pax=True)
+    with pytest.raises(ConvertError):
+        pack_layer(raw, PackOption(chunk_size=0x10000))
+
+
+def test_malformed_devnum_bails():
+    """Garbage devmajor on a chardev member: scanner bails (no bare
+    ValueError) and the tarfile path owns the verdict."""
+    ti = tarfile.TarInfo("dev/weird")
+    ti.type = tarfile.CHRTYPE
+    ti.devmajor = 1
+    ti.devminor = 3
+    raw = bytearray(_mk_tar([(ti, None)]))
+    raw[329:336] = b"zzzzzzz"  # devmajor field
+    raw[148:156] = b" " * 8
+    raw[148:156] = ("%06o\0 " % sum(raw[0:512])).encode("ascii")
+    assert _fast_tar_members(memoryview(bytes(raw))) is None
+
+
+def test_nonfinite_pax_mtime_is_convert_error():
+    """A pax mtime of nan/inf must not escape as a bare ValueError: the
+    scanner bails, and the tarfile fallback surfaces ConvertError."""
+    from nydus_snapshotter_tpu.converter.types import ConvertError
+
+    for val in ("nan", "inf"):
+        ti = tarfile.TarInfo("evil")
+        ti.size = 4
+        ti.pax_headers = {"mtime": val}
+        raw = _mk_tar([(ti, b"data")], pax=True)
+        assert _fast_tar_members(memoryview(raw)) is None
+        with pytest.raises(ConvertError):
+            pack_layer(raw, PackOption(chunk_size=0x10000))
+
+
 if __name__ == "__main__":
     import sys
 

@@ -154,3 +154,81 @@ class TestFusedChunkDigest:
         assert [[(m.offset, m.size, m.digest) for m in f] for f in got] == [
             [(m.offset, m.size, m.digest) for m in f] for f in want
         ]
+
+
+@pytest.mark.skipif(
+    not native_cdc.pack_section_available(), reason="pack_section arm not built"
+)
+class TestPackSection:
+    """Fused blob-section assembly (ntpu_pack_section)."""
+
+    def _mk(self):
+        rng = np.random.default_rng(91)
+        src0 = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+        src0[: 1 << 18] = 0x61  # compressible run
+        src1 = rng.integers(0, 256, 8000, dtype=np.uint8)
+        ext, off = [], 0
+        while off + 70000 < src0.size:
+            n = int(rng.integers(1, 70000))
+            ext.append((0, off, n))
+            off += n
+        ext.append((1, 100, 4000))
+        return src0, src1, np.asarray(ext, dtype=np.int64)
+
+    def test_lz4_matches_python_codec(self):
+        from nydus_snapshotter_tpu.utils import lz4
+
+        if not lz4.native_available():
+            pytest.skip("liblz4 missing")
+        src0, src1, ext = self._mk()
+        res = native_cdc.pack_section(src0, src1, ext, compressor=1)
+        assert res is not None
+        blob, cext, dig = res
+        want = b"".join(
+            lz4.compress_block(memoryview((src0 if s == 0 else src1).data)[o : o + n])
+            for s, o, n in ext
+        )
+        assert blob.tobytes() == want
+        assert dig == hashlib.sha256(want).digest()
+        # extents tile the section exactly
+        assert int(cext[0, 0]) == 0
+        assert (cext[1:, 0] == cext[:-1, 0] + cext[:-1, 1]).all()
+        assert int(cext[-1, 0] + cext[-1, 1]) == blob.size
+
+    def test_threaded_equals_serial(self):
+        src0, src1, ext = self._mk()
+        for comp in (0, 1):
+            a = native_cdc.pack_section(src0, src1, ext, comp, 1, 1)
+            b = native_cdc.pack_section(src0, src1, ext, comp, 1, 4)
+            if a is None or b is None:
+                assert comp == 1
+                continue
+            assert a[0].tobytes() == b[0].tobytes()
+            assert (a[1] == b[1]).all()
+            assert a[2] == b[2]
+
+    def test_raw_mode_concatenates(self):
+        src0, src1, ext = self._mk()
+        res = native_cdc.pack_section(src0, src1, ext, compressor=0)
+        assert res is not None
+        blob, cext, dig = res
+        want = b"".join(
+            bytes(memoryview((src0 if s == 0 else src1).data)[o : o + n])
+            for s, o, n in ext
+        )
+        assert blob.tobytes() == want and dig == hashlib.sha256(want).digest()
+
+    def test_accel_roundtrips(self):
+        from nydus_snapshotter_tpu.utils import lz4
+
+        if not lz4.native_available():
+            pytest.skip("liblz4 missing")
+        src0, src1, ext = self._mk()
+        res = native_cdc.pack_section(src0, src1, ext, compressor=1, accel=8)
+        assert res is not None
+        blob, cext, _ = res
+        raw = blob.tobytes()
+        for (s, o, n), (co, cs) in zip(ext.tolist(), res[1].tolist()):
+            got = lz4.decompress_block(raw[co : co + cs], n)
+            src = src0 if s == 0 else src1
+            assert got == src[o : o + n].tobytes()

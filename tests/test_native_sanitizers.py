@@ -85,6 +85,28 @@ ans = native_cdc.dict_probe_native(
     q, keys.reshape(-1, 8), values.reshape(-1), 4, keys.shape[1], MAX_PROBE
 )
 assert (ans[:500] == np.arange(500)).all()
+
+# Fused blob-section assembly: serial vs threaded identity, raw + lz4,
+# two-source extents, edge sizes (empty list, 1-byte, tile-edge chunks).
+src0 = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+src0[: 1 << 18] = 0x41  # compressible run
+src1 = rng.integers(0, 256, 4096, dtype=np.uint8)
+ext = [(0, 0, 1), (0, 1, 55), (0, 56, 65536), (1, 0, 4096), (0, 65592, 200000)]
+ext = np.asarray(ext, dtype=np.int64)
+for comp in (0, 1):
+    outs = []
+    for nt in (1, 3):
+        res = native_cdc.pack_section(src0, src1, ext, comp, 1, nt)
+        if res is None:
+            assert comp == 1  # liblz4 absent is legal only for lz4
+            continue
+        blob, cext, dig = res
+        assert dig == hashlib.sha256(blob.tobytes()).digest()
+        assert int(cext[-1, 0] + cext[-1, 1]) == blob.size
+        outs.append(blob.tobytes())
+    assert len(set(outs)) <= 1  # threaded == serial
+empty = native_cdc.pack_section(src0, src1, np.empty((0, 3), np.int64), 1, 1, 1)
+assert empty is None or empty[0].size == 0
 print("SANITIZED-ENGINE-OK")
 """
 

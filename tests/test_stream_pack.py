@@ -170,3 +170,65 @@ assert delta < 160, f"streaming pack used {{delta}} MiB over baseline"
         )
         assert proc.returncode == 0, proc.stderr[-2000:]
         assert "RSS_DELTA_MIB" in proc.stdout
+
+
+class TestDeferredNativeSection:
+    """The one-native-pass blob assembly (_DeferredSectionWriter) must be
+    byte-equivalent to the per-chunk Python section writer in every
+    configuration that activates it."""
+
+    def _layer(self, seed=17, n=30):
+        rng = np.random.default_rng(seed)
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+            for i in range(n):
+                size = int(rng.integers(1, 300_000))
+                ti = tarfile.TarInfo(f"d{i % 5}/f{i}")
+                ti.size = size
+                data = rng.integers(0, 256, size, dtype=np.uint8)
+                if i % 3 == 0:
+                    data[: size // 2] = 0x42  # compressible half
+                tf.addfile(ti, io.BytesIO(data.tobytes()))
+        return buf.getvalue()
+
+    def _python_section_blob(self, raw, opt):
+        """Pack via the streaming (file-like) path, which always uses the
+        per-chunk Python _SectionWriter."""
+        out = io.BytesIO()
+        pack_stream(out, io.BytesIO(raw), opt)
+        return out.getvalue()
+
+    @pytest.mark.parametrize("compressor", ["lz4_block", "none"])
+    def test_identical_to_python_writer(self, compressor):
+        raw = self._layer()
+        opt = PackOption(chunk_size=0x10000, compressor=compressor)
+        blob_fast, _ = pack_layer(raw, opt)
+        assert blob_fast == self._python_section_blob(raw, opt)
+
+    def test_threaded_native_identical(self, monkeypatch):
+        raw = self._layer(seed=23)
+        opt = PackOption(chunk_size=0x10000)
+        monkeypatch.setenv("NTPU_PACK_THREADS", "1")
+        one, _ = pack_layer(raw, opt)
+        monkeypatch.setenv("NTPU_PACK_THREADS", "4")
+        four, _ = pack_layer(raw, opt)
+        assert one == four
+
+    def test_lz4_acceleration_roundtrip(self):
+        raw = self._layer(seed=29)
+        opt = PackOption(chunk_size=0x10000, lz4_acceleration=6)
+        blob, res = pack_layer(raw, opt)
+        # fast (native) and streaming (python) paths agree at accel != 1
+        assert blob == self._python_section_blob(raw, opt)
+        # and the image round-trips
+        from nydus_snapshotter_tpu.converter.convert import bootstrap_from_layer_blob
+
+        bs = bootstrap_from_layer_blob(blob)
+        assert bs.chunks, "expected chunks"
+        from nydus_snapshotter_tpu.converter.types import ConvertError
+
+        try:
+            PackOption(lz4_acceleration=0).validate()
+            raise AssertionError("accel 0 must be rejected")
+        except ConvertError:
+            pass
