@@ -197,3 +197,230 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(pytest.main([__file__, "-q"]))
+
+
+# ---------------------------------------------------------------------------
+# Real bootstraps: FULL inode/chunk-table parse (VERDICT r3 next #2)
+# ---------------------------------------------------------------------------
+
+from nydus_snapshotter_tpu.models.nydus_real import (  # noqa: E402
+    RealBootstrapError,
+    parse_real_bootstrap,
+)
+
+# Ground truth enumerated from the real artifacts themselves and
+# cross-checked between the two independent encodings (same rootfs
+# converted to v5 and v6 by the reference toolchain).
+V5_BLOB = "02fef4a13a311de4adc5b34ca152d3a87c9371c76a5f720451c8b9602859b780"
+V6_BLOB = "cdde6f5645daea414d60bc75611102a8bc8dae6198f087366365d6ff85bf5726"
+N_INODES = 3517
+N_UNIQUE_CHUNKS = 2515
+N_DIRS, N_REGULAR, N_SYMLINKS = 678, 2627, 212
+V5_COMPRESSED, V5_UNCOMPRESSED = 43090887, 77298891
+
+
+class TestRealV5Parse:
+    @pytest.fixture(scope="class")
+    def bs(self):
+        return parse_real_bootstrap(_boot_from("v5-bootstrap-file-size-736032.tar.gz"))
+
+    def test_full_inode_enumeration(self, bs):
+        assert len(bs.inodes) == N_INODES
+        kinds = (
+            sum(1 for i in bs.inodes if i.is_dir),
+            sum(1 for i in bs.inodes if i.is_regular),
+            sum(1 for i in bs.inodes if i.is_symlink),
+        )
+        assert kinds == (N_DIRS, N_REGULAR, N_SYMLINKS)
+        paths = {i.path for i in bs.inodes}
+        # a real Linux rootfs: spot-check well-known paths
+        for p in ("/", "/bin", "/etc", "/var", "/usr"):
+            assert p in paths
+        assert all(p == "/" or p.startswith("/") for p in paths)
+
+    def test_chunk_table_and_blob_accounting(self, bs):
+        assert [b.blob_id for b in bs.blobs] == [V5_BLOB]
+        assert bs.blobs[0].chunk_count == N_UNIQUE_CHUNKS
+        assert bs.blobs[0].compressed_size == V5_COMPRESSED
+        assert bs.blobs[0].uncompressed_size == V5_UNCOMPRESSED
+        uniq = {}
+        for c in bs.chunks:
+            assert len(c.digest) == 32
+            uniq.setdefault(c.compressed_offset, c)
+        assert len(uniq) == N_UNIQUE_CHUNKS
+        assert sum(c.compressed_size for c in uniq.values()) == V5_COMPRESSED
+        assert sum(c.uncompressed_size for c in uniq.values()) == V5_UNCOMPRESSED
+
+    def test_per_file_chunk_runs_tile_file_sizes(self, bs):
+        for i in bs.inodes:
+            if i.is_regular and i.chunks:
+                assert sum(c.uncompressed_size for c in i.chunks) == i.size, i.path
+
+    def test_tree_reconstruction(self, bs):
+        tree = bs.tree()
+        assert isinstance(tree["etc"], dict)
+        # usrmerge rootfs: /bin is a symlink to usr/bin
+        assert tree["bin"].is_symlink and tree["bin"].symlink_target == "usr/bin"
+        node = tree["etc"]["adduser.conf"]
+        assert node.is_regular and node.size == 3028
+        assert len(node.chunks) == 1 and node.chunks[0].compressed_size == 2017
+
+
+class TestRealV6Parse:
+    @pytest.fixture(scope="class")
+    def bs(self):
+        return parse_real_bootstrap(_boot_from("v6-bootstrap-chunk-pos-438272.tar.gz"))
+
+    def test_full_inode_enumeration(self, bs):
+        assert len(bs.inodes) == N_INODES
+        kinds = (
+            sum(1 for i in bs.inodes if i.is_dir),
+            sum(1 for i in bs.inodes if i.is_regular),
+            sum(1 for i in bs.inodes if i.is_symlink),
+        )
+        assert kinds == (N_DIRS, N_REGULAR, N_SYMLINKS)
+
+    def test_chunk_table(self, bs):
+        # the fixture's very name pins the chunk table position
+        assert len(bs.chunks) == N_UNIQUE_CHUNKS
+        assert [b.blob_id for b in bs.blobs] == [V6_BLOB]
+        assert bs.blobs[0].chunk_count == N_UNIQUE_CHUNKS
+        # v6 compresses the SAME chunks as v5 (same rootfs, same builder)
+        assert bs.blobs[0].compressed_size == V5_COMPRESSED
+        assert bs.blobs[0].chunk_size == 0x100000
+
+    def test_per_file_chunk_refs_resolve(self, bs):
+        for i in bs.inodes:
+            if i.is_regular and i.chunks:
+                assert sum(c.uncompressed_size for c in i.chunks) == i.size, i.path
+
+    def test_same_rootfs_as_v5(self, bs):
+        v5 = parse_real_bootstrap(_boot_from("v5-bootstrap-file-size-736032.tar.gz"))
+        assert {i.path for i in v5.inodes} == {i.path for i in bs.inodes}
+        m5, m6 = v5.by_path(), bs.by_path()
+        for p in m5:
+            a, b = m5[p], m6[p]
+            assert stat_kind(a.mode) == stat_kind(b.mode), p
+            assert a.size == b.size or not a.is_regular, p
+        # symlink targets agree between the two independent encodings
+        for p in m5:
+            if m5[p].is_symlink:
+                assert m5[p].symlink_target == m6[p].symlink_target, p
+
+
+def stat_kind(mode: int) -> int:
+    import stat as _s
+
+    return _s.S_IFMT(mode)
+
+
+def test_real_unpack_to_tar_structure():
+    bs = parse_real_bootstrap(_boot_from("v6-bootstrap-chunk-pos-438272.tar.gz"))
+    out = io.BytesIO()
+    n = bs.to_tar(out)  # no blob bytes: structure + metadata only
+    assert n == N_INODES - 1  # every inode except the root
+    out.seek(0)
+    with tarfile.open(fileobj=out) as tf:
+        members = {m.name: m for m in tf.getmembers()}
+    assert "etc/adduser.conf" in members
+    assert members["bin"].isdir() or members["bin"].issym()
+    sym = next(m for m in members.values() if m.issym())
+    assert sym.linkname
+
+
+def test_invalid_real_bootstrap_raises():
+    boot = _boot_from("invalid-bootstrap-file-size-133513.tar.gz")
+    with pytest.raises((RealBootstrapError, layout.LayoutError)):
+        parse_real_bootstrap(boot)
+
+
+def test_real_unpack_with_blob_data_roundtrip():
+    """to_tar reconstructs file bytes from blob data: chunks sliced at
+    their compressed extents and lz4-inflated per flags."""
+    from nydus_snapshotter_tpu.models import layout as _layout
+    from nydus_snapshotter_tpu.models.nydus_real import (
+        RealBlob,
+        RealBootstrap,
+        RealChunk,
+        RealInode,
+    )
+    from nydus_snapshotter_tpu.utils import lz4
+
+    import stat as _s
+
+    content = b"A" * 5000 + bytes(range(256)) * 4
+    comp = lz4.compress_block(content)
+    blob = b"\xee" * 7 + comp  # chunk at offset 7
+    chunk = RealChunk(
+        digest=b"\0" * 32,
+        blob_index=0,
+        flags=1,
+        compressed_size=len(comp),
+        uncompressed_size=len(content),
+        compressed_offset=7,
+        uncompressed_offset=0,
+    )
+    ino = RealInode(
+        path="/data.bin", ino=2, mode=_s.S_IFREG | 0o644, size=len(content),
+        chunks=[chunk],
+    )
+    root = RealInode(path="/", ino=1, mode=_s.S_IFDIR | 0o755)
+    bs = RealBootstrap(
+        version=_layout.RAFS_V5,
+        flags=0x2,  # RafsSuperFlags: lz4_block
+        inodes=[root, ino],
+        blobs=[RealBlob(blob_id="aa" * 32)],
+        chunks=[chunk],
+    )
+    assert bs.compressor == "lz4_block"
+    out = io.BytesIO()
+    bs.to_tar(out, blob_data={"aa" * 32: blob})
+    out.seek(0)
+    with tarfile.open(fileobj=out) as tf:
+        assert tf.extractfile("data.bin").read() == content
+
+
+def test_real_v6_hardlinks_become_tar_links():
+    """The committed v6 fixture carries real hardlinks (perl aliases);
+    to_tar must emit LNKTYPE entries, not duplicated file bodies."""
+    bs = parse_real_bootstrap(_boot_from("v6-bootstrap-chunk-pos-438272.tar.gz"))
+    by_ino = {}
+    for i in bs.inodes:
+        if i.is_regular:
+            by_ino.setdefault(i.ino, []).append(i.path)
+    aliases = {k: v for k, v in by_ino.items() if len(v) > 1}
+    assert aliases, "fixture is known to contain hardlinked perl binaries"
+    out = io.BytesIO()
+    bs.to_tar(out)
+    out.seek(0)
+    with tarfile.open(fileobj=out) as tf:
+        members = {m.name: m for m in tf.getmembers()}
+    links = [m for m in members.values() if m.islnk()]
+    assert len(links) == sum(len(v) - 1 for v in aliases.values())
+    for m in links:
+        assert members[m.linkname].isreg()
+
+
+def test_real_parser_corruption_fuzz():
+    """Bit-flipped real bootstraps must raise the domain error quickly —
+    never a bare struct/index crash, never a spinning loop (both were
+    found and fixed by fuzzing; this pins the guards)."""
+    import random
+    import time
+
+    random.seed(0xBAD5EED)
+    for name in (
+        "v5-bootstrap-file-size-736032.tar.gz",
+        "v6-bootstrap-chunk-pos-438272.tar.gz",
+    ):
+        d = _boot_from(name)
+        for _ in range(40):
+            b = bytearray(d)
+            for _k in range(3):
+                b[random.randrange(0, min(len(b), 500_000))] ^= 0xFF
+            t0 = time.time()
+            try:
+                parse_real_bootstrap(bytes(b))
+            except (RealBootstrapError, layout.LayoutError):
+                pass
+            assert time.time() - t0 < 5, "parser spun on corrupt input"
