@@ -350,9 +350,14 @@ class Filesystem:
                 d.shared_mount(rafs, bootstrap, config_json)
             else:
                 rafs.mountpoint = os.path.join(rafs.snapshot_dir, "mnt")
-                d.add_rafs_instance(rafs)
                 if d.state() == DaemonState.UNKNOWN:
                     mgr.start_daemon(d)
+                # The dedicated daemon must actually SERVE its instance,
+                # not just exist: attach via the mount API exactly like
+                # the shared path (the reference's dedicated nydusd gets
+                # its bootstrap on the command line; one API surface here
+                # keeps supervisor state sync + failover replay uniform).
+                d.shared_mount(rafs, bootstrap, config_json)
         elif fs_driver == C.FS_DRIVER_BLOCKDEV:
             if self.tarfs_mgr is None:
                 raise errdefs.Unavailable("tarfs manager is not enabled")

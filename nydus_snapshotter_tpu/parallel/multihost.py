@@ -43,10 +43,13 @@ def runtime(
     coordinator: Optional[str] = None,
     process_id: Optional[int] = None,
     num_processes: Optional[int] = None,
+    init_timeout_s: Optional[int] = None,
 ) -> HostRuntime:
     """Resolve this host's (index, count), initializing jax.distributed
     when a coordinator is configured (args or JAX_COORDINATOR_ADDRESS /
     JAX_PROCESS_ID / JAX_NUM_PROCESSES env), else a single-host view.
+    ``init_timeout_s`` bounds the coordinator join (jax's default retries
+    for 300 s before surfacing an unreachable coordinator).
     """
     coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if coordinator:
@@ -54,9 +57,13 @@ def runtime(
 
         pid = process_id if process_id is not None else int(os.environ.get("JAX_PROCESS_ID", "0"))
         n = num_processes if num_processes is not None else int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+        kwargs = {}
+        if init_timeout_s is not None:
+            kwargs["initialization_timeout"] = init_timeout_s
         try:
             jax.distributed.initialize(
-                coordinator_address=coordinator, num_processes=n, process_id=pid
+                coordinator_address=coordinator, num_processes=n, process_id=pid,
+                **kwargs,
             )
         except RuntimeError as e:
             # Only idempotent re-entry is benign. A genuine join failure

@@ -26,8 +26,10 @@ sharded across the mesh:
   falls back to the dense all_gather+psum path, which is exact for any
   distribution.
 - **Persistence.** ``save``/``load`` round-trip the built table through one
-  ``.npz`` so the dict survives across conversions — the persistent
-  cross-repo dict of BASELINE config #5.
+  raw header+tables file (mmap'd on load — the table is uniform-random u32,
+  where compression bought ~4% for two orders of magnitude of CPU) so the
+  dict survives across conversions — the persistent cross-repo dict of
+  BASELINE config #5. Legacy ``.npz`` saves still load.
 """
 
 from __future__ import annotations
@@ -41,9 +43,17 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from nydus_snapshotter_tpu.parallel import mesh as mesh_lib
 
-MAX_PROBE = 32
+# Longest probe chain the BUILD tolerates before doubling capacity. The
+# probe paths bound their loops by the table's actual max chain
+# (_table_max_depth, persisted with the table), so a deeper tolerance
+# costs probes nothing while halving table bytes whenever chains would
+# have crossed the old 32 bound at the current capacity (observed at the
+# 32M-entry registry scale: 0.48 load factor -> max chain ~40).
+MAX_PROBE = 64
 
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 1  # legacy .npz container (read-only support)
+_RAW_FORMAT_VERSION = 4  # NTPUDICT raw header + dense tables
+_RAW_HEADER_FIELDS = 5  # version, n_shards, n_entries, capacity, max_depth
 
 
 class DictBuildError(RuntimeError):
@@ -142,20 +152,46 @@ def _build_host_tables(
         cap *= 2
 
 
-def _probe_local(k: jax.Array, v: jax.Array, q: jax.Array, cap: int) -> jax.Array:
-    """Probe queries against one shard's table: q u32[M,8] -> i32[M]."""
+def _table_max_depth(keys: np.ndarray, values: np.ndarray) -> int:
+    """Longest probe chain actually present in the built table. The probe
+    only ever needs this many rounds (first-match-in-chain semantics), and
+    it is typically ~4-8 at the 2x capacity factor — bounding the device
+    probe loop by it instead of MAX_PROBE is a direct multiplier on probe
+    throughput."""
+    cap = keys.shape[1]
+    flat_v = values.reshape(-1)
+    occ = flat_v != 0
+    if not occ.any():
+        return 1
+    occ_keys = keys.reshape(-1, 8)[occ]
+    slots = np.nonzero(occ)[0] % cap
+    base = occ_keys[:, 1] & np.uint32(cap - 1)
+    depth = (slots - base) & np.uint32(cap - 1)
+    return int(depth.max()) + 1
+
+
+def _probe_local(
+    k: jax.Array, v: jax.Array, q: jax.Array, cap: int, depth: int = MAX_PROBE
+) -> jax.Array:
+    """Probe queries against one shard's table: q u32[M,8] -> i32[M].
+
+    One fused gather over the whole chain window (u32[M, D, 8]) instead of
+    D sequential row gathers — XLA vectorizes a single big gather far
+    better, and `depth` comes from the table itself (_table_max_depth)."""
     slot0 = q[:, 1] & np.uint32(cap - 1)
-    found = jnp.zeros(q.shape[0], dtype=jnp.int32)
-    for j in range(MAX_PROBE):
-        slot = (slot0 + np.uint32(j)) & np.uint32(cap - 1)
-        cand_keys = k[slot]  # u32[M,8]
-        match = jnp.all(cand_keys == q, axis=1) & (v[slot] != 0)
-        found = jnp.where((found == 0) & match, v[slot], found)
-    return found
+    slots = (slot0[:, None] + np.arange(depth, dtype=np.uint32)) & np.uint32(
+        cap - 1
+    )  # [M, D]
+    cand_keys = k[slots]  # u32[M, D, 8]
+    cand_vals = v[slots]  # i32[M, D]
+    match = jnp.all(cand_keys == q[:, None, :], axis=2) & (cand_vals != 0)
+    hit = jnp.argmax(match, axis=1)  # first True (argmax on bool)
+    found = jnp.take_along_axis(cand_vals, hit[:, None], axis=1)[:, 0]
+    return jnp.where(jnp.any(match, axis=1), found, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("n_shards", "mesh"))
-def _probe_sharded(keys, values, queries, n_shards: int, mesh):
+@functools.partial(jax.jit, static_argnames=("n_shards", "mesh", "depth"))
+def _probe_sharded(keys, values, queries, n_shards: int, mesh, depth: int = MAX_PROBE):
     """Dense fallback probe (all_gather + psum): exact for any query
     distribution; ICI/compute cost O(M·S). queries u32[M,8] -> i32[M]."""
     cap = keys.shape[1]
@@ -166,7 +202,7 @@ def _probe_sharded(keys, values, queries, n_shards: int, mesh):
         shard_id = jax.lax.axis_index(mesh_lib.AXIS_DATA)
         allq = jax.lax.all_gather(q, mesh_lib.AXIS_DATA, tiled=True)  # u32[M,8]
         belongs = (allq[:, 0] % np.uint32(n_shards)) == shard_id.astype(jnp.uint32)
-        found = _probe_local(k, v, allq, cap)
+        found = _probe_local(k, v, allq, cap, depth)
         return jnp.where(belongs, found, 0)
 
     partial_answers = jax.shard_map(
@@ -190,8 +226,8 @@ def _bucket_capacity(m_local: int, n_shards: int) -> int:
     return int(4 * ((m_local + n_shards - 1) // n_shards) + 8)
 
 
-@functools.partial(jax.jit, static_argnames=("n_shards", "mesh"))
-def _probe_routed(keys, values, queries, n_shards: int, mesh):
+@functools.partial(jax.jit, static_argnames=("n_shards", "mesh", "depth"))
+def _probe_routed(keys, values, queries, n_shards: int, mesh, depth: int = MAX_PROBE):
     """all_to_all probe: route each query to its owning shard, answer
     locally, route answers back. Returns (answers i32[M], overflowed bool[S])
     — when any bucket overflowed its capacity the answers are incomplete and
@@ -220,7 +256,7 @@ def _probe_routed(keys, values, queries, n_shards: int, mesh):
         send = send.at[slot].set(payload)[:-1].reshape(n_shards, bucket_cap, 9)
         recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0, tiled=True)
         rq = recv.reshape(-1, 9)
-        found = _probe_local(k, v, rq[:, :8], cap) * rq[:, 8].astype(jnp.int32)
+        found = _probe_local(k, v, rq[:, :8], cap, depth) * rq[:, 8].astype(jnp.int32)
         back = jax.lax.all_to_all(
             found.reshape(n_shards, bucket_cap), axis, split_axis=0, concat_axis=0, tiled=True
         ).reshape(-1)
@@ -251,7 +287,7 @@ class ShardedChunkDict:
         capacity_factor: float = 2.0,
         probe_backend: str = "auto",
     ):
-        if probe_backend not in ("auto", "device", "host"):
+        if probe_backend not in ("auto", "device", "host", "pallas"):
             raise ValueError(f"unknown probe backend {probe_backend!r}")
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.n_shards = int(np.prod(list(self.mesh.shape.values())))
@@ -261,15 +297,28 @@ class ShardedChunkDict:
         keys, values = _build_host_tables(digests_u32, self.n_shards, capacity_factor)
         self._put_tables(keys, values)
 
-    def _put_tables(self, keys: np.ndarray, values: np.ndarray) -> None:
+    def _put_tables(
+        self, keys: np.ndarray, values: np.ndarray, max_depth: "int | None" = None
+    ) -> None:
         self.capacity = keys.shape[1]
-        # Host copies back the native probe arm (and save()); the device
-        # copies serve the sharded all_to_all probe.
+        self.max_depth = (
+            max_depth if max_depth is not None else _table_max_depth(keys, values)
+        )
+        # Host arrays back the native probe arm and save(); the device
+        # copies serve the sharded all_to_all probe and are staged LAZILY —
+        # the single-chip host-probe default (and an mmap'd load()) must
+        # not pay a full-table device transfer it never uses.
         self._host_keys = np.ascontiguousarray(keys, dtype=np.uint32)
         self._host_values = np.ascontiguousarray(values, dtype=np.int32)
-        shard_sharding = NamedSharding(self.mesh, PartitionSpec(mesh_lib.AXIS_DATA))
-        self._keys = jax.device_put(keys, shard_sharding)
-        self._values = jax.device_put(values, shard_sharding)
+        self._keys = None
+        self._values = None
+
+    def _device_tables(self):
+        if self._keys is None:
+            shard_sharding = NamedSharding(self.mesh, PartitionSpec(mesh_lib.AXIS_DATA))
+            self._keys = jax.device_put(self._host_keys, shard_sharding)
+            self._values = jax.device_put(self._host_values, shard_sharding)
+        return self._keys, self._values
 
     def _use_host_probe(self) -> bool:
         """Crossover policy: the device probe exists for dicts sharded over a
@@ -280,32 +329,73 @@ class ShardedChunkDict:
 
         if self.probe_backend == "host":
             return True
-        if self.probe_backend == "device":
+        if self.probe_backend in ("device", "pallas"):
             return False
         return self.n_shards == 1 and native_cdc.dict_probe_available()
 
     # -- persistence --------------------------------------------------------
+    #
+    # Dense raw format: fixed header (incl. max_depth, so loading never
+    # rescans the table) + both tables as raw bytes. The table is
+    # uniform-random u32 (SHA words) — compression buys ~4% for two
+    # orders of magnitude of CPU (np.savez_compressed measured 158 s
+    # save / 78 s load on the 32M-entry table, REGISTRY_SCALE r3). Save
+    # is one sequential disk-bound write; load is an mmap whose pages
+    # fault in as probes touch them. Legacy .npz files (format 1) still
+    # load.
+
+    _RAW_MAGIC = b"NTPUDICT"
 
     def save(self, path: str) -> None:
         """Persist the built table (reload with ``load`` — no rebuild)."""
-        np.savez_compressed(
-            path,
-            format_version=_FORMAT_VERSION,
-            n_shards=self.n_shards,
-            n_entries=self.n_entries,
-            keys=np.asarray(jax.device_get(self._keys)),
-            values=np.asarray(jax.device_get(self._values)),
-        )
+        header = self._RAW_MAGIC + np.asarray(
+            [_RAW_FORMAT_VERSION, self.n_shards, self.n_entries,
+             self.capacity, self.max_depth],
+            dtype=np.uint64,
+        ).tobytes()
+        with open(path, "wb") as f:
+            f.write(header)
+            self._host_keys.tofile(f)
+            self._host_values.tofile(f)
 
     @classmethod
     def load(cls, path: str, mesh=None, probe_backend: str = "auto") -> "ShardedChunkDict":
-        with np.load(path) as z:
-            if int(z["format_version"]) != _FORMAT_VERSION:
+        import os as _os
+
+        with open(path, "rb") as f:
+            magic = f.read(8)
+        if magic == cls._RAW_MAGIC:
+            hdr = np.fromfile(
+                path, dtype=np.uint64, count=_RAW_HEADER_FIELDS, offset=8
+            )
+            if len(hdr) != _RAW_HEADER_FIELDS:
+                raise DictBuildError("chunk dict file truncated (short header)")
+            version, n_shards, n_entries, cap, max_depth = (int(x) for x in hdr)
+            if version != _RAW_FORMAT_VERSION:
                 raise DictBuildError(
-                    f"chunk dict file format {int(z['format_version'])} != {_FORMAT_VERSION}"
+                    f"chunk dict file format {version} != {_RAW_FORMAT_VERSION}"
                 )
-            keys, values = z["keys"], z["values"]
-            n_shards, n_entries = int(z["n_shards"]), int(z["n_entries"])
+            base = 8 + 8 * _RAW_HEADER_FIELDS
+            if _os.path.getsize(path) < base + n_shards * cap * 36:
+                raise DictBuildError("chunk dict file truncated")
+            keys = np.memmap(
+                path, dtype=np.uint32, mode="r", offset=base,
+                shape=(n_shards, cap, 8),
+            )
+            values = np.memmap(
+                path, dtype=np.int32, mode="r",
+                offset=base + keys.nbytes, shape=(n_shards, cap),
+            )
+            loaded_depth = max_depth
+        else:
+            with np.load(path) as z:
+                if int(z["format_version"]) != _FORMAT_VERSION:
+                    raise DictBuildError(
+                        f"chunk dict file format {int(z['format_version'])} != {_FORMAT_VERSION}"
+                    )
+                keys, values = z["keys"], z["values"]
+                n_shards, n_entries = int(z["n_shards"]), int(z["n_entries"])
+            loaded_depth = None  # legacy files carry no depth: rescan
         self = cls.__new__(cls)
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         self.n_shards = int(np.prod(list(self.mesh.shape.values())))
@@ -326,7 +416,7 @@ class ShardedChunkDict:
             self._put_tables(k2, orig[v2.reshape(-1)].reshape(v2.shape))
             return self
         self.n_entries = n_entries
-        self._put_tables(keys, values)
+        self._put_tables(keys, values, max_depth=loaded_depth)
         return self
 
     # -- probing ------------------------------------------------------------
@@ -345,8 +435,10 @@ class ShardedChunkDict:
             return native_cdc.dict_probe_native(
                 queries_u32, self._host_keys.reshape(-1, 8),
                 self._host_values.reshape(-1),
-                self.n_shards, self.capacity, MAX_PROBE,
+                self.n_shards, self.capacity, self.max_depth,
             )
+        if self.probe_backend == "pallas":
+            return self._lookup_pallas(queries_u32)
         # Route unique queries only: duplicates would concentrate buckets
         # (and waste probe work); uniqueness restores the uniform digest
         # distribution the bucket capacity is sized for.
@@ -354,6 +446,33 @@ class ShardedChunkDict:
         _, first, inverse = np.unique(void, return_index=True, return_inverse=True)
         uniq_ans = self._lookup_unique(queries_u32[first])
         return uniq_ans[inverse]
+
+    def _lookup_pallas(self, queries_u32: np.ndarray) -> np.ndarray:
+        """Single-host DMA-pipelined device probe (ops/probe_pallas): the
+        TPU-native replacement for the XLA gather (VERDICT r3 next #4) —
+        the table stays in HBM, each query's chain window is DMA'd into
+        VMEM with pipelined copies. Queries are partitioned by owning
+        shard host-side; each shard's table is probed in one kernel
+        launch. Falls back to interpret mode off-TPU (correctness path)."""
+        from nydus_snapshotter_tpu.ops import probe_pallas
+
+        interpret = not probe_pallas.supported()
+        m = len(queries_u32)
+        shard_of = queries_u32[:, 0] % np.uint32(self.n_shards)
+        out = np.zeros(m, dtype=np.int64)
+        for s in range(self.n_shards):
+            idx = np.nonzero(shard_of == s)[0]
+            if not len(idx):
+                continue
+            ans = probe_pallas.probe(
+                self._host_keys[s],
+                self._host_values[s],
+                queries_u32[idx],
+                self.max_depth,
+                interpret=interpret,
+            )
+            out[idx] = ans.astype(np.int64)
+        return out - 1
 
     def _lookup_unique(self, queries_u32: np.ndarray) -> np.ndarray:
         m = len(queries_u32)
@@ -365,11 +484,14 @@ class ShardedChunkDict:
         q = jax.device_put(
             queries_u32, NamedSharding(self.mesh, PartitionSpec(mesh_lib.AXIS_DATA))
         )
+        dkeys, dvalues = self._device_tables()
         ans, overflowed = _probe_routed(
-            self._keys, self._values, q, self.n_shards, self.mesh
+            dkeys, dvalues, q, self.n_shards, self.mesh, self.max_depth
         )
         if bool(np.any(np.asarray(jax.device_get(overflowed)))):
-            ans = _probe_sharded(self._keys, self._values, q, self.n_shards, self.mesh)
+            ans = _probe_sharded(
+                dkeys, dvalues, q, self.n_shards, self.mesh, self.max_depth
+            )
         ans = np.asarray(jax.device_get(ans))[:m]
         return ans.astype(np.int64) - 1
 

@@ -86,8 +86,9 @@ class TestShardedDict:
         if pad:
             q = np.concatenate([q, np.zeros((pad, 8), np.uint32)])
         qd = jax.device_put(q, NamedSharding(mesh, PartitionSpec(mesh_lib.AXIS_DATA)))
-        dense = np.asarray(_probe_sharded(sdict._keys, sdict._values, qd, sdict.n_shards, mesh))
-        routed, overflow = _probe_routed(sdict._keys, sdict._values, qd, sdict.n_shards, mesh)
+        dk, dv = sdict._device_tables()
+        dense = np.asarray(_probe_sharded(dk, dv, qd, sdict.n_shards, mesh))
+        routed, overflow = _probe_routed(dk, dv, qd, sdict.n_shards, mesh)
         assert not np.asarray(overflow).any()
         assert np.array_equal(dense, np.asarray(routed))
 
@@ -119,15 +120,39 @@ class TestShardedDict:
 
         from nydus_snapshotter_tpu.parallel.sharded_dict import DictBuildError
 
-        p = str(tmp_path / "dict.npz")
+        # raw (format 2) file with a corrupted version field
+        p = str(tmp_path / "dict.bin")
         sdict.save(p)
-        with _np.load(p) as z:
-            data = dict(z)
-        data["format_version"] = _np.int64(999)
-        p2 = str(tmp_path / "bad.npz")
-        _np.savez_compressed(p2, **data)
+        raw = bytearray(open(p, "rb").read())
+        raw[8:16] = _np.asarray([999], dtype=_np.uint64).tobytes()
+        p2 = str(tmp_path / "bad.bin")
+        open(p2, "wb").write(bytes(raw))
         with pytest.raises(DictBuildError):
             ShardedChunkDict.load(p2, mesh)
+        # legacy npz with an unknown version is rejected too
+        p3 = str(tmp_path / "bad.npz")
+        _np.savez_compressed(
+            p3, format_version=_np.int64(999), n_shards=1, n_entries=0,
+            keys=_np.zeros((1, 64, 8), _np.uint32), values=_np.zeros((1, 64), _np.int32),
+        )
+        with pytest.raises(DictBuildError):
+            ShardedChunkDict.load(p3, mesh)
+
+    def test_legacy_npz_still_loads(self, tmp_path, mesh, sdict):
+        import numpy as _np
+
+        p = str(tmp_path / "legacy.npz")
+        _np.savez_compressed(
+            p,
+            format_version=_np.int64(1),
+            n_shards=sdict.n_shards,
+            n_entries=sdict.n_entries,
+            keys=sdict._host_keys,
+            values=sdict._host_values,
+        )
+        again = ShardedChunkDict.load(p, mesh)
+        assert again.n_entries == sdict.n_entries
+        assert (again._host_keys == sdict._host_keys).all()
 
 
 class TestBuildBackends:

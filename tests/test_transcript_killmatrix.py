@@ -1,0 +1,260 @@
+"""Kill/restart e2e matrix over the REAL gRPC snapshotter service
+(VERDICT r3 next #8) — the transcript-harness port of the reference's
+integration scenarios:
+
+- ``only_restart_snapshotter`` (integration/entrypoint.sh:446): the
+  snapshotter process dies and restarts while a live daemon keeps
+  serving; the new process must RECONNECT to the same daemon (same pid),
+  keep the mounts, and keep answering gRPC.
+- ``kill_multiple_nydusd_recover_failover`` (:529): several daemons are
+  SIGKILLed while their mounts are in use; the failover policy brings up
+  successors via the supervisor fd/state handoff and reads keep working.
+- ``is_cache_cleared`` (:203): removing a committed layer snapshot
+  clears its blob-cache files.
+
+Everything is driven through the real UDS gRPC service against the real
+Filesystem/Manager/Daemon stack (no FakeFs) — the daemons are live
+processes serving packed RAFS images.
+"""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu import constants as C
+from nydus_snapshotter_tpu.api.client import SnapshotsClient
+from nydus_snapshotter_tpu.api.service import serve
+from nydus_snapshotter_tpu.cache.manager import CacheManager
+from nydus_snapshotter_tpu.config.config import SnapshotterConfig
+from nydus_snapshotter_tpu.config.daemonconfig import DaemonRuntimeConfig
+from nydus_snapshotter_tpu.filesystem.fs import Filesystem
+from nydus_snapshotter_tpu.manager.manager import Manager
+from nydus_snapshotter_tpu.snapshot.snapshotter import Snapshotter
+from nydus_snapshotter_tpu.store.database import Database
+
+from tests.test_daemon_lifecycle import _build_image
+
+IMAGE_REF = "registry.example.com/library/app:latest"
+
+
+def _mk_cfg(tmp_path, policy=C.RECOVER_POLICY_RESTART) -> SnapshotterConfig:
+    root = str(tmp_path / "r")
+    os.makedirs(root, exist_ok=True)
+    cfg = SnapshotterConfig(root=root)
+    cfg.daemon.recover_policy = policy
+    cfg.validate()
+    return cfg
+
+
+def _mk_stack(cfg, daemon_mode=C.DAEMON_MODE_SHARED):
+    """Real Manager + Filesystem + Snapshotter + gRPC service on a UDS."""
+    db = Database(cfg.database_path)
+    mgr = Manager(cfg, db, fs_driver=C.FS_DRIVER_FUSEDEV)
+    fs = Filesystem(
+        managers={C.FS_DRIVER_FUSEDEV: mgr},
+        cache_mgr=CacheManager(cfg.cache_root),
+        root=cfg.root,
+        fs_driver=C.FS_DRIVER_FUSEDEV,
+        daemon_mode=daemon_mode,
+        daemon_config=DaemonRuntimeConfig.from_dict(
+            # blobs are staged into the cache dir (the localfs "registry"
+            # stand-in, as the reference smoke uses localfs backends)
+            {"device": {"backend": {"type": "localfs"}}},
+            C.FS_DRIVER_FUSEDEV,
+        ),
+    )
+    fs.startup()
+    mgr.run_death_handler()
+    sn = Snapshotter(root=cfg.root, fs=fs)
+    sock = os.path.join(cfg.root, "grpc.sock")
+    server = serve(sn, sock)
+    client = SnapshotsClient(sock, timeout=30.0)
+    return db, mgr, fs, sn, server, client, sock
+
+
+def _meta_labels():
+    return {C.CRI_IMAGE_REF: IMAGE_REF, C.NYDUS_META_LAYER: "true"}
+
+
+def _pull_and_run(client, sn, fs, boot, blob_dir, name="img"):
+    """CRI-shaped transcript: prepare+commit the meta layer (bootstrap
+    staged like containerd's unpack would; blobs staged into the cache
+    dir, where the Filesystem points the daemon's default blob_dir), then
+    prepare the container's writable snapshot on top and return its
+    overlay mounts."""
+    import shutil
+
+    os.makedirs(fs.cache_mgr.cache_dir, exist_ok=True)
+    for b in os.listdir(blob_dir):
+        shutil.copyfile(
+            os.path.join(blob_dir, b), os.path.join(fs.cache_mgr.cache_dir, b)
+        )
+    meta_key = f"extract-{name}-meta"
+    chain = f"sha256:{name}-chain"
+    labels = dict(_meta_labels())
+    labels[C.TARGET_SNAPSHOT_REF] = chain  # CRI extract-style prepare
+    client.prepare(meta_key, "", labels=labels)
+    sid, _info, _us = sn.ms.get_info(meta_key)
+    image_dir = os.path.join(sn.upper_path(sid), "image")
+    os.makedirs(image_dir, exist_ok=True)
+    with open(boot, "rb") as f:
+        open(os.path.join(image_dir, "image.boot"), "wb").write(f.read())
+    client.commit(chain, meta_key, labels=_meta_labels())
+    ctr_key = f"ctr-{name}"
+    client.prepare(ctr_key, chain, labels={C.CRI_IMAGE_REF: IMAGE_REF})
+    mounts = client.mounts(ctr_key)
+    return ctr_key, chain, mounts
+
+
+def _lowerdir_of(mounts):
+    for m in mounts:
+        for o in m.options:
+            if o.startswith("lowerdir="):
+                return o[len("lowerdir=") :].split(":")[0]
+    raise AssertionError(f"no overlay lowerdir in {mounts}")
+
+
+class TestSnapshotterRestartLiveDaemon:
+    def test_restart_reconnects_live_daemon(self, tmp_path):
+        cfg = _mk_cfg(tmp_path)
+        boot, blob_dir, files = _build_image(tmp_path)
+        db, mgr, fs, sn, server, client, sock = _mk_stack(cfg)
+        try:
+            ctr_key, chain, mounts = _pull_and_run(client, sn, fs, boot, blob_dir)
+            daemon = fs.get_shared_daemon(C.FS_DRIVER_FUSEDEV)
+            pid1 = daemon.pid
+            rafs = fs.instances.list()[0]
+            snap_id = rafs.snapshot_id
+            # the daemon actually serves the image
+            assert (
+                daemon.client().read_file(f"/{snap_id}", "/app/hello.txt")
+                == files["/app/hello.txt"]
+            )
+        finally:
+            # snapshotter "crash": stop gRPC + drop all in-process state
+            # WITHOUT teardown — daemons must keep running.
+            client.close()
+            server.stop(grace=None)
+            sn.close()
+            mgr.stop()
+
+        # restart: fresh stack over the same root/db
+        db2, mgr2, fs2, sn2, server2, client2, _sock = _mk_stack(cfg)
+        try:
+            fs2.wait_until_ready(snap_id)
+            d2 = fs2.get_shared_daemon(C.FS_DRIVER_FUSEDEV)
+            # RECONNECTED, not respawned (entrypoint.sh:446 contract)
+            assert d2.pid == pid1
+            assert (
+                d2.client().read_file(f"/{snap_id}", "/app/hello.txt")
+                == files["/app/hello.txt"]
+            )
+            # gRPC surface is back and the container snapshot survived
+            mounts2 = client2.mounts(ctr_key)
+            assert _lowerdir_of(mounts2) == _lowerdir_of(mounts)
+            info2 = client2.stat(ctr_key)
+            assert info2.parent == chain
+        finally:
+            client2.close()
+            server2.stop(grace=None)
+            fs2.teardown()
+            sn2.close()
+            mgr2.stop()
+
+
+class TestMultiDaemonKillFailover:
+    def test_kill_all_dedicated_daemons_while_mounted(self, tmp_path):
+        cfg = _mk_cfg(tmp_path, policy=C.RECOVER_POLICY_FAILOVER)
+        db, mgr, fs, sn, server, client, sock = _mk_stack(
+            cfg, daemon_mode=C.DAEMON_MODE_DEDICATED
+        )
+        try:
+            imgs = {}
+            for name in ("one", "two"):
+                sub = tmp_path / name
+                sub.mkdir()
+                boot, blob_dir, files = _build_image(sub)
+                ctr_key, chain, mounts = _pull_and_run(
+                    client, sn, fs, boot, blob_dir, name=name
+                )
+                imgs[name] = (ctr_key, mounts, files)
+            daemons = list(mgr.list_daemons())
+            assert len(daemons) >= 2, "dedicated mode must spawn one daemon per image"
+            pids = {d.id: d.pid for d in daemons}
+            # wait for supervisor sessions, then kill EVERY daemon at once
+            for d in daemons:
+                assert mgr.supervisors.get(d.id).wait_for_state(timeout=10)
+            for d in daemons:
+                os.kill(d.pid, signal.SIGKILL)
+            deadline = time.time() + 30
+            for d in daemons:
+                while time.time() < deadline:
+                    try:
+                        if d.pid != pids[d.id] or d.state().name == "RUNNING":
+                            if d.client().info().get("state") == "RUNNING":
+                                break
+                    except Exception:
+                        pass
+                    time.sleep(0.2)
+            # failover complete: mounts survived, every image still reads
+            for name, (ctr_key, mounts, files) in imgs.items():
+                mounts_now = client.mounts(ctr_key)
+                assert _lowerdir_of(mounts_now) == _lowerdir_of(mounts)
+            for rafs in fs.instances.list():
+                d = mgr.get_by_daemon_id(rafs.daemon_id)
+                got = d.client().read_file(
+                    f"/{rafs.snapshot_id}", "/app/hello.txt"
+                )
+                assert got == b"hello from rafs\n"
+        finally:
+            client.close()
+            server.stop(grace=None)
+            fs.teardown()
+            sn.close()
+            mgr.stop()
+
+
+class TestCacheCleared:
+    def test_remove_clears_blob_cache(self, tmp_path):
+        """entrypoint.sh:203 is_cache_cleared analog: removing the
+        committed layer snapshot deletes its blob-cache files."""
+        cfg = _mk_cfg(tmp_path)
+        boot, blob_dir, files = _build_image(tmp_path)
+        db, mgr, fs, sn, server, client, sock = _mk_stack(cfg)
+        try:
+            blob_digest = "sha256:" + "ab" * 32
+            # stage cache files the daemon would have written for the blob
+            os.makedirs(cfg.cache_root, exist_ok=True)
+            cache_files = [
+                os.path.join(cfg.cache_root, blob_digest.split(":")[1] + suffix)
+                for suffix in (".blob.data", ".chunk_map")
+            ]
+            for p in cache_files:
+                open(p, "wb").write(b"x")
+            labels = _meta_labels()
+            labels[C.CRI_LAYER_DIGEST] = blob_digest
+            labels[C.TARGET_SNAPSHOT_REF] = "sha256:cc-chain"
+            meta_key = "extract-cc-meta"
+            client.prepare(meta_key, "", labels=labels)
+            client.commit("sha256:cc-chain", meta_key, labels=labels)
+            client.remove("sha256:cc-chain")
+            deadline = time.time() + 10
+            while any(os.path.exists(p) for p in cache_files) and time.time() < deadline:
+                time.sleep(0.1)
+            assert not any(os.path.exists(p) for p in cache_files), cache_files
+        finally:
+            client.close()
+            server.stop(grace=None)
+            fs.teardown()
+            sn.close()
+            mgr.stop()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q", "-x"]))
