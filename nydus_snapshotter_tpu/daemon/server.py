@@ -48,7 +48,11 @@ class _Instance:
         self.source = source
         self.config_json = config_json
         with open(source, "rb") as f:
-            self.bootstrap = Bootstrap.from_bytes(f.read())
+            # Either layout: native, or a real nydus-toolchain bootstrap
+            # (bridged) — the daemon serves both (models/nydus_real.py).
+            from nydus_snapshotter_tpu.models.nydus_real import load_any_bootstrap
+
+            self.bootstrap = load_any_bootstrap(f.read())
         self.by_path = self.bootstrap.inode_by_path()
         self.metrics = FsMetrics()
         # Per-blob readers with open fds — the per-chunk open() of the naive

@@ -715,8 +715,12 @@ class ChunkDict:
 
     @classmethod
     def from_path(cls, path: str) -> "ChunkDict":
+        from nydus_snapshotter_tpu.models.nydus_real import load_any_bootstrap
+
         with open(path, "rb") as f:
-            return cls(Bootstrap.from_bytes(f.read()))
+            # `--chunk-dict bootstrap=…` accepts REAL nydus bootstraps
+            # too: dedup against images the reference toolchain built.
+            return cls(load_any_bootstrap(f.read()))
 
     def __len__(self) -> int:
         return len(self._by_digest)

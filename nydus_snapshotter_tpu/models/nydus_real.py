@@ -361,7 +361,10 @@ def parse_real_v5(data: bytes) -> RealBootstrap:
             idend += 1
         bid = data[boff:idend].decode("ascii", "replace")
         if bid:
-            blobs.append(RealBlob(blob_id=bid))
+            # v5 keeps the chunking granularity in the superblock's
+            # block_size (1 MiB on the fixture) — surface it per blob so
+            # bridged bootstraps keep a valid Bootstrap.chunk_size.
+            blobs.append(RealBlob(blob_id=bid, chunk_size=_block_size))
         # ids are NUL-separated when multiple entries follow
         boff = idend + 1
     # Extended blob table: 64-B entries with chunk_count + sizes. A
@@ -864,6 +867,121 @@ def parse_real_v6(data: bytes) -> RealBootstrap:
         blobs=blobs,
         chunks=chunks,
     )
+
+
+def to_bootstrap(real: RealBootstrap):
+    """Bridge a REAL nydus bootstrap into the framework's internal model
+    (models/bootstrap.Bootstrap) so every downstream surface — the
+    userspace daemon, FUSE mounts, Unpack, EROFS export — can serve
+    images the reference toolchain built, not only images this framework
+    converted itself.
+
+    Chunk compression flags translate from nydus BlobChunkFlags (bit0 =
+    COMPRESSED) + the superblock codec identity into the framework's
+    per-chunk compressor flags. Hardlink aliases (repeated ino) become
+    hardlink_target references to the first path.
+    """
+    from nydus_snapshotter_tpu import constants
+    from nydus_snapshotter_tpu.models.bootstrap import (
+        INODE_FLAG_SYMLINK,
+        Bootstrap,
+        BlobRecord,
+        ChunkRecord,
+        Inode,
+    )
+    from nydus_snapshotter_tpu.models.bootstrap import INODE_FLAG_HARDLINK
+
+    comp_flag = {
+        "lz4_block": constants.COMPRESSOR_LZ4_BLOCK,
+        "zstd": constants.COMPRESSOR_ZSTD,
+        "gzip": constants.COMPRESSOR_GZIP,
+        "none": constants.COMPRESSOR_NONE,
+    }[real.compressor]
+
+    chunks: list = []
+    inodes: list = []
+    first_path_of_ino: dict[int, str] = {}
+    for ri in sorted(real.inodes, key=lambda i: i.path):
+        ino = Inode(
+            path=ri.path,
+            mode=ri.mode,
+            uid=ri.uid,
+            gid=ri.gid,
+            rdev=ri.rdev,
+            mtime=ri.mtime,
+            size=ri.size,
+            symlink_target=ri.symlink_target,
+            xattrs=dict(ri.xattrs),
+        )
+        if ri.is_symlink:
+            ino.flags |= INODE_FLAG_SYMLINK
+        if ri.is_regular:
+            first = first_path_of_ino.get(ri.ino)
+            if first is not None and ri.nlink > 1:
+                ino.flags |= INODE_FLAG_HARDLINK
+                ino.hardlink_target = first
+                inodes.append(ino)
+                continue
+            first_path_of_ino[ri.ino] = ri.path
+        if ri.chunks:
+            ino.chunk_index = len(chunks)
+            ino.chunk_count = len(ri.chunks)
+            for ck in ri.chunks:
+                chunks.append(
+                    ChunkRecord(
+                        digest=ck.digest,
+                        blob_index=ck.blob_index,
+                        flags=comp_flag
+                        if ck.flags & 0x1
+                        else constants.COMPRESSOR_NONE,
+                        uncompressed_offset=ck.uncompressed_offset,
+                        compressed_offset=ck.compressed_offset,
+                        uncompressed_size=ck.uncompressed_size,
+                        compressed_size=ck.compressed_size,
+                    )
+                )
+        inodes.append(ino)
+
+    blobs = [
+        BlobRecord(
+            blob_id=b.blob_id,
+            compressed_size=b.compressed_size,
+            uncompressed_size=b.uncompressed_size,
+            chunk_count=b.chunk_count,
+        )
+        for b in real.blobs
+    ]
+    return Bootstrap(
+        version=real.version,
+        chunk_size=real.blobs[0].chunk_size if real.blobs else 0x100000,
+        inodes=inodes,
+        chunks=chunks,
+        blobs=blobs,
+    )
+
+
+def load_any_bootstrap(data: bytes):
+    """Load a bootstrap in EITHER layout: this framework's native format,
+    or the real nydus toolchain's v5/v6 (bridged via to_bootstrap). This
+    is what lets the daemon mount — and the chunk dict dedup against —
+    images the reference ecosystem built, with zero caller special-casing
+    (the two formats share detection magics; the field layouts identify
+    which reader owns the bytes)."""
+    from nydus_snapshotter_tpu.models.bootstrap import Bootstrap, BootstrapError
+
+    try:
+        return Bootstrap.from_bytes(data)
+    except (ValueError, struct.error, IndexError) as native_err:
+        # BootstrapError and LayoutError are ValueError subclasses; bare
+        # struct/index errors on truncated native headers must also fall
+        # through to the real-format reader rather than escaping.
+        try:
+            return to_bootstrap(parse_real_bootstrap(data))
+        except (RealBootstrapError, ValueError) as real_err:
+            raise BootstrapError(
+                f"not a native bootstrap ({native_err}) nor a real nydus "
+                f"one ({real_err})"
+            ) from native_err
 
 
 def parse_real_bootstrap(data: bytes) -> RealBootstrap:
