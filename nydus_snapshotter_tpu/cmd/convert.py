@@ -30,6 +30,7 @@ def _pack_option(args) -> "PackOption":
     return PackOption(
         fs_version=args.fs_version,
         compressor=args.compressor,
+        lz4_acceleration=getattr(args, "lz4_acceleration", 1),
         chunk_size=args.chunk_size,
         batch_size=args.batch_size,
         chunk_dict_path=args.chunk_dict or "",
@@ -117,14 +118,14 @@ def cmd_unpack(args) -> int:
 
 def cmd_check(args) -> int:
     """``nydus-image check`` shape: parse + structural validation."""
-    from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
-    from nydus_snapshotter_tpu.models import layout
-
     with open(args.boot, "rb") as f:
         buf = f.read()
     try:
-        version = layout.detect_fs_version(buf[: layout.MAX_SUPER_BLOCK_SIZE])
-        bs = Bootstrap.from_bytes(buf)
+        # Either layout — native or a REAL toolchain bootstrap (bridged).
+        from nydus_snapshotter_tpu.models.nydus_real import load_any_bootstrap
+
+        bs = load_any_bootstrap(buf)
+        version = bs.version
     except Exception:
         # Maybe a framed layer stream (pack output) rather than a bare
         # bootstrap — accept both, like nydus-image check does.
@@ -205,6 +206,9 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--fs-version", default="v6", choices=("v5", "v6"))
         sp.add_argument("--compressor", default="lz4_block",
                         choices=("none", "zstd", "lz4_block"))
+        sp.add_argument("--lz4-acceleration", type=int, default=1,
+                        help="LZ4_compress_fast acceleration (1 = max "
+                        "ratio; higher trades ratio for speed)")
         sp.add_argument("--chunk-size", type=lambda v: int(v, 0), default=0x100000)
         sp.add_argument("--batch-size", type=lambda v: int(v, 0), default=0)
         sp.add_argument("--backend", default="hybrid",

@@ -522,8 +522,15 @@ def Unpack(
     ``blob_provider`` maps blob id → *blob data section* bytes (for a packed
     layer stream, pass the bytes of its ``image.blob`` section, see
     ``blob_data_from_layer_blob``). Reference surface convert_unix.go:669-733.
+    Accepts REAL nydus-toolchain bootstraps too (auto-detected and bridged
+    via models/nydus_real.load_any_bootstrap).
     """
-    bs = bootstrap if isinstance(bootstrap, Bootstrap) else Bootstrap.from_bytes(bootstrap)
+    if isinstance(bootstrap, Bootstrap):
+        bs = bootstrap
+    else:
+        from nydus_snapshotter_tpu.models.nydus_real import load_any_bootstrap
+
+        bs = load_any_bootstrap(bootstrap)
     provider = blob_provider.__getitem__ if isinstance(blob_provider, dict) else blob_provider
     readers: dict[int, BlobReader] = {}
     batch_map = bs.batch_map()

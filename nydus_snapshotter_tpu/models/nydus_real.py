@@ -951,12 +951,20 @@ def to_bootstrap(real: RealBootstrap):
         )
         for b in real.blobs
     ]
+    # v5 prefetch table: inode numbers -> paths (the runtime warm list).
+    path_of_ino = {}
+    for ri in real.inodes:
+        path_of_ino.setdefault(ri.ino, ri.path)
+    # "/" is a legitimate entry (prefetch-everything policy — and what the
+    # committed v5 fixture actually carries); keep it.
+    prefetch = [path_of_ino[pi] for pi in real.prefetch_inos if pi in path_of_ino]
     return Bootstrap(
         version=real.version,
         chunk_size=real.blobs[0].chunk_size if real.blobs else 0x100000,
         inodes=inodes,
         chunks=chunks,
         blobs=blobs,
+        prefetch=prefetch,
     )
 
 
