@@ -107,6 +107,31 @@ for comp in (0, 1):
     assert len(set(outs)) <= 1  # threaded == serial
 empty = native_cdc.pack_section(src0, src1, np.empty((0, 3), np.int64), 1, 1, 1)
 assert empty is None or empty[0].size == 0
+
+# Randomized threaded pack_section stress: many extents of adversarial
+# sizes racing through the bound-spaced parallel arm; each output must
+# equal the serial arm byte-for-byte under the sanitizer.
+for trial in range(6):
+    trng = np.random.default_rng(1000 + trial)
+    big = trng.integers(0, 256, 3 << 20, dtype=np.uint8)
+    if trial % 2:
+        big[: 1 << 20] = 0x55
+    exts = []
+    off = 0
+    while off + 200_000 < big.size and len(exts) < 500:
+        sz = int(trng.choice([1, 7, 63, 64, 4096, 65537, int(trng.integers(1, 150_000))]))
+        exts.append((0, off, sz))
+        off += sz
+    exts = np.asarray(exts, dtype=np.int64)
+    for compn in (0, 1):
+        a = native_cdc.pack_section(big, src1, exts, compn, 1 + trial % 3, 1)
+        b = native_cdc.pack_section(big, src1, exts, compn, 1 + trial % 3, 5)
+        assert (a is None) == (b is None), (trial, compn)  # asymmetric arm failure
+        if a is None:
+            assert compn == 1  # only liblz4 absence may disable the arm
+            continue
+        assert a[0].tobytes() == b[0].tobytes(), trial
+        assert (a[1] == b[1]).all(), trial  # extent tables, not just bytes
 print("SANITIZED-ENGINE-OK")
 """
 

@@ -232,3 +232,100 @@ class TestDeferredNativeSection:
             raise AssertionError("accel 0 must be rejected")
         except ConvertError:
             pass
+
+
+class TestDeferredDifferentialFuzz:
+    """Randomized differential: for many random tar shapes (file sizes
+    across chunk boundaries, duplicates, symlinks/dirs/empties, pax and
+    GNU formats, both compressors, and a chunk-dict trial), the in-memory
+    fast path (native deferred section) and the file-like streaming path
+    (Python section writer) must produce byte-identical layer blobs, and
+    the blob must round-trip through Unpack."""
+
+    def _random_layer(self, rng, fmt):
+        buf = io.BytesIO()
+        n = int(rng.integers(1, 25))
+        shared = rng.integers(0, 256, 70_000, dtype=np.uint8).tobytes()
+        with tarfile.open(fileobj=buf, mode="w", format=fmt) as tf:
+            for i in range(n):
+                kind = rng.random()
+                name = f"d{int(rng.integers(0, 4))}/n{i}"
+                if kind < 0.12:
+                    ti = tarfile.TarInfo(name)
+                    ti.type = tarfile.DIRTYPE
+                    tf.addfile(ti)
+                elif kind < 0.2:
+                    ti = tarfile.TarInfo(name)
+                    ti.type = tarfile.SYMTYPE
+                    ti.linkname = "n0"
+                    tf.addfile(ti)
+                else:
+                    size = int(rng.choice([0, 1, 100, 4095, 4096, 4097,
+                                           65535, 65536, 65537,
+                                           int(rng.integers(1, 400_000))]))
+                    if rng.random() < 0.3:
+                        data = (shared * (size // len(shared) + 1))[:size]
+                    else:
+                        data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+                    ti = tarfile.TarInfo(name)
+                    ti.size = size
+                    tf.addfile(ti, io.BytesIO(data))
+        return buf.getvalue()
+
+    def test_differential_fuzz(self):
+        rng = np.random.default_rng(0xF00D)
+        for trial in range(24):
+            fmt = tarfile.GNU_FORMAT if trial % 2 else tarfile.PAX_FORMAT
+            raw = self._random_layer(rng, fmt)
+            comp = "none" if trial % 5 == 0 else "lz4_block"
+            accel = 1 if trial % 3 else 4
+            opt = PackOption(
+                chunk_size=0x4000, compressor=comp, lz4_acceleration=accel
+            )
+            blob_fast, res = pack_layer(raw, opt)
+            out = io.BytesIO()
+            pack_stream(out, io.BytesIO(raw), opt)
+            assert blob_fast == out.getvalue(), f"trial {trial} diverged"
+            if res.blob_size:
+                tar_back = Unpack(
+                    res.bootstrap, {res.blob_id: blob_data_from_layer_blob(blob_fast)}
+                )
+                with tarfile.open(fileobj=io.BytesIO(tar_back)) as tf:
+                    names_back = {m.name.lstrip("./") for m in tf.getmembers()}
+                with tarfile.open(fileobj=io.BytesIO(raw)) as tf:
+                    in_members = tf.getmembers()
+                    # every input member survives the round trip (dirs,
+                    # symlinks, empties included; last-wins for dup paths)
+                    assert {
+                        m.name.lstrip("./").rstrip("/") for m in in_members
+                    } <= names_back, f"trial {trial} lost members"
+                    for m in in_members:
+                        if m.isreg() and m.size > 0:
+                            want = tf.extractfile(m).read()
+                            with tarfile.open(fileobj=io.BytesIO(tar_back)) as tb:
+                                got = tb.extractfile(
+                                    next(x for x in tb.getmembers() if x.name.lstrip("./") == m.name.lstrip("./"))
+                                ).read()
+                            assert got == want, f"trial {trial}: {m.name}"
+                            break  # one byte-check per trial keeps it fast
+
+    def test_differential_with_chunk_dict(self):
+        """Dict-enabled differential: both paths, packed against the same
+        ChunkDict, stay byte-identical (dict hits skip storage in both)."""
+        from nydus_snapshotter_tpu.converter.convert import Merge
+        from nydus_snapshotter_tpu.converter.types import MergeOption
+        from nydus_snapshotter_tpu.models.bootstrap import Bootstrap, ChunkDict
+
+        rng = np.random.default_rng(0xD1C7)
+        base = self._random_layer(rng, tarfile.GNU_FORMAT)
+        opt = PackOption(chunk_size=0x4000)
+        blob_a, _res_a = pack_layer(base, opt)
+        merged = Merge([blob_a], MergeOption(with_tar=False))
+        cdict = ChunkDict(Bootstrap.from_bytes(merged.bootstrap))
+        # a fresh layer (misses) and the base itself (all dict hits)
+        overlap = self._random_layer(rng, tarfile.GNU_FORMAT)
+        for raw in (overlap, base):
+            fast, res = pack_layer(raw, opt, chunk_dict=cdict)
+            out = io.BytesIO()
+            pack_stream(out, io.BytesIO(raw), opt, chunk_dict=cdict)
+            assert fast == out.getvalue()
