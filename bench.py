@@ -576,6 +576,30 @@ def main() -> None:
         comp_bytes / max(1, sum(len(t) for t in layers)), 4
     )
 
+    # Speed-profile arm: same full path with the documented lz4
+    # acceleration dial (PackOption.lz4_acceleration=8). The headline
+    # stays at fidelity defaults; this records what the knob buys and
+    # what ratio it costs on the same corpus.
+    opt_accel = PackOption(
+        chunk_size=CHUNK_SIZE, chunking="cdc", lz4_acceleration=8,
+        **_pack_kwargs(winner),
+    )
+    total_in = sum(len(t) for t in layers)
+    accel_best = None
+    packed_accel = None
+    for _ in range(REPS):  # same best-of-REPS discipline as the headline
+        t0 = time.time()
+        packed_accel = _pack_layers(layers, opt_accel)
+        dt = time.time() - t0
+        accel_best = dt if accel_best is None or dt < accel_best else accel_best
+    accel_profile = {
+        "lz4_acceleration": 8,
+        "full_path_gibps": round(total_in / accel_best / (1 << 30), 4),
+        "compress_ratio": round(
+            sum(r.blob_size for _b, r in packed_accel) / max(1, total_in), 4
+        ),
+    }
+
     # ---- detail runs ----
     engine_detail = engine_flat_run(bench_engine, probe)
     pool = build_file_pool(min(IMAGE_MIB, 128), seed=555)
@@ -609,6 +633,7 @@ def main() -> None:
                     "calibration": cal,
                     "engine_flat": engine_detail,
                     "stage_breakdown_s": stage_breakdown,
+                    "accel_profile": accel_profile,
                     "baseline_shaped": shaped,
                     "stargz_zran": stargz_zran,
                     "host_cores": os.cpu_count(),

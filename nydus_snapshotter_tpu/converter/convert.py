@@ -389,10 +389,28 @@ def Merge(
         raise ConvertError("merge needs at least one layer")
     if chunk_dict is None and opt.chunk_dict_path:
         chunk_dict = ChunkDict.from_path(parse_chunk_dict_arg(opt.chunk_dict_path))
+    from nydus_snapshotter_tpu.models.nydus_real import load_any_bootstrap
+
     parent: Optional[Bootstrap] = None
     if opt.parent_bootstrap_path:
         with open(opt.parent_bootstrap_path, "rb") as f:
-            parent = Bootstrap.from_bytes(f.read())
+            parent = load_any_bootstrap(f.read())
+
+    def _layer_bootstrap(layer: bytes) -> Bootstrap:
+        # A framed layer stream (pack output) or a bare bootstrap in
+        # either layout — the reference Merge takes per-layer bootstraps
+        # (convert_unix.go:560-607), including real-toolchain ones.
+        try:
+            return bootstrap_from_layer_blob(layer)
+        except (ConvertError, nydus_tar.TarFramingError) as frame_err:
+            try:
+                return load_any_bootstrap(layer)
+            except Exception as boot_err:
+                # keep the framing diagnosis AND the caller-visible type
+                raise ConvertError(
+                    f"layer is neither a framed blob ({frame_err}) nor a "
+                    f"bootstrap ({boot_err})"
+                ) from frame_err
 
     merged: dict[str, _Node] = {}
     boots: list[Bootstrap] = []
@@ -400,7 +418,7 @@ def Merge(
         boots.append(parent)
     for layer in layers:
         boots.append(
-            layer if isinstance(layer, Bootstrap) else bootstrap_from_layer_blob(layer)
+            layer if isinstance(layer, Bootstrap) else _layer_bootstrap(layer)
         )
     chunk_size = boots[-1].chunk_size
     version = opt.fs_version or boots[-1].version

@@ -634,3 +634,35 @@ def test_real_v5_prefetch_bridges():
 
     again = Bootstrap.from_bytes(bs.to_bytes())
     assert again.prefetch == ["/"]  # survives serialization
+
+
+def test_merge_accepts_real_bootstrap_layer(tmp_path):
+    """Merge over a REAL per-layer bootstrap (the reference's Merge takes
+    layer bootstraps, convert_unix.go:560-607): overlay a framework-built
+    layer on top of the real Ubuntu image and serve the union."""
+    import io as _io
+    import numpy as np
+
+    from nydus_snapshotter_tpu.converter.convert import Merge, pack_layer
+    from nydus_snapshotter_tpu.converter.types import MergeOption, PackOption
+    from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
+
+    real_boot = _boot_from("v6-bootstrap-chunk-pos-438272.tar.gz")
+    rng = np.random.default_rng(77)
+    buf = _io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+        data = rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes()
+        ti = tarfile.TarInfo("opt/app/bin")
+        ti.size = len(data)
+        tf.addfile(ti, _io.BytesIO(data))
+    top_blob, top_res = pack_layer(buf.getvalue(), PackOption(chunk_size=0x10000))
+
+    merged = Merge([real_boot, top_blob], MergeOption(with_tar=False))
+    bs = Bootstrap.from_bytes(merged.bootstrap)
+    paths = {i.path for i in bs.inodes}
+    assert "/etc/adduser.conf" in paths  # the real rootfs
+    assert "/opt/app/bin" in paths  # the overlay layer
+    # both blobs referenced: the real image's and the new layer's
+    ids = set(merged.blob_digests)
+    assert top_res.blob_id in ids
+    assert any(b != top_res.blob_id for b in ids)

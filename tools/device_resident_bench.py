@@ -11,7 +11,7 @@ Replaces the chunking+digesting hot loop of the reference's external
 ``nydus-image create`` (pkg/converter/tool/builder.go:148-178) with the
 repo's Pallas/XLA kernels; this script is the hardware evidence for them.
 
-Usage: python tools/device_resident_bench.py [--stage all|gear|gear-xla|sha|sha-pallas] [--mib N]
+Usage: python tools/device_resident_bench.py [--stage all|gear|gear-xla|sha|sha-pallas|probe] [--mib N]
 Intended to be driven by tools/device_hunt.py inside a hard-timeout
 subprocess (a wedged tunnel hangs forever; see memory: axon-tunnel-wedges).
 """
@@ -144,6 +144,75 @@ def bench_sha(total_mib: int, chunk_kib: int = 64, pallas: bool = False):
     }
 
 
+def bench_probe(n_entries: int = 1_000_000, m_queries: int = 262_144):
+    """DMA-pipelined Pallas dict probe (ops/probe_pallas) on device.
+
+    Unlike the other stages, the inputs here are HOST-built and uploaded
+    untimed (~45 MiB table + ~8 MiB queries): planted hits require host
+    knowledge of the table, so devgen doesn't apply — budget the wedged-
+    tunnel upload (10-50 MiB/s => up to ~90 s) in the stage timeout.
+    Only the probe itself is timed, and a post-timing hit-count check
+    guards against a miscompiled kernel reporting healthy throughput.
+    The roofline prediction for this stage lives in DEVICE_NUMBERS.md."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nydus_snapshotter_tpu.ops import probe_pallas
+    from nydus_snapshotter_tpu.parallel.sharded_dict import (
+        _build_host_tables,
+        _table_max_depth,
+    )
+
+    rng = np.random.default_rng(11)
+    digests = rng.integers(0, 2**32, (n_entries, 8), dtype=np.uint32)
+    keys, values = _build_host_tables(digests, 1)
+    depth = _table_max_depth(keys, values)
+    keys_pad, vals_pad = probe_pallas.pad_tables(keys[0], values[0], depth)
+    kd = jax.device_put(jnp.asarray(keys_pad))
+    vd = jax.device_put(jnp.asarray(vals_pad))
+    cap = keys.shape[1]
+
+    def host_batch(seed):
+        # half planted hits (host knows the table), half misses
+        r = np.random.default_rng(seed)
+        q = np.concatenate(
+            [
+                digests[r.integers(0, n_entries, m_queries // 2)],
+                r.integers(0, 2**32, (m_queries - m_queries // 2, 8), np.uint32),
+            ]
+        )
+        slot0 = (q[:, 1] & np.uint32(cap - 1)).astype(np.int32)
+        wstart = slot0 & ~np.int32(7)
+        return (
+            jax.device_put(jnp.asarray(q)),
+            jax.device_put(jnp.asarray(wstart)),
+            jax.device_put(jnp.asarray(slot0 - wstart)),
+        )
+
+    argsets = [host_batch(21), host_batch(22)]  # distinct: no memo faking
+
+    def fn(q, w, o):
+        return probe_pallas.probe_padded(kd, vd, q, w, o, depth)
+
+    dt = _timeit(fn, argsets)
+    # correctness signal, outside the timed region: planted hits found
+    hits = int(np.count_nonzero(np.asarray(jax.device_get(fn(*argsets[0])))))
+    expected = m_queries // 2
+    return {
+        "stage": "dict-probe-pallas",
+        "queries_per_s": round(m_queries / dt),
+        "ms": round(dt * 1e3, 2),
+        "depth": depth,
+        "entries": n_entries,
+        "hits": hits,
+        "hits_expected_min": expected,
+        "hits_ok": hits >= expected,
+        "backend": jax.default_backend(),
+        "devgen": False,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mib", type=int, default=64)
@@ -171,6 +240,8 @@ def main():
         print(json.dumps(bench_sha(args.mib)), flush=True)
     if args.stage in ("all", "sha-pallas"):
         print(json.dumps(bench_sha(args.mib, pallas=True)), flush=True)
+    if args.stage in ("all", "probe"):
+        print(json.dumps(bench_probe()), flush=True)
 
 
 if __name__ == "__main__":
