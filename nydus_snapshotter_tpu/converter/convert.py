@@ -321,13 +321,18 @@ def _layer_nodes(bootstrap: Bootstrap) -> list[_Node]:
 
 
 def bootstrap_from_layer_blob(blob: bytes) -> Bootstrap:
-    """Extract the layer bootstrap from a packed nydus blob stream."""
+    """Extract the layer bootstrap from a packed nydus blob stream. The
+    embedded section may be in either layout — native, or the real
+    toolchain's v5/v6 (a reference-built framed layer, convert_unix.go's
+    packToTar shape) — and is auto-bridged."""
+    from nydus_snapshotter_tpu.models.nydus_real import load_any_bootstrap
+
     f = io.BytesIO(blob)
     loc = nydus_tar.seek_file_by_tar_header(f, len(blob), toc.ENTRY_BOOTSTRAP)
     if loc is None:
         raise ConvertError("layer blob carries no bootstrap section")
     off, size = loc
-    return Bootstrap.from_bytes(blob[off : off + size])
+    return load_any_bootstrap(blob[off : off + size])
 
 
 def bootstrap_from_bootstrap_layer(data: bytes) -> Bootstrap:
@@ -402,7 +407,7 @@ def Merge(
         # (convert_unix.go:560-607), including real-toolchain ones.
         try:
             return bootstrap_from_layer_blob(layer)
-        except (ConvertError, nydus_tar.TarFramingError) as frame_err:
+        except (ConvertError, nydus_tar.TarFramingError, ValueError) as frame_err:
             try:
                 return load_any_bootstrap(layer)
             except Exception as boot_err:

@@ -876,7 +876,46 @@ def pack_stream(
                 raise ConvertError(f"bad layer tar: {e}") from e
     _t1 = _pc()
     if plan:
+        from nydus_snapshotter_tpu.ops import native_cdc
+
         arr_all = np.frombuffer(raw, dtype=np.uint8)
+        n_threads = _pack_threads()
+        # Single-thread fast lane: ONE native call fuses chunk+digest for
+        # EVERY planned file (small and large alike — a <= min_size file
+        # is exactly one CDC chunk, so the unified pass subsumes the
+        # batched small-file digest sweep). Cut points, digests, dedup
+        # and blob bytes are bit-identical to the per-file path.
+        use_multi = (
+            n_threads == 1
+            and shared_chunker.fused
+            and params is not None
+            and opt.chunking == "cdc"
+            and native_cdc.chunk_digest_multi_available()
+        )
+        if use_multi:
+            ext = np.asarray(
+                [(off, size) for _t, _m, off, size in plan], dtype=np.int64
+            )
+            _tc = _pc()
+            ncuts_arr, cuts_all, digs_all = native_cdc.chunk_digest_multi(
+                arr_all, ext, params
+            )
+            _t_chunk += _pc() - _tc
+            pos = 0
+            for (tag, meta, off, size), nc in zip(plan, ncuts_arr):
+                nc = int(nc)
+                view = raw[off : off + size]
+                s = 0
+                batch = []
+                dlist = []
+                for k in range(nc):
+                    c = int(cuts_all[pos + k])
+                    batch.append((meta, view[s:c]))
+                    dlist.append(digs_all[32 * (pos + k) : 32 * (pos + k + 1)])
+                    s = c
+                _process(batch, dlist)
+                pos += nc
+            plan = []  # consumed; skip the per-file paths below
         small_items = [
             (arr_all, off, size) for tag, _m, off, size in plan if tag == "small"
         ]
@@ -895,7 +934,6 @@ def pack_stream(
         # duplicate digests write identical bytes — and the ordered serial
         # walk below only assembles. Blob bytes are identical to the
         # serial path (pinned by tests/test_fast_tar.py).
-        n_threads = _pack_threads()
         file_chunks: dict[int, list] = {}
         comp_cache: dict[bytes, tuple[bytes, int]] = {}
         file_idxs = [i for i, (tag, *_rest) in enumerate(plan) if tag == "file"]

@@ -108,6 +108,16 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_void_p,  # data, extents (i64 pairs)
                 ctypes.c_int64, ctypes.c_void_p,   # m, digests_out
             ]
+        if hasattr(lib, "ntpu_chunk_digest_multi"):
+            lib.ntpu_chunk_digest_multi.restype = ctypes.c_int64
+            lib.ntpu_chunk_digest_multi.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,  # data, extents, m
+                ctypes.c_uint32, ctypes.c_uint32,  # masks
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # min/normal/max
+                ctypes.c_void_p,  # file_ncuts
+                ctypes.c_void_p, ctypes.c_int64,  # cuts_out, cap
+                ctypes.c_void_p,  # digests_out
+            ]
         if hasattr(lib, "ntpu_pack_section"):
             lib.ntpu_pack_section.restype = ctypes.c_int64
             lib.ntpu_pack_section.argtypes = [
@@ -198,6 +208,44 @@ def chunk_digest_native(
         cuts[:n].copy(),
         digests[: n * 32].tobytes() if digests is not None else b"",
     )
+
+
+def chunk_digest_multi_available() -> bool:
+    lib = load()
+    return lib is not None and hasattr(lib, "ntpu_chunk_digest_multi")
+
+
+def chunk_digest_multi(
+    data: np.ndarray, extents: np.ndarray, params: cdc.CDCParams
+) -> "tuple[np.ndarray, np.ndarray, bytes]":
+    """Fused chunk+digest over m (off, size) file extents in ONE native
+    call (one FFI round trip / GIL drop per layer instead of per file).
+
+    Returns (file_ncuts i64[m], cuts i64[total] file-relative exclusive
+    ends concatenated in file order, digests bytes 32*total). Cut points
+    and digests are bit-identical to per-file chunk_digest_native calls.
+    """
+    lib = load()
+    if lib is None or not hasattr(lib, "ntpu_chunk_digest_multi"):
+        raise RuntimeError("ntpu_chunk_digest_multi not available")
+    arr = np.ascontiguousarray(data, dtype=np.uint8)
+    ext = np.ascontiguousarray(extents, dtype=np.int64)
+    m = ext.shape[0]
+    if m == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64), b""
+    cap = int((ext[:, 1] // max(1, params.min_size)).sum()) + 2 * m
+    file_ncuts = np.empty(m, dtype=np.int64)
+    cuts = np.empty(cap, dtype=np.int64)
+    digests = np.empty(cap * 32, dtype=np.uint8)
+    total = lib.ntpu_chunk_digest_multi(
+        arr.ctypes.data, ext.ctypes.data, m,
+        np.uint32(params.mask_small), np.uint32(params.mask_large),
+        params.min_size, params.normal_size, params.max_size,
+        file_ncuts.ctypes.data, cuts.ctypes.data, cap, digests.ctypes.data,
+    )
+    if total < 0:
+        raise RuntimeError("native multi chunk+digest failed (overflow or OOM)")
+    return file_ncuts, cuts[:total], digests[: total * 32].tobytes()
 
 
 def sha256_many_native(data: np.ndarray, extents: np.ndarray) -> bytes:

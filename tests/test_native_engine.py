@@ -232,3 +232,39 @@ class TestPackSection:
             got = lz4.decompress_block(raw[co : co + cs], n)
             src = src0 if s == 0 else src1
             assert got == src[o : o + n].tobytes()
+
+
+@pytest.mark.skipif(
+    not native_cdc.chunk_digest_multi_available(),
+    reason="multi chunk+digest arm not built",
+)
+class TestChunkDigestMulti:
+    def test_matches_per_file_calls(self):
+        rng = np.random.default_rng(55)
+        data = rng.integers(0, 256, 2 << 20, dtype=np.uint8)
+        params = cdc.CDCParams(0x10000)
+        exts, off = [], 0
+        for size in (1, 100, 4096, params.min_size, params.min_size + 1,
+                     70_000, 300_000, 65536):
+            exts.append((off, size))
+            off += size
+        ext = np.asarray(exts, dtype=np.int64)
+        ncuts, cuts, digs = native_cdc.chunk_digest_multi(data, ext, params)
+        pos = 0
+        for (o, n), nc in zip(exts, ncuts):
+            want_cuts, want_digs = native_cdc.chunk_digest_native(
+                data[o : o + n], params
+            )
+            nc = int(nc)
+            assert nc == len(want_cuts)
+            assert (cuts[pos : pos + nc] == want_cuts).all()
+            assert digs[pos * 32 : (pos + nc) * 32] == want_digs
+            pos += nc
+        assert pos == len(cuts)
+
+    def test_empty_extent_list(self):
+        params = cdc.CDCParams(0x10000)
+        ncuts, cuts, digs = native_cdc.chunk_digest_multi(
+            np.zeros(10, np.uint8), np.empty((0, 2), np.int64), params
+        )
+        assert len(ncuts) == 0 and len(cuts) == 0 and digs == b""

@@ -61,6 +61,23 @@ for size in (0, 1, 31, 32, 511, 2048, 2049, 65535, 65536 * 4 + 7, 1 << 22):
         start = end
     assert start == size
 
+# Batched multi-extent fused pass: per-file outputs must equal per-file
+# ntpu_chunk_digest calls (thin loop, but the pointer arithmetic into the
+# shared output buffers is exactly what ASan should watch).
+mdata = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+mext = []
+moff = 0
+for s in (1, 31, 2048, 65535, 200_000):
+    mext.append((moff, s)); moff += s
+mext = np.asarray(mext, dtype=np.int64)
+ncuts, cuts, digs = native_cdc.chunk_digest_multi(mdata, mext, params)
+pos = 0
+for (o, s), nc in zip(mext.tolist(), ncuts.tolist()):
+    wc, wd = native_cdc.chunk_digest_native(mdata[o:o+s], params)
+    assert nc == len(wc) and (cuts[pos:pos+nc] == wc).all()
+    assert digs[pos*32:(pos+nc)*32] == wd
+    pos += nc
+
 # Batch SHA over ragged extents (exercises all three scheduler phases).
 data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
 sizes = [0, 1, 55, 56, 63, 64, 65, 127, 128, 1000, 65536, 100000]

@@ -666,3 +666,25 @@ def test_merge_accepts_real_bootstrap_layer(tmp_path):
     ids = set(merged.blob_digests)
     assert top_res.blob_id in ids
     assert any(b != top_res.blob_id for b in ids)
+
+
+def test_framed_layer_with_real_bootstrap_section(tmp_path):
+    """A framed layer blob whose embedded bootstrap section is in the
+    REAL toolchain layout (the reference's packToTar shape) parses and
+    merges — the bridge applies inside the framing too."""
+    from nydus_snapshotter_tpu.converter.convert import (
+        Merge,
+        bootstrap_from_layer_blob,
+    )
+    from nydus_snapshotter_tpu.converter.types import MergeOption
+    from nydus_snapshotter_tpu.models import nydus_tar, toc as toc_mod
+
+    real_boot = _boot_from("v6-bootstrap-chunk-pos-438272.tar.gz")
+    framed = io.BytesIO()
+    framed.write(real_boot)
+    framed.write(nydus_tar.make_header(toc_mod.ENTRY_BOOTSTRAP, len(real_boot)))
+    blob = framed.getvalue()
+    bs = bootstrap_from_layer_blob(blob)
+    assert len(bs.inodes) == 3517
+    merged = Merge([blob], MergeOption(with_tar=False))
+    assert len(merged.blob_digests) == 1
