@@ -9,6 +9,7 @@ a user of that toolchain finds the workflow here:
     python -m nydus_snapshotter_tpu.cmd.convert merge  --out image.boot layer1.nydus layer2.nydus [--chunk-dict d.boot]
     python -m nydus_snapshotter_tpu.cmd.convert unpack --boot image.boot --blob-dir blobs/ --out layer.tar
     python -m nydus_snapshotter_tpu.cmd.convert check  --boot image.boot
+    python -m nydus_snapshotter_tpu.cmd.convert inspect --boot image.boot [--path /etc/foo | --list /etc | --prefix /opt]
     python -m nydus_snapshotter_tpu.cmd.convert batch  --out-dir converted/ --dict-out dict.boot img1.tar,img2.tar ...
     python -m nydus_snapshotter_tpu.cmd.convert export-erofs --boot image.boot --tar-dir tars/ --out image.erofs
 
@@ -113,6 +114,95 @@ def cmd_unpack(args) -> int:
     with open(args.out, "wb") as f:
         f.write(tar)
     print(json.dumps({"tar_bytes": len(tar)}))
+    return 0
+
+
+def _inode_json(bs, ino) -> dict:
+    out = {
+        "path": ino.path,
+        "mode": oct(ino.mode),
+        "uid": ino.uid,
+        "gid": ino.gid,
+        "mtime": ino.mtime,
+        "size": ino.size,
+    }
+    if ino.symlink_target:
+        out["symlink"] = ino.symlink_target
+    if ino.hardlink_target:
+        out["hardlink"] = ino.hardlink_target
+    if ino.xattrs:
+        out["xattrs"] = sorted(ino.xattrs)
+    if ino.chunk_count:
+        end = ino.chunk_index + ino.chunk_count
+        if ino.chunk_index < 0 or end > len(bs.chunks):
+            raise SystemExit(
+                f"ntpu-convert: inode {ino.path!r} chunk run "
+                f"[{ino.chunk_index}, {end}) overruns the chunk table "
+                f"of {len(bs.chunks)} records (corrupt bootstrap)"
+            )
+        out["chunks"] = [
+            {
+                "digest": c.digest.hex(),
+                "blob": bs.blobs[c.blob_index].blob_id
+                if 0 <= c.blob_index < len(bs.blobs)
+                else f"<invalid blob index {c.blob_index}>",
+                "compressed_offset": c.compressed_offset,
+                "compressed_size": c.compressed_size,
+                "uncompressed_size": c.uncompressed_size,
+                "flags": c.flags,
+            }
+            for c in bs.chunks[ino.chunk_index : end]
+        ]
+    return out
+
+
+def cmd_inspect(args) -> int:
+    """``nydus-image inspect`` shape: query the inode tree of a bootstrap
+    (either layout — native or real-toolchain)."""
+    from nydus_snapshotter_tpu.models.nydus_real import load_any_bootstrap
+
+    with open(args.boot, "rb") as f:
+        bs = load_any_bootstrap(f.read())
+    by_path = {i.path: i for i in bs.inodes}
+    if args.path:
+        norm = "/" + args.path.strip("/") if args.path != "/" else "/"
+        ino = by_path.get(norm)
+        if ino is None:
+            print(f"ntpu-convert: no inode at {args.path!r}", file=sys.stderr)
+            return 1
+        print(json.dumps(_inode_json(bs, ino)))
+        return 0
+    if args.list_dir:
+        d = "/" + args.list_dir.strip("/") if args.list_dir != "/" else "/"
+        if d != "/" and d not in by_path:
+            print(f"ntpu-convert: no directory at {args.list_dir!r}", file=sys.stderr)
+            return 1
+        prefix = d.rstrip("/") + "/" if d != "/" else "/"
+        names = sorted(
+            p[len(prefix) :]
+            for p in by_path
+            if p != "/" and p.startswith(prefix) and "/" not in p[len(prefix) :]
+        )
+        print(json.dumps({"dir": d, "entries": names}))
+        return 0
+    pfx = ("/" + args.prefix.strip("/")) if args.prefix else ""
+    paths = sorted(
+        p
+        for p in by_path
+        # component-boundary prefix match: /opt must not pull in /opt2
+        if not pfx or p == pfx or p.startswith(pfx.rstrip("/") + "/")
+    )
+    print(
+        json.dumps(
+            {
+                "version": bs.version,
+                "inodes": len(bs.inodes),
+                "chunks": len(bs.chunks),
+                "blobs": [b.blob_id for b in bs.blobs],
+                "paths": paths,
+            }
+        )
+    )
     return 0
 
 
@@ -240,6 +330,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--blob-dir", required=True)
     sp.add_argument("--out", required=True)
     sp.set_defaults(fn=cmd_unpack)
+
+    sp = sub.add_parser(
+        "inspect", help="query a bootstrap: tree listing / per-path detail"
+    )
+    sp.add_argument("--boot", required=True)
+    g = sp.add_mutually_exclusive_group()
+    g.add_argument("--path", default="", help="inspect one path in detail")
+    g.add_argument("--list", dest="list_dir", default="",
+                   help="list the entries of a directory path")
+    g.add_argument("--prefix", default="",
+                   help="restrict the full listing to a path prefix")
+    sp.set_defaults(fn=cmd_inspect)
 
     sp = sub.add_parser("check", help="validate + describe a bootstrap")
     sp.add_argument("--boot", required=True)

@@ -148,3 +148,76 @@ def test_oci_ref_output_feeds_merge(tmp_path):
     digests = json.loads(out.stdout)["blob_digests"]
     import hashlib
     assert digests == [hashlib.sha256(src.read_bytes()).hexdigest()]
+
+
+def test_inspect_subcommand(tmp_path):
+    """`ntpu-convert inspect`: tree listing, per-path chunk detail, dir
+    listing — the `nydus-image inspect` surface (SURVEY §2.2)."""
+    import io
+    import tarfile
+
+    import numpy as np
+
+    from nydus_snapshotter_tpu.converter.convert import Merge, pack_layer
+    from nydus_snapshotter_tpu.converter.types import MergeOption, PackOption
+
+    rng = np.random.default_rng(8)
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for name, size in (("app/a.bin", 150_000), ("app/sub/b.bin", 3000)):
+            ti = tarfile.TarInfo(name)
+            ti.size = size
+            tf.addfile(ti, io.BytesIO(rng.integers(0, 256, size, dtype=np.uint8).tobytes()))
+    blob, _res = pack_layer(buf.getvalue(), PackOption(chunk_size=0x10000))
+    merged = Merge([blob], MergeOption(with_tar=False))
+    boot = tmp_path / "img.boot"
+    boot.write_bytes(merged.bootstrap)
+
+    out = run_cli("inspect", "--boot", str(boot))
+    assert out.returncode == 0, out.stderr[-300:]
+    d = json.loads(out.stdout.strip())
+    assert "/app/a.bin" in d["paths"] and d["inodes"] >= 4
+
+    out = run_cli("inspect", "--boot", str(boot), "--path", "/app/a.bin")
+    d = json.loads(out.stdout.strip())
+    assert d["size"] == 150_000 and len(d["chunks"]) >= 2
+    assert all(len(c["digest"]) == 64 for c in d["chunks"])
+
+    out = run_cli("inspect", "--boot", str(boot), "--list", "/app")
+    d = json.loads(out.stdout.strip())
+    assert d["entries"] == ["a.bin", "sub"]
+
+    out = run_cli("inspect", "--boot", str(boot), "--path", "/nope")
+    assert out.returncode == 1
+
+
+def test_inspect_edge_semantics(tmp_path):
+    """inspect flag semantics: mutually exclusive queries, missing dir is
+    rc 1 (not an empty listing), trailing slashes normalize, prefix
+    matches path components."""
+    import io
+    import tarfile
+
+    import numpy as np
+
+    from nydus_snapshotter_tpu.converter.convert import Merge, pack_layer
+    from nydus_snapshotter_tpu.converter.types import MergeOption, PackOption
+
+    rng = np.random.default_rng(9)
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for name in ("opt/x.bin", "opt2/y.bin"):
+            ti = tarfile.TarInfo(name)
+            ti.size = 1000
+            tf.addfile(ti, io.BytesIO(rng.integers(0, 256, 1000, dtype=np.uint8).tobytes()))
+    blob, _ = pack_layer(buf.getvalue(), PackOption(chunk_size=0x10000))
+    boot = tmp_path / "e.boot"
+    boot.write_bytes(Merge([blob], MergeOption(with_tar=False)).bootstrap)
+
+    assert run_cli("inspect", "--boot", str(boot), "--path", "/opt/", ).returncode == 0
+    assert run_cli("inspect", "--boot", str(boot), "--list", "/typo").returncode == 1
+    out = run_cli("inspect", "--boot", str(boot), "--prefix", "/opt")
+    d = json.loads(out.stdout.strip())
+    assert "/opt/x.bin" in d["paths"] and not any(p.startswith("/opt2") for p in d["paths"])
+    conflicting = run_cli("inspect", "--boot", str(boot), "--path", "/opt", "--list", "/opt")
+    assert conflicting.returncode != 0
