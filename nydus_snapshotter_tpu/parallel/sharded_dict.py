@@ -15,6 +15,10 @@ sharded across the mesh:
   (first insertion wins), then MAX_PROBE rounds of batched scatter where
   slot conflicts are resolved first-come (np.unique on linearized slots).
   Deterministic and identical to the sequential insertion order.
+  ``capacity_factor`` is the probe-latency/HBM dial: device probes pay
+  per chain-depth row (the whole window is gathered/DMA'd), so a
+  device-probe-heavy deployment builds at factor 8 (~8-deep chains) while
+  the memory-lean default of 2 suits the early-exiting host arm.
 - **Probe.** Queries arrive row-sharded over the ``data`` axis. Default
   path: bucketed **all_to_all** routing inside ``shard_map`` — each device
   bins its local queries by owning shard into fixed-capacity buckets,

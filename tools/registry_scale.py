@@ -57,22 +57,31 @@ def host_phase(entries_m: int, tmpdir: str) -> dict:
     queries = np.concatenate(
         [digests[hit_rows], rng.integers(0, 2**32, (m - m // 2, 8), dtype=np.uint32)]
     )
-    t0 = time.perf_counter()
-    r1 = sd.lookup_u32(queries)
-    t_probe = time.perf_counter() - t0
+    # min-of-reps on every timing cheap enough to repeat: this box's
+    # 1 vCPU shares a noisy host and single runs swing 2-3x (measured).
+    # The two long single-run timings (build, grow) are labelled so.
+    t_probe = float("inf")
+    for _rep in range(5):
+        t0 = time.perf_counter()
+        r1 = sd.lookup_u32(queries)
+        t_probe = min(t_probe, time.perf_counter() - t0)
     r2 = sd.lookup_u32(queries)
     probe_deterministic = bool(np.array_equal(r1, r2))
     # Hits must resolve to the exact inserted indices (first-wins order).
     hits_ok = bool(np.array_equal(r1[: m // 2], hit_rows))
 
-    # Persistence round trip.
+    # Persistence round trip (save is disk-bound: min-of-3).
     path = os.path.join(tmpdir, "dict.npz")
-    t0 = time.perf_counter()
-    sd.save(path)
-    t_save = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    sd2 = ShardedChunkDict.load(path, mesh, probe_backend="host")
-    t_load = time.perf_counter() - t0
+    t_save = float("inf")
+    for _rep in range(3):
+        t0 = time.perf_counter()
+        sd.save(path)
+        t_save = min(t_save, time.perf_counter() - t0)
+    t_load = float("inf")
+    for _rep in range(3):
+        t0 = time.perf_counter()
+        sd2 = ShardedChunkDict.load(path, mesh, probe_backend="host")
+        t_load = min(t_load, time.perf_counter() - t0)
     reload_identical = bool(np.array_equal(sd2.lookup_u32(queries), r1))
 
     # Incremental growth: append 2M new entries; old indices must be
@@ -92,6 +101,7 @@ def host_phase(entries_m: int, tmpdir: str) -> dict:
     return {
         "entries": n,
         "build_s": round(t_build, 2),
+        "build_single_run": True,  # too long to repeat; noise-prone
         "build_entries_per_s": round(n / t_build),
         "probe_queries": m,
         "probe_s": round(t_probe, 3),
@@ -105,6 +115,7 @@ def host_phase(entries_m: int, tmpdir: str) -> dict:
         "reload_probe_identical": reload_identical,
         "grow_entries": len(grow),
         "grow_rebuild_s": round(t_grow, 2),
+        "grow_single_run": True,
         "grown_old_indices_stable": grown_old_stable,
         "grown_new_entries_found": grown_new_found,
     }
@@ -124,7 +135,12 @@ n = %(mesh_entries)d
 rng = np.random.default_rng(7)
 digests = rng.integers(0, 2**32, (n, 8), dtype=np.uint32)
 mesh = mesh_lib.make_mesh(8)
-sd_dev = ShardedChunkDict(digests, mesh, probe_backend="device")
+# Device-probe deployment point: probe cost scales with the table's max
+# chain depth, so the HBM-resident mesh table trades capacity for depth
+# (capacity_factor 8 -> chains ~8 deep instead of ~50 at factor 2; the
+# host arm is depth-insensitive thanks to its early exit).
+CAPACITY_FACTOR = 8.0
+sd_dev = ShardedChunkDict(digests, mesh, probe_backend="device", capacity_factor=CAPACITY_FACTOR)
 sd_host = ShardedChunkDict(digests, mesh, probe_backend="host")
 
 m = %(mesh_queries)d
@@ -133,16 +149,25 @@ q = np.concatenate([
     rng.integers(0, 2**32, (m - m // 2, 8), dtype=np.uint32),
 ])
 r_dev = np.asarray(sd_dev.lookup_u32(q))     # compile + first run
-t0 = time.perf_counter()
-r_dev2 = np.asarray(sd_dev.lookup_u32(q))
-t_dev = time.perf_counter() - t0
+# min-of-reps: this box's 1 vCPU shares a noisy host — single timed
+# runs swing 2-3x run-to-run (measured); min over the reps below is the
+# guard, and the full rep list lands in the artifact.
+t_reps = []
+for _rep in range(5):
+    t0 = time.perf_counter()
+    r_dev2 = np.asarray(sd_dev.lookup_u32(q))
+    t_reps.append(time.perf_counter() - t0)
+t_dev = min(t_reps)
 r_host = sd_host.lookup_u32(q)
 print(json.dumps({
     "mesh_devices": 8,
     "dict_entries": n,
+    "capacity_factor": CAPACITY_FACTOR,
+    "probe_chain_depth": sd_dev.max_depth,
     "queries": m,
     "routed_probe_s": round(t_dev, 3),
     "routed_probe_per_s": round(m / t_dev),
+    "routed_probe_per_s_reps": [round(m / t) for t in t_reps],
     "routed_equals_host": bool(np.array_equal(r_dev2, r_host)),
     "routed_deterministic": bool(np.array_equal(r_dev, r_dev2)),
 }))
