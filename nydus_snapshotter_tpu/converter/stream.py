@@ -423,13 +423,25 @@ class _DeferredSectionWriter:
             self.hasher._d = hasher.digest()
             return
         blob, comp_ext, digest = res
+        self._adopt(blob, comp_ext, digest)
+
+    def _adopt(self, blob, comp_extents, digest: bytes) -> None:
+        """Adopt a native pass's assembled section (shared by finish()
+        and finish_fused())."""
         self.extents = [
-            (int(comp_ext[j, 0]), int(comp_ext[j, 1]), self._cflag)
-            for j in range(m)
+            (int(comp_extents[j, 0]), int(comp_extents[j, 1]), self._cflag)
+            for j in range(comp_extents.shape[0])
         ]
         self.hasher._d = digest
-        self.out.write(memoryview(blob))
+        if blob.size:
+            self.out.write(memoryview(blob))
         self.coff = int(blob.size)
+
+    def finish_fused(self, blob, comp_extents, digest: bytes) -> None:
+        """Adopt the whole-layer fused pass's output (ntpu_pack_files):
+        the native call already compressed/assembled/hashed; nothing was
+        ever add()ed, so the regular finish() stays a no-op."""
+        self._adopt(blob, comp_extents, digest)
 
 
 @dataclass
@@ -683,6 +695,7 @@ def pack_stream(
 
     _t_chunk = 0.0
     _t_spec = 0.0  # speculative compression (counts toward 'assemble')
+    _t_fused = 0.0  # whole-layer fused pass (chunk+dedup+assemble in one)
 
     opt.validate()
     # In-memory layers take the zero-copy path: random-access tar parse,
@@ -892,7 +905,63 @@ def pack_stream(
             and opt.chunking == "cdc"
             and native_cdc.chunk_digest_multi_available()
         )
-        if use_multi:
+        # Whole-layer fused lane: chunk + digest + first-wins dedup +
+        # compress + assemble + blob hash in ONE native call (the
+        # reference's entire `nydus-image create` hot loop). Applies when
+        # there is no chunk dict (dict probes stay in the Python dedup
+        # lane) and the storage layout is the deferred writer's.
+        if (
+            use_multi
+            and chunk_dict is None
+            and isinstance(section, _DeferredSectionWriter)
+            and native_cdc.pack_files_available()
+            # the walk must not have seeded any chunk state already
+            # (sparse members stream through _process during the walk):
+            # the fused pass owns the WHOLE dedup/storage state or none.
+            and uoff == 0
+            and not own_chunks
+            and not pending
+            and in_flight is None
+            and not section._items
+        ):
+            ext = np.asarray(
+                [(off, size) for _t, _m, off, size in plan], dtype=np.int64
+            )
+            _tc = _pc()
+            fused = native_cdc.pack_files(
+                arr_all, ext, params, section._kind, section._accel, n_threads
+            )
+            if fused is not None:
+                digs = fused["digests"]
+                sizes_arr = fused["chunk_sizes"]
+                uniq_arr = fused["chunk_uniq"]
+                pos = 0
+                for (_tag, meta, _off, _size), nc in zip(
+                    plan, fused["file_nchunks"]
+                ):
+                    for k in range(int(nc)):
+                        meta.chunks.append(
+                            _ChunkRef(
+                                digest=digs[32 * (pos + k) : 32 * (pos + k + 1)],
+                                size=int(sizes_arr[pos + k]),
+                                uniq_idx=int(uniq_arr[pos + k]),
+                            )
+                        )
+                    pos += int(nc)
+                usz = fused["uniq_sizes"]
+                if len(usz):
+                    uncomp_offsets = (
+                        np.concatenate([[0], np.cumsum(usz[:-1])])
+                        .astype(np.int64)
+                        .tolist()
+                    )
+                    uoff = int(usz.sum())
+                section.finish_fused(
+                    fused["blob"], fused["comp_extents"], fused["blob_digest"]
+                )
+                plan = []
+                _t_fused += _pc() - _tc
+        if use_multi and plan:
             ext = np.asarray(
                 [(off, size) for _t, _m, off, size in plan], dtype=np.int64
             )
@@ -1163,8 +1232,10 @@ def pack_stream(
     if stats is not None:
         stats["scan"] = stats.get("scan", 0.0) + (_t1 - _t0)
         stats["chunk_digest"] = stats.get("chunk_digest", 0.0) + _t_chunk
+        # fused_pack spans chunk+dedup+assemble inside one native call
+        stats["fused_pack"] = stats.get("fused_pack", 0.0) + _t_fused
         stats["dedup"] = stats.get("dedup", 0.0) + (
-            _t2 - _t1 - _t_chunk - _t_spec
+            _t2 - _t1 - _t_chunk - _t_spec - _t_fused
         )
         stats["assemble"] = stats.get("assemble", 0.0) + (_t3 - _t2) + _t_spec
         stats["bootstrap"] = stats.get("bootstrap", 0.0) + (_pc() - _t3)

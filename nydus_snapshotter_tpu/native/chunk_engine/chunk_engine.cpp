@@ -788,4 +788,109 @@ int64_t ntpu_pack_section(const uint8_t *src0, const uint8_t *src1,
   return coff;
 }
 
+// Whole-layer fused pack: chunk + digest + first-wins dedup + compress +
+// blob assembly + blob SHA-256 in ONE native pass over the planned file
+// extents (no chunk-dict arm — dictionary packs keep the Python dedup
+// lane). This is the full in-process equivalent of the reference's
+// `nydus-image create` hot loop (pkg/converter/tool/builder.go:148-178).
+//
+// Inputs: data/n = the tar buffer; extents = m (off, size) pairs in tar
+// order; CDC params; compressor (0 raw, 1 lz4) + accel + n_threads for
+// the assembly phase.
+// Outputs: per-file chunk counts; per-chunk-ref digest32 / size /
+// unique-index (first occurrence wins, indices dense in first-seen
+// order); per-unique (coff, csize) extents; the assembled blob and its
+// SHA-256. n_uniq_out / blob_size_out receive the table sizes.
+// Returns total chunk refs; -1 overflow/OOM; -2 lz4 unavailable.
+int64_t ntpu_pack_files(const uint8_t *data, int64_t n,
+                        const int64_t *extents, int64_t m,
+                        uint32_t mask_small, uint32_t mask_large,
+                        int64_t min_size, int64_t normal_size,
+                        int64_t max_size, int64_t compressor, int64_t accel,
+                        int64_t n_threads, int64_t *file_nchunks,
+                        uint8_t *digests_out, int64_t *chunk_sizes,
+                        int64_t *chunk_uniq, int64_t refs_cap,
+                        int64_t *comp_extents, uint8_t *out_blob,
+                        int64_t out_cap, uint8_t *blob_digest32,
+                        int64_t *n_uniq_out, int64_t *blob_size_out) {
+  (void)n;
+  // Phase 1: fused chunk+digest per file (same kernel as the multi call).
+  int64_t total = 0;
+  std::vector<int64_t> cuts((size_t)refs_cap);
+  for (int64_t i = 0; i < m; ++i) {
+    const int64_t off = extents[2 * i];
+    const int64_t size = extents[2 * i + 1];
+    const int64_t c = ntpu_chunk_digest(
+        data + off, size, mask_small, mask_large, min_size, normal_size,
+        max_size, cuts.data() + total, refs_cap - total,
+        digests_out + 32 * total);
+    if (c < 0) return -1;
+    file_nchunks[i] = c;
+    total += c;
+  }
+
+  // Phase 2: sequential first-wins dedup over the refs in tar order.
+  // Open addressing keyed on the digest's first 8 bytes, full 32-byte
+  // confirm; values are dense unique indices in first-seen order.
+  int64_t tab_cap = 64;
+  while (tab_cap < 2 * total) tab_cap <<= 1;
+  std::vector<int64_t> slots((size_t)tab_cap, -1);
+  std::vector<int64_t> uniq_off((size_t)(total > 0 ? total : 1));
+  std::vector<int64_t> uniq_size((size_t)(total > 0 ? total : 1));
+  std::vector<int64_t> uniq_first_ref((size_t)(total > 0 ? total : 1));
+  int64_t n_uniq = 0;
+  {
+    int64_t ref = 0;
+    for (int64_t i = 0; i < m; ++i) {
+      const int64_t base = extents[2 * i];
+      int64_t s = 0;
+      for (int64_t k = 0; k < file_nchunks[i]; ++k, ++ref) {
+        const int64_t end = cuts[(size_t)ref];
+        const int64_t sz = end - s;
+        chunk_sizes[ref] = sz;
+        const uint8_t *dig = digests_out + 32 * ref;
+        uint64_t h;
+        std::memcpy(&h, dig, 8);
+        int64_t slot = (int64_t)(h & (uint64_t)(tab_cap - 1));
+        int64_t idx = -1;
+        for (;;) {
+          const int64_t v = slots[(size_t)slot];
+          if (v < 0) {
+            slots[(size_t)slot] = n_uniq;
+            uniq_off[(size_t)n_uniq] = base + s;
+            uniq_size[(size_t)n_uniq] = sz;
+            uniq_first_ref[(size_t)n_uniq] = ref;
+            idx = n_uniq++;
+            break;
+          }
+          if (std::memcmp(
+                  digests_out + 32 * uniq_first_ref[(size_t)v], dig, 32) == 0) {
+            idx = v;
+            break;
+          }
+          slot = (slot + 1) & (tab_cap - 1);
+        }
+        chunk_uniq[ref] = idx;
+        s = end;
+      }
+    }
+  }
+
+  // Phase 3: compress + assemble the unique chunks (the pack_section
+  // core), then hash the section.
+  std::vector<int64_t> triples((size_t)n_uniq * 3);
+  for (int64_t u = 0; u < n_uniq; ++u) {
+    triples[(size_t)(3 * u)] = 0;
+    triples[(size_t)(3 * u + 1)] = uniq_off[(size_t)u];
+    triples[(size_t)(3 * u + 2)] = uniq_size[(size_t)u];
+  }
+  const int64_t blob = ntpu_pack_section(
+      data, nullptr, triples.data(), n_uniq, compressor, accel, n_threads,
+      out_blob, out_cap, comp_extents, blob_digest32);
+  if (blob < 0) return blob;
+  *n_uniq_out = n_uniq;
+  *blob_size_out = blob;
+  return total;
+}
+
 }  // extern "C"

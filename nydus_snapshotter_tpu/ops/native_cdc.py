@@ -118,6 +118,22 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_int64,  # cuts_out, cap
                 ctypes.c_void_p,  # digests_out
             ]
+        if hasattr(lib, "ntpu_pack_files"):
+            lib.ntpu_pack_files.restype = ctypes.c_int64
+            lib.ntpu_pack_files.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,  # data, n
+                ctypes.c_void_p, ctypes.c_int64,  # extents, m
+                ctypes.c_uint32, ctypes.c_uint32,  # masks
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # min/normal/max
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # comp, accel, threads
+                ctypes.c_void_p,  # file_nchunks
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,  # digests, sizes, uniq
+                ctypes.c_int64,  # refs_cap
+                ctypes.c_void_p,  # comp_extents
+                ctypes.c_void_p, ctypes.c_int64,  # out_blob, out_cap
+                ctypes.c_void_p,  # blob_digest32
+                ctypes.c_void_p, ctypes.c_void_p,  # n_uniq_out, blob_size_out
+            ]
         if hasattr(lib, "ntpu_pack_section"):
             lib.ntpu_pack_section.restype = ctypes.c_int64
             lib.ntpu_pack_section.argtypes = [
@@ -263,6 +279,99 @@ def sha256_many_native(data: np.ndarray, extents: np.ndarray) -> bytes:
     out = np.empty(m * 32, dtype=np.uint8)
     lib.ntpu_sha256_many(arr.ctypes.data, ext.ctypes.data, m, out.ctypes.data)
     return out.tobytes()
+
+
+def pack_files_available() -> bool:
+    """The whole-layer fused pack arm (chunk+digest+dedup+assemble)."""
+    lib = load()
+    return lib is not None and hasattr(lib, "ntpu_pack_files")
+
+
+def pack_files(
+    data: np.ndarray,
+    extents: np.ndarray,
+    params: cdc.CDCParams,
+    compressor: int,
+    accel: int = 1,
+    n_threads: int = 1,
+):
+    """One native pass over a layer's planned file extents: CDC chunking,
+    SHA-256 digests, first-wins dedup, per-unique compression, blob
+    assembly, blob SHA-256 (the `nydus-image create` hot loop in one
+    call). Returns None when the arm cannot run (library/liblz4 absent);
+    else a dict with file_nchunks, digests, chunk_sizes, chunk_uniq,
+    uniq_sizes, comp_extents, blob (np view), blob_digest. Per-chunk and
+    blob bytes are bit-identical to the per-stage lanes.
+    """
+    lib = load()
+    if lib is None or not hasattr(lib, "ntpu_pack_files"):
+        return None
+    arr = np.ascontiguousarray(data, dtype=np.uint8)
+    ext = np.ascontiguousarray(extents, dtype=np.int64)
+    m = ext.shape[0]
+    if m == 0:
+        import hashlib
+
+        return {
+            "file_nchunks": np.zeros(0, np.int64),
+            "digests": b"",
+            "chunk_sizes": np.zeros(0, np.int64),
+            "chunk_uniq": np.zeros(0, np.int64),
+            "uniq_sizes": np.zeros(0, np.int64),
+            "comp_extents": np.zeros((0, 2), np.int64),
+            "blob": np.zeros(0, np.uint8),
+            # same contract as the separable lanes: digest of the empty blob
+            "blob_digest": hashlib.sha256(b"").digest(),
+        }
+    sizes = ext[:, 1]
+    refs_cap = int((sizes // max(1, params.min_size)).sum()) + 2 * m
+    total_bytes = int(sizes.sum())
+    out_cap = (
+        total_bytes + total_bytes // 255 + 16 * refs_cap
+        if compressor == 1
+        else total_bytes
+    )
+    file_nchunks = np.empty(m, np.int64)
+    digests = np.empty(refs_cap * 32, np.uint8)
+    chunk_sizes = np.empty(refs_cap, np.int64)
+    chunk_uniq = np.empty(refs_cap, np.int64)
+    comp = np.empty((refs_cap, 2), np.int64)
+    blob = np.empty(max(out_cap, 1), np.uint8)
+    blob_digest = np.empty(32, np.uint8)
+    n_uniq = np.zeros(1, np.int64)
+    blob_size = np.zeros(1, np.int64)
+    total = lib.ntpu_pack_files(
+        arr.ctypes.data, arr.size,
+        ext.ctypes.data, m,
+        np.uint32(params.mask_small), np.uint32(params.mask_large),
+        params.min_size, params.normal_size, params.max_size,
+        compressor, accel, max(1, n_threads),
+        file_nchunks.ctypes.data,
+        digests.ctypes.data, chunk_sizes.ctypes.data, chunk_uniq.ctypes.data,
+        refs_cap,
+        comp.ctypes.data,
+        blob.ctypes.data, blob.size,
+        blob_digest.ctypes.data,
+        n_uniq.ctypes.data, blob_size.ctypes.data,
+    )
+    if total == -2:
+        return None
+    if total < 0:
+        raise RuntimeError("native pack_files failed (overflow or OOM)")
+    nu = int(n_uniq[0])
+    uniq_first = np.zeros(nu, dtype=np.int64)
+    # first-wins: walking refs backward records each unique's FIRST ref
+    uniq_first[chunk_uniq[:total][::-1]] = np.arange(total - 1, -1, -1)
+    return {
+        "file_nchunks": file_nchunks,
+        "digests": digests[: total * 32].tobytes(),
+        "chunk_sizes": chunk_sizes[:total],
+        "chunk_uniq": chunk_uniq[:total],
+        "uniq_sizes": chunk_sizes[:total][uniq_first],
+        "comp_extents": comp[:nu],
+        "blob": blob[: int(blob_size[0])],
+        "blob_digest": blob_digest.tobytes(),
+    }
 
 
 def pack_section_available() -> bool:

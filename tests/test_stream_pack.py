@@ -329,3 +329,58 @@ class TestDeferredDifferentialFuzz:
             out = io.BytesIO()
             pack_stream(out, io.BytesIO(raw), opt, chunk_dict=cdict)
             assert fast == out.getvalue()
+
+
+def _gnu_sparse_member() -> bytes:
+    """Hand-crafted GNU sparse ('S') member: 8192-byte file, one 512-byte
+    data region at offset 0 (tarfile can read but not write sparse)."""
+    hdr = bytearray(512)
+    hdr[0:10] = b"sparse.bin"
+    hdr[100:108] = b"0000644\x00"
+    hdr[108:116] = b"0000000\x00"
+    hdr[116:124] = b"0000000\x00"
+    hdr[124:136] = b"00000001000\x00"  # stored data: 512 bytes (octal)
+    hdr[136:148] = b"00000000000\x00"
+    hdr[156] = ord("S")
+    hdr[257:265] = b"ustar  \x00"  # GNU magic
+    hdr[386:398] = b"00000000000\x00"  # sparse[0].offset = 0
+    hdr[398:410] = b"00000001000\x00"  # sparse[0].numbytes = 512
+    hdr[483:495] = b"00000020000\x00"  # realsize = 8192 (octal)
+    hdr[148:156] = b" " * 8
+    hdr[148:156] = ("%06o\0 " % sum(hdr)).encode()
+    return bytes(hdr) + b"\xab" * 512
+
+
+class TestSparseMemberFusedGate:
+    def test_sparse_plus_plan_files_identical_paths(self):
+        """A layer mixing a sparse member (streams through the walk,
+        seeding dedup/storage state) with normal files (planned) must
+        stay byte-identical between the fast and streaming paths — the
+        whole-layer fused lane must disable itself when the walk already
+        seeded state."""
+        rng = np.random.default_rng(31)
+        norm = io.BytesIO()
+        with tarfile.open(fileobj=norm, mode="w", format=tarfile.GNU_FORMAT) as tf:
+            for i in range(4):
+                data = rng.integers(0, 256, 90_000, dtype=np.uint8).tobytes()
+                ti = tarfile.TarInfo(f"n{i}")
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        raw = _gnu_sparse_member() + norm.getvalue()
+        # sanity: tarfile sees the sparse member with its real size
+        with tarfile.open(fileobj=io.BytesIO(raw)) as tf:
+            m0 = tf.getmembers()[0]
+            assert m0.issparse() and m0.size == 8192
+            content = tf.extractfile(m0).read()
+            assert content == b"\xab" * 512 + b"\x00" * (8192 - 512)
+        opt = PackOption(chunk_size=0x4000)
+        blob_fast, res = pack_layer(raw, opt)
+        out = io.BytesIO()
+        pack_stream(out, io.BytesIO(raw), opt)
+        assert blob_fast == out.getvalue()
+        back = Unpack(
+            res.bootstrap, {res.blob_id: blob_data_from_layer_blob(blob_fast)}
+        )
+        with tarfile.open(fileobj=io.BytesIO(back)) as tf:
+            got = tf.extractfile("sparse.bin").read()
+        assert got == content
