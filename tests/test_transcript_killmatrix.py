@@ -254,7 +254,54 @@ class TestCacheCleared:
             mgr.stop()
 
 
+class TestSharedImageMultipleContainers:
+    def test_two_containers_share_one_mount(self, tmp_path):
+        """entrypoint.sh start_multiple_containers_same_image analog over
+        gRPC: two container snapshots on one image chain share the meta
+        mount (refcount 2); removing one keeps the other served; removing
+        both releases the instance."""
+        cfg = _mk_cfg(tmp_path)
+        boot, blob_dir, files = _build_image(tmp_path)
+        db, mgr, fs, sn, server, client, sock = _mk_stack(cfg)
+        try:
+            ctr1, chain, mounts1 = _pull_and_run(client, sn, fs, boot, blob_dir)
+            ctr2 = "ctr-img-second"
+            client.prepare(ctr2, chain, labels={C.CRI_IMAGE_REF: IMAGE_REF})
+            mounts2 = client.mounts(ctr2)
+            # both overlays stack on the SAME rafs lowerdir
+            assert _lowerdir_of(mounts1) == _lowerdir_of(mounts2)
+            daemon = fs.get_shared_daemon(C.FS_DRIVER_FUSEDEV)
+            rafs = fs.instances.list()[0]
+            snap_id = rafs.snapshot_id
+            read = lambda: daemon.client().read_file(  # noqa: E731
+                f"/{snap_id}", "/app/hello.txt"
+            )
+            assert read() == files["/app/hello.txt"]
+            # removing ONE container (and running the periodic Cleanup
+            # containerd drives): the shared image must survive — a
+            # sibling container still references it
+            client.remove(ctr1)
+            client.cleanup()
+            assert fs.instances.get(snap_id) is not None
+            assert read() == files["/app/hello.txt"]
+            assert client.mounts(ctr2)
+            # removing the second AND the committed chain, then the
+            # periodic Cleanup containerd drives, releases the instance
+            client.remove(ctr2)
+            client.remove(chain)
+            client.cleanup()  # releases the instance synchronously
+            assert fs.instances.get(snap_id) is None, "instance not released"
+        finally:
+            client.close()
+            server.stop(grace=None)
+            fs.teardown()
+            sn.close()
+            mgr.stop()
+
+
 if __name__ == "__main__":
     import sys
 
     sys.exit(pytest.main([__file__, "-q", "-x"]))
+
+
