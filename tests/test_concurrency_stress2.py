@@ -111,7 +111,9 @@ class TestFuseTakeoverStorm:
         # D-state pytest + live dead mount behind. Dump goes to a file so
         # output-capturing runs still leave evidence.
         self._watchdog_log = open("/tmp/ntpu_storm_watchdog.txt", "w")
-        faulthandler.dump_traceback_later(180, exit=True, file=self._watchdog_log)
+        # 420s: headroom for the widened daemon-start waits under box
+        # contention; still converts a genuine D-state wedge into a dump.
+        faulthandler.dump_traceback_later(420, exit=True, file=self._watchdog_log)
         import hashlib
 
         boot, blob_dir = _build_image(str(tmp_path))
@@ -237,7 +239,10 @@ def _spawn_nofuse_daemon(d: str, name: str):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     cli = NydusdClient(sock)
-    cli.wait_until_socket_exists(15)
+    # 60s: the daemon is a fresh interpreter importing jax-adjacent modules;
+    # under heavy box contention (parallel suite + device-hunt stages) 15s
+    # has been observed to flake.
+    cli.wait_until_socket_exists(60)
     return proc, cli
 
 
