@@ -1,0 +1,476 @@
+"""Writers for REAL nydus-toolchain bootstrap layouts (RAFS v5, v6).
+
+models/nydus_real.py made real bootstraps first-class *inputs*; this
+module is the other direction: serialize a bootstrap in the reference
+toolchain's own on-disk layout, so images this framework converts can be
+consumed by the reference ecosystem (nydusd mounts v5/v6 bootstraps
+produced by `nydus-image`; pkg/filesystem/fs.go:268-431 never sees any
+other format). Layout knowledge is the same field maps the reader was
+validated with on the committed real fixtures; the reader is the
+round-trip oracle for everything written here.
+
+Digest semantics (reverse-engineered structurally from the v5 fixture,
+where every one of its 3,517 inode digests matches):
+
+- regular file:  H(concat of its chunk digests)   (2602/2602 fixture inodes)
+- symlink:       H(target bytes)                  (212/212)
+- directory:     H(concat of child digests, children sorted by name,
+                 computed bottom-up)              (678/678)
+- empty file / special file: H(b"")
+- hardlink alias: the target inode's digest
+
+with H = blake3 (RafsSuperFlags 0x4, the toolchain default — see
+utils/blake3.py) or sha256 (0x8). `real_from_bootstrap` computes these
+when bridging the framework's internal model; fixture-parsed
+RealBootstraps keep their digests verbatim.
+
+Superblock flag bits (nydus RafsSuperFlags, validated against both
+fixtures: v5 carries 0x16, v6 carries 0x6):
+0x1 none / 0x2 lz4_block / 0x40 gzip / 0x80 zstd compressor;
+0x4 blake3 / 0x8 sha256 digester; 0x10 explicit uid/gid; 0x20 xattrs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import stat as statmod
+import struct
+
+from nydus_snapshotter_tpu import constants
+from nydus_snapshotter_tpu.models import layout
+from nydus_snapshotter_tpu.models.nydus_real import (
+    RealBlob,
+    RealBootstrap,
+    RealBootstrapError,
+    RealChunk,
+    RealInode,
+    _V5_CHUNK,
+    _V5_FLAG_HARDLINK,
+    _V5_FLAG_SYMLINK,
+    _V5_FLAG_XATTR,
+    _V5_INODE,
+    _V5_SB,
+)
+from nydus_snapshotter_tpu.utils.blake3 import blake3
+
+__all__ = ["real_from_bootstrap", "write_real_v5"]
+
+_FLAG_COMP_NONE = 0x1
+_FLAG_COMP_LZ4 = 0x2
+_FLAG_HASH_BLAKE3 = 0x4
+_FLAG_HASH_SHA256 = 0x8
+_FLAG_EXPLICIT_UIDGID = 0x10
+_FLAG_HAS_XATTR = 0x20
+_FLAG_COMP_GZIP = 0x40
+_FLAG_COMP_ZSTD = 0x80
+
+_CHUNK_FLAG_COMPRESSED = 0x1
+
+_V5_SB_SIZE = 8192
+
+
+def _align8(n: int) -> int:
+    return (n + 7) & ~7
+
+
+def _digester(name: str):
+    if name == "blake3":
+        return blake3
+    if name == "sha256":
+        return lambda b: hashlib.sha256(b).digest()
+    raise RealBootstrapError(f"unknown digester {name!r}")
+
+
+def _comp_flag_of(bootstrap) -> int:
+    """Superblock compressor bit from the internal chunk flags."""
+    for ck in bootstrap.chunks:
+        c = ck.flags & constants.COMPRESSOR_MASK
+        if c == constants.COMPRESSOR_LZ4_BLOCK:
+            return _FLAG_COMP_LZ4
+        if c == constants.COMPRESSOR_ZSTD:
+            return _FLAG_COMP_ZSTD
+        if c == constants.COMPRESSOR_GZIP:
+            return _FLAG_COMP_GZIP
+    return _FLAG_COMP_NONE
+
+
+def real_from_bootstrap(bootstrap, digester: str = "sha256") -> RealBootstrap:
+    """Bridge the framework's internal model (models/bootstrap.Bootstrap)
+    into a RealBootstrap ready for the real-layout writers.
+
+    Inode digests are computed per the reference formulas above (the
+    internal model does not carry them); v5 per-inode chunk runs get
+    file_offset/index fields the internal shared chunk table does not
+    track. Chunk digests pass through as-is — they are sha256 from the
+    pack engine, so pick digester="sha256" (the toolchain's own
+    `--digester sha256` mode) unless the caller rehashed with blake3.
+    """
+    H = _digester(digester)
+
+    blobs = [
+        RealBlob(
+            blob_id=b.blob_id,
+            chunk_count=b.chunk_count,
+            compressed_size=b.compressed_size,
+            uncompressed_size=b.uncompressed_size,
+            chunk_size=bootstrap.chunk_size,
+        )
+        for b in bootstrap.blobs
+    ]
+
+    # Per-blob chunk ordinals for the v5 records' index field.
+    ordinal: dict[tuple[int, int], int] = {}
+    per_blob: dict[int, list[int]] = {}
+    for ck in bootstrap.chunks:
+        per_blob.setdefault(ck.blob_index, []).append(ck.compressed_offset)
+    for bi, offs in per_blob.items():
+        for i, off in enumerate(sorted(set(offs))):
+            ordinal[(bi, off)] = i
+
+    by_path: dict[str, RealInode] = {}
+    ino_of_path: dict[str, int] = {}
+    next_ino = 1
+    reals: list[RealInode] = []
+    for ino in sorted(bootstrap.inodes, key=lambda i: i.path):
+        target = ino.hardlink_target
+        if target:
+            tpath = "/" + target.lstrip("/")
+            num = ino_of_path.get(tpath)
+            if num is None:
+                raise RealBootstrapError(f"hardlink target missing: {target}")
+        else:
+            num = next_ino
+            next_ino += 1
+        ri = RealInode(
+            path=ino.path,
+            ino=num,
+            mode=ino.mode,
+            uid=ino.uid,
+            gid=ino.gid,
+            mtime=ino.mtime,
+            size=ino.size,
+            nlink=1,
+            rdev=ino.rdev,
+            flags=0,
+            symlink_target=ino.symlink_target,
+            xattrs=dict(ino.xattrs),
+        )
+        if ri.is_symlink:
+            ri.flags |= _V5_FLAG_SYMLINK
+        if ri.xattrs:
+            ri.flags |= _V5_FLAG_XATTR
+        if target:
+            ri.flags |= _V5_FLAG_HARDLINK
+            head = by_path["/" + target.lstrip("/")]
+            ri.chunks = head.chunks
+            ri.size = head.size
+            ri.digest = b""  # filled after head digests are computed
+        elif ino.chunk_count:
+            pos = 0
+            for rec in bootstrap.chunks[
+                ino.chunk_index : ino.chunk_index + ino.chunk_count
+            ]:
+                ri.chunks.append(
+                    RealChunk(
+                        digest=rec.digest,
+                        blob_index=rec.blob_index,
+                        flags=(
+                            _CHUNK_FLAG_COMPRESSED
+                            if (rec.flags & constants.COMPRESSOR_MASK)
+                            not in (0, constants.COMPRESSOR_NONE)
+                            else 0
+                        ),
+                        compressed_size=rec.compressed_size,
+                        uncompressed_size=rec.uncompressed_size,
+                        compressed_offset=rec.compressed_offset,
+                        uncompressed_offset=rec.uncompressed_offset,
+                        file_offset=pos,
+                        index=ordinal.get(
+                            (rec.blob_index, rec.compressed_offset), 0
+                        ),
+                    )
+                )
+                pos += rec.uncompressed_size
+        reals.append(ri)
+        by_path[ri.path] = ri
+        ino_of_path[ri.path] = num
+
+    # nlink: hardlink group sizes; directories 2 + subdirectories.
+    group_size: dict[int, int] = {}
+    for ri in reals:
+        group_size[ri.ino] = group_size.get(ri.ino, 0) + 1
+    children: dict[str, list[RealInode]] = {}
+    for ri in reals:
+        if ri.path != "/":
+            parent = ri.path.rsplit("/", 1)[0] or "/"
+            children.setdefault(parent, []).append(ri)
+    for ri in reals:
+        if ri.is_dir:
+            ri.nlink = 2 + sum(1 for c in children.get(ri.path, []) if c.is_dir)
+        else:
+            ri.nlink = group_size[ri.ino]
+
+    # Digests. Leaves first (files/symlinks), then hardlink aliases (their
+    # head is always a non-directory, so it is final by then — an alias
+    # must contribute its target's digest to its parent directory's hash,
+    # not a placeholder), then directories bottom-up.
+    for ri in reals:
+        if ri.flags & _V5_FLAG_HARDLINK or ri.is_dir:
+            continue
+        if ri.is_symlink:
+            ri.digest = H(ri.symlink_target.encode())
+        elif ri.chunks:
+            ri.digest = H(b"".join(c.digest for c in ri.chunks))
+        else:
+            ri.digest = H(b"")
+    head_of: dict[int, RealInode] = {}
+    for ri in reals:
+        if not (ri.flags & _V5_FLAG_HARDLINK):
+            head_of.setdefault(ri.ino, ri)
+    for ri in reals:
+        if ri.flags & _V5_FLAG_HARDLINK:
+            ri.digest = head_of[ri.ino].digest
+    for ri in sorted(reals, key=lambda r: r.path.count("/"), reverse=True):
+        if ri.is_dir:
+            kids = sorted(children.get(ri.path, []), key=lambda k: k.path)
+            ri.digest = H(b"".join(k.digest for k in kids))
+
+    flags = (
+        _comp_flag_of(bootstrap)
+        | (_FLAG_HASH_BLAKE3 if digester == "blake3" else _FLAG_HASH_SHA256)
+        | _FLAG_EXPLICIT_UIDGID
+        | (_FLAG_HAS_XATTR if any(r.xattrs for r in reals) else 0)
+    )
+
+    # The shared chunk table (v6 shape): unique (blob, offset) locations.
+    seen: set[tuple[int, int]] = set()
+    shared: list[RealChunk] = []
+    for ri in reals:
+        if ri.flags & _V5_FLAG_HARDLINK:
+            continue
+        for ck in ri.chunks:
+            key = (ck.blob_index, ck.compressed_offset)
+            if key not in seen:
+                seen.add(key)
+                shared.append(ck)
+
+    prefetch_inos = [
+        ino_of_path[p if p.startswith("/") else "/" + p]
+        for p in getattr(bootstrap, "prefetch", [])
+        if (p if p.startswith("/") else "/" + p) in ino_of_path
+    ]
+
+    return RealBootstrap(
+        version=bootstrap.version
+        if bootstrap.version in (layout.RAFS_V5, layout.RAFS_V6)
+        else layout.RAFS_V6,
+        flags=flags,
+        inodes=reals,
+        blobs=blobs,
+        chunks=shared,
+        prefetch_inos=prefetch_inos,
+    )
+
+
+def _table_order(real: RealBootstrap):
+    """RAFS v5 table order, matching the reference builder exactly:
+    pre-order DFS over directories — each directory's children laid out
+    contiguously (child_index/child_count address that run), then its
+    subdirectories recursed in bytewise name order (verified slot-by-slot
+    against the committed v5 fixture). Returns (ordered inodes,
+    first_child_slot: {id(dir): 1-based index}, child_count)."""
+    by_parent: dict[str, list[RealInode]] = {}
+    root = None
+    for ri in real.inodes:
+        if ri.path == "/":
+            root = ri
+            continue
+        parent = ri.path.rsplit("/", 1)[0] or "/"
+        by_parent.setdefault(parent, []).append(ri)
+    if root is None:
+        raise RealBootstrapError("bootstrap has no root inode")
+    for kids in by_parent.values():
+        kids.sort(key=lambda k: k.path.rsplit("/", 1)[1].encode())
+
+    order = [root]
+    first_child: dict[int, int] = {}
+    count: dict[int, int] = {}
+
+    def emit(node: RealInode):
+        kids = by_parent.get(node.path, [])
+        count[id(node)] = len(kids)
+        first_child[id(node)] = len(order) + 1  # 1-based table index
+        order.extend(kids)
+        for k in kids:
+            if k.is_dir:
+                emit(k)
+
+    emit(root)
+    if len(order) != len(real.inodes):
+        raise RealBootstrapError(
+            f"{len(real.inodes) - len(order)} inodes unreachable from the root"
+        )
+    return order, first_child, count
+
+
+def _v5_xattr_region(xattrs: dict[str, bytes]) -> bytes:
+    body = io.BytesIO()
+    for key in sorted(xattrs):
+        pair = key.encode("utf-8", "surrogateescape") + b"\0" + xattrs[key]
+        body.write(struct.pack("<I", len(pair)))
+        body.write(pair)
+        body.write(b"\0" * (_align8(len(pair)) - len(pair)))
+    buf = body.getvalue()
+    out = struct.pack("<Q", len(buf)) + buf
+    return out + b"\0" * (_align8(len(out)) - len(out))
+
+
+def write_real_v5(real: RealBootstrap) -> bytes:
+    """Serialize a RealBootstrap in the reference's RAFS v5 layout
+    (superblock / inode table / prefetch table / blob table / extended
+    blob table / inode region — the section order of the committed
+    fixture). parse_real_v5 round-trips the output exactly."""
+    order, first_child, child_count = _table_order(real)
+
+    # ino -> first table slot: that occurrence serializes the chunk run.
+    head_slot: dict[int, int] = {}
+    ino_by_path: dict[str, int] = {}
+    for slot, ri in enumerate(order):
+        head_slot.setdefault(ri.ino, slot)
+        ino_by_path.setdefault(ri.path, ri.ino)
+
+    ino_bufs: list[bytes] = []
+    for slot, ri in enumerate(order):
+        name = "/" if ri.path == "/" else ri.path.rsplit("/", 1)[1]
+        nb = name.encode("utf-8", "surrogateescape")
+        if len(nb) > 0xFFFF:
+            raise RealBootstrapError(f"name too long: {name!r}")
+        tb = ri.symlink_target.encode("utf-8", "surrogateescape")
+        is_alias = bool(ri.flags & _V5_FLAG_HARDLINK) and head_slot[ri.ino] != slot
+        writes_chunks = (
+            ri.is_regular and not (ri.flags & _V5_FLAG_HARDLINK) and ri.chunks
+        )
+        if ri.path == "/":
+            parent_ino = 0
+        else:
+            parent_path = ri.path.rsplit("/", 1)[0] or "/"
+            parent_ino = ino_by_path.get(parent_path, 0)
+        if ri.is_dir:
+            ci, cc = first_child.get(id(ri), 0), child_count.get(id(ri), 0)
+        elif writes_chunks:
+            ci, cc = 0, len(ri.chunks)
+        else:
+            ci, cc = 0, 0
+        if len(ri.digest) != 32:
+            raise RealBootstrapError(f"{ri.path}: inode digest must be 32 bytes")
+        buf = io.BytesIO()
+        buf.write(
+            _V5_INODE.pack(
+                ri.digest,
+                parent_ino,
+                ri.ino,
+                ri.uid,
+                ri.gid,
+                0,  # projid
+                ri.mode,
+                ri.size,
+                (ri.size + 511) // 512,  # 512-B sectors (fixture-verified)
+                ri.flags,
+                ri.nlink,
+                ci,
+                cc,
+                len(nb),
+                len(tb) if ri.flags & _V5_FLAG_SYMLINK else 0,
+                ri.rdev,
+                0,  # pad
+                ri.mtime,
+                0,  # mtime_ns
+                0,  # reserved
+            )
+        )
+        buf.write(nb)
+        buf.write(b"\0" * (_align8(len(nb)) - len(nb)))
+        if ri.flags & _V5_FLAG_SYMLINK:
+            buf.write(tb)
+            buf.write(b"\0" * (_align8(len(tb)) - len(tb)))
+        if ri.flags & _V5_FLAG_XATTR:
+            buf.write(_v5_xattr_region(ri.xattrs))
+        if writes_chunks and not is_alias:
+            for ck in ri.chunks:
+                buf.write(
+                    _V5_CHUNK.pack(
+                        ck.digest,
+                        ck.blob_index,
+                        ck.flags,
+                        ck.compressed_size,
+                        ck.uncompressed_size,
+                        ck.compressed_offset,
+                        ck.uncompressed_offset,
+                        ck.file_offset,
+                        ck.index,
+                        0,
+                    )
+                )
+        ino_bufs.append(buf.getvalue())
+
+    n = len(order)
+    inode_table_off = _V5_SB_SIZE
+    prefetch_off = _align8(inode_table_off + 4 * n)
+    prefetch_buf = b"".join(struct.pack("<I", pi) for pi in real.prefetch_inos)
+    blob_table_off = _align8(prefetch_off + len(prefetch_buf))
+    blob_parts = []
+    for i, blob in enumerate(real.blobs):
+        rec = struct.pack("<II", 0, 0) + blob.blob_id.encode("ascii")
+        if i + 1 < len(real.blobs):
+            rec += b"\0"
+        blob_parts.append(rec)
+    blob_buf = b"".join(blob_parts)
+    ext_blob_off = _align8(blob_table_off + len(blob_buf))
+    ext_buf = b"".join(
+        struct.pack(
+            "<IIQQ", b.chunk_count, 0, b.uncompressed_size, b.compressed_size
+        ).ljust(64, b"\0")
+        for b in real.blobs
+    )
+    inodes_base = _align8(ext_blob_off + len(ext_buf))
+
+    table = []
+    pos = inodes_base
+    for buf in ino_bufs:
+        if pos & 7:
+            raise RealBootstrapError("internal: inode offset not 8-aligned")
+        table.append(pos >> 3)
+        pos += len(buf)
+
+    sb = _V5_SB.pack(
+        layout.RAFS_V5_SUPER_MAGIC,
+        0x500,
+        _V5_SB_SIZE,
+        real.blobs[0].chunk_size if real.blobs else 0x100000,
+        real.flags,
+        len({ri.ino for ri in order}),
+        inode_table_off,
+        prefetch_off,
+        blob_table_off,
+        n,
+        len(real.prefetch_inos),
+        len(blob_buf),
+        len(real.blobs),
+        ext_blob_off,
+    )
+
+    out = io.BytesIO()
+    out.write(sb)
+    out.write(b"\0" * (_V5_SB_SIZE - out.tell()))
+    out.write(struct.pack(f"<{n}I", *table))
+    out.write(b"\0" * (prefetch_off - out.tell()))
+    out.write(prefetch_buf)
+    out.write(b"\0" * (blob_table_off - out.tell()))
+    out.write(blob_buf)
+    out.write(b"\0" * (ext_blob_off - out.tell()))
+    out.write(ext_buf)
+    out.write(b"\0" * (inodes_base - out.tell()))
+    for buf in ino_bufs:
+        out.write(buf)
+    return out.getvalue()

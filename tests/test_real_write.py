@@ -1,0 +1,238 @@
+"""Write-direction interop: emitting REAL nydus-toolchain bootstrap layouts.
+
+The crown jewel here: `write_real_v5` rebuilds the committed reference v5
+fixture (produced by the Rust `nydus-image` builder,
+/root/reference/pkg/filesystem/testdata/) **byte-for-byte identical** from
+its parsed model — every layout choice of the real builder (pre-order DFS
+table order, 512-B sector counts, digest formulas, section alignment) is
+reproduced exactly. Plus the internal-model path: Pack output bridges to
+a real-layout v5 that the real-format reader and the whole runtime accept.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import stat
+import tarfile
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu.converter import PackOption, pack_layer
+from nydus_snapshotter_tpu.converter.convert import (
+    blob_data_from_layer_blob,
+    bootstrap_from_layer_blob,
+)
+from nydus_snapshotter_tpu.models import layout
+from nydus_snapshotter_tpu.models.nydus_real import (
+    load_any_bootstrap,
+    parse_real_v5,
+    to_bootstrap,
+)
+from nydus_snapshotter_tpu.models.nydus_real_write import (
+    real_from_bootstrap,
+    write_real_v5,
+)
+from nydus_snapshotter_tpu.utils.blake3 import blake3
+
+REF = "/root/reference"
+FS_TESTDATA = os.path.join(REF, "pkg", "filesystem", "testdata")
+
+RNG = np.random.default_rng(7)
+
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir(FS_TESTDATA), reason="reference tree not available"
+)
+
+
+def _boot_from(name: str) -> bytes:
+    with tarfile.open(os.path.join(FS_TESTDATA, name), mode="r:gz") as tf:
+        for member in tf.getmembers():
+            if member.name.lstrip("./") == layout.BOOTSTRAP_FILE:
+                return tf.extractfile(member).read()
+    raise AssertionError(f"{name} has no {layout.BOOTSTRAP_FILE}")
+
+
+@pytest.fixture(scope="module")
+def v5_fixture_bytes() -> bytes:
+    if not os.path.isdir(FS_TESTDATA):
+        pytest.skip("reference tree not available")
+    return _boot_from("v5-bootstrap-file-size-736032.tar.gz")
+
+
+class TestBlake3:
+    def test_empty_vector(self):
+        # The official BLAKE3 empty-input vector — also what the real v5
+        # fixture stores for childless directories and empty files.
+        assert (
+            blake3(b"").hex()
+            == "af1349b9f5f9a1a6a0404dea36dcc9499bcb25c9adc112b7cc9a93cae41f3262"
+        )
+
+    def test_shapes_and_determinism(self):
+        seen = set()
+        for n in (1, 63, 64, 65, 1023, 1024, 1025, 2048, 3100, 5000):
+            data = RNG.integers(0, 256, n, dtype=np.uint8).tobytes()
+            d = blake3(data)
+            assert len(d) == 32
+            assert d == blake3(data)
+            seen.add(d)
+        assert len(seen) == 10  # all distinct
+
+
+@needs_reference
+class TestRealV5FixtureDigests:
+    """The digest formulas the writer relies on, proven exhaustively on
+    the real builder's own output (blake3-flagged, RafsSuperFlags 0x4)."""
+
+    def test_all_digest_formulas(self, v5_fixture_bytes):
+        real = parse_real_v5(v5_fixture_bytes)
+        children: dict[str, list] = {}
+        for i in real.inodes:
+            if i.path != "/":
+                children.setdefault(i.path.rsplit("/", 1)[0] or "/", []).append(i)
+        checked = {"file": 0, "dir": 0, "symlink": 0, "empty": 0}
+        for i in real.inodes:
+            if i.is_symlink:
+                assert i.digest == blake3(i.symlink_target.encode()), i.path
+                checked["symlink"] += 1
+            elif i.is_dir:
+                kids = sorted(children.get(i.path, []), key=lambda k: k.path)
+                assert i.digest == blake3(b"".join(k.digest for k in kids)), i.path
+                checked["dir"] += 1
+            elif i.is_regular and i.chunks:
+                assert i.digest == blake3(
+                    b"".join(c.digest for c in i.chunks)
+                ), i.path
+                checked["file"] += 1
+            elif i.is_regular:
+                assert i.digest == blake3(b""), i.path
+                checked["empty"] += 1
+        # the fixture genuinely exercises every formula, including the
+        # >1024-byte tree path (directories with >32 children)
+        assert checked["file"] > 2500 and checked["dir"] > 600
+        assert checked["symlink"] > 200 and checked["empty"] > 10
+        assert any(
+            len(children.get(i.path, [])) > 32
+            for i in real.inodes
+            if i.is_dir
+        )
+
+
+@needs_reference
+class TestRealV5Writer:
+    def test_fixture_roundtrip_byte_identical(self, v5_fixture_bytes):
+        """parse -> write reproduces the Rust builder's output exactly:
+        every one of the fixture's 736,032 bytes."""
+        real = parse_real_v5(v5_fixture_bytes)
+        out = write_real_v5(real)
+        assert out == v5_fixture_bytes
+
+    def test_write_is_idempotent(self, v5_fixture_bytes):
+        out = write_real_v5(parse_real_v5(v5_fixture_bytes))
+        again = write_real_v5(parse_real_v5(out))
+        assert again == out
+
+
+def _packed_bootstrap():
+    files = [
+        ("dir-1/file-2", RNG.integers(0, 256, 20_000, dtype=np.uint8).tobytes()),
+        ("dir-2/file-1", b"lower-file-1-content" * 500),
+        ("dir-2/empty", b""),
+    ]
+    out = io.BytesIO()
+    with tarfile.open(fileobj=out, mode="w:") as tf:
+        for d in ("dir-1", "dir-2"):
+            info = tarfile.TarInfo(d + "/")
+            info.type = tarfile.DIRTYPE
+            info.mode = 0o755
+            info.mtime = 1_700_000_000
+            tf.addfile(info)
+        for name, data in files:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mode = 0o644
+            info.mtime = 1_700_000_000
+            tf.addfile(info, io.BytesIO(data))
+        info = tarfile.TarInfo("dir-2/link-1")
+        info.type = tarfile.SYMTYPE
+        info.linkname = "../dir-1/file-2"
+        tf.addfile(info)
+        info = tarfile.TarInfo("dir-2/hard-1")
+        info.type = tarfile.LNKTYPE
+        info.linkname = "dir-2/file-1"
+        tf.addfile(info)
+        info = tarfile.TarInfo("dir-1/tagged")
+        info.size = 4
+        info.pax_headers = {"SCHILY.xattr.user.tag": "val1"}
+        tf.addfile(info, io.BytesIO(b"data"))
+    blob, res = pack_layer(out.getvalue(), PackOption(chunk_size=0x1000))
+    return bootstrap_from_layer_blob(blob), blob, res
+
+
+class TestRealFromBootstrap:
+    """Pack output -> real-layout v5 -> reader -> runtime bridge."""
+
+    def test_pack_to_real_v5_roundtrip(self):
+        bs, _, _ = _packed_bootstrap()
+        real = real_from_bootstrap(bs, digester="sha256")
+        out = write_real_v5(real)
+        back = parse_real_v5(out)
+        assert back.flags & 0x8  # sha256 digester flagged
+        assert back.flags & 0x10  # explicit uid/gid
+        assert back.flags & 0x20  # has xattrs
+        by = back.by_path()
+        assert set(by) == {i.path for i in bs.inodes} | {"/"}
+        f = by["/dir-1/file-2"]
+        assert f.size == 20_000 and f.chunks
+        import hashlib
+
+        assert f.digest == hashlib.sha256(
+            b"".join(c.digest for c in f.chunks)
+        ).digest()
+        assert by["/dir-2/link-1"].symlink_target == "../dir-1/file-2"
+        assert by["/dir-2/hard-1"].ino == by["/dir-2/file-1"].ino
+        assert by["/dir-2/hard-1"].nlink == 2 == by["/dir-2/file-1"].nlink
+        assert by["/dir-2/empty"].digest == hashlib.sha256(b"").digest()
+        # a hardlink alias contributes its TARGET's digest to the parent
+        # directory hash (the reference formula; regression for a bug
+        # where the placeholder b"" was hashed instead)
+        assert by["/dir-2/hard-1"].digest == by["/dir-2/file-1"].digest
+        kids = sorted(
+            (p for p in by if p.startswith("/dir-2/") and p.count("/") == 2),
+        )
+        assert by["/dir-2"].digest == hashlib.sha256(
+            b"".join(by[k].digest for k in kids)
+        ).digest()
+        assert by["/dir-1/tagged"].xattrs == {"user.tag": b"val1"}
+        # chunk runs survive with digests and blob coordinates intact
+        want = {
+            c.digest
+            for c in bs.chunks
+        }
+        got = {c.digest for c in back.chunks}
+        assert got == want
+
+    def test_real_v5_serves_through_the_runtime_bridge(self):
+        """The emitted real-layout bytes are a first-class runtime input:
+        load_any_bootstrap auto-detects them and Unpack reconstructs the
+        original file bytes from the blob."""
+        from nydus_snapshotter_tpu.converter.convert import Unpack
+
+        bs, blob, res = _packed_bootstrap()
+        out = write_real_v5(real_from_bootstrap(bs))
+        bridged = load_any_bootstrap(out)
+        tar_bytes = Unpack(bridged, {res.blob_id: blob_data_from_layer_blob(blob)})
+        with tarfile.open(fileobj=io.BytesIO(tar_bytes)) as tf:
+            data = tf.extractfile("dir-2/file-1").read()
+        assert data == b"lower-file-1-content" * 500
+
+    def test_prefetch_inos_resolve(self):
+        bs, _, _ = _packed_bootstrap()
+        bs.prefetch = ["/dir-1/file-2", "/"]
+        real = real_from_bootstrap(bs)
+        out = write_real_v5(real)
+        back = parse_real_v5(out)
+        paths = {i.ino: i.path for i in back.inodes}
+        assert [paths[p] for p in back.prefetch_inos] == ["/dir-1/file-2", "/"]
