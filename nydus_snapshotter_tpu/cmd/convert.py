@@ -92,6 +92,8 @@ def cmd_merge(args) -> int:
             fs_version=args.fs_version,
             chunk_dict_path=args.chunk_dict or "",
             prefetch_patterns=_read_prefetch(args),
+            bootstrap_format=getattr(args, "bootstrap_format", "native"),
+            digester=getattr(args, "digester", "sha256"),
         ),
     )
     with open(args.out, "wb") as f:
@@ -271,6 +273,44 @@ def cmd_batch(args) -> int:
     return 0
 
 
+def cmd_export_real(args) -> int:
+    """Transcode any bootstrap (native, or real v5/v6) into the reference
+    toolchain's real on-disk layout — including real v5 <-> v6."""
+    from nydus_snapshotter_tpu.models.bootstrap import Bootstrap, BootstrapError
+    from nydus_snapshotter_tpu.models.nydus_real import parse_real_bootstrap
+    from nydus_snapshotter_tpu.models.nydus_real_write import (
+        real_from_bootstrap,
+        write_real_v5,
+        write_real_v6,
+    )
+
+    with open(args.boot, "rb") as f:
+        data = f.read()
+    try:
+        real = real_from_bootstrap(
+            Bootstrap.from_bytes(data), digester=args.digester
+        )
+        source = "native"
+    except (BootstrapError, ValueError):
+        real = parse_real_bootstrap(data)  # digests preserved verbatim
+        source = f"real-{real.version}"
+    out = write_real_v5(real) if args.format == "v5" else write_real_v6(real)
+    with open(args.out, "wb") as f:
+        f.write(out)
+    print(
+        json.dumps(
+            {
+                "source": source,
+                "format": args.format,
+                "bytes": len(out),
+                "inodes": len(real.inodes),
+                "chunks": len(real.chunks),
+            }
+        )
+    )
+    return 0
+
+
 def cmd_export_erofs(args) -> int:
     """``nydus-image export --block`` shape: self-contained EROFS disk."""
     from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
@@ -322,8 +362,26 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("merge", help="layer streams -> image bootstrap")
     sp.add_argument("layers", nargs="+")
     sp.add_argument("--out", required=True)
+    sp.add_argument("--bootstrap-format", default="native",
+                    choices=("native", "rafs-v5", "rafs-v6"),
+                    help="emit the image bootstrap in this framework's "
+                    "format or the reference toolchain's real layout")
+    sp.add_argument("--digester", default="sha256",
+                    choices=("sha256", "blake3"),
+                    help="inode digest algorithm for real layouts")
     common(sp)
     sp.set_defaults(fn=cmd_merge)
+
+    sp = sub.add_parser(
+        "export-real",
+        help="bootstrap (either format) -> real nydus v5/v6 layout",
+    )
+    sp.add_argument("--boot", required=True)
+    sp.add_argument("--out", required=True)
+    sp.add_argument("--format", required=True, choices=("v5", "v6"))
+    sp.add_argument("--digester", default="sha256",
+                    choices=("sha256", "blake3"))
+    sp.set_defaults(fn=cmd_export_real)
 
     sp = sub.add_parser("unpack", help="bootstrap + blobs -> OCI tar")
     sp.add_argument("--boot", required=True)

@@ -512,7 +512,38 @@ def Merge(
         if opt.prefetch_patterns
         else [],
     )
-    boot_bytes = bootstrap.to_bytes()
+    if opt.bootstrap_format in ("rafs-v5", "rafs-v6"):
+        # Emit the image bootstrap in the reference toolchain's own
+        # layout so its ecosystem can mount what this framework built.
+        if bootstrap.ciphers or bootstrap.batches:
+            raise ConvertError(
+                "encrypted/batched bootstraps have no real-layout "
+                "representation; use bootstrap_format='native'"
+            )
+        from nydus_snapshotter_tpu.models.nydus_real_write import (
+            real_from_bootstrap,
+            write_real_v5,
+            write_real_v6,
+        )
+
+        from nydus_snapshotter_tpu.models.nydus_real import RealBootstrapError
+
+        try:
+            real = real_from_bootstrap(bootstrap, digester=opt.digester)
+            boot_bytes = (
+                write_real_v5(real)
+                if opt.bootstrap_format == "rafs-v5"
+                else write_real_v6(real)
+            )
+        except RealBootstrapError as e:
+            raise ConvertError(f"real-layout emit failed: {e}") from e
+    elif opt.bootstrap_format in ("", "native"):
+        boot_bytes = bootstrap.to_bytes()
+    else:
+        raise ConvertError(
+            f"unknown bootstrap_format {opt.bootstrap_format!r} "
+            "(native | rafs-v5 | rafs-v6)"
+        )
     if opt.with_tar:
         # Standard forward tar carrying image/image.boot — the bootstrap
         # *layer* format every consumer expects (reference packToTar;

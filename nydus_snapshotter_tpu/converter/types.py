@@ -101,6 +101,12 @@ class MergeOption:
     oci_ref: bool = False
     with_referrer: bool = False
     timeout: Optional[float] = None
+    # "native" (this framework's format), or the reference toolchain's
+    # real on-disk layouts: "rafs-v5" / "rafs-v6" (models/nydus_real_write).
+    bootstrap_format: str = "native"
+    # inode-digest algorithm when emitting a real layout ("sha256" matches
+    # the pack engine's chunk digests; "blake3" is the toolchain default)
+    digester: str = "sha256"
 
 
 @dataclass

@@ -77,6 +77,20 @@ class RafsFuseOps:
             name = inode.path.rsplit("/", 1)[1].encode()
             self.children.setdefault(parent.ino, {})[name] = inode
         self._by_path = by_path
+        # st_nlink: hardlink group sizes (alias + target count as links to
+        # the same storage inode — what the reference nydusd reports);
+        # directories report 2 + subdirectories.
+        self._nlink: dict[int, int] = {}
+        for inode in bootstrap.inodes:
+            if stat_mod.S_ISDIR(inode.mode):
+                self._nlink[inode.ino] = 2 + sum(
+                    1
+                    for c in self.children.get(inode.ino, {}).values()
+                    if stat_mod.S_ISDIR(c.mode)
+                )
+            else:
+                tgt = self.resolve(inode)
+                self._nlink[tgt.ino] = self._nlink.get(tgt.ino, 0) + 1
 
     def resolve(self, inode: Inode) -> Inode:
         """Follow a hardlink to its storage inode."""
@@ -92,7 +106,7 @@ class RafsFuseOps:
             ino=target.ino,
             size=target.size,
             mode=target.mode,
-            nlink=2 if stat_mod.S_ISDIR(target.mode) else 1,
+            nlink=self._nlink.get(target.ino, 1),
             uid=target.uid,
             gid=target.gid,
             rdev=target.rdev,

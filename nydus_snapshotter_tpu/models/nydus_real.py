@@ -40,6 +40,7 @@ RAFS v6 bootstrap = EROFS image + nydus extensions:
 
 from __future__ import annotations
 
+import os
 import stat
 import struct
 from dataclasses import dataclass, field
@@ -110,6 +111,9 @@ class RealBlob:
     compressed_size: int = 0
     uncompressed_size: int = 0
     chunk_size: int = 0
+    # v6: the raw 256-B RafsV6Blob record as parsed, so the writer can
+    # round-trip fields beyond the ones modeled here.
+    raw_rec: bytes = b""
 
 
 @dataclass
@@ -567,6 +571,11 @@ from nydus_snapshotter_tpu.models.erofs_image import (  # noqa: E402
 _EROFS_SB = struct.Struct("<IIIBBHQQIIII16s16sIHHH")
 _EROFS_INODE_EXTENDED = struct.Struct("<HHHHQIIIIQIII")
 _NYDUS_EXT_SB = struct.Struct("<QQIIQQ")
+# ...followed by (prefetch_table_offset u64, prefetch_table_size u32) —
+# decoded from the committed v6 fixture, whose ext sb carries
+# (4352, 4): one u32 prefetch entry right after the blob table. Entries
+# are EROFS nids (the fixture's single entry is nid 142).
+_NYDUS_EXT_SB_PREFETCH = struct.Struct("<QI")
 
 # index -> name prefix (reverse of the writer's registry).
 _EROFS_XATTR_PREFIXES = {idx: prefix for prefix, idx in _EROFS_XATTR_PREFIX_LIST}
@@ -619,6 +628,15 @@ def parse_real_v6(data: bytes) -> RealBootstrap:
         raise RealBootstrapError("v6 chunk table exceeds bootstrap size")
     if chunk_table_size % 80:
         raise RealBootstrapError("v6 chunk table not a multiple of 80 bytes")
+    prefetch_off, prefetch_size = _NYDUS_EXT_SB_PREFETCH.unpack_from(
+        data, 1024 + 128 + _NYDUS_EXT_SB.size
+    )
+    prefetch_nids: list[int] = []
+    if prefetch_off and prefetch_off + prefetch_size <= len(data):
+        prefetch_nids = [
+            struct.unpack_from("<I", data, prefetch_off + 4 * i)[0]
+            for i in range(prefetch_size // 4)
+        ]
 
     # Device slots name the data blobs.
     blobs: list[RealBlob] = []
@@ -640,6 +658,8 @@ def parse_real_v6(data: bytes) -> RealBootstrap:
         blobs[i].chunk_count = cc
         blobs[i].compressed_size = csize
         blobs[i].uncompressed_size = usize
+        if off + 256 <= len(data):
+            blobs[i].raw_rec = data[off : off + 256]
 
     # Shared chunk table (80-B v5-layout records).
     chunks: list[RealChunk] = []
@@ -788,6 +808,7 @@ def parse_real_v6(data: bytes) -> RealBootstrap:
 
     inodes: list[RealInode] = []
     visited: set[int] = set()
+    ino_of_nid: dict[int, int] = {}
     stack: list[tuple[int, str]] = [(root_nid, "/")]
     while stack:
         nid, path = stack.pop()
@@ -804,6 +825,11 @@ def parse_real_v6(data: bytes) -> RealBootstrap:
             isize,
             xattr_size,
         ) = parse_inode(nid)
+        rdev = 0
+        if stat.S_ISCHR(mode) or stat.S_ISBLK(mode):
+            # i_u carries new_encode_dev(): minor low byte | major << 8
+            # | high minor bits << 12
+            rdev = os.makedev((u >> 8) & 0xFFF, (u & 0xFF) | ((u >> 12) & ~0xFF))
         inode = RealInode(
             path=path,
             ino=ino,
@@ -813,9 +839,11 @@ def parse_real_v6(data: bytes) -> RealBootstrap:
             mtime=mtime,
             size=size,
             nlink=nlink,
+            rdev=rdev,
             xattrs=parse_xattrs(nid, isize, xattr_size),
         )
         inodes.append(inode)
+        ino_of_nid.setdefault(nid, ino)
         if stat.S_ISDIR(mode):
             if nid in visited:
                 continue
@@ -866,6 +894,12 @@ def parse_real_v6(data: bytes) -> RealBootstrap:
         inodes=inodes,
         blobs=blobs,
         chunks=chunks,
+        # prefetch entries are nids on disk; surface them as the inode
+        # numbers the rest of the model speaks (to_bootstrap resolves
+        # them to paths exactly like the v5 table).
+        prefetch_inos=[
+            ino_of_nid[n] for n in prefetch_nids if n in ino_of_nid
+        ],
     )
 
 
@@ -958,6 +992,19 @@ def to_bootstrap(real: RealBootstrap):
     # "/" is a legitimate entry (prefetch-everything policy — and what the
     # committed v5 fixture actually carries); keep it.
     prefetch = [path_of_ino[pi] for pi in real.prefetch_inos if pi in path_of_ino]
+    # Assign ino/parent_ino the way Bootstrap.to_bytes does (1-based, path
+    # order): consumers of the *in-memory* bridge — the daemon's FUSE
+    # layer keys nodes by ino — must see the same numbering a
+    # serialize/parse round trip would produce, not zeros.
+    ino_by_path = {inode.path: i + 1 for i, inode in enumerate(inodes)}
+    for i, inode in enumerate(inodes):
+        inode.ino = i + 1
+        if inode.path == "/":
+            inode.parent_ino = 0
+        else:
+            inode.parent_ino = ino_by_path.get(
+                inode.path.rsplit("/", 1)[0] or "/", 0
+            )
     return Bootstrap(
         version=real.version,
         chunk_size=real.blobs[0].chunk_size if real.blobs else 0x100000,

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import hashlib
 import io
+import os
 import stat as statmod
 import struct
 
@@ -54,7 +55,7 @@ from nydus_snapshotter_tpu.models.nydus_real import (
 )
 from nydus_snapshotter_tpu.utils.blake3 import blake3
 
-__all__ = ["real_from_bootstrap", "write_real_v5"]
+__all__ = ["real_from_bootstrap", "write_real_v5", "write_real_v6"]
 
 _FLAG_COMP_NONE = 0x1
 _FLAG_COMP_LZ4 = 0x2
@@ -132,7 +133,12 @@ def real_from_bootstrap(bootstrap, digester: str = "sha256") -> RealBootstrap:
     ino_of_path: dict[str, int] = {}
     next_ino = 1
     reals: list[RealInode] = []
-    for ino in sorted(bootstrap.inodes, key=lambda i: i.path):
+    # Two passes: hardlink aliases resolve against their target inode, and
+    # a tar may name the alias before the target in path order.
+    ordered = sorted(bootstrap.inodes, key=lambda i: i.path)
+    for ino in [i for i in ordered if not i.hardlink_target] + [
+        i for i in ordered if i.hardlink_target
+    ]:
         target = ino.hardlink_target
         if target:
             tpath = "/" + target.lstrip("/")
@@ -158,13 +164,21 @@ def real_from_bootstrap(bootstrap, digester: str = "sha256") -> RealBootstrap:
         )
         if ri.is_symlink:
             ri.flags |= _V5_FLAG_SYMLINK
+            # POSIX (and the real builder): a symlink's size is its
+            # target length; tar stores 0
+            ri.size = len(ri.symlink_target.encode("utf-8", "surrogateescape"))
         if ri.xattrs:
             ri.flags |= _V5_FLAG_XATTR
         if target:
+            # a hardlink IS its target inode: aliases carry the head's
+            # attributes (v6 serializes one inode for the whole group)
             ri.flags |= _V5_FLAG_HARDLINK
             head = by_path["/" + target.lstrip("/")]
             ri.chunks = head.chunks
             ri.size = head.size
+            ri.mode = head.mode
+            ri.uid, ri.gid = head.uid, head.gid
+            ri.mtime = head.mtime
             ri.digest = b""  # filled after head digests are computed
         elif ino.chunk_count:
             pos = 0
@@ -195,6 +209,7 @@ def real_from_bootstrap(bootstrap, digester: str = "sha256") -> RealBootstrap:
         reals.append(ri)
         by_path[ri.path] = ri
         ino_of_path[ri.path] = num
+    reals.sort(key=lambda r: r.path)
 
     # nlink: hardlink group sizes; directories 2 + subdirectories.
     group_size: dict[int, int] = {}
@@ -210,6 +225,20 @@ def real_from_bootstrap(bootstrap, digester: str = "sha256") -> RealBootstrap:
             ri.nlink = 2 + sum(1 for c in children.get(ri.path, []) if c.is_dir)
         else:
             ri.nlink = group_size[ri.ino]
+
+    # ino numbers follow the real builder's convention: the head's
+    # 1-based slot in the v5 pre-order table (v6 images carry the same
+    # numbers — fixture-verified: /etc=5, /var=22 match their v5 slots).
+    probe = RealBootstrap(
+        version=layout.RAFS_V5, flags=0, inodes=reals, blobs=[], chunks=[]
+    )
+    order, _, _ = _table_order(probe)
+    slot_of: dict[int, int] = {}
+    for slot, ri in enumerate(order, start=1):
+        slot_of.setdefault(ri.ino, slot)
+    for ri in reals:
+        ri.ino = slot_of[ri.ino]
+    ino_of_path = {ri.path: ri.ino for ri in reals}
 
     # Digests. Leaves first (files/symlinks), then hardlink aliases (their
     # head is always a non-directory, so it is final by then — an alias
@@ -231,7 +260,12 @@ def real_from_bootstrap(bootstrap, digester: str = "sha256") -> RealBootstrap:
     for ri in reals:
         if ri.flags & _V5_FLAG_HARDLINK:
             ri.digest = head_of[ri.ino].digest
-    for ri in sorted(reals, key=lambda r: r.path.count("/"), reverse=True):
+    # Deepest directories first; the root is depth 0, NOT the same depth
+    # as "/etc" (both contain one slash) — hashing it early would fold
+    # empty placeholders for every top-level subdirectory into the root
+    # digest.
+    depth = lambda r: 0 if r.path == "/" else r.path.count("/")  # noqa: E731
+    for ri in sorted(reals, key=depth, reverse=True):
         if ri.is_dir:
             kids = sorted(children.get(ri.path, []), key=lambda k: k.path)
             ri.digest = H(b"".join(k.digest for k in kids))
@@ -333,21 +367,19 @@ def write_real_v5(real: RealBootstrap) -> bytes:
     fixture). parse_real_v5 round-trips the output exactly."""
     order, first_child, child_count = _table_order(real)
 
-    # ino -> first table slot: that occurrence serializes the chunk run.
-    head_slot: dict[int, int] = {}
     ino_by_path: dict[str, int] = {}
-    for slot, ri in enumerate(order):
-        head_slot.setdefault(ri.ino, slot)
+    for ri in order:
         ino_by_path.setdefault(ri.path, ri.ino)
 
     ino_bufs: list[bytes] = []
-    for slot, ri in enumerate(order):
+    for ri in order:
         name = "/" if ri.path == "/" else ri.path.rsplit("/", 1)[1]
         nb = name.encode("utf-8", "surrogateescape")
         if len(nb) > 0xFFFF:
             raise RealBootstrapError(f"name too long: {name!r}")
         tb = ri.symlink_target.encode("utf-8", "surrogateescape")
-        is_alias = bool(ri.flags & _V5_FLAG_HARDLINK) and head_slot[ri.ino] != slot
+        # hardlink aliases carry the flag and no chunk run; their head
+        # does not carry it (parse rule in parse_real_v5)
         writes_chunks = (
             ri.is_regular and not (ri.flags & _V5_FLAG_HARDLINK) and ri.chunks
         )
@@ -396,7 +428,7 @@ def write_real_v5(real: RealBootstrap) -> bytes:
             buf.write(b"\0" * (_align8(len(tb)) - len(tb)))
         if ri.flags & _V5_FLAG_XATTR:
             buf.write(_v5_xattr_region(ri.xattrs))
-        if writes_chunks and not is_alias:
+        if writes_chunks:
             for ck in ri.chunks:
                 buf.write(
                     _V5_CHUNK.pack(
@@ -474,3 +506,492 @@ def write_real_v5(real: RealBootstrap) -> bytes:
     for buf in ino_bufs:
         out.write(buf)
     return out.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# RAFS v6 (EROFS + nydus extensions)
+# ---------------------------------------------------------------------------
+
+# On-disk contract shared with the reader and the in-tree EROFS writer.
+from nydus_snapshotter_tpu.models.erofs_image import (  # noqa: E402
+    _CHUNK_INDEX,
+    _DEVICE_SLOT,
+    _DIRENT,
+    _SB as _EROFS_SB_FULL,
+    _encode_xattrs,
+    _file_type,
+    _XATTR_IBODY_HEADER,
+)
+from nydus_snapshotter_tpu.models.nydus_real import (  # noqa: E402
+    _NYDUS_EXT_SB,
+    _NYDUS_EXT_SB_PREFETCH,
+)
+
+_V6_BLKSZBITS = 12
+_V6_BLKSZ = 1 << _V6_BLKSZBITS
+_V6_DEVT_SLOTOFF = 11  # fixture: device slots right after the ext sb region
+_V6_ROOT_SLOT = 128  # fixture: inodes start one block into the meta area
+_V6_INODE_EXT = struct.Struct("<HHHHQIIIIQII")  # + 16 reserved bytes = 64
+_V6_LAYOUT_PLAIN = 0
+_V6_LAYOUT_INLINE = 2
+_V6_LAYOUT_CHUNK = 4
+_V6_CHUNK_FORMAT_INDEXES = 0x0020
+_V6_FEAT_CHUNKED_FILE = 0x4
+_V6_FEAT_DEVICE_TABLE = 0x8
+
+
+class _V6Node:
+    __slots__ = (
+        "ri", "nid", "ino", "nlink", "dl", "iu", "inline", "data_blocks",
+        "xattr_body", "chunks", "kids",
+    )
+
+    def __init__(self, ri: RealInode):
+        self.ri = ri
+        self.nid = 0
+        self.ino = 0
+        self.nlink = 1
+        self.dl = _V6_LAYOUT_INLINE
+        self.iu = 0
+        self.inline = b""
+        self.data_blocks = b""
+        self.xattr_body = b""
+        self.chunks: list[RealChunk] = []
+        self.kids: list["_V6Node"] = []
+
+
+def _v6_dir_blocks(entries: list[tuple[bytes, int, int]]) -> bytes:
+    """Serialize sorted (name, nid, ftype) dirents: greedy per-block
+    packing, names unpadded in the final block (so the byte length IS the
+    directory size, matching the fixture's exact-tail sizes)."""
+    entries = sorted(entries, key=lambda t: t[0])
+    blocks: list[list[tuple[bytes, int, int]]] = []
+    cur: list[tuple[bytes, int, int]] = []
+    used = 0
+    for name, nid, ft in entries:
+        cost = _DIRENT.size + len(name)
+        if cost > _V6_BLKSZ:
+            raise RealBootstrapError(f"dirent {name!r} exceeds the 4 KiB block")
+        if cur and used + cost > _V6_BLKSZ:
+            blocks.append(cur)
+            cur, used = [], 0
+        cur.append((name, nid, ft))
+        used += cost
+    if cur:
+        blocks.append(cur)
+    out = io.BytesIO()
+    for bi, ents in enumerate(blocks):
+        base = out.tell()
+        nameoff = len(ents) * _DIRENT.size
+        names = io.BytesIO()
+        for name, nid, ft in ents:
+            out.write(_DIRENT.pack(nid, nameoff + names.tell(), ft, 0))
+            names.write(name)
+        out.write(names.getvalue())
+        if bi < len(blocks) - 1:
+            out.write(b"\0" * (base + _V6_BLKSZ - out.tell()))
+    return out.getvalue()
+
+
+def _v6_realign_uoffs(real: RealBootstrap) -> dict[tuple[int, int], int]:
+    """(blob_index, compressed_offset) -> block-aligned uncompressed
+    offset. v6 chunk indexes address 4 KiB blocks, so every chunk's
+    virtual uncompressed offset must be block-aligned; bootstraps from
+    the internal pack engine carry packed (unaligned) offsets, which are
+    re-laid per blob in compressed-offset order — exactly the aligned
+    virtual layout the real builder produces. Already-aligned inputs
+    (parsed real bootstraps) map to themselves."""
+    keys: dict[tuple[int, int], RealChunk] = {}
+    for ri in real.inodes:
+        for ck in ri.chunks:
+            keys.setdefault((ck.blob_index, ck.compressed_offset), ck)
+    for ck in real.chunks:
+        keys.setdefault((ck.blob_index, ck.compressed_offset), ck)
+    if all(ck.uncompressed_offset % _V6_BLKSZ == 0 for ck in keys.values()):
+        return {k: ck.uncompressed_offset for k, ck in keys.items()}
+    out: dict[tuple[int, int], int] = {}
+    per_blob: dict[int, list[tuple[int, RealChunk]]] = {}
+    for (bi, coff), ck in keys.items():
+        per_blob.setdefault(bi, []).append((coff, ck))
+    for bi, lst in per_blob.items():
+        pos = 0
+        for coff, ck in sorted(lst):
+            out[(bi, coff)] = pos
+            pos += ck.uncompressed_size
+            pos += (-pos) % _V6_BLKSZ
+    return out
+
+
+def write_real_v6(real: RealBootstrap) -> bytes:
+    """Serialize a RealBootstrap in the reference's RAFS v6 layout: a
+    kernel-mountable EROFS image (extended inodes, FLAT_INLINE tails,
+    CHUNK_BASED regular files, per-blob device slots) plus the nydus
+    extended superblock, 256-B blob table, prefetch table, and shared
+    80-B chunk table. parse_real_v6 round-trips the output; the layout
+    parameters (devt slot 11, root one block into the meta area, blob
+    table on the block after the device slots, 512-B-sector-free
+    extended inodes) mirror the committed fixture.
+
+    One deliberate divergence from the Rust builder: its chunk table is
+    emitted in hash-map iteration order (irreproducible); this writer
+    uses first-appearance order over the directory walk, which is
+    deterministic and carries the identical record multiset."""
+    # --- tree & head/alias resolution -----------------------------------
+    by_path: dict[str, _V6Node] = {}
+    root = None
+    for ri in real.inodes:
+        node = _V6Node(ri)
+        by_path[ri.path] = node
+        if ri.path == "/":
+            root = node
+    if root is None:
+        raise RealBootstrapError("bootstrap has no root inode")
+    head_of_ino: dict[int, _V6Node] = {}
+    order_hint = {id(ri): i for i, ri in enumerate(real.inodes)}
+    for ri in sorted(real.inodes, key=lambda r: order_hint[id(r)]):
+        head_of_ino.setdefault(ri.ino, by_path[ri.path])
+    for path, node in by_path.items():
+        if path == "/":
+            continue
+        parent = by_path.get(path.rsplit("/", 1)[0] or "/")
+        if parent is None:
+            raise RealBootstrapError(f"orphan path {path!r}")
+        parent.kids.append(node)
+    for node in by_path.values():
+        node.kids.sort(key=lambda k: k.ri.path.rsplit("/", 1)[1].encode())
+
+    # nlink: dirs 2 + subdirs; files their hardlink-group size.
+    group: dict[int, int] = {}
+    for ri in real.inodes:
+        group[ri.ino] = group.get(ri.ino, 0) + 1
+    for node in by_path.values():
+        node.nlink = (
+            2 + sum(1 for k in node.kids if k.ri.is_dir)
+            if node.ri.is_dir
+            else group[node.ri.ino]
+        )
+
+    # Disk order: per directory, non-dir children first, then dir
+    # children each with its whole subtree (fixture-verified).
+    disk: list[_V6Node] = []
+
+    def emit(node: _V6Node):
+        disk.append(node)
+        files = [
+            k
+            for k in node.kids
+            if not k.ri.is_dir and head_of_ino[k.ri.ino] is k
+        ]
+        disk.extend(files)
+        for k in node.kids:
+            if k.ri.is_dir:
+                emit(k)
+
+    emit(root)
+
+    # v6 chunk indexes address a per-file fixed grid: index ci covers file
+    # bytes [ci*chunk_size, (ci+1)*chunk_size). Variable-size (CDC) chunk
+    # runs cannot be represented — reject them loudly (the fixture's own
+    # multi-chunk files sit on an exact 1 MiB grid, f_off included).
+    grid = real.blobs[0].chunk_size if real.blobs else 0x100000
+    for node in disk:
+        run = node.ri.chunks
+        for ci, ck in enumerate(run):
+            want = min(grid, max(node.ri.size - ci * grid, 0)) if node.ri.size else 0
+            if ck.uncompressed_size != want:
+                raise RealBootstrapError(
+                    f"{node.ri.path}: chunk {ci} has {ck.uncompressed_size} "
+                    f"uncompressed bytes but the v6 fixed grid needs {want} "
+                    f"(chunk_size {grid:#x}); RAFS v6 cannot carry variable "
+                    "CDC chunks - pack with chunking='fixed' or emit v5"
+                )
+
+    uoff_of = _v6_realign_uoffs(real)
+
+    # --- per-node bodies (sizes first; dirents need nids, done later) ---
+    for node in disk:
+        ri = node.ri
+        node.xattr_body = _encode_xattrs(ri.xattrs)
+        if ri.is_dir:
+            node.dl = _V6_LAYOUT_INLINE
+        elif ri.is_symlink:
+            node.dl = _V6_LAYOUT_INLINE
+            node.inline = ri.symlink_target.encode("utf-8", "surrogateescape")
+        elif ri.is_regular:
+            node.dl = _V6_LAYOUT_CHUNK
+            node.chunks = list(ri.chunks)
+        else:  # char/block/fifo/socket
+            node.dl = _V6_LAYOUT_PLAIN
+            major, minor = os.major(ri.rdev), os.minor(ri.rdev)
+            node.iu = (minor & 0xFF) | (major << 8) | ((minor & ~0xFF) << 12)
+
+    # Directory sizes need only names; serialize dirents with nid=0 to
+    # size them, then re-serialize after nid assignment.
+    def dir_entries(node: _V6Node, nids: bool) -> list[tuple[bytes, int, int]]:
+        ents = [
+            (b".", node.nid if nids else 0, 2),
+            (b"..", (node_parent[id(node)].nid if nids else 0), 2),
+        ]
+        for k in node.kids:
+            tgt = head_of_ino[k.ri.ino] if not k.ri.is_dir else k
+            ents.append(
+                (
+                    k.ri.path.rsplit("/", 1)[1].encode("utf-8", "surrogateescape"),
+                    tgt.nid if nids else 0,
+                    _file_type(k.ri.mode),
+                )
+            )
+        return ents
+
+    node_parent: dict[int, _V6Node] = {id(root): root}
+    for node in by_path.values():
+        for k in node.kids:
+            node_parent[id(k)] = node
+
+    dir_sizes: dict[int, int] = {}
+    for node in disk:
+        if node.ri.is_dir:
+            dir_sizes[id(node)] = len(_v6_dir_blocks(dir_entries(node, False)))
+
+    # --- layout: slots, block-aligned full dir blocks -------------------
+    # Geometry (fixture-shaped): sb + ext sb, device slots at slot 11,
+    # blob table on the next block, prefetch right after it, meta area on
+    # the block after that, inodes starting one block into it.
+    n_blobs = len(real.blobs)
+    devt_end = _V6_DEVT_SLOTOFF * 128 + 128 * n_blobs
+    blob_table_off = devt_end + (-devt_end) % _V6_BLKSZ
+    blob_table_size = 256 * n_blobs
+    prefetch_off = blob_table_off + blob_table_size
+    nid_of_ino = {}
+    prefetch_nids: list[int] = []
+    prefetch_size = 4 * len(real.prefetch_inos)
+    meta_end = prefetch_off + prefetch_size
+    meta_blkaddr = -(-meta_end // _V6_BLKSZ)
+    meta_base = meta_blkaddr * _V6_BLKSZ
+
+    def slot_bytes(node: _V6Node) -> tuple[int, int]:
+        """(bytes after the 64-B inode in the slot run, inline tail len)."""
+        extra = len(node.xattr_body)
+        if node.dl == _V6_LAYOUT_CHUNK:
+            pad = (-(64 + extra)) % 8
+            return extra + pad + 8 * len(node.chunks), 0
+        size = dir_sizes[id(node)] if node.ri.is_dir else len(node.inline)
+        tail = size % _V6_BLKSZ if size else 0
+        return extra + tail, tail
+
+    pos = meta_base + _V6_ROOT_SLOT * 32
+    for node in disk:
+        size = (
+            dir_sizes[id(node)]
+            if node.ri.is_dir
+            else len(node.inline)
+            if node.dl == _V6_LAYOUT_INLINE
+            else node.ri.size
+        )
+        full_blocks = size // _V6_BLKSZ if node.dl == _V6_LAYOUT_INLINE else 0
+        extra, tail = slot_bytes(node)
+        if full_blocks:
+            # inode at a block start; its full data blocks on the block(s)
+            # right after the inode's block (fixture rule for big dirs)
+            pos += (-pos) % _V6_BLKSZ
+            if 64 + extra > _V6_BLKSZ:
+                raise RealBootstrapError(
+                    f"{node.ri.path}: inline tail cannot fit one block"
+                )
+        elif tail and (pos % _V6_BLKSZ) + 64 + extra > _V6_BLKSZ:
+            # the inline tail must not cross a block boundary
+            pos += (-pos) % _V6_BLKSZ
+        node.nid = (pos - meta_base) // 32
+        if full_blocks:
+            data_blk = (pos + 64 + extra + _V6_BLKSZ - 1) // _V6_BLKSZ
+            node.iu = data_blk
+            pos = (data_blk + full_blocks) * _V6_BLKSZ
+        else:
+            if node.dl == _V6_LAYOUT_INLINE:
+                node.iu = (pos + 64 + len(node.xattr_body)) >> _V6_BLKSZBITS
+            pos += 64 + extra
+            pos += (-pos) % 32
+    slots_end = pos
+
+    for node in disk:
+        node.ino = node.ri.ino
+        nid_of_ino[node.ri.ino] = node.nid
+    prefetch_nids = [
+        nid_of_ino[i] for i in real.prefetch_inos if i in nid_of_ino
+    ]
+
+    # --- chunk table: first-appearance order over the disk walk ---------
+    table_recs: list[RealChunk] = []
+    seen_key: set[tuple[int, int]] = set()
+    for node in disk:
+        for ck in node.chunks:
+            key = (ck.blob_index, ck.compressed_offset)
+            if key not in seen_key:
+                seen_key.add(key)
+                table_recs.append(ck)
+    chunk_table_off = slots_end + (-slots_end) % _V6_BLKSZ
+    chunk_table_size = 80 * len(table_recs)
+    total = chunk_table_off + chunk_table_size
+    total += (-total) % _V6_BLKSZ
+
+    # --- serialize ------------------------------------------------------
+    out = bytearray(total)
+
+    chunk_size = real.blobs[0].chunk_size if real.blobs else 0x100000
+    if chunk_size & (chunk_size - 1) or not chunk_size:
+        raise RealBootstrapError(f"v6 chunk size {chunk_size:#x} not a power of 2")
+    chunk_bits = chunk_size.bit_length() - 1
+    if chunk_bits < _V6_BLKSZBITS:
+        raise RealBootstrapError(f"v6 chunk size {chunk_size:#x} below block size")
+
+    feat = _V6_FEAT_DEVICE_TABLE if n_blobs else 0
+    if any(node.dl == _V6_LAYOUT_CHUNK for node in disk):
+        feat |= _V6_FEAT_CHUNKED_FILE
+    sb = _EROFS_SB_FULL.pack(
+        layout.RAFS_V6_SUPER_MAGIC,
+        0,
+        0,
+        _V6_BLKSZBITS,
+        0,
+        root.nid,
+        len(real.inodes),
+        0,
+        0,
+        total // _V6_BLKSZ,
+        meta_blkaddr,
+        0,
+        b"\0" * 16,
+        b"\0" * 16,
+        feat,
+        0,
+        n_blobs,
+        _V6_DEVT_SLOTOFF if n_blobs else 0,
+        0,
+        0,
+        0,
+        0,
+        0,
+        b"\0" * 23,
+    )
+    out[1024 : 1024 + len(sb)] = sb
+    ext = _NYDUS_EXT_SB.pack(
+        real.flags,
+        blob_table_off,
+        blob_table_size,
+        chunk_size,
+        chunk_table_off,
+        chunk_table_size,
+    ) + _NYDUS_EXT_SB_PREFETCH.pack(
+        prefetch_off if prefetch_nids else 0, 4 * len(prefetch_nids)
+    )
+    out[1152 : 1152 + len(ext)] = ext
+
+    for i, blob in enumerate(real.blobs):
+        slot_off = _V6_DEVT_SLOTOFF * 128 + 128 * i
+        out[slot_off : slot_off + 128] = _DEVICE_SLOT.pack(
+            blob.blob_id.encode("ascii")[:64].ljust(64, b"\0"),
+            -(-(blob.uncompressed_size or blob.compressed_size) // _V6_BLKSZ),
+            0,
+            b"\0" * 56,
+        )
+        if blob.raw_rec:
+            rec = blob.raw_rec
+        else:
+            # fields validated against the fixture record; +76/+80 carry
+            # the constants the fixture does (features / cipher config)
+            rec = (
+                blob.blob_id.encode("ascii")[:64].ljust(64, b"\0")
+                + struct.pack(
+                    "<IIII", i, chunk_size, blob.chunk_count, 1
+                )
+                + struct.pack(
+                    "<QQQ",
+                    0x1_0000_0000,
+                    blob.compressed_size,
+                    blob.uncompressed_size,
+                )
+            ).ljust(256, b"\0")
+        off = blob_table_off + 256 * i
+        out[off : off + 256] = rec
+
+    for i, nid in enumerate(prefetch_nids):
+        struct.pack_into("<I", out, prefetch_off + 4 * i, nid)
+
+    for node in disk:
+        ri = node.ri
+        off = meta_base + 32 * node.nid
+        if node.dl == _V6_LAYOUT_CHUNK:
+            iu = _V6_CHUNK_FORMAT_INDEXES | (chunk_bits - _V6_BLKSZBITS)
+        else:
+            iu = node.iu
+        xic = (
+            1 + (len(node.xattr_body) - _XATTR_IBODY_HEADER.size) // 4
+            if node.xattr_body
+            else 0
+        )
+        size = (
+            dir_sizes[id(node)]
+            if ri.is_dir
+            else len(node.inline)
+            if node.dl == _V6_LAYOUT_INLINE
+            else ri.size
+        )
+        inode = _V6_INODE_EXT.pack(
+            (node.dl << 1) | 1,
+            xic,
+            ri.mode & 0xFFFF,
+            0,
+            size,
+            iu,
+            node.ino,
+            ri.uid,
+            ri.gid,
+            ri.mtime,
+            0,
+            node.nlink,
+        ) + b"\0" * 16
+        out[off : off + 64] = inode
+        body = off + 64
+        out[body : body + len(node.xattr_body)] = node.xattr_body
+        body += len(node.xattr_body)
+        if node.dl == _V6_LAYOUT_CHUNK:
+            body += (-(body - off)) % 8
+            for ci, ck in enumerate(node.chunks):
+                uoff = uoff_of[(ck.blob_index, ck.compressed_offset)]
+                struct.pack_into(
+                    "<HHI",
+                    out,
+                    body + 8 * ci,
+                    0,
+                    ck.blob_index + 1,
+                    uoff >> _V6_BLKSZBITS,
+                )
+        elif node.dl == _V6_LAYOUT_INLINE:
+            data = (
+                _v6_dir_blocks(dir_entries(node, True))
+                if ri.is_dir
+                else node.inline
+            )
+            nbl = len(data) // _V6_BLKSZ
+            if nbl:
+                dst = node.iu * _V6_BLKSZ
+                out[dst : dst + nbl * _V6_BLKSZ] = data[: nbl * _V6_BLKSZ]
+            tail = data[nbl * _V6_BLKSZ :]
+            out[body : body + len(tail)] = tail
+
+    for i, ck in enumerate(table_recs):
+        off = chunk_table_off + 80 * i
+        out[off : off + 80] = _V5_CHUNK.pack(
+            ck.digest,
+            ck.blob_index,
+            ck.flags,
+            ck.compressed_size,
+            ck.uncompressed_size,
+            ck.compressed_offset,
+            uoff_of[(ck.blob_index, ck.compressed_offset)],
+            ck.file_offset,
+            ck.index,
+            0,
+        )
+
+    return bytes(out)
