@@ -39,6 +39,7 @@ def _pack_option(args) -> "PackOption":
         chunking=args.chunking,
         oci_ref=getattr(args, "oci_ref", False),
         encrypt=getattr(args, "encrypt", False),
+        digester=getattr(args, "digester", "sha256"),
         prefetch_patterns=_read_prefetch(args),
     )
 
@@ -344,6 +345,11 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--backend", default="hybrid",
                         choices=("jax", "numpy", "hybrid"))
         sp.add_argument("--chunking", default="cdc", choices=("cdc", "fixed"))
+        sp.add_argument("--digester", default="sha256",
+                        choices=("sha256", "blake3"),
+                        help="chunk digest algorithm (blake3 = the real "
+                        "toolchain default; needed for content dedup "
+                        "against real nydus images)")
         sp.add_argument("--prefetch-files", default="",
                         help="file of prefetch patterns, one per line")
         if dict_opt:
@@ -366,9 +372,8 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=("native", "rafs-v5", "rafs-v6"),
                     help="emit the image bootstrap in this framework's "
                     "format or the reference toolchain's real layout")
-    sp.add_argument("--digester", default="sha256",
-                    choices=("sha256", "blake3"),
-                    help="inode digest algorithm for real layouts")
+    # --digester comes from common(): one flag covers chunk digests at
+    # pack time and inode digests when emitting a real layout.
     common(sp)
     sp.set_defaults(fn=cmd_merge)
 

@@ -87,7 +87,11 @@ class IncrementalChunker:
         # Callers packing many files pass one shared engine instance.
         kwargs = {"digest_backend": opt.digest_backend} if opt.digest_backend else {}
         self._engine = engine or ChunkDigestEngine(
-            chunk_size=opt.chunk_size, mode=opt.chunking, backend=opt.backend, **kwargs
+            chunk_size=opt.chunk_size,
+            mode=opt.chunking,
+            backend=opt.backend,
+            digester=opt.digester,
+            **kwargs,
         )
         self.lookahead = (
             self._engine.params.max_size if self._engine.params else opt.chunk_size
@@ -186,8 +190,14 @@ class _HostDigester:
     per-chunk calls would forfeit both. hashlib thread pool otherwise.
     """
 
+    def __init__(self, digester: str = "sha256"):
+        self.digester = digester
+
     def submit(self, datas: list[bytes]):
-        from nydus_snapshotter_tpu.ops.chunker import _host_digests
+        from nydus_snapshotter_tpu.ops.chunker import (
+            _host_digests,
+            _host_digests_blake3,
+        )
 
         # One shared buffer so _host_digests' same-source-array grouping
         # makes a single native call for the whole batch.
@@ -197,7 +207,8 @@ class _HostDigester:
         for d in datas:
             items.append((buf, off, len(d)))
             off += len(d)
-        return _host_digests(items)
+        fn = _host_digests_blake3 if self.digester == "blake3" else _host_digests
+        return fn(items)
 
     def collect(self, handle) -> list[bytes]:
         return handle
@@ -730,8 +741,11 @@ def pack_stream(
     max_chunk = cdc.CDCParams(opt.chunk_size).max_size if opt.chunking == "cdc" else opt.chunk_size
     digester = (
         _DeviceDigester(max_chunk)
-        if opt.backend == "jax" or opt.digest_backend == "jax"
-        else _HostDigester()
+        # the device batch kernel is SHA-256; blake3 always digests on the
+        # host blake3 arm (native/pure-Python), whatever the backend
+        if (opt.backend == "jax" or opt.digest_backend == "jax")
+        and opt.digester == "sha256"
+        else _HostDigester(opt.digester)
     )
 
     metas: dict[str, _Meta] = {}
@@ -989,10 +1003,16 @@ def pack_stream(
             (arr_all, off, size) for tag, _m, off, size in plan if tag == "small"
         ]
         if small_items:
-            from nydus_snapshotter_tpu.ops.chunker import _host_digests
+            from nydus_snapshotter_tpu.ops.chunker import (
+                _host_digests,
+                _host_digests_blake3,
+            )
 
             _tc = _pc()
-            small_digests = iter(_host_digests(small_items))
+            _small_fn = (
+                _host_digests_blake3 if opt.digester == "blake3" else _host_digests
+            )
+            small_digests = iter(_small_fn(small_items))
             _t_chunk += _pc() - _tc
 
         # Within-layer parallelism for multi-core hosts (the reference gets

@@ -53,6 +53,14 @@ class PackOption:
     # through the device batch path while boundaries stay on the host
     # (bench.py's device_digest arm).
     digest_backend: str = ""
+    # Chunk-digest algorithm (reference `nydus-image --digester`,
+    # RafsSuperFlags 0x4 blake3 / 0x8 sha256). blake3 is the real
+    # toolchain's default — packing with it makes `--chunk-dict
+    # bootstrap=<real nydus image>` content hits possible, since dict
+    # probes are digest-keyed. The blob ID stays sha256 (OCI convention).
+    # sha256 keeps the SHA-NI/device fused fast paths; blake3 digests run
+    # on the host blake3 arm (native ntpu_blake3_many or pure Python).
+    digester: str = "sha256"
 
     def validate(self) -> None:
         if self.fs_version not in (layout.RAFS_V5, layout.RAFS_V6):
@@ -73,6 +81,8 @@ class PackOption:
             raise ConvertError(
                 f"unsupported digest backend {self.digest_backend!r}"
             )
+        if self.digester not in ("sha256", "blake3"):
+            raise ConvertError(f"unsupported digester {self.digester!r}")
         bs = self.batch_size
         # Reference bound (types.go:78-79): power of two in 0x1000-0x1000000
         # or zero (disabled).
@@ -104,8 +114,9 @@ class MergeOption:
     # "native" (this framework's format), or the reference toolchain's
     # real on-disk layouts: "rafs-v5" / "rafs-v6" (models/nydus_real_write).
     bootstrap_format: str = "native"
-    # inode-digest algorithm when emitting a real layout ("sha256" matches
-    # the pack engine's chunk digests; "blake3" is the toolchain default)
+    # Inode-digest algorithm when emitting a real layout ("blake3" is the
+    # toolchain default; use the same algorithm the layers' CHUNK digests
+    # were packed with — PackOption.digester — for a coherent image).
     digester: str = "sha256"
 
 

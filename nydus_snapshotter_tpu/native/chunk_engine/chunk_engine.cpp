@@ -19,6 +19,7 @@
 
 #include <dlfcn.h>
 
+#include "blake3.h"
 #include "sha256.h"
 
 #if defined(__x86_64__) || defined(_M_X64)
@@ -540,6 +541,16 @@ void ntpu_gear_hashes(const uint8_t *data, int64_t n,
 void ntpu_sha256_many(const uint8_t *data, const int64_t *extents, int64_t m,
                       uint8_t *digests_out) {
   ntpu_sha::sha256_extents(data, extents, m, digests_out);
+}
+
+// BLAKE3 of m extents of data (same shape contract as ntpu_sha256_many).
+// The chunk digester for real-image dedup parity: the reference
+// toolchain's default chunk digests are blake3, so `--chunk-dict
+// bootstrap=<real image>` content hits need blake3 chunk digests at pack
+// time (reference tool/builder.go:122-123; RafsSuperFlags HASH_BLAKE3).
+void ntpu_blake3_many(const uint8_t *data, const int64_t *extents, int64_t m,
+                      uint8_t *digests_out) {
+  ntpu_b3::blake3_extents(data, extents, m, digests_out);
 }
 
 // Fused single-pass chunk + digest: SIMD candidate bitmaps -> cut

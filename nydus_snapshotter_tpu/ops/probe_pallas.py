@@ -120,7 +120,8 @@ def _kernel(
         masked_rows = jnp.where(match, rows, jnp.int32(2 * w))
         rmin = jnp.min(masked_rows)
         val = jnp.sum(jnp.where(masked_rows == rmin, win_v, 0))
-        out_ref[i, 0] = jnp.where(rmin < 2 * w, val, 0)
+        res = jnp.where(rmin < 2 * w, val, 0)
+        out_ref[pl.ds(i, 1), :] = jnp.full((1, 1), res, jnp.int32)
 
         @pl.when(i + k < n_queries)
         def _():
@@ -153,11 +154,15 @@ def probe_padded(
             num_scalar_prefetch=2,
             grid=(1,),
             in_specs=[
-                pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+                # queries live in VMEM (the kernel loads them directly;
+                # Mosaic only allows loads on VMEM/SMEM refs — the first
+                # real-TPU window rejected the ANY spec here)
+                pl.BlockSpec((q, 8), lambda i, *_: (0, 0)),
+                # tables stay in ANY (HBM): only ever touched via DMA
                 pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
                 pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
             ],
-            out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            out_specs=pl.BlockSpec((q, 1), lambda i, *_: (0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((PIPELINE, w, 8), jnp.uint32),
                 pltpu.VMEM((PIPELINE, w, 1), jnp.int32),

@@ -1,0 +1,214 @@
+"""BLAKE3 chunk digester: native arm differentials + real-image dedup e2e.
+
+The reference toolchain's default chunk digester is blake3 (`nydus-image
+--digester`, RafsSuperFlags HASH_BLAKE3 0x4 — both committed fixtures under
+/root/reference/pkg/filesystem/testdata carry it), and its chunk-dict dedup
+is digest-keyed (tool/builder.go:122-123). So content hits against REAL
+nydus images require packing with blake3 chunk digests. These tests cover:
+
+- the native blake3 arm (ntpu_blake3_many) against the pure-Python spec
+  implementation (utils/blake3.py — itself validated against the real
+  fixtures' digests) across chunk/tree-boundary sizes;
+- PackOption(digester="blake3") producing blake3 chunk digests through
+  both the streaming and in-memory pack paths;
+- the full interop loop: pack+merge an image to the REAL RAFS v6 layout
+  with blake3 digests, load it back as a chunk dict, and dedup a second
+  layer's shared content against it (the reference smoke test's
+  chunk-dict assertion shape, tests/converter_test.go:515-521).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import random
+import tarfile
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu.converter.convert import Merge, Pack
+from nydus_snapshotter_tpu.converter.types import (
+    ConvertError,
+    MergeOption,
+    PackOption,
+)
+from nydus_snapshotter_tpu.models.bootstrap import Bootstrap, ChunkDict
+from nydus_snapshotter_tpu.ops import native_cdc
+from nydus_snapshotter_tpu.utils import blake3 as pyb3
+
+
+def _mktar(files):
+    b = io.BytesIO()
+    with tarfile.open(fileobj=b, mode="w") as tf:
+        for name, data in files:
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+    return b.getvalue()
+
+
+class TestNativeBlake3:
+    # Sizes straddling every structural boundary: block (64), chunk (1024),
+    # and the largest-power-of-two-left-subtree splits (3072 = 2+1 chunks,
+    # 5*1024+7 = 4+2 chunks unbalanced tail, multi-MiB deep trees).
+    SIZES = [0, 1, 63, 64, 65, 1023, 1024, 1025, 2048, 3071, 3072, 4096,
+             5 * 1024 + 7, 65536, 1 << 20, (1 << 20) + 13, 3 * (1 << 20) + 5]
+
+    @pytest.mark.skipif(
+        not native_cdc.blake3_many_available(), reason="native engine not built"
+    )
+    def test_native_matches_python_oracle(self):
+        rng = random.Random(7)
+        data = bytes(rng.randrange(256) for _ in range(sum(self.SIZES)))
+        arr = np.frombuffer(data, dtype=np.uint8)
+        ext, off = [], 0
+        for s in self.SIZES:
+            ext.append((off, s))
+            off += s
+        out = native_cdc.blake3_many_native(arr, np.asarray(ext, dtype=np.int64))
+        for i, (o, s) in enumerate(ext):
+            assert out[32 * i : 32 * (i + 1)] == pyb3.blake3(data[o : o + s]), s
+
+    @pytest.mark.skipif(
+        not native_cdc.blake3_many_available(), reason="native engine not built"
+    )
+    def test_known_vector_empty(self):
+        # Published BLAKE3 test vector for the empty input.
+        out = native_cdc.blake3_many_native(
+            np.zeros(1, np.uint8), np.asarray([(0, 0)], dtype=np.int64)
+        )
+        assert out.hex().startswith("af1349b9f5f9a1a6")
+
+    def test_host_digests_blake3_python_fallback(self):
+        # The threaded fan-out helper must agree with the oracle even when
+        # forced down the pure-Python lane (native lib present or not).
+        from nydus_snapshotter_tpu.ops.chunker import _host_digests_blake3
+
+        rng = random.Random(11)
+        data = bytes(rng.randrange(256) for _ in range(200_000))
+        arr = np.frombuffer(data, dtype=np.uint8)
+        items = [(arr, o, s) for o, s in [(0, 1500), (1500, 0), (1500, 123_456), (125_000, 75_000)]]
+        got = _host_digests_blake3(items)
+        assert got == [pyb3.blake3(data[o : o + s]) for _a, o, s in items]
+
+
+class TestPackDigester:
+    def _pack(self, tmp_path, tar, **kw):
+        opt = PackOption(work_dir=str(tmp_path), **kw)
+        dest = io.BytesIO()
+        res = Pack(dest, tar, opt)
+        return res, Bootstrap.from_bytes(res.bootstrap)
+
+    def test_pack_blake3_chunk_digests(self, tmp_path):
+        rng = random.Random(3)
+        payload = bytes(rng.randrange(256) for _ in range(2_500_000))
+        tar = _mktar([("x.bin", payload)])
+        _res, boot = self._pack(tmp_path, tar, digester="blake3")
+        assert boot.chunks
+        for c in boot.chunks:
+            seg = payload[c.uncompressed_offset : c.uncompressed_offset + c.uncompressed_size]
+            assert c.digest == pyb3.blake3(seg)
+
+    def test_pack_blake3_streaming_matches_inmemory(self, tmp_path):
+        rng = random.Random(5)
+        payload = bytes(rng.randrange(256) for _ in range(1_800_000))
+        tar = _mktar([("d/y.bin", payload), ("d/z.txt", b"hello" * 100)])
+        _res_mem, boot_mem = self._pack(tmp_path, tar, digester="blake3")
+        opt = PackOption(work_dir=str(tmp_path), digester="blake3")
+        dest = io.BytesIO()
+        res_stream = Pack(dest, io.BytesIO(tar), opt)  # file-like: streaming walk
+        assert res_stream.bootstrap == boot_mem.to_bytes() or (
+            Bootstrap.from_bytes(res_stream.bootstrap).chunks == boot_mem.chunks
+        )
+
+    def test_pack_blake3_blob_identical_to_sha256(self, tmp_path):
+        # The digester changes digests only: cuts, compression, and blob
+        # bytes are identical across algorithms.
+        rng = random.Random(9)
+        payload = bytes(rng.randrange(256) for _ in range(1_200_000))
+        tar = _mktar([("b.bin", payload)])
+        res_sha, boot_sha = self._pack(tmp_path, tar, digester="sha256")
+        res_b3, boot_b3 = self._pack(tmp_path, tar, digester="blake3")
+        assert res_sha.blob_id == res_b3.blob_id
+        assert res_sha.blob_size == res_b3.blob_size
+        assert [c.uncompressed_size for c in boot_sha.chunks] == [
+            c.uncompressed_size for c in boot_b3.chunks
+        ]
+        assert all(
+            a.digest != b.digest for a, b in zip(boot_sha.chunks, boot_b3.chunks)
+        )
+
+    def test_bad_digester_rejected(self, tmp_path):
+        with pytest.raises(ConvertError):
+            PackOption(work_dir=str(tmp_path), digester="md5").validate()
+
+
+class TestRealImageDedup:
+    def test_blake3_dict_from_real_v6_layout(self, tmp_path):
+        """Pack→Merge to the REAL v6 layout with blake3, reload as a chunk
+        dict, dedup a second layer against it — the loop a user needs to
+        dedup new layers against images the reference toolchain built."""
+        rng = random.Random(42)
+        shared = bytes(rng.randrange(256) for _ in range(3 << 20))
+        uniq = bytes(rng.randrange(256) for _ in range(1 << 20))
+        # fixed chunking: the real v6 layout's chunk grid (and the real
+        # toolchain's default chunking mode)
+        opt = PackOption(work_dir=str(tmp_path), digester="blake3", chunking="fixed")
+        destA = io.BytesIO()
+        resA = Pack(destA, _mktar([("a.bin", shared)]), opt)
+        mres = Merge(
+            [resA.bootstrap],
+            MergeOption(bootstrap_format="rafs-v6", digester="blake3"),
+        )
+        dict_path = os.path.join(str(tmp_path), "dictA.boot")
+        with open(dict_path, "wb") as f:
+            f.write(mres.bootstrap)
+
+        d = ChunkDict.from_path(dict_path)
+        assert len(d) == 3  # 3 MiB shared at the 1 MiB fixed grid
+
+        optB = PackOption(
+            work_dir=str(tmp_path),
+            digester="blake3",
+            chunking="fixed",
+            chunk_dict_path=f"bootstrap={dict_path}",
+        )
+        destB = io.BytesIO()
+        resB = Pack(destB, _mktar([("b.bin", shared), ("c.bin", uniq)]), optB)
+        bootB = Bootstrap.from_bytes(resB.bootstrap)
+        dedup = [
+            c for c in bootB.chunks
+            if bootB.blobs[c.blob_index].blob_id != resB.blob_id
+        ]
+        assert len(dedup) == 3  # every shared chunk resolved to the dict
+        assert resB.blob_size < len(uniq) * 1.1  # blob carries only uniq
+        assert resA.blob_id in resB.referenced_blob_ids
+
+    def test_sha256_pack_misses_blake3_dict(self, tmp_path):
+        """Digest-keyed dedup: a sha256 pack probing a blake3 dict gets no
+        hits (algorithm coherence is the caller's contract, as with the
+        reference toolchain)."""
+        rng = random.Random(6)
+        shared = bytes(rng.randrange(256) for _ in range(2 << 20))
+        opt = PackOption(work_dir=str(tmp_path), digester="blake3", chunking="fixed")
+        destA = io.BytesIO()
+        resA = Pack(destA, _mktar([("a.bin", shared)]), opt)
+        mres = Merge(
+            [resA.bootstrap],
+            MergeOption(bootstrap_format="rafs-v6", digester="blake3"),
+        )
+        dict_path = os.path.join(str(tmp_path), "d.boot")
+        with open(dict_path, "wb") as f:
+            f.write(mres.bootstrap)
+        optB = PackOption(
+            work_dir=str(tmp_path),
+            digester="sha256",
+            chunking="fixed",
+            chunk_dict_path=f"bootstrap={dict_path}",
+        )
+        resB = Pack(io.BytesIO(), _mktar([("b.bin", shared)]), optB)
+        bootB = Bootstrap.from_bytes(resB.bootstrap)
+        assert all(
+            bootB.blobs[c.blob_index].blob_id == resB.blob_id for c in bootB.chunks
+        )
