@@ -194,21 +194,17 @@ class _HostDigester:
         self.digester = digester
 
     def submit(self, datas: list[bytes]):
-        from nydus_snapshotter_tpu.ops.chunker import (
-            _host_digests,
-            _host_digests_blake3,
-        )
+        from nydus_snapshotter_tpu.ops.chunker import host_digests_for
 
-        # One shared buffer so _host_digests' same-source-array grouping
-        # makes a single native call for the whole batch.
+        # One shared buffer so the same-source-array grouping makes a
+        # single native call for the whole batch.
         buf = np.frombuffer(b"".join(datas), dtype=np.uint8)
         items = []
         off = 0
         for d in datas:
             items.append((buf, off, len(d)))
             off += len(d)
-        fn = _host_digests_blake3 if self.digester == "blake3" else _host_digests
-        return fn(items)
+        return host_digests_for(self.digester)(items)
 
     def collect(self, handle) -> list[bytes]:
         return handle
@@ -1003,16 +999,10 @@ def pack_stream(
             (arr_all, off, size) for tag, _m, off, size in plan if tag == "small"
         ]
         if small_items:
-            from nydus_snapshotter_tpu.ops.chunker import (
-                _host_digests,
-                _host_digests_blake3,
-            )
+            from nydus_snapshotter_tpu.ops.chunker import host_digests_for
 
             _tc = _pc()
-            _small_fn = (
-                _host_digests_blake3 if opt.digester == "blake3" else _host_digests
-            )
-            small_digests = iter(_small_fn(small_items))
+            small_digests = iter(host_digests_for(opt.digester)(small_items))
             _t_chunk += _pc() - _tc
 
         # Within-layer parallelism for multi-core hosts (the reference gets

@@ -29,6 +29,8 @@ import io
 import zlib
 from typing import BinaryIO, Callable, Optional
 
+import numpy as np
+
 from nydus_snapshotter_tpu import constants
 from nydus_snapshotter_tpu.converter.types import ConvertError, PackOption
 from nydus_snapshotter_tpu.models import fstree
@@ -202,9 +204,15 @@ def pack_gzip_layer(raw_gzip: bytes, opt: PackOption, engine=None) -> Bootstrap:
     view = memoryview(tar_bytes)  # no second copy of multi-GB content
     datas = [view[o : o + s] for _, o, s in chunk_meta]
     if engine is not None:
+        # the engine carries its own digester (digest_many branches on it)
         digests = engine.digest_many(datas)
     else:
-        digests = [hashlib.sha256(d).digest() for d in datas]
+        from nydus_snapshotter_tpu.ops.chunker import host_digests_for
+
+        buf = np.frombuffer(tar_bytes, dtype=np.uint8)
+        digests = host_digests_for(opt.digester)(
+            [(buf, o, s) for _p, o, s in chunk_meta]
+        )
 
     blob_id = hashlib.sha256(raw_gzip).hexdigest()
 

@@ -94,14 +94,47 @@ def _map_threads(fn, items: list, min_batch: int = 2) -> list:
         return list(pool.map(fn, items))
 
 
+def _grouped_native_digests(
+    items: list[tuple[np.ndarray, int, int]], native_fn
+) -> list[bytes]:
+    """Fan (array, offset, size) items out to GIL-dropping native batch calls.
+
+    Groups runs of extents sharing a source array, then splits long runs
+    into ~cpu_count sub-groups so one large stream still fans out across
+    cores (each sub-group is an independent native call; order-preserving
+    concat keeps digest order). ``native_fn(arr, extents_i64) -> bytes``
+    is the 32-B-per-extent batch contract shared by ntpu_sha256_many and
+    ntpu_blake3_many.
+    """
+    groups: list[tuple[np.ndarray, list[tuple[int, int]]]] = []
+    for arr, off, size in items:
+        if groups and groups[-1][0] is arr:
+            groups[-1][1].append((off, size))
+        else:
+            groups.append((arr, [(off, size)]))
+    ncpu = _cpu_count()
+    if ncpu > 1 and len(groups) < ncpu:
+        per = max(8, -(-len(items) // ncpu))
+        groups = [
+            (arr, exts[i : i + per])
+            for arr, exts in groups
+            for i in range(0, len(exts), per)
+        ]
+    flat = _map_threads(
+        lambda g: native_fn(g[0], np.asarray(g[1], dtype=np.int64)), groups
+    )
+    return [
+        blob[32 * i : 32 * (i + 1)] for blob in flat for i in range(len(blob) // 32)
+    ]
+
+
 def _host_digests(items: list[tuple[np.ndarray, int, int]]) -> list[bytes]:
     """Threaded host SHA-256 over (array, offset, size) extents.
 
     Routes through the native SHA-NI batch call when the engine is built
-    (per-array extent runs split into ~cpu_count GIL-dropping native
-    calls); hashlib otherwise — which
-    also releases the GIL for buffers > 2 KiB, so both arms scale across
-    cores (the crossover arm for small batches where the device scan is
+    (≥ 8 items: below that hashlib — which also drops the GIL for buffers
+    > 2 KiB — beats the FFI round trip); both arms scale across cores
+    (the crossover arm for small batches where the device scan is
     latency-bound).
     """
     import hashlib
@@ -110,35 +143,7 @@ def _host_digests(items: list[tuple[np.ndarray, int, int]]) -> list[bytes]:
 
     lib = native_cdc.load()
     if lib is not None and hasattr(lib, "ntpu_sha256_many") and len(items) >= 8:
-        # Group runs of extents sharing a source array, then split long runs
-        # into ~cpu_count sub-groups so one large stream still fans out
-        # across cores (each sub-group is an independent GIL-dropping
-        # native call; order-preserving concat keeps digest order).
-        groups: list[tuple[np.ndarray, list[tuple[int, int]]]] = []
-        for arr, off, size in items:
-            if groups and groups[-1][0] is arr:
-                groups[-1][1].append((off, size))
-            else:
-                groups.append((arr, [(off, size)]))
-        ncpu = _cpu_count()
-        if ncpu > 1 and len(groups) < ncpu:
-            per = max(8, -(-len(items) // ncpu))
-            groups = [
-                (arr, exts[i : i + per])
-                for arr, exts in groups
-                for i in range(0, len(exts), per)
-            ]
-        flat = _map_threads(
-            lambda g: native_cdc.sha256_many_native(
-                g[0], np.asarray(g[1], dtype=np.int64)
-            ),
-            groups,
-        )
-        return [
-            blob[32 * i : 32 * (i + 1)]
-            for blob in flat
-            for i in range(len(blob) // 32)
-        ]
+        return _grouped_native_digests(items, native_cdc.sha256_many_native)
 
     def one(item: tuple[np.ndarray, int, int]) -> bytes:
         arr, off, size = item
@@ -150,9 +155,11 @@ def _host_digests(items: list[tuple[np.ndarray, int, int]]) -> list[bytes]:
 def _host_digests_blake3(items: list[tuple[np.ndarray, int, int]]) -> list[bytes]:
     """Threaded host BLAKE3 over (array, offset, size) extents.
 
-    Same grouping/fan-out shape as :func:`_host_digests`, hashing with the
-    native blake3 arm (ntpu_blake3_many) when the engine is built, the
-    pure-Python spec implementation otherwise. Needed when packing with
+    Same fan-out as :func:`_host_digests` via the shared grouped-batch
+    helper, hashing with the native blake3 arm (ntpu_blake3_many) when the
+    engine is built — with no minimum-batch gate, because the fallback is
+    the pure-Python spec implementation (~3 orders slower than hashlib, so
+    the FFI round trip always wins). Needed when packing with
     ``digester="blake3"`` so chunk digests match the reference toolchain's
     default and dedup against REAL nydus images gets content hits
     (reference tool/builder.go:122-123 chunk-dict probes are digest-keyed).
@@ -161,31 +168,7 @@ def _host_digests_blake3(items: list[tuple[np.ndarray, int, int]]) -> list[bytes
 
     lib = native_cdc.load()
     if lib is not None and hasattr(lib, "ntpu_blake3_many"):
-        groups: list[tuple[np.ndarray, list[tuple[int, int]]]] = []
-        for arr, off, size in items:
-            if groups and groups[-1][0] is arr:
-                groups[-1][1].append((off, size))
-            else:
-                groups.append((arr, [(off, size)]))
-        ncpu = _cpu_count()
-        if ncpu > 1 and len(groups) < ncpu:
-            per = max(8, -(-len(items) // ncpu))
-            groups = [
-                (arr, exts[i : i + per])
-                for arr, exts in groups
-                for i in range(0, len(exts), per)
-            ]
-        flat = _map_threads(
-            lambda g: native_cdc.blake3_many_native(
-                g[0], np.asarray(g[1], dtype=np.int64)
-            ),
-            groups,
-        )
-        return [
-            blob[32 * i : 32 * (i + 1)]
-            for blob in flat
-            for i in range(len(blob) // 32)
-        ]
+        return _grouped_native_digests(items, native_cdc.blake3_many_native)
 
     from nydus_snapshotter_tpu.utils import blake3 as pyb3
 
@@ -194,6 +177,12 @@ def _host_digests_blake3(items: list[tuple[np.ndarray, int, int]]) -> list[bytes
         return pyb3.blake3(bytes(memoryview(arr)[off : off + size]))
 
     return _map_threads(one, items, min_batch=8)
+
+
+def host_digests_for(digester: str):
+    """The (array, offset, size)-extents digest fan-out for an algorithm —
+    the single selector pack paths use instead of branching inline."""
+    return _host_digests_blake3 if digester == "blake3" else _host_digests
 
 
 class ChunkDigestEngine:
@@ -452,6 +441,10 @@ class ChunkDigestEngine:
         index build sources, where boundaries come from the tar layout."""
         if not datas:
             return []
+        if self.digester == "blake3":
+            return _host_digests_blake3(
+                [(np.frombuffer(d, dtype=np.uint8), 0, len(d)) for d in datas]
+            )
         if self.digest_backend == "numpy":
             import hashlib
 

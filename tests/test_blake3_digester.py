@@ -80,9 +80,12 @@ class TestNativeBlake3:
         )
         assert out.hex().startswith("af1349b9f5f9a1a6")
 
-    def test_host_digests_blake3_python_fallback(self):
-        # The threaded fan-out helper must agree with the oracle even when
-        # forced down the pure-Python lane (native lib present or not).
+    def test_host_digests_blake3_python_fallback(self, monkeypatch):
+        # The threaded fan-out helper must agree with the oracle when
+        # FORCED down the pure-Python lane (the path every user without
+        # the native build hits).
+        monkeypatch.setattr(native_cdc, "load", lambda: None)
+
         from nydus_snapshotter_tpu.ops.chunker import _host_digests_blake3
 
         rng = random.Random(11)
@@ -142,6 +145,24 @@ class TestPackDigester:
     def test_bad_digester_rejected(self, tmp_path):
         with pytest.raises(ConvertError):
             PackOption(work_dir=str(tmp_path), digester="md5").validate()
+
+    def test_oci_ref_zran_honors_digester(self):
+        # The zran/oci_ref pack path digests pre-delimited chunks outside
+        # the CDC engine; it must honor PackOption.digester too.
+        import gzip
+
+        from nydus_snapshotter_tpu.converter.zran import pack_gzip_layer
+
+        rng = random.Random(8)
+        payload = bytes(rng.randrange(256) for _ in range(1_500_000))
+        raw = gzip.compress(_mktar([("f.bin", payload)]))
+        bs = pack_gzip_layer(raw, PackOption(oci_ref=True, digester="blake3"))
+        assert bs.chunks
+        # chunk offsets are tar-stream offsets; recompute from the tar
+        tar = gzip.decompress(raw)
+        for c in bs.chunks:
+            seg = tar[c.uncompressed_offset : c.uncompressed_offset + c.uncompressed_size]
+            assert c.digest == pyb3.blake3(seg)
 
 
 class TestRealImageDedup:

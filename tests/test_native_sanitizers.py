@@ -121,6 +121,22 @@ lib.ntpu_sha256_many(data.ctypes.data, ext.ctypes.data, len(sizes), out.ctypes.d
 for i, (o, s) in enumerate(ext):
     assert out[i].tobytes() == hashlib.sha256(data[o:o+s].tobytes()).digest(), i
 
+# BLAKE3 batch over tree-boundary sizes (block / chunk / pow2-subtree
+# splits and the recursive merge path), vs the pure-Python spec oracle.
+if hasattr(lib, "ntpu_blake3_many"):
+    from nydus_snapshotter_tpu.utils import blake3 as pyb3
+    b3sizes = [0, 1, 64, 1023, 1024, 1025, 3072, 5 * 1024 + 7, 100000, 1 << 19]
+    ext = []
+    off = 0
+    for s in b3sizes:
+        ext.append((off, s))
+        off += s
+    ext = np.asarray(ext, dtype=np.int64)
+    out = np.empty((len(b3sizes), 32), dtype=np.uint8)
+    lib.ntpu_blake3_many(data.ctypes.data, ext.ctypes.data, len(b3sizes), out.ctypes.data)
+    for i, (o, s) in enumerate(ext):
+        assert out[i].tobytes() == pyb3.blake3(data[o:o+s].tobytes()), i
+
 # Dict build + probe (linear-probe chains, shard arithmetic).
 n = 100_000
 digests = rng.integers(0, 2**32, (n, 8), dtype=np.uint32)

@@ -195,6 +195,34 @@ def bench_probe(n_entries: int = 1_000_000, m_queries: int = 262_144):
     def fn(q, w, o):
         return probe_pallas.probe_padded(kd, vd, q, w, o, depth)
 
+    # Lowering smoke first: a tiny-Q call proves the Mosaic compile (the
+    # first real-TPU window died on a memory-space constraint interpret
+    # mode can't see) and prints its own line, so even a window too short
+    # for the full run records whether the kernel lowers on hardware.
+    qs, ws, os_ = argsets[0]
+    smoke = int(
+        np.count_nonzero(
+            np.asarray(
+                jax.device_get(
+                    probe_pallas.probe_padded(
+                        kd, vd, qs[:1024], ws[:1024], os_[:1024], depth
+                    )
+                )
+            )
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "stage": "dict-probe-pallas-smoke",
+                "lowered": True,
+                "hits_nonzero": smoke > 0,
+                "backend": jax.default_backend(),
+            }
+        ),
+        flush=True,
+    )
+
     dt = _timeit(fn, argsets)
     # correctness signal, outside the timed region: planted hits found
     hits = int(np.count_nonzero(np.asarray(jax.device_get(fn(*argsets[0])))))
