@@ -222,9 +222,11 @@ class ChunkDigestEngine:
         if digester not in ("sha256", "blake3"):
             raise ValueError(f"unknown digester {digester!r}")
         # blake3 = the reference toolchain's default chunk digester
-        # (RafsSuperFlags HASH_BLAKE3): digests always run on the host arm
-        # (native ntpu_blake3_many / pure-Python spec impl) — the device
-        # SHA-256 batch kernel and the SHA-NI fused arms are sha-specific.
+        # (RafsSuperFlags HASH_BLAKE3). digest_backend="jax" routes blake3
+        # through the device tree kernel (_digests_bucketed_b3 /
+        # ops/blake3_jax); other backends use the host arm (native
+        # ntpu_blake3_many / pure-Python spec impl). The SHA-NI *fused*
+        # chunk+digest arms are sha-specific and gate off (_fused_available).
         self.digester = digester
         self.params = cdc.CDCParams(chunk_size) if mode == "cdc" else None
 
@@ -314,7 +316,10 @@ class ChunkDigestEngine:
         arr = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else data
         extents = cdc.cuts_to_extents(cuts)
         if self.digester == "blake3":
-            return _host_digests_blake3([(arr, o, s) for o, s in extents])
+            items = [(arr, o, s) for o, s in extents]
+            if self.digest_backend == "jax":
+                return self._digests_bucketed_b3(items)
+            return _host_digests_blake3(items)
         if self.digest_backend == "numpy":
             import hashlib
 
@@ -355,6 +360,43 @@ class ChunkDigestEngine:
             )
             for row, i in enumerate(idxs):
                 out[i] = sha256.digest_to_bytes(states[row])
+        return out  # type: ignore[return-value]
+
+    def _digests_bucketed_b3(
+        self, items: list[tuple[np.ndarray, int, int]]
+    ) -> list[bytes]:
+        """Device BLAKE3: bucket chunks by power-of-two leaf count, digest
+        per bucket (ops/blake3_jax — leaves parallel across lanes, log-depth
+        tree merge). The blake3 analog of :meth:`_digests_bucketed`; takes
+        (array, offset, size) items so call sites hand over zero-copy views
+        (the only copy is pack_messages_np's write into the padded batch)."""
+        from nydus_snapshotter_tpu.ops import blake3_jax
+
+        out: list[bytes | None] = [None] * len(items)
+        if not items:
+            return []
+        max_chunk = self.params.max_size if self.params else self.chunk_size
+        max_leaves = _pow2_ceil(blake3_jax.n_leaves(max_chunk))
+        buckets: dict[int, list[int]] = {}
+        for idx, (_arr, _off, size) in enumerate(items):
+            cap = min(_pow2_ceil(blake3_jax.n_leaves(size)), max_leaves)
+            buckets.setdefault(cap, []).append(idx)
+        for cap, idxs in sorted(buckets.items()):
+            msgs = [items[i][0][items[i][1] : items[i][1] + items[i][2]] for i in idxs]
+            blocks, lengths = blake3_jax.pack_messages_np(msgs, leaf_capacity=cap)
+            m_pad = _pow2_ceil(len(msgs)) - len(msgs)
+            if m_pad:
+                blocks = np.concatenate(
+                    [blocks, np.zeros((m_pad,) + blocks.shape[1:], np.uint32)]
+                )
+                lengths = np.concatenate([lengths, np.zeros(m_pad, np.int32)])
+            words = np.asarray(
+                jax.device_get(
+                    blake3_jax.blake3_batch(jnp.asarray(blocks), jnp.asarray(lengths))
+                )
+            )
+            for row, i in enumerate(idxs):
+                out[i] = blake3_jax.digest_to_bytes(words[row])
         return out  # type: ignore[return-value]
 
     def boundaries_many(self, arrs: list[np.ndarray]) -> list[np.ndarray]:
@@ -399,13 +441,14 @@ class ChunkDigestEngine:
         if not arrs:
             return []
         if self.digester == "blake3":
-            return _host_digests_blake3(
-                [
-                    (arr, o, s)
-                    for arr, extents in zip(arrs, per_file_extents)
-                    for o, s in extents
-                ]
-            )
+            items = [
+                (arr, o, s)
+                for arr, extents in zip(arrs, per_file_extents)
+                for o, s in extents
+            ]
+            if self.digest_backend == "jax":
+                return self._digests_bucketed_b3(items)
+            return _host_digests_blake3(items)
         if self.digest_backend == "host":
             return _host_digests(
                 [
@@ -442,9 +485,10 @@ class ChunkDigestEngine:
         if not datas:
             return []
         if self.digester == "blake3":
-            return _host_digests_blake3(
-                [(np.frombuffer(d, dtype=np.uint8), 0, len(d)) for d in datas]
-            )
+            items = [(np.frombuffer(d, dtype=np.uint8), 0, len(d)) for d in datas]
+            if self.digest_backend == "jax":
+                return self._digests_bucketed_b3(items)
+            return _host_digests_blake3(items)
         if self.digest_backend == "numpy":
             import hashlib
 

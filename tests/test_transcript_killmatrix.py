@@ -194,9 +194,11 @@ class TestMultiDaemonKillFailover:
             for d in daemons:
                 while time.time() < deadline:
                     try:
-                        if d.pid != pids[d.id] or d.state().name == "RUNNING":
-                            if d.client().info().get("state") == "RUNNING":
-                                break
+                        if (
+                            d.pid != pids[d.id]
+                            and d.client().get_daemon_info().get("state") == "RUNNING"
+                        ):
+                            break
                     except Exception:
                         pass
                     time.sleep(0.2)
@@ -291,6 +293,164 @@ class TestSharedImageMultipleContainers:
             client.remove(chain)
             client.cleanup()  # releases the instance synchronously
             assert fs.instances.get(snap_id) is None, "instance not released"
+        finally:
+            client.close()
+            server.stop(grace=None)
+            fs.teardown()
+            sn.close()
+            mgr.stop()
+
+
+class TestKillSnapshotterAndDaemonRecover:
+    def test_kill_both_then_recover_from_persisted_state(self, tmp_path):
+        """entrypoint.sh:359 kill_snapshotter_and_nydusd_recover analog:
+        the snapshotter AND its daemon die together; a fresh stack over the
+        same root must clear the vestige, spawn a NEW daemon (the old pid
+        is gone), replay the persisted instances, and serve reads again —
+        the full crash-recovery path from sqlite + dumped daemon configs."""
+        cfg = _mk_cfg(tmp_path)
+        boot, blob_dir, files = _build_image(tmp_path)
+        db, mgr, fs, sn, server, client, sock = _mk_stack(cfg)
+        try:
+            ctr_key, chain, mounts = _pull_and_run(client, sn, fs, boot, blob_dir)
+            daemon = fs.get_shared_daemon(C.FS_DRIVER_FUSEDEV)
+            pid1 = daemon.pid
+            rafs = fs.instances.list()[0]
+            snap_id = rafs.snapshot_id
+            assert (
+                daemon.client().read_file(f"/{snap_id}", "/app/hello.txt")
+                == files["/app/hello.txt"]
+            )
+        finally:
+            # crash BOTH: gRPC/state drops without teardown, daemon killed
+            client.close()
+            server.stop(grace=None)
+            sn.close()
+            mgr.stop()
+        os.kill(pid1, signal.SIGKILL)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                os.kill(pid1, 0)
+                time.sleep(0.1)
+            except ProcessLookupError:
+                break
+
+        db2, mgr2, fs2, sn2, server2, client2, _sock = _mk_stack(cfg)
+        try:
+            fs2.wait_until_ready(snap_id)
+            d2 = fs2.get_shared_daemon(C.FS_DRIVER_FUSEDEV)
+            assert d2.pid != pid1, "dead daemon must be respawned, not reused"
+            assert (
+                d2.client().read_file(f"/{snap_id}", "/app/hello.txt")
+                == files["/app/hello.txt"]
+            )
+            mounts2 = client2.mounts(ctr_key)
+            assert _lowerdir_of(mounts2) == _lowerdir_of(mounts)
+        finally:
+            client2.close()
+            server2.stop(grace=None)
+            fs2.teardown()
+            sn2.close()
+            mgr2.stop()
+
+
+class TestKillDaemonRestartPolicy:
+    def test_sigkill_daemon_restart_policy_respawns_and_remounts(self, tmp_path):
+        """entrypoint.sh:478 kill_nydusd_recover_nydusd analog — the
+        RESTART recover policy arm (the failover arm is covered above):
+        SIGKILL the live shared daemon; the epoll liveness monitor's death
+        event must respawn a NEW daemon process and re-mount the persisted
+        instances through the API, with reads working after."""
+        cfg = _mk_cfg(tmp_path, policy=C.RECOVER_POLICY_RESTART)
+        boot, blob_dir, files = _build_image(tmp_path)
+        db, mgr, fs, sn, server, client, sock = _mk_stack(cfg)
+        try:
+            ctr_key, chain, mounts = _pull_and_run(client, sn, fs, boot, blob_dir)
+            daemon = fs.get_shared_daemon(C.FS_DRIVER_FUSEDEV)
+            pid1 = daemon.pid
+            rafs = fs.instances.list()[0]
+            snap_id = rafs.snapshot_id
+            assert (
+                daemon.client().read_file(f"/{snap_id}", "/app/hello.txt")
+                == files["/app/hello.txt"]
+            )
+            os.kill(pid1, signal.SIGKILL)
+            # monitor death event -> restart policy respawn -> re-mount
+            deadline = time.time() + 30
+            recovered = False
+            while time.time() < deadline:
+                try:
+                    d = fs.get_shared_daemon(C.FS_DRIVER_FUSEDEV)
+                    if (
+                        d.pid != pid1
+                        and d.client().read_file(f"/{snap_id}", "/app/hello.txt")
+                        == files["/app/hello.txt"]
+                    ):
+                        recovered = True
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            assert recovered, "restart policy did not respawn + re-mount"
+            # the gRPC surface never noticed
+            mounts2 = client.mounts(ctr_key)
+            assert _lowerdir_of(mounts2) == _lowerdir_of(mounts)
+        finally:
+            client.close()
+            server.stop(grace=None)
+            fs.teardown()
+            sn.close()
+            mgr.stop()
+
+
+class TestPullRemoveLoop:
+    def test_pull_remove_multiple_images_clears_everything(self, tmp_path):
+        """entrypoint.sh:317 pull_remove_multiple_images +
+        validate_mnt_number (:110) analog: pull several images, validate
+        the instance count matches, remove them all, and verify instances
+        AND blob caches are gone — the leak check the reference loops in
+        its e2e container."""
+        cfg = _mk_cfg(tmp_path)
+        db, mgr, fs, sn, server, client, sock = _mk_stack(cfg)
+        try:
+            imgs = {}
+            for name in ("alpha", "beta", "gamma"):
+                sub = tmp_path / name
+                sub.mkdir()
+                boot, blob_dir, files = _build_image(sub)
+                ctr_key, chain, mounts = _pull_and_run(
+                    client, sn, fs, boot, blob_dir, name=name
+                )
+                imgs[name] = (ctr_key, chain)
+            # one mounted rafs instance per image (validate_mnt_number)
+            assert len(fs.instances.list()) == len(imgs)
+            # every image serves through the shared daemon
+            daemon = fs.get_shared_daemon(C.FS_DRIVER_FUSEDEV)
+            for rafs in fs.instances.list():
+                assert (
+                    daemon.client().read_file(
+                        f"/{rafs.snapshot_id}", "/app/hello.txt"
+                    )
+                    == b"hello from rafs\n"
+                )
+            for name, (ctr_key, chain) in imgs.items():
+                client.remove(ctr_key)
+                client.remove(chain)
+            client.cleanup()
+            assert fs.instances.list() == [], "instances leaked after removal"
+            # blob caches cleared (is_cache_cleared analog, async removal)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                leftovers = [
+                    f
+                    for f in os.listdir(cfg.cache_root)
+                    if f.endswith((".blob.data", ".chunk_map"))
+                ] if os.path.isdir(cfg.cache_root) else []
+                if not leftovers:
+                    break
+                time.sleep(0.1)
+            assert not leftovers, f"blob cache leaked: {leftovers}"
         finally:
             client.close()
             server.stop(grace=None)

@@ -11,7 +11,7 @@ Replaces the chunking+digesting hot loop of the reference's external
 ``nydus-image create`` (pkg/converter/tool/builder.go:148-178) with the
 repo's Pallas/XLA kernels; this script is the hardware evidence for them.
 
-Usage: python tools/device_resident_bench.py [--stage all|gear|gear-xla|sha|sha-pallas|probe] [--mib N]
+Usage: python tools/device_resident_bench.py [--stage all|gear|gear-xla|sha|sha-pallas|b3|probe] [--mib N]
 Intended to be driven by tools/device_hunt.py inside a hard-timeout
 subprocess (a wedged tunnel hangs forever; see memory: axon-tunnel-wedges).
 """
@@ -144,6 +144,37 @@ def bench_sha(total_mib: int, chunk_kib: int = 64, pallas: bool = False):
     }
 
 
+def bench_b3(total_mib: int, chunk_kib: int = 1024):
+    """Device BLAKE3 batch (ops/blake3_jax): leaves parallel across lanes,
+    log-depth tree merge. The device lane for the real toolchain's default
+    chunk digester — measured here because the SHA arms say nothing about
+    a tree-structured hash's lane occupancy."""
+    import jax
+    import jax.numpy as jnp
+
+    from nydus_snapshotter_tpu.ops import blake3_jax
+
+    chunk = chunk_kib << 10
+    m = max(1, (total_mib << 20) // chunk)
+    cap = blake3_jax.n_leaves(chunk)
+    shape = (m, cap, 16, 16)
+    blocks = _devgen_u32(shape, 4)
+    blocks2 = _devgen_u32(shape, 5)
+    lengths = jnp.full(m, chunk, dtype=jnp.int32)
+
+    fn = blake3_jax.blake3_batch
+    dt = _timeit(fn, [(blocks, lengths), (blocks2, lengths)])
+    nbytes = m * chunk
+    return {
+        "stage": "blake3",
+        "gibps": round(nbytes / dt / (1 << 30), 3),
+        "ms": round(dt * 1e3, 2),
+        "shape": list(shape),
+        "backend": jax.default_backend(),
+        "devgen": True,
+    }
+
+
 def bench_probe(n_entries: int = 1_000_000, m_queries: int = 262_144):
     """DMA-pipelined Pallas dict probe (ops/probe_pallas) on device.
 
@@ -268,6 +299,8 @@ def main():
         print(json.dumps(bench_sha(args.mib)), flush=True)
     if args.stage in ("all", "sha-pallas"):
         print(json.dumps(bench_sha(args.mib, pallas=True)), flush=True)
+    if args.stage in ("all", "b3"):
+        print(json.dumps(bench_b3(args.mib)), flush=True)
     if args.stage in ("all", "probe"):
         print(json.dumps(bench_probe()), flush=True)
 
