@@ -114,5 +114,11 @@ CHUNK_SIZE_DEFAULT = 0x100000  # 1 MiB, nydus default
 COMPRESSOR_NONE = 0x0000_0001
 COMPRESSOR_ZSTD = 0x0000_0002
 COMPRESSOR_LZ4_BLOCK = 0x0000_0004
+
+# zstd level for chunk compression — the SINGLE source: the Python codec
+# lane (utils/zstd.py), the converter, and the native fused arms (level
+# threaded through the pack ABI's codec-param slot) all read this, so the
+# cross-lane byte-identity invariant cannot drift on a level bump.
+ZSTD_LEVEL = 3
 COMPRESSOR_GZIP = 0x0000_0008  # estargz chunks stay gzip streams in-place
 COMPRESSOR_MASK = 0x0000_000F

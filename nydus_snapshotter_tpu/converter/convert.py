@@ -43,7 +43,7 @@ from nydus_snapshotter_tpu.models.bootstrap import (
 )
 from nydus_snapshotter_tpu.utils import lz4
 
-_ZSTD_LEVEL = 3
+_ZSTD_LEVEL = constants.ZSTD_LEVEL
 
 
 @dataclass
@@ -64,6 +64,16 @@ def _make_compressor(compressor: str, lz4_accel: int = 1):
     """One reusable codec per Pack — a fresh zstd context per chunk costs
     allocation/init for every one of the thousands of chunks in a layer."""
     if compressor == "zstd":
+        from nydus_snapshotter_tpu.utils import zstd as zstd_native
+
+        if zstd_native.available():
+            # System libzstd: byte-identical to the fused native section
+            # assembly (which dlopens the same library) — the bundled
+            # zstandard build can emit different frames (utils/zstd.py).
+            return lambda data: (
+                zstd_native.compress_block(data, _ZSTD_LEVEL),
+                constants.COMPRESSOR_ZSTD,
+            )
         ctx = zstandard.ZstdCompressor(level=_ZSTD_LEVEL)
         return lambda data: (ctx.compress(data), constants.COMPRESSOR_ZSTD)
     if compressor == "lz4_block":

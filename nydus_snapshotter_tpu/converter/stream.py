@@ -363,9 +363,12 @@ class _DeferredSectionWriter:
 
     Only used for layouts it reproduces byte-identically to
     _SectionWriter: chunks packed back-to-back (align 1, no batch
-    packing), no encryption, lz4_block/none compressor. If the native arm
-    is unavailable at finish() (e.g. liblz4 vanished), the recorded
-    extents replay through the Python codec — same bytes either way.
+    packing), no encryption, lz4_block/zstd/none compressor (native zstd
+    is ZSTD_compress level 3 — byte-identical to the Python lane's
+    zstandard level-3 context against the same libzstd). If the native
+    arm is unavailable at finish() (e.g. liblz4/libzstd vanished), the
+    recorded extents replay through the Python codec — same bytes either
+    way.
     """
 
     def __init__(self, out: _CountingWriter, opt: PackOption, compress, raw: memoryview):
@@ -376,13 +379,16 @@ class _DeferredSectionWriter:
         self.coff = 0
         self.extents: list[Optional[tuple[int, int, int]]] = []
         self.batches: list[tuple[int, int, int]] = []
-        self._kind = 1 if opt.compressor == "lz4_block" else 0
-        self._accel = opt.lz4_acceleration
-        self._cflag = (
-            constants.COMPRESSOR_LZ4_BLOCK
-            if opt.compressor == "lz4_block"
-            else constants.COMPRESSOR_NONE
+        self._kind = {"lz4_block": 1, "zstd": 2}.get(opt.compressor, 0)
+        # codec-param slot: lz4 acceleration, or the zstd level (single
+        # source constants.ZSTD_LEVEL — threads through to the native arm)
+        self._accel = (
+            constants.ZSTD_LEVEL if self._kind == 2 else opt.lz4_acceleration
         )
+        self._cflag = {
+            "lz4_block": constants.COMPRESSOR_LZ4_BLOCK,
+            "zstd": constants.COMPRESSOR_ZSTD,
+        }.get(opt.compressor, constants.COMPRESSOR_NONE)
         self._raw_arr = np.frombuffer(raw, dtype=np.uint8)
         self._base = self._raw_arr.ctypes.data
         self._raw_len = len(raw)
@@ -725,7 +731,7 @@ def pack_stream(
     align_needed = opt.aligned_chunk and opt.fs_version == layout.RAFS_V5
     if (
         raw is not None
-        and opt.compressor in ("none", "lz4_block")
+        and opt.compressor in ("none", "lz4_block", "zstd")
         and not opt.encrypt
         and not opt.batch_size
         and not align_needed

@@ -388,6 +388,40 @@ inline int64_t lz4_bound(int64_t n) { return n + n / 255 + 16; }
 
 constexpr int64_t LZ4_MAX_INPUT = 0x7E000000;
 
+// ---- zstd codec (dlopen'd system libzstd; absent -> caller falls back
+// to its Python codec path). The level arrives through the pack ABI's
+// codec-param slot (Python single source: constants.ZSTD_LEVEL);
+// ZSTD_compress at a given level is byte-identical to the Python lane's
+// system-libzstd binding at the same level, so the fused/serial/parallel
+// and Python arms keep the byte-identity invariant across compressors. ----
+
+typedef size_t (*zstd_compress_fn)(void *, size_t, const void *, size_t, int);
+typedef size_t (*zstd_bound_fn)(size_t);
+typedef unsigned (*zstd_iserr_fn)(size_t);
+
+struct ZstdApi {
+  zstd_compress_fn compress;
+  zstd_bound_fn bound;
+  zstd_iserr_fn iserr;
+};
+
+
+const ZstdApi *load_zstd(void) {
+  static const ZstdApi *api = []() -> const ZstdApi * {
+    void *h = dlopen("libzstd.so.1", RTLD_NOW);
+    if (h == nullptr) h = dlopen("libzstd.so", RTLD_NOW);
+    if (h == nullptr) return nullptr;
+    static ZstdApi a;
+    a.compress = (zstd_compress_fn)dlsym(h, "ZSTD_compress");
+    a.bound = (zstd_bound_fn)dlsym(h, "ZSTD_compressBound");
+    a.iserr = (zstd_iserr_fn)dlsym(h, "ZSTD_isError");
+    if (a.compress == nullptr || a.bound == nullptr || a.iserr == nullptr)
+      return nullptr;
+    return &a;
+  }();
+  return api;
+}
+
 }  // namespace
 
 extern "C" {
@@ -697,39 +731,62 @@ int64_t ntpu_chunk_digest_multi(const uint8_t *data, const int64_t *extents,
 // output bytes are identical to the serial pass.
 //
 // Returns the section size, -1 on overflow/allocation/compress failure,
-// -2 when compressor needs liblz4 and it is unavailable.
+// -2 when the compressor's system library (liblz4/libzstd) is absent.
 int64_t ntpu_pack_section(const uint8_t *src0, const uint8_t *src1,
                           const int64_t *extents, int64_t m,
                           int64_t compressor, int64_t accel,
                           int64_t n_threads, uint8_t *out, int64_t out_cap,
                           int64_t *comp_extents, uint8_t *blob_digest32) {
   lz4_fast_fn lz4 = nullptr;
+  const ZstdApi *zstd = nullptr;
   if (compressor == 1) {
     lz4 = load_lz4();
     if (lz4 == nullptr) return -2;
+  } else if (compressor == 2) {
+    zstd = load_zstd();
+    if (zstd == nullptr) return -2;
   }
   if (accel < 1) accel = 1;
+  // Worst-case output per chunk for bound-spaced parallel slots and
+  // serial overflow checks.
+  auto bound = [&](int64_t n) -> int64_t {
+    if (compressor == 1) return lz4_bound(n);
+    if (compressor == 2) return (int64_t)zstd->bound((size_t)n);
+    return n;
+  };
+  // Compress one chunk into dst (dst has >= bound(size) room); returns
+  // csize or -1 on codec failure.
+  auto compress_one = [&](const uint8_t *src, int64_t size, uint8_t *dst,
+                          int64_t dst_cap) -> int64_t {
+    if (compressor == 1) {
+      const int64_t cap =
+          dst_cap > LZ4_MAX_INPUT ? LZ4_MAX_INPUT : dst_cap;
+      const int64_t csize = lz4((const char *)src, (char *)dst, (int)size,
+                                (int)cap, (int)accel);
+      return csize <= 0 ? -1 : csize;
+    }
+    if (compressor == 2) {
+      // accel doubles as the codec-param slot: for zstd it IS the level,
+      // threaded from Python's single source (constants.ZSTD_LEVEL) so
+      // the cross-lane byte identity cannot drift on a level bump.
+      const size_t w = zstd->compress(dst, (size_t)dst_cap, src,
+                                      (size_t)size, (int)accel);
+      return zstd->iserr(w) ? -1 : (int64_t)w;
+    }
+    std::memcpy(dst, src, (size_t)size);
+    return size;
+  };
   int64_t coff = 0;
   if (m > 0 && n_threads <= 1) {
     for (int64_t j = 0; j < m; ++j) {
       const uint8_t *base = extents[3 * j] == 0 ? src0 : src1;
       const int64_t off = extents[3 * j + 1];
       const int64_t size = extents[3 * j + 2];
-      int64_t csize;
-      if (compressor == 1) {
-        if (size > LZ4_MAX_INPUT || coff + lz4_bound(size) > out_cap)
-          return -1;
-        csize = lz4((const char *)(base + off), (char *)(out + coff),
-                    (int)size, (int)(out_cap - coff > LZ4_MAX_INPUT
-                                         ? LZ4_MAX_INPUT
-                                         : out_cap - coff),
-                    (int)accel);
-        if (csize <= 0) return -1;
-      } else {
-        if (coff + size > out_cap) return -1;
-        std::memcpy(out + coff, base + off, (size_t)size);
-        csize = size;
-      }
+      if (compressor == 1 && size > LZ4_MAX_INPUT) return -1;
+      if (coff + bound(size) > out_cap) return -1;
+      const int64_t csize =
+          compress_one(base + off, size, out + coff, out_cap - coff);
+      if (csize < 0) return -1;
       comp_extents[2 * j] = coff;
       comp_extents[2 * j + 1] = csize;
       coff += csize;
@@ -744,9 +801,9 @@ int64_t ntpu_pack_section(const uint8_t *src0, const uint8_t *src1,
     int64_t acc = 0;
     for (int64_t j = 0; j < m; ++j) {
       const int64_t size = extents[3 * j + 2];
-      if (size > LZ4_MAX_INPUT) return -1;
+      if (compressor == 1 && size > LZ4_MAX_INPUT) return -1;
       pre[(size_t)j] = acc;
-      acc += compressor == 1 ? lz4_bound(size) : size;
+      acc += bound(size);
     }
     if (acc > out_cap) return -1;
     std::atomic<int64_t> next{0};
@@ -761,18 +818,11 @@ int64_t ntpu_pack_section(const uint8_t *src0, const uint8_t *src1,
           const uint8_t *base = extents[3 * j] == 0 ? src0 : src1;
           const int64_t off = extents[3 * j + 1];
           const int64_t size = extents[3 * j + 2];
-          int64_t csize;
-          if (compressor == 1) {
-            csize = lz4((const char *)(base + off),
-                        (char *)(out + pre[(size_t)j]), (int)size,
-                        (int)lz4_bound(size), (int)accel);
-            if (csize <= 0) {
-              failed.store(true, std::memory_order_relaxed);
-              return;
-            }
-          } else {
-            std::memcpy(out + pre[(size_t)j], base + off, (size_t)size);
-            csize = size;
+          const int64_t csize = compress_one(
+              base + off, size, out + pre[(size_t)j], bound(size));
+          if (csize < 0) {
+            failed.store(true, std::memory_order_relaxed);
+            return;
           }
           comp_extents[2 * j + 1] = csize;
         }
@@ -806,13 +856,14 @@ int64_t ntpu_pack_section(const uint8_t *src0, const uint8_t *src1,
 // `nydus-image create` hot loop (pkg/converter/tool/builder.go:148-178).
 //
 // Inputs: data/n = the tar buffer; extents = m (off, size) pairs in tar
-// order; CDC params; compressor (0 raw, 1 lz4) + accel + n_threads for
+// order; CDC params; compressor (0 raw, 1 lz4, 2 zstd) + codec param
+// (lz4 acceleration / zstd level) + n_threads for
 // the assembly phase.
 // Outputs: per-file chunk counts; per-chunk-ref digest32 / size /
 // unique-index (first occurrence wins, indices dense in first-seen
 // order); per-unique (coff, csize) extents; the assembled blob and its
 // SHA-256. n_uniq_out / blob_size_out receive the table sizes.
-// Returns total chunk refs; -1 overflow/OOM; -2 lz4 unavailable.
+// Returns total chunk refs; -1 overflow/OOM; -2 system codec absent.
 int64_t ntpu_pack_files(const uint8_t *data, int64_t n,
                         const int64_t *extents, int64_t m,
                         uint32_t mask_small, uint32_t mask_large,

@@ -312,6 +312,20 @@ def blake3_many_native(data: np.ndarray, extents: np.ndarray) -> bytes:
     return out.tobytes()
 
 
+def _comp_bound_total(total_bytes: int, n_chunks: int, compressor: int) -> int:
+    """Worst-case section size for n_chunks chunks summing total_bytes.
+
+    Must dominate the native arm's per-chunk bound: lz4 n + n/255 + 16;
+    zstd ZSTD_compressBound = n + n/256 + small (≤ 64 B lowmem margin) —
+    over-provisioned here as n/128 + 128 per chunk against version drift.
+    """
+    if compressor == 1:
+        return total_bytes + total_bytes // 255 + 16 * n_chunks
+    if compressor == 2:
+        return total_bytes + total_bytes // 128 + 128 * n_chunks
+    return total_bytes
+
+
 def pack_files_available() -> bool:
     """The whole-layer fused pack arm (chunk+digest+dedup+assemble)."""
     lib = load()
@@ -357,11 +371,7 @@ def pack_files(
     sizes = ext[:, 1]
     refs_cap = int((sizes // max(1, params.min_size)).sum()) + 2 * m
     total_bytes = int(sizes.sum())
-    out_cap = (
-        total_bytes + total_bytes // 255 + 16 * refs_cap
-        if compressor == 1
-        else total_bytes
-    )
+    out_cap = _comp_bound_total(total_bytes, refs_cap, compressor)
     file_nchunks = np.empty(m, np.int64)
     digests = np.empty(refs_cap * 32, np.uint8)
     chunk_sizes = np.empty(refs_cap, np.int64)
@@ -424,10 +434,12 @@ def pack_section(
     extents: i64[m, 3] of (src, off, size) — src 0 slices src0 (the tar
     buffer, zero-copy), src 1 slices src1 (staged loose bytes).
     compressor: 0 = store raw, 1 = LZ4 block (accel 1 == liblz4 default
-    output, byte-identical to utils.lz4.compress_block). Returns
+    output, byte-identical to utils.lz4.compress_block), 2 = zstd (accel
+    carries the LEVEL — pass constants.ZSTD_LEVEL; byte-identical to the
+    utils.zstd system-libzstd lane at the same level). Returns
     (section_bytes, comp_extents i64[m, 2] of (coff, csize),
     sha256_of_section) — or None when the native arm cannot run
-    (library/liblz4 missing), in which case the caller uses its Python
+    (library/liblz4/libzstd missing), in which case the caller uses its Python
     codec loop; both paths produce identical bytes.
     """
     lib = load()
@@ -438,7 +450,7 @@ def pack_section(
     if m == 0:
         return np.empty(0, dtype=np.uint8), np.empty((0, 2), dtype=np.int64), b""
     sizes = ext[:, 2]
-    cap = int((sizes + sizes // 255 + 16).sum()) if compressor == 1 else int(sizes.sum())
+    cap = _comp_bound_total(int(sizes.sum()), m, compressor)
     out = np.empty(max(cap, 1), dtype=np.uint8)
     comp = np.empty((m, 2), dtype=np.int64)
     digest = np.empty(32, dtype=np.uint8)
@@ -451,7 +463,7 @@ def pack_section(
         comp.ctypes.data, digest.ctypes.data,
     )
     if total == -2:
-        return None  # liblz4 unavailable: caller's codec path takes over
+        return None  # system codec library absent: Python path takes over
     if total < 0:
         raise RuntimeError("native pack_section failed (overflow or OOM)")
     return out[:total], comp, digest.tobytes()

@@ -148,19 +148,20 @@ ans = native_cdc.dict_probe_native(
 )
 assert (ans[:500] == np.arange(500)).all()
 
-# Fused blob-section assembly: serial vs threaded identity, raw + lz4,
-# two-source extents, edge sizes (empty list, 1-byte, tile-edge chunks).
+# Fused blob-section assembly: serial vs threaded identity, raw + lz4 +
+# zstd, two-source extents, edge sizes (empty list, 1-byte, tile-edge
+# chunks).
 src0 = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
 src0[: 1 << 18] = 0x41  # compressible run
 src1 = rng.integers(0, 256, 4096, dtype=np.uint8)
 ext = [(0, 0, 1), (0, 1, 55), (0, 56, 65536), (1, 0, 4096), (0, 65592, 200000)]
 ext = np.asarray(ext, dtype=np.int64)
-for comp in (0, 1):
+for comp in (0, 1, 2):
     outs = []
     for nt in (1, 3):
         res = native_cdc.pack_section(src0, src1, ext, comp, 1, nt)
         if res is None:
-            assert comp == 1  # liblz4 absent is legal only for lz4
+            assert comp in (1, 2)  # system codec absent is legal
             continue
         blob, cext, dig = res
         assert dig == hashlib.sha256(blob.tobytes()).digest()
@@ -185,12 +186,12 @@ for trial in range(6):
         exts.append((0, off, sz))
         off += sz
     exts = np.asarray(exts, dtype=np.int64)
-    for compn in (0, 1):
+    for compn in (0, 1, 2):
         a = native_cdc.pack_section(big, src1, exts, compn, 1 + trial % 3, 1)
         b = native_cdc.pack_section(big, src1, exts, compn, 1 + trial % 3, 5)
         assert (a is None) == (b is None), (trial, compn)  # asymmetric arm failure
         if a is None:
-            assert compn == 1  # only liblz4 absence may disable the arm
+            assert compn in (1, 2)  # only system-codec absence disables
             continue
         assert a[0].tobytes() == b[0].tobytes(), trial
         assert (a[1] == b[1]).all(), trial  # extent tables, not just bytes
