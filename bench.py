@@ -600,6 +600,31 @@ def main() -> None:
         ),
     }
 
+    # zstd arm: same full path at the reference toolchain's modern default
+    # compressor (native fused section assembly via the system libzstd,
+    # level constants.ZSTD_LEVEL) — records the speed/ratio tradeoff vs
+    # the lz4 headline on the same corpus.
+    opt_zstd = PackOption(
+        chunk_size=CHUNK_SIZE, chunking="cdc", compressor="zstd",
+        **_pack_kwargs(winner),
+    )
+    zstd_best = None
+    packed_zstd = None
+    for _ in range(REPS):
+        t0 = time.time()
+        packed_zstd = _pack_layers(layers, opt_zstd)
+        dt = time.time() - t0
+        zstd_best = dt if zstd_best is None or dt < zstd_best else zstd_best
+    from nydus_snapshotter_tpu import constants as _const
+
+    zstd_profile = {
+        "level": _const.ZSTD_LEVEL,
+        "full_path_gibps": round(total_in / zstd_best / (1 << 30), 4),
+        "compress_ratio": round(
+            sum(r.blob_size for _b, r in packed_zstd) / max(1, total_in), 4
+        ),
+    }
+
     # ---- detail runs ----
     engine_detail = engine_flat_run(bench_engine, probe)
     pool = build_file_pool(min(IMAGE_MIB, 128), seed=555)
@@ -634,6 +659,7 @@ def main() -> None:
                     "engine_flat": engine_detail,
                     "stage_breakdown_s": stage_breakdown,
                     "accel_profile": accel_profile,
+                    "zstd_profile": zstd_profile,
                     "baseline_shaped": shaped,
                     "stargz_zran": stargz_zran,
                     "host_cores": os.cpu_count(),

@@ -398,11 +398,33 @@ constexpr int64_t LZ4_MAX_INPUT = 0x7E000000;
 typedef size_t (*zstd_compress_fn)(void *, size_t, const void *, size_t, int);
 typedef size_t (*zstd_bound_fn)(size_t);
 typedef unsigned (*zstd_iserr_fn)(size_t);
+typedef void *(*zstd_createcctx_fn)(void);
+typedef size_t (*zstd_freecctx_fn)(void *);
+typedef size_t (*zstd_compresscctx_fn)(void *, void *, size_t, const void *,
+                                       size_t, int);
 
 struct ZstdApi {
   zstd_compress_fn compress;
   zstd_bound_fn bound;
   zstd_iserr_fn iserr;
+  zstd_createcctx_fn create_cctx;
+  zstd_freecctx_fn free_cctx;
+  zstd_compresscctx_fn compress_cctx;
+};
+
+// RAII per-worker compression context: ZSTD_compressCCtx produces the
+// same bytes as one-shot ZSTD_compress at the same level, without paying
+// context alloc/init per chunk in the fused hot loop.
+struct ZstdCtx {
+  const ZstdApi *api;
+  void *ctx;
+  explicit ZstdCtx(const ZstdApi *a)
+      : api(a), ctx(a != nullptr ? a->create_cctx() : nullptr) {}
+  ~ZstdCtx() {
+    if (ctx != nullptr) api->free_cctx(ctx);
+  }
+  ZstdCtx(const ZstdCtx &) = delete;
+  ZstdCtx &operator=(const ZstdCtx &) = delete;
 };
 
 
@@ -415,7 +437,12 @@ const ZstdApi *load_zstd(void) {
     a.compress = (zstd_compress_fn)dlsym(h, "ZSTD_compress");
     a.bound = (zstd_bound_fn)dlsym(h, "ZSTD_compressBound");
     a.iserr = (zstd_iserr_fn)dlsym(h, "ZSTD_isError");
-    if (a.compress == nullptr || a.bound == nullptr || a.iserr == nullptr)
+    a.create_cctx = (zstd_createcctx_fn)dlsym(h, "ZSTD_createCCtx");
+    a.free_cctx = (zstd_freecctx_fn)dlsym(h, "ZSTD_freeCCtx");
+    a.compress_cctx = (zstd_compresscctx_fn)dlsym(h, "ZSTD_compressCCtx");
+    if (a.compress == nullptr || a.bound == nullptr || a.iserr == nullptr ||
+        a.create_cctx == nullptr || a.free_cctx == nullptr ||
+        a.compress_cctx == nullptr)
       return nullptr;
     return &a;
   }();
@@ -746,7 +773,10 @@ int64_t ntpu_pack_section(const uint8_t *src0, const uint8_t *src1,
     zstd = load_zstd();
     if (zstd == nullptr) return -2;
   }
-  if (accel < 1) accel = 1;
+  // lz4-only clamp: for zstd the slot carries the LEVEL verbatim (libzstd
+  // defines level 0 = default and negative fast levels; rewriting them
+  // here would silently diverge from the Python lane's same-level call).
+  if (compressor != 2 && accel < 1) accel = 1;
   // Worst-case output per chunk for bound-spaced parallel slots and
   // serial overflow checks.
   auto bound = [&](int64_t n) -> int64_t {
@@ -755,9 +785,10 @@ int64_t ntpu_pack_section(const uint8_t *src0, const uint8_t *src1,
     return n;
   };
   // Compress one chunk into dst (dst has >= bound(size) room); returns
-  // csize or -1 on codec failure.
-  auto compress_one = [&](const uint8_t *src, int64_t size, uint8_t *dst,
-                          int64_t dst_cap) -> int64_t {
+  // csize or -1 on codec failure. zctx is the worker's reusable zstd
+  // compression context (null for other codecs).
+  auto compress_one = [&](void *zctx, const uint8_t *src, int64_t size,
+                          uint8_t *dst, int64_t dst_cap) -> int64_t {
     if (compressor == 1) {
       const int64_t cap =
           dst_cap > LZ4_MAX_INPUT ? LZ4_MAX_INPUT : dst_cap;
@@ -769,8 +800,9 @@ int64_t ntpu_pack_section(const uint8_t *src0, const uint8_t *src1,
       // accel doubles as the codec-param slot: for zstd it IS the level,
       // threaded from Python's single source (constants.ZSTD_LEVEL) so
       // the cross-lane byte identity cannot drift on a level bump.
-      const size_t w = zstd->compress(dst, (size_t)dst_cap, src,
-                                      (size_t)size, (int)accel);
+      if (zctx == nullptr) return -1;
+      const size_t w = zstd->compress_cctx(zctx, dst, (size_t)dst_cap, src,
+                                           (size_t)size, (int)accel);
       return zstd->iserr(w) ? -1 : (int64_t)w;
     }
     std::memcpy(dst, src, (size_t)size);
@@ -778,6 +810,7 @@ int64_t ntpu_pack_section(const uint8_t *src0, const uint8_t *src1,
   };
   int64_t coff = 0;
   if (m > 0 && n_threads <= 1) {
+    ZstdCtx zc(compressor == 2 ? zstd : nullptr);
     for (int64_t j = 0; j < m; ++j) {
       const uint8_t *base = extents[3 * j] == 0 ? src0 : src1;
       const int64_t off = extents[3 * j + 1];
@@ -785,7 +818,7 @@ int64_t ntpu_pack_section(const uint8_t *src0, const uint8_t *src1,
       if (compressor == 1 && size > LZ4_MAX_INPUT) return -1;
       if (coff + bound(size) > out_cap) return -1;
       const int64_t csize =
-          compress_one(base + off, size, out + coff, out_cap - coff);
+          compress_one(zc.ctx, base + off, size, out + coff, out_cap - coff);
       if (csize < 0) return -1;
       comp_extents[2 * j] = coff;
       comp_extents[2 * j + 1] = csize;
@@ -810,6 +843,7 @@ int64_t ntpu_pack_section(const uint8_t *src0, const uint8_t *src1,
     std::atomic<bool> failed{false};
     auto worker = [&]() {
       constexpr int64_t GRAB = 32;  // chunks per work grab
+      ZstdCtx zc(compressor == 2 ? zstd : nullptr);  // one ctx per worker
       for (;;) {
         int64_t j = next.fetch_add(GRAB);
         if (j >= m || failed.load(std::memory_order_relaxed)) return;
@@ -819,7 +853,7 @@ int64_t ntpu_pack_section(const uint8_t *src0, const uint8_t *src1,
           const int64_t off = extents[3 * j + 1];
           const int64_t size = extents[3 * j + 2];
           const int64_t csize = compress_one(
-              base + off, size, out + pre[(size_t)j], bound(size));
+              zc.ctx, base + off, size, out + pre[(size_t)j], bound(size));
           if (csize < 0) {
             failed.store(true, std::memory_order_relaxed);
             return;

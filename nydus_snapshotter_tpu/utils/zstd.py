@@ -33,7 +33,16 @@ _LIB_CANDIDATES = ("libzstd.so.1", "libzstd.so", "libzstd.dylib")
 
 
 class _Api:
+    # A CCtx is not concurrency-safe and each one holds a multi-MiB
+    # workspace, so contexts live in a small bounded pool instead of
+    # thread-locals: short-lived pool threads (the per-layer speculative
+    # compression executors) would otherwise strand one leaked context
+    # per dead thread. Contexts beyond the cap are freed immediately.
+    POOL_CAP = 8
+
     def __init__(self, lib: ctypes.CDLL):
+        import threading
+
         lib.ZSTD_compressBound.restype = ctypes.c_size_t
         lib.ZSTD_compressBound.argtypes = [ctypes.c_size_t]
         lib.ZSTD_isError.restype = ctypes.c_uint
@@ -42,6 +51,8 @@ class _Api:
         # the same output as one-shot ZSTD_compress at the same level,
         # without the per-call CCtx alloc/free.
         lib.ZSTD_createCCtx.restype = ctypes.c_void_p
+        lib.ZSTD_freeCCtx.restype = ctypes.c_size_t
+        lib.ZSTD_freeCCtx.argtypes = [ctypes.c_void_p]
         lib.ZSTD_compressCCtx.restype = ctypes.c_size_t
         lib.ZSTD_compressCCtx.argtypes = [
             ctypes.c_void_p,
@@ -50,15 +61,21 @@ class _Api:
             ctypes.c_int,
         ]
         self.lib = lib
-        self._local = __import__("threading").local()
+        self._lock = threading.Lock()
+        self._pool: list[int] = []
 
-    def cctx(self) -> int:
-        # one reusable context per thread (CCtx is not concurrency-safe)
-        ctx = getattr(self._local, "ctx", None)
-        if ctx is None:
-            ctx = self.lib.ZSTD_createCCtx()
-            self._local.ctx = ctx
-        return ctx
+    def acquire(self) -> int:
+        with self._lock:
+            if self._pool:
+                return self._pool.pop()
+        return self.lib.ZSTD_createCCtx()
+
+    def release(self, ctx: int) -> None:
+        with self._lock:
+            if len(self._pool) < self.POOL_CAP:
+                self._pool.append(ctx)
+                return
+        self.lib.ZSTD_freeCCtx(ctx)
 
 
 def _load():
@@ -93,13 +110,19 @@ def compress_block(data: bytes | memoryview, level: int = LEVEL) -> bytes:
         raise ZstdError("system libzstd not available")
     import numpy as np
 
-    data = bytes(data) if isinstance(data, memoryview) else data
-    n = len(data)
+    # zero-copy source: memoryview chunk slices of the tar buffer go
+    # straight to libzstd (same contract as utils/lz4.compress_block)
+    src = np.frombuffer(data, dtype=np.uint8)
+    n = src.size
     cap = _API.lib.ZSTD_compressBound(n)
     buf = np.empty(cap, dtype=np.uint8)  # uninitialized: no bound memset
-    w = _API.lib.ZSTD_compressCCtx(
-        _API.cctx(), buf.ctypes.data, cap, data, n, level
-    )
+    ctx = _API.acquire()
+    try:
+        w = _API.lib.ZSTD_compressCCtx(
+            ctx, buf.ctypes.data, cap, src.ctypes.data, n, level
+        )
+    finally:
+        _API.release(ctx)
     if _API.lib.ZSTD_isError(w):
         raise ZstdError(f"zstd compress failed for {n}-byte input")
     return buf[:w].tobytes()
