@@ -1,0 +1,180 @@
+"""Referrer-detect scenario over the REAL gRPC snapshotter service — the
+transcript-harness port of the reference's
+``start_container_with_referrer_detect`` (integration/entrypoint.sh:295):
+
+a PLAIN OCI image is pulled; the snapshotter discovers a companion nydus
+image through the OCI referrers API, skips the tar download for the
+data layer, fetches the companion's bootstrap at container-prepare time,
+and mounts rafs — the daemon then serves reads from the nydus blobs.
+Reference flow: snapshot/process.go referrer arm + referer_adaptor.go.
+"""
+
+import gzip
+import hashlib
+import io
+import json
+import os
+import tarfile
+
+import grpc
+import pytest
+
+from nydus_snapshotter_tpu import constants as C
+from nydus_snapshotter_tpu.api.client import SnapshotsClient
+from nydus_snapshotter_tpu.api.service import serve
+from nydus_snapshotter_tpu.cache.manager import CacheManager
+from nydus_snapshotter_tpu.config.config import SnapshotterConfig
+from nydus_snapshotter_tpu.config.daemonconfig import DaemonRuntimeConfig
+from nydus_snapshotter_tpu.filesystem.fs import Filesystem
+from nydus_snapshotter_tpu.manager.manager import Manager
+from nydus_snapshotter_tpu.referrer import ReferrerManager
+from nydus_snapshotter_tpu.remote.remote import Remote
+from nydus_snapshotter_tpu.snapshot.snapshotter import Snapshotter
+from nydus_snapshotter_tpu.store.database import Database
+
+from tests.test_daemon_lifecycle import _build_image
+from tests.test_referrer import METADATA_NAME_IN_LAYER
+from tests.test_remote import FakeRegistry
+
+IMAGE_REF_TMPL = "{host}/library/plain-oci:latest"
+
+
+@pytest.fixture()
+def registry():
+    reg = FakeRegistry(require_auth=False)
+    yield reg
+    reg.close()
+
+
+@pytest.fixture(autouse=True)
+def plain_http(monkeypatch):
+    orig = Remote.__init__
+
+    def patched(self, keychain=None, insecure=False):
+        orig(self, keychain=keychain, insecure=insecure)
+        self.with_plain_http = True
+
+    monkeypatch.setattr(Remote, "__init__", patched)
+
+
+def _publish_companion(reg: FakeRegistry, boot_bytes: bytes) -> str:
+    """Registry state: OCI image digest D -> referrer manifest whose last
+    layer is a gzip tar carrying the REAL nydus bootstrap."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:") as tf:
+        info = tarfile.TarInfo(METADATA_NAME_IN_LAYER)
+        info.size = len(boot_bytes)
+        tf.addfile(info, io.BytesIO(boot_bytes))
+    layer_blob = gzip.compress(buf.getvalue())
+    layer_digest = reg.add_blob(layer_blob)
+    manifest = {
+        "schemaVersion": 2,
+        "layers": [
+            {
+                "mediaType": "application/vnd.oci.image.layer.v1.tar+gzip",
+                "digest": layer_digest,
+                "size": len(layer_blob),
+                "annotations": {C.LAYER_ANNOTATION_NYDUS_BOOTSTRAP: "true"},
+            }
+        ],
+    }
+    mbody = json.dumps(manifest).encode()
+    mdigest = reg.add_blob(mbody)
+    image_digest = "sha256:" + hashlib.sha256(b"plain-oci-manifest").hexdigest()
+    reg.referrers[image_digest] = [
+        {
+            "mediaType": "application/vnd.oci.image.manifest.v1+json",
+            "digest": mdigest,
+            "size": len(mbody),
+        }
+    ]
+    return image_digest
+
+
+def _mk_referrer_stack(tmp_path):
+    root = str(tmp_path / "r")
+    os.makedirs(root, exist_ok=True)
+    cfg = SnapshotterConfig(root=root)
+    cfg.validate()
+    db = Database(cfg.database_path)
+    mgr = Manager(cfg, db, fs_driver=C.FS_DRIVER_FUSEDEV)
+    fs = Filesystem(
+        managers={C.FS_DRIVER_FUSEDEV: mgr},
+        cache_mgr=CacheManager(cfg.cache_root),
+        root=cfg.root,
+        fs_driver=C.FS_DRIVER_FUSEDEV,
+        daemon_mode=C.DAEMON_MODE_SHARED,
+        daemon_config=DaemonRuntimeConfig.from_dict(
+            {"device": {"backend": {"type": "localfs"}}}, C.FS_DRIVER_FUSEDEV
+        ),
+        referrer_mgr=ReferrerManager(),
+    )
+    fs.startup()
+    mgr.run_death_handler()
+    sn = Snapshotter(root=cfg.root, fs=fs)
+    sock = os.path.join(cfg.root, "grpc.sock")
+    server = serve(sn, sock)
+    client = SnapshotsClient(sock, timeout=30.0)
+    return cfg, db, mgr, fs, sn, server, client
+
+
+class TestReferrerOverGrpc:
+    def test_detect_fetch_mount_and_read(self, tmp_path, registry):
+        boot, blob_dir, files = _build_image(tmp_path)
+        boot_bytes = open(boot, "rb").read()
+        image_digest = _publish_companion(registry, boot_bytes)
+        ref = IMAGE_REF_TMPL.format(host=registry.host)
+
+        cfg, db, mgr, fs, sn, server, client = _mk_referrer_stack(tmp_path)
+        try:
+            # stage the nydus blobs where the daemon's localfs backend looks
+            import shutil
+
+            os.makedirs(fs.cache_mgr.cache_dir, exist_ok=True)
+            for b in os.listdir(blob_dir):
+                shutil.copyfile(
+                    os.path.join(blob_dir, b),
+                    os.path.join(fs.cache_mgr.cache_dir, b),
+                )
+
+            chain = "sha256:oci-chain"
+            labels = {
+                C.CRI_IMAGE_REF: ref,
+                C.CRI_MANIFEST_DIGEST: image_digest,
+                C.CRI_LAYER_DIGEST: "sha256:" + "11" * 32,
+                C.TARGET_SNAPSHOT_REF: chain,
+            }
+            # plain-OCI data layer: the referrer probe claims it (skip the
+            # tar download) because a companion nydus image exists.
+            with pytest.raises(grpc.RpcError) as exc_info:
+                client.prepare("extract-oci-layer", "", labels=labels)
+            assert exc_info.value.code() == grpc.StatusCode.ALREADY_EXISTS
+            assert any("referrers" in r for r in registry.requests)
+
+            # container prepare: fetch the companion bootstrap, mount rafs
+            ctr_key = "ctr-oci"
+            client.prepare(ctr_key, chain, labels={C.CRI_IMAGE_REF: ref})
+            sid, _info, _ = sn.ms.get_info(chain)
+            meta_path = os.path.join(
+                cfg.root, "snapshots", sid, "fs", "image.boot"
+            )
+            assert os.path.exists(meta_path), "companion bootstrap not fetched"
+            assert open(meta_path, "rb").read() == boot_bytes
+            mounts = client.mounts(ctr_key)
+            assert any(
+                o.startswith("lowerdir=") for m in mounts for o in m.options
+            ), mounts
+
+            # the daemon serves the companion image's content
+            daemon = fs.get_shared_daemon(C.FS_DRIVER_FUSEDEV)
+            rafs = fs.instances.list()[0]
+            got = daemon.client().read_file(
+                f"/{rafs.snapshot_id}", "/app/hello.txt"
+            )
+            assert got == files["/app/hello.txt"]
+        finally:
+            client.close()
+            server.stop(grace=None)
+            fs.teardown()
+            sn.close()
+            mgr.stop()
