@@ -403,7 +403,9 @@ class Filesystem:
                 mgr.destroy_daemon(d)
         elif fs_driver == C.FS_DRIVER_BLOCKDEV:
             if self.tarfs_mgr is not None:
-                self.tarfs_mgr.umount_tar_erofs(snapshot_id)
+                # pass the persisted mountpoint: kernel mounts outlive the
+                # process, the manager's in-memory status does not
+                self.tarfs_mgr.umount_tar_erofs(snapshot_id, rafs.mountpoint)
             mgr = self.managers.get(fs_driver)
             if mgr is not None:
                 mgr.db.delete_instance(snapshot_id)
@@ -532,9 +534,24 @@ class Filesystem:
         return self.tarfs_mgr is not None and self._tarfs_export
 
     def prepare_tarfs_layer(self, snap_labels: dict, snapshot_id: str, upper_path: str) -> None:
+        """Claim an OCI layer for tarfs (reference tarfs_adaptor.go:33-64):
+        gate on the image's tarfs-hint annotation, kick the async blob
+        process, and LABEL the snapshot as a tarfs data layer — the label
+        is what routes the container-prepare to the tarfs merge/mount path
+        (process.go writable-branch is_tarfs_data_layer check), so without
+        it the whole tarfs runtime is unreachable from the snapshotter."""
         if self.tarfs_mgr is None:
             raise errdefs.Unavailable("tarfs support is not enabled")
+        ref = snap_labels.get(C.CRI_IMAGE_REF, "")
+        manifest_digest = snap_labels.get(C.CRI_MANIFEST_DIGEST, "")
+        layer_digest = snap_labels.get(C.CRI_LAYER_DIGEST, "")
+        # (missing ref/digest labels are rejected by prepare_layer below)
+        if not self.tarfs_mgr.check_tarfs_hint_annotation(ref, manifest_digest):
+            raise errdefs.InvalidArgument("this image is not recommended for tarfs")
+        # concurrency is bounded inside the manager's async blob process
+        # (per-ref semaphore + LRU, tarfs.py _blob_process)
         self.tarfs_mgr.prepare_layer(snap_labels, snapshot_id, upper_path)
+        snap_labels[C.NYDUS_TARFS_LAYER] = layer_digest.split(":", 1)[-1]
 
     def merge_tarfs_layers(self, snapshot, path_fn) -> None:
         if self.tarfs_mgr is None:

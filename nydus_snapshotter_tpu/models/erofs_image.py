@@ -271,6 +271,7 @@ def build_erofs(
     chunk_map: Optional[dict[str, ChunkedData]] = None,
     device: Optional[tuple[bytes, int]] = None,
     total_size: Optional[int] = None,
+    devices: Optional[list[tuple[bytes, int]]] = None,
 ) -> bytes:
     """Serialize ``entries`` into a mountable EROFS image.
 
@@ -280,14 +281,25 @@ def build_erofs(
     prefixes and POSIX ACL names — anything else raises).
 
     ``chunk_map`` maps paths of regular files to external-device extents
-    (CHUNK_BASED inodes, data read from the blob device); ``device`` is the
-    (tag, size_bytes) of that blob device, passed to the kernel at mount
-    time via ``-o device=``. Chunk offsets must be block-aligned — tarfs
+    (CHUNK_BASED inodes, data read from the blob device); ``devices`` are
+    the (tag, size_bytes) of the blob devices in device-table order —
+    ``ChunkedData.device_id`` N addresses ``devices[N-1]``, and the kernel
+    maps them positionally from the ``-o device=`` list at mount time
+    (multi-layer tarfs images carry one tar device per layer). ``device``
+    is single-device sugar. Chunk offsets must be block-aligned — tarfs
     callers use ``blkszbits=9`` so 512-aligned tar data qualifies.
     """
     chunk_map = chunk_map or {}
-    if device is None and any(cd.device_id != 0 for cd in chunk_map.values()):
-        raise ErofsError("chunk_map with extra-device extents requires a blob device")
+    if devices is None:
+        devices = [device] if device is not None else []
+    elif device is not None:
+        raise ErofsError("pass device or devices, not both")
+    for cd in chunk_map.values():
+        if cd.device_id > len(devices):
+            raise ErofsError(
+                f"chunk device_id {cd.device_id} exceeds the "
+                f"{len(devices)}-entry device table"
+            )
     if not 9 <= blkszbits <= 12:
         raise ErofsError(f"blkszbits {blkszbits} outside the supported 9..12")
     blksz = 1 << blkszbits
@@ -359,9 +371,8 @@ def build_erofs(
                 )
             dev_size = total_size
         else:
-            # device is not None here: the guard above rejected extra-device
-            # extents without a blob device.
-            dev_size = device[1]
+            # in-range per the device-table guard above
+            dev_size = devices[cd.device_id - 1][1]
         for k, off in enumerate(cd.offsets):
             if off % blksz:
                 raise ErofsError(
@@ -378,8 +389,8 @@ def build_erofs(
     # Assign nids: slot index in the 32-byte-unit metadata area; xattrs and
     # chunk indexes occupy the slots right after their inode.
     meta_blkaddr_bytes = SB_OFFSET + 128
-    if device is not None:
-        meta_blkaddr_bytes = _DEVT_SLOTOFF * _DEVT_SLOT_SIZE + _DEVT_SLOT_SIZE
+    if devices:
+        meta_blkaddr_bytes = (_DEVT_SLOTOFF + len(devices)) * _DEVT_SLOT_SIZE
     meta_blkaddr = -(-meta_blkaddr_bytes // blksz)
     orphans = set(chunk_map) - set(by_path)
     if orphans:
@@ -493,8 +504,8 @@ def build_erofs(
     feature_incompat = 0
     extra_devices = 0
     devt_slotoff = 0
-    if device is not None:
-        extra_devices = 1
+    if devices:
+        extra_devices = len(devices)
         devt_slotoff = _DEVT_SLOTOFF
         feature_incompat |= _FEATURE_INCOMPAT_DEVICE_TABLE
     if chunk_map:
@@ -528,9 +539,8 @@ def build_erofs(
     )
     header = bytearray(meta_blkaddr * blksz)
     header[SB_OFFSET : SB_OFFSET + len(sb)] = sb
-    if device is not None:
-        tag, size_bytes = device
-        slot_off = _DEVT_SLOTOFF * _DEVT_SLOT_SIZE
+    for i, (tag, size_bytes) in enumerate(devices):
+        slot_off = (_DEVT_SLOTOFF + i) * _DEVT_SLOT_SIZE
         header[slot_off : slot_off + _DEVT_SLOT_SIZE] = _DEVICE_SLOT.pack(
             tag[:64].ljust(64, b"\0"),
             -(-size_bytes // blksz),
@@ -636,26 +646,28 @@ def write_erofs_disk(bootstrap, tar_path_of, out) -> int:
 
 
 def erofs_from_rafs(bootstrap, device_tag: bytes = b"") -> bytes:
-    """RAFS bootstrap whose chunks index an uncompressed blob (the tarfs
+    """RAFS bootstrap whose chunks index uncompressed blobs (the tarfs
     shape, tarfs/bootstrap.py) → kernel-mountable EROFS meta image with
-    that blob as device 1.
+    one device per blob, in blob-table order.
 
     This replaces the reference's ``nydus-image export --block`` for the
     tarfs path (tarfs.go:525-541): mount the returned image with
-    ``-o device=<loop of the tar>`` and the kernel reads file bytes
-    straight from the tar. Chunks must be identity-mapped
-    (uncompressed == compressed offsets) and 512-aligned, which tarfs
-    bootstraps are by construction. Opaque-directory xattrs
-    (trusted.overlay.opaque) and whiteout char devices both carry through,
-    so overlayfs layering over the mount behaves like the reference's.
+    ``-o device=<loop of tar 1>,device=<loop of tar 2>,…`` (the kernel
+    maps the list positionally onto the device table) and file bytes are
+    read straight from the layer tars. A merged multi-layer image carries
+    one tar device per layer; single-layer bootstraps keep the original
+    one-device shape. Chunks must be identity-mapped (uncompressed ==
+    compressed offsets) and 512-aligned, which tarfs bootstraps are by
+    construction. Opaque-directory xattrs (trusted.overlay.opaque) and
+    whiteout char devices both carry through, so overlayfs layering over
+    the mount behaves like the reference's.
     """
     from nydus_snapshotter_tpu.models import fstree
 
-    if len(bootstrap.blobs) != 1:
-        raise ErofsError(
-            f"tarfs export expects exactly one blob, got {len(bootstrap.blobs)}"
-        )
-    blob = bootstrap.blobs[0]
+    if not bootstrap.blobs:
+        raise ErofsError("tarfs export needs at least one blob")
+    if device_tag and len(bootstrap.blobs) > 1:
+        raise ErofsError("device_tag override only applies to one-blob images")
     entries: list[FileEntry] = []
     chunk_map: dict[str, ChunkedData] = {}
     for inode in bootstrap.inodes:
@@ -669,14 +681,27 @@ def erofs_from_rafs(bootstrap, device_tag: bytes = b"") -> bytes:
                     f"{inode.path}: chunk not identity-mapped; "
                     "only tarfs bootstraps export to EROFS"
                 )
+        blob_ids = {rec.blob_index for rec in recs}
+        if len(blob_ids) != 1:
+            raise ErofsError(
+                f"{inode.path}: chunks span blobs {sorted(blob_ids)}; "
+                "tarfs files live in exactly one layer tar"
+            )
         chunk_map[inode.path] = ChunkedData(
             size=inode.size,
             chunk_size=bootstrap.chunk_size,
             offsets=[rec.uncompressed_offset for rec in recs],
+            device_id=recs[0].blob_index + 1,
         )
     return build_erofs(
         entries,
         blkszbits=9,
         chunk_map=chunk_map,
-        device=(device_tag or blob.blob_id.encode(), blob.compressed_size),
+        devices=[
+            (
+                device_tag if (i == 0 and device_tag) else b.blob_id.encode(),
+                b.compressed_size,
+            )
+            for i, b in enumerate(bootstrap.blobs)
+        ],
     )

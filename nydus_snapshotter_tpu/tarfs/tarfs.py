@@ -91,6 +91,7 @@ class _SnapshotStatus:
         self.erofs_mountpoint = ""
         self.data_loopdev: Optional[losetup.LoopDevice] = None
         self.meta_loopdev: Optional[losetup.LoopDevice] = None
+        self.meta_image_path = ""  # the EROFS meta the meta loop backs
         self.done = threading.Event()
 
 
@@ -497,19 +498,53 @@ class Manager:
 
         merged_bootstrap = self.image_meta_file_path(upper_dir)
         with open(merged_bootstrap, "rb") as f:
-            image_blob_ids = {b.blob_id for b in Bootstrap.from_bytes(f.read()).blobs}
+            merged = Bootstrap.from_bytes(f.read())
 
-        devices = []
-        for sid in reversed(snapshot.parent_ids):  # low to high
+        # The kernel maps the -o device= list POSITIONALLY onto the meta
+        # image's device table, which erofs_from_rafs emits in blob-table
+        # order — so the loop devices must be collected per blob-table
+        # entry, not per parent-chain order.
+        status_by_blob: dict[str, _SnapshotStatus] = {}
+        for sid in snapshot.parent_ids:
             self.wait_layer_ready(sid)
-            st = self._get_status(sid)
-            with st.lock:
-                if st.blob_id in image_blob_ids:
-                    if st.data_loopdev is None:
-                        with self._loop_mu:
-                            st.data_loopdev = losetup.attach(st.blob_tar_file_path)
-                    devices.append("device=" + st.data_loopdev.path)
+            lst = self._get_status(sid)
+            with lst.lock:
+                status_by_blob[lst.blob_id] = lst
+        devices = []
+        for blob in merged.blobs:
+            lst = status_by_blob.get(blob.blob_id)
+            if lst is None:
+                raise errdefs.NotFound(
+                    f"no prepared layer tar for blob {blob.blob_id}"
+                )
+            with lst.lock:
+                dev = lst.data_loopdev
+                # AUTOCLEAR hands loop lifetime to the kernel: a cached
+                # handle may be unbound (reaped with a previous mount) or
+                # re-bound to an unrelated file — validate before reuse.
+                if dev is not None and not losetup.still_backed_by(
+                    dev, lst.blob_tar_file_path
+                ):
+                    dev = None
+                if dev is None:
+                    with self._loop_mu:
+                        dev = losetup.attach(lst.blob_tar_file_path)
+                    lst.data_loopdev = dev
+                devices.append("device=" + dev.path)
         mount_opts = ",".join(devices)
+
+        # The kernel mounts an EROFS meta image, not the internal merged
+        # bootstrap: export it next to the bootstrap on first mount
+        # (reference: `nydus-image export --block` produces the block image;
+        # here models/erofs_image writes it in-process).
+        meta_image = merged_bootstrap + ".erofs"
+        if not os.path.exists(meta_image):
+            from nydus_snapshotter_tpu.models.erofs_image import erofs_from_rafs
+
+            tmp = meta_image + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(erofs_from_rafs(merged))
+            os.rename(tmp, meta_image)
 
         st = self._get_status(snapshot_id)
         mountpoint = os.path.join(rafs.snapshot_dir, "mnt")
@@ -521,32 +556,71 @@ class Manager:
                 raise errdefs.AlreadyExists(
                     f"tarfs for snapshot {snapshot_id} already mounted at {st.erofs_mountpoint}"
                 )
+            if st.meta_loopdev is not None and not losetup.still_backed_by(
+                st.meta_loopdev, meta_image
+            ):
+                st.meta_loopdev = None  # reaped by a previous unmount
             if st.meta_loopdev is None:
                 with self._loop_mu:
-                    st.meta_loopdev = losetup.attach(merged_bootstrap)
+                    st.meta_loopdev = losetup.attach(meta_image)
+                st.meta_image_path = meta_image
             mount_utils.mount(st.meta_loopdev.path, mountpoint, "erofs", mount_opts)
             st.erofs_mountpoint = mountpoint
+        # Now that the mount holds every device, flag AUTOCLEAR so the
+        # kernel reaps the loops when the mount goes away — a crash-
+        # restarted snapshotter that can only unmount by path (its
+        # in-memory loop handles are gone) then strands nothing. Outside
+        # st.lock: snapshot_id is usually its own topmost parent, so
+        # re-locking parent statuses here would self-deadlock.
+        losetup.set_autoclear(st.meta_loopdev)
+        for lst in status_by_blob.values():
+            with lst.lock:
+                if lst.data_loopdev is not None:
+                    losetup.set_autoclear(lst.data_loopdev)
         rafs.mountpoint = mountpoint
 
-    def umount_tar_erofs(self, snapshot_id: str) -> None:
-        st = self._get_status(snapshot_id)
-        with st.lock:
-            if st.erofs_mountpoint:
-                mount_utils.umount(st.erofs_mountpoint)
-                st.erofs_mountpoint = ""
+    def umount_tar_erofs(self, snapshot_id: str, mountpoint: str = "") -> None:
+        """Unmount a tarfs EROFS mount. The in-memory status survives only
+        one snapshotter process, but the KERNEL mount survives restarts —
+        after a crash-restart the caller supplies the persisted instance's
+        mountpoint (rafs.mountpoint, replayed from the db) so the mount
+        never leaks (the reference recovers the same way: instance records
+        are the durable truth, tarfs.go vestige handling)."""
+        with self._mu:
+            st = self.snapshot_map.get(snapshot_id)
+        if st is not None:
+            with st.lock:
+                if st.erofs_mountpoint:
+                    mount_utils.umount(st.erofs_mountpoint)
+                    st.erofs_mountpoint = ""
+            return
+        if mountpoint and os.path.ismount(mountpoint):
+            mount_utils.umount(mountpoint)
 
     def detach_layer(self, snapshot_id: str) -> None:
-        st = self._get_status(snapshot_id)
-        with st.lock:
-            if st.erofs_mountpoint:
-                mount_utils.umount(st.erofs_mountpoint)
-                st.erofs_mountpoint = ""
-            if st.meta_loopdev is not None:
-                st.meta_loopdev.detach()
-                st.meta_loopdev = None
-            if st.data_loopdev is not None:
-                st.data_loopdev.detach()
-                st.data_loopdev = None
+        with self._mu:
+            st = self.snapshot_map.get(snapshot_id)
+        if st is not None:
+            with st.lock:
+                if st.erofs_mountpoint:
+                    mount_utils.umount(st.erofs_mountpoint)
+                    st.erofs_mountpoint = ""
+                # AUTOCLEAR may have reaped these handles with the mount —
+                # and LOOP_CTL_GET_FREE may have re-bound the same index to
+                # an unrelated snapshot. Only detach a loop that is still
+                # OURS; a stale handle is just dropped.
+                if st.meta_loopdev is not None:
+                    if losetup.still_backed_by(
+                        st.meta_loopdev, st.meta_image_path
+                    ):
+                        st.meta_loopdev.detach()
+                    st.meta_loopdev = None
+                if st.data_loopdev is not None:
+                    if losetup.still_backed_by(
+                        st.data_loopdev, st.blob_tar_file_path
+                    ):
+                        st.data_loopdev.detach()
+                    st.data_loopdev = None
         with self._mu:
             self.snapshot_map.pop(snapshot_id, None)
 

@@ -21,6 +21,7 @@ LOOP_CTL_GET_FREE = 0x4C82
 LOOP_SET_FD = 0x4C00
 LOOP_CLR_FD = 0x4C01
 LOOP_SET_STATUS64 = 0x4C04
+LOOP_GET_STATUS64 = 0x4C05
 LOOP_CONTROL = "/dev/loop-control"
 
 LO_FLAGS_READ_ONLY = 1
@@ -42,7 +43,9 @@ class LoopDevice:
 class KernelBackend:
     """ioctl-based loop management (what go-losetup does)."""
 
-    def attach(self, blob_path: str, offset: int = 0, ro: bool = True) -> LoopDevice:
+    def attach(
+        self, blob_path: str, offset: int = 0, ro: bool = True,
+    ) -> LoopDevice:
         with open(LOOP_CONTROL, "rb") as ctl:
             index = fcntl.ioctl(ctl.fileno(), LOOP_CTL_GET_FREE)
         dev = LoopDevice(index)
@@ -69,9 +72,48 @@ class KernelBackend:
         return dev
 
     def detach(self, dev: LoopDevice) -> None:
-        fd = os.open(dev.path, os.O_RDONLY)
+        import errno
+
+        try:
+            fd = os.open(dev.path, os.O_RDONLY)
+        except OSError as e:
+            if e.errno == errno.ENXIO:
+                return  # already gone
+            raise
         try:
             fcntl.ioctl(fd, LOOP_CLR_FD, 0)
+        except OSError as e:
+            # ENXIO: the device is already unbound — the kernel reaped it
+            # via AUTOCLEAR when its mount went away. Idempotent success.
+            if e.errno != errno.ENXIO:
+                raise
+        finally:
+            os.close(fd)
+
+    def backing_file(self, dev: LoopDevice) -> Optional[str]:
+        """Path currently backing the device (sysfs: full, unlike
+        lo_file_name's 63-byte truncation); None when unbound."""
+        try:
+            with open(f"/sys/block/loop{dev.index}/loop/backing_file") as f:
+                return f.read().strip()
+        except OSError:
+            return None
+
+    def set_autoclear(self, dev: LoopDevice) -> None:
+        """Flag AUTOCLEAR on an attached device. MUST be called after a
+        durable user (the erofs mount) holds the device: autoclear fires
+        when the last reference drops, so setting it at attach time —
+        before any mount — detaches the loop the moment the setup fd
+        closes. Post-mount, the kernel reaps the loop exactly when the
+        mount goes away, so crash-restarted snapshotters that unmount by
+        path never strand a bound device."""
+        fd = os.open(dev.path, os.O_RDONLY)
+        try:
+            info = bytearray(232)
+            fcntl.ioctl(fd, LOOP_GET_STATUS64, info)
+            flags = struct.unpack_from("<I", info, 52)[0]
+            struct.pack_into("<I", info, 52, flags | LO_FLAGS_AUTOCLEAR)
+            fcntl.ioctl(fd, LOOP_SET_STATUS64, bytes(info))
         finally:
             os.close(fd)
 
@@ -79,7 +121,9 @@ class KernelBackend:
 class CliBackend:
     """losetup(8) fallback."""
 
-    def attach(self, blob_path: str, offset: int = 0, ro: bool = True) -> LoopDevice:
+    def attach(
+        self, blob_path: str, offset: int = 0, ro: bool = True,
+    ) -> LoopDevice:
         cmd = ["losetup", "--find", "--show"]
         if ro:
             cmd.append("--read-only")
@@ -99,13 +143,51 @@ class CliBackend:
 backend = KernelBackend()
 
 
-def attach(blob_path: str, offset: int = 0, ro: bool = True) -> LoopDevice:
+def attach(
+    blob_path: str, offset: int = 0, ro: bool = True
+) -> LoopDevice:
     """Attach ``blob_path`` to a free loop device (thread-safety is the
     caller's job — reference holds mutexLoopDev, tarfs.go:754-760)."""
     try:
         return backend.attach(blob_path, offset=offset, ro=ro)
     except (PermissionError, FileNotFoundError) as e:
         raise errdefs.Unavailable(f"loop attach of {blob_path} failed: {e}") from e
+
+
+def set_autoclear(dev: LoopDevice) -> None:
+    """Best-effort post-mount AUTOCLEAR (see KernelBackend.set_autoclear);
+    silently skipped on backends without the capability."""
+    fn = getattr(backend, "set_autoclear", None)
+    if fn is None:
+        return
+    try:
+        fn(dev)
+    except OSError:
+        pass
+
+
+def still_backed_by(dev: LoopDevice, path: str) -> bool:
+    """Whether the device is still bound to ``path``.
+
+    With AUTOCLEAR, loop lifetime belongs to the KERNEL: the device may
+    have been reaped when its mount went away and even re-bound to an
+    unrelated file by a later LOOP_CTL_GET_FREE. Any cached handle must
+    be validated before reuse (or a mount would read the wrong backing
+    file) and before detach (or LOOP_CLR_FD would land on someone else's
+    live binding). Backends without introspection (test fakes) return
+    "unknown" and the handle is trusted, preserving their semantics.
+    """
+    fn = getattr(backend, "backing_file", None)
+    if fn is None:
+        return True  # unknown: trust the handle (non-autoclear backends)
+    try:
+        bf = fn(dev)
+    except OSError:
+        return False
+    if bf is None:
+        return False  # definitely unbound
+    bf = bf.removesuffix(" (deleted)")
+    return bf == path or bf == os.path.realpath(path)
 
 
 def detach(dev: LoopDevice) -> None:

@@ -1,0 +1,197 @@
+"""Tarfs scenario over the REAL gRPC snapshotter service — the
+transcript-harness port of the reference's tarfs container start
+(integration/entrypoint.sh tarfs scenarios; pkg/tarfs/tarfs.go):
+
+a containerd-shaped pull with the tarfs hint drives the full flow: the
+data-layer Prepare kicks the async blob process (download from a live
+registry fixture, diffID validation, tar → tarfs bootstrap index), the
+container Prepare merges layer bootstraps and mounts EROFS over REAL
+loop devices (kernel mount), and the mounted tree serves the image's
+file content byte-for-byte.
+"""
+
+import gzip
+import hashlib
+import json
+import os
+
+import grpc
+import pytest
+
+from nydus_snapshotter_tpu import constants as C
+from nydus_snapshotter_tpu.api.client import SnapshotsClient
+from nydus_snapshotter_tpu.api.service import serve
+from nydus_snapshotter_tpu.cache.manager import CacheManager
+from nydus_snapshotter_tpu.config.config import SnapshotterConfig
+from nydus_snapshotter_tpu.config.daemonconfig import DaemonRuntimeConfig
+from nydus_snapshotter_tpu.filesystem.fs import Filesystem
+from nydus_snapshotter_tpu.manager.manager import Manager
+from nydus_snapshotter_tpu.remote.remote import Remote
+from nydus_snapshotter_tpu.snapshot.snapshotter import Snapshotter
+from nydus_snapshotter_tpu.store.database import Database
+from nydus_snapshotter_tpu.tarfs import Manager as TarfsManager
+
+from tests.test_remote import FakeRegistry
+from tests.test_tarfs import make_tar, publish_image
+
+FILES = {
+    "app/hello.txt": b"hello from tarfs\n",
+    "app/data.bin": bytes(range(256)) * 512,
+    "etc/cfg": b"k=v\n",
+}
+
+pytestmark = pytest.mark.skipif(
+    os.geteuid() != 0 or not os.path.exists("/dev/loop-control"),
+    reason="needs root + loop devices for real EROFS mounts",
+)
+
+
+@pytest.fixture()
+def registry():
+    reg = FakeRegistry(require_auth=False)
+    yield reg
+    reg.close()
+
+
+@pytest.fixture(autouse=True)
+def plain_http(monkeypatch):
+    orig = Remote.__init__
+
+    def patched(self, keychain=None, insecure=False):
+        orig(self, keychain=keychain, insecure=insecure)
+        self.with_plain_http = True
+
+    monkeypatch.setattr(Remote, "__init__", patched)
+
+
+def _mk_tarfs_stack(tmp_path):
+    root = str(tmp_path / "r")
+    os.makedirs(root, exist_ok=True)
+    cfg = SnapshotterConfig(root=root)
+    cfg.validate()
+    db = Database(cfg.database_path)
+    mgr = Manager(cfg, db, fs_driver=C.FS_DRIVER_FUSEDEV)
+    blk_mgr = Manager(cfg, db, fs_driver=C.FS_DRIVER_BLOCKDEV)
+    cache = CacheManager(cfg.cache_root)
+    tarfs_mgr = TarfsManager(
+        cache_dir_path=cfg.cache_root, mount_on_host=True, insecure=True
+    )
+    fs = Filesystem(
+        managers={C.FS_DRIVER_FUSEDEV: mgr, C.FS_DRIVER_BLOCKDEV: blk_mgr},
+        cache_mgr=cache,
+        root=cfg.root,
+        fs_driver=C.FS_DRIVER_FUSEDEV,
+        daemon_mode=C.DAEMON_MODE_SHARED,
+        daemon_config=DaemonRuntimeConfig.from_dict(
+            {"device": {"backend": {"type": "localfs"}}}, C.FS_DRIVER_FUSEDEV
+        ),
+        tarfs_mgr=tarfs_mgr,
+    )
+    fs.startup()
+    mgr.run_death_handler()
+    sn = Snapshotter(root=cfg.root, fs=fs)
+    sock = os.path.join(cfg.root, "grpc.sock")
+    server = serve(sn, sock)
+    client = SnapshotsClient(sock, timeout=60.0)
+    return cfg, db, mgr, fs, sn, server, client
+
+
+class TestTarfsOverGrpc:
+    def test_pull_merge_erofs_mount_and_read(self, tmp_path, registry):
+        mdigest, layer_digests = publish_image(
+            registry, [FILES], tarfs_hint="true"
+        )
+        ref = f"{registry.host}/library/app:latest"
+
+        cfg, db, mgr, fs, sn, server, client = _mk_tarfs_stack(tmp_path)
+        try:
+            chain = "sha256:tarfs-chain"
+            labels = {
+                C.CRI_IMAGE_REF: ref,
+                C.CRI_MANIFEST_DIGEST: mdigest,
+                C.CRI_LAYER_DIGEST: layer_digests[0],
+                C.TARGET_SNAPSHOT_REF: chain,
+            }
+            # the tarfs arm claims the data layer (async blob process
+            # starts; no tar unpack by containerd)
+            with pytest.raises(grpc.RpcError) as exc_info:
+                client.prepare("extract-tarfs-layer", "", labels=labels)
+            assert exc_info.value.code() == grpc.StatusCode.ALREADY_EXISTS
+
+            # container prepare: merge tarfs bootstraps + EROFS loop mount
+            ctr_key = "ctr-tarfs"
+            client.prepare(ctr_key, chain, labels={C.CRI_IMAGE_REF: ref})
+            mounts = client.mounts(ctr_key)
+            lower = next(
+                o for m in mounts for o in m.options if o.startswith("lowerdir=")
+            )
+            mnt = lower[len("lowerdir=") :].split(":")[0]
+            # the kernel-mounted EROFS tree serves the image content
+            for name, want in FILES.items():
+                with open(os.path.join(mnt, name), "rb") as f:
+                    assert f.read() == want, name
+            # it is a real erofs kernel mount, not a bind of loose files
+            with open("/proc/mounts") as f:
+                assert any(
+                    "erofs" in line and mnt in line for line in f
+                ), f"{mnt} not an erofs mount"
+
+            # removal detaches the loop devices and unmounts
+            client.remove(ctr_key)
+            client.remove(chain)
+            client.cleanup()
+            with open("/proc/mounts") as f:
+                assert not any(mnt in line for line in f), "erofs mount leaked"
+        finally:
+            client.close()
+            server.stop(grace=None)
+            fs.teardown()
+            sn.close()
+            mgr.stop()
+
+    def test_multi_layer_image_multi_device_mount(self, tmp_path, registry):
+        """Two tarfs layers -> one EROFS meta image with a two-entry
+        device table; the kernel maps the device= list positionally and
+        upper-layer files shadow lower ones through the merge."""
+        lower = {"app/base.txt": b"base layer\n", "lib/one.bin": bytes(range(256)) * 128}
+        upper = {"app/extra.txt": b"upper layer\n", "app/base.txt": b"shadowed!\n"}
+        mdigest, layer_digests = publish_image(
+            registry, [lower, upper], tarfs_hint="true"
+        )
+        ref = f"{registry.host}/library/app:latest"
+
+        cfg, db, mgr, fs, sn, server, client = _mk_tarfs_stack(tmp_path)
+        try:
+            parent = ""
+            chains = []
+            for i, ld in enumerate(layer_digests):
+                chain = f"sha256:tarfs-multi-{i}"
+                labels = {
+                    C.CRI_IMAGE_REF: ref,
+                    C.CRI_MANIFEST_DIGEST: mdigest,
+                    C.CRI_LAYER_DIGEST: ld,
+                    C.TARGET_SNAPSHOT_REF: chain,
+                }
+                with pytest.raises(grpc.RpcError) as exc_info:
+                    client.prepare(f"extract-multi-{i}", parent, labels=labels)
+                assert exc_info.value.code() == grpc.StatusCode.ALREADY_EXISTS
+                chains.append(chain)
+                parent = chain
+
+            ctr_key = "ctr-multi"
+            client.prepare(ctr_key, parent, labels={C.CRI_IMAGE_REF: ref})
+            mounts = client.mounts(ctr_key)
+            lowerdir = next(
+                o for m in mounts for o in m.options if o.startswith("lowerdir=")
+            )
+            mnt = lowerdir[len("lowerdir=") :].split(":")[0]
+            # merged view: both layers' files, upper shadows lower
+            assert open(os.path.join(mnt, "app/extra.txt"), "rb").read() == upper["app/extra.txt"]
+            assert open(os.path.join(mnt, "lib/one.bin"), "rb").read() == lower["lib/one.bin"]
+            assert open(os.path.join(mnt, "app/base.txt"), "rb").read() == upper["app/base.txt"]
+        finally:
+            client.close()
+            server.stop(grace=None)
+            fs.teardown()
+            sn.close()
+            mgr.stop()
