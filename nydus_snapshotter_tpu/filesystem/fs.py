@@ -436,6 +436,23 @@ class Filesystem:
     def _instance_config_path(self, d: Daemon, snapshot_id: str) -> str:
         return os.path.join(d.states.workdir, f"{snapshot_id}.json")
 
+    def get_instance_annotations(self, snapshot_id: str) -> dict:
+        """The mounted instance's annotations (tarfs block-info labels,
+        proxy mode, …) — reference rafs.Annotations, consumed by the kata
+        volume synthesis (mount_option.go:137-243)."""
+        rafs = self.instances.get(snapshot_id)
+        return dict(rafs.annotations) if rafs is not None else {}
+
+    def tarfs_image_disk_path(self, blob_id: str) -> str:
+        if self.tarfs_mgr is None:
+            raise errdefs.Unavailable("tarfs support is not enabled")
+        return self.tarfs_mgr.image_disk_file_path(blob_id)
+
+    def tarfs_layer_disk_path(self, blob_id: str) -> str:
+        if self.tarfs_mgr is None:
+            raise errdefs.Unavailable("tarfs support is not enabled")
+        return self.tarfs_mgr.layer_disk_file_path(blob_id)
+
     def get_instance_extra_option(self, snapshot_id: str) -> Optional[ExtraOption]:
         """Assemble the extraoption payload for the mount helper
         (mount_option.go:42-116)."""

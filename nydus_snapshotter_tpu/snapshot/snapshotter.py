@@ -27,6 +27,8 @@ from nydus_snapshotter_tpu.snapshot import labels as label
 from nydus_snapshotter_tpu.snapshot import metastore as ms
 from nydus_snapshotter_tpu.snapshot.metastore import Info, MetaStore, Snapshot, Usage
 from nydus_snapshotter_tpu.snapshot.mount import (
+    KATA_IMAGE_RAW_BLOCK,
+    KATA_LAYER_RAW_BLOCK,
     ExtraOption,
     Mount,
     bind_mount,
@@ -568,6 +570,59 @@ class Snapshotter:
         return [Mount(type=self._overlay_mount_type(), source="overlay", options=options)]
 
     def _mount_with_kata_volume(self, meta_sid: str, options: list[str], key: str) -> list[Mount]:
+        """Kata-volume mount synthesis (reference mount_option.go:117-243):
+        tarfs snapshots carry raw-block volumes pointing at the exported
+        EROFS disk images (whole-image or one per layer, with dm-verity
+        info from the block-info labels); nydus-fs snapshots carry the
+        extraoption-backed image_nydus_fs volume."""
+        ann = {}
+        if self.fs.tarfs_enabled():
+            ann = self.fs.get_instance_annotations(meta_sid)
+        if C.NYDUS_TARFS_LAYER in ann:
+            if C.NYDUS_IMAGE_BLOCK_INFO in ann:
+                path = self.fs.tarfs_image_disk_path(ann[C.NYDUS_TARFS_LAYER])
+                options.append(
+                    prepare_kata_virtual_volume(
+                        C.NYDUS_IMAGE_BLOCK_INFO,
+                        path,
+                        KATA_IMAGE_RAW_BLOCK,
+                        "erofs",
+                        ["ro"],
+                        ann,
+                    )
+                )
+            elif C.NYDUS_LAYER_BLOCK_INFO in ann:
+                # One raw-block volume per tarfs layer, walked bottom-up
+                # (mount_option.go:211-242).
+                vols: list[str] = []
+
+                def visit(_sid: str, info: Info) -> bool:
+                    blob_id = info.labels.get(C.NYDUS_TARFS_LAYER, "")
+                    if blob_id:
+                        vols.append(
+                            prepare_kata_virtual_volume(
+                                C.NYDUS_LAYER_BLOCK_INFO,
+                                self.fs.tarfs_layer_disk_path(blob_id),
+                                KATA_LAYER_RAW_BLOCK,
+                                "erofs",
+                                ["ro"],
+                                dict(info.labels),
+                            )
+                        )
+                    return False  # walk the whole chain
+
+                try:
+                    self.ms.iterate_parent_snapshots(key, visit)
+                except errdefs.NotFound:
+                    pass  # chain exhausted — expected
+                options.extend(reversed(vols))  # low layer first
+            return [
+                Mount(
+                    type=self._overlay_mount_type(),
+                    source="overlay",
+                    options=options,
+                )
+            ]
         extra = self.fs.get_instance_extra_option(meta_sid)
         if extra is not None:
             vol_opt = prepare_kata_virtual_volume(

@@ -511,27 +511,69 @@ class Manager:
             with lst.lock:
                 status_by_blob[lst.blob_id] = lst
         devices = []
-        for blob in merged.blobs:
-            lst = status_by_blob.get(blob.blob_id)
-            if lst is None:
-                raise errdefs.NotFound(
-                    f"no prepared layer tar for blob {blob.blob_id}"
-                )
-            with lst.lock:
-                dev = lst.data_loopdev
-                # AUTOCLEAR hands loop lifetime to the kernel: a cached
-                # handle may be unbound (reaped with a previous mount) or
-                # re-bound to an unrelated file — validate before reuse.
-                if dev is not None and not losetup.still_backed_by(
-                    dev, lst.blob_tar_file_path
-                ):
-                    dev = None
-                if dev is None:
-                    with self._loop_mu:
-                        dev = losetup.attach(lst.blob_tar_file_path)
-                    lst.data_loopdev = dev
-                devices.append("device=" + dev.path)
-        mount_opts = ",".join(devices)
+        # Pin each validated device with an open fd until the mount holds
+        # it: autoclear fires when the LAST reference drops, so without a
+        # pin a concurrent remove of a sharing image could reap + re-bind
+        # the index between validation and mount(2) — the mount would then
+        # read another snapshot's bytes. An open fd is a reference, so the
+        # kernel cannot reap the loop inside the window.
+        pin_fds: list[int] = []
+        try:
+            for blob in merged.blobs:
+                lst = status_by_blob.get(blob.blob_id)
+                if lst is None:
+                    raise errdefs.NotFound(
+                        f"no prepared layer tar for blob {blob.blob_id}"
+                    )
+                with lst.lock:
+                    dev = lst.data_loopdev
+                    # AUTOCLEAR hands loop lifetime to the kernel: a cached
+                    # handle may be unbound (reaped with a previous mount)
+                    # or re-bound to an unrelated file — validate before
+                    # reuse, and re-validate after pinning (the reap could
+                    # land between the check and the open).
+                    dev = self._pin_validated(
+                        dev, lst.blob_tar_file_path, pin_fds
+                    )
+                    if dev is None:
+                        with self._loop_mu:
+                            dev = losetup.attach(lst.blob_tar_file_path)
+                        self._pin(dev, pin_fds)
+                        lst.data_loopdev = dev
+                    devices.append("device=" + dev.path)
+            mount_opts = ",".join(devices)
+            self._mount_meta(
+                snapshot_id, snapshot, rafs, merged_bootstrap, merged,
+                mount_opts, status_by_blob, pin_fds,
+            )
+        finally:
+            for fd in pin_fds:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+
+    def _pin(self, dev, pin_fds: list) -> None:
+        try:
+            pin_fds.append(os.open(dev.path, os.O_RDONLY))
+        except OSError:
+            pass  # fake/test backends have no real device nodes
+
+    def _pin_validated(self, dev, path: str, pin_fds: list):
+        """Pin dev if (still) backed by path; None if it must be re-made."""
+        if dev is None or not losetup.still_backed_by(dev, path):
+            return None
+        self._pin(dev, pin_fds)
+        # re-check under the pin: a reap between validate and open would
+        # have let the index re-bind; pinned-and-matching cannot change.
+        if not losetup.still_backed_by(dev, path):
+            return None
+        return dev
+
+    def _mount_meta(
+        self, snapshot_id: str, snapshot, rafs, merged_bootstrap: str,
+        merged, mount_opts: str, status_by_blob: dict, pin_fds: list,
+    ) -> None:
 
         # The kernel mounts an EROFS meta image, not the internal merged
         # bootstrap: export it next to the bootstrap on first mount
@@ -556,23 +598,25 @@ class Manager:
                 raise errdefs.AlreadyExists(
                     f"tarfs for snapshot {snapshot_id} already mounted at {st.erofs_mountpoint}"
                 )
-            if st.meta_loopdev is not None and not losetup.still_backed_by(
-                st.meta_loopdev, meta_image
-            ):
-                st.meta_loopdev = None  # reaped by a previous unmount
-            if st.meta_loopdev is None:
+            meta_dev = self._pin_validated(st.meta_loopdev, meta_image, pin_fds)
+            if meta_dev is None:
                 with self._loop_mu:
-                    st.meta_loopdev = losetup.attach(meta_image)
+                    meta_dev = losetup.attach(meta_image)
+                self._pin(meta_dev, pin_fds)
+                st.meta_loopdev = meta_dev
                 st.meta_image_path = meta_image
-            mount_utils.mount(st.meta_loopdev.path, mountpoint, "erofs", mount_opts)
+            mount_utils.mount(meta_dev.path, mountpoint, "erofs", mount_opts)
             st.erofs_mountpoint = mountpoint
         # Now that the mount holds every device, flag AUTOCLEAR so the
         # kernel reaps the loops when the mount goes away — a crash-
         # restarted snapshotter that can only unmount by path (its
         # in-memory loop handles are gone) then strands nothing. Outside
         # st.lock: snapshot_id is usually its own topmost parent, so
-        # re-locking parent statuses here would self-deadlock.
-        losetup.set_autoclear(st.meta_loopdev)
+        # re-locking parent statuses here would self-deadlock. meta_dev is
+        # the locally-captured handle (st.meta_loopdev may be nulled by a
+        # concurrent detach); the data handles are re-read under their
+        # locks with None guards.
+        losetup.set_autoclear(meta_dev)
         for lst in status_by_blob.values():
             with lst.lock:
                 if lst.data_loopdev is not None:

@@ -10,9 +10,6 @@ loop devices (kernel mount), and the mounted tree serves the image's
 file content byte-for-byte.
 """
 
-import gzip
-import hashlib
-import json
 import os
 
 import grpc
@@ -64,7 +61,9 @@ def plain_http(monkeypatch):
     monkeypatch.setattr(Remote, "__init__", patched)
 
 
-def _mk_tarfs_stack(tmp_path):
+def _mk_tarfs_stack(
+    tmp_path, mount_on_host=True, export_mode="", enable_kata_volume=False
+):
     root = str(tmp_path / "r")
     os.makedirs(root, exist_ok=True)
     cfg = SnapshotterConfig(root=root)
@@ -74,7 +73,10 @@ def _mk_tarfs_stack(tmp_path):
     blk_mgr = Manager(cfg, db, fs_driver=C.FS_DRIVER_BLOCKDEV)
     cache = CacheManager(cfg.cache_root)
     tarfs_mgr = TarfsManager(
-        cache_dir_path=cfg.cache_root, mount_on_host=True, insecure=True
+        cache_dir_path=cfg.cache_root,
+        mount_on_host=mount_on_host,
+        export_mode=export_mode,
+        insecure=True,
     )
     fs = Filesystem(
         managers={C.FS_DRIVER_FUSEDEV: mgr, C.FS_DRIVER_BLOCKDEV: blk_mgr},
@@ -86,10 +88,11 @@ def _mk_tarfs_stack(tmp_path):
             {"device": {"backend": {"type": "localfs"}}}, C.FS_DRIVER_FUSEDEV
         ),
         tarfs_mgr=tarfs_mgr,
+        tarfs_export=export_mode != "",
     )
     fs.startup()
     mgr.run_death_handler()
-    sn = Snapshotter(root=cfg.root, fs=fs)
+    sn = Snapshotter(root=cfg.root, fs=fs, enable_kata_volume=enable_kata_volume)
     sock = os.path.join(cfg.root, "grpc.sock")
     server = serve(sn, sock)
     client = SnapshotsClient(sock, timeout=60.0)
@@ -189,6 +192,58 @@ class TestTarfsOverGrpc:
             assert open(os.path.join(mnt, "app/extra.txt"), "rb").read() == upper["app/extra.txt"]
             assert open(os.path.join(mnt, "lib/one.bin"), "rb").read() == lower["lib/one.bin"]
             assert open(os.path.join(mnt, "app/base.txt"), "rb").read() == upper["app/base.txt"]
+        finally:
+            client.close()
+            server.stop(grace=None)
+            fs.teardown()
+            sn.close()
+            mgr.stop()
+
+    def test_kata_raw_block_volume_with_verity(self, tmp_path, registry):
+        """Guest-mount shape (reference mount_option.go:195-243): tarfs
+        block export + kata volumes instead of host EROFS mounts — the
+        container mount options carry an image_raw_block KataVirtualVolume
+        pointing at the exported disk, with the dm-verity root from the
+        block-info label."""
+        from nydus_snapshotter_tpu.snapshot.mount import KataVirtualVolume
+
+        mdigest, layer_digests = publish_image(registry, [FILES], tarfs_hint="true")
+        ref = f"{registry.host}/library/app:latest"
+
+        cfg, db, mgr, fs, sn, server, client = _mk_tarfs_stack(
+            tmp_path,
+            mount_on_host=False,
+            export_mode="image_block_with_verity",
+            enable_kata_volume=True,
+        )
+        try:
+            chain = "sha256:kata-chain"
+            labels = {
+                C.CRI_IMAGE_REF: ref,
+                C.CRI_MANIFEST_DIGEST: mdigest,
+                C.CRI_LAYER_DIGEST: layer_digests[0],
+                C.TARGET_SNAPSHOT_REF: chain,
+            }
+            with pytest.raises(grpc.RpcError) as exc_info:
+                client.prepare("extract-kata-layer", "", labels=labels)
+            assert exc_info.value.code() == grpc.StatusCode.ALREADY_EXISTS
+
+            ctr_key = "ctr-kata"
+            client.prepare(ctr_key, chain, labels={C.CRI_IMAGE_REF: ref})
+            mounts = client.mounts(ctr_key)
+            vol_opts = [
+                o
+                for m in mounts
+                for o in m.options
+                if o.startswith("io.katacontainers.volume=")
+            ]
+            assert vol_opts, f"no kata volume option in {mounts}"
+            vol = KataVirtualVolume.decode_option(vol_opts[0])
+            assert vol.volume_type == "image_raw_block"
+            assert vol.fs_type == "erofs"
+            assert os.path.exists(vol.source), vol.source
+            assert vol.dm_verity is not None
+            assert len(vol.dm_verity.hash) == 64  # sha256 root hex
         finally:
             client.close()
             server.stop(grace=None)
