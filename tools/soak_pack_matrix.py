@@ -1,0 +1,149 @@
+"""Round-closing soak: randomized pack differentials across the full
+compressor × digester matrix.
+
+For each trial: build a random-shape tar corpus (file-count/size mix,
+dirs/symlinks/small files), Pack it through the in-memory fast path AND
+the file-like streaming path for every (compressor, digester) pair, and
+assert (a) byte-identical blobs across the two walks, (b) bootstrap
+chunk digests match the independent oracle (hashlib / utils.blake3),
+(c) Unpack reconstructs the corpus byte-for-byte. One JSON line per
+phase; a summary line at the end.
+
+Usage: python tools/soak_pack_matrix.py [--trials N] [--seed S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import io
+import json
+import os
+import random
+import sys
+import tarfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nydus_snapshotter_tpu.converter.convert import (  # noqa: E402
+    Pack,
+    Unpack,
+    bootstrap_from_layer_blob,
+)
+from nydus_snapshotter_tpu.converter.types import PackOption  # noqa: E402
+from nydus_snapshotter_tpu.utils import blake3 as pyb3  # noqa: E402
+
+MATRIX = [
+    (comp, dig)
+    for comp in ("none", "lz4_block", "zstd")
+    for dig in ("sha256", "blake3")
+]
+
+
+def _corpus(rng: random.Random) -> tuple[bytes, dict[str, bytes]]:
+    files: dict[str, bytes] = {}
+    n = rng.randrange(1, 40)
+    for i in range(n):
+        depth = rng.randrange(0, 4)
+        parts = [f"d{rng.randrange(5)}" for _ in range(depth)] + [f"f{i}"]
+        size = rng.choice(
+            [0, 1, rng.randrange(2, 512), rng.randrange(512, 65536),
+             rng.randrange(65536, 1 << 20)]
+        )
+        kind = rng.randrange(3)
+        if kind == 0:
+            data = bytes(rng.randrange(256) for _ in range(min(size, 4096)))
+            data = (data * (size // max(1, len(data)) + 1))[:size]  # repetitive
+        elif kind == 1:
+            data = os.urandom(size)
+        else:
+            data = (b"text line %d\n" % i) * (size // 13 + 1)
+            data = data[:size]
+        files["/".join(parts)] = data
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+        for name, data in sorted(files.items()):
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+    return buf.getvalue(), files
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=0xC0FFEE)
+    args = ap.parse_args()
+
+    rng = random.Random(args.seed)
+    t0 = time.time()
+    packs = 0
+    for trial in range(args.trials):
+        tarb, files = _corpus(rng)
+        for comp, dig in MATRIX:
+            opt = PackOption(compressor=comp, digester=dig)
+            d_mem, d_stream = io.BytesIO(), io.BytesIO()
+            r_mem = Pack(d_mem, tarb, opt)
+            r_stream = Pack(d_stream, io.BytesIO(tarb), opt)
+            packs += 2
+            assert d_mem.getvalue() == d_stream.getvalue(), (
+                trial, comp, dig, "walk divergence")
+            assert r_mem.blob_id == r_stream.blob_id, (trial, comp, dig)
+            bs = bootstrap_from_layer_blob(d_mem.getvalue())
+            # digest oracle over reconstructed chunk bytes
+            content = b"".join(data for _n, data in sorted(files.items()))
+            oracle = (
+                (lambda b: hashlib.sha256(b).digest())
+                if dig == "sha256"
+                else pyb3.blake3
+            )
+            for ino in bs.inodes:
+                if not ino.chunk_count:
+                    continue
+                path = ino.path.lstrip("/")
+                data = files.get(path)
+                if data is None:
+                    continue
+                off = 0
+                for rec in bs.chunks[
+                    ino.chunk_index : ino.chunk_index + ino.chunk_count
+                ]:
+                    seg = data[off : off + rec.uncompressed_size]
+                    assert rec.digest == oracle(seg), (trial, comp, dig, path)
+                    off += rec.uncompressed_size
+            # roundtrip
+            out = Unpack(bs.to_bytes(), {r_mem.blob_id: d_mem.getvalue()})
+            tf = tarfile.open(fileobj=io.BytesIO(out))
+            for name, data in files.items():
+                got = tf.extractfile(name)
+                assert (got.read() if got else b"") == data, (trial, comp, dig, name)
+        if (trial + 1) % 20 == 0:
+            print(
+                json.dumps(
+                    {
+                        "trial": trial + 1,
+                        "packs": packs,
+                        "elapsed_s": round(time.time() - t0, 1),
+                    }
+                ),
+                flush=True,
+            )
+    print(
+        json.dumps(
+            {
+                "soak": "pack-matrix",
+                "trials": args.trials,
+                "matrix": len(MATRIX),
+                "packs": packs,
+                "elapsed_s": round(time.time() - t0, 1),
+                "ok": True,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
