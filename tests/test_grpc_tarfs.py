@@ -199,6 +199,71 @@ class TestTarfsOverGrpc:
             sn.close()
             mgr.stop()
 
+    def test_crash_restart_serves_and_cleans_up(self, tmp_path, registry):
+        """only_restart_snapshotter, tarfs arm: kernel EROFS mounts
+        outlive the snapshotter process; a restarted stack keeps serving
+        the mounted tree AND can fully clean it up by persisted-instance
+        path — zero leaked mounts or loop devices (the in-memory loop
+        handles died with the old process; AUTOCLEAR + umount-by-path is
+        the durable contract)."""
+        import subprocess
+
+        mdigest, layer_digests = publish_image(registry, [FILES], tarfs_hint="true")
+        ref = f"{registry.host}/library/app:latest"
+        cfg, db, mgr, fs, sn, server, client = _mk_tarfs_stack(tmp_path)
+        chain = "sha256:tarfs-restart"
+        labels = {
+            C.CRI_IMAGE_REF: ref,
+            C.CRI_MANIFEST_DIGEST: mdigest,
+            C.CRI_LAYER_DIGEST: layer_digests[0],
+            C.TARGET_SNAPSHOT_REF: chain,
+        }
+        try:
+            with pytest.raises(grpc.RpcError):
+                client.prepare("extract-r", "", labels=labels)
+            client.prepare("ctr-r", chain, labels={C.CRI_IMAGE_REF: ref})
+            mounts = client.mounts("ctr-r")
+            mnt = next(
+                o for m in mounts for o in m.options if o.startswith("lowerdir=")
+            ).split("=", 1)[1].split(":")[0]
+            assert (
+                open(os.path.join(mnt, "app/hello.txt"), "rb").read()
+                == FILES["app/hello.txt"]
+            )
+        finally:
+            # crash: drop all in-process state WITHOUT teardown
+            client.close()
+            server.stop(grace=None)
+            sn.close()
+            mgr.stop()
+
+        cfg2, db2, mgr2, fs2, sn2, server2, client2 = _mk_tarfs_stack(tmp_path)
+        try:
+            # the kernel mount survived and still serves
+            assert (
+                open(os.path.join(mnt, "app/hello.txt"), "rb").read()
+                == FILES["app/hello.txt"]
+            )
+            client2.remove("ctr-r")
+            client2.remove(chain)
+            client2.cleanup()
+            root = str(tmp_path)
+            assert not any(root in line for line in open("/proc/mounts")), (
+                "mount leaked after restart-cleanup"
+            )
+            loops = subprocess.run(
+                ["losetup", "-a"], capture_output=True, text=True
+            ).stdout
+            assert not any(root in line for line in loops.splitlines()), (
+                "loop device leaked after restart-cleanup"
+            )
+        finally:
+            client2.close()
+            server2.stop(grace=None)
+            fs2.teardown()
+            sn2.close()
+            mgr2.stop()
+
     def test_kata_raw_block_volume_with_verity(self, tmp_path, registry):
         """Guest-mount shape (reference mount_option.go:195-243): tarfs
         block export + kata volumes instead of host EROFS mounts — the
