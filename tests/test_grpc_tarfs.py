@@ -208,6 +208,14 @@ class TestTarfsOverGrpc:
         the durable contract)."""
         import subprocess
 
+        def _force_cleanup():
+            # bound the leak if an assertion fails before stack 2's
+            # remove/cleanup (the only intended teardown) runs
+            for line in list(open("/proc/mounts")):
+                target = line.split()[1]
+                if str(tmp_path) in target:
+                    subprocess.run(["umount", "-l", target], check=False)
+
         mdigest, layer_digests = publish_image(registry, [FILES], tarfs_hint="true")
         ref = f"{registry.host}/library/app:latest"
         cfg, db, mgr, fs, sn, server, client = _mk_tarfs_stack(tmp_path)
@@ -230,6 +238,9 @@ class TestTarfsOverGrpc:
                 open(os.path.join(mnt, "app/hello.txt"), "rb").read()
                 == FILES["app/hello.txt"]
             )
+        except BaseException:
+            _force_cleanup()
+            raise
         finally:
             # crash: drop all in-process state WITHOUT teardown
             client.close()
@@ -237,7 +248,11 @@ class TestTarfsOverGrpc:
             sn.close()
             mgr.stop()
 
-        cfg2, db2, mgr2, fs2, sn2, server2, client2 = _mk_tarfs_stack(tmp_path)
+        try:
+            cfg2, db2, mgr2, fs2, sn2, server2, client2 = _mk_tarfs_stack(tmp_path)
+        except BaseException:
+            _force_cleanup()
+            raise
         try:
             # the kernel mount survived and still serves
             assert (
@@ -257,6 +272,9 @@ class TestTarfsOverGrpc:
             assert not any(root in line for line in loops.splitlines()), (
                 "loop device leaked after restart-cleanup"
             )
+        except BaseException:
+            _force_cleanup()
+            raise
         finally:
             client2.close()
             server2.stop(grace=None)
