@@ -128,7 +128,9 @@ class IncrementalChunker:
         if self.fused:
             from nydus_snapshotter_tpu.ops import native_cdc
 
-            cuts, digests = native_cdc.chunk_digest_native(buf, self._engine.params)
+            cuts, digests = native_cdc.chunk_digest_native(
+                buf, self._engine.params, digester=self._engine.digester
+            )
         else:
             cuts, digests = self._boundaries(buf), None
         out: list[tuple[bytes, Optional[bytes]]] = []
@@ -164,7 +166,9 @@ class IncrementalChunker:
         if self.fused:
             from nydus_snapshotter_tpu.ops import native_cdc
 
-            cuts, digests = native_cdc.chunk_digest_native(arr, self._engine.params)
+            cuts, digests = native_cdc.chunk_digest_native(
+                arr, self._engine.params, digester=self._engine.digester
+            )
         else:
             cuts, digests = self._boundaries(arr), None
         out: list[tuple[memoryview, Optional[bytes]]] = []
@@ -945,7 +949,8 @@ def pack_stream(
             )
             _tc = _pc()
             fused = native_cdc.pack_files(
-                arr_all, ext, params, section._kind, section._accel, n_threads
+                arr_all, ext, params, section._kind, section._accel, n_threads,
+                digester=opt.digester,
             )
             if fused is not None:
                 digs = fused["digests"]
@@ -983,7 +988,7 @@ def pack_stream(
             )
             _tc = _pc()
             ncuts_arr, cuts_all, digs_all = native_cdc.chunk_digest_multi(
-                arr_all, ext, params
+                arr_all, ext, params, digester=opt.digester
             )
             _t_chunk += _pc() - _tc
             pos = 0

@@ -621,13 +621,17 @@ void ntpu_blake3_many(const uint8_t *data, const int64_t *extents, int64_t m,
 // `nydus-image create` process, pkg/converter/tool/builder.go:148-178).
 // Hashing is gear-v2 arithmetic (mix32); callers that pass a custom gear
 // table must use ntpu_cdc_chunk instead. digests_out may be null for a
-// boundaries-only pass. Returns the number of cuts (= digests) written,
-// or -1 on cuts_cap overflow / allocation failure.
+// boundaries-only pass. algo selects the chunk digest: 0 = SHA-256
+// (SHA-NI batch), 1 = BLAKE3 (AVX2 8-way leaves) — the real toolchain's
+// default digester, so blake3 packs ride the same fused hot loop.
+// Returns the number of cuts (= digests) written, or -1 on cuts_cap
+// overflow / allocation failure.
 int64_t ntpu_chunk_digest(const uint8_t *data, int64_t n,
                           uint32_t mask_small, uint32_t mask_large,
                           int64_t min_size, int64_t normal_size,
                           int64_t max_size, int64_t *cuts_out,
-                          int64_t cuts_cap, uint8_t *digests_out) {
+                          int64_t cuts_cap, uint8_t *digests_out,
+                          int64_t algo) {
   if (n <= 0) return 0;  // malloc(0) may return NULL; empty input is 0 cuts
   const int64_t words = (n + 63) >> 6;
   uint64_t *bm = (uint64_t *)std::malloc((size_t)words * 16);
@@ -704,7 +708,10 @@ int64_t ntpu_chunk_digest(const uint8_t *data, int64_t n,
       ext[2 * j + 1] = cuts_out[j] - s;
       s = cuts_out[j];
     }
-    ntpu_sha::sha256_extents(data, ext, n_cuts, digests_out);
+    if (algo == 1)
+      ntpu_b3::blake3_extents(data, ext, n_cuts, digests_out);
+    else
+      ntpu_sha::sha256_extents(data, ext, n_cuts, digests_out);
     std::free(ext);
   }
   return n_cuts;
@@ -725,7 +732,8 @@ int64_t ntpu_chunk_digest_multi(const uint8_t *data, const int64_t *extents,
                                 uint32_t mask_large, int64_t min_size,
                                 int64_t normal_size, int64_t max_size,
                                 int64_t *file_ncuts, int64_t *cuts_out,
-                                int64_t cuts_cap, uint8_t *digests_out) {
+                                int64_t cuts_cap, uint8_t *digests_out,
+                                int64_t algo) {
   int64_t total = 0;
   for (int64_t i = 0; i < m; ++i) {
     const int64_t off = extents[2 * i];
@@ -733,7 +741,7 @@ int64_t ntpu_chunk_digest_multi(const uint8_t *data, const int64_t *extents,
     const int64_t n = ntpu_chunk_digest(
         data + off, size, mask_small, mask_large, min_size, normal_size,
         max_size, cuts_out + total, cuts_cap - total,
-        digests_out != nullptr ? digests_out + 32 * total : nullptr);
+        digests_out != nullptr ? digests_out + 32 * total : nullptr, algo);
     if (n < 0) return -1;
     file_ncuts[i] = n;
     total += n;
@@ -908,7 +916,8 @@ int64_t ntpu_pack_files(const uint8_t *data, int64_t n,
                         int64_t *chunk_uniq, int64_t refs_cap,
                         int64_t *comp_extents, uint8_t *out_blob,
                         int64_t out_cap, uint8_t *blob_digest32,
-                        int64_t *n_uniq_out, int64_t *blob_size_out) {
+                        int64_t *n_uniq_out, int64_t *blob_size_out,
+                        int64_t algo) {
   (void)n;
   // Phase 1: fused chunk+digest per file (same kernel as the multi call).
   int64_t total = 0;
@@ -919,7 +928,7 @@ int64_t ntpu_pack_files(const uint8_t *data, int64_t n,
     const int64_t c = ntpu_chunk_digest(
         data + off, size, mask_small, mask_large, min_size, normal_size,
         max_size, cuts.data() + total, refs_cap - total,
-        digests_out + 32 * total);
+        digests_out + 32 * total, algo);
     if (c < 0) return -1;
     file_nchunks[i] = c;
     total += c;

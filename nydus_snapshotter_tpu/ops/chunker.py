@@ -556,7 +556,9 @@ class ChunkDigestEngine:
             self.mode == "cdc"
             and self.backend == "hybrid"
             and self.digest_backend == "host"
-            and self.digester == "sha256"  # fused arm digests with SHA-NI
+            # the fused arm digests with SHA-NI or 8-way-AVX2 blake3; both
+            # route through the native algo dispatch (ntpu_chunk_digest)
+            and self.digester in ("sha256", "blake3")
         ):
             return False
         from nydus_snapshotter_tpu.ops import native_cdc
@@ -567,7 +569,9 @@ class ChunkDigestEngine:
         from nydus_snapshotter_tpu.ops import native_cdc
 
         def one(arr: np.ndarray) -> list[ChunkMeta]:
-            cuts, digests = native_cdc.chunk_digest_native(arr, self.params)
+            cuts, digests = native_cdc.chunk_digest_native(
+                arr, self.params, digester=self.digester
+            )
             start = 0
             metas = []
             for i, c in enumerate(cuts):

@@ -101,6 +101,7 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # min/normal/max
                 ctypes.c_void_p, ctypes.c_int64,  # cuts_out, cap
                 ctypes.c_void_p,  # digests_out (nullable)
+                ctypes.c_int64,  # algo (0=sha256, 1=blake3)
             ]
         if hasattr(lib, "ntpu_sha256_many"):
             lib.ntpu_sha256_many.restype = None
@@ -123,6 +124,7 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p,  # file_ncuts
                 ctypes.c_void_p, ctypes.c_int64,  # cuts_out, cap
                 ctypes.c_void_p,  # digests_out
+                ctypes.c_int64,  # algo
             ]
         if hasattr(lib, "ntpu_pack_files"):
             lib.ntpu_pack_files.restype = ctypes.c_int64
@@ -139,6 +141,7 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_int64,  # out_blob, out_cap
                 ctypes.c_void_p,  # blob_digest32
                 ctypes.c_void_p, ctypes.c_void_p,  # n_uniq_out, blob_size_out
+                ctypes.c_int64,  # algo
             ]
         if hasattr(lib, "ntpu_pack_section"):
             lib.ntpu_pack_section.restype = ctypes.c_int64
@@ -190,19 +193,24 @@ def chunk_digest_available() -> bool:
     return lib is not None and hasattr(lib, "ntpu_chunk_digest")
 
 
+DIGEST_ALGO = {"sha256": 0, "blake3": 1}
+
+
 def chunk_digest_native(
     data: bytes | np.ndarray,
     params: cdc.CDCParams,
     want_digests: bool = True,
+    digester: str = "sha256",
 ) -> tuple[np.ndarray, bytes]:
-    """One native pass: cut offsets + per-chunk SHA-256 digests.
+    """One native pass: cut offsets + per-chunk digests.
 
     The fused host arm — AVX2 position-parallel gear candidate bitmaps
     (the TPU kernel's log-doubling identity on host SIMD), bitmap cut
-    resolution, then SHA-NI digests while the bytes are cache-warm. Cut
-    points are bit-identical to chunk_data_native / cdc.chunk_data_np
-    (differential-tested); digests are standard SHA-256. Uses the gear-v2
-    table only (mix32 computed inline).
+    resolution, then digests while the bytes are cache-warm. Cut points
+    are bit-identical to chunk_data_native / cdc.chunk_data_np
+    (differential-tested); ``digester`` picks the digest algorithm —
+    "sha256" (SHA-NI batch) or "blake3" (8-way AVX2 leaves, the real
+    toolchain's default). Uses the gear-v2 table only (mix32 inline).
     """
     lib = load()
     if lib is None or not hasattr(lib, "ntpu_chunk_digest"):
@@ -223,6 +231,7 @@ def chunk_digest_native(
         params.min_size, params.normal_size, params.max_size,
         cuts.ctypes.data, cap,
         digests.ctypes.data if digests is not None else None,
+        DIGEST_ALGO[digester],
     )
     if n < 0:
         raise RuntimeError("native fused chunker failed (cut overflow or OOM)")
@@ -238,7 +247,8 @@ def chunk_digest_multi_available() -> bool:
 
 
 def chunk_digest_multi(
-    data: np.ndarray, extents: np.ndarray, params: cdc.CDCParams
+    data: np.ndarray, extents: np.ndarray, params: cdc.CDCParams,
+    digester: str = "sha256",
 ) -> "tuple[np.ndarray, np.ndarray, bytes]":
     """Fused chunk+digest over m (off, size) file extents in ONE native
     call (one FFI round trip / GIL drop per layer instead of per file).
@@ -264,6 +274,7 @@ def chunk_digest_multi(
         np.uint32(params.mask_small), np.uint32(params.mask_large),
         params.min_size, params.normal_size, params.max_size,
         file_ncuts.ctypes.data, cuts.ctypes.data, cap, digests.ctypes.data,
+        DIGEST_ALGO[digester],
     )
     if total < 0:
         raise RuntimeError("native multi chunk+digest failed (overflow or OOM)")
@@ -339,11 +350,13 @@ def pack_files(
     compressor: int,
     accel: int = 1,
     n_threads: int = 1,
+    digester: str = "sha256",
 ):
     """One native pass over a layer's planned file extents: CDC chunking,
-    SHA-256 digests, first-wins dedup, per-unique compression, blob
-    assembly, blob SHA-256 (the `nydus-image create` hot loop in one
-    call). Returns None when the arm cannot run (library/liblz4 absent);
+    per-chunk digests (``digester``: sha256 or blake3), first-wins dedup,
+    per-unique compression, blob assembly, blob SHA-256 (the
+    `nydus-image create` hot loop in one call; the blob ID stays SHA-256
+    whatever the chunk digester). Returns None when the arm cannot run (library/liblz4 absent);
     else a dict with file_nchunks, digests, chunk_sizes, chunk_uniq,
     uniq_sizes, comp_extents, blob (np view), blob_digest. Per-chunk and
     blob bytes are bit-identical to the per-stage lanes.
@@ -394,6 +407,7 @@ def pack_files(
         blob.ctypes.data, blob.size,
         blob_digest.ctypes.data,
         n_uniq.ctypes.data, blob_size.ctypes.data,
+        DIGEST_ALGO[digester],
     )
     if total == -2:
         return None

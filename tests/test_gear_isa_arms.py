@@ -39,7 +39,7 @@ for size in (0, 1, 2047, 2048, 65536 * 3 + 5, 1 << 21):
     n = lib.ntpu_chunk_digest(
         data.ctypes.data, size, 0x3FFFF, 0x3FFF,
         params.min_size, params.normal_size, params.max_size,
-        cuts.ctypes.data, cap, digs.ctypes.data,
+        cuts.ctypes.data, cap, digs.ctypes.data, 0,
     )
     h = hashlib.sha256()
     h.update(cuts[:n].tobytes())

@@ -50,7 +50,7 @@ for size in (0, 1, 31, 32, 511, 2048, 2049, 65535, 65536 * 4 + 7, 1 << 22):
     n = lib.ntpu_chunk_digest(
         data.ctypes.data, size, 0x3FFFF, 0x3FFF,
         params.min_size, params.normal_size, params.max_size,
-        cuts.ctypes.data, cap, digs.ctypes.data,
+        cuts.ctypes.data, cap, digs.ctypes.data, 0,
     )
     assert n >= 0, size
     start = 0
@@ -60,6 +60,17 @@ for size in (0, 1, 31, 32, 511, 2048, 2049, 65535, 65536 * 4 + 7, 1 << 22):
         assert digs[i].tobytes() == want, (size, i)
         start = end
     assert start == size
+
+# Fused chunk+digest with the BLAKE3 algo (the 8-way AVX2 leaves under
+# ASan): digests must equal the pure-Python spec oracle.
+from nydus_snapshotter_tpu.utils import blake3 as _pyb3
+b3data = rng.integers(0, 256, 1 << 21, dtype=np.uint8)
+cuts3, digs3 = native_cdc.chunk_digest_native(b3data, params, digester="blake3")
+s3 = 0
+for i in range(len(cuts3)):
+    e3 = int(cuts3[i])
+    assert digs3[32*i:32*(i+1)] == _pyb3.blake3(b3data[s3:e3].tobytes()), i
+    s3 = e3
 
 # Batched multi-extent fused pass: per-file outputs must equal per-file
 # ntpu_chunk_digest calls (thin loop, but the pointer arithmetic into the
