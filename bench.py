@@ -625,6 +625,30 @@ def main() -> None:
         ),
     }
 
+    # Reference-defaults arm: the real nydus-image defaults are blake3
+    # chunk digests + zstd — the configuration whose output interops with
+    # real nydus images (chunk-dict content hits are digest-keyed). The
+    # blake3 digests ride the same fused native pass (8-way AVX2 leaves).
+    opt_refdef = PackOption(
+        chunk_size=CHUNK_SIZE, chunking="cdc", compressor="zstd",
+        digester="blake3", **_pack_kwargs(winner),
+    )
+    refdef_best = None
+    packed_refdef = None
+    for _ in range(REPS):
+        t0 = time.time()
+        packed_refdef = _pack_layers(layers, opt_refdef)
+        dt = time.time() - t0
+        refdef_best = dt if refdef_best is None or dt < refdef_best else refdef_best
+    reference_defaults_profile = {
+        "digester": "blake3",
+        "compressor": "zstd",
+        "full_path_gibps": round(total_in / refdef_best / (1 << 30), 4),
+        "compress_ratio": round(
+            sum(r.blob_size for _b, r in packed_refdef) / max(1, total_in), 4
+        ),
+    }
+
     # ---- detail runs ----
     engine_detail = engine_flat_run(bench_engine, probe)
     pool = build_file_pool(min(IMAGE_MIB, 128), seed=555)
@@ -660,6 +684,7 @@ def main() -> None:
                     "stage_breakdown_s": stage_breakdown,
                     "accel_profile": accel_profile,
                     "zstd_profile": zstd_profile,
+                    "reference_defaults_profile": reference_defaults_profile,
                     "baseline_shaped": shaped,
                     "stargz_zran": stargz_zran,
                     "host_cores": os.cpu_count(),
