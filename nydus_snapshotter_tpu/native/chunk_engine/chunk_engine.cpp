@@ -614,6 +614,10 @@ void ntpu_blake3_many(const uint8_t *data, const int64_t *extents, int64_t m,
   ntpu_b3::blake3_extents(data, extents, m, digests_out);
 }
 
+// Which blake3 leaf arm runs on this host + env (3 = avx512, 2 = avx2,
+// 1 = scalar) — lets the ISA differential tests assert the pinned arm.
+int64_t ntpu_b3_active_isa(void) { return ntpu_b3::b3_active_isa(); }
+
 // Fused single-pass chunk + digest: SIMD candidate bitmaps -> cut
 // resolution -> per-chunk SHA-256 while the bytes are cache-warm. This is
 // the host latency arm's fast path, replacing the separate

@@ -80,6 +80,53 @@ class TestNativeBlake3:
         )
         assert out.hex().startswith("af1349b9f5f9a1a6")
 
+    def test_isa_arms_identical(self, tmp_path):
+        """NTPU_B3_FORCE_ISA pins the scalar / AVX2 / AVX-512 leaf arms
+        (gear-engine contract); every arm the host can run must produce
+        identical digests for the same extents. Child processes because
+        the pin is read once per process."""
+        import json
+        import os as _os
+        import subprocess
+        import sys
+
+        lib = native_cdc.load()
+        if lib is None or not hasattr(lib, "ntpu_b3_active_isa"):
+            pytest.skip("native engine without the blake3 ISA hook")
+        child = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["NTPU_REPO"])
+import numpy as np
+from nydus_snapshotter_tpu.ops import native_cdc
+lib = native_cdc.load()
+rng = np.random.default_rng(0xB3)
+data = rng.integers(0, 256, 1 << 21, dtype=np.uint8)
+sizes = [1, 1024, 9 * 1024, 17 * 1024, 33 * 1024 - 5, 1 << 20]
+ext, off = [], 0
+for s in sizes:
+    ext.append((off, s)); off += s
+out = native_cdc.blake3_many_native(data, np.asarray(ext, dtype=np.int64))
+print(json.dumps({"isa": int(lib.ntpu_b3_active_isa()),
+                  "sig": __import__("hashlib").sha256(out).hexdigest()}))
+"""
+        results = {}
+        for arm in ("scalar", "avx2", "avx512"):
+            env = dict(_os.environ)
+            env["NTPU_B3_FORCE_ISA"] = arm
+            env["NTPU_REPO"] = _os.path.dirname(
+                _os.path.dirname(_os.path.abspath(__file__))
+            )
+            r = subprocess.run(
+                [sys.executable, "-c", child], env=env,
+                capture_output=True, text=True, timeout=300,
+            )
+            assert r.returncode == 0, r.stderr[-800:]
+            results[arm] = json.loads(r.stdout.strip().splitlines()[-1])
+        # a pin never selects an arm the host can't run
+        assert results["scalar"]["isa"] == 1
+        sigs = {v["sig"] for v in results.values()}
+        assert len(sigs) == 1, results
+
     def test_host_digests_blake3_python_fallback(self, monkeypatch):
         # The threaded fan-out helper must agree with the oracle when
         # FORCED down the pure-Python lane (the path every user without
