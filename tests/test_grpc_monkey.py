@@ -1,0 +1,162 @@
+"""Model-based randomized stress over the REAL gRPC snapshotter.
+
+The reference's e2e loops pull/remove sequences to shake out state-machine
+leaks (integration/entrypoint.sh:306-347); this goes further: a seeded
+random walk issues prepare/view/commit/remove/mounts/cleanup in arbitrary
+interleavings against the real service while a shadow model tracks what
+MUST exist. After every operation the service's `list()` must equal the
+model exactly (names + kinds + parents), errors must be the expected gRPC
+codes (never an internal error or a hang), and the final teardown must
+drain everything — zero snapshots, zero instances, zero stray dirs.
+"""
+
+import os
+import random
+
+import grpc
+import pytest
+
+from nydus_snapshotter_tpu.api import snapshots_pb2 as pb
+
+from tests.test_transcript_killmatrix import _mk_cfg, _mk_stack
+
+KIND_ACTIVE = pb.ACTIVE
+KIND_VIEW = pb.VIEW
+KIND_COMMITTED = pb.COMMITTED
+
+N_OPS = 1000
+SEED = 0x5EED
+
+
+class _Model:
+    """Shadow of what the snapshotter must contain."""
+
+    def __init__(self):
+        self.snaps: dict[str, tuple[int, str]] = {}  # key -> (kind, parent)
+
+    def children(self, key: str) -> list[str]:
+        return [k for k, (_kd, p) in self.snaps.items() if p == key]
+
+    def committed(self) -> list[str]:
+        return [k for k, (kd, _p) in self.snaps.items() if kd == KIND_COMMITTED]
+
+    def actives(self) -> list[str]:
+        return [k for k, (kd, _p) in self.snaps.items() if kd == KIND_ACTIVE]
+
+
+class TestGrpcMonkey:
+    def test_random_walk_matches_model(self, tmp_path):
+        cfg = _mk_cfg(tmp_path)
+        db, mgr, fs, sn, server, client, sock = _mk_stack(cfg)
+        rng = random.Random(SEED)
+        model = _Model()
+        seq = 0
+        try:
+            for step in range(N_OPS):
+                op = rng.choice(
+                    ["prepare", "view", "commit", "remove", "mounts", "stat",
+                     "cleanup", "prepare_dup", "remove_missing"]
+                )
+                if op == "prepare":
+                    seq += 1
+                    key = f"active-{seq}"
+                    parent = rng.choice(model.committed() + [""])
+                    client.prepare(key, parent)
+                    model.snaps[key] = (KIND_ACTIVE, parent)
+                elif op == "view":
+                    committed = model.committed()
+                    if not committed:
+                        # reference parity: View requires an existing
+                        # parent (snapshot.go:485 fails on '')
+                        with pytest.raises(grpc.RpcError) as ei:
+                            client.view(f"view-none-{step}", "")
+                        assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+                        continue
+                    seq += 1
+                    key = f"view-{seq}"
+                    parent = rng.choice(committed)
+                    client.view(key, parent)
+                    model.snaps[key] = (KIND_VIEW, parent)
+                elif op == "commit":
+                    actives = model.actives()
+                    if not actives:
+                        continue
+                    key = rng.choice(actives)
+                    seq += 1
+                    name = f"committed-{seq}"
+                    client.commit(name, key)
+                    _kd, parent = model.snaps.pop(key)
+                    model.snaps[name] = (KIND_COMMITTED, parent)
+                elif op == "remove":
+                    if not model.snaps:
+                        continue
+                    key = rng.choice(sorted(model.snaps))
+                    if model.children(key):
+                        # a parent with children must be refused
+                        with pytest.raises(grpc.RpcError) as ei:
+                            client.remove(key)
+                        assert ei.value.code() in (
+                            grpc.StatusCode.FAILED_PRECONDITION,
+                            grpc.StatusCode.INVALID_ARGUMENT,
+                        ), ei.value
+                        assert client.stat(key) is not None  # still there
+                    else:
+                        client.remove(key)
+                        del model.snaps[key]
+                elif op == "mounts":
+                    actives = model.actives()
+                    if not actives:
+                        continue
+                    m = client.mounts(rng.choice(actives))
+                    assert m, "active snapshot without mounts"
+                elif op == "stat":
+                    if not model.snaps:
+                        continue
+                    key = rng.choice(sorted(model.snaps))
+                    info = client.stat(key)
+                    assert info.kind == model.snaps[key][0], key
+                elif op == "cleanup":
+                    client.cleanup()
+                elif op == "prepare_dup":
+                    if not model.snaps:
+                        continue
+                    key = rng.choice(sorted(model.snaps))
+                    with pytest.raises(grpc.RpcError) as ei:
+                        client.prepare(key, "")
+                    assert ei.value.code() == grpc.StatusCode.ALREADY_EXISTS
+                elif op == "remove_missing":
+                    with pytest.raises(grpc.RpcError) as ei:
+                        client.remove(f"never-existed-{step}")
+                    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+                # oracle: the service's listing equals the model exactly
+                listed = {i.name: (i.kind, i.parent) for i in client.list()}
+                want = {k: (kd, p) for k, (kd, p) in model.snaps.items()}
+                assert listed == want, (
+                    f"step {step} op {op}: service={sorted(listed)} "
+                    f"model={sorted(want)}"
+                )
+
+            # drain: remove leaves-first until empty
+            while model.snaps:
+                leaves = [k for k in model.snaps if not model.children(k)]
+                assert leaves, "cycle in model?!"
+                for k in leaves:
+                    client.remove(k)
+                    del model.snaps[k]
+            client.cleanup()
+            assert client.list() == []
+            assert fs.instances.list() == []
+            # no stray snapshot dirs survive the drain + cleanup
+            snap_root = os.path.join(cfg.root, "snapshots")
+            leftovers = [
+                d for d in (os.listdir(snap_root) if os.path.isdir(snap_root) else [])
+                if not d.startswith("metadata")
+            ]
+            assert leftovers == [], leftovers
+        finally:
+            client.close()
+            server.stop(grace=None)
+            fs.teardown()
+            sn.close()
+            mgr.stop()
