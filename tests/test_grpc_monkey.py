@@ -160,3 +160,106 @@ class TestGrpcMonkey:
             fs.teardown()
             sn.close()
             mgr.stop()
+
+    def test_concurrent_walkers_leave_no_residue(self, tmp_path):
+        """Four client threads race namespaced random walks against one
+        service. Interleaving is non-deterministic, so the oracle is the
+        invariant set: only expected gRPC codes ever surface, the service
+        keeps answering, and the combined final drain leaves zero
+        snapshots/instances/dirs (the per-snapshot locking and metastore
+        transactions must hold under contention)."""
+        import threading
+
+        from nydus_snapshotter_tpu.api.client import SnapshotsClient
+
+        cfg = _mk_cfg(tmp_path)
+        db, mgr, fs, sn, server, client, sock = _mk_stack(cfg)
+        errors: list[str] = []
+        OK_CODES = {
+            grpc.StatusCode.ALREADY_EXISTS,
+            grpc.StatusCode.NOT_FOUND,
+            grpc.StatusCode.FAILED_PRECONDITION,
+            grpc.StatusCode.INVALID_ARGUMENT,
+        }
+
+        def walker(wid: int):
+            rng = random.Random(1000 + wid)
+            cli = SnapshotsClient(sock, timeout=30.0)
+            mine: dict[str, tuple[int, str]] = {}
+            try:
+                for i in range(120):
+                    op = rng.choice(
+                        ["prepare", "commit", "remove", "stat", "cleanup"]
+                    )
+                    try:
+                        if op == "prepare":
+                            key = f"w{wid}-a{i}"
+                            committed = [
+                                k for k, (kd, _p) in mine.items()
+                                if kd == KIND_COMMITTED
+                            ]
+                            parent = rng.choice(committed + [""])
+                            cli.prepare(key, parent)
+                            mine[key] = (KIND_ACTIVE, parent)
+                        elif op == "commit":
+                            actives = [
+                                k for k, (kd, _p) in mine.items()
+                                if kd == KIND_ACTIVE
+                            ]
+                            if not actives:
+                                continue
+                            key = rng.choice(actives)
+                            name = f"w{wid}-c{i}"
+                            cli.commit(name, key)
+                            _kd, parent = mine.pop(key)
+                            mine[name] = (KIND_COMMITTED, parent)
+                        elif op == "remove":
+                            leaves = [
+                                k for k in mine
+                                if not any(p == k for _kd, p in mine.values())
+                            ]
+                            if not leaves:
+                                continue
+                            key = rng.choice(leaves)
+                            cli.remove(key)
+                            del mine[key]
+                        elif op == "stat":
+                            if mine:
+                                cli.stat(rng.choice(sorted(mine)))
+                        elif op == "cleanup":
+                            cli.cleanup()
+                    except grpc.RpcError as e:
+                        if e.code() not in OK_CODES:
+                            errors.append(f"w{wid} op {op}: {e.code()} {e}")
+                            return
+                # drain own namespace leaves-first
+                while mine:
+                    leaves = [
+                        k for k in mine
+                        if not any(p == k for _kd, p in mine.values())
+                    ]
+                    for k in leaves:
+                        cli.remove(k)
+                        del mine[k]
+            except Exception as e:  # noqa: BLE001 - collected for the assert
+                errors.append(f"w{wid}: {type(e).__name__}: {e}")
+            finally:
+                cli.close()
+
+        threads = [threading.Thread(target=walker, args=(w,)) for w in range(4)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in threads), "walker hung"
+            assert errors == [], errors
+            client.cleanup()
+            assert client.list() == []
+            assert fs.instances.list() == []
+        finally:
+            client.close()
+            server.stop(grace=None)
+            fs.teardown()
+            sn.close()
+            mgr.stop()
