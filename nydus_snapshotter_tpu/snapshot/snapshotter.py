@@ -129,7 +129,11 @@ class Snapshotter:
         self.nydus_overlayfs_path = nydus_overlayfs_path
         os.makedirs(self.snapshot_root(), exist_ok=True)
         self.ms = MetaStore(os.path.join(root, "snapshots", "metadata.db"))
-        self._lock = threading.RLock()
+        # In-flight prepare temp dirs ("new-*"): the Cleanup GC must not
+        # reap a sibling RPC's staging dir mid-rename (the orphan sweep
+        # only targets crash leftovers, which are never in this set).
+        self._inflight_tmp: set[str] = set()
+        self._inflight_mu = threading.Lock()
 
     # -- path layout ---------------------------------------------------------
 
@@ -448,7 +452,13 @@ class Snapshotter:
         self, kind: str, key: str, parent: str, snap_labels: Optional[dict]
     ) -> tuple[Info, Snapshot]:
         base_labels = dict(snap_labels or {})
-        td = tempfile.mkdtemp(prefix="new-", dir=self.snapshot_root())
+        # mkdtemp + registration are atomic w.r.t. the GC's
+        # list-then-check (see _get_cleanup_directories ordering): any
+        # staging dir the GC can observe is already registered.
+        with self._inflight_mu:
+            td = tempfile.mkdtemp(prefix="new-", dir=self.snapshot_root())
+            td_name = os.path.basename(td)
+            self._inflight_tmp.add(td_name)
         path = ""
         s: Optional[Snapshot] = None
         try:
@@ -475,6 +485,8 @@ class Snapshotter:
                     pass
             raise
         finally:
+            with self._inflight_mu:
+                self._inflight_tmp.discard(td_name)
             if td:
                 shutil.rmtree(td, ignore_errors=True)
         _, info, _ = self.ms.get_info(key)
@@ -639,15 +651,28 @@ class Snapshotter:
     # -- GC -------------------------------------------------------------------
 
     def _get_cleanup_directories(self) -> list[str]:
-        ids = self.ms.id_map()
+        # Ordering against concurrent prepares: list FIRST, then read the
+        # id map and the in-flight set. A staging dir created after the
+        # listing isn't in `dirs`; one created before is registered
+        # (mkdtemp+add are atomic) and gets skipped; and a dir RENAMED to
+        # its final id between the two reads had its metastore row
+        # created before the rename, so a LATER id_map() must contain it
+        # — reading ids before listdir reopened exactly that window (the
+        # GC would reap a just-created live snapshot).
         try:
             dirs = os.listdir(self.snapshot_root())
         except FileNotFoundError:
             return []
+        ids = self.ms.id_map()
+        with self._inflight_mu:
+            inflight = set(self._inflight_tmp)
         return [
             self.snapshot_dir(d)
             for d in dirs
-            if d not in ids and d != "metadata.db" and not d.endswith(("-wal", "-shm"))
+            if d not in ids
+            and d not in inflight  # a sibling RPC's staging dir, not an orphan
+            and d != "metadata.db"
+            and not d.endswith(("-wal", "-shm"))
         ]
 
     def _cleanup_snapshot_directory(self, d: str) -> None:

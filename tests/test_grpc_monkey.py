@@ -25,7 +25,6 @@ KIND_VIEW = pb.VIEW
 KIND_COMMITTED = pb.COMMITTED
 
 N_OPS = 1000
-SEED = 0x5EED
 
 
 class _Model:
@@ -45,10 +44,11 @@ class _Model:
 
 
 class TestGrpcMonkey:
-    def test_random_walk_matches_model(self, tmp_path):
+    @pytest.mark.parametrize("seed", [0x5EED, 7, 424242])
+    def test_random_walk_matches_model(self, tmp_path, seed):
         cfg = _mk_cfg(tmp_path)
         db, mgr, fs, sn, server, client, sock = _mk_stack(cfg)
-        rng = random.Random(SEED)
+        rng = random.Random(seed)
         model = _Model()
         seq = 0
         try:
