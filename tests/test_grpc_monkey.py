@@ -161,6 +161,108 @@ class TestGrpcMonkey:
             sn.close()
             mgr.stop()
 
+    def test_nydus_image_lifecycle_walk(self, tmp_path):
+        """Randomized NYDUS flows: image pulls (extract→commit meta chain),
+        container creates on random images, daemon reads after every
+        create, container/image removals, cleanup — the shared daemon's
+        instance refcounts under arbitrary interleavings. Oracle: model
+        listing equality, byte-correct reads through the live daemon, and
+        a final drain to zero snapshots AND zero rafs instances."""
+        import shutil
+
+        from nydus_snapshotter_tpu import constants as C
+
+        from tests.test_daemon_lifecycle import _build_image
+        from tests.test_transcript_killmatrix import (
+            IMAGE_REF,
+            _meta_labels,
+        )
+
+        cfg = _mk_cfg(tmp_path)
+        db, mgr, fs, sn, server, client, sock = _mk_stack(cfg)
+        rng = random.Random(99)
+        # images[name] = (chain, file bytes); containers[key] = image name
+        images: dict[str, tuple[str, bytes]] = {}
+        containers: dict[str, str] = {}
+        seq = 0
+        try:
+            for step in range(60):
+                op = rng.choice(
+                    ["pull", "create", "read", "rm_ctr", "rm_img", "cleanup"]
+                )
+                if op == "pull" and len(images) < 4:
+                    seq += 1
+                    name = f"img{seq}"
+                    sub = tmp_path / name
+                    sub.mkdir()
+                    boot, blob_dir, files = _build_image(sub)
+                    os.makedirs(fs.cache_mgr.cache_dir, exist_ok=True)
+                    for b in os.listdir(blob_dir):
+                        shutil.copyfile(
+                            os.path.join(blob_dir, b),
+                            os.path.join(fs.cache_mgr.cache_dir, b),
+                        )
+                    chain = f"sha256:{name}-chain"
+                    labels = dict(_meta_labels())
+                    labels[C.TARGET_SNAPSHOT_REF] = chain
+                    client.prepare(f"extract-{name}", "", labels=labels)
+                    sid, _info, _us = sn.ms.get_info(f"extract-{name}")
+                    image_dir = os.path.join(sn.upper_path(sid), "image")
+                    os.makedirs(image_dir, exist_ok=True)
+                    shutil.copyfile(boot, os.path.join(image_dir, "image.boot"))
+                    client.commit(chain, f"extract-{name}", labels=_meta_labels())
+                    images[name] = (chain, files["/app/hello.txt"])
+                elif op == "create" and images:
+                    seq += 1
+                    name = rng.choice(sorted(images))
+                    key = f"ctr{seq}"
+                    client.prepare(
+                        key, images[name][0],
+                        labels={C.CRI_IMAGE_REF: IMAGE_REF},
+                    )
+                    assert client.mounts(key), key
+                    containers[key] = name
+                elif op == "read" and containers:
+                    key = rng.choice(sorted(containers))
+                    name = containers[key]
+                    chain, want = images[name]
+                    sid, _i, _u = sn.ms.get_info(chain)
+                    d = fs.get_shared_daemon(C.FS_DRIVER_FUSEDEV)
+                    got = d.client().read_file(f"/{sid}", "/app/hello.txt")
+                    assert got == want, key
+                elif op == "rm_ctr" and containers:
+                    key = rng.choice(sorted(containers))
+                    client.remove(key)
+                    del containers[key]
+                elif op == "rm_img" and images:
+                    name = rng.choice(sorted(images))
+                    chain = images[name][0]
+                    if any(v == name for v in containers.values()):
+                        with pytest.raises(grpc.RpcError):
+                            client.remove(chain)
+                    else:
+                        client.remove(chain)
+                        del images[name]
+                elif op == "cleanup":
+                    client.cleanup()
+
+            # drain: containers first, then images
+            for key in sorted(containers):
+                client.remove(key)
+            for name in sorted(images):
+                client.remove(images[name][0])
+            client.cleanup()
+            assert client.list() == []
+            assert fs.instances.list() == [], [
+                r.snapshot_id for r in fs.instances.list()
+            ]
+        finally:
+            client.close()
+            server.stop(grace=None)
+            fs.teardown()
+            sn.close()
+            mgr.stop()
+
     def test_concurrent_walkers_leave_no_residue(self, tmp_path):
         """Four client threads race namespaced random walks against one
         service. Interleaving is non-deterministic, so the oracle is the
