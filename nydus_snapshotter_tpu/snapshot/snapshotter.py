@@ -604,8 +604,10 @@ class Snapshotter:
                     )
                 )
             elif C.NYDUS_LAYER_BLOCK_INFO in ann:
-                # One raw-block volume per tarfs layer, walked bottom-up
-                # (mount_option.go:211-242).
+                # One raw-block volume per tarfs layer, appended in
+                # parent-walk order — topmost committed layer first —
+                # exactly as the reference emits them while walking the
+                # chain down (mount_option.go:211-242).
                 vols: list[str] = []
 
                 def visit(_sid: str, info: Info) -> bool:
@@ -627,7 +629,7 @@ class Snapshotter:
                     self.ms.iterate_parent_snapshots(key, visit)
                 except errdefs.NotFound:
                     pass  # chain exhausted — expected
-                options.extend(reversed(vols))  # low layer first
+                options.extend(vols)  # top layer first (parent-walk order)
             return [
                 Mount(
                     type=self._overlay_mount_type(),

@@ -20,10 +20,12 @@ Reference pkg/tarfs/tarfs.go. Capabilities reproduced:
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import logging
 import os
+import tempfile
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -583,10 +585,22 @@ class Manager:
         if not os.path.exists(meta_image):
             from nydus_snapshotter_tpu.models.erofs_image import erofs_from_rafs
 
-            tmp = meta_image + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(erofs_from_rafs(merged))
-            os.rename(tmp, meta_image)
+            # Unique temp per writer: two concurrent first-mounts must not
+            # share (and truncate) one tmp file; whoever renames first wins
+            # and the loser's identical image is discarded.
+            fd, tmp = tempfile.mkstemp(
+                prefix=os.path.basename(meta_image) + ".",
+                dir=os.path.dirname(meta_image),
+            )
+            try:
+                os.fchmod(fd, 0o644)  # mkstemp's 0600 would hide the image from non-root readers
+                with os.fdopen(fd, "wb") as f:
+                    f.write(erofs_from_rafs(merged))
+                os.rename(tmp, meta_image)
+            except BaseException:
+                with contextlib.suppress(OSError):
+                    os.unlink(tmp)
+                raise
 
         st = self._get_status(snapshot_id)
         mountpoint = os.path.join(rafs.snapshot_dir, "mnt")

@@ -68,9 +68,14 @@ class _Api:
         with self._lock:
             if self._pool:
                 return self._pool.pop()
-        return self.lib.ZSTD_createCCtx()
+        ctx = self.lib.ZSTD_createCCtx()
+        if not ctx:  # NULL on allocation failure — never hand it out
+            raise ZstdError("ZSTD_createCCtx failed (out of memory)")
+        return ctx
 
     def release(self, ctx: int) -> None:
+        if not ctx:
+            return  # never pool a NULL/failed context
         with self._lock:
             if len(self._pool) < self.POOL_CAP:
                 self._pool.append(ctx)

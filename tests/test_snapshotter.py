@@ -405,3 +405,41 @@ class TestSnapshotter:
         assert mounts[0].type == "fuse.nydus-overlayfs"
         assert any(o.startswith("extraoption=") for o in mounts[0].options)
         s.close()
+
+    def test_kata_layer_raw_block_volumes_emitted_top_first(self, tmp_path):
+        """Per-layer kata raw-block volumes must appear in parent-walk
+        (top-down) order, matching the reference's mountWithTarfsVolume
+        loop that appends while walking from the topmost committed layer
+        to the bottom (mount_option.go:211-242)."""
+        fs = FakeFs()
+        fs.tarfs = True
+        fs.get_instance_annotations = lambda sid: {
+            C.NYDUS_TARFS_LAYER: "blob-top",
+            C.NYDUS_LAYER_BLOCK_INFO: "4096,2097152,sha256:" + "a" * 64,
+        }
+        fs.tarfs_layer_disk_path = lambda blob_id: f"/disk/{blob_id}.layer.disk"
+        s = Snapshotter(root=str(tmp_path), fs=fs, enable_kata_volume=True)
+
+        # three committed tarfs layers: bottom -> mid -> top (the ro-layer
+        # prepare commits under the target ref and raises AlreadyExists)
+        parent = ""
+        for name in ("bottom", "mid", "top"):
+            labels = {C.NYDUS_TARFS_LAYER: f"blob-{name}"}
+            with pytest.raises(errdefs.AlreadyExists):
+                s.prepare(
+                    f"p-{name}", parent, {C.TARGET_SNAPSHOT_REF: f"ref-{name}", **labels}
+                )
+            parent = f"ref-{name}"
+
+        mounts = s.prepare("rw-kata", "ref-top")
+        opts = [o for o in mounts[0].options if o.startswith("io.katacontainers.volume=")]
+        assert len(opts) == 3
+        sources = [KataVirtualVolume.decode_option(o).source for o in opts]
+        assert sources == [
+            "/disk/blob-top.layer.disk",
+            "/disk/blob-mid.layer.disk",
+            "/disk/blob-bottom.layer.disk",
+        ]
+        for o in opts:
+            assert KataVirtualVolume.decode_option(o).volume_type == "layer_raw_block"
+        s.close()
