@@ -207,6 +207,9 @@ ENGINE_ARMS = {
     "host": {"backend": "hybrid"},
     "device_digest": {"backend": "hybrid", "digest_backend": "jax"},
     "device_all": {"backend": "jax", "digest_backend": "jax"},
+    # full-path two-dispatch composition (ops/fused_convert): the whole
+    # batch as one gear+compaction dispatch and one gather+digest dispatch
+    "device_fused": {"backend": "fused"},
 }
 
 
@@ -245,7 +248,7 @@ def calibrate_engine(chunk_size: int, repo: str, device_ok: bool):
     times = {"host": time.time() - t}
 
     if device_ok:
-        for arm in ("device_digest", "device_all"):
+        for arm in ("device_digest", "device_all", "device_fused"):
             dt = _time_engine_child(repo, chunk_size, ENGINE_ARMS[arm])
             if dt is not None:
                 times[arm] = dt
@@ -331,6 +334,8 @@ def engine_flat_run(engine, probe) -> dict:
 def _pack_kwargs(winner: str) -> dict:
     """PackOption fields matching the raced engine arm, so the headline
     full-path run actually uses the winning configuration."""
+    if winner == "device_fused":
+        return {"backend": "fused"}
     if winner == "device_all":
         return {"backend": "jax"}
     if winner == "device_digest":

@@ -1006,6 +1006,41 @@ def pack_stream(
                 _process(batch, dlist)
                 pos += nc
             plan = []  # consumed; skip the per-file paths below
+        # Device full-path lane (opt.backend == "fused"): the WHOLE layer's
+        # files as one two-dispatch device batch (ops/fused_convert —
+        # gear+compaction, then gather+digest), host keeping only cut
+        # metadata. Dedup (incl. chunk-dict probes) and compression stay
+        # in the _process lane, byte-identical to the host paths.
+        if (
+            plan
+            and opt.backend == "fused"
+            and params is not None
+            and opt.chunking == "cdc"
+            and opt.digester == "sha256"
+        ):
+            from nydus_snapshotter_tpu.ops import fused_convert
+
+            feng = fused_convert.FusedDeviceEngine(chunk_size=opt.chunk_size)
+            streams = [arr_all[off : off + size] for _t, _m, off, size in plan]
+            _tc = _pc()
+            try:
+                fres = feng.process_many(streams)
+            except fused_convert.FusedOverflow:
+                fres = None  # pathological input: per-file paths below
+            _t_chunk += _pc() - _tc
+            if fres is not None:
+                for (_tag, meta, off, size), fcuts, dlist in zip(
+                    plan, fres.cuts, fres.digests
+                ):
+                    view = raw[off : off + size]
+                    s = 0
+                    batch = []
+                    for c in fcuts:
+                        batch.append((meta, view[s : int(c)]))
+                        s = int(c)
+                    if batch:
+                        _process(batch, dlist)
+                plan = []
         small_items = [
             (arr_all, off, size) for tag, _m, off, size in plan if tag == "small"
         ]

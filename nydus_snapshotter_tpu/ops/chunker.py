@@ -205,7 +205,7 @@ class ChunkDigestEngine:
     ):
         if mode not in ("cdc", "fixed"):
             raise ValueError(f"unknown chunking mode {mode!r}")
-        if backend not in ("jax", "numpy", "hybrid"):
+        if backend not in ("jax", "numpy", "hybrid", "fused"):
             raise ValueError(f"unknown backend {backend!r}")
         if window % 32:
             raise ValueError("window must be a multiple of 32")
@@ -216,7 +216,9 @@ class ChunkDigestEngine:
         # hybrid: native/sequential boundaries + threaded host SHA — the
         # latency arm of the crossover (device kernels win only on bulk
         # batches; SURVEY §7 hard-part #3 fallback)
-        self.digest_backend = digest_backend or ("host" if backend == "hybrid" else backend)
+        self.digest_backend = digest_backend or (
+            "host" if backend == "hybrid" else "jax" if backend == "fused" else backend
+        )
         if self.digest_backend not in ("jax", "numpy", "host"):
             raise ValueError(f"unknown digest backend {self.digest_backend!r}")
         if digester not in ("sha256", "blake3"):
@@ -531,6 +533,10 @@ class ChunkDigestEngine:
             np.frombuffer(s, dtype=np.uint8) if isinstance(s, (bytes, bytearray)) else s
             for s in streams
         ]
+        if self.backend == "fused" and self.mode == "cdc" and self.digester == "sha256":
+            out = self._process_many_device_fused(arrs)
+            if out is not None:
+                return out
         if self._fused_available():
             return self._process_many_fused(arrs)
         all_cuts = self.boundaries_many(arrs)
@@ -564,6 +570,29 @@ class ChunkDigestEngine:
         from nydus_snapshotter_tpu.ops import native_cdc
 
         return native_cdc.chunk_digest_available()
+
+    def _process_many_device_fused(
+        self, arrs: list[np.ndarray]
+    ) -> list[list[ChunkMeta]] | None:
+        """Full-path device composition (ops/fused_convert): the whole
+        batch as two device dispatches — gear+compaction, then
+        gather+digest — with only candidate/cut metadata on the host.
+        Returns None on candidate-capacity overflow (pathological input)
+        so process_many falls through to the windowed device path."""
+        from nydus_snapshotter_tpu.ops import fused_convert
+
+        eng = fused_convert.FusedDeviceEngine(chunk_size=self.chunk_size)
+        try:
+            res = eng.process_many(arrs)
+        except fused_convert.FusedOverflow:
+            return None
+        return [
+            [
+                ChunkMeta(offset=o, size=s, digest=d)
+                for (o, s), d in zip(cdc.cuts_to_extents(cuts), digests)
+            ]
+            for cuts, digests in zip(res.cuts, res.digests)
+        ]
 
     def _process_many_fused(self, arrs: list[np.ndarray]) -> list[list[ChunkMeta]]:
         from nydus_snapshotter_tpu.ops import native_cdc

@@ -1,0 +1,438 @@
+"""Fused device full-path convert: gear → cuts → gather → digest → probe.
+
+The composition the isolated kernel benchmarks don't prove: one device
+program per phase, with only KILOBYTES of metadata crossing the host
+boundary between them. The multi-GiB corpus is uploaded (or generated)
+on device ONCE and never comes back:
+
+- **Pass 1 (one jit dispatch).** Gear candidate bitmaps over the whole
+  buffer (ops/gear_pallas on TPU, the XLA formulation elsewhere), then
+  ON-DEVICE sparse compaction: word-level ``lax.population_count`` →
+  ``nonzero`` over words → bit expansion. D2H is the candidate position
+  list (~KBs at real mask densities), not the N/32-byte bitmaps.
+- **Host middle (microseconds).** FastCDC cut resolution over the sparse
+  candidates per file (ops/cdc.resolve_cuts — O(chunks·log cands)) and
+  the bucket plan (power-of-two block-capacity classes, exact counts).
+  Shipping cuts through the host costs two dispatch floors but buys
+  EXACT static shapes for pass 2 — an on-device resolver would force
+  worst-case (~16x padded) digest compute, which loses at any batch size.
+- **Pass 2 (one jit dispatch).** Per bucket: ``lax.scan`` of
+  ``dynamic_slice`` gathers (byte-exact chunk starts, so no realignment
+  kernel), SHA-256 padding applied with iota masks on device, the
+  measured ``sha256_batch`` scan, and the chunk-dict probe
+  (parallel/sharded_dict._probe_local) over every digest. D2H is
+  32 B/chunk of digests + 4 B/chunk of dict hits.
+
+Why two dispatches and not one: the digest stage's shapes depend on the
+resolved cuts. Keeping resolution on device would make bucket geometry
+dynamic, forcing every chunk slot to the 4 MiB max class. At the axon
+tunnel's measured ~125-145 ms dispatch floor, 2 dispatches on a multi-GiB
+batch cost <15% of the 2.5 GiB/s/chip budget; on a real PCIe host the
+floor is microseconds.
+
+Replaces the one-process hot loop of the reference's ``nydus-image
+create`` (chunk+digest+dedup inside pkg/converter/tool/builder.go:148-178;
+the chunk-dict probe at builder.go:122-123).
+
+Differential oracle: ChunkDigestEngine(backend="numpy") — the fused path
+must produce byte-identical cuts and digests (tests/test_fused_convert.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nydus_snapshotter_tpu.ops import cdc, gear, sha256
+
+WINDOW = 1 << 22  # pass-1 hash window (matches ops/chunker.DEFAULT_WINDOW)
+TAIL = gear.GEAR_WINDOW - 1
+
+
+class FusedOverflow(RuntimeError):
+    """Candidate compaction capacity exceeded (pathological input) —
+    callers fall back to the windowed bitmap-download path."""
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << (n - 1).bit_length() if n > 1 else 1
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: gear bitmaps + on-device candidate compaction
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mask_s", "mask_l", "wcap_s", "wcap_l")
+)
+def _pass1(
+    buffer: jax.Array,  # u8[NP], NP % WINDOW == 0
+    n: jax.Array,  # i32/i64 scalar: valid bytes
+    mask_s: int,
+    mask_l: int,
+    wcap_s: int,
+    wcap_l: int,
+):
+    """-> (sel_s i32[wcap_s], words_s u32[wcap_s], nw_s, … same for _l).
+
+    sel_* are ascending candidate-WORD indices (sentinel: nwords) with
+    their raw bitmap words; nw_* are the true candidate-word counts — a
+    count > wcap means truncation (FusedOverflow on host).
+    """
+    npad = buffer.shape[0]
+    b = npad // WINDOW
+    # windows with 31-byte seam-carry tails (row i prefixed by the last
+    # 31 bytes of row i-1; row 0 by zeros — positions < min_size are
+    # never judged, so the zeros can't reach a resolved cut)
+    main = buffer.reshape(b, WINDOW)
+    tails = jnp.concatenate(
+        [jnp.zeros((1, TAIL), jnp.uint8), main[:-1, WINDOW - TAIL :]], axis=0
+    )
+    rows = jnp.concatenate([tails, main], axis=1)  # u8[B, TAIL+WINDOW]
+
+    from nydus_snapshotter_tpu.ops import gear_pallas
+
+    if gear_pallas.supported(WINDOW):
+        bm_s, bm_l = gear_pallas.gear_bitmaps(rows, mask_s, mask_l, WINDOW)
+    else:
+        from nydus_snapshotter_tpu.ops.chunker import _hash_bitmaps_kernel
+
+        bm_s, bm_l = _hash_bitmaps_kernel(
+            rows, jnp.uint32(mask_s), jnp.uint32(mask_l), WINDOW
+        )
+
+    nwords = npad // 32
+    widx_valid = jnp.arange(nwords, dtype=jnp.int32) < (n + 31) // 32
+
+    def compact(bm, wcap):
+        # Word indices + raw words, NOT byte positions: word indices stay
+        # well inside int32 for any addressable buffer (device ints are
+        # 32-bit without x64), and the host expands bit positions in int64.
+        words = bm.reshape(nwords)
+        # zero whole words beyond the valid length (window padding would
+        # otherwise flood the capacity with phantom candidates)
+        words = jnp.where(widx_valid, words, jnp.uint32(0))
+        pc = jax.lax.population_count(words)
+        (sel,) = jnp.nonzero(pc > 0, size=wcap, fill_value=nwords)
+        nw = jnp.sum((pc > 0).astype(jnp.int32))
+        got = jnp.where(
+            sel < nwords, words[jnp.minimum(sel, nwords - 1)], jnp.uint32(0)
+        )  # u32[wcap]
+        return sel.astype(jnp.int32), got, nw
+
+    sel_s, got_s, nw_s = compact(bm_s, wcap_s)
+    sel_l, got_l, nw_l = compact(bm_l, wcap_l)
+    return sel_s, got_s, nw_s, sel_l, got_l, nw_l
+
+
+def _wcap_for(n: int, density_bits: int, floor: int = 1024) -> int:
+    """Static candidate-word capacity: 4x the expected count for a
+    2^-density_bits per-position hit rate, floored."""
+    expected = max(1, n >> density_bits)
+    return _pow2_ceil(max(floor, 4 * expected))
+
+
+# ---------------------------------------------------------------------------
+# Pass 2: gather + SHA pack + digest + dict probe
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Bucket:
+    """One power-of-two block-capacity class of the pass-2 plan.
+
+    offsets/sizes are pow2-padded (padding rows have size 0 and offset 0
+    and are discarded on assembly); ``count`` is the live prefix.
+    """
+
+    cap_blocks: int
+    offsets: np.ndarray  # i32[M] absolute byte offsets into the buffer
+    sizes: np.ndarray  # i32[M]
+    count: int
+
+
+def _gather_pack_sha(buffer: jax.Array, offs: jax.Array, sizes: jax.Array, cap_blocks: int):
+    """Gather chunks at byte-exact offsets and emit SHA-padded blocks.
+
+    One scan step per chunk: dynamic_slice (a contiguous DMA-shaped copy,
+    not an element gather), zero/0x80 padding + big-endian word build +
+    64-bit length words, all via iota masks. -> u32[M, cap_blocks, 16].
+    """
+    capb = cap_blocks * 64
+    byte_iota = jnp.arange(capb, dtype=jnp.int32)
+    word_iota = jnp.arange(capb // 4, dtype=jnp.int32)
+
+    def step(carry, xs):
+        off, size = xs
+        raw = jax.lax.dynamic_slice(buffer, (off,), (capb,))
+        padded = jnp.where(byte_iota < size, raw, jnp.uint8(0))
+        padded = jnp.where(byte_iota == size, jnp.uint8(0x80), padded)
+        w = padded.reshape(-1, 4).astype(jnp.uint32)
+        words = (w[:, 0] << 24) | (w[:, 1] << 16) | (w[:, 2] << 8) | w[:, 3]
+        nb = (size + 8) // 64 + 1  # n_padded_blocks
+        hi = (size >> 29).astype(jnp.uint32)
+        lo = size.astype(jnp.uint32) << 3
+        words = jnp.where(word_iota == (nb - 1) * 16 + 14, hi, words)
+        words = jnp.where(word_iota == (nb - 1) * 16 + 15, lo, words)
+        return carry, words.reshape(cap_blocks, 16)
+
+    _, blocks = jax.lax.scan(step, 0, (offs, sizes))
+    return blocks
+
+
+@functools.partial(jax.jit, static_argnames=("caps", "table_cap", "depth"))
+def _pass2(
+    buffer: jax.Array,
+    bucket_offs: tuple[jax.Array, ...],
+    bucket_sizes: tuple[jax.Array, ...],
+    caps: tuple[int, ...],
+    table_keys: jax.Array | None = None,  # u32[C, 8]
+    table_vals: jax.Array | None = None,  # i32[C]
+    table_cap: int = 0,
+    depth: int = 0,
+):
+    """-> (tuple of u32[M_i, 8] digest states, i32[sum M_i] probe or None)."""
+    states = []
+    for offs, sizes, cap in zip(bucket_offs, bucket_sizes, caps):
+        blocks = _gather_pack_sha(buffer, offs, sizes, cap)
+        counts = (sizes + 8) // 64 + 1
+        unroll = jax.default_backend() != "cpu"
+        states.append(sha256._sha256_batch_jit(blocks, counts, unroll))
+    probe = None
+    if table_keys is not None:
+        from nydus_snapshotter_tpu.parallel.sharded_dict import _probe_local
+
+        allq = jnp.concatenate(states, axis=0)
+        probe = _probe_local(table_keys, table_vals, allq, table_cap, depth)
+    return tuple(states), probe
+
+
+# ---------------------------------------------------------------------------
+# Host orchestration
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FusedResult:
+    """Per-stream chunk extents/digests + optional dict-probe hits."""
+
+    cuts: list[np.ndarray]  # per-stream exclusive cut ends
+    digests: list[list[bytes]]  # per-stream raw 32-B sha256 digests
+    probe: np.ndarray | None  # i32 over all chunks in stream order (0=miss)
+
+
+class FusedDeviceEngine:
+    """Full-path device convert for a batch of per-file streams.
+
+    Mirrors ChunkDigestEngine.process_many semantics (per-file CDC with
+    the engine's CDCParams, per-chunk sha256) but runs the whole batch as
+    two device dispatches. ``chunk_dict`` (keys u32[C,8] / values i32[C],
+    the sharded-dict single-shard layout) adds the dedup probe to pass 2.
+    """
+
+    MAX_COMPILED_BUFFERS = 1  # quantize buffer length to pow2: O(log) shapes
+
+    def __init__(self, chunk_size: int = 0x100000, max_bucket_rows: int = 1 << 14):
+        self.params = cdc.CDCParams(chunk_size)
+        self.max_bucket_rows = max_bucket_rows
+
+    # -- planning ------------------------------------------------------------
+
+    def layout(self, arrs: list[np.ndarray]) -> tuple[np.ndarray, list[tuple[int, int]]]:
+        """Concatenate streams; returns (buffer, [(offset, length)])."""
+        table = []
+        total = 0
+        for a in arrs:
+            table.append((total, a.size))
+            total += a.size
+        # pad to a window multiple + one max-chunk guard so pass-2
+        # dynamic_slice never clamps a start (clamping would shift the
+        # slice and corrupt in-range bytes)
+        guard = self.params.max_size + 64
+        npad = -(-max(1, total + guard) // WINDOW) * WINDOW
+        # quantize to 1/8-pow2 steps: bounded compile count without the
+        # full pow2 doubling (which would push a 1.1 GiB batch to 2 GiB)
+        step = max(WINDOW, _pow2_ceil(npad) // 8)
+        npad = -(-npad // step) * step
+        # Device ints are 32-bit (no x64): pass-2 chunk offsets must
+        # address the buffer with int32. Callers split larger corpora
+        # into sub-2-GiB batches (bench packs per layer, far below this).
+        if npad >= 1 << 31:
+            raise FusedOverflow(
+                f"batch of {total} bytes pads to {npad} — beyond int32 "
+                "device addressing; split the batch"
+            )
+        buf = np.zeros(npad, dtype=np.uint8)
+        pos = 0
+        for a in arrs:
+            buf[pos : pos + a.size] = a
+            pos += a.size
+        return buf, table
+
+    def resolve(
+        self,
+        cand_s: np.ndarray,
+        cand_l: np.ndarray,
+        table: list[tuple[int, int]],
+    ) -> list[np.ndarray]:
+        """Per-file cut resolution over the global candidate arrays.
+
+        Candidates judged per file always sit >= min_size-1 >= 31 bytes
+        past the file start, where the 32-byte gear window lies entirely
+        inside the file — so global (concatenated) hashing resolves to
+        bit-identical per-file cuts (the ops/chunker seam argument).
+        """
+        cuts = []
+        for off, length in table:
+            if length == 0:
+                cuts.append(np.asarray([], dtype=np.int64))
+                continue
+            lo_s, hi_s = np.searchsorted(cand_s, [off, off + length])
+            lo_l, hi_l = np.searchsorted(cand_l, [off, off + length])
+            cuts.append(
+                cdc.resolve_cuts(
+                    cand_s[lo_s:hi_s] - off,
+                    cand_l[lo_l:hi_l] - off,
+                    length,
+                    self.params,
+                )
+            )
+        return cuts
+
+    def plan_buckets(
+        self, table: list[tuple[int, int]], cuts: list[np.ndarray]
+    ) -> tuple[list[Bucket], list[tuple[int, int]]]:
+        """Bucket chunks by pow2 padded-block class with EXACT counts.
+
+        Returns (buckets, flat chunk order) where the flat order is
+        (bucket, row) assignments per chunk in stream order, used to
+        scatter results back.
+        """
+        max_blocks = sha256.n_padded_blocks(self.params.max_size)
+        per_class: dict[int, list[tuple[int, int]]] = {}
+        order: list[tuple[int, int]] = []
+        for (f_off, _f_len), f_cuts in zip(table, cuts):
+            prev = 0
+            for cut in f_cuts:
+                size = int(cut) - prev
+                nb = sha256.n_padded_blocks(size)
+                cap = min(_pow2_ceil(nb), max_blocks)
+                rows = per_class.setdefault(cap, [])
+                order.append((cap, len(rows)))
+                rows.append((f_off + prev, size))
+                prev = int(cut)
+        buckets = []
+        for cap in sorted(per_class):
+            rows = per_class[cap]
+            m = _pow2_ceil(len(rows))
+            offs = np.zeros(m, dtype=np.int32)
+            sizes = np.zeros(m, dtype=np.int32)
+            offs[: len(rows)] = [r[0] for r in rows]
+            sizes[: len(rows)] = [r[1] for r in rows]
+            buckets.append(Bucket(cap, offs, sizes, len(rows)))
+        return buckets, order
+
+    # -- execution -----------------------------------------------------------
+
+    def candidates(self, buffer_dev: jax.Array, n: int) -> tuple[np.ndarray, np.ndarray]:
+        """Pass 1 on an already-device-resident buffer."""
+        p = self.params
+        wcap_s = _wcap_for(n, p.bits + 2)
+        wcap_l = _wcap_for(n, p.bits - 2)
+        sel_s, got_s, nw_s, sel_l, got_l, nw_l = _pass1(
+            buffer_dev, jnp.int32(n), p.mask_small, p.mask_large, wcap_s, wcap_l
+        )
+        nw_s, nw_l = int(nw_s), int(nw_l)
+        if nw_s > wcap_s or nw_l > wcap_l:
+            raise FusedOverflow(
+                f"candidate words {nw_s}/{nw_l} exceed caps {wcap_s}/{wcap_l}"
+            )
+        def host_pos(sel, got, nw):
+            # expand word-index + bitmap word to int64 byte positions
+            sel = np.asarray(jax.device_get(sel))[:nw].astype(np.int64)
+            got = np.asarray(jax.device_get(got))[:nw]
+            bits = np.unpackbits(
+                got.view(np.uint8).reshape(-1, 4), axis=1, bitorder="little"
+            )  # [nw, 32]
+            widx, bit = np.nonzero(bits)
+            pos = sel[widx] * 32 + bit
+            return pos[pos < n]
+
+        return host_pos(sel_s, got_s, nw_s), host_pos(sel_l, got_l, nw_l)
+
+    def digest_probe(
+        self,
+        buffer_dev: jax.Array,
+        buckets: list[Bucket],
+        chunk_dict: tuple[np.ndarray, np.ndarray] | None = None,
+        depth: int = 8,
+    ):
+        """Pass 2: per-bucket digest states + optional dict probe."""
+        offs = tuple(jnp.asarray(b.offsets) for b in buckets)
+        sizes = tuple(jnp.asarray(b.sizes) for b in buckets)
+        caps = tuple(b.cap_blocks for b in buckets)
+        tk = tv = None
+        table_cap = 0
+        if chunk_dict is not None:
+            keys, vals = chunk_dict
+            table_cap = keys.shape[0]
+            tk, tv = jnp.asarray(keys), jnp.asarray(vals)
+        states, probe = _pass2(
+            buffer_dev, offs, sizes, caps, tk, tv, table_cap, depth
+        )
+        return states, probe
+
+    def process_many(
+        self,
+        streams: list[bytes | np.ndarray],
+        chunk_dict: tuple[np.ndarray, np.ndarray] | None = None,
+        depth: int = 8,
+    ) -> FusedResult:
+        arrs = [
+            np.frombuffer(s, dtype=np.uint8) if isinstance(s, (bytes, bytearray)) else s
+            for s in streams
+        ]
+        buf, table = self.layout(arrs)
+        n = sum(a.size for a in arrs)
+        buffer_dev = jax.device_put(jnp.asarray(buf))
+        if n == 0:
+            return FusedResult(
+                cuts=[np.asarray([], dtype=np.int64) for _ in arrs],
+                digests=[[] for _ in arrs],
+                probe=np.zeros(0, np.int32) if chunk_dict is not None else None,
+            )
+        cand_s, cand_l = self.candidates(buffer_dev, n)
+        cuts = self.resolve(cand_s, cand_l, table)
+        buckets, order = self.plan_buckets(table, cuts)
+        states, probe = self.digest_probe(buffer_dev, buckets, chunk_dict, depth)
+        by_cap = {
+            b.cap_blocks: np.asarray(jax.device_get(s))
+            for b, s in zip(buckets, states)
+        }
+        flat_digests = [
+            sha256.digest_to_bytes(by_cap[cap][row]) for cap, row in order
+        ]
+        probe_np = None
+        if probe is not None:
+            # probe ran over the concatenation of bucket rows (incl.
+            # padding); remap to stream order via each bucket's row base
+            probe_all = np.asarray(jax.device_get(probe))
+            base = {}
+            acc = 0
+            for b in buckets:
+                base[b.cap_blocks] = acc
+                acc += len(b.offsets)
+            probe_np = np.asarray(
+                [probe_all[base[cap] + row] for cap, row in order], dtype=np.int32
+            )
+        out_digests: list[list[bytes]] = []
+        pos = 0
+        for f_cuts in cuts:
+            out_digests.append(flat_digests[pos : pos + len(f_cuts)])
+            pos += len(f_cuts)
+        return FusedResult(cuts=cuts, digests=out_digests, probe=probe_np)

@@ -1,0 +1,165 @@
+"""Fused device full-path differentials: the two-dispatch composition
+(ops/fused_convert) must produce bit-identical cuts and digests to the
+host oracle engine, and its dict-probe must match the host dict.
+
+Runs the XLA formulation on the CPU backend (the gear Pallas kernel and
+real dispatch-floor economics are hardware-only; tools/device_hunt.py
+measures those in tunnel windows)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu.ops import fused_convert
+from nydus_snapshotter_tpu.ops.chunker import ChunkDigestEngine
+from nydus_snapshotter_tpu.parallel.sharded_dict import (
+    _build_host_tables,
+    _table_max_depth,
+)
+
+CHUNK = 0x10000  # 64 KiB average so small corpora produce many chunks
+
+
+def _corpus(seed: int, sizes: list[int]) -> list[bytes]:
+    rng = np.random.default_rng(seed)
+    out = []
+    for i, size in enumerate(sizes):
+        if i % 3 == 0:
+            data = rng.integers(0, 256, size, dtype=np.uint8)
+        elif i % 3 == 1:
+            base = rng.integers(0, 256, max(1, size // 7), dtype=np.uint8)
+            data = np.tile(base, 8)[:size]
+        else:
+            words = rng.integers(32, 127, size, dtype=np.uint8)
+            data = words
+        out.append(data.tobytes())
+    return out
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return ChunkDigestEngine(chunk_size=CHUNK, backend="numpy", digest_backend="numpy")
+
+
+class TestFusedDifferential:
+    def test_cuts_and_digests_match_oracle(self, oracle):
+        streams = _corpus(7, [3, 100_000, 0, 700_001, 64, 250_000, 1_048_576])
+        eng = fused_convert.FusedDeviceEngine(chunk_size=CHUNK)
+        res = eng.process_many(streams)
+        want = oracle.process_many(streams)
+        assert len(res.cuts) == len(streams)
+        for i, (got_cuts, got_digs, metas) in enumerate(
+            zip(res.cuts, res.digests, want)
+        ):
+            want_cuts = np.asarray(
+                [m.offset + m.size for m in metas], dtype=np.int64
+            )
+            np.testing.assert_array_equal(got_cuts, want_cuts, err_msg=f"stream {i}")
+            assert got_digs == [m.digest for m in metas], f"stream {i}"
+
+    def test_digests_are_real_sha256(self):
+        streams = _corpus(11, [150_000, 80_000])
+        eng = fused_convert.FusedDeviceEngine(chunk_size=CHUNK)
+        res = eng.process_many(streams)
+        for s, cuts, digs in zip(streams, res.cuts, res.digests):
+            prev = 0
+            for cut, d in zip(cuts, digs):
+                assert hashlib.sha256(s[prev:cut]).digest() == d
+                prev = int(cut)
+
+    def test_probe_matches_host_dict(self):
+        streams = _corpus(13, [400_000, 200_000])
+        eng = fused_convert.FusedDeviceEngine(chunk_size=CHUNK)
+        first = eng.process_many(streams)
+        flat = [d for digs in first.digests for d in digs]
+        digests_u32 = np.frombuffer(b"".join(flat), dtype=">u4").astype(
+            np.uint32
+        ).reshape(-1, 8)
+        keys, values = _build_host_tables(digests_u32, 1)
+        depth = _table_max_depth(keys, values)
+        # second corpus: one stream re-used verbatim (all hits), one fresh
+        streams2 = [streams[0], _corpus(17, [300_000])[0]]
+        res = eng.process_many(
+            streams2, chunk_dict=(keys[0], values[0]), depth=depth
+        )
+        assert res.probe is not None
+        n0 = len(res.digests[0])
+        hits = res.probe[:n0]
+        # stream 0 is byte-identical to dict source: every chunk must hit,
+        # and each hit value is the 1-based insertion index
+        assert (hits > 0).all()
+        for d, h in zip(res.digests[0], hits):
+            assert flat[int(h) - 1] == d
+        # fresh random stream: digests absent from the dict must miss
+        fresh_hits = res.probe[n0:]
+        fresh_set = {d for d in res.digests[1]}
+        expected_miss = [d not in set(flat) for d in res.digests[1]]
+        for miss, h in zip(expected_miss, fresh_hits):
+            if miss:
+                assert h == 0
+        assert len(fresh_set) > 0
+
+    def test_empty_and_tiny_batch(self):
+        eng = fused_convert.FusedDeviceEngine(chunk_size=CHUNK)
+        res = eng.process_many([b"", b"x"])
+        assert list(res.cuts[0]) == []
+        assert list(res.cuts[1]) == [1]
+        assert res.digests[1] == [hashlib.sha256(b"x").digest()]
+
+    def test_overflow_raises(self, monkeypatch):
+        # Pathological inputs can exceed the static candidate capacity;
+        # the engine must refuse loudly (callers fall back to the windowed
+        # path) rather than silently truncate candidates — truncation
+        # would yield WRONG cuts. Force the condition by shrinking the cap.
+        monkeypatch.setattr(
+            fused_convert, "_wcap_for", lambda n, bits, floor=1024: 2
+        )
+        eng = fused_convert.FusedDeviceEngine(chunk_size=CHUNK)
+        data = _corpus(23, [1 << 20])[0]
+        with pytest.raises(fused_convert.FusedOverflow):
+            eng.process_many([data])
+
+
+class TestFusedPackLane:
+    def test_pack_layer_byte_identity_vs_hybrid(self):
+        """PackOption(backend="fused") must produce byte-identical layer
+        blobs and bootstraps to the host lane — the cross-lane invariant
+        every other arm holds (tests/test_fast_tar.py)."""
+        import io
+        import tarfile
+
+        from nydus_snapshotter_tpu.converter.convert import pack_layer
+        from nydus_snapshotter_tpu.converter.types import PackOption
+
+        rng = np.random.default_rng(5)
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            for i in range(24):
+                size = int(rng.choice([0, 100, 5000, 80_000, 400_000]))
+                data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+                ti = tarfile.TarInfo(f"d/f{i}")
+                ti.size = size
+                tf.addfile(ti, io.BytesIO(data))
+            ti = tarfile.TarInfo("d/link")
+            ti.type = tarfile.SYMTYPE
+            ti.linkname = "f0"
+            tf.addfile(ti)
+        tar = buf.getvalue()
+
+        for compressor in ("none", "lz4_block"):
+            blob_h, res_h = pack_layer(
+                tar,
+                PackOption(
+                    chunk_size=0x10000, backend="hybrid", compressor=compressor
+                ),
+            )
+            blob_f, res_f = pack_layer(
+                tar,
+                PackOption(
+                    chunk_size=0x10000, backend="fused", compressor=compressor
+                ),
+            )
+            assert blob_h == blob_f, compressor
+            assert res_h.bootstrap == res_f.bootstrap, compressor
+            assert res_h.blob_id == res_f.blob_id, compressor

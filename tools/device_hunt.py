@@ -148,6 +148,18 @@ def run_stages(window_note: str) -> list[dict]:
     stage("b3-64", [sys.executable, drb, "--stage", "b3", "--mib", "64"])
     stage("b3-512", [sys.executable, drb, "--stage", "b3", "--mib", "512"])
     stage("dict-probe", [sys.executable, drb, "--stage", "probe"])
+    # THE composition number (VERDICT r4 #1): full-path convert as the
+    # two-dispatch fused program — gear → compaction → host cut resolve →
+    # gather → sha256 → dict probe, corpus device-generated.
+    stage("fullpath-64", [sys.executable, drb, "--stage", "fullpath", "--mib", "64"])
+    stage("fullpath-512", [sys.executable, drb, "--stage", "fullpath", "--mib", "512"])
+    # 1536 MiB is the largest batch whose padded layout stays inside
+    # int32 device addressing (the fused engine's per-dispatch cap)
+    stage(
+        "fullpath-1536",
+        [sys.executable, drb, "--stage", "fullpath", "--mib", "1536"],
+        timeout=600,
+    )
     stage("gear-xla-64", [sys.executable, drb, "--stage", "gear-xla", "--mib", "64"])
     # tile 2048 hung >420 s in BOTH measured windows — compile-pathological;
     # dropped so it stops burning 420 s of every window. 512 lowered and

@@ -272,6 +272,92 @@ def bench_probe(n_entries: int = 1_000_000, m_queries: int = 262_144):
     }
 
 
+def bench_fullpath(total_mib: int, chunk_kib: int = 1024, with_dict: bool = True):
+    """FULL-PATH convert on device: gear → candidate compaction → host cut
+    resolution → gather → SHA-256 → dict probe (ops/fused_convert, the
+    two-dispatch composition). The corpus buffer is device-generated; only
+    candidate positions (~KBs) and digests (32 B/chunk) cross the tunnel.
+
+    The timed region is the WHOLE step including the host middle and both
+    dispatch floors — this is the number VERDICT r4 asked for (a measured
+    device full-path rate, not isolated kernels). Correctness signal: a
+    dict built from the first run's digests is probed by a second run over
+    the same buffer — every chunk must hit with its own insertion index.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from nydus_snapshotter_tpu.ops import fused_convert, sha256
+    from nydus_snapshotter_tpu.parallel.sharded_dict import (
+        _build_host_tables,
+        _table_max_depth,
+    )
+
+    n = total_mib << 20
+    eng = fused_convert.FusedDeviceEngine(chunk_size=chunk_kib << 10)
+    guard = eng.params.max_size + 64
+    npad = 1 << (n + guard - 1).bit_length()
+    buffers = [_devgen_u8((npad,), 30 + i) for i in range(2)]
+    # synthetic per-file table over the device bytes: a node-ish mix of
+    # file sizes, known host-side without ever downloading the data
+    rng = np.random.default_rng(9)
+    table = []
+    pos = 0
+    while pos < n:
+        size = min(int(rng.choice([4 << 10, 64 << 10, 1 << 20, 16 << 20])), n - pos)
+        table.append((pos, size))
+        pos += size
+
+    def full(buffer_dev, chunk_dict=None, depth=8):
+        cand_s, cand_l = eng.candidates(buffer_dev, n)
+        cuts = eng.resolve(cand_s, cand_l, table)
+        buckets, order = eng.plan_buckets(table, cuts)
+        states, probe = eng.digest_probe(buffer_dev, buckets, chunk_dict, depth)
+        states = [np.asarray(jax.device_get(s)) for s in states]
+        if probe is not None:
+            probe = np.asarray(jax.device_get(probe))
+        return cuts, buckets, order, states, probe
+
+    # warm-up + dict build from run 1's digests
+    cuts, buckets, order, states, _ = full(buffers[0])
+    by_cap = {b.cap_blocks: s for b, s in zip(buckets, states)}
+    digests_u32 = np.concatenate(
+        [by_cap[cap][row][None] for cap, row in order]
+    ).astype(np.uint32)
+    keys, values = _build_host_tables(digests_u32, 1)
+    depth = _table_max_depth(keys, values)
+    chunk_dict = (keys[0], values[0]) if with_dict else None
+
+    best = float("inf")
+    for i in range(4):
+        t = time.perf_counter()
+        _, _, order_i, _, probe = full(
+            buffers[i % 2], chunk_dict=chunk_dict, depth=depth
+        )
+        best = min(best, time.perf_counter() - t)
+    # correctness: buffer 0's chunks must all hit their own dict entries
+    _, buckets0, order0, _, probe0 = full(buffers[0], chunk_dict, depth)
+    base = {}
+    acc = 0
+    for b in buckets0:
+        base[b.cap_blocks] = acc
+        acc += len(b.offsets)
+    hits = np.asarray([probe0[base[c] + r] for c, r in order0])
+    hits_ok = bool((hits == np.arange(1, len(hits) + 1)).all())
+    n_chunks = len(order0)
+    return {
+        "stage": "fullpath-fused",
+        "gibps": round(n / best / (1 << 30), 3),
+        "ms": round(best * 1e3, 2),
+        "shape": [len(table), n_chunks],
+        "chunks": n_chunks,
+        "dict": bool(with_dict),
+        "hits_ok": hits_ok,
+        "backend": jax.default_backend(),
+        "devgen": True,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mib", type=int, default=64)
@@ -303,6 +389,8 @@ def main():
         print(json.dumps(bench_b3(args.mib)), flush=True)
     if args.stage in ("all", "probe"):
         print(json.dumps(bench_probe()), flush=True)
+    if args.stage in ("all", "fullpath"):
+        print(json.dumps(bench_fullpath(args.mib)), flush=True)
 
 
 if __name__ == "__main__":
