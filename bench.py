@@ -654,6 +654,65 @@ def main() -> None:
         ),
     }
 
+    # Uncompressed arm + derived codec economics: the denominator for the
+    # compression scaling argument (docs/COMPRESSION_SCALING.md). The
+    # per-core codec rate is derived from the measured wall deltas on the
+    # ACTUAL corpus (unique post-dedup bytes / extra wall vs "none"), so
+    # each round re-grounds the cores-needed-for-20GiB/s table on the
+    # bench box rather than trusting the doc's frozen numbers.
+    opt_none = PackOption(
+        chunk_size=CHUNK_SIZE, chunking="cdc", compressor="none",
+        **_pack_kwargs(winner),
+    )
+    none_best = None
+    packed_none = None
+    for _ in range(REPS):
+        t0 = time.time()
+        packed_none = _pack_layers(layers, opt_none)
+        dt = time.time() - t0
+        none_best = dt if none_best is None or dt < none_best else none_best
+    uniq_bytes = sum(r.blob_size for _b, r in packed_none)  # raw unique
+    lz4_wall = total_in / max(1e-9, full_gibps * (1 << 30))
+    ncores = os.cpu_count() or 1
+
+    def _codec_rate(wall):
+        # unique bytes compressed during (wall - uncompressed wall);
+        # None when the delta is within noise (a codec wall at or below
+        # the uncompressed wall) rather than an absurd clamped rate
+        extra = wall - none_best
+        if extra <= 0.01 * none_best:
+            return None
+        return uniq_bytes / extra / (1 << 30)
+
+    target = PER_CHIP_TARGET_GIBPS * 8  # 20 GiB/s aggregate
+    uniq_frac = uniq_bytes / max(1, total_in)
+    lz4_rate = _codec_rate(lz4_wall)
+    zstd_rate = _codec_rate(zstd_best)
+    compression_economics = {
+        "uncompressed_full_path_gibps": round(
+            total_in / none_best / (1 << 30), 4
+        ),
+        "unique_fraction_post_dedup": round(uniq_frac, 4),
+        "lz4_gibps_per_core": round(lz4_rate, 4) if lz4_rate else None,
+        "zstd_gibps_per_core": round(zstd_rate, 4) if zstd_rate else None,
+        "cores_for_20gibps_lz4": (
+            round(target * uniq_frac / lz4_rate, 1) if lz4_rate else None
+        ),
+        "cores_for_20gibps_zstd": (
+            round(target * uniq_frac / zstd_rate, 1) if zstd_rate else None
+        ),
+        "refdef_vs_uncompressed": round(
+            reference_defaults_profile["full_path_gibps"]
+            / max(1e-9, total_in / none_best / (1 << 30)),
+            4,
+        ),
+        "overlap_note": (
+            "per-chunk frames are independent; compression scales across "
+            f"cores and pipelines behind chunk+digest — this box has "
+            f"{ncores} core(s), so walls here are fully serialized"
+        ),
+    }
+
     # ---- detail runs ----
     engine_detail = engine_flat_run(bench_engine, probe)
     pool = build_file_pool(min(IMAGE_MIB, 128), seed=555)
@@ -690,6 +749,7 @@ def main() -> None:
                     "accel_profile": accel_profile,
                     "zstd_profile": zstd_profile,
                     "reference_defaults_profile": reference_defaults_profile,
+                    "compression": compression_economics,
                     "baseline_shaped": shaped,
                     "stargz_zran": stargz_zran,
                     "host_cores": os.cpu_count(),

@@ -479,12 +479,25 @@ class _Meta:
 
 
 def _pack_threads() -> int:
-    """Worker count for the pack pipeline (NTPU_PACK_THREADS override)."""
+    """Worker count for the pack pipeline.
+
+    ``NTPU_PACK_THREADS`` requests a count, but it auto-degrades to the
+    core count: threads cannot help beyond the cores that exist, and the
+    pooled pipeline measurably costs 13-23% over the fused single-thread
+    lane when oversubscribed on one core (MULTICORE_r04). Tests that must
+    exercise the threaded lanes regardless (the cross-lane byte-identity
+    gate) set ``NTPU_PACK_THREADS_FORCE=1`` to bypass the clamp.
+    """
     try:
         n = int(os.environ.get("NTPU_PACK_THREADS", ""))
     except ValueError:
         n = 0
-    return n if n >= 1 else (os.cpu_count() or 1)
+    ncpu = os.cpu_count() or 1
+    if n >= 1:
+        if os.environ.get("NTPU_PACK_THREADS_FORCE", "") not in ("", "0"):
+            return n
+        return min(n, ncpu)
+    return ncpu
 
 
 def _tar_num(field: memoryview) -> int:

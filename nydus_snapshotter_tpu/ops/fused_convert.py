@@ -235,8 +235,6 @@ class FusedDeviceEngine:
     the sharded-dict single-shard layout) adds the dedup probe to pass 2.
     """
 
-    MAX_COMPILED_BUFFERS = 1  # quantize buffer length to pow2: O(log) shapes
-
     def __init__(self, chunk_size: int = 0x100000, max_bucket_rows: int = 1 << 14):
         self.params = cdc.CDCParams(chunk_size)
         self.max_bucket_rows = max_bucket_rows
@@ -397,15 +395,15 @@ class FusedDeviceEngine:
             np.frombuffer(s, dtype=np.uint8) if isinstance(s, (bytes, bytearray)) else s
             for s in streams
         ]
-        buf, table = self.layout(arrs)
         n = sum(a.size for a in arrs)
-        buffer_dev = jax.device_put(jnp.asarray(buf))
         if n == 0:
             return FusedResult(
                 cuts=[np.asarray([], dtype=np.int64) for _ in arrs],
                 digests=[[] for _ in arrs],
                 probe=np.zeros(0, np.int32) if chunk_dict is not None else None,
             )
+        buf, table = self.layout(arrs)
+        buffer_dev = jnp.asarray(buf)  # committed to the default device
         cand_s, cand_l = self.candidates(buffer_dev, n)
         cuts = self.resolve(cand_s, cand_l, table)
         buckets, order = self.plan_buckets(table, cuts)

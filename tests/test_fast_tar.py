@@ -132,6 +132,7 @@ def test_parallel_pack_bytes_identical(monkeypatch):
     monkeypatch.setenv("NTPU_PACK_THREADS", "1")
     blob_serial, res_serial = pack_layer(raw, opt)
     monkeypatch.setenv("NTPU_PACK_THREADS", "8")
+    monkeypatch.setenv("NTPU_PACK_THREADS_FORCE", "1")
     blob_par, _ = pack_layer(raw, opt)
     assert blob_par == blob_serial
 
@@ -143,6 +144,7 @@ def test_parallel_pack_bytes_identical(monkeypatch):
     monkeypatch.setenv("NTPU_PACK_THREADS", "1")
     blob_d_serial, _ = pack_layer(raw, opt, chunk_dict=cdict)
     monkeypatch.setenv("NTPU_PACK_THREADS", "8")
+    monkeypatch.setenv("NTPU_PACK_THREADS_FORCE", "1")
     blob_d_par, _ = pack_layer(raw, opt, chunk_dict=cdict)
     assert blob_d_par == blob_d_serial
     assert len(blob_d_serial) < len(blob_serial)  # dedup actually engaged
@@ -152,6 +154,7 @@ def test_parallel_pack_bytes_identical(monkeypatch):
     monkeypatch.setenv("NTPU_PACK_THREADS", "1")
     blob_z_serial, _ = pack_layer(raw, zopt)
     monkeypatch.setenv("NTPU_PACK_THREADS", "8")
+    monkeypatch.setenv("NTPU_PACK_THREADS_FORCE", "1")
     blob_z_par, _ = pack_layer(raw, zopt)
     assert blob_z_par == blob_z_serial
 

