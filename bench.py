@@ -452,6 +452,154 @@ def dedup_shaped_run(opt, pool: list[bytes]) -> dict:
     }
 
 
+def _manifest_files(gen_of) -> list:
+    """Materialize the committed REAL Ubuntu manifest as tar members.
+
+    misc/fixtures/ubuntu_v6_manifest.json.gz carries the real fixture's
+    tree (paths, modes, sizes, symlink targets — extracted by
+    tools/extract_real_manifest.py from the reference's v6 bootstrap of a
+    real rootfs). File CONTENT is synthesized deterministically per
+    (path, generation): bumping a file's generation models a changed file
+    in an upgraded image while every other byte stays identical.
+    """
+    import gzip
+    import hashlib
+    import json
+    import stat as statmod
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "misc", "fixtures", "ubuntu_v6_manifest.json.gz",
+    )
+    with gzip.open(path, "rb") as f:
+        manifest = json.load(f)
+
+    members = []
+    for e in manifest["entries"]:
+        p = e["path"].lstrip("/")
+        if not p:
+            continue
+        mode = e["mode"]
+        if statmod.S_ISDIR(mode):
+            members.append((p, mode, None, e.get("symlink")))
+        elif statmod.S_ISLNK(mode):
+            members.append((p, mode, None, e["symlink"]))
+        elif statmod.S_ISREG(mode):
+            seed = int.from_bytes(
+                hashlib.sha256(
+                    f"{e['path']}:{gen_of(e['path'])}".encode()
+                ).digest()[:8],
+                "little",
+            )
+            rng = np.random.default_rng(seed)
+            size = e["size"]
+            if seed % 5 < 3:  # text-ish: low-entropy, compressible
+                base = rng.integers(32, 127, max(1, size // 6 + 1), dtype=np.uint8)
+                data = np.tile(base, 7)[:size].tobytes()
+            else:  # binary: high-entropy
+                data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+            members.append((p, mode, data, None))
+    return members
+
+
+def _members_to_tar(members) -> bytes:
+    import io
+    import tarfile
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+        for p, mode, data, link in members:
+            ti = tarfile.TarInfo(p)
+            ti.mode = mode & 0o7777
+            if data is None and link is not None:
+                ti.type = tarfile.SYMTYPE
+                ti.linkname = link
+                tf.addfile(ti)
+            elif data is None:
+                ti.type = tarfile.DIRTYPE
+                tf.addfile(ti)
+            else:
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+    return buf.getvalue()
+
+
+def real_image_run(opt) -> dict:
+    """BASELINE configs #1/#2 on a REAL image shape (VERDICT r4 next #6).
+
+    Image A = the real Ubuntu rootfs tree (single layer, as the real
+    ubuntu base image ships). Its merged bootstrap is re-emitted in the
+    REAL nydus v6 on-disk layout (models/nydus_real_write) and loaded
+    back through the real-bootstrap parser as the chunk dict — the same
+    round trip `--chunk-dict bootstrap=<real image>` takes. Image B = the
+    upgraded rootfs (~25% of files changed) converted against that dict;
+    the dedup ratio counts B's bytes resolved into A's blobs.
+    """
+    from nydus_snapshotter_tpu.converter.convert import (
+        Merge,
+        bootstrap_from_layer_blob,
+        pack_layer,
+    )
+    from nydus_snapshotter_tpu.converter.types import MergeOption
+    from nydus_snapshotter_tpu.models.bootstrap import Bootstrap, ChunkDict
+    from nydus_snapshotter_tpu.models.nydus_real import load_any_bootstrap
+    from nydus_snapshotter_tpu.models.nydus_real_write import (
+        real_from_bootstrap,
+        write_real_v6,
+    )
+
+    # RAFS v6's on-disk chunk index is a fixed grid, so REAL v6 images are
+    # fixed-chunked (the nydus default; the fixture uses 1 MiB). Pack both
+    # images fixed so the real-layout round trip is valid and B's chunk
+    # digests can actually hit A's grid.
+    from dataclasses import replace
+
+    ropt = replace(opt, chunking="fixed")
+    members_a = _manifest_files(lambda p: 0)
+    tar_a = _members_to_tar(members_a)
+    t0 = time.time()
+    blob_a, res_a = pack_layer(tar_a, ropt)
+    t_a = time.time() - t0
+    merged = Merge([blob_a], MergeOption(with_tar=False))
+    # real-layout round trip: our merged bootstrap -> REAL v6 bytes ->
+    # real parser -> chunk dict (what the reference hands nydus-image)
+    real_v6 = write_real_v6(
+        real_from_bootstrap(Bootstrap.from_bytes(merged.bootstrap))
+    )
+    cdict = ChunkDict(load_any_bootstrap(real_v6))
+
+    def gen_b(p):  # ~25% of files changed: an apt-upgrade-sized delta
+        import hashlib as h
+
+        return 1 if h.sha256(p.encode()).digest()[0] < 64 else 0
+
+    tar_b = _members_to_tar(_manifest_files(gen_b))
+    t1 = time.time()
+    blob_b, res_b = pack_layer(tar_b, ropt, chunk_dict=cdict)
+    t_b = time.time() - t1
+
+    bs_b = bootstrap_from_layer_blob(blob_b)
+    own = {res_b.blob_id}
+    dedup_bytes = sum(
+        c.uncompressed_size
+        for c in bs_b.chunks
+        if bs_b.blobs[c.blob_index].blob_id not in own
+    )
+    total_chunk_bytes = sum(c.uncompressed_size for c in bs_b.chunks)
+    return {
+        "source": "real ubuntu rootfs tree (committed manifest of the "
+        "reference's v6 fixture; content synthesized per file)",
+        "inodes": len(members_a),
+        "image_mib": round(len(tar_a) / (1 << 20), 1),
+        "convert_gibps": round(len(tar_a) / t_a / (1 << 30), 4),
+        "dict_source": "REAL v6 layout round trip (write_real_v6 -> "
+        "load_any_bootstrap)",
+        "dict_chunks": len(cdict),
+        "convert_vs_real_dict_gibps": round(len(tar_b) / t_b / (1 << 30), 4),
+        "dedup_ratio": round(dedup_bytes / max(1, total_chunk_bytes), 4),
+    }
+
+
 def stargz_zran_run(opt) -> dict:
     """BASELINE config #4 shape: eStargz index build + OCI-zran (targz-ref)
     conversion of a python:3.12-like compressible layer. Reports MiB/s of
@@ -672,22 +820,42 @@ def main() -> None:
         dt = time.time() - t0
         none_best = dt if none_best is None or dt < none_best else none_best
     uniq_bytes = sum(r.blob_size for _b, r in packed_none)  # raw unique
-    lz4_wall = total_in / max(1e-9, full_gibps * (1 << 30))
     ncores = os.cpu_count() or 1
+
+    # Per-core codec rates need SERIAL walls: _pack_layers runs layers on
+    # a thread pool, so on a multi-core box its wall deltas would reflect
+    # N cores compressing concurrently and overstate the per-core rate.
+    def _serial_wall(o):
+        best = None
+        for _ in range(REPS):
+            t0 = time.time()
+            for t in layers:
+                pack_layer_fn(t, o)
+            dt = time.time() - t0
+            best = dt if best is None or dt < best else best
+        return best
+
+    from nydus_snapshotter_tpu.converter.convert import (
+        pack_layer as pack_layer_fn,
+    )
+
+    none_serial = _serial_wall(opt_none)
+    lz4_serial = _serial_wall(opt)
+    zstd_serial = _serial_wall(opt_zstd)
 
     def _codec_rate(wall):
         # unique bytes compressed during (wall - uncompressed wall);
         # None when the delta is within noise (a codec wall at or below
         # the uncompressed wall) rather than an absurd clamped rate
-        extra = wall - none_best
-        if extra <= 0.01 * none_best:
+        extra = wall - none_serial
+        if extra <= 0.01 * none_serial:
             return None
         return uniq_bytes / extra / (1 << 30)
 
     target = PER_CHIP_TARGET_GIBPS * 8  # 20 GiB/s aggregate
     uniq_frac = uniq_bytes / max(1, total_in)
-    lz4_rate = _codec_rate(lz4_wall)
-    zstd_rate = _codec_rate(zstd_best)
+    lz4_rate = _codec_rate(lz4_serial)
+    zstd_rate = _codec_rate(zstd_serial)
     compression_economics = {
         "uncompressed_full_path_gibps": round(
             total_in / none_best / (1 << 30), 4
@@ -718,6 +886,7 @@ def main() -> None:
     pool = build_file_pool(min(IMAGE_MIB, 128), seed=555)
     shaped = dedup_shaped_run(opt, pool)
     stargz_zran = stargz_zran_run(opt)
+    real_image = real_image_run(opt)
 
     print(
         json.dumps(
@@ -751,6 +920,7 @@ def main() -> None:
                     "reference_defaults_profile": reference_defaults_profile,
                     "compression": compression_economics,
                     "baseline_shaped": shaped,
+                    "real_image": real_image,
                     "stargz_zran": stargz_zran,
                     "host_cores": os.cpu_count(),
                 },

@@ -91,7 +91,7 @@ def main() -> None:
     forced_wall, forced_digest = _run(args.mib, 4, force=True)
 
     rec = {
-        "artifact": "MULTICORE_r05",
+        "artifact": os.path.splitext(os.path.basename(args.out))[0],
         "purpose": (
             "VERDICT r4 next #8: thread requests auto-degrade to the core "
             "count (converter/stream._pack_threads), so oversubscription "
