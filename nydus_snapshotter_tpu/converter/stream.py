@@ -1029,11 +1029,12 @@ def pack_stream(
             and opt.backend == "fused"
             and params is not None
             and opt.chunking == "cdc"
-            and opt.digester == "sha256"
         ):
             from nydus_snapshotter_tpu.ops import fused_convert
 
-            feng = fused_convert.FusedDeviceEngine(chunk_size=opt.chunk_size)
+            feng = fused_convert.FusedDeviceEngine(
+                chunk_size=opt.chunk_size, digester=opt.digester
+            )
             streams = [arr_all[off : off + size] for _t, _m, off, size in plan]
             _tc = _pc()
             try:

@@ -533,7 +533,7 @@ class ChunkDigestEngine:
             np.frombuffer(s, dtype=np.uint8) if isinstance(s, (bytes, bytearray)) else s
             for s in streams
         ]
-        if self.backend == "fused" and self.mode == "cdc" and self.digester == "sha256":
+        if self.backend == "fused" and self.mode == "cdc":
             out = self._process_many_device_fused(arrs)
             if out is not None:
                 return out
@@ -581,7 +581,9 @@ class ChunkDigestEngine:
         so process_many falls through to the windowed device path."""
         from nydus_snapshotter_tpu.ops import fused_convert
 
-        eng = fused_convert.FusedDeviceEngine(chunk_size=self.chunk_size)
+        eng = fused_convert.FusedDeviceEngine(
+            chunk_size=self.chunk_size, digester=self.digester
+        )
         try:
             res = eng.process_many(arrs)
         except fused_convert.FusedOverflow:

@@ -185,7 +185,33 @@ def _gather_pack_sha(buffer: jax.Array, offs: jax.Array, sizes: jax.Array, cap_b
     return blocks
 
 
-@functools.partial(jax.jit, static_argnames=("caps", "table_cap", "depth"))
+def _gather_pack_b3(buffer: jax.Array, offs: jax.Array, sizes: jax.Array, cap_leaves: int):
+    """Gather chunks into the blake3 batch layout u32[M, C, 16, 16].
+
+    Simpler than the SHA pack: zero beyond the message and build
+    LITTLE-endian words (blake3's byte order); lengths drive the in-kernel
+    flag/tail handling, so no padding bytes or length words are embedded.
+    """
+    from nydus_snapshotter_tpu.ops import blake3_jax
+
+    capb = cap_leaves * blake3_jax.LEAF_BYTES
+    byte_iota = jnp.arange(capb, dtype=jnp.int32)
+
+    def step(carry, xs):
+        off, size = xs
+        raw = jax.lax.dynamic_slice(buffer, (off,), (capb,))
+        b = jnp.where(byte_iota < size, raw, jnp.uint8(0))
+        w = b.reshape(-1, 4).astype(jnp.uint32)
+        words = w[:, 0] | (w[:, 1] << 8) | (w[:, 2] << 16) | (w[:, 3] << 24)
+        return carry, words.reshape(cap_leaves, 16, 16)
+
+    _, blocks = jax.lax.scan(step, 0, (offs, sizes))
+    return blocks
+
+
+@functools.partial(
+    jax.jit, static_argnames=("caps", "table_cap", "depth", "digester")
+)
 def _pass2(
     buffer: jax.Array,
     bucket_offs: tuple[jax.Array, ...],
@@ -195,14 +221,26 @@ def _pass2(
     table_vals: jax.Array | None = None,  # i32[C]
     table_cap: int = 0,
     depth: int = 0,
+    digester: str = "sha256",
 ):
-    """-> (tuple of u32[M_i, 8] digest states, i32[sum M_i] probe or None)."""
+    """-> (tuple of u32[M_i, 8] digest states, i32[sum M_i] probe or None).
+
+    Digest states are u32 words in the digester's natural order (big-
+    endian words for sha256, little-endian for blake3); chunk-dict keys
+    must be built with the same convention.
+    """
+    unroll = jax.default_backend() != "cpu"
     states = []
     for offs, sizes, cap in zip(bucket_offs, bucket_sizes, caps):
-        blocks = _gather_pack_sha(buffer, offs, sizes, cap)
-        counts = (sizes + 8) // 64 + 1
-        unroll = jax.default_backend() != "cpu"
-        states.append(sha256._sha256_batch_jit(blocks, counts, unroll))
+        if digester == "blake3":
+            from nydus_snapshotter_tpu.ops import blake3_jax
+
+            blocks = _gather_pack_b3(buffer, offs, sizes, cap)
+            states.append(blake3_jax._blake3_batch_jit(blocks, sizes, unroll))
+        else:
+            blocks = _gather_pack_sha(buffer, offs, sizes, cap)
+            counts = (sizes + 8) // 64 + 1
+            states.append(sha256._sha256_batch_jit(blocks, counts, unroll))
     probe = None
     if table_keys is not None:
         from nydus_snapshotter_tpu.parallel.sharded_dict import _probe_local
@@ -235,9 +273,26 @@ class FusedDeviceEngine:
     the sharded-dict single-shard layout) adds the dedup probe to pass 2.
     """
 
-    def __init__(self, chunk_size: int = 0x100000, max_bucket_rows: int = 1 << 14):
+    def __init__(
+        self,
+        chunk_size: int = 0x100000,
+        max_bucket_rows: int = 1 << 14,
+        digester: str = "sha256",
+    ):
+        if digester not in ("sha256", "blake3"):
+            raise ValueError(f"unknown digester {digester!r}")
         self.params = cdc.CDCParams(chunk_size)
         self.max_bucket_rows = max_bucket_rows
+        self.digester = digester
+
+    def _blocks_of(self, size: int) -> int:
+        """Digest-layout capacity units of one chunk (SHA 64-B blocks or
+        blake3 leaves) — the bucket-class axis."""
+        if self.digester == "blake3":
+            from nydus_snapshotter_tpu.ops import blake3_jax
+
+            return blake3_jax.n_leaves(size)
+        return sha256.n_padded_blocks(size)
 
     # -- planning ------------------------------------------------------------
 
@@ -311,14 +366,14 @@ class FusedDeviceEngine:
         (bucket, row) assignments per chunk in stream order, used to
         scatter results back.
         """
-        max_blocks = sha256.n_padded_blocks(self.params.max_size)
+        max_blocks = self._blocks_of(self.params.max_size)
         per_class: dict[int, list[tuple[int, int]]] = {}
         order: list[tuple[int, int]] = []
         for (f_off, _f_len), f_cuts in zip(table, cuts):
             prev = 0
             for cut in f_cuts:
                 size = int(cut) - prev
-                nb = sha256.n_padded_blocks(size)
+                nb = self._blocks_of(size)
                 cap = min(_pow2_ceil(nb), max_blocks)
                 rows = per_class.setdefault(cap, [])
                 order.append((cap, len(rows)))
@@ -381,9 +436,17 @@ class FusedDeviceEngine:
             table_cap = keys.shape[0]
             tk, tv = jnp.asarray(keys), jnp.asarray(vals)
         states, probe = _pass2(
-            buffer_dev, offs, sizes, caps, tk, tv, table_cap, depth
+            buffer_dev, offs, sizes, caps, tk, tv, table_cap, depth,
+            digester=self.digester,
         )
         return states, probe
+
+    def _digest_bytes(self, state_row: np.ndarray) -> bytes:
+        if self.digester == "blake3":
+            from nydus_snapshotter_tpu.ops import blake3_jax
+
+            return blake3_jax.digest_to_bytes(state_row)
+        return sha256.digest_to_bytes(state_row)
 
     def process_many(
         self,
@@ -413,7 +476,7 @@ class FusedDeviceEngine:
             for b, s in zip(buckets, states)
         }
         flat_digests = [
-            sha256.digest_to_bytes(by_cap[cap][row]) for cap, row in order
+            self._digest_bytes(by_cap[cap][row]) for cap, row in order
         ]
         probe_np = None
         if probe is not None:

@@ -163,3 +163,51 @@ class TestFusedPackLane:
             assert blob_h == blob_f, compressor
             assert res_h.bootstrap == res_f.bootstrap, compressor
             assert res_h.blob_id == res_f.blob_id, compressor
+
+
+class TestFusedBlake3:
+    def test_blake3_digests_match_spec(self):
+        """blake3 fused lane: device-gathered digests must equal the
+        pure-Python spec implementation over the same cuts."""
+        from nydus_snapshotter_tpu.utils import blake3 as pyb3
+
+        streams = _corpus(31, [3, 2000, 150_000, 70_000, 1_048_577])
+        eng = fused_convert.FusedDeviceEngine(chunk_size=CHUNK, digester="blake3")
+        res = eng.process_many(streams)
+        # cuts are digester-independent: same oracle as sha256
+        oracle = ChunkDigestEngine(
+            chunk_size=CHUNK, backend="numpy", digest_backend="numpy"
+        )
+        want = oracle.process_many(streams)
+        for i, (cuts, metas) in enumerate(zip(res.cuts, want)):
+            np.testing.assert_array_equal(
+                cuts, [m.offset + m.size for m in metas], err_msg=f"stream {i}"
+            )
+        for s, cuts, digs in zip(streams, res.cuts, res.digests):
+            prev = 0
+            for cut, d in zip(cuts, digs):
+                assert pyb3.blake3(s[prev:cut]) == d
+                prev = int(cut)
+
+    def test_pack_layer_blake3_byte_identity_vs_hybrid(self):
+        import io
+        import tarfile
+
+        from nydus_snapshotter_tpu.converter.convert import pack_layer
+        from nydus_snapshotter_tpu.converter.types import PackOption
+
+        rng = np.random.default_rng(37)
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            for i in range(12):
+                size = int(rng.choice([90, 6000, 120_000]))
+                data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+                ti = tarfile.TarInfo(f"x/f{i}")
+                ti.size = size
+                tf.addfile(ti, io.BytesIO(data))
+        tar = buf.getvalue()
+        kw = dict(chunk_size=0x10000, digester="blake3", compressor="zstd")
+        blob_h, res_h = pack_layer(tar, PackOption(backend="hybrid", **kw))
+        blob_f, res_f = pack_layer(tar, PackOption(backend="fused", **kw))
+        assert blob_h == blob_f
+        assert res_h.bootstrap == res_f.bootstrap
