@@ -691,3 +691,59 @@ class TestOciFallbackStart:
             fs.teardown()
             sn.close()
             mgr.stop()
+
+
+class TestMultipleImagesSharedDaemon:
+    def test_three_images_one_daemon(self, tmp_path):
+        """entrypoint.sh:252 start_multiple_containers_shared_daemon:
+        three DIFFERENT images under one shared daemon — a single daemon
+        pid serves all three RAFS instances (validate_mnt_number analog:
+        instance count == images, daemon count == 1), every image reads,
+        and removing all containers+chains drains the instances while the
+        shared daemon stays up for the next image."""
+        cfg = _mk_cfg(tmp_path)
+        db, mgr, fs, sn, server, client, sock = _mk_stack(
+            cfg, daemon_mode=C.DAEMON_MODE_SHARED
+        )
+        names = ("java", "wordpress", "tomcat")
+        try:
+            keys = {}
+            for name in names:
+                sub = tmp_path / name
+                sub.mkdir()
+                boot, blob_dir, files = _build_image(sub)
+                ctr_key, chain, _m = _pull_and_run(
+                    client, sn, fs, boot, blob_dir, name=name
+                )
+                keys[name] = (ctr_key, chain)
+            daemons = list(mgr.list_daemons())
+            assert len(daemons) == 1  # ONE shared daemon
+            shared = fs.get_shared_daemon(C.FS_DRIVER_FUSEDEV)
+            assert daemons[0].id == shared.id
+            instances = fs.instances.list()
+            assert len(instances) == len(names)  # validate_mnt_number
+            for rafs in instances:
+                assert rafs.daemon_id == shared.id
+                got = shared.client().read_file(
+                    f"/{rafs.snapshot_id}", "/app/hello.txt"
+                )
+                assert got == b"hello from rafs\n"
+
+            for name in names:
+                ctr_key, chain = keys[name]
+                client.remove(ctr_key)
+                client.remove(chain)
+            client.cleanup()
+            deadline = time.time() + 15
+            while fs.instances.list() and time.time() < deadline:
+                time.sleep(0.2)
+            assert not fs.instances.list()
+            # shared daemon survives an empty instance set (the reference
+            # keeps it for the next pull)
+            assert fs.get_shared_daemon(C.FS_DRIVER_FUSEDEV) is not None
+        finally:
+            client.close()
+            server.stop(grace=None)
+            fs.teardown()
+            sn.close()
+            mgr.stop()
