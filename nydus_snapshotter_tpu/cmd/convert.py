@@ -331,6 +331,16 @@ def cmd_export_erofs(args) -> int:
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="ntpu-convert", description=__doc__)
+    # Pin the JAX platform BEFORE any device backend initializes: env
+    # JAX_PLATFORMS can be overridden by site hooks, and on a host whose
+    # accelerator transport is down a default-platform init can hang the
+    # whole CLI. "cpu" makes the jax/fused backends run host-side.
+    p.add_argument(
+        "--jax-platform",
+        default="",
+        choices=("", "cpu", "tpu"),
+        help="force the JAX platform (default: environment's)",
+    )
     sub = p.add_subparsers(dest="cmd", required=True)
 
     def common(sp, dict_opt=True):
@@ -343,7 +353,7 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--chunk-size", type=lambda v: int(v, 0), default=0x100000)
         sp.add_argument("--batch-size", type=lambda v: int(v, 0), default=0)
         sp.add_argument("--backend", default="hybrid",
-                        choices=("jax", "numpy", "hybrid"))
+                        choices=("jax", "numpy", "hybrid", "fused"))
         sp.add_argument("--chunking", default="cdc", choices=("cdc", "fixed"))
         sp.add_argument("--digester", default="sha256",
                         choices=("sha256", "blake3"),
@@ -429,6 +439,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.jax_platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.jax_platform)
     try:
         return args.fn(args)
     except Exception as e:  # noqa: BLE001 — subprocess contract: 1 line, rc 1
