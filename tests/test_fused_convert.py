@@ -274,3 +274,34 @@ class TestFusedRandomizedSoak:
         )
         assert blob_f == blob_h
         assert res_f.bootstrap == res_h.bootstrap
+
+    def test_streaming_pack_fused_backend_identical(self):
+        """File-like (streaming) Pack with backend='fused': the fused
+        batch lane only serves the in-memory walk, so the streaming path
+        must fall back to the engine's windowed boundaries and still
+        produce the byte-identical blob."""
+        import io
+        import tarfile
+
+        from nydus_snapshotter_tpu.converter.convert import Pack
+        from nydus_snapshotter_tpu.converter.types import PackOption
+
+        rng = np.random.default_rng(43)
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            for i in range(5):
+                data = rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+                ti = tarfile.TarInfo(f"s/f{i}")
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        tar = buf.getvalue()
+
+        def pack_with(backend, source):
+            out = io.BytesIO()
+            res = Pack(out, source, PackOption(chunk_size=CHUNK, backend=backend))
+            return out.getvalue(), res
+
+        mem_blob, _ = pack_with("fused", tar)
+        stream_blob, _ = pack_with("fused", io.BytesIO(tar))
+        hybrid_blob, _ = pack_with("hybrid", io.BytesIO(tar))
+        assert mem_blob == stream_blob == hybrid_blob
