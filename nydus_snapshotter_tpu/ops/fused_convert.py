@@ -210,18 +210,23 @@ def _gather_pack_b3(buffer: jax.Array, offs: jax.Array, sizes: jax.Array, cap_le
 
 
 @functools.partial(
-    jax.jit, static_argnames=("caps", "table_cap", "depth", "digester")
+    jax.jit,
+    static_argnames=(
+        "caps", "table_cap", "depth", "digester", "pallas_probe", "probe_interpret"
+    ),
 )
 def _pass2(
     buffer: jax.Array,
     bucket_offs: tuple[jax.Array, ...],
     bucket_sizes: tuple[jax.Array, ...],
     caps: tuple[int, ...],
-    table_keys: jax.Array | None = None,  # u32[C, 8]
-    table_vals: jax.Array | None = None,  # i32[C]
+    table_keys: jax.Array | None = None,  # u32[C,8] (or u32[C+W,8] padded)
+    table_vals: jax.Array | None = None,  # i32[C]   (or i32[C+W,1] padded)
     table_cap: int = 0,
     depth: int = 0,
     digester: str = "sha256",
+    pallas_probe: bool = False,
+    probe_interpret: bool = False,
 ):
     """-> (tuple of u32[M_i, 8] digest states, i32[sum M_i] probe or None).
 
@@ -243,10 +248,24 @@ def _pass2(
             states.append(sha256._sha256_batch_jit(blocks, counts, unroll))
     probe = None
     if table_keys is not None:
-        from nydus_snapshotter_tpu.parallel.sharded_dict import _probe_local
-
         allq = jnp.concatenate(states, axis=0)
-        probe = _probe_local(table_keys, table_vals, allq, table_cap, depth)
+        if pallas_probe:
+            # DMA-pipelined Pallas probe (ops/probe_pallas): the XLA
+            # gather formulation runs effectively element-serially on
+            # TPU (~1 µs/element) — at full-batch chunk counts it would
+            # dominate the dispatch. Tables arrive pre-padded wrap-free.
+            from nydus_snapshotter_tpu.ops import probe_pallas
+
+            slot0 = (allq[:, 1] & jnp.uint32(table_cap - 1)).astype(jnp.int32)
+            wstart = slot0 & ~jnp.int32(7)
+            probe = probe_pallas.probe_padded(
+                table_keys, table_vals, allq, wstart, slot0 - wstart,
+                depth, interpret=probe_interpret,
+            )
+        else:
+            from nydus_snapshotter_tpu.parallel.sharded_dict import _probe_local
+
+            probe = _probe_local(table_keys, table_vals, allq, table_cap, depth)
     return tuple(states), probe
 
 
@@ -424,20 +443,39 @@ class FusedDeviceEngine:
         buckets: list[Bucket],
         chunk_dict: tuple[np.ndarray, np.ndarray] | None = None,
         depth: int = 8,
+        probe_kernel: str = "auto",  # "auto" | "xla" | "pallas" | "pallas-interpret"
     ):
-        """Pass 2: per-bucket digest states + optional dict probe."""
+        """Pass 2: per-bucket digest states + optional dict probe.
+
+        ``probe_kernel``: auto = the DMA-pipelined Pallas probe on real
+        TPU, the XLA gather elsewhere; "pallas-interpret" forces the
+        Pallas lowering in interpret mode (CPU differential tests).
+        """
         offs = tuple(jnp.asarray(b.offsets) for b in buckets)
         sizes = tuple(jnp.asarray(b.sizes) for b in buckets)
         caps = tuple(b.cap_blocks for b in buckets)
         tk = tv = None
         table_cap = 0
+        use_pallas = probe_interpret = False
         if chunk_dict is not None:
             keys, vals = chunk_dict
             table_cap = keys.shape[0]
-            tk, tv = jnp.asarray(keys), jnp.asarray(vals)
+            if probe_kernel == "auto":
+                use_pallas = jax.default_backend() == "tpu"
+            elif probe_kernel in ("pallas", "pallas-interpret"):
+                use_pallas = True
+                probe_interpret = probe_kernel == "pallas-interpret"
+            if use_pallas:
+                from nydus_snapshotter_tpu.ops import probe_pallas
+
+                keys_pad, vals_pad = probe_pallas.pad_tables(keys, vals, depth)
+                tk, tv = jnp.asarray(keys_pad), jnp.asarray(vals_pad)
+            else:
+                tk, tv = jnp.asarray(keys), jnp.asarray(vals)
         states, probe = _pass2(
             buffer_dev, offs, sizes, caps, tk, tv, table_cap, depth,
-            digester=self.digester,
+            digester=self.digester, pallas_probe=use_pallas,
+            probe_interpret=probe_interpret,
         )
         return states, probe
 
@@ -453,6 +491,7 @@ class FusedDeviceEngine:
         streams: list[bytes | np.ndarray],
         chunk_dict: tuple[np.ndarray, np.ndarray] | None = None,
         depth: int = 8,
+        probe_kernel: str = "auto",
     ) -> FusedResult:
         arrs = [
             np.frombuffer(s, dtype=np.uint8) if isinstance(s, (bytes, bytearray)) else s
@@ -470,7 +509,9 @@ class FusedDeviceEngine:
         cand_s, cand_l = self.candidates(buffer_dev, n)
         cuts = self.resolve(cand_s, cand_l, table)
         buckets, order = self.plan_buckets(table, cuts)
-        states, probe = self.digest_probe(buffer_dev, buckets, chunk_dict, depth)
+        states, probe = self.digest_probe(
+            buffer_dev, buckets, chunk_dict, depth, probe_kernel
+        )
         by_cap = {
             b.cap_blocks: np.asarray(jax.device_get(s))
             for b, s in zip(buckets, states)

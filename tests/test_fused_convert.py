@@ -305,3 +305,28 @@ class TestFusedRandomizedSoak:
         stream_blob, _ = pack_with("fused", io.BytesIO(tar))
         hybrid_blob, _ = pack_with("hybrid", io.BytesIO(tar))
         assert mem_blob == stream_blob == hybrid_blob
+
+    def test_pallas_probe_interpret_matches_xla(self):
+        """The Pallas DMA-probe lane of pass 2 (used on real TPU) must
+        agree with the XLA gather formulation — driven in interpret mode
+        on CPU, same discipline as tests/test_probe_pallas.py."""
+        streams = _corpus(53, [250_000, 120_000])
+        eng = fused_convert.FusedDeviceEngine(chunk_size=CHUNK)
+        first = eng.process_many(streams)
+        flat = [d for digs in first.digests for d in digs]
+        digests_u32 = (
+            np.frombuffer(b"".join(flat), dtype=">u4").astype(np.uint32).reshape(-1, 8)
+        )
+        keys, values = _build_host_tables(digests_u32, 1)
+        depth = _table_max_depth(keys, values)
+        streams2 = [streams[0], _corpus(59, [90_000])[0]]
+        res_xla = eng.process_many(
+            streams2, chunk_dict=(keys[0], values[0]), depth=depth,
+            probe_kernel="xla",
+        )
+        res_pl = eng.process_many(
+            streams2, chunk_dict=(keys[0], values[0]), depth=depth,
+            probe_kernel="pallas-interpret",
+        )
+        np.testing.assert_array_equal(res_pl.probe, res_xla.probe)
+        assert (res_pl.probe[: len(res_pl.digests[0])] > 0).all()
