@@ -458,18 +458,19 @@ class FusedDeviceEngine:
         table_cap = 0
         use_pallas = probe_interpret = False
         if chunk_dict is not None:
+            from nydus_snapshotter_tpu.ops import probe_pallas
+
+            if probe_kernel not in ("auto", "xla", "pallas", "pallas-interpret"):
+                raise ValueError(f"unknown probe kernel {probe_kernel!r}")
             keys, vals = chunk_dict
             table_cap = keys.shape[0]
             if probe_kernel == "auto":
-                use_pallas = jax.default_backend() == "tpu"
-            elif probe_kernel in ("pallas", "pallas-interpret"):
+                use_pallas = probe_pallas.supported()
+            elif probe_kernel != "xla":
                 use_pallas = True
                 probe_interpret = probe_kernel == "pallas-interpret"
             if use_pallas:
-                from nydus_snapshotter_tpu.ops import probe_pallas
-
-                keys_pad, vals_pad = probe_pallas.pad_tables(keys, vals, depth)
-                tk, tv = jnp.asarray(keys_pad), jnp.asarray(vals_pad)
+                tk, tv = self._padded_tables(keys, vals, depth)
             else:
                 tk, tv = jnp.asarray(keys), jnp.asarray(vals)
         states, probe = _pass2(
@@ -478,6 +479,26 @@ class FusedDeviceEngine:
             probe_interpret=probe_interpret,
         )
         return states, probe
+
+    def _padded_tables(self, keys: np.ndarray, vals: np.ndarray, depth: int):
+        """Wrap-free padded device tables for the Pallas probe, cached per
+        (dict identity, depth) — padding copies tens of MB for million-
+        entry dicts and repeated digest_probe calls (the bench loop) must
+        not pay it, or the H2D re-upload, per dispatch."""
+        from nydus_snapshotter_tpu.ops import probe_pallas
+
+        cached = getattr(self, "_table_cache", None)
+        if (
+            cached is not None
+            and cached[0] is keys  # identity: the cache keeps them alive,
+            and cached[1] is vals  # so `is` cannot alias freed objects
+            and cached[2] == depth
+        ):
+            return cached[3], cached[4]
+        keys_pad, vals_pad = probe_pallas.pad_tables(keys, vals, depth)
+        tk, tv = jnp.asarray(keys_pad), jnp.asarray(vals_pad)
+        self._table_cache = (keys, vals, depth, tk, tv)
+        return tk, tv
 
     def _digest_bytes(self, state_row: np.ndarray) -> bytes:
         if self.digester == "blake3":
