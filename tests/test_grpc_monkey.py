@@ -161,7 +161,8 @@ class TestGrpcMonkey:
             sn.close()
             mgr.stop()
 
-    def test_nydus_image_lifecycle_walk(self, tmp_path):
+    @pytest.mark.parametrize("monkey_seed", [99, 7, 23])
+    def test_nydus_image_lifecycle_walk(self, tmp_path, monkey_seed):
         """Randomized NYDUS flows: image pulls (extract→commit meta chain),
         container creates on random images, daemon reads after every
         create, container/image removals, cleanup — the shared daemon's
@@ -180,7 +181,7 @@ class TestGrpcMonkey:
 
         cfg = _mk_cfg(tmp_path)
         db, mgr, fs, sn, server, client, sock = _mk_stack(cfg)
-        rng = random.Random(99)
+        rng = random.Random(monkey_seed)
         # images[name] = (chain, file bytes); containers[key] = image name
         images: dict[str, tuple[str, bytes]] = {}
         containers: dict[str, str] = {}
