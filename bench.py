@@ -352,8 +352,13 @@ def _pack_layers(layers: list[bytes], opt, chunk_dict=None, stats=None) -> list:
 
     from nydus_snapshotter_tpu.converter.convert import pack_layer
 
-    if len(layers) == 1:
-        return [pack_layer(layers[0], opt, chunk_dict=chunk_dict, stats=stats)]
+    # Same auto-degradation as converter/stream._pack_threads: a pool on a
+    # 1-core host measurably costs ~13% (GIL handoffs + contention) over
+    # the serial walk it cannot beat.
+    if len(layers) == 1 or (os.cpu_count() or 1) == 1:
+        return [
+            pack_layer(t, opt, chunk_dict=chunk_dict, stats=stats) for t in layers
+        ]
 
     def _one(t):
         # Per-layer stats dict, merged after: the shared-dict accumulation
