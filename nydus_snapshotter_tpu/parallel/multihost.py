@@ -38,6 +38,18 @@ class HostRuntime:
         """
         return list(items[self.index :: self.count])
 
+    def barrier(self, name: str) -> None:
+        """Fleet-wide sync point over DCN (no-op single-host).
+
+        The one control primitive batch pipelines need beyond membership:
+        phase handoffs like "every host finished building the shared dict
+        artifact" before dependents load it from the storage boundary.
+        """
+        if self.count > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(name)
+
 
 def runtime(
     coordinator: Optional[str] = None,

@@ -170,3 +170,118 @@ def test_genuine_join_failure_never_degrades():
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-q"]))
+
+
+_DICT_CHILD = r"""
+import io, json, os, sys, tarfile
+sys.path.insert(0, os.environ["NTPU_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")  # never touch the axon tunnel
+
+import numpy as np
+from nydus_snapshotter_tpu.parallel import multihost
+from nydus_snapshotter_tpu.converter.convert import Merge, pack_layer
+from nydus_snapshotter_tpu.converter.types import MergeOption, PackOption
+from nydus_snapshotter_tpu.models.bootstrap import Bootstrap, ChunkDict
+
+rt = multihost.runtime(
+    coordinator=os.environ["COORD"],
+    process_id=int(os.environ["PID_IDX"]),
+    num_processes=2,
+)
+share = os.environ["SHARE_DIR"]  # the storage boundary (registry stand-in)
+opt = PackOption(chunk_size=0x10000)
+
+
+def image_tar(seed, pool):
+    rng = np.random.default_rng(seed)
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+        for f in range(5):
+            data = pool[rng.integers(0, len(pool))]
+            ti = tarfile.TarInfo(f"app/f{seed}-{f}")
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+    return buf.getvalue()
+
+
+prng = np.random.default_rng(777)  # SHARED content pool: cross-host overlap
+pool = [prng.integers(0, 256, 60_000, dtype=np.uint8).tobytes() for _ in range(8)]
+
+if rt.index == 0:
+    # Host 0: convert the base image, publish its merged bootstrap as the
+    # fleet's chunk-dict artifact (the reference ships dict bootstraps
+    # through the registry the same way).
+    blob, res = pack_layer(image_tar(1, pool), opt)
+    merged = Merge([blob], MergeOption(with_tar=False))
+    with open(os.path.join(share, "dict.boot.tmp"), "wb") as f:
+        f.write(merged.bootstrap)
+    os.rename(os.path.join(share, "dict.boot.tmp"), os.path.join(share, "dict.boot"))
+    rt.barrier("dict-published")
+    print("RESULT " + json.dumps({"index": 0, "dict_chunks": len(
+        ChunkDict(Bootstrap.from_bytes(merged.bootstrap)))}))
+else:
+    rt.barrier("dict-published")  # wait for host 0's artifact
+    cdict = ChunkDict.from_path(os.path.join(share, "dict.boot"))
+    blob, res = pack_layer(image_tar(2, pool), opt, chunk_dict=cdict)
+    from nydus_snapshotter_tpu.converter.convert import bootstrap_from_layer_blob
+    bs = bootstrap_from_layer_blob(blob)
+    foreign = sum(
+        c.uncompressed_size
+        for c in bs.chunks
+        if bs.blobs[c.blob_index].blob_id != res.blob_id
+    )
+    total = sum(c.uncompressed_size for c in bs.chunks)
+    print("RESULT " + json.dumps({
+        "index": 1, "dedup_bytes": foreign, "total_bytes": total,
+        "referenced": sorted({bs.blobs[c.blob_index].blob_id for c in bs.chunks}),
+        "own": res.blob_id,
+    }))
+"""
+
+
+def test_cross_host_chunk_dict_over_storage_boundary(tmp_path):
+    """Two-host dict handoff: host 0 converts and PUBLISHES its merged
+    bootstrap as the dict artifact; a DCN barrier gates host 1, which
+    loads it from the shared store and converts a content-overlapping
+    image against it — cross-host dedup must produce real foreign-blob
+    references. DCN carries only membership + the barrier; conversion
+    state crosses hosts exclusively through the storage boundary,
+    exactly the reference's distribution model (SURVEY §2.3)."""
+    port = _free_port()
+    share = str(tmp_path / "registry")
+    os.makedirs(share)
+    env_base = {
+        **os.environ,
+        "NTPU_REPO": REPO,
+        "COORD": f"127.0.0.1:{port}",
+        "SHARE_DIR": share,
+    }
+    procs = []
+    for idx in range(2):
+        env = dict(env_base)
+        env["PID_IDX"] = str(idx)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _DICT_CHILD],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                env=env,
+                cwd=REPO,
+            )
+        )
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, (out[-500:], err[-2000:])
+        line = next(l for l in out.splitlines() if l.startswith("RESULT "))
+        r = json.loads(line[len("RESULT ") :])
+        results[r["index"]] = r
+    assert results[0]["dict_chunks"] > 0
+    r1 = results[1]
+    assert r1["dedup_bytes"] > 0, "no cross-host dedup hits"
+    assert r1["dedup_bytes"] <= r1["total_bytes"]
+    # host 1's bootstrap must reference BOTH its own blob and host 0's
+    assert r1["own"] in r1["referenced"]
+    assert len(r1["referenced"]) == 2
