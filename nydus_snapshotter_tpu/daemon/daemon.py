@@ -201,15 +201,23 @@ class Daemon:
         API reads, not cachefiles. Do not mix the two surfaces for one
         instance — their teardowns differ.
         """
-        self.client().bind_blob(config_json)
-        try:
-            blob_id = json.loads(config_json or "{}").get("id", "")
-        except ValueError:
-            blob_id = ""
         mp = rafs.mountpoint or os.path.join(
             self.states.workdir, "erofs", rafs.snapshot_id
         )
         fscache_id = mount_utils.erofs_fscache_id(rafs.snapshot_id)
+        # Carry the bootstrap + fsid in the bind config (the reference's
+        # fscache daemon config has metadata_path the same way): a
+        # cachefiles-capable daemon then serves the EROFS meta cookie —
+        # the fsid mount's first read — not just the data blob cookies.
+        try:
+            cfg = json.loads(config_json or "{}")
+            blob_id = cfg.get("id", "")
+            cfg.setdefault("metadata_path", bootstrap)
+            cfg.setdefault("fscache_id", fscache_id)
+            config_json = json.dumps(cfg)
+        except ValueError:
+            blob_id = ""
+        self.client().bind_blob(config_json)
         try:
             os.makedirs(mp, exist_ok=True)
             (mounter or mount_utils.erofs_mount)(bootstrap, fscache_id, fscache_id, mp)

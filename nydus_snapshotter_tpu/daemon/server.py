@@ -689,6 +689,18 @@ class DaemonServer:
         with self._lock:
             cfg = self._blob_bind_configs.get(cookie_key)
             if cfg is None:
+                # the EROFS meta cookie: the fsid mount's first open —
+                # rendered from the bound config's metadata_path bootstrap
+                for bound in self._blob_bind_configs.values():
+                    if bound.get("fscache_id") == cookie_key and bound.get(
+                        "metadata_path"
+                    ):
+                        meta = self._erofs_meta_bytes(bound["metadata_path"])
+                        return (
+                            len(meta),
+                            lambda off, ln, _m=meta: _m[off : off + ln],
+                            None,
+                        )
                 raise KeyError(cookie_key)
             backend = (cfg.get("device") or {}).get("backend") or {}
             bcfg = backend.get("config") or {}
@@ -707,6 +719,23 @@ class DaemonServer:
                     lambda _fd=fd: os.close(_fd),
                 )
         raise KeyError(cookie_key)
+
+    def _erofs_meta_bytes(self, bootstrap_path: str) -> bytes:
+        """Kernel-mountable EROFS meta image rendered from a bootstrap
+        (internal or real layout), cached per path — the bytes the fsid
+        mount's metadata cookie reads."""
+        cache = getattr(self, "_erofs_meta_cache", None)
+        if cache is None:
+            cache = self._erofs_meta_cache = {}
+        meta = cache.get(bootstrap_path)
+        if meta is None:
+            from nydus_snapshotter_tpu.models.erofs_image import erofs_from_rafs
+            from nydus_snapshotter_tpu.models.nydus_real import load_any_bootstrap
+
+            with open(bootstrap_path, "rb") as f:
+                meta = erofs_from_rafs(load_any_bootstrap(f.read()))
+            cache[bootstrap_path] = meta
+        return meta
 
     def _push_state_async(self) -> None:
         """Keep the supervisor's saved session current after every mount

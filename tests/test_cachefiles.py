@@ -325,3 +325,52 @@ class TestLoopResilience:
         d.stop()  # poll-based loop: observes stop within one interval
         assert not d._thread.is_alive()
         os.close(w)
+
+    def test_meta_cookie_serves_erofs_image(self, tmp_path, monkeypatch):
+        """shared_erofs_mount's bind config carries metadata_path +
+        fscache_id; the daemon must serve the fsid cookie with a
+        kernel-mountable EROFS meta image rendered from the bootstrap."""
+        import io
+        import json
+        import tarfile
+
+        from nydus_snapshotter_tpu.converter.convert import Merge, pack_layer
+        from nydus_snapshotter_tpu.converter.types import MergeOption, PackOption
+        from nydus_snapshotter_tpu.daemon import cachefiles as cfmod
+        from nydus_snapshotter_tpu.daemon.server import DaemonServer
+
+        monkeypatch.setattr(cfmod, "supported", lambda: True)
+        monkeypatch.setattr(
+            cfmod.CachefilesOndemandDaemon, "bind", lambda self: None
+        )
+        monkeypatch.setattr(
+            cfmod.CachefilesOndemandDaemon, "start", lambda self: None
+        )
+
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            ti = tarfile.TarInfo("hello.txt")
+            data = b"cachefiles meta cookie\n"
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+        blob, res = pack_layer(buf.getvalue(), PackOption())
+        merged = Merge([blob], MergeOption(with_tar=False))
+        boot_path = tmp_path / "image.boot"
+        boot_path.write_bytes(merged.bootstrap)
+
+        d = DaemonServer("d2", str(tmp_path / "api.sock"), workdir=str(tmp_path))
+        d.bind_blob(
+            json.dumps(
+                {
+                    "id": res.blob_id,
+                    "metadata_path": str(boot_path),
+                    "fscache_id": "fsid-abc",
+                }
+            )
+        )
+        size, reader, _closer = d._resolve_cachefiles_cookie("fsid-abc")
+        assert size > 1024
+        # EROFS superblock magic at offset 1024
+        assert reader(1024, 4) == b"\xe2\xe1\xf5\xe0"
+        # rendered once, cached per path
+        assert str(boot_path) in d._erofs_meta_cache
