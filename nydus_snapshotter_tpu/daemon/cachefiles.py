@@ -255,10 +255,12 @@ class CachefilesOndemandDaemon:
             resolved = self.resolver(cookie_key)
             size, reader = resolved[0], resolved[1]
             closer = resolved[2] if len(resolved) > 2 else None
-        except KeyError:
-            # fail the open: kernel surfaces ENOENT to the mount instead
-            # of wedging it on a cookie nobody can serve
-            logger.warning("cachefiles open for unknown cookie %r", cookie_key)
+        except Exception:
+            # ANY resolver failure (unknown cookie, unreadable bootstrap,
+            # render error) must fail the open: the kernel surfaces ENOENT
+            # to the mount instead of wedging it waiting for a copen that
+            # would never come.
+            logger.exception("cachefiles open failed for cookie %r", cookie_key)
             if fd >= 0:
                 try:
                     os.close(fd)

@@ -212,8 +212,10 @@ class Daemon:
         try:
             cfg = json.loads(config_json or "{}")
             blob_id = cfg.get("id", "")
-            cfg.setdefault("metadata_path", bootstrap)
-            cfg.setdefault("fscache_id", fscache_id)
+            # direct assignment, not setdefault: the cookie keys must
+            # match the fsid/bootstrap THIS mount actually uses
+            cfg["metadata_path"] = bootstrap
+            cfg["fscache_id"] = fscache_id
             config_json = json.dumps(cfg)
         except ValueError:
             blob_id = ""

@@ -280,6 +280,11 @@ class DaemonServer:
         self.instances: dict[str, _Instance] = {}
         self.bound_blobs: set[str] = set()
         self._blob_bind_configs: dict[str, dict] = {}
+        # fscache_id -> metadata_path: survives same-blob re-binds (two
+        # snapshots sharing a layer blob clobber _blob_bind_configs[id],
+        # but each keeps its own fsid cookie here until ITS unbind)
+        self._meta_binds: dict[str, str] = {}
+        self._erofs_meta_cache: dict[str, bytes] = {}
         self._cachefiles = None  # CachefilesOndemandDaemon on capable kernels
         self._lock = threading.RLock()
         self._httpd: Optional[socketserver.ThreadingMixIn] = None
@@ -647,12 +652,20 @@ class DaemonServer:
             if blob_id:
                 self.bound_blobs.add(blob_id)
                 self._blob_bind_configs[blob_id] = cfg
+                if cfg.get("fscache_id") and cfg.get("metadata_path"):
+                    self._meta_binds[cfg["fscache_id"]] = cfg["metadata_path"]
                 self._ensure_cachefiles()
 
     def unbind_blob(self, domain_id: str, blob_id: str) -> None:
         with self._lock:
             self.bound_blobs.discard(blob_id)
             self._blob_bind_configs.pop(blob_id, None)
+            # domain_id is the mount's fsid (daemon.py passes
+            # erofs_fscache_id): drop exactly this mount's meta cookie and
+            # its rendered image — other snapshots' binds stay live
+            path = self._meta_binds.pop(domain_id, None)
+            if path is not None and path not in self._meta_binds.values():
+                self._erofs_meta_cache.pop(path, None)
 
     # -- cachefiles ondemand (the in-kernel erofs-over-fscache data path) ----
 
@@ -688,27 +701,26 @@ class DaemonServer:
         looked up in the bind config's backend dir, then the workdir."""
         with self._lock:
             cfg = self._blob_bind_configs.get(cookie_key)
+            meta_path = None
             if cfg is None:
                 # the EROFS meta cookie: the fsid mount's first open —
-                # rendered from the bound config's metadata_path bootstrap
-                for bound in self._blob_bind_configs.values():
-                    if bound.get("fscache_id") == cookie_key and bound.get(
-                        "metadata_path"
-                    ):
-                        meta = self._erofs_meta_bytes(bound["metadata_path"])
-                        return (
-                            len(meta),
-                            lambda off, ln, _m=meta: _m[off : off + ln],
-                            None,
-                        )
-                raise KeyError(cookie_key)
-            backend = (cfg.get("device") or {}).get("backend") or {}
-            bcfg = backend.get("config") or {}
-            candidates = [
-                os.path.join(d, cookie_key)
-                for d in (bcfg.get("blob_dir"), bcfg.get("dir"), self.workdir)
-                if d
-            ]
+                # rendered from the bind's metadata_path bootstrap
+                meta_path = self._meta_binds.get(cookie_key)
+                if meta_path is None:
+                    raise KeyError(cookie_key)
+            else:
+                backend = (cfg.get("device") or {}).get("backend") or {}
+                bcfg = backend.get("config") or {}
+                candidates = [
+                    os.path.join(d, cookie_key)
+                    for d in (bcfg.get("blob_dir"), bcfg.get("dir"), self.workdir)
+                    if d
+                ]
+        if meta_path is not None:
+            # render OUTSIDE the lock: building a large image under
+            # self._lock would stall every concurrent API operation
+            meta = self._erofs_meta_bytes(meta_path)
+            return (len(meta), lambda off, ln, _m=meta: _m[off : off + ln], None)
         for path in candidates:
             if os.path.exists(path):
                 size = os.path.getsize(path)
@@ -724,17 +736,14 @@ class DaemonServer:
         """Kernel-mountable EROFS meta image rendered from a bootstrap
         (internal or real layout), cached per path — the bytes the fsid
         mount's metadata cookie reads."""
-        cache = getattr(self, "_erofs_meta_cache", None)
-        if cache is None:
-            cache = self._erofs_meta_cache = {}
-        meta = cache.get(bootstrap_path)
+        meta = self._erofs_meta_cache.get(bootstrap_path)
         if meta is None:
             from nydus_snapshotter_tpu.models.erofs_image import erofs_from_rafs
             from nydus_snapshotter_tpu.models.nydus_real import load_any_bootstrap
 
             with open(bootstrap_path, "rb") as f:
                 meta = erofs_from_rafs(load_any_bootstrap(f.read()))
-            cache[bootstrap_path] = meta
+            self._erofs_meta_cache[bootstrap_path] = meta
         return meta
 
     def _push_state_async(self) -> None:

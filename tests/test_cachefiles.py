@@ -374,3 +374,49 @@ class TestLoopResilience:
         assert reader(1024, 4) == b"\xe2\xe1\xf5\xe0"
         # rendered once, cached per path
         assert str(boot_path) in d._erofs_meta_cache
+
+    def test_resolver_failure_answers_negative_copen(self, tmp_path):
+        """ANY resolver failure (not just unknown cookies) must answer the
+        kernel with a negative copen — an unanswered OPEN wedges the mount
+        and leaks the anon fd."""
+
+        def resolver(key):
+            raise ValueError("bootstrap render exploded")
+
+        dev = FakeDevice()
+        d = cf.CachefilesOndemandDaemon(resolver, device=dev)
+        obj_fd = os.open(str(tmp_path / "obj"), os.O_RDWR | os.O_CREAT)
+        d.handle_msg(_open_msg(5, 77, b"v\x00", b"any", obj_fd))
+        assert dev.writes[-1] == b"copen 5,-2"
+        assert 77 not in d.objects
+        with pytest.raises(OSError):
+            os.fstat(obj_fd)
+
+    def test_shared_blob_rebind_keeps_both_meta_cookies(self, tmp_path, monkeypatch):
+        """Two snapshots binding the SAME layer blob each keep their own
+        fsid meta cookie; unbinding one must not orphan the other."""
+        import json
+
+        from nydus_snapshotter_tpu.daemon import cachefiles as cfmod
+        from nydus_snapshotter_tpu.daemon.server import DaemonServer
+
+        monkeypatch.setattr(cfmod, "supported", lambda: False)
+        boot = tmp_path / "image.boot"
+        boot.write_bytes(b"")  # never rendered in this test
+
+        d = DaemonServer("d3", str(tmp_path / "api.sock"), workdir=str(tmp_path))
+        for fsid in ("fsid-a", "fsid-b"):
+            d.bind_blob(
+                json.dumps(
+                    {
+                        "id": "shared-blob",
+                        "metadata_path": str(boot),
+                        "fscache_id": fsid,
+                    }
+                )
+            )
+        assert set(d._meta_binds) == {"fsid-a", "fsid-b"}
+        d.unbind_blob("fsid-a", "shared-blob")
+        assert set(d._meta_binds) == {"fsid-b"}
+        # fsid-b still resolvable as a meta cookie path
+        assert d._meta_binds["fsid-b"] == str(boot)
