@@ -218,7 +218,7 @@ def batch_determinism_phase(tmpdir: str) -> dict:
         for _li in range(3):
             buf = io.BytesIO()
             with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
-                for fi in range(40):
+                for fi in range(16):
                     data = pool[int(r.integers(0, len(pool)))]
                     ti = tarfile.TarInfo(f"d/f{seed}_{fi}")
                     ti.size = len(data)
@@ -226,7 +226,9 @@ def batch_determinism_phase(tmpdir: str) -> dict:
             layers.append(buf.getvalue())
         return layers
 
-    images = [(f"img{k}", mk_image(1000 + k)) for k in range(8)]
+    # BASELINE config #3 is a TOP-100 batch: 100 images sharing the pool
+    # (cross-repo content reuse), determinism proven on the full set.
+    images = [(f"img{k}", mk_image(1000 + k)) for k in range(100)]
     opt = PackOption(chunk_size=0x10000, chunking="cdc")
 
     def run() -> tuple[list[bytes], list[list[str]], int, float]:
