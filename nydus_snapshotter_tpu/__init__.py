@@ -24,6 +24,8 @@ Layout (tpu-first, not a port of the reference's Go package tree):
 - ``store/``     persistence (reference pkg/store bbolt database).
 - ``config/``    layered TOML config + daemon config templates.
 - ``utils/``     retry, transport, mount/erofs helpers, signals.
+- ``failpoint/`` process-wide fault-injection registry threaded through
+                 every I/O and process boundary (docs/robustness.md).
 """
 
 __version__ = "0.1.0"

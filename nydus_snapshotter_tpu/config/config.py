@@ -13,11 +13,11 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from nydus_snapshotter_tpu import constants
+from nydus_snapshotter_tpu.utils.tomlcompat import tomllib
 
 
 class ConfigError(ValueError):
@@ -43,6 +43,14 @@ class DaemonConfig:
     nydusd_path: str = ""
     nydusd_config_path: str = "/etc/nydus/nydusd-config.json"
     recover_policy: str = constants.RECOVER_POLICY_RESTART
+    # Restart budget / circuit breaker for the restart+failover policies:
+    # at most recover_max_restarts respawns per recover_window_secs, with
+    # exponential backoff between them; past the budget the daemon is
+    # degraded to passthrough instead of hot-looping.
+    recover_max_restarts: int = 3
+    recover_window_secs: float = 60.0
+    recover_backoff_secs: float = 0.5
+    recover_backoff_max_secs: float = 8.0
     fs_driver: str = constants.DEFAULT_FS_DRIVER
     threads_number: int = 4
     log_rotation_size: int = 100  # MiB
@@ -187,6 +195,10 @@ class SnapshotterConfig:
             raise ConfigError(f"invalid recover policy {self.daemon.recover_policy!r}")
         if self.daemon.accel_backend not in ("hybrid", "jax", "numpy"):
             raise ConfigError(f"invalid accel backend {self.daemon.accel_backend!r}")
+        if self.daemon.recover_max_restarts < 1:
+            raise ConfigError("daemon.recover_max_restarts must be >= 1")
+        if self.daemon.recover_window_secs <= 0 or self.daemon.recover_backoff_secs < 0:
+            raise ConfigError("daemon recover window/backoff must be positive")
         if self.daemon.fs_driver in (constants.FS_DRIVER_BLOCKDEV, constants.FS_DRIVER_PROXY):
             # Proxy/blockdev modes run without nydusd daemons
             # (reference config.go:300-311 forces daemon_mode none).

@@ -24,7 +24,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import BinaryIO, Callable, Optional
 
-import zstandard
+from nydus_snapshotter_tpu.utils.zstdcompat import zstandard
 
 from nydus_snapshotter_tpu import constants
 from nydus_snapshotter_tpu.converter import crypto
@@ -273,8 +273,10 @@ def Pack(
     digests -> dedup -> compress -> dest), shared by in-memory and
     streaming callers alike.
     """
+    from nydus_snapshotter_tpu import failpoint
     from nydus_snapshotter_tpu.converter.stream import pack_stream
 
+    failpoint.hit("converter.pack")
     return pack_stream(dest, src_tar, opt, chunk_dict=chunk_dict, stats=stats)
 
 

@@ -15,7 +15,12 @@ from __future__ import annotations
 
 import os
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+try:  # optional: only the --encrypt feature needs a cipher backend
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    _HAVE_CRYPTOGRAPHY = True
+except ModuleNotFoundError:  # gate, don't break converter imports
+    _HAVE_CRYPTOGRAPHY = False
 
 CIPHER_NONE = 0
 CIPHER_AES_256_CTR = 1
@@ -35,6 +40,8 @@ def generate_context() -> tuple[bytes, bytes]:
 
 def _ctr_at(key: bytes, iv: bytes, block_index: int):
     """CTR cipher positioned at 16-byte block ``block_index`` of the stream."""
+    if not _HAVE_CRYPTOGRAPHY:
+        raise CryptoError("blob encryption needs the 'cryptography' package")
     if len(key) != KEY_LEN or len(iv) != IV_LEN:
         raise CryptoError("AES-256-CTR needs a 32-byte key and 16-byte IV")
     counter = (int.from_bytes(iv, "big") + block_index) % (1 << 128)

@@ -15,7 +15,9 @@ import socket
 import time
 from typing import Any, Optional
 
+from nydus_snapshotter_tpu import failpoint
 from nydus_snapshotter_tpu.utils import errdefs
+from nydus_snapshotter_tpu.utils import retry as retry_lib
 
 
 class ClientError(errdefs.NydusError):
@@ -56,6 +58,27 @@ class NydusdClient:
     # -- plumbing -----------------------------------------------------------
 
     def _request(
+        self, method: str, path: str, body: Optional[dict] = None, raw: bool = False
+    ) -> Any:
+        failpoint.hit("daemon.rpc")
+        if method == "GET":
+            # Idempotent reads retry through a daemon restarting mid-RPC
+            # (connection torn down after connect); the deadline keeps the
+            # whole loop inside this client's timeout. Non-idempotent
+            # mounts/starts fail fast — their callers own recovery.
+            try:
+                return retry_lib.do_with_deadline(
+                    lambda: self._request_once(method, path, body, raw),
+                    deadline=self.timeout,
+                    attempts=3,
+                    delay=0.05,
+                    retry_on=(ConnectionResetError, BrokenPipeError),
+                )
+            except retry_lib.RetryError as e:
+                raise e.last
+        return self._request_once(method, path, body, raw)
+
+    def _request_once(
         self, method: str, path: str, body: Optional[dict] = None, raw: bool = False
     ) -> Any:
         conn = _UDSConnection(self.sock_path, self.timeout)

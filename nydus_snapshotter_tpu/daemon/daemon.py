@@ -15,7 +15,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
-from nydus_snapshotter_tpu import constants
+from nydus_snapshotter_tpu import constants, failpoint
 from nydus_snapshotter_tpu.daemon.client import NydusdClient
 from nydus_snapshotter_tpu.daemon.command import DaemonCommand
 from nydus_snapshotter_tpu.daemon.types import DaemonState
@@ -88,6 +88,7 @@ class Daemon:
         )
 
     def spawn(self, upgrade: bool = False) -> int:
+        failpoint.hit("daemon.spawn")
         argv = self.command(upgrade=upgrade).build()
         # The daemon runs `-m nydus_snapshotter_tpu.daemon.server`; make sure
         # the package root is importable regardless of the caller's cwd.

@@ -28,6 +28,7 @@ import threading
 from typing import Optional
 
 from nydus_snapshotter_tpu import constants as C
+from nydus_snapshotter_tpu import failpoint
 from nydus_snapshotter_tpu.cache.manager import CacheManager
 from nydus_snapshotter_tpu.config.daemonconfig import DaemonRuntimeConfig
 from nydus_snapshotter_tpu.daemon.daemon import SHARED_DAEMON_ID, Daemon
@@ -239,6 +240,7 @@ class Filesystem:
         # The pending-mount count keeps try_stop_shared_daemon from tearing
         # the shared daemon down between get_shared_daemon and the refcount
         # attach inside shared_mount.
+        failpoint.hit("fs.mount")
         with self._lock:
             self._pending_mounts += 1
         try:
@@ -380,6 +382,7 @@ class Filesystem:
             mgr.db.save_instance(rafs.snapshot_id, rafs.to_dict(), rafs.seq)
 
     def umount(self, snapshot_id: str) -> None:
+        failpoint.hit("fs.umount")
         with self._snapshot_lock(snapshot_id):
             self._umount_locked(snapshot_id)
 
@@ -418,6 +421,12 @@ class Filesystem:
                 return
             raise errdefs.NotFound(f"no instance {snapshot_id}")
         if rafs.fs_driver in (C.FS_DRIVER_FSCACHE, C.FS_DRIVER_FUSEDEV):
+            # A daemon whose restart budget is exhausted never comes back:
+            # serve the snapshot dirs as-is (nodev-style passthrough)
+            # instead of blocking the mount path on a dead socket.
+            mgr = self.managers.get(rafs.fs_driver)
+            if mgr is not None and mgr.is_degraded(rafs.daemon_id):
+                return
             d = self.get_daemon_by_rafs(rafs)
             d.wait_until_state(DaemonState.RUNNING)
 

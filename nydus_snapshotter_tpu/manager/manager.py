@@ -16,10 +16,11 @@ import threading
 import time
 from typing import Callable, Optional
 
-from nydus_snapshotter_tpu import constants
+from nydus_snapshotter_tpu import constants, failpoint
 from nydus_snapshotter_tpu.config.config import SnapshotterConfig
 from nydus_snapshotter_tpu.daemon.daemon import ConfigState, Daemon
 from nydus_snapshotter_tpu.daemon.types import DaemonState
+from nydus_snapshotter_tpu.manager.budget import RestartBudget
 from nydus_snapshotter_tpu.manager.monitor import DeathEvent, LivenessMonitor
 from nydus_snapshotter_tpu.rafs.rafs import Rafs
 from nydus_snapshotter_tpu.store.database import Database
@@ -49,6 +50,19 @@ class Manager:
         self._stop = threading.Event()
         self.on_death: Optional[Callable[[DeathEvent], None]] = None  # test hook
         self.cgroup_mgr = None  # optional pkg/cgroup Manager (daemon_adaptor.go:74-86)
+        # Restart budget / circuit breaker: a crash-looping daemon gets
+        # bounded respawns with backoff, then degrades to passthrough
+        # instead of storming (knobs under [daemon] in the config TOML).
+        dcfg = cfg.daemon
+        self.restart_budget = RestartBudget(
+            max_restarts=getattr(dcfg, "recover_max_restarts", 3),
+            window=getattr(dcfg, "recover_window_secs", 60.0),
+            base_delay=getattr(dcfg, "recover_backoff_secs", 0.5),
+            max_delay=getattr(dcfg, "recover_backoff_max_secs", 8.0),
+        )
+        self.degraded: set[str] = set()
+        self.on_degraded: Optional[Callable[[Daemon], None]] = None
+        self._sleep: Callable[[float], None] = time.sleep
 
     # -- daemon book-keeping -------------------------------------------------
 
@@ -139,6 +153,8 @@ class Manager:
         daemon.clear_vestige()
         self.supervisors.destroy(daemon.id)
         self.remove_daemon(daemon.id)
+        self.restart_budget.reset(daemon.id)
+        self.degraded.discard(daemon.id)
 
     # -- recovery ------------------------------------------------------------
 
@@ -190,15 +206,50 @@ class Manager:
                 self.on_death(event)
 
     def handle_death_event(self, event: DeathEvent) -> None:
-        """Dispatch per recovery policy (reference daemon_event.go:43-138)."""
+        """Dispatch per recovery policy (reference daemon_event.go:43-138),
+        metered by the restart budget: bounded respawns with exponential
+        backoff, then circuit-open degradation."""
         daemon = self.get_by_daemon_id(event.daemon_id)
         if daemon is None:
             return
+        if self.recover_policy == constants.RECOVER_POLICY_NONE:
+            return  # leave it dead
+        if event.daemon_id in self.degraded:
+            return  # circuit already open; no respawn
+        delay = self.restart_budget.next_delay(event.daemon_id)
+        if delay is None:
+            self._degrade(daemon)
+            return
+        if delay > 0:
+            logger.warning(
+                "daemon %s died again; backing off %.2fs before respawn (%d/%d in window)",
+                daemon.id, delay,
+                self.restart_budget.restarts_in_window(daemon.id),
+                self.restart_budget.max_restarts,
+            )
+            self._sleep(delay)
         if self.recover_policy == constants.RECOVER_POLICY_FAILOVER:
             self.do_daemon_failover(daemon)
         elif self.recover_policy == constants.RECOVER_POLICY_RESTART:
             self.do_daemon_restart(daemon)
-        # RECOVER_POLICY_NONE: leave it dead.
+
+    def is_degraded(self, daemon_id: str) -> bool:
+        return daemon_id in self.degraded
+
+    def _degrade(self, daemon: Daemon) -> None:
+        """Circuit open: stop respawning, clean up the corpse, and serve
+        what's on disk (nodev-style passthrough) instead of hot-looping
+        on a daemon that cannot stay up."""
+        logger.error(
+            "daemon %s exhausted its restart budget (%d respawns/%.0fs); "
+            "degrading to passthrough",
+            daemon.id, self.restart_budget.max_restarts, self.restart_budget.window,
+        )
+        self.degraded.add(daemon.id)
+        self.monitor.unsubscribe(daemon.id)
+        daemon.clear_vestige()
+        if self.on_degraded is not None:
+            self.on_degraded(daemon)
 
     def do_daemon_failover(self, daemon: Daemon) -> None:
         """Supervisor-held state + fd replay into a fresh process
@@ -223,6 +274,7 @@ class Manager:
     def do_daemon_restart(self, daemon: Daemon) -> None:
         """Respawn + re-mount every instance via the API
         (reference daemon_event.go:109-137)."""
+        failpoint.hit("manager.restart")
         daemon.wait(timeout=5)
         daemon.clear_vestige()
         self.start_daemon(daemon)

@@ -30,38 +30,53 @@ class LivenessMonitor:
         self.events: "queue.Queue[DeathEvent]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._closed = False
 
     def subscribe(self, daemon_id: str, sock_path: str) -> None:
         """Connect to the daemon socket and watch for hangup
-        (reference monitor.go:81-138)."""
+        (reference monitor.go:81-138). Exception-safe: a failed connect
+        or register never leaks the socket fd."""
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        s.connect(sock_path)
-        s.setblocking(False)
-        fd = s.fileno()
-        with self._lock:
-            if daemon_id in self._by_id:
-                self._unsubscribe_locked(daemon_id)
-            self._socks[fd] = (daemon_id, sock_path, s)
-            self._by_id[daemon_id] = fd
-        self._epoll.register(fd, select.EPOLLHUP | select.EPOLLERR | select.EPOLLET)
+        try:
+            s.connect(sock_path)
+            s.setblocking(False)
+            fd = s.fileno()
+            with self._lock:
+                if self._closed:
+                    raise ValueError("monitor is stopped")
+                if daemon_id in self._by_id:
+                    self._unsubscribe_locked(daemon_id)
+                self._epoll.register(fd, select.EPOLLHUP | select.EPOLLERR | select.EPOLLET)
+                self._socks[fd] = (daemon_id, sock_path, s)
+                self._by_id[daemon_id] = fd
+        except BaseException:
+            s.close()
+            raise
 
     def unsubscribe(self, daemon_id: str) -> None:
         with self._lock:
             self._unsubscribe_locked(daemon_id)
 
     def _unsubscribe_locked(self, daemon_id: str) -> None:
+        """Unregister from epoll AND close the socket — the single
+        teardown used by explicit unsubscribe, death events, and stop(),
+        so no path can leak a watched fd."""
         fd = self._by_id.pop(daemon_id, None)
         if fd is None:
             return
         try:
             self._epoll.unregister(fd)
-        except (OSError, FileNotFoundError):
-            pass
-        _, _, s = self._socks.pop(fd)
-        s.close()
+        except (OSError, ValueError):
+            pass  # fd already gone, or epoll already closed
+        entry = self._socks.pop(fd, None)
+        if entry is not None:
+            entry[2].close()
 
     def run(self) -> None:
         """Event loop (reference monitor.go:191-229)."""
+        with self._lock:
+            if self._closed:
+                raise ValueError("monitor is stopped")
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -83,10 +98,17 @@ class LivenessMonitor:
                     self.events.put(DeathEvent(daemon_id=daemon_id, path=path))
 
     def stop(self) -> None:
+        """Join the poll thread, drop every subscription, close the epoll
+        fd. Idempotent: repeated setup/teardown in tests must not leak or
+        double-close fds."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2)
+            self._thread = None
         with self._lock:
+            if self._closed:
+                return
+            self._closed = True
             for daemon_id in list(self._by_id):
                 self._unsubscribe_locked(daemon_id)
         self._epoll.close()

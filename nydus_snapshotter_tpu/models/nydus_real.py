@@ -241,7 +241,7 @@ def _make_chunk_decoder(compressor: str):
 
         return lz4mod.decompress_block
     if compressor == "zstd":
-        import zstandard
+        from nydus_snapshotter_tpu.utils.zstdcompat import zstandard
 
         return lambda raw, usize: zstandard.ZstdDecompressor().decompress(
             raw, max_output_size=max(usize, 1)

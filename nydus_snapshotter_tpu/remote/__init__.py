@@ -7,6 +7,7 @@ containerd fork), with the same plain-HTTP retry heuristic
 (pkg/utils/transport/pool.go:24-70).
 """
 
+from nydus_snapshotter_tpu.remote.mirror import HostHealth, MirrorRouter
 from nydus_snapshotter_tpu.remote.reference import ParsedReference, parse_docker_ref
 from nydus_snapshotter_tpu.remote.registry import Descriptor, RegistryClient
 from nydus_snapshotter_tpu.remote.remote import Remote
@@ -21,4 +22,6 @@ __all__ = [
     "Remote",
     "Resolver",
     "Pool",
+    "MirrorRouter",
+    "HostHealth",
 ]

@@ -1,0 +1,126 @@
+"""Registry mirror failover routing with per-host health scoring.
+
+Consumes the containerd-style per-registry mirror directories parsed by
+:mod:`nydus_snapshotter_tpu.config.mirrors` (``<dir>/<host>/hosts.toml``)
+and keeps an in-process health score per mirror host: after
+``failure_limit`` consecutive failures a mirror is put on cooldown for
+``health_check_interval`` seconds and skipped by the candidate ordering
+until the cooldown expires (reference mirrors_health.go semantics,
+collapsed into the request path — no background prober needed for a
+snapshotter-side transport).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.parse
+from typing import Callable, Optional
+
+from nydus_snapshotter_tpu.config.daemonconfig import MirrorConfig
+from nydus_snapshotter_tpu.config.mirrors import load_mirrors_config
+
+
+class HostHealth:
+    """Consecutive-failure scorer with cooldown."""
+
+    def __init__(
+        self,
+        failure_limit: int = 5,
+        cooldown: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.failure_limit = max(1, int(failure_limit))
+        self.cooldown = float(cooldown)
+        self._clock = clock
+        self.consecutive_failures = 0
+        self.down_until = 0.0
+
+    def available(self) -> bool:
+        return self._clock() >= self.down_until
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        self.down_until = 0.0
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if self.consecutive_failures >= self.failure_limit:
+            # Trip: cool down, then start a fresh count — a recovered
+            # mirror gets a full failure budget after the cooldown.
+            self.down_until = self._clock() + self.cooldown
+            self.consecutive_failures = 0
+
+
+def split_mirror_host(mirror_host: str) -> tuple[str, bool]:
+    """``https://mirror:5000`` → (netloc, plain_http)."""
+    parsed = urllib.parse.urlsplit(mirror_host)
+    if parsed.netloc:
+        return parsed.netloc, parsed.scheme == "http"
+    return mirror_host, False
+
+
+class MirrorRouter:
+    """Orders mirror candidates per upstream registry host, health-aware."""
+
+    def __init__(
+        self,
+        mirrors_config_dir: str = "",
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.mirrors_config_dir = mirrors_config_dir
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._mirrors: dict[str, list[MirrorConfig]] = {}
+        self._health: dict[str, HostHealth] = {}
+
+    def mirrors_for(self, registry_host: str) -> list[MirrorConfig]:
+        """Configured mirrors for ``registry_host`` (cached per host)."""
+        if not self.mirrors_config_dir:
+            return []
+        with self._lock:
+            if registry_host in self._mirrors:
+                return self._mirrors[registry_host]
+        mirrors = load_mirrors_config(self.mirrors_config_dir, registry_host)
+        with self._lock:
+            self._mirrors.setdefault(registry_host, mirrors)
+            return self._mirrors[registry_host]
+
+    def candidates(self, registry_host: str) -> list[MirrorConfig]:
+        """Healthy mirrors in configured order (cooled-down hosts skipped)."""
+        return [
+            m
+            for m in self.mirrors_for(registry_host)
+            if self._health_for(m).available()
+        ]
+
+    def _health_for(self, mirror: MirrorConfig) -> HostHealth:
+        with self._lock:
+            h = self._health.get(mirror.host)
+            if h is None:
+                h = HostHealth(
+                    failure_limit=mirror.failure_limit,
+                    cooldown=float(mirror.health_check_interval),
+                    clock=self._clock,
+                )
+                self._health[mirror.host] = h
+            return h
+
+    def health(self, mirror_host: str) -> Optional[HostHealth]:
+        with self._lock:
+            return self._health.get(mirror_host)
+
+    def record(self, mirror: MirrorConfig, ok: bool) -> None:
+        h = self._health_for(mirror)
+        if ok:
+            h.record_success()
+        else:
+            h.record_failure()
+
+    def invalidate(self, registry_host: Optional[str] = None) -> None:
+        """Drop the cached hosts.toml parse (config reload)."""
+        with self._lock:
+            if registry_host is None:
+                self._mirrors.clear()
+            else:
+                self._mirrors.pop(registry_host, None)

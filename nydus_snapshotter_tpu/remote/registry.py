@@ -21,6 +21,7 @@ import urllib.parse
 from dataclasses import dataclass, field
 from typing import Iterable, Mapping, Optional
 
+from nydus_snapshotter_tpu import failpoint
 from nydus_snapshotter_tpu.utils import errdefs
 
 MANIFEST_ACCEPTS = (
@@ -34,11 +35,34 @@ _AUTH_PARAM_RE = re.compile(r'(\w+)="([^"]*)"')
 
 
 class HTTPError(errdefs.NydusError):
-    def __init__(self, code: int, url: str, body: bytes = b""):
+    def __init__(self, code: int, url: str, body: bytes = b"", retry_after: float = 0.0):
         self.code = code
         self.url = url
         self.body = body
+        # Parsed Retry-After (seconds); 0.0 when the response carried none.
+        self.retry_after = retry_after
         super().__init__(f"HTTP {code} for {url}: {body[:200]!r}")
+
+
+def parse_retry_after(value: Optional[str]) -> float:
+    """Retry-After header → seconds (delta-seconds or HTTP-date form)."""
+    if not value:
+        return 0.0
+    value = value.strip()
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    try:
+        import email.utils
+
+        when = email.utils.parsedate_to_datetime(value)
+    except (TypeError, ValueError):
+        return 0.0
+    import datetime
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return max(0.0, (when - now).total_seconds())
 
 
 @dataclass
@@ -129,12 +153,15 @@ class RegistryClient:
         plain_http: bool = False,
         insecure_tls: bool = False,
         timeout: float = 60.0,
+        headers: Optional[Mapping[str, str]] = None,
     ):
         self.host = host
         self.keychain = keychain
         self.plain_http = plain_http
         self.insecure_tls = insecure_tls
         self.timeout = timeout
+        # Always-sent headers (mirror configs carry e.g. X-Registry).
+        self.extra_headers = dict(headers or {})
         self._token: Optional[str] = None  # cached bearer token
         self._lock = threading.Lock()
 
@@ -213,7 +240,8 @@ class RegistryClient:
         following (resolver.go request.doWithRetries semantics)."""
         scheme = "http" if self.plain_http else "https"
         url = path if "://" in path else f"{scheme}://{self.host}{path}"
-        hdrs = dict(headers or {})
+        hdrs = dict(self.extra_headers)
+        hdrs.update(headers or {})
         for attempt in range(2):
             auth = self._authorization()
             if auth:
@@ -246,10 +274,11 @@ class RegistryClient:
             if r.status in ok:
                 return r
             data = b"" if stream else r.read(4096)
+            retry_after = parse_retry_after(r.headers.get("retry-after"))
             r.close()
             if r.status == 404:
                 raise errdefs.NotFound(f"{method} {url}: 404")
-            raise HTTPError(r.status, url, data)
+            raise HTTPError(r.status, url, data, retry_after=retry_after)
         raise errdefs.Unavailable(f"auth retry exhausted for {url}")
 
     # -- resolver / fetcher ---------------------------------------------------
@@ -335,6 +364,7 @@ class RegistryClient:
     def fetch_blob(self, repo: str, digest: str, byte_range: Optional[tuple[int, int]] = None):
         """Streaming blob fetch; ``byte_range`` is an inclusive (start, end)
         pair mapped to an HTTP Range header (stargz range reads)."""
+        failpoint.hit("transport.fetch_blob")
         hdrs = {}
         ok: tuple[int, ...] = (200,)
         if byte_range is not None:

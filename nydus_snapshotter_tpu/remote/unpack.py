@@ -20,12 +20,11 @@ def decompress_stream(data: bytes) -> bytes:
     if data[:2] == b"\x1f\x8b":
         return gzip.decompress(data)
     if data[:4] == b"\x28\xb5\x2f\xfd":
-        try:
-            import zstandard
+        from nydus_snapshotter_tpu.utils import zstdcompat
 
-            return zstandard.ZstdDecompressor().decompress(data)
-        except ImportError as e:
-            raise errdefs.Unavailable("zstd layer but no zstandard module") from e
+        if not zstdcompat.available():
+            raise errdefs.Unavailable("zstd layer but no zstd implementation")
+        return zstdcompat.zstandard.ZstdDecompressor().decompress(data)
     if data[:2] == b"\x78\x9c" or data[:2] == b"\x78\xda":
         return zlib.decompress(data)
     return data

@@ -25,6 +25,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, Optional
 
+from nydus_snapshotter_tpu import failpoint
 from nydus_snapshotter_tpu.utils import errdefs
 
 KIND_VIEW = "view"
@@ -119,6 +120,7 @@ class MetaStore:
     def create_snapshot(
         self, kind: str, key: str, parent: str = "", labels: Optional[dict[str, str]] = None
     ) -> Snapshot:
+        failpoint.hit("metastore.create")
         if kind not in (KIND_VIEW, KIND_ACTIVE):
             raise errdefs.InvalidArgument(f"snapshot kind {kind!r} not creatable")
         if not key:
@@ -190,6 +192,7 @@ class MetaStore:
     def commit_active(self, key: str, name: str, usage: Usage) -> str:
         """Commit active snapshot `key` as committed snapshot `name`;
         returns the (unchanged) snapshot id."""
+        failpoint.hit("metastore.commit")
         if not name:
             raise errdefs.InvalidArgument("committed name is empty")
         with self._lock:
@@ -210,6 +213,7 @@ class MetaStore:
     def remove(self, key: str) -> tuple[str, str]:
         """Remove snapshot `key`; returns (id, kind). Fails while children
         reference it (containerd Remove contract)."""
+        failpoint.hit("metastore.remove")
         with self._lock:
             row = self._row(key)
             child = self._conn.execute(

@@ -24,3 +24,9 @@ jax.config.update("jax_platforms", "cpu")
 # kernel FUSE mounts are covered by tests/test_fusedev.py, which re-enables
 # this in its subprocess daemons.
 os.environ.setdefault("NTPU_DISABLE_FUSE", "1")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: slow chaos/e2e sweeps excluded from tier-1 (-m 'not slow')"
+    )

@@ -91,6 +91,10 @@ def _is_mounted(mp: str) -> bool:
 
 
 @requires_fuse
+@pytest.mark.slow  # kill-based kernel-FUSE takeover is environment-sensitive:
+# on sandboxed kernels the lost-request window can wedge the whole pytest
+# process in an uninterruptible FUSE wait, so this storm runs in the slow
+# chaos tier (tools/chaos_matrix.py territory), not tier-1.
 class TestFuseTakeoverStorm:
     def test_fuse_reads_inflight_across_sigkill_takeover_cycles(self, tmp_path):
         """Reader PROCESSES stream file bytes through the kernel mount

@@ -223,7 +223,12 @@ class TestFeatures:
         assert f1 is f2
         assert f1.contains(Feature.TAR_RAFS)
         assert f1.contains(Feature.CDC_CHUNKING)
-        assert f1.contains(Feature.ENCRYPT)  # cryptography is available
+        # ENCRYPT tracks whether a cipher backend is importable here.
+        import importlib.util
+
+        assert f1.contains(Feature.ENCRYPT) == (
+            importlib.util.find_spec("cryptography") is not None
+        )
         assert not f1.contains(Feature.BATCH_SIZE)
 
 

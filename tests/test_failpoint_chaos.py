@@ -1,0 +1,558 @@
+"""Failpoint fault-injection layer + chaos matrix.
+
+Fast subset (unmarked): spec parsing, registry semantics (n-shot,
+probability, env activation, zero overhead), the restart budget, the
+manager circuit breaker driven by injected daemon-spawn faults, monitor
+fd hygiene, and a Prepare→Mounts→Commit→Remove chaos pass with faults at
+each control-plane site. The exhaustive site × policy sweep lives in
+tools/chaos_matrix.py and the ``slow``-marked test at the bottom.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+
+import pytest
+
+from nydus_snapshotter_tpu import constants, failpoint
+from nydus_snapshotter_tpu.config.config import SnapshotterConfig
+from nydus_snapshotter_tpu.failpoint.spec import (
+    Panic,
+    SpecError,
+    build_error,
+    parse_action,
+    parse_spec,
+)
+from nydus_snapshotter_tpu.manager.budget import RestartBudget
+from nydus_snapshotter_tpu.manager.manager import Manager
+from nydus_snapshotter_tpu.manager.monitor import DeathEvent, LivenessMonitor
+from nydus_snapshotter_tpu.snapshot import metastore as ms
+from nydus_snapshotter_tpu.snapshot.metastore import Usage
+from nydus_snapshotter_tpu.snapshot.mount import ExtraOption
+from nydus_snapshotter_tpu.snapshot.snapshotter import Snapshotter
+from nydus_snapshotter_tpu.store.database import Database
+from nydus_snapshotter_tpu.utils import errdefs
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoint.clear()
+    yield
+    failpoint.clear()
+
+
+# ------------------------------------------------------------------- spec
+
+
+class TestSpec:
+    def test_parse_multi_site_spec(self):
+        table = parse_spec(
+            "transport.fetch_blob=error(HTTPError:503)%0.5;"
+            "daemon.spawn=delay(0.2);metastore.commit=panic"
+        )
+        assert set(table) == {"transport.fetch_blob", "daemon.spawn", "metastore.commit"}
+        a = table["transport.fetch_blob"]
+        assert (a.kind, a.arg, a.prob) == ("error", "HTTPError:503", 0.5)
+        assert table["daemon.spawn"].kind == "delay"
+        assert table["metastore.commit"].kind == "panic"
+
+    def test_parse_count_and_off(self):
+        table = parse_spec("a=error(OSError)*2;b=off;;")
+        assert table["a"].count == 2
+        assert "b" not in table
+
+    def test_bad_specs_rejected(self):
+        for bad in ("a=explode", "a=error(X)%1.5", "noequals", "=error(X)", "a=delay(x)"):
+            with pytest.raises(SpecError):
+                parse_spec(bad)
+
+    def test_action_roundtrips_through_str(self):
+        a = parse_action("error(OSError:boom)%0.25*3")
+        assert parse_action(str(a)) == a
+
+    def test_build_error_mapping(self):
+        from nydus_snapshotter_tpu.remote.registry import HTTPError
+
+        e = build_error("HTTPError:429", "site")
+        assert isinstance(e, HTTPError) and e.code == 429
+        assert isinstance(build_error("OSError:boom", "s"), OSError)
+        assert isinstance(build_error("TimeoutError", "s"), TimeoutError)
+        assert isinstance(build_error("Unavailable:down", "s"), errdefs.Unavailable)
+        assert isinstance(build_error("NoSuchThing", "s"), RuntimeError)
+
+
+# --------------------------------------------------------------- registry
+
+
+class TestRegistry:
+    def test_disabled_hit_is_noop(self):
+        assert failpoint.active() == {}
+        failpoint.hit("transport.fetch_blob")  # no error, no state
+
+    def test_unarmed_site_is_noop_while_others_armed(self):
+        with failpoint.injected("some.site", "error(OSError)"):
+            failpoint.hit("other.site")
+        assert failpoint.counts().get("other.site") is None
+
+    def test_inject_fire_clear(self):
+        failpoint.inject("x", "error(OSError:kaboom)")
+        with pytest.raises(OSError, match="kaboom"):
+            failpoint.hit("x")
+        failpoint.clear("x")
+        failpoint.hit("x")
+        assert failpoint.counts()["x"] == 1
+
+    def test_n_shot_disarms(self):
+        failpoint.inject("x", "error(OSError)*2")
+        for _ in range(2):
+            with pytest.raises(OSError):
+                failpoint.hit("x")
+        failpoint.hit("x")  # third hit: disarmed
+        assert "x" not in failpoint.active()
+        assert failpoint.counts()["x"] == 2
+
+    def test_probability_extremes(self):
+        failpoint.inject("never", "error(OSError)%0.0")
+        for _ in range(20):
+            failpoint.hit("never")
+        failpoint.inject("always", "error(OSError)%1.0")
+        with pytest.raises(OSError):
+            failpoint.hit("always")
+
+    def test_delay_action_sleeps(self):
+        failpoint.inject("z", "delay(0.02)")
+        t0 = time.monotonic()
+        failpoint.hit("z")
+        assert time.monotonic() - t0 >= 0.015
+
+    def test_panic_bypasses_except_exception(self):
+        failpoint.inject("p", "panic(boom)")
+        caught = None
+        try:
+            try:
+                failpoint.hit("p")
+            except Exception:  # must NOT swallow a panic
+                pytest.fail("panic was caught by `except Exception`")
+        except Panic as e:
+            caught = e
+        assert caught is not None and "boom" in str(caught)
+
+    def test_env_activation(self, monkeypatch):
+        monkeypatch.setenv(failpoint.ENV_VAR, "env.site=error(OSError)")
+        assert failpoint.configure_from_env()
+        with pytest.raises(OSError):
+            failpoint.hit("env.site")
+        monkeypatch.delenv(failpoint.ENV_VAR)
+        assert not failpoint.configure_from_env()
+
+    def test_malformed_env_spec_is_ignored(self, monkeypatch):
+        # import-time safety: a typo'd chaos knob must not crash the process
+        monkeypatch.setenv(failpoint.ENV_VAR, "not a spec!!")
+        assert not failpoint.configure_from_env()
+        assert failpoint.active() == {}
+
+    def test_known_sites_catalog_is_wired(self):
+        """Every cataloged site name appears as a hit() call in the tree."""
+        import subprocess
+
+        pkg = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                           "nydus_snapshotter_tpu")
+        src = subprocess.run(
+            ["grep", "-rho", r"hit(\"[a-z_.]*\")", pkg],
+            capture_output=True, text=True,
+        ).stdout
+        wired = {line[len('hit("'):-2] for line in src.splitlines()}
+        assert set(failpoint.KNOWN_SITES) <= wired
+
+
+# -------------------------------------------------------- chaos: snapshotter
+
+
+class FakeFs:
+    """Minimal L3 facade (native-mount flows only)."""
+
+    def __init__(self):
+        self.mounted = {}
+        self.ready = set()
+
+    def mount(self, sid, labels, snapshot):
+        self.mounted[sid] = labels
+        self.ready.add(sid)
+
+    def umount(self, sid):
+        self.mounted.pop(sid, None)
+
+    def wait_until_ready(self, sid):
+        if sid not in self.ready:
+            raise errdefs.NotFound(sid)
+
+    def mount_point(self, sid):
+        if sid in self.mounted:
+            return f"/mnt/nydus/{sid}"
+        raise errdefs.NotFound(sid)
+
+    def bootstrap_file(self, sid):
+        return f"/snap/{sid}/fs/image/image.boot"
+
+    def remove_cache(self, digest):
+        pass
+
+    def cache_usage(self, digest):
+        return Usage()
+
+    def teardown(self):
+        pass
+
+    def try_stop_shared_daemon(self):
+        pass
+
+    def check_referrer(self, labels):
+        return False
+
+    def referrer_detect_enabled(self):
+        return False
+
+    def try_fetch_metadata(self, labels, meta_path):
+        pass
+
+    def stargz_enabled(self):
+        return False
+
+    def is_stargz_data_layer(self, labels):
+        return False, None
+
+    def prepare_stargz_meta_layer(self, blob, storage_path, labels):
+        pass
+
+    def merge_stargz_meta_layer(self, snapshot):
+        pass
+
+    def tarfs_enabled(self):
+        return False
+
+    def prepare_tarfs_layer(self, labels, sid, upper):
+        pass
+
+    def merge_tarfs_layers(self, snapshot, path_fn):
+        pass
+
+    def export_block_data(self, snapshot, per_layer, labels, path_fn):
+        return []
+
+    def detach_tarfs_layer(self, sid):
+        pass
+
+    def tarfs_export_enabled(self):
+        return False
+
+    def get_instance_extra_option(self, sid):
+        return ExtraOption(source="", config="{}", snapshotdir="", fs_version="6")
+
+
+def _lifecycle(sn: Snapshotter) -> None:
+    """One full Prepare→Mounts→Commit→Remove pass."""
+    sn.prepare("prep-key", "")
+    sn.mounts("prep-key")
+    sn.commit("layer-1", "prep-key")
+    sn.remove("layer-1")
+
+
+@pytest.fixture
+def sn(tmp_path):
+    s = Snapshotter(root=str(tmp_path), fs=FakeFs())
+    yield s
+    s.close()
+
+
+class TestChaosLifecycle:
+    """Fault at each control-plane site: the failure is clean (typed
+    error, no residue) and the identical operation succeeds once the
+    fault is cleared — no poisoned metastore rows, no leaked staging
+    dirs, no restart storms."""
+
+    def _no_staging_residue(self, sn):
+        return not [
+            d for d in os.listdir(sn.snapshot_root()) if d.startswith("new-")
+        ]
+
+    def test_fault_at_metastore_create_then_recover(self, sn):
+        with failpoint.injected("metastore.create", "error(Unavailable:db down)"):
+            with pytest.raises(errdefs.Unavailable):
+                sn.prepare("k", "")
+        assert self._no_staging_residue(sn)
+        _lifecycle(sn)  # same keys succeed after the fault clears
+
+    def test_fault_at_metastore_commit_keeps_snapshot_active(self, sn):
+        sn.prepare("k", "")
+        with failpoint.injected("metastore.commit", "error(Unavailable:db down)"):
+            with pytest.raises(errdefs.Unavailable):
+                sn.commit("layer", "k")
+        _, info, _ = sn.ms.get_info("k")
+        assert info.kind == ms.KIND_ACTIVE  # not half-committed
+        sn.commit("layer", "k")  # retry succeeds
+        sn.remove("layer")
+
+    def test_fault_at_metastore_remove_is_retryable(self, sn):
+        sn.prepare("k", "")
+        sn.commit("layer", "k")
+        with failpoint.injected("metastore.remove", "error(Unavailable)*1"):
+            with pytest.raises(errdefs.Unavailable):
+                sn.remove("layer")
+        sn.remove("layer")
+
+    def test_panic_at_metastore_create_rolls_back(self, sn):
+        with failpoint.injected("metastore.create", "panic"):
+            with pytest.raises(Panic):
+                sn.prepare("k", "")
+        assert self._no_staging_residue(sn)
+        # The row never landed, so the retry isn't poisoned.
+        _lifecycle(sn)
+
+    def test_one_shot_fault_then_full_lifecycle(self, sn):
+        failpoint.inject("metastore.create", "error(Unavailable)*1")
+        with pytest.raises(errdefs.Unavailable):
+            sn.prepare("prep-key", "")
+        _lifecycle(sn)  # the n-shot disarmed itself
+
+    def test_converter_pack_fault_surfaces(self):
+        import io
+
+        from nydus_snapshotter_tpu.converter import PackOption
+        from nydus_snapshotter_tpu.converter.convert import Pack
+
+        with failpoint.injected("converter.pack", "error(Unavailable:accel down)"):
+            with pytest.raises(errdefs.Unavailable):
+                Pack(io.BytesIO(), b"", PackOption())
+
+
+# ------------------------------------------------------------ restart budget
+
+
+class TestRestartBudget:
+    def test_backoff_sequence_and_exhaustion(self):
+        t = [0.0]
+        b = RestartBudget(max_restarts=3, window=60, base_delay=0.5, max_delay=8,
+                          clock=lambda: t[0])
+        assert b.next_delay("d") == 0.0          # first respawn immediate
+        assert b.next_delay("d") == 0.5          # then exponential
+        assert b.next_delay("d") == 1.0
+        assert b.next_delay("d") is None         # budget exhausted
+        assert b.exhausted("d")
+
+    def test_cap_applies(self):
+        t = [0.0]
+        b = RestartBudget(max_restarts=10, window=60, base_delay=2.0, max_delay=5.0,
+                          clock=lambda: t[0])
+        delays = [b.next_delay("d") for _ in range(6)]
+        assert delays == [0.0, 2.0, 4.0, 5.0, 5.0, 5.0]
+
+    def test_window_expiry_refills_budget(self):
+        t = [0.0]
+        b = RestartBudget(max_restarts=2, window=10, clock=lambda: t[0])
+        assert b.next_delay("d") == 0.0
+        assert b.next_delay("d") is not None
+        assert b.next_delay("d") is None
+        t[0] = 11.0  # events age out of the window
+        assert b.next_delay("d") == 0.0
+
+    def test_budgets_are_per_daemon(self):
+        b = RestartBudget(max_restarts=1)
+        assert b.next_delay("a") == 0.0
+        assert b.next_delay("a") is None
+        assert b.next_delay("b") == 0.0
+
+    def test_reset(self):
+        b = RestartBudget(max_restarts=1)
+        assert b.next_delay("d") == 0.0
+        b.reset("d")
+        assert b.next_delay("d") == 0.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RestartBudget(max_restarts=0)
+
+
+def _mk_config(tmp_path, **daemon_overrides) -> SnapshotterConfig:
+    root = str(tmp_path / "r")
+    os.makedirs(root, exist_ok=True)
+    cfg = SnapshotterConfig(root=root)
+    for k, v in daemon_overrides.items():
+        setattr(cfg.daemon, k, v)
+    cfg.validate()
+    return cfg
+
+
+class TestManagerCircuitBreaker:
+    """Acceptance: with a daemon-death fault injected on every restart,
+    the manager performs at most the budgeted respawns in the window,
+    then degrades — without busy-looping."""
+
+    def _mgr(self, tmp_path, max_restarts=3):
+        cfg = _mk_config(
+            tmp_path,
+            recover_policy=constants.RECOVER_POLICY_RESTART,
+            recover_max_restarts=max_restarts,
+            recover_backoff_secs=0.01,
+            recover_backoff_max_secs=0.02,
+        )
+        mgr = Manager(cfg, Database(cfg.database_path))
+        sleeps: list[float] = []
+        mgr._sleep = sleeps.append  # no real waiting in tests
+        return mgr, sleeps
+
+    def test_budgeted_respawns_then_degrade(self, tmp_path):
+        mgr, sleeps = self._mgr(tmp_path, max_restarts=3)
+        daemon = mgr.new_daemon("dX")
+        mgr.add_daemon(daemon)
+        degraded = []
+        mgr.on_degraded = lambda d: degraded.append(d.id)
+        event = DeathEvent(daemon_id="dX", path=daemon.states.api_socket)
+        with failpoint.injected("daemon.spawn", "error(OSError:spawn refused)"):
+            for _ in range(8):  # storm of death events
+                try:
+                    mgr.handle_death_event(event)
+                except OSError:
+                    pass  # the respawn attempt failed (as injected)
+        # At most the budgeted number of spawn attempts happened...
+        assert failpoint.counts()["daemon.spawn"] == 3
+        # ...the circuit opened exactly once...
+        assert degraded == ["dX"]
+        assert mgr.is_degraded("dX")
+        # ...with exponential backoff between respawns, not a hot loop.
+        assert sleeps == [0.01, 0.02]
+        mgr.stop()
+
+    def test_degraded_daemon_ignores_further_events(self, tmp_path):
+        mgr, _ = self._mgr(tmp_path, max_restarts=1)
+        daemon = mgr.new_daemon("dY")
+        mgr.add_daemon(daemon)
+        event = DeathEvent(daemon_id="dY", path="p")
+        with failpoint.injected("daemon.spawn", "error(OSError)"):
+            with pytest.raises(OSError):
+                mgr.handle_death_event(event)
+            mgr.handle_death_event(event)  # opens the circuit
+            assert mgr.is_degraded("dY")
+            before = failpoint.counts()["daemon.spawn"]
+            mgr.handle_death_event(event)  # ignored: no new spawn attempt
+        assert failpoint.counts()["daemon.spawn"] == before
+        mgr.stop()
+
+    def test_policy_none_never_consumes_budget(self, tmp_path):
+        cfg = _mk_config(tmp_path, recover_policy=constants.RECOVER_POLICY_NONE)
+        mgr = Manager(cfg, Database(cfg.database_path))
+        daemon = mgr.new_daemon("dZ")
+        mgr.add_daemon(daemon)
+        for _ in range(5):
+            mgr.handle_death_event(DeathEvent(daemon_id="dZ", path="p"))
+        assert mgr.restart_budget.restarts_in_window("dZ") == 0
+        assert not mgr.is_degraded("dZ")
+        mgr.stop()
+
+    def test_destroy_daemon_resets_budget_and_degradation(self, tmp_path):
+        mgr, _ = self._mgr(tmp_path, max_restarts=1)
+        daemon = mgr.new_daemon("dW")
+        mgr.add_daemon(daemon)
+        with failpoint.injected("daemon.spawn", "error(OSError)"):
+            with pytest.raises(OSError):
+                mgr.handle_death_event(DeathEvent(daemon_id="dW", path="p"))
+            mgr.handle_death_event(DeathEvent(daemon_id="dW", path="p"))
+        assert mgr.is_degraded("dW")
+        mgr.destroy_daemon(daemon)
+        assert not mgr.is_degraded("dW")
+        assert mgr.restart_budget.restarts_in_window("dW") == 0
+        mgr.stop()
+
+
+# ---------------------------------------------------------- monitor hygiene
+
+
+def _open_fds() -> int:
+    return len(os.listdir("/proc/self/fd"))
+
+
+class TestMonitorFdHygiene:
+    def test_repeated_setup_teardown_leaks_no_fds(self, tmp_path):
+        sock_path = str(tmp_path / "api.sock")
+        server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        server.bind(sock_path)
+        server.listen(16)
+        try:
+            base = _open_fds()
+            for _ in range(10):
+                m = LivenessMonitor()
+                m.subscribe("d1", sock_path)
+                m.run()
+                m.stop()
+                m.stop()  # idempotent double-stop must not raise
+                server.accept()[0].close()  # drain the backlog
+            assert _open_fds() <= base + 1
+        finally:
+            server.close()
+
+    def test_death_event_path_closes_fds(self, tmp_path):
+        sock_path = str(tmp_path / "api.sock")
+        server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        server.bind(sock_path)
+        server.listen(4)
+        m = LivenessMonitor()
+        try:
+            base = _open_fds()
+            m.subscribe("d1", sock_path)
+            m.run()
+            conn, _ = server.accept()
+            conn.close()  # hangup → death event
+            event = m.events.get(timeout=5)
+            assert event.daemon_id == "d1"
+            deadline = time.time() + 2
+            while _open_fds() > base + 1 and time.time() < deadline:
+                time.sleep(0.01)
+            # monitor epoll fd is the only thing left open beyond base
+            assert _open_fds() <= base + 1
+        finally:
+            m.stop()
+            server.close()
+
+    def test_failed_connect_leaks_no_socket(self, tmp_path):
+        m = LivenessMonitor()
+        try:
+            base = _open_fds()
+            for _ in range(5):
+                with pytest.raises(OSError):
+                    m.subscribe("ghost", str(tmp_path / "nope.sock"))
+            assert _open_fds() == base
+        finally:
+            m.stop()
+
+    def test_subscribe_after_stop_rejected(self, tmp_path):
+        sock_path = str(tmp_path / "api.sock")
+        server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        server.bind(sock_path)
+        server.listen(1)
+        m = LivenessMonitor()
+        m.stop()
+        try:
+            with pytest.raises(ValueError):
+                m.subscribe("d", sock_path)
+            with pytest.raises(ValueError):
+                m.run()
+        finally:
+            server.close()
+
+
+# --------------------------------------------------------------- slow sweep
+
+
+@pytest.mark.slow
+def test_full_chaos_matrix_sweep(tmp_path):
+    """Exhaustive failpoint-site × action sweep via the shared runner."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import chaos_matrix
+
+    results = chaos_matrix.run_matrix(str(tmp_path), fast=False)
+    bad = [r for r in results if not r.ok]
+    assert not bad, f"chaos matrix regressions: {bad}"

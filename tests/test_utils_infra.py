@@ -70,6 +70,76 @@ class TestRetry:
             retry.do(lambda: 1, attempts=0)
 
 
+class TestRetryJitterDeadline:
+    """New jitter/deadline knobs: defaults unchanged, full jitter on the
+    computed delay, and no retry started past the deadline."""
+
+    def _boom(self):
+        raise ValueError("x")
+
+    def test_full_jitter_scales_computed_delay(self):
+        sleeps = []
+        with pytest.raises(retry.RetryError):
+            retry.do(
+                self._boom, attempts=4, delay=1.0, backoff=2.0, max_delay=10.0,
+                sleep=sleeps.append, jitter=True, rng=lambda: 0.5,
+            )
+        assert sleeps == [0.5, 1.0, 2.0]  # half of 1, 2, 4
+
+    def test_jitter_zero_rng_means_no_wait(self):
+        sleeps = []
+        with pytest.raises(retry.RetryError):
+            retry.do(self._boom, attempts=3, delay=1.0,
+                     sleep=sleeps.append, jitter=True, rng=lambda: 0.0)
+        assert sleeps == [0.0, 0.0]
+
+    def test_deadline_stops_retrying_early(self):
+        t = [0.0]
+
+        def clock():
+            return t[0]
+
+        def sleep(d):
+            t[0] += d
+
+        calls = [0]
+
+        def boom():
+            calls[0] += 1
+            t[0] += 0.4  # each attempt burns 0.4s
+            raise OSError("down")
+
+        with pytest.raises(retry.RetryError) as ei:
+            retry.do(boom, attempts=10, delay=0.5, backoff=2.0,
+                     deadline=1.0, sleep=sleep, clock=clock)
+        # attempt(0.4) + sleep(0.5) + attempt(0.4) = 1.3 > 1.0: the third
+        # attempt's pause would overrun the budget, so it never starts.
+        assert calls[0] == 2
+        assert ei.value.deadline_exceeded
+        assert ei.value.attempts == 2
+        assert isinstance(ei.value.last, OSError)
+
+    def test_deadline_not_exceeded_behaves_normally(self):
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise OSError("nope")
+            return "ok"
+
+        assert retry.do(flaky, attempts=5, delay=0.001, deadline=30.0) == "ok"
+
+    def test_do_with_deadline_jitters_by_default(self):
+        sleeps = []
+        with pytest.raises(retry.RetryError):
+            retry.do_with_deadline(
+                self._boom, deadline=100.0, attempts=3, delay=1.0,
+                sleep=sleeps.append, rng=lambda: 0.25,
+            )
+        assert sleeps == [0.25, 0.5]
+
+
 class TestNativeBuild:
     """Against the real source tree (the engine is already built by the
     suite): staleness detection and the failure-memo contract."""

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Chaos matrix runner: sweep failpoint sites × actions × recovery policies.
+
+Runs OUTSIDE tier-1 (slow): a fast subset of the same scenarios is part
+of the tier-1 suite via tests/test_failpoint_chaos.py, which also drives
+this module's :func:`run_matrix` from its ``slow``-marked sweep test.
+
+Scenarios:
+
+- control-plane lifecycle: Prepare→Mounts→Commit→Remove on a real
+  Snapshotter (fake L3 facade) with a fault injected at each metastore /
+  fs site and each action (error / panic / n-shot). Pass criteria: the
+  fault surfaces as a typed error, no staging-dir residue is left, and
+  the identical operation succeeds after the fault clears.
+- manager circuit breaker: a spawn fault injected on every respawn, for
+  each recovery policy. Pass criteria: at most the budgeted respawn
+  attempts, exactly one degradation, no busy loop.
+
+Usage::
+
+    python tools/chaos_matrix.py [--fast] [--json OUT]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from dataclasses import dataclass
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nydus_snapshotter_tpu import constants, failpoint  # noqa: E402
+from nydus_snapshotter_tpu.config.config import SnapshotterConfig  # noqa: E402
+from nydus_snapshotter_tpu.failpoint.spec import Panic  # noqa: E402
+from nydus_snapshotter_tpu.manager.manager import Manager  # noqa: E402
+from nydus_snapshotter_tpu.manager.monitor import DeathEvent  # noqa: E402
+from nydus_snapshotter_tpu.snapshot.metastore import Usage  # noqa: E402
+from nydus_snapshotter_tpu.snapshot.snapshotter import Snapshotter  # noqa: E402
+from nydus_snapshotter_tpu.store.database import Database  # noqa: E402
+from nydus_snapshotter_tpu.utils import errdefs  # noqa: E402
+
+LIFECYCLE_SITES = (
+    "metastore.create",
+    "metastore.commit",
+    "metastore.remove",
+    "fs.mount",
+    "fs.umount",
+)
+ACTIONS = (
+    "error(Unavailable:injected)",
+    "error(OSError:injected)*1",
+    "panic",
+)
+POLICIES = (
+    constants.RECOVER_POLICY_RESTART,
+    constants.RECOVER_POLICY_FAILOVER,
+    constants.RECOVER_POLICY_NONE,
+)
+
+
+@dataclass
+class Result:
+    scenario: str
+    site: str
+    action: str
+    ok: bool
+    detail: str = ""
+
+    def row(self) -> str:
+        mark = "PASS" if self.ok else "FAIL"
+        return f"{mark}  {self.scenario:<12} {self.site:<18} {self.action:<28} {self.detail}"
+
+
+class _NullFs:
+    """Duck-typed L3 facade for native-mount flows (no daemons)."""
+
+    def __getattr__(self, name):
+        if name in ("referrer_detect_enabled", "stargz_enabled", "tarfs_enabled",
+                    "tarfs_export_enabled"):
+            return lambda *a: False
+        if name == "check_referrer":
+            return lambda labels: False
+        if name == "is_stargz_data_layer":
+            return lambda labels: (False, None)
+        if name == "cache_usage":
+            return lambda digest: Usage()
+        if name == "mount_point":
+            return self._raise_not_found
+        if name == "export_block_data":
+            return lambda *a: []
+        return lambda *a, **k: None
+
+    @staticmethod
+    def _raise_not_found(sid):
+        raise errdefs.NotFound(sid)
+
+
+def _lifecycle(sn: Snapshotter, tag: str) -> None:
+    key, name = f"prep-{tag}", f"layer-{tag}"
+    sn.prepare(key, "")
+    sn.mounts(key)
+    sn.commit(name, key)
+    sn.remove(name)
+
+
+def run_lifecycle_cell(root: str, site: str, action: str, tag: str) -> Result:
+    sn = Snapshotter(root=os.path.join(root, f"sn-{tag}"), fs=_NullFs())
+    try:
+        failpoint.inject(site, action)
+        faulted = False
+        try:
+            _lifecycle(sn, tag)
+        except (errdefs.NydusError, OSError, Panic, RuntimeError):
+            faulted = True
+        finally:
+            failpoint.clear(site)
+        residue = [d for d in os.listdir(sn.snapshot_root()) if d.startswith("new-")]
+        if residue:
+            return Result("lifecycle", site, action, False, f"staging residue {residue}")
+        # fs.* sites are no-ops for purely-native flows; a fault there may
+        # legitimately never fire. Metastore faults must have fired.
+        if site.startswith("metastore.") and not faulted:
+            return Result("lifecycle", site, action, False, "fault never surfaced")
+        # Recovery: the same lifecycle must succeed once the fault clears.
+        try:
+            _lifecycle(sn, tag + "-retry")
+        except Exception as e:  # noqa: BLE001
+            return Result("lifecycle", site, action, False, f"post-fault retry failed: {e}")
+        return Result("lifecycle", site, action, True)
+    finally:
+        sn.close()
+
+
+def run_breaker_cell(root: str, policy: str, tag: str) -> Result:
+    # Socket paths must fit in sun_path, so the manager root stays short
+    # regardless of how deep the caller's scratch dir is.
+    cfg = SnapshotterConfig(root=tempfile.mkdtemp(prefix=f"cm-{tag[:8]}-", dir="/tmp"))
+    cfg.daemon.recover_policy = policy
+    cfg.daemon.recover_max_restarts = 2
+    cfg.daemon.recover_backoff_secs = 0.001
+    cfg.daemon.recover_backoff_max_secs = 0.002
+    cfg.validate()
+    mgr = Manager(cfg, Database(cfg.database_path))
+    sleeps: list[float] = []
+    mgr._sleep = sleeps.append
+    degraded: list[str] = []
+    mgr.on_degraded = lambda d: degraded.append(d.id)
+    try:
+        # No supervisor session: failover degrades to a plain restart, so
+        # both policies exercise the budgeted-respawn path without waiting
+        # on a supervisor handshake that will never come.
+        daemon = mgr.new_daemon(f"d-{tag}", use_supervisor=False)
+        mgr.add_daemon(daemon)
+        event = DeathEvent(daemon_id=daemon.id, path=daemon.states.api_socket)
+        failpoint.clear()
+        failpoint.inject("daemon.spawn", "error(OSError:chaos spawn)")
+        try:
+            for _ in range(6):
+                try:
+                    mgr.handle_death_event(event)
+                except (OSError, errdefs.NydusError, TimeoutError):
+                    pass
+        finally:
+            failpoint.clear("daemon.spawn")
+        spawns = failpoint.counts().get("daemon.spawn", 0)
+        if policy == constants.RECOVER_POLICY_NONE:
+            ok = spawns == 0 and not degraded
+            detail = f"spawns={spawns} degraded={degraded}"
+        else:
+            # failover degrades to restart when no supervisor session exists,
+            # so both policies bound their spawn attempts the same way.
+            ok = spawns <= cfg.daemon.recover_max_restarts and degraded == [daemon.id]
+            detail = (
+                f"spawns={spawns}/{cfg.daemon.recover_max_restarts} "
+                f"degraded={len(degraded)} backoffs={sleeps}"
+            )
+        return Result("breaker", f"policy={policy}", "daemon.spawn=error", ok, detail)
+    finally:
+        mgr.stop()
+        failpoint.clear()
+
+
+def run_matrix(root: str, fast: bool = False) -> list[Result]:
+    results: list[Result] = []
+    failpoint.clear()
+    sites = LIFECYCLE_SITES[:2] if fast else LIFECYCLE_SITES
+    actions = ACTIONS[:1] if fast else ACTIONS
+    for i, site in enumerate(sites):
+        for j, action in enumerate(actions):
+            results.append(run_lifecycle_cell(root, site, action, f"{i}-{j}"))
+    for policy in POLICIES if not fast else POLICIES[:1]:
+        results.append(run_breaker_cell(root, policy, policy))
+    return results
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--fast", action="store_true", help="small subset of the matrix")
+    ap.add_argument("--json", default="", help="write machine-readable results here")
+    ap.add_argument("--root", default="", help="scratch dir (default: a temp dir)")
+    args = ap.parse_args()
+    root = args.root or tempfile.mkdtemp(prefix="chaos-matrix-")
+    results = run_matrix(root, fast=args.fast)
+    for r in results:
+        print(r.row())
+    failed = [r for r in results if not r.ok]
+    print(f"\n{len(results) - len(failed)}/{len(results)} cells passed")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump([r.__dict__ for r in results], f, indent=2)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
