@@ -213,21 +213,64 @@ ENGINE_ARMS = {
 }
 
 
+def _run_child_watchdog(argv: list[str], timeout: float):
+    """Run a child under a HARD watchdog: the wait happens on a worker
+    thread, so a child wedged in uninterruptible device I/O (the
+    BENCH_r05 "device probe hung >120s" failure: subprocess timeout fired
+    but the kill/reap itself stalled on the wedged TPU tunnel) can never
+    stall the bench main thread. On timeout the child's whole process
+    group is SIGKILLed and the reaper thread is abandoned (daemon) if
+    even the reap hangs.
+
+    Returns ``(returncode, stdout, stderr)`` or ``None`` on timeout/spawn
+    failure.
+    """
+    import signal
+    import subprocess
+    import threading
+
+    try:
+        proc = subprocess.Popen(
+            argv,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            start_new_session=True,  # own pgid: killpg reaps grandchildren
+        )
+    except OSError:
+        return None
+    result = {}
+
+    def _wait():
+        try:
+            result["out"], result["err"] = proc.communicate()
+        except Exception as e:  # noqa: BLE001 — watchdog must not raise
+            result["exc"] = e
+
+    waiter = threading.Thread(target=_wait, daemon=True)
+    waiter.start()
+    waiter.join(timeout)
+    if waiter.is_alive() or "exc" in result:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+        waiter.join(5.0)  # give the reap a moment; abandon it if stuck
+        return None
+    return proc.returncode, result.get("out", ""), result.get("err", "")
+
+
 def _time_engine_child(repo: str, chunk_size: int, kwargs: dict):
     """Timed process_many in a subprocess; None on failure/timeout."""
-    import subprocess
-
     child = _ENGINE_CHILD.format(
         repo=repo, mib=CALIBRATE_MIB, chunk_size=chunk_size, kwargs=kwargs
     )
+    res = _run_child_watchdog([sys.executable, "-c", child], timeout=240)
+    if res is None or res[0] != 0:
+        return None
     try:
-        out = subprocess.run(
-            [sys.executable, "-c", child], capture_output=True, text=True, timeout=240,
-        )
-        if out.returncode != 0:
-            return None
-        return float(out.stdout.strip().splitlines()[-1])
-    except (subprocess.TimeoutExpired, ValueError, IndexError):
+        return float(res[1].strip().splitlines()[-1])
+    except (ValueError, IndexError):
         return None
 
 
@@ -376,17 +419,23 @@ def _pack_layers(layers: list[bytes], opt, chunk_dict=None, stats=None) -> list:
     return [r for r, _st in results]
 
 
-def full_path_run(layers: list[bytes], opt) -> tuple[float, list, list, dict]:
+def full_path_run(layers: list[bytes], opt) -> tuple[float, list, list, dict, dict]:
     """Best-of-REPS wall time converting every layer of the image; also
     returns a per-stage wall breakdown (scan / chunk_digest / dedup /
     assemble / bootstrap) measured on a SEPARATE layer-serial pass —
     parallel-layer stage clocks would sum thread wall time (including
-    GIL/CPU contention) to more than the elapsed wall and mislead."""
+    GIL/CPU contention) to more than the elapsed wall and mislead — plus
+    a ``pipeline`` dict capturing the stage-parallel executor's overlap
+    win (parallel vs serial wall, per-stage busy/utilization, worker
+    counts and queue high-water) so the perf trajectory records it."""
     from nydus_snapshotter_tpu.converter.convert import pack_layer
+    from nydus_snapshotter_tpu.converter.stream import _pack_threads
+    from nydus_snapshotter_tpu.parallel import pipeline as pipeline_mod
 
     total = sum(len(t) for t in layers)
     best = None
     out = None
+    snap_before = pipeline_mod.snapshot_counters()
     for _ in range(REPS):
         t0 = time.time()
         packed = _pack_layers(layers, opt)
@@ -394,6 +443,7 @@ def full_path_run(layers: list[bytes], opt) -> tuple[float, list, list, dict]:
         if best is None or elapsed < best:
             best = elapsed
             out = packed
+    snap_after = pipeline_mod.snapshot_counters()
     stats: dict = {}
     t0 = time.time()
     for t in layers:
@@ -404,11 +454,45 @@ def full_path_run(layers: list[bytes], opt) -> tuple[float, list, list, dict]:
     breakdown = {k: round(v, 4) for k, v in sorted(stats.items())}
     breakdown["serial_wall"] = round(serial_wall, 4)
     breakdown["parallel_wall"] = round(best, 4)
+
+    n_threads = _pack_threads()
+    pcfg = pipeline_mod.resolve_config(n_threads)
+    runs = snap_after["runs"] - snap_before["runs"]
+    stage_busy = {
+        k: round((snap_after["stage_busy_s"][k] - snap_before["stage_busy_s"][k]) / REPS, 4)
+        for k in snap_after["stage_busy_s"]
+    }
+    pipeline_info = {
+        "enabled": pcfg.enabled,
+        "engaged_runs": runs / REPS if runs else 0.0,
+        "workers": {
+            "pack_threads": n_threads,
+            "chunk": pcfg.chunk_workers,
+            "compress": pcfg.compress_workers,
+        },
+        "parallel_wall": round(best, 4),
+        "serial_wall": round(serial_wall, 4),
+        "speedup": round(serial_wall / max(1e-9, best), 4),
+        # busy seconds per rep; utilization = busy / (wall × workers)
+        "stage_busy_s": stage_busy,
+        "stage_utilization": {
+            "chunk": round(
+                stage_busy.get("chunk", 0.0) / max(1e-9, best * pcfg.chunk_workers), 4
+            ),
+            "compress": round(
+                stage_busy.get("compress", 0.0)
+                / max(1e-9, best * pcfg.compress_workers),
+                4,
+            ),
+        },
+        "queue_high_water_bytes": snap_after["queue_high_water_bytes"],
+        "shed_bytes": snap_after["shed_bytes"] - snap_before["shed_bytes"],
+    }
     # Both lanes produce identical blobs; the headline is the best measured
     # full-path wall (the serial pass even carries stats overhead, so this
     # is conservative — it only de-noises, never flatters).
     best = min(best, serial_wall)
-    return total / best / (1 << 30), blobs, results, breakdown
+    return total / best / (1 << 30), blobs, results, breakdown, pipeline_info
 
 
 def dedup_shaped_run(opt, pool: list[bytes]) -> dict:
@@ -663,32 +747,32 @@ def stargz_zran_run(opt) -> dict:
 
 
 def _device_available(repo: str, timeout: float = 120.0) -> tuple[bool, str]:
-    """(ok, note) — probe jax.devices() in a subprocess: a wedged device
-    tunnel must degrade the bench to the host arm, not hang it. The note
-    records WHY the device was not engaged so a host-arm result is
-    attributable (wedged tunnel vs lost race vs import failure)."""
-    import subprocess
-
+    """(ok, note) — probe jax.devices() in a subprocess under the hard
+    watchdog (_run_child_watchdog): a wedged device tunnel must degrade
+    the bench to the host arm CLEANLY, never stall it (BENCH_r05 recorded
+    the whole bench wedging behind this probe). The note records WHY the
+    device was not engaged so a host-arm result is attributable (wedged
+    tunnel vs lost race vs import failure)."""
     child = (
         "import os, sys; os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',"
         " '/tmp/ntpu_jax_cache'); sys.path.insert(0, %r);"
         " import jax; print([d.platform for d in jax.devices()])" % repo
     )
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", child], capture_output=True, text=True,
-            timeout=timeout,
+    res = _run_child_watchdog([sys.executable, "-c", child], timeout=timeout)
+    if res is None:
+        return False, (
+            f"device probe hung >{timeout:.0f}s (wedged tunnel; watchdog "
+            "SIGKILLed the probe pgroup, bench fell back to host arm)"
         )
-        if out.returncode == 0 and out.stdout.strip():
-            platforms = out.stdout.strip().splitlines()[-1]
-            if "'cpu'" in platforms and "tpu" not in platforms:
-                # jax silently fell back to host CPU: that is NOT a device
-                return False, f"jax fell back to CPU-only ({platforms})"
-            return True, f"devices: {platforms}"
-        err = out.stderr.strip().splitlines()[-1] if out.stderr.strip() else ""
-        return False, f"device probe exited rc={out.returncode}: {err}"[:200]
-    except subprocess.TimeoutExpired:
-        return False, f"device probe hung >{timeout:.0f}s (wedged tunnel)"
+    rc, stdout, stderr = res
+    if rc == 0 and stdout.strip():
+        platforms = stdout.strip().splitlines()[-1]
+        if "'cpu'" in platforms and "tpu" not in platforms:
+            # jax silently fell back to host CPU: that is NOT a device
+            return False, f"jax fell back to CPU-only ({platforms})"
+        return True, f"devices: {platforms}"
+    err = stderr.strip().splitlines()[-1] if stderr.strip() else ""
+    return False, f"device probe exited rc={rc}: {err}"[:200]
 
 
 def main() -> None:
@@ -732,7 +816,9 @@ def main() -> None:
     # ---- headline: full-path convert of the node-shaped image ----
     opt = PackOption(chunk_size=CHUNK_SIZE, chunking="cdc", **_pack_kwargs(winner))
     layers, corpus_info = build_node_shaped_layers(IMAGE_MIB, seed=7)
-    full_gibps, blobs, results, stage_breakdown = full_path_run(layers, opt)
+    full_gibps, blobs, results, stage_breakdown, pipeline_info = full_path_run(
+        layers, opt
+    )
     comp_bytes = sum(r.blob_size for r in results)
     corpus_info["compress_ratio"] = round(
         comp_bytes / max(1, sum(len(t) for t in layers)), 4
@@ -924,6 +1010,7 @@ def main() -> None:
                     "calibration": cal,
                     "engine_flat": engine_detail,
                     "stage_breakdown_s": stage_breakdown,
+                    "pipeline": pipeline_info,
                     "accel_profile": accel_profile,
                     "zstd_profile": zstd_profile,
                     "reference_defaults_profile": reference_defaults_profile,

@@ -116,6 +116,31 @@ class ImageConfig:
 
 
 @dataclass
+class ConvertConfig:
+    """Stage-parallel conversion pipeline knobs (parallel/pipeline.py).
+
+    The pipeline overlaps chunk/digest, speculative compression and
+    ordered blob assembly inside one layer, and bounds memory in BYTES:
+    per-queue (``queue_mib``), actively-chunked window (``window_mib``)
+    and compressed-bytes-in-flight aggregate (``memory_budget_mib``,
+    shared across every concurrently converting layer). Worker counts of
+    0 mean auto (the pack-path worker request, clamped to cores).
+    Environment variables override per-process (``NTPU_PIPELINE``,
+    ``NTPU_CHUNK_THREADS``, ``NTPU_COMPRESS_THREADS``,
+    ``NTPU_PIPELINE_{QUEUE,BUDGET,WINDOW}_MIB``).
+    """
+
+    pipeline: str = "auto"  # auto | on | off
+    chunk_workers: int = 0
+    compress_workers: int = 0
+    queue_mib: int = 32
+    memory_budget_mib: int = 256
+    window_mib: int = 64
+    # Concurrently packing layers in batch conversion (0 = pool default).
+    layer_fanout: int = 0
+
+
+@dataclass
 class ExperimentalConfig:
     enable_stargz: bool = False
     enable_referrer_detect: bool = False
@@ -144,6 +169,7 @@ class SnapshotterConfig:
     snapshot: SnapshotConfig = field(default_factory=SnapshotConfig)
     cache_manager: CacheManagerConfig = field(default_factory=CacheManagerConfig)
     image: ImageConfig = field(default_factory=ImageConfig)
+    convert: ConvertConfig = field(default_factory=ConvertConfig)
     experimental: ExperimentalConfig = field(default_factory=ExperimentalConfig)
 
     # -- derived paths (reference config/global.go accessors) ---------------
@@ -199,6 +225,21 @@ class SnapshotterConfig:
             raise ConfigError("daemon.recover_max_restarts must be >= 1")
         if self.daemon.recover_window_secs <= 0 or self.daemon.recover_backoff_secs < 0:
             raise ConfigError("daemon recover window/backoff must be positive")
+        if self.convert.pipeline not in ("auto", "on", "off"):
+            raise ConfigError(
+                f"invalid convert.pipeline {self.convert.pipeline!r} "
+                "(auto | on | off)"
+            )
+        if self.convert.chunk_workers < 0 or self.convert.compress_workers < 0:
+            raise ConfigError("convert worker counts must be >= 0 (0 = auto)")
+        if self.convert.layer_fanout < 0:
+            raise ConfigError("convert.layer_fanout must be >= 0 (0 = auto)")
+        if (
+            self.convert.queue_mib <= 0
+            or self.convert.memory_budget_mib <= 0
+            or self.convert.window_mib <= 0
+        ):
+            raise ConfigError("convert queue/budget/window MiB must be positive")
         if self.daemon.fs_driver in (constants.FS_DRIVER_BLOCKDEV, constants.FS_DRIVER_PROXY):
             # Proxy/blockdev modes run without nydusd daemons
             # (reference config.go:300-311 forces daemon_mode none).

@@ -170,6 +170,16 @@ class BatchConverter:
     an image); the dict grows between images, so image N dedups against
     images 0..N-1 plus any seeded dict — the top-100/cross-repo shape of
     BASELINE configs #3/#5.
+
+    Multi-layer fan-out runs under ONE aggregate memory budget: every
+    layer's stage-parallel pipeline (parallel/pipeline.py) draws its
+    speculative-compression bytes from the same
+    :class:`~nydus_snapshotter_tpu.parallel.pipeline.MemoryBudget`, so
+    batch convert memory is bounded regardless of how many layers the
+    fan-out has in flight or how large each is. ``layer_fanout`` caps the
+    concurrently packing layers (0/None = the pool default);
+    ``memory_budget_mib`` sizes a converter-private budget instead of the
+    process-shared one.
     """
 
     def __init__(
@@ -177,14 +187,24 @@ class BatchConverter:
         opt: PackOption,
         dict_path: Optional[str] = None,
         max_workers: Optional[int] = None,
+        memory_budget_mib: Optional[int] = None,
+        layer_fanout: Optional[int] = None,
     ):
         if opt.chunk_dict_path:
             raise ConvertError(
                 "BatchConverter owns the chunk dict; use dict_path= instead "
                 "of PackOption.chunk_dict_path"
             )
+        from nydus_snapshotter_tpu.parallel import pipeline as pipeline_mod
+
         self.opt = opt
         self.max_workers = max_workers
+        self.layer_fanout = layer_fanout
+        self.budget = (
+            pipeline_mod.MemoryBudget(memory_budget_mib << 20)
+            if memory_budget_mib
+            else pipeline_mod.shared_budget()
+        )
         self.dict = (
             GrowingChunkDict.load(dict_path) if dict_path else GrowingChunkDict()
         )
@@ -195,11 +215,18 @@ class BatchConverter:
 
         def pack_one(tar: bytes) -> tuple[bytes, PackResult]:
             out = io.BytesIO()
-            res = Pack(out, tar, self.opt, chunk_dict=self.dict if len(self.dict) else None)
+            res = Pack(
+                out,
+                tar,
+                self.opt,
+                chunk_dict=self.dict if len(self.dict) else None,
+                budget=self.budget,
+            )
             return out.getvalue(), res
 
         if len(layer_tars) > 1:
-            with ThreadPoolExecutor(max_workers=self.max_workers) as pool:
+            fanout = self.layer_fanout or self.max_workers
+            with ThreadPoolExecutor(max_workers=fanout) as pool:
                 packed = list(pool.map(pack_one, layer_tars))
         else:
             packed = [pack_one(layer_tars[0])]

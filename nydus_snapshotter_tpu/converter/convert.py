@@ -263,6 +263,7 @@ def Pack(
     opt: PackOption,
     chunk_dict=None,
     stats: dict | None = None,
+    budget=None,
 ) -> PackResult:
     """Convert one OCI layer tar into a nydus blob stream written to dest.
 
@@ -271,21 +272,30 @@ def Pack(
     only referenced. Implementation: the bounded-memory streaming pipeline
     in converter/stream.py (tar stream -> incremental CDC -> batched
     digests -> dedup -> compress -> dest), shared by in-memory and
-    streaming callers alike.
+    streaming callers alike. On multi-worker hosts the per-layer stages
+    overlap through the stage-parallel executor (parallel/pipeline.py);
+    ``budget`` optionally pins that executor to a caller-owned
+    MemoryBudget (batch conversion shares one across layers).
     """
     from nydus_snapshotter_tpu import failpoint
     from nydus_snapshotter_tpu.converter.stream import pack_stream
 
     failpoint.hit("converter.pack")
-    return pack_stream(dest, src_tar, opt, chunk_dict=chunk_dict, stats=stats)
+    return pack_stream(
+        dest, src_tar, opt, chunk_dict=chunk_dict, stats=stats, budget=budget
+    )
 
 
 def pack_layer(
-    src_tar: bytes, opt: PackOption, chunk_dict=None, stats: dict | None = None
+    src_tar: bytes,
+    opt: PackOption,
+    chunk_dict=None,
+    stats: dict | None = None,
+    budget=None,
 ) -> tuple[bytes, PackResult]:
     """Convenience: Pack to bytes."""
     out = io.BytesIO()
-    res = Pack(out, src_tar, opt, chunk_dict=chunk_dict, stats=stats)
+    res = Pack(out, src_tar, opt, chunk_dict=chunk_dict, stats=stats, budget=budget)
     return out.getvalue(), res
 
 

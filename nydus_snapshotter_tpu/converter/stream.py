@@ -704,6 +704,7 @@ def pack_stream(
     opt: PackOption,
     chunk_dict=None,
     stats: "Optional[dict]" = None,
+    budget=None,
 ):
     """Stream one OCI layer tar into a nydus blob written to ``dest``.
 
@@ -719,6 +720,12 @@ def pack_stream(
     ``chunk_digest`` CDC + chunk SHA-256, ``dedup`` dedup/bookkeeping,
     ``assemble`` compression + blob append + blob digest,
     ``bootstrap`` inode/chunk-table serialization.
+
+    ``budget``: optional :class:`parallel.pipeline.MemoryBudget` bounding
+    this conversion's speculative-compression bytes in flight; batch
+    conversion passes ONE budget for every concurrently packing layer so
+    aggregate convert memory stays independent of layer count. ``None``
+    draws from the process-wide shared budget.
     """
     import io
     from time import perf_counter as _pc
@@ -1066,95 +1073,125 @@ def pack_stream(
             _t_chunk += _pc() - _tc
 
         # Within-layer parallelism for multi-core hosts (the reference gets
-        # it from the builder's internal thread pool): phase A chunks +
-        # digests every file concurrently (native calls drop the GIL),
-        # phase B speculatively compresses unique chunks into a
-        # digest-keyed cache — compression is deterministic, so racing
+        # it from the builder's internal thread pool): the stage-parallel
+        # pipeline (parallel/pipeline.py) chunks + digests files on a
+        # worker pool, speculatively compresses each unique chunk as soon
+        # as its digest exists — compression is deterministic, so racing
         # duplicate digests write identical bytes — and the ordered serial
-        # walk below only assembles. Blob bytes are identical to the
-        # serial path (pinned by tests/test_fast_tar.py).
-        file_chunks: dict[int, list] = {}
-        comp_cache: dict[bytes, tuple[bytes, int]] = {}
+        # walk below only dedups + assembles. Queues between stages are
+        # byte-bounded and compressed bytes in flight draw from a
+        # MemoryBudget (shared across layers in batch conversion), so
+        # convert memory stays independent of layer size and count. Blob
+        # bytes are identical to the serial path (pinned by
+        # tests/test_fast_tar.py and tests/test_pipeline_determinism.py).
+        comp_cache: dict[bytes, tuple[bytes, int]] = {}  # serial-path default
         file_idxs = [i for i, (tag, *_rest) in enumerate(plan) if tag == "file"]
-        # Fused arm only: that is what makes phase A's threads actually
-        # parallel (GIL-dropping native calls) — numpy would serialize
-        # under the GIL and jax would bypass the engine's double-buffered
-        # device dispatch discipline.
-        if n_threads > 1 and len(file_idxs) > 1 and shared_chunker.fused:
-            from concurrent.futures import ThreadPoolExecutor
+        # Host arms only: fused/native/numpy chunking is safe to call from
+        # worker threads (GIL-dropping where it matters); the jax lanes
+        # keep their own double-buffered device dispatch discipline.
+        pipe = None
+        if (
+            n_threads > 1
+            and len(file_idxs) > 1
+            and opt.backend in ("hybrid", "numpy")
+            and opt.digest_backend != "jax"
+        ):
+            from nydus_snapshotter_tpu.parallel import pipeline as pipeline_mod
 
-            def _chunk_one(i: int):
-                _tag, _meta, off, size = plan[i]
-                return i, shared_chunker.chunk_whole(raw[off : off + size])
+            pcfg = pipeline_mod.resolve_config(n_threads)
+            if pcfg.enabled:
+                digest_fn = None
+                if not shared_chunker.fused:
+                    # Non-fused engines cut without digesting; digest in
+                    # the worker (same bytes → same digests as the batched
+                    # host dispatch) so dedup and speculative compression
+                    # can run ahead of the ordered walk.
+                    from nydus_snapshotter_tpu.ops.chunker import (
+                        host_digests_for as _hdf,
+                    )
 
-            with ThreadPoolExecutor(max_workers=min(32, n_threads)) as pool:
-                _tc = _pc()
-                for i, chunks in pool.map(_chunk_one, file_idxs):
-                    file_chunks[i] = chunks
-                _t_chunk += _pc() - _tc
+                    digest_fn = _hdf(opt.digester)
 
+                def _chunk_one(i: int):
+                    _tag, _meta, off, size = plan[i]
+                    chunks = shared_chunker.chunk_whole(raw[off : off + size])
+                    if digest_fn is not None and chunks:
+                        items = []
+                        s = off
+                        for view, _d in chunks:
+                            items.append((arr_all, s, len(view)))
+                            s += len(view)
+                        digs = digest_fn(items)
+                        chunks = [(v, d) for (v, _), d in zip(chunks, digs)]
+                    return chunks
+
+                compress_fn = None
+                compress_eligible = None
                 if opt.compressor in ("lz4_block", "zstd") and not isinstance(
                     section, _DeferredSectionWriter
                 ):
                     # (Deferred sections compress inside the native pass
                     # with their own thread fan-out — speculating here
-                    # would do the work twice.)
+                    # would do the work twice.) Per-thread codec contexts:
+                    # lz4 calls are stateless, zstd contexts are not
+                    # thread-safe; both codecs are deterministic.
                     from nydus_snapshotter_tpu.converter.convert import (
                         ThreadSafeCompressor,
                     )
 
-                    # Per-thread codec contexts: lz4 calls are stateless,
-                    # zstd contexts are not thread-safe; both codecs are
-                    # deterministic, so racing duplicate digests write
-                    # identical bytes.
-                    ts_compress = ThreadSafeCompressor(
+                    compress_fn = ThreadSafeCompressor(
                         opt.compressor, opt.lz4_acceleration
                     )
                     batch_limit = opt.batch_size
 
-                    def _comp_one(item):
-                        digest, view = item
-                        if digest in comp_cache:
-                            return
+                    def compress_eligible(digest, view):
+                        if batch_limit and len(view) < batch_limit:
+                            return False  # batch-packed: compressed jointly
                         if chunk_dict is not None and chunk_dict.get(digest):
-                            return  # dict hit: never stored, never compressed
-                        comp_cache[digest] = ts_compress(view)
+                            return False  # dict hit: never stored
+                        return True
 
-                    todo = []
-                    seen: set[bytes] = set()
-                    for i in file_idxs:
-                        for view, digest in file_chunks[i]:
-                            if (
-                                digest is None
-                                or digest in seen
-                                or (batch_limit and len(view) < batch_limit)
-                            ):
-                                continue
-                            seen.add(digest)
-                            todo.append((digest, view))
-                    _ts = _pc()
-                    list(pool.map(_comp_one, todo))
-                    _t_spec += _pc() - _ts
-
-        for i, (tag, meta, off, size) in enumerate(plan):
-            view = raw[off : off + size]
-            if tag == "small":  # ≤ min_size ⇒ exactly one chunk
-                _process([(meta, view)], [next(small_digests)])
-                continue
-            chunks = file_chunks.get(i)
-            if chunks is None:
-                _tc = _pc()
-                chunks = shared_chunker.chunk_whole(view)
-                _t_chunk += _pc() - _tc
-            if chunks and chunks[0][1] is not None:
-                _process(
-                    [(meta, c) for c, _ in chunks],
-                    [d for _, d in chunks],
-                    comp_cache=comp_cache,
+                pipe = pipeline_mod.ConvertPipeline(
+                    items=[(i, plan[i][3]) for i in file_idxs],
+                    chunk_fn=_chunk_one,
+                    compress_fn=compress_fn,
+                    compress_eligible=compress_eligible,
+                    config=pcfg,
+                    budget=budget,
+                    stats=stats,
                 )
-            else:
-                for chunk, digest in chunks:
-                    _add_chunk(meta, chunk, digest)
+                # Serial-path equivalence: any walk-time chunks (sparse
+                # members) sit in the pending digest batches and would be
+                # section.add'ed before the plan's chunks — drain them now
+                # so the pipelined immediate _process keeps that order.
+                _drain_all()
+
+        from contextlib import nullcontext
+
+        with pipe if pipe is not None else nullcontext():
+            for i, (tag, meta, off, size) in enumerate(plan):
+                view = raw[off : off + size]
+                if tag == "small":  # ≤ min_size ⇒ exactly one chunk
+                    _process([(meta, view)], [next(small_digests)])
+                    continue
+                _tc = _pc()
+                chunks = (
+                    pipe.chunks_for(i)
+                    if pipe is not None
+                    else shared_chunker.chunk_whole(view)
+                )
+                _t_chunk += _pc() - _tc
+                if chunks and chunks[0][1] is not None:
+                    _process(
+                        [(meta, c) for c, _ in chunks],
+                        [d for _, d in chunks],
+                        comp_cache=pipe.comp
+                        if pipe is not None and pipe.compress_fn is not None
+                        else comp_cache,
+                    )
+                else:
+                    for chunk, digest in chunks:
+                        _add_chunk(meta, chunk, digest)
     _t2 = _pc()
     _drain_all()
     section.finish()

@@ -68,6 +68,11 @@ KNOWN_SITES = (
     "metastore.commit",      # snapshot/metastore.py commit_active
     "metastore.remove",      # snapshot/metastore.py remove
     "converter.pack",        # converter/convert.py Pack dispatch
+    "pipeline.chunk",        # parallel/pipeline.py chunk-worker item entry
+    "pipeline.queue",        # parallel/pipeline.py ByteBoundedQueue.put
+    "pipeline.compress",     # parallel/pipeline.py compress-worker item entry
+    "pipeline.assemble",     # parallel/pipeline.py ordered chunks_for fetch
+    "fused.dispatch",        # ops/fused_convert.py device batch dispatch
 )
 
 _lock = threading.Lock()

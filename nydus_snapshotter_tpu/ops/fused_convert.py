@@ -58,6 +58,40 @@ class FusedOverflow(RuntimeError):
     callers fall back to the windowed bitmap-download path."""
 
 
+def _record_dispatch(n_bytes: int, pass1_s: float, host_s: float, pass2_s: float) -> None:
+    """Fused-convert stage counters next to the pipeline's
+    (ntpu_convert_pipeline_*): pass1 = gear+compaction dispatch, host =
+    cut resolution + bucket plan (the host arm between dispatches),
+    pass2 = gather+digest+probe dispatch."""
+    from nydus_snapshotter_tpu.metrics import registry as _metrics
+
+    reg = _metrics.default_registry
+    disp = reg.register(
+        _metrics.Counter(
+            "ntpu_fused_convert_dispatches",
+            "Fused device convert batches dispatched",
+        )
+    )
+    by_bytes = reg.register(
+        _metrics.Counter(
+            "ntpu_fused_convert_bytes",
+            "Bytes processed by fused device convert batches",
+        )
+    )
+    busy = reg.register(
+        _metrics.Counter(
+            "ntpu_fused_convert_stage_seconds",
+            "Wall seconds per fused-convert stage",
+            ("stage",),
+        )
+    )
+    disp.inc()
+    by_bytes.inc(n_bytes)
+    busy.labels("pass1_gear").inc(pass1_s)
+    busy.labels("host_resolve").inc(host_s)
+    busy.labels("pass2_digest").inc(pass2_s)
+
+
 def _pow2_ceil(n: int) -> int:
     return 1 << (n - 1).bit_length() if n > 1 else 1
 
@@ -514,6 +548,15 @@ class FusedDeviceEngine:
         depth: int = 8,
         probe_kernel: str = "auto",
     ) -> FusedResult:
+        from time import perf_counter as _pc
+
+        from nydus_snapshotter_tpu import failpoint
+
+        # Device batch boundary: chaos-testable (the stream.py caller
+        # falls back to the per-file host paths on error) and timed so
+        # the host-arm scheduling around the two dispatches is visible
+        # next to the pipeline's stage counters.
+        failpoint.hit("fused.dispatch")
         arrs = [
             np.frombuffer(s, dtype=np.uint8) if isinstance(s, (bytes, bytearray)) else s
             for s in streams
@@ -525,14 +568,18 @@ class FusedDeviceEngine:
                 digests=[[] for _ in arrs],
                 probe=np.zeros(0, np.int32) if chunk_dict is not None else None,
             )
+        _t0 = _pc()
         buf, table = self.layout(arrs)
         buffer_dev = jnp.asarray(buf)  # committed to the default device
         cand_s, cand_l = self.candidates(buffer_dev, n)
+        _t1 = _pc()
         cuts = self.resolve(cand_s, cand_l, table)
         buckets, order = self.plan_buckets(table, cuts)
+        _t2 = _pc()
         states, probe = self.digest_probe(
             buffer_dev, buckets, chunk_dict, depth, probe_kernel
         )
+        _record_dispatch(n, _t1 - _t0, _t2 - _t1, _pc() - _t2)
         by_cap = {
             b.cap_blocks: np.asarray(jax.device_get(s))
             for b, s in zip(buckets, states)

@@ -1,0 +1,197 @@
+"""Failpoint chaos at the conversion pipeline's stage boundaries.
+
+The concurrency contract under fault: whichever stage dies first
+(chunk worker, queue producer, compress worker, assembler fetch), the
+first error propagates to the Pack caller, queues drain instead of
+wedging producers, worker threads all join (no leaks — the CI smoke job
+re-runs this under PYTHONDEVMODE), charges return to the memory budget,
+and nothing partial is left behind (Pack writes only into the caller's
+stream; a failed pack_layer leaves no artifact).
+"""
+
+from __future__ import annotations
+
+import io
+import tarfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.converter.convert import pack_layer
+from nydus_snapshotter_tpu.converter.types import PackOption
+from nydus_snapshotter_tpu.parallel import pipeline as pl
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoint.clear()
+    yield
+    failpoint.clear()
+
+
+@pytest.fixture(autouse=True)
+def _force_pipeline(monkeypatch):
+    monkeypatch.setenv("NTPU_PACK_THREADS", "4")
+    monkeypatch.setenv("NTPU_PACK_THREADS_FORCE", "1")
+
+
+def _mk_layer(n_files=14, seed=3) -> bytes:
+    rng = np.random.default_rng(seed)
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+        for i in range(n_files):
+            data = rng.integers(
+                0, 256, int(rng.integers(30_000, 200_000)), dtype=np.uint8
+            ).tobytes()
+            ti = tarfile.TarInfo(f"c/f{i}")
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+    return buf.getvalue()
+
+
+LAYER = _mk_layer()
+OPT_KW = {"chunk_size": 0x10000}
+
+
+def _pipe_threads() -> list[threading.Thread]:
+    return [t for t in threading.enumerate() if t.name.startswith("ntpu-pipe")]
+
+
+def _assert_joined(deadline=5.0):
+    """Every pipeline worker must terminate (threads join in __exit__,
+    so any survivor is a leak)."""
+    end = time.monotonic() + deadline
+    while _pipe_threads() and time.monotonic() < end:
+        time.sleep(0.01)
+    assert not _pipe_threads(), f"leaked: {[t.name for t in _pipe_threads()]}"
+
+
+SITES = ["pipeline.chunk", "pipeline.queue", "pipeline.compress", "pipeline.assemble"]
+
+
+class TestStageFaults:
+    @pytest.mark.parametrize("site", SITES)
+    def test_error_propagates_and_threads_join(self, site):
+        failpoint.inject(site, "error(OSError:injected)")
+        with pytest.raises(OSError, match="injected"):
+            pack_layer(LAYER, PackOption(**OPT_KW))
+        _assert_joined()
+        assert failpoint.counts().get(site, 0) >= 1
+
+    @pytest.mark.parametrize("site", SITES)
+    def test_midlayer_oneshot_abort(self, site):
+        """A single fault mid-stream (n-shot, while other stages are in
+        flight) aborts the whole layer exactly once; the next convert of
+        the same layer succeeds and is byte-identical to serial."""
+        failpoint.inject(site, "error(RuntimeError:midlayer)*1")
+        with pytest.raises(RuntimeError, match="midlayer"):
+            pack_layer(LAYER, PackOption(**OPT_KW))
+        _assert_joined()
+        # site disarmed after 1 shot: retry converts cleanly
+        blob_retry, _ = pack_layer(LAYER, PackOption(**OPT_KW))
+        failpoint.clear()
+        import os
+
+        os.environ["NTPU_PACK_THREADS"] = "1"
+        try:
+            blob_serial, _ = pack_layer(LAYER, PackOption(**OPT_KW))
+        finally:
+            os.environ["NTPU_PACK_THREADS"] = "4"
+        assert blob_retry == blob_serial
+
+    def test_panic_escapes_pipeline(self):
+        """Injected Panic (BaseException) must cross the worker boundary
+        and re-raise on the caller thread, not vanish into a thread."""
+        failpoint.inject("pipeline.compress", "panic(boom)")
+        with pytest.raises(failpoint.Panic):
+            pack_layer(LAYER, PackOption(**OPT_KW))
+        _assert_joined()
+
+    def test_budget_drains_after_fault(self, monkeypatch):
+        """Compress-stage charges must return to a shared budget on
+        abort — a leaked charge would starve every later conversion."""
+        budget = pl.MemoryBudget(64 << 20)
+        failpoint.inject("pipeline.compress", "error(OSError:mid)*1")
+        out = io.BytesIO()
+        from nydus_snapshotter_tpu.converter.convert import Pack
+
+        with pytest.raises(OSError):
+            Pack(out, LAYER, PackOption(**OPT_KW), budget=budget)
+        _assert_joined()
+        assert budget.held == 0
+
+    def test_queue_producer_crash_does_not_wedge_consumers(self):
+        """Kill the producer side (chunk worker putting into the compress
+        queue) with a tiny queue so peers are blocked mid-put: everything
+        must still unwind within the join deadline."""
+        failpoint.inject("pipeline.chunk", "error(OSError:producer)*1")
+        import os
+
+        os.environ["NTPU_PIPELINE_QUEUE_MIB"] = "1"
+        try:
+            with pytest.raises(OSError):
+                pack_layer(LAYER, PackOption(**OPT_KW))
+        finally:
+            os.environ.pop("NTPU_PIPELINE_QUEUE_MIB", None)
+        _assert_joined()
+
+    def test_delay_fault_changes_nothing(self):
+        """A latency fault (stage stall) must only slow the pipeline —
+        output stays byte-identical to serial."""
+        failpoint.inject("pipeline.compress", "delay(0.02)%0.5")
+        blob_slow, _ = pack_layer(LAYER, PackOption(**OPT_KW))
+        failpoint.clear()
+        import os
+
+        os.environ["NTPU_PACK_THREADS"] = "1"
+        try:
+            blob_serial, _ = pack_layer(LAYER, PackOption(**OPT_KW))
+        finally:
+            os.environ["NTPU_PACK_THREADS"] = "4"
+        assert blob_slow == blob_serial
+        _assert_joined()
+
+    def test_no_partial_output_consumed_on_fault(self, tmp_path):
+        """A Pack into a real temp file that fails mid-layer: the caller
+        owns cleanup, and the file must not look like a valid blob
+        (no bootstrap/TOC framing ever lands)."""
+        from nydus_snapshotter_tpu.converter.convert import (
+            Pack,
+            bootstrap_from_layer_blob,
+        )
+        from nydus_snapshotter_tpu.converter.types import ConvertError
+
+        failpoint.inject("pipeline.assemble", "error(OSError:late)*1")
+        dest = tmp_path / "partial.blob"
+        with open(dest, "wb") as f, pytest.raises(OSError):
+            Pack(f, LAYER, PackOption(**OPT_KW))
+        _assert_joined()
+        data = dest.read_bytes()
+        with pytest.raises((ConvertError, Exception)):
+            bootstrap_from_layer_blob(data)
+
+
+class TestRepeatedChaos:
+    def test_alternating_fault_and_success_is_stable(self):
+        """Fault → recover → fault … : no cross-run contamination (stale
+        queue state, leaked threads, poisoned shared budget)."""
+        good = None
+        for round_i in range(3):
+            failpoint.inject("pipeline.compress", "error(OSError:r)*1")
+            with pytest.raises(OSError):
+                pack_layer(LAYER, PackOption(**OPT_KW))
+            failpoint.clear()
+            blob, _ = pack_layer(LAYER, PackOption(**OPT_KW))
+            if good is None:
+                good = blob
+            assert blob == good
+        _assert_joined()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

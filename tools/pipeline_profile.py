@@ -1,0 +1,113 @@
+"""Pipeline profile: serial vs stage-parallel convert of one synthetic
+layer set, with per-stage busy/utilization and queue high-water from the
+``ntpu_convert_pipeline_*`` metrics.
+
+Doubles as the CI smoke driver: ``--threads 2 --mib 8`` under
+``PYTHONDEVMODE=1`` converts with the pipeline forcibly engaged, checks
+byte identity against the serial walk in-process, and exits non-zero on
+any mismatch, error, or leaked pipeline thread — surfacing unjoined
+threads and unclosed resources the way the devmode CI job expects.
+
+Usage: python tools/pipeline_profile.py [--mib 32] [--threads N] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mib", type=int, default=32, help="corpus size")
+    ap.add_argument(
+        "--threads",
+        type=int,
+        default=max(2, os.cpu_count() or 1),
+        help="pipeline worker request (forced past the core clamp)",
+    )
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args()
+
+    os.environ["NTPU_PACK_THREADS_FORCE"] = "1"
+
+    import bench
+    from nydus_snapshotter_tpu.converter.convert import pack_layer
+    from nydus_snapshotter_tpu.converter.types import PackOption
+    from nydus_snapshotter_tpu.parallel import pipeline as pl
+
+    layers, info = bench.build_node_shaped_layers(args.mib, seed=7)
+    total = sum(len(t) for t in layers)
+    opt = PackOption(chunk_size=0x10000, chunking="cdc", backend="hybrid")
+
+    def run(threads: int):
+        os.environ["NTPU_PACK_THREADS"] = str(threads)
+        t0 = time.time()
+        blobs = [pack_layer(t, opt)[0] for t in layers]
+        return time.time() - t0, blobs
+
+    run(1)  # warm-up (native build, pools)
+    serial_wall, serial_blobs = run(1)
+    before = pl.snapshot_counters()
+    pipe_wall, pipe_blobs = run(args.threads)
+    after = pl.snapshot_counters()
+
+    identical = serial_blobs == pipe_blobs
+    engaged = after["runs"] > before["runs"]
+    leaked = [t.name for t in threading.enumerate() if t.name.startswith("ntpu-pipe")]
+    stage_busy = {
+        k: round(after["stage_busy_s"][k] - before["stage_busy_s"][k], 4)
+        for k in after["stage_busy_s"]
+    }
+    report = {
+        "corpus_mib": args.mib,
+        "files": info["files"],
+        "threads": args.threads,
+        "serial_wall_s": round(serial_wall, 4),
+        "pipeline_wall_s": round(pipe_wall, 4),
+        "speedup": round(serial_wall / max(1e-9, pipe_wall), 3),
+        "gibps_serial": round(total / serial_wall / (1 << 30), 4),
+        "gibps_pipeline": round(total / pipe_wall / (1 << 30), 4),
+        "pipeline_engaged": engaged,
+        "byte_identical": identical,
+        "stage_busy_s": stage_busy,
+        "stage_utilization": after["stage_utilization"],
+        "queue_high_water_bytes": after["queue_high_water_bytes"],
+        "shed_bytes": after["shed_bytes"] - before["shed_bytes"],
+        "leaked_threads": leaked,
+    }
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(f"corpus: {args.mib} MiB / {info['files']} files")
+        print(
+            f"serial {serial_wall:.3f}s  pipeline({args.threads}w) "
+            f"{pipe_wall:.3f}s  speedup {report['speedup']}x"
+        )
+        print(f"stage busy: {stage_busy}  util: {after['stage_utilization']}")
+        print(
+            f"queue high-water: {after['queue_high_water_bytes']}  "
+            f"shed: {report['shed_bytes']} B"
+        )
+        print(f"byte-identical: {identical}  engaged: {engaged}  leaked: {leaked}")
+    if not identical:
+        print("FAIL: pipelined blobs differ from serial", file=sys.stderr)
+        return 1
+    if not engaged:
+        print("FAIL: pipeline did not engage", file=sys.stderr)
+        return 1
+    if leaked:
+        print(f"FAIL: leaked pipeline threads {leaked}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
