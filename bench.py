@@ -746,6 +746,34 @@ def stargz_zran_run(opt) -> dict:
     }
 
 
+_LAZY_READ_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from tools.lazy_read_profile import profile
+print(json.dumps(profile(mib=8, workers=4, latency_ms=2.0)))
+"""
+
+
+def lazy_read_run(repo: str, timeout: float = 240.0) -> dict:
+    """Cold vs warm lazy-read profile (tools/lazy_read_profile.py) in a
+    child under the hard watchdog: the fetch scheduler spins worker
+    threads, and a wedged pool must cost the bench one timeout, not a
+    hang. Returns the profile dict or a {'error': ...} marker."""
+    res = _run_child_watchdog(
+        [sys.executable, "-c", _LAZY_READ_CHILD.format(repo=repo)], timeout=timeout
+    )
+    if res is None:
+        return {"error": f"lazy-read profile hung >{timeout:.0f}s (watchdog killed it)"}
+    rc, stdout, stderr = res
+    if rc != 0:
+        tail = stderr.strip().splitlines()[-1] if stderr.strip() else ""
+        return {"error": f"lazy-read profile exited rc={rc}: {tail}"[:200]}
+    try:
+        return json.loads(stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": "lazy-read profile produced no JSON"}
+
+
 def _device_available(repo: str, timeout: float = 120.0) -> tuple[bool, str]:
     """(ok, note) — probe jax.devices() in a subprocess under the hard
     watchdog (_run_child_watchdog): a wedged device tunnel must degrade
@@ -982,6 +1010,7 @@ def main() -> None:
     shaped = dedup_shaped_run(opt, pool)
     stargz_zran = stargz_zran_run(opt)
     real_image = real_image_run(opt)
+    lazy_read = lazy_read_run(repo)
 
     print(
         json.dumps(
@@ -1011,6 +1040,7 @@ def main() -> None:
                     "engine_flat": engine_detail,
                     "stage_breakdown_s": stage_breakdown,
                     "pipeline": pipeline_info,
+                    "lazy_read": lazy_read,
                     "accel_profile": accel_profile,
                     "zstd_profile": zstd_profile,
                     "reference_defaults_profile": reference_defaults_profile,

@@ -4,6 +4,12 @@ Reference pkg/cache/manager.go:33-122: blob caches live under one cache dir
 as ``<blobID>`` plus suffixed companions (``.blob.data``, ``.chunk_map``,
 ``.blob.meta``, ``.image.disk``, ``.layer.disk``); usage is a du over the
 matching files and removal deletes them all.
+
+Beyond the reference's age-based removal, :meth:`CacheManager.gc_watermark`
+bounds total cache *capacity*: whole entries (a blob plus companions) are
+evicted least-recently-accessed-first until usage is back under a byte
+watermark. Live ``CachedBlob`` instances survive eviction transparently —
+they notice the dropped link and re-fetch (daemon/blobcache.py).
 """
 
 from __future__ import annotations
@@ -13,6 +19,7 @@ import threading
 import time
 from typing import Optional
 
+from nydus_snapshotter_tpu import failpoint
 from nydus_snapshotter_tpu.snapshot.metastore import Usage
 
 # Companion-file suffixes of one blob cache entry (manager.go:99-120).
@@ -106,7 +113,66 @@ class CacheManager:
                     continue
         return removed
 
-    def start_gc(self, max_age_sec: float) -> None:
+    # -- capacity-watermark eviction (LRU over whole entries) ----------------
+
+    def _scan_entries(self) -> tuple[dict[str, list[str]], dict[str, float], dict[str, int]]:
+        """(members, newest_atime, sizes) per blob id, one listdir pass."""
+        members: dict[str, list[str]] = {}
+        newest_atime: dict[str, float] = {}
+        sizes: dict[str, int] = {}
+        try:
+            names = os.listdir(self.cache_dir)
+        except FileNotFoundError:
+            return members, newest_atime, sizes
+        for name in names:
+            path = os.path.join(self.cache_dir, name)
+            try:
+                st = os.lstat(path)
+            except FileNotFoundError:
+                continue
+            bid = self._entry_id(name)
+            members.setdefault(bid, []).append(path)
+            newest_atime[bid] = max(newest_atime.get(bid, 0.0), st.st_atime)
+            sizes[bid] = sizes.get(bid, 0) + st.st_size
+        return members, newest_atime, sizes
+
+    def gc_watermark(self, max_bytes: int, protect: Optional[set] = None) -> list[str]:
+        """Evict whole cache entries, least-recently-accessed first, until
+        total usage is <= ``max_bytes``; returns removed paths. ``protect``
+        names blob ids that must never be evicted (e.g. currently
+        mounting). Eviction under a live reader is safe: open fds keep the
+        old bytes readable and the next read re-seeds the cache."""
+        removed: list[str] = []
+        if max_bytes <= 0:
+            return removed
+        members, newest_atime, sizes = self._scan_entries()
+        total = sum(sizes.values())
+        if total <= max_bytes:
+            return removed
+        from nydus_snapshotter_tpu.daemon import fetch_sched
+
+        for bid in sorted(members, key=lambda b: newest_atime[b]):
+            if total <= max_bytes:
+                break
+            if protect and bid in protect:
+                continue
+            failpoint.hit("blobcache.evict")
+            entry_removed = 0
+            for path in members[bid]:
+                try:
+                    st = os.lstat(path)
+                    os.remove(path)
+                except OSError:
+                    continue
+                entry_removed += st.st_size
+                removed.append(path)
+            if entry_removed:
+                total -= entry_removed
+                fetch_sched.EVICTED_BYTES.inc(entry_removed)
+                fetch_sched.EVICTED_ENTRIES.inc()
+        return removed
+
+    def start_gc(self, max_age_sec: float, watermark_bytes: int = 0) -> None:
         if not self.enabled or self._period <= 0:
             return
         self.stop_gc()
@@ -117,6 +183,8 @@ class CacheManager:
             if stop.is_set():
                 return
             self.gc_once(max_age_sec)
+            if watermark_bytes > 0:
+                self.gc_watermark(watermark_bytes)
             if stop.is_set():
                 return
             self._timer = threading.Timer(self._period, tick)

@@ -115,6 +115,20 @@ def _parse_size(value: str) -> int:
     return int(value)
 
 
+def _parse_duration(value: str) -> float:
+    """'24h' / '30m' / '90s' / '120' → seconds; empty/invalid → 0 (off)."""
+    value = value.strip().lower()
+    if not value:
+        return 0.0
+    units = {"h": 3600.0, "m": 60.0, "s": 1.0}
+    try:
+        if value[-1] in units:
+            return float(value[:-1]) * units[value[-1]]
+        return float(value)
+    except ValueError:
+        return 0.0
+
+
 def build_stack(cfg: SnapshotterConfig):
     """Assemble store → managers → filesystem → snapshotter
     (reference snapshot.NewSnapshotter snapshot.go:64-299)."""
@@ -135,7 +149,20 @@ def build_stack(cfg: SnapshotterConfig):
         mgr.run_death_handler()
         managers[cfg.daemon.fs_driver] = mgr
 
-    cache_mgr = CacheManager(cfg.cache_root, enabled=cfg.cache_manager.enable)
+    gc_period_sec = _parse_duration(cfg.cache_manager.gc_period)
+    cache_mgr = CacheManager(
+        cfg.cache_root,
+        period_sec=gc_period_sec,
+        enabled=cfg.cache_manager.enable,
+    )
+    if gc_period_sec > 0:
+        # Age GC keeps the reference behavior; the capacity watermark
+        # ([blobcache].eviction_watermark_mib) additionally evicts whole
+        # LRU entries once total usage crosses it (cache/manager.py).
+        cache_mgr.start_gc(
+            max_age_sec=gc_period_sec,
+            watermark_bytes=cfg.blobcache.eviction_watermark_mib << 20,
+        )
 
     # Bootstrap signature verifier (snapshot.go:65) + daemon cgroup
     # (snapshot.go:88); both optional and config-gated.

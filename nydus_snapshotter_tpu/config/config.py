@@ -141,6 +141,31 @@ class ConvertConfig:
 
 
 @dataclass
+class BlobcacheConfig:
+    """Lazy-read data plane knobs (daemon/fetch_sched.py).
+
+    Cache misses are scheduled on a per-blob fetch worker pool: adjacent
+    miss gaps within ``merge_gap_kib`` coalesce into one ranged GET,
+    sequential readers get ``readahead_kib`` of background warming, and
+    all fetches draw from one ``inflight_budget_mib`` byte budget shared
+    across every lazily-read blob. ``eviction_watermark_mib`` bounds
+    total blob-cache capacity (0 disables; LRU whole-entry eviction in
+    cache/manager.py). Environment variables override per-process
+    (``NTPU_BLOBCACHE_WORKERS``, ``NTPU_BLOBCACHE_MERGE_GAP_KIB``,
+    ``NTPU_BLOBCACHE_READAHEAD_KIB``, ``NTPU_BLOBCACHE_BUDGET_MIB``,
+    ``NTPU_BLOBCACHE_WATERMARK_MIB``, ``NTPU_BLOBCACHE_PREFETCH``) —
+    that is also how the section reaches spawned daemon processes.
+    """
+
+    fetch_workers: int = 4
+    merge_gap_kib: int = 128
+    readahead_kib: int = 1024
+    inflight_budget_mib: int = 64
+    eviction_watermark_mib: int = 0
+    prefetch_replay: bool = True
+
+
+@dataclass
 class ExperimentalConfig:
     enable_stargz: bool = False
     enable_referrer_detect: bool = False
@@ -170,6 +195,7 @@ class SnapshotterConfig:
     cache_manager: CacheManagerConfig = field(default_factory=CacheManagerConfig)
     image: ImageConfig = field(default_factory=ImageConfig)
     convert: ConvertConfig = field(default_factory=ConvertConfig)
+    blobcache: BlobcacheConfig = field(default_factory=BlobcacheConfig)
     experimental: ExperimentalConfig = field(default_factory=ExperimentalConfig)
 
     # -- derived paths (reference config/global.go accessors) ---------------
@@ -240,6 +266,16 @@ class SnapshotterConfig:
             or self.convert.window_mib <= 0
         ):
             raise ConfigError("convert queue/budget/window MiB must be positive")
+        if self.blobcache.fetch_workers < 1:
+            raise ConfigError("blobcache.fetch_workers must be >= 1")
+        if self.blobcache.merge_gap_kib < 0 or self.blobcache.readahead_kib < 0:
+            raise ConfigError("blobcache merge_gap/readahead KiB must be >= 0")
+        if self.blobcache.inflight_budget_mib <= 0:
+            raise ConfigError("blobcache.inflight_budget_mib must be positive")
+        if self.blobcache.eviction_watermark_mib < 0:
+            raise ConfigError(
+                "blobcache.eviction_watermark_mib must be >= 0 (0 = unbounded)"
+            )
         if self.daemon.fs_driver in (constants.FS_DRIVER_BLOCKDEV, constants.FS_DRIVER_PROXY):
             # Proxy/blockdev modes run without nydusd daemons
             # (reference config.go:300-311 forces daemon_mode none).

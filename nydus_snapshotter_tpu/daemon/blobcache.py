@@ -9,9 +9,16 @@ second access is a local pread. Cache artifacts use the reference's
 blobcache names — ``<blob_id>.blob.data`` + ``<blob_id>.chunk_map`` — the
 exact files pkg/cache's accounting/GC already manages (cache/manager.py).
 
+The miss path is parallel (daemon/fetch_sched.py): concurrent misses on
+overlapping extents share one flight, adjacent miss gaps coalesce into
+larger ranged GETs, sequential readers get readahead, and all fetches run
+on a multi-connection worker pool under a byte-bounded in-flight budget.
+
 The chunk map is an append-only sequence of ``(u64 offset, u32 size)``
 little-endian records; a torn final record (crash mid-append) is dropped
-on load, and the corresponding extent simply re-fetches.
+on load, and the corresponding extent simply re-fetches. Appends are
+batched: each fetch batch (one ``read_at`` miss, one prefetch-replay
+file) flushes once instead of once per record.
 """
 
 from __future__ import annotations
@@ -20,32 +27,64 @@ import logging
 import os
 import struct
 import threading
+import time
 from typing import Callable, Optional
+
+from nydus_snapshotter_tpu.daemon import fetch_sched
+from nydus_snapshotter_tpu.daemon.fetch_sched import (
+    BACKGROUND,
+    DEMAND,
+    FetchConfig,
+    FetchScheduler,
+    IntervalSet,
+)
+from nydus_snapshotter_tpu.remote.mirror import HostHealth
 
 logger = logging.getLogger(__name__)
 
 _RECORD = struct.Struct("<QI")
 
+# A throttling registry's Retry-After is honored in place (the host is
+# being polite, not failing), bounded like remote/transport.py.
+RETRY_AFTER_CAP = 5.0
+
 
 class RegistryBlobFetcher:
-    """Ranged blob GETs with mirror failover.
+    """Ranged blob GETs with health-scored mirror failover.
 
     ``backend`` is a daemonconfig.BackendConfig-shaped object (host, repo,
     scheme, auth, skip_verify, mirrors). Mirrors are tried in listed order,
-    the origin host last; a host that fails is skipped for subsequent
-    reads until every other candidate has also failed (simple demotion —
-    the reference delegates richer health checking to nydusd's config,
-    mirrors.go:63-69).
+    the origin host last. Each host carries a
+    :class:`~nydus_snapshotter_tpu.remote.mirror.HostHealth` consecutive-
+    failure scorer: a host that trips its failure limit goes on cooldown
+    and is skipped by the ordering until the cooldown expires, then gets a
+    fresh budget — no host stays demoted forever. Cooled-down hosts are
+    still tried last-resort when every healthy candidate failed. HTTP 429
+    honors Retry-After with one bounded in-place retry, the same contract
+    as remote/transport.py.
+
+    ``read_range`` is thread-safe and is called concurrently by the fetch
+    scheduler's worker pool (one pooled RegistryClient per host; the
+    client itself opens one connection per request).
     """
 
-    def __init__(self, backend, blob_id: str):
+    def __init__(self, backend, blob_id: str, clock=time.monotonic, sleep=time.sleep):
         self.backend = backend
         self.blob_id = blob_id
-        hosts = [m.host for m in getattr(backend, "mirrors", []) if m.host]
+        self._sleep = sleep
+        mirrors = [m for m in getattr(backend, "mirrors", []) if m.host]
+        hosts = [m.host for m in mirrors]
         hosts.append(backend.host)
         self._hosts = hosts
         self._clients: dict[str, object] = {}
-        self._demoted: set[str] = set()
+        self._health: dict[str, HostHealth] = {}
+        for m in mirrors:
+            self._health[m.host] = HostHealth(
+                failure_limit=getattr(m, "failure_limit", 5),
+                cooldown=float(getattr(m, "health_check_interval", 5)),
+                clock=clock,
+            )
+        self._health[backend.host] = HostHealth(clock=clock)
         self._lock = threading.Lock()
 
     def _client(self, host: str):
@@ -76,40 +115,62 @@ class RegistryBlobFetcher:
                 self._clients[host] = client
         return client
 
+    def _candidates(self) -> list[str]:
+        """Healthy hosts in configured order, cooled-down hosts after —
+        a last resort, not a permanent exclusion."""
+        with self._lock:
+            healthy = [h for h in self._hosts if self._health[h].available()]
+            cooling = [h for h in self._hosts if not self._health[h].available()]
+        return healthy + cooling
+
+    def _record(self, host: str, ok: bool) -> None:
+        with self._lock:
+            h = self._health[host]
+            if ok:
+                h.record_success()
+            else:
+                h.record_failure()
+
+    def _fetch_once(self, host: str, digest: str, offset: int, size: int) -> bytes:
+        r = self._client(host).fetch_blob(
+            self.backend.repo, digest, byte_range=(offset, offset + size - 1)
+        )
+        try:
+            status = r.status
+            data = r.read()
+        finally:
+            r.close()
+        if status == 200 and len(data) > size:
+            # Registry ignored the Range header and served the whole
+            # blob (fetch_blob whitelists 200 for exactly this case).
+            data = data[offset : offset + size]
+        if len(data) != size:
+            raise OSError(f"ranged GET returned {len(data)} bytes, wanted {size}")
+        return data
+
     def read_range(self, offset: int, size: int) -> bytes:
+        from nydus_snapshotter_tpu.remote.registry import HTTPError
+
         if size <= 0:
             return b""
         digest = self.blob_id if ":" in self.blob_id else f"sha256:{self.blob_id}"
         last_error: Optional[Exception] = None
-        with self._lock:
-            order = [h for h in self._hosts if h not in self._demoted] + [
-                h for h in self._hosts if h in self._demoted
-            ]
-        for host in order:
+        for host in self._candidates():
             try:
-                r = self._client(host).fetch_blob(
-                    self.backend.repo, digest, byte_range=(offset, offset + size - 1)
-                )
                 try:
-                    status = r.status
-                    data = r.read()
-                finally:
-                    r.close()
-                if status == 200 and len(data) > size:
-                    # Registry ignored the Range header and served the whole
-                    # blob (fetch_blob whitelists 200 for exactly this case).
-                    data = data[offset : offset + size]
-                if len(data) != size:
-                    raise OSError(
-                        f"ranged GET returned {len(data)} bytes, wanted {size}"
-                    )
-                with self._lock:
-                    self._demoted.discard(host)
+                    data = self._fetch_once(host, digest, offset, size)
+                except HTTPError as e:
+                    if e.code != 429:
+                        raise
+                    # Throttled, not broken: pause as asked (bounded) and
+                    # retry this host once before moving on.
+                    self._sleep(min(max(e.retry_after, 0.0), RETRY_AFTER_CAP))
+                    data = self._fetch_once(host, digest, offset, size)
+                self._record(host, ok=True)
                 return data
-            except Exception as e:  # noqa: BLE001 — any failure demotes, next host tries
+            except Exception as e:  # noqa: BLE001 — any failure scores, next host tries
                 last_error = e
-                with self._lock:
-                    self._demoted.add(host)
+                self._record(host, ok=False)
                 logger.warning("blob fetch from %s failed: %s", host, e)
         raise OSError(f"all registry hosts failed for {self.blob_id}: {last_error}")
 
@@ -119,21 +180,50 @@ class CachedBlob:
 
     ``read_at(offset, size)`` serves from ``<blob_id>.blob.data`` when the
     requested extent is covered by previously fetched intervals, else
-    fetches, persists (sparse pwrite + chunk-map append) and returns.
+    schedules the miss gaps on the fetch scheduler (singleflight +
+    coalescing + readahead), waits, and preads the now-resident range.
+
+    ``blob_size`` (when known) clamps readahead so sequential warming
+    never runs past the blob's end. An eviction that unlinks the cache
+    files under a live instance is survived transparently: the next read
+    notices the dropped link, re-creates the files and re-fetches.
     """
 
-    def __init__(self, cache_dir: str, blob_id: str, fetch_range: Callable[[int, int], bytes]):
+    def __init__(
+        self,
+        cache_dir: str,
+        blob_id: str,
+        fetch_range: Callable[[int, int], bytes],
+        blob_size: int = 0,
+        config: Optional[FetchConfig] = None,
+        budget=None,
+    ):
         os.makedirs(cache_dir, exist_ok=True)
         self.data_path = os.path.join(cache_dir, f"{blob_id}.blob.data")
         self.map_path = os.path.join(cache_dir, f"{blob_id}.chunk_map")
         self.fetch_range = fetch_range
+        self.blob_size = max(0, int(blob_size))
         self._lock = threading.Lock()
-        self._intervals: list[tuple[int, int]] = []  # merged (start, end)
+        self._intervals = IntervalSet()
+        self._ra_spans = IntervalSet()  # readahead-fetched, not yet read
         self._data_fd = os.open(self.data_path, os.O_RDWR | os.O_CREAT, 0o644)
         self._map_f = open(self.map_path, "ab")
+        self._map_dirty = False
         self._closed = False
+        self._last_end = -1  # sequential-access detector
         self._load_map()
         self.remote_bytes = 0  # fetched over the network (metrics)
+        self.sched = FetchScheduler(
+            self._lock,
+            self._intervals,
+            self._fetch,
+            self._deliver,
+            config=config,
+            budget=budget,
+            name=blob_id[:8],
+        )
+
+    # -- persistence ---------------------------------------------------------
 
     def _load_map(self) -> None:
         try:
@@ -144,52 +234,161 @@ class CachedBlob:
         usable = len(raw) - len(raw) % _RECORD.size  # drop a torn tail record
         for i in range(0, usable, _RECORD.size):
             off, size = _RECORD.unpack_from(raw, i)
-            self._insert(off, off + size)
+            self._intervals.add(off, off + size)
 
-    def _insert(self, start: int, end: int) -> None:
-        merged = []
-        for s, e in self._intervals:
-            if e < start or s > end:
-                merged.append((s, e))
-            else:
-                start, end = min(start, s), max(end, e)
-        merged.append((start, end))
-        merged.sort()
-        self._intervals = merged
+    def _fetch(self, offset: int, size: int) -> bytes:
+        data = self.fetch_range(offset, size)
+        if len(data) != size:
+            raise OSError(
+                f"fetcher returned {len(data)} bytes for [{offset}, {offset + size})"
+            )
+        return data
 
-    def _covered(self, start: int, end: int) -> bool:
-        for s, e in self._intervals:
-            if s <= start and end <= e:
-                return True
-        return False
+    def _deliver(self, offset: int, data: bytes) -> None:
+        """Persist one completed flight (runs under self._lock): sparse
+        pwrite + chunk-map append (flushed per batch, not per record)."""
+        os.pwrite(self._data_fd, data, offset)
+        self._map_f.write(_RECORD.pack(offset, len(data)))
+        self._map_dirty = True
+        self._intervals.add(offset, offset + len(data))
+        self.remote_bytes += len(data)
+
+    def _flush_map_locked(self) -> None:
+        if self._map_dirty:
+            self._map_f.flush()
+            self._map_dirty = False
+
+    # -- eviction survival ---------------------------------------------------
+
+    def _revalidate_locked(self) -> None:
+        """A capacity-watermark eviction (cache/manager.py) may unlink the
+        cache files under a live instance. The open fd keeps old bytes
+        readable but new write-through would land in an unlinked inode —
+        so detect the dropped link and start the cache over."""
+        try:
+            if os.fstat(self._data_fd).st_nlink > 0:
+                return
+        except OSError:
+            return
+        try:
+            os.close(self._data_fd)
+        except OSError:
+            pass
+        try:
+            self._map_f.close()
+        except OSError:
+            pass
+        self._data_fd = os.open(self.data_path, os.O_RDWR | os.O_CREAT, 0o644)
+        self._map_f = open(self.map_path, "ab")
+        self._map_dirty = False
+        self._intervals.clear()
+        self._ra_spans.clear()
+        self._load_map()  # a concurrent writer may have re-seeded it
+
+    # -- reads ---------------------------------------------------------------
+
+    def _plan_readahead_locked(self, end: int) -> None:
+        """Sequential reader: extend the window ahead of the read as
+        BACKGROUND flights (never merged into the demand fetch, so a
+        readahead failure can't fail the read)."""
+        ra = self.sched.cfg.readahead
+        if ra <= 0:
+            return
+        ra_end = end + ra
+        if self.blob_size:
+            ra_end = min(ra_end, self.blob_size)
+        if ra_end <= end:
+            return
+        from nydus_snapshotter_tpu import failpoint
+
+        failpoint.hit("blobcache.readahead")
+        pre = {id(f) for f in self.sched.overlapping_flights(end, ra_end)}
+        for f in self.sched.plan_locked(end, ra_end, priority=BACKGROUND):
+            if id(f) not in pre and f.priority == BACKGROUND:
+                # New flights cover exactly uncovered, not-in-flight gaps.
+                fetch_sched.READAHEAD_BYTES.inc(f.end - f.start)
+                self._ra_spans.add(f.start, f.end)
+
+    def _account_ra_hit_locked(self, start: int, end: int) -> None:
+        hit = self._ra_spans.remove(start, end)
+        if hit:
+            fetch_sched.READAHEAD_HIT_BYTES.inc(hit)
 
     def read_at(self, offset: int, size: int) -> bytes:
         if size <= 0:
             return b""
+        end = offset + size
+        first_pass = True
+        while True:
+            with self._lock:
+                if self._closed:
+                    raise OSError(f"blob cache {self.data_path} is closed")
+                self._revalidate_locked()
+                sequential = offset == self._last_end
+                self._last_end = end
+                if self._intervals.covered(offset, end):
+                    if first_pass:
+                        fetch_sched.HIT_BYTES.inc(size)
+                    self._account_ra_hit_locked(offset, end)
+                    if sequential:
+                        self._plan_readahead_locked(end)
+                    return os.pread(self._data_fd, size, offset)
+                flights = self.sched.plan_locked(offset, end, priority=DEMAND)
+                if sequential and first_pass:
+                    self._plan_readahead_locked(end)
+            first_pass = False
+            for f in flights:
+                f.wait()
+            errors = [f.error for f in flights if f.error is not None]
+            if errors:
+                raise errors[0]
+            with self._lock:
+                if self._closed:
+                    raise OSError(f"blob cache {self.data_path} is closed")
+                self._flush_map_locked()
+                # A concurrent eviction can drop coverage between flight
+                # delivery and this pread — replan instead of returning
+                # holes (the while-loop re-checks under the lock).
+                if self._intervals.covered(offset, end):
+                    self._account_ra_hit_locked(offset, end)
+                    return os.pread(self._data_fd, size, offset)
+
+    def warm(self, offset: int, size: int) -> list:
+        """Schedule ``[offset, offset+size)`` residency at BACKGROUND
+        priority (prefetch replay); returns the flights to optionally
+        wait on. Never raises on a closed cache — warming is advisory."""
+        if size <= 0:
+            return []
         with self._lock:
             if self._closed:
-                raise OSError(f"blob cache {self.data_path} is closed")
-            if self._covered(offset, offset + size):
-                return os.pread(self._data_fd, size, offset)
-        data = self.fetch_range(offset, size)
+                return []
+            if self._intervals.covered(offset, offset + size):
+                return []
+            try:
+                return self.sched.plan_locked(offset, offset + size, priority=BACKGROUND)
+            except OSError:
+                return []
+
+    def flush_map(self) -> None:
+        """One batched chunk-map flush (prefetch replay calls this per
+        replayed file; read_at flushes per miss batch)."""
         with self._lock:
-            if self._closed:
-                # Umount raced the fetch: return the data, skip the
-                # write-through (the fd is gone).
-                return data
-            os.pwrite(self._data_fd, data, offset)
-            self._map_f.write(_RECORD.pack(offset, size))
-            self._map_f.flush()
-            self._insert(offset, offset + size)
-            self.remote_bytes += len(data)
-        return data
+            if not self._closed:
+                self._flush_map_locked()
 
     def close(self) -> None:
         with self._lock:
             if self._closed:
                 return
             self._closed = True
+        # Scheduler teardown happens outside the lock: in-flight workers
+        # need it to finish delivering before they observe the close.
+        self.sched.close()
+        with self._lock:
             try:
-                os.close(self._data_fd)
+                try:
+                    self._map_f.flush()
+                finally:
+                    os.close(self._data_fd)
             finally:
                 self._map_f.close()

@@ -1,0 +1,613 @@
+"""Parallel fetch scheduler for the lazy-read data plane.
+
+The serial lazy-read path (daemon/blobcache.py before this module) issued
+one blocking ranged GET per miss, duplicate-fetched the same extent under
+concurrent readers, and never looked ahead. This module is the data-plane
+counterpart of the convert pipeline (parallel/pipeline.py): it turns every
+cache miss into *flights* — in-flight ranged fetches tracked in a per-blob
+singleflight table — and executes them on a multi-connection worker pool
+under a byte-bounded in-flight budget (the same
+:class:`~nydus_snapshotter_tpu.parallel.pipeline.MemoryBudget` discipline
+the convert path uses):
+
+- **singleflight**: concurrent misses on overlapping extents wait on the
+  existing flight instead of re-fetching; only uncovered gaps spawn new
+  flights, so no byte is ever fetched twice by racing readers;
+- **coalescing**: adjacent miss gaps closer than ``merge_gap`` merge into
+  one larger ranged GET (re-fetching the few covered bytes in between is
+  cheaper than another HTTP round trip);
+- **readahead**: a sequential reader extends its miss window ahead of the
+  read as *background* flights, clamped to the blob size and isolated
+  from the demand read — a failed readahead never fails a read;
+- **prefetch replay**: :class:`PrefetchReplayer` walks prefetch file
+  lists / fanotify traces through the bootstrap chunk index and warms the
+  cache through the same scheduler at background priority, cancellable on
+  umount.
+
+Demand flights always dispatch before background ones; a demand read that
+lands on a queued background flight promotes it. Observability lands in
+``metrics/registry.default_registry`` as ``ntpu_blobcache_*``;
+``failpoint.hit`` fires at the fetch / coalesce / readahead boundaries
+(``blobcache.{fetch,coalesce,readahead}``) so the overlap is
+chaos-testable (docs/robustness.md).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from bisect import bisect_left, bisect_right
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.metrics import registry as _metrics
+from nydus_snapshotter_tpu.parallel.pipeline import MemoryBudget
+
+DEFAULT_FETCH_WORKERS = 4
+DEFAULT_MERGE_GAP = 128 << 10
+DEFAULT_READAHEAD = 1 << 20
+DEFAULT_BUDGET_BYTES = 64 << 20
+MAX_FETCH_WORKERS = 32
+
+# Flight priorities: demand reads outrank readahead/prefetch warming.
+DEMAND = 0
+BACKGROUND = 1
+
+_reg = _metrics.default_registry
+HIT_BYTES = _reg.register(
+    _metrics.Counter(
+        "ntpu_blobcache_hit_bytes",
+        "Lazy-read bytes served from the local chunk cache",
+    )
+)
+MISS_BYTES = _reg.register(
+    _metrics.Counter(
+        "ntpu_blobcache_miss_bytes",
+        "Lazy-read bytes that required a remote fetch",
+    )
+)
+FETCH_REQUESTS = _reg.register(
+    _metrics.Counter(
+        "ntpu_blobcache_fetch_requests",
+        "Ranged GETs issued by the fetch scheduler",
+    )
+)
+COALESCED_REQUESTS = _reg.register(
+    _metrics.Counter(
+        "ntpu_blobcache_coalesced_requests",
+        "Ranged GETs that merged more than one miss gap",
+    )
+)
+INFLIGHT_BYTES = _reg.register(
+    _metrics.Gauge(
+        "ntpu_blobcache_inflight_bytes",
+        "Bytes currently being fetched by blobcache workers",
+    )
+)
+READAHEAD_BYTES = _reg.register(
+    _metrics.Counter(
+        "ntpu_blobcache_readahead_bytes",
+        "Bytes fetched speculatively ahead of sequential readers",
+    )
+)
+READAHEAD_HIT_BYTES = _reg.register(
+    _metrics.Counter(
+        "ntpu_blobcache_readahead_hit_bytes",
+        "Readahead bytes later served to a real read (accuracy numerator)",
+    )
+)
+PREFETCH_BYTES = _reg.register(
+    _metrics.Counter(
+        "ntpu_blobcache_prefetch_bytes",
+        "Bytes warmed by the background prefetch replayer",
+    )
+)
+SINGLEFLIGHT_WAITS = _reg.register(
+    _metrics.Counter(
+        "ntpu_blobcache_singleflight_waits",
+        "Reads that piggybacked on another reader's in-flight fetch",
+    )
+)
+EVICTED_BYTES = _reg.register(
+    _metrics.Counter(
+        "ntpu_blobcache_evicted_bytes",
+        "Bytes removed by capacity-watermark blob cache eviction",
+    )
+)
+EVICTED_ENTRIES = _reg.register(
+    _metrics.Counter(
+        "ntpu_blobcache_evicted_entries",
+        "Whole blob cache entries removed by capacity-watermark eviction",
+    )
+)
+
+
+def snapshot_counters() -> dict:
+    """Current cumulative ``ntpu_blobcache_*`` values (bench/tools delta
+    these around a run)."""
+    ra = READAHEAD_BYTES.value()
+    return {
+        "hit_bytes": HIT_BYTES.value(),
+        "miss_bytes": MISS_BYTES.value(),
+        "fetch_requests": FETCH_REQUESTS.value(),
+        "coalesced_requests": COALESCED_REQUESTS.value(),
+        "readahead_bytes": ra,
+        "readahead_hit_bytes": READAHEAD_HIT_BYTES.value(),
+        "readahead_accuracy": (
+            READAHEAD_HIT_BYTES.value() / ra if ra else None
+        ),
+        "prefetch_bytes": PREFETCH_BYTES.value(),
+        "singleflight_waits": SINGLEFLIGHT_WAITS.value(),
+        "evicted_bytes": EVICTED_BYTES.value(),
+        "evicted_entries": EVICTED_ENTRIES.value(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Sorted-interval coverage
+# ---------------------------------------------------------------------------
+
+
+class IntervalSet:
+    """Disjoint, sorted, half-open ``[start, end)`` intervals with
+    bisect-based point/range queries — O(log n + k) where the previous
+    blobcache scan was O(n) per read. Touching intervals merge."""
+
+    __slots__ = ("_starts", "_ends")
+
+    def __init__(self):
+        self._starts: list[int] = []
+        self._ends: list[int] = []
+
+    def __len__(self) -> int:
+        return len(self._starts)
+
+    def add(self, start: int, end: int) -> None:
+        if end <= start:
+            return
+        # Intervals whose end >= start and whose start <= end overlap or
+        # touch [start, end): one contiguous run in the sorted lists.
+        i = bisect_left(self._ends, start)
+        j = bisect_right(self._starts, end)
+        if i < j:
+            start = min(start, self._starts[i])
+            end = max(end, self._ends[j - 1])
+        self._starts[i:j] = [start]
+        self._ends[i:j] = [end]
+
+    def covered(self, start: int, end: int) -> bool:
+        if end <= start:
+            return True
+        i = bisect_right(self._starts, start) - 1
+        return i >= 0 and self._ends[i] >= end
+
+    def missing(self, start: int, end: int) -> list[tuple[int, int]]:
+        """Sub-ranges of ``[start, end)`` not covered, in order."""
+        if end <= start:
+            return []
+        gaps: list[tuple[int, int]] = []
+        i = bisect_right(self._starts, start) - 1
+        if i < 0 or self._ends[i] <= start:
+            i += 1
+        pos = start
+        while pos < end and i < len(self._starts):
+            s, e = self._starts[i], self._ends[i]
+            if s >= end:
+                break
+            if pos < s:
+                gaps.append((pos, s))
+            pos = max(pos, e)
+            i += 1
+        if pos < end:
+            gaps.append((pos, end))
+        return gaps
+
+    def spans(self) -> list[tuple[int, int]]:
+        return list(zip(self._starts, self._ends))
+
+    def total_bytes(self) -> int:
+        return sum(e - s for s, e in zip(self._starts, self._ends))
+
+    def clear(self) -> None:
+        self._starts.clear()
+        self._ends.clear()
+
+    def remove(self, start: int, end: int) -> int:
+        """Uncover ``[start, end)``; returns bytes actually removed."""
+        if end <= start:
+            return 0
+        removed = 0
+        keep_s: list[int] = []
+        keep_e: list[int] = []
+        for s, e in zip(self._starts, self._ends):
+            if e <= start or s >= end:
+                keep_s.append(s)
+                keep_e.append(e)
+                continue
+            removed += min(e, end) - max(s, start)
+            if s < start:
+                keep_s.append(s)
+                keep_e.append(start)
+            if e > end:
+                keep_s.append(end)
+                keep_e.append(e)
+        self._starts, self._ends = keep_s, keep_e
+        return removed
+
+
+# ---------------------------------------------------------------------------
+# Configuration
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FetchConfig:
+    fetch_workers: int = DEFAULT_FETCH_WORKERS
+    merge_gap: int = DEFAULT_MERGE_GAP
+    readahead: int = DEFAULT_READAHEAD
+    budget_bytes: int = DEFAULT_BUDGET_BYTES
+    prefetch_replay: bool = True
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        v = int(os.environ.get(name, ""))
+        return v if v >= 0 else default
+    except ValueError:
+        return default
+
+
+def _global_blobcache_config():
+    """The snapshotter's ``[blobcache]`` section when a global config is
+    set (config/config.py); None in the daemon process / library use."""
+    try:
+        from nydus_snapshotter_tpu.config import config as _cfg
+
+        return _cfg.get_global_config().blobcache
+    except Exception:
+        return None
+
+
+def resolve_config() -> FetchConfig:
+    """Resolve the lazy-read knobs: env > ``[blobcache]`` config > defaults.
+
+    Environment overrides (``NTPU_BLOBCACHE*``) matter doubly here: the
+    daemon is a separate process with no global snapshotter config, so the
+    spawned environment is how the section reaches the data plane.
+    """
+    bc = _global_blobcache_config()
+    workers = _env_int(
+        "NTPU_BLOBCACHE_WORKERS",
+        getattr(bc, "fetch_workers", 0) or DEFAULT_FETCH_WORKERS,
+    )
+    merge_gap = _env_int(
+        "NTPU_BLOBCACHE_MERGE_GAP_KIB",
+        -1,
+    )
+    if merge_gap < 0:
+        gap_kib = getattr(bc, "merge_gap_kib", None)
+        merge_gap = gap_kib if gap_kib is not None else (DEFAULT_MERGE_GAP >> 10)
+    readahead = _env_int("NTPU_BLOBCACHE_READAHEAD_KIB", -1)
+    if readahead < 0:
+        ra_kib = getattr(bc, "readahead_kib", None)
+        readahead = ra_kib if ra_kib is not None else (DEFAULT_READAHEAD >> 10)
+    budget = _env_int(
+        "NTPU_BLOBCACHE_BUDGET_MIB",
+        getattr(bc, "inflight_budget_mib", 0) or (DEFAULT_BUDGET_BYTES >> 20),
+    )
+    prefetch_env = os.environ.get("NTPU_BLOBCACHE_PREFETCH", "")
+    if prefetch_env:
+        prefetch = prefetch_env not in ("0", "off", "false")
+    else:
+        prefetch = bool(getattr(bc, "prefetch_replay", True))
+    return FetchConfig(
+        fetch_workers=min(MAX_FETCH_WORKERS, max(1, workers)),
+        merge_gap=merge_gap << 10,
+        readahead=readahead << 10,
+        budget_bytes=max(1, budget) << 20,
+        prefetch_replay=prefetch,
+    )
+
+
+_shared_budget: Optional[MemoryBudget] = None
+_shared_budget_lock = threading.Lock()
+
+
+def shared_budget() -> MemoryBudget:
+    """Process-wide in-flight byte budget every scheduler without an
+    explicit budget shares, so aggregate fetch memory is independent of
+    how many blobs are being lazily read at once."""
+    global _shared_budget
+    with _shared_budget_lock:
+        if _shared_budget is None:
+            _shared_budget = MemoryBudget(resolve_config().budget_bytes)
+        return _shared_budget
+
+
+# ---------------------------------------------------------------------------
+# Flights + scheduler
+# ---------------------------------------------------------------------------
+
+
+class Flight:
+    """One in-flight ranged fetch covering ``[start, end)``."""
+
+    __slots__ = ("start", "end", "priority", "coalesced", "done", "error")
+
+    def __init__(self, start: int, end: int, priority: int, coalesced: int = 1):
+        self.start = start
+        self.end = end
+        self.priority = priority
+        self.coalesced = coalesced  # miss gaps merged into this fetch
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.done.wait(timeout)
+
+
+class FetchScheduler:
+    """Per-blob singleflight table + coalescing planner + worker pool.
+
+    The scheduler shares its caller's lock (the CachedBlob lock): every
+    ``plan_locked`` call and every delivery runs under that one lock, so
+    interval state, the flight table and the cache file never disagree.
+    ``fetch_range(offset, size)`` runs concurrently on worker threads and
+    must be thread-safe; ``deliver(offset, data)`` is called back under
+    the lock to persist a completed fetch.
+    """
+
+    def __init__(
+        self,
+        lock: threading.Lock,
+        intervals: IntervalSet,
+        fetch_range: Callable[[int, int], bytes],
+        deliver: Callable[[int, bytes], None],
+        config: Optional[FetchConfig] = None,
+        budget: Optional[MemoryBudget] = None,
+        name: str = "",
+    ):
+        self.cfg = config or resolve_config()
+        self.budget = budget or shared_budget()
+        self.name = name
+        self._lock = lock
+        self._cv = threading.Condition(lock)
+        self._intervals = intervals
+        self._fetch_range = fetch_range
+        self._deliver = deliver
+        self._flights: list[Flight] = []  # active (queued or fetching)
+        self._queue: deque[Flight] = deque()  # demand FIFO
+        self._queue_bg: deque[Flight] = deque()  # background FIFO
+        self._threads: list[threading.Thread] = []
+        self._idle = 0
+        self._closed = False
+
+    # -- planning (caller holds the shared lock) ----------------------------
+
+    def overlapping_flights(self, start: int, end: int) -> list[Flight]:
+        return [f for f in self._flights if f.start < end and f.end > start]
+
+    def plan_locked(
+        self, start: int, end: int, priority: int = DEMAND
+    ) -> list[Flight]:
+        """Ensure ``[start, end)`` becomes resident: returns every flight
+        the caller must wait on (pre-existing overlaps + newly created
+        gap fetches). Caller holds the shared lock."""
+        if self._closed:
+            raise OSError(f"fetch scheduler {self.name!r} is closed")
+        waiters = self.overlapping_flights(start, end)
+        if waiters and priority == DEMAND:
+            SINGLEFLIGHT_WAITS.inc()
+            self._promote(waiters)
+        # Gaps = uncovered minus already in flight.
+        gaps: list[tuple[int, int]] = []
+        for s, e in self._intervals.missing(start, end):
+            pos = s
+            for f in sorted(self.overlapping_flights(s, e), key=lambda f: f.start):
+                if f.start > pos:
+                    gaps.append((pos, f.start))
+                pos = max(pos, f.end)
+            if pos < e:
+                gaps.append((pos, e))
+        new = self._coalesce(gaps, priority)
+        for f in new:
+            self._flights.append(f)
+            (self._queue if priority == DEMAND else self._queue_bg).append(f)
+        if new:
+            self._spawn_workers(len(new))
+            self._cv.notify_all()
+        return waiters + new
+
+    def _coalesce(self, gaps: list[tuple[int, int]], priority: int) -> list[Flight]:
+        flights: list[Flight] = []
+        for s, e in gaps:
+            if (
+                flights
+                and s - flights[-1].end <= self.cfg.merge_gap
+                and flights[-1].priority == priority
+            ):
+                failpoint.hit("blobcache.coalesce")
+                flights[-1].end = e
+                flights[-1].coalesced += 1
+            else:
+                flights.append(Flight(s, e, priority))
+        return flights
+
+    def _promote(self, flights: list[Flight]) -> None:
+        """A demand read waits on these: background flights still queued
+        jump to the demand queue so the reader isn't stuck behind other
+        warming work."""
+        for f in flights:
+            if f.priority == BACKGROUND and f in self._queue_bg:
+                self._queue_bg.remove(f)
+                f.priority = DEMAND
+                self._queue.append(f)
+
+    # -- worker pool ---------------------------------------------------------
+
+    def _spawn_workers(self, backlog: int) -> None:
+        if self._idle >= backlog:
+            return
+        want = min(self.cfg.fetch_workers, len(self._threads) + backlog - self._idle)
+        while len(self._threads) < want:
+            t = threading.Thread(
+                target=self._worker,
+                name=f"ntpu-fetch-{self.name}-{len(self._threads)}",
+                daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._closed and not self._queue and not self._queue_bg:
+                    self._idle += 1
+                    try:
+                        self._cv.wait()
+                    finally:
+                        self._idle -= 1
+                if self._closed and not self._queue and not self._queue_bg:
+                    return
+                flight = (self._queue or self._queue_bg).popleft()
+            self._run_flight(flight)
+
+    def _run_flight(self, flight: Flight) -> None:
+        n = flight.end - flight.start
+        acquired = False
+        try:
+            self.budget.acquire(n, aborted=lambda: self._closed)
+            acquired = True
+            INFLIGHT_BYTES.set(self.budget.held)
+            failpoint.hit("blobcache.fetch")
+            data = self._fetch_range(flight.start, n)
+            FETCH_REQUESTS.inc()
+            if flight.coalesced > 1:
+                COALESCED_REQUESTS.inc()
+            MISS_BYTES.inc(n)
+            with self._lock:
+                if not self._closed:
+                    self._deliver(flight.start, data)
+        except BaseException as e:  # noqa: BLE001 — surfaced to waiters
+            flight.error = e if isinstance(e, Exception) else OSError(str(e))
+        finally:
+            if acquired:
+                self.budget.release(n)
+                INFLIGHT_BYTES.set(self.budget.held)
+            with self._cv:
+                try:
+                    self._flights.remove(flight)
+                except ValueError:
+                    pass
+                self._cv.notify_all()
+            flight.done.set()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Abort queued flights, wake workers, join the pool. Caller must
+        NOT hold the shared lock (workers need it to finish delivering)."""
+        with self._cv:
+            self._closed = True
+            aborted = list(self._queue) + list(self._queue_bg)
+            self._queue.clear()
+            self._queue_bg.clear()
+            for f in aborted:
+                try:
+                    self._flights.remove(f)
+                except ValueError:
+                    pass
+                f.error = OSError(f"fetch scheduler {self.name!r} closed")
+                f.done.set()
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join()
+        self._threads.clear()
+
+
+# ---------------------------------------------------------------------------
+# Background prefetch replay
+# ---------------------------------------------------------------------------
+
+
+class PrefetchReplayer:
+    """Replays a prefetch file list through the bootstrap chunk index to
+    warm blob caches off the critical path.
+
+    ``warm_chunk(rec)`` is provided by the owner (daemon/server.py): for
+    registry-backed blobs it routes the chunk's compressed extent through
+    the fetch scheduler at BACKGROUND priority; any other backend falls
+    back to a plain read. The replayer owns cancellation: ``cancel()``
+    (umount/close) stops the walk between chunks and is also observed by
+    in-flight waits, so teardown never blocks on a cold registry.
+    """
+
+    def __init__(
+        self,
+        bootstrap,
+        by_path: dict,
+        warm_chunk: Callable[[object], int],
+        name: str = "",
+        on_file: Optional[Callable[[], None]] = None,
+    ):
+        self.bootstrap = bootstrap
+        self.by_path = by_path
+        self.warm_chunk = warm_chunk
+        self.name = name
+        self.on_file = on_file  # e.g. one batched chunk-map flush per file
+        self.warmed_bytes = 0
+        self.files_replayed = 0
+        self._cancel = threading.Event()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
+
+    def cancel(self) -> None:
+        self._cancel.set()
+
+    @staticmethod
+    def paths_from_trace(trace_path: str, strip_prefix: str = "") -> list[str]:
+        """Fanotify/optimizer access trace → ordered path list (first
+        access first — that IS the replay priority)."""
+        from nydus_snapshotter_tpu.prefetch.prefetch import patterns_from_trace
+
+        text = patterns_from_trace(trace_path, strip_prefix=strip_prefix)
+        return [p for p in text.split("\n") if p]
+
+    def replay(self, paths: list[str]) -> int:
+        """Warm every chunk of every path, in order; returns bytes warmed.
+        Per-file errors are contained (prefetch lists are hints)."""
+        import logging
+
+        log = logging.getLogger(__name__)
+        for path in paths:
+            if self._cancel.is_set():
+                break
+            failpoint.hit("blobcache.replay")
+            inode = self.by_path.get(path)
+            if inode is None:
+                continue
+            if inode.hardlink_target:
+                inode = self.by_path.get(inode.hardlink_target) or inode
+            try:
+                for rec in self.bootstrap.chunks[
+                    inode.chunk_index : inode.chunk_index + inode.chunk_count
+                ]:
+                    if self._cancel.is_set():
+                        break
+                    n = self.warm_chunk(rec)
+                    self.warmed_bytes += n
+                    PREFETCH_BYTES.inc(n)
+            except Exception:  # noqa: BLE001 — one bad hint must not
+                # abandon the rest of the list
+                log.warning("prefetch replay of %s failed", path, exc_info=True)
+                continue
+            if self._cancel.is_set():
+                break  # cancelled mid-file: it was not fully replayed
+            self.files_replayed += 1
+            if self.on_file is not None:
+                self.on_file()
+        return self.warmed_bytes

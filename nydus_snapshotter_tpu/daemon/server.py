@@ -63,6 +63,8 @@ class _Instance:
         self._closed = False
         self.prefetched_bytes = 0
         self._cached_blobs: list = []  # CachedBlob instances (registry backend)
+        self._cached_by_index: dict[int, object] = {}  # blob_index -> CachedBlob
+        self._replayer = None  # PrefetchReplayer while a replay is running
         # In-flight data-plane requests (API and FUSE reads both funnel
         # through read()); the inflight metrics endpoint snapshots this so
         # the collector's hung-IO gauge sees real request ages
@@ -109,6 +111,11 @@ class _Instance:
         if self.fuse is not None:
             self.fuse.close(unmount=unmount)
             self.fuse = None
+        # Umount cancels any background prefetch replay first, so cache
+        # teardown never waits behind low-priority warming fetches.
+        replayer = self._replayer
+        if replayer is not None:
+            replayer.cancel()
         # Drop the readers; each blob file closes when its last in-flight
         # read releases the closure reference (no explicit close — closing
         # under a racing read would either raise on a closed file or, worse,
@@ -116,12 +123,16 @@ class _Instance:
         with self._reader_lock:
             self._closed = True
             self._readers.clear()
-            for cached in self._cached_blobs:
-                try:
-                    cached.close()
-                except OSError:
-                    pass
+            cached_blobs = list(self._cached_blobs)
             self._cached_blobs.clear()
+            self._cached_by_index.clear()
+        # CachedBlob.close joins fetch workers; doing that under
+        # _reader_lock would deadlock against a worker delivering.
+        for cached in cached_blobs:
+            try:
+                cached.close()
+            except OSError:
+                pass
 
     def _parsed_config(self):
         if not hasattr(self, "_cfg_cache"):
@@ -161,8 +172,16 @@ class _Instance:
 
                     cache_dir = cfg.cache.work_dir or os.path.join(blob_dir, "cache")
                     fetcher = RegistryBlobFetcher(cfg.backend, blob_id)
-                    cached = CachedBlob(cache_dir, blob_id, fetcher.read_range)
+                    cached = CachedBlob(
+                        cache_dir,
+                        blob_id,
+                        fetcher.read_range,
+                        # Clamps readahead at the blob's end (the record's
+                        # compressed_size IS the published data section).
+                        blob_size=self.bootstrap.blobs[blob_index].compressed_size,
+                    )
                     self._cached_blobs.append(cached)
+                    self._cached_by_index[blob_index] = cached
                     read_at = cached.read_at
                 else:
                     f = open(os.path.join(blob_dir, blob_id), "rb")
@@ -184,32 +203,59 @@ class _Instance:
             return cfg.backend.blob_dir
         return default_dir
 
-    def prefetch(self, default_blob_dir: str) -> int:
+    def prefetch(self, default_blob_dir: str, extra_paths: Optional[list] = None) -> int:
         """Warm the bootstrap's prefetch-table files (reference nydusd's
-        --prefetch-files behavior): pull each hinted file's chunks through
-        the blob readers so their caches are hot before first access.
-        Returns bytes warmed. Errors are contained per file (hints, not
-        requirements), warming counts only into prefetch_data_amount — not
-        the fs read metrics, which track client traffic."""
+        --prefetch-files behavior) through the background replayer
+        (daemon/fetch_sched.PrefetchReplayer): registry-backed blobs are
+        warmed at BACKGROUND fetch priority so demand reads always win the
+        worker pool, any other backend reads through the blob reader.
+        Returns bytes warmed; cancelled by umount. Errors are contained
+        per file (hints, not requirements), warming counts only into
+        prefetch_data_amount — not the fs read metrics, which track
+        client traffic."""
+        from nydus_snapshotter_tpu.daemon.fetch_sched import PrefetchReplayer
+
         blob_dir = self.blob_dir(default_blob_dir)
-        warmed = 0
-        for path in self.bootstrap.prefetch:
-            inode = self.by_path.get(path)
-            if inode is None:
-                continue
-            if inode.hardlink_target:
-                inode = self.by_path.get(inode.hardlink_target) or inode
-            try:
-                for rec in self.bootstrap.chunks[
-                    inode.chunk_index : inode.chunk_index + inode.chunk_count
-                ]:
-                    n = len(self._reader(rec.blob_index, blob_dir).chunk_data(rec))
-                    warmed += n
-                    self.prefetched_bytes += n
-            except Exception:  # noqa: BLE001 — any one bad hint must not
-                # abandon the rest of the table
-                logger.warning("prefetch of %s failed", path, exc_info=True)
-        return warmed
+
+        def warm_chunk(rec) -> int:
+            # Ensure the blob's reader (and CachedBlob, for registry
+            # backends) exists; raises after close(), ending the replay.
+            reader = self._reader(rec.blob_index, blob_dir)
+            cached = self._cached_by_index.get(rec.blob_index)
+            if cached is not None:
+                flights = cached.warm(rec.compressed_offset, rec.compressed_size)
+                for f in flights:
+                    while not f.wait(0.1):
+                        if replayer.cancelled:
+                            return 0
+                if any(f.error is not None for f in flights):
+                    return 0
+                n = rec.compressed_size
+            else:
+                n = len(reader.chunk_data(rec))
+            self.prefetched_bytes += n
+            return n
+
+        def flush_maps() -> None:
+            with self._reader_lock:
+                cached_blobs = list(self._cached_blobs)
+            for c in cached_blobs:
+                c.flush_map()
+
+        replayer = PrefetchReplayer(
+            self.bootstrap,
+            self.by_path,
+            warm_chunk,
+            name=self.mountpoint,
+            on_file=flush_maps,
+        )
+        self._replayer = replayer
+        try:
+            paths = list(self.bootstrap.prefetch) + list(extra_paths or ())
+            return replayer.replay(paths)
+        finally:
+            flush_maps()
+            self._replayer = None
 
     def inflight_snapshot(self) -> list[dict]:
         with self._inflight_lock:
@@ -446,11 +492,15 @@ class DaemonServer:
                     mp = q.get("id", [""])[0]
                     self._reply(200, daemon.fs_metrics(mp))
                 elif u.path == "/api/v1/metrics/blobcache":
+                    from nydus_snapshotter_tpu.daemon import fetch_sched
+
                     with daemon._lock:
                         amount = sum(
                             i.prefetched_bytes for i in daemon.instances.values()
                         )
-                    self._reply(200, {"prefetch_data_amount": amount})
+                    body = {"prefetch_data_amount": amount}
+                    body.update(fetch_sched.snapshot_counters())
+                    self._reply(200, body)
                 elif u.path == "/api/v1/metrics/inflight":
                     with daemon._lock:
                         instances = list(daemon.instances.values())

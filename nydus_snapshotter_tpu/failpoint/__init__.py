@@ -73,6 +73,11 @@ KNOWN_SITES = (
     "pipeline.compress",     # parallel/pipeline.py compress-worker item entry
     "pipeline.assemble",     # parallel/pipeline.py ordered chunks_for fetch
     "fused.dispatch",        # ops/fused_convert.py device batch dispatch
+    "blobcache.fetch",       # daemon/fetch_sched.py worker ranged-GET entry
+    "blobcache.coalesce",    # daemon/fetch_sched.py miss-gap merge decision
+    "blobcache.readahead",   # daemon/blobcache.py sequential window extension
+    "blobcache.evict",       # cache/manager.py watermark entry eviction
+    "blobcache.replay",      # daemon/fetch_sched.py prefetch-replay per file
 )
 
 _lock = threading.Lock()

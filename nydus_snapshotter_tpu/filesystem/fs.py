@@ -512,6 +512,12 @@ class Filesystem:
     # -- teardown ------------------------------------------------------------
 
     def teardown(self) -> None:
+        # Stop the periodic cache GC first: an eviction tick racing the
+        # umounts below would churn entries that are being torn down anyway.
+        try:
+            self.cache_mgr.stop_gc()
+        except Exception:
+            logger.exception("failed to stop cache GC during teardown")
         for rafs in self.instances.list():
             try:
                 self.umount(rafs.snapshot_id)

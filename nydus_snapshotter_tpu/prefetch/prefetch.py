@@ -31,6 +31,21 @@ class PrefetchManager:
         with self._lock:
             return self._map.get(image, "")
 
+    def paths_for(self, image: str) -> list[str]:
+        """The image's prefetch hint as an ordered replay list for
+        :class:`~nydus_snapshotter_tpu.daemon.fetch_sched.PrefetchReplayer`
+        (newline- or comma-separated paths, duplicates dropped, order —
+        i.e. replay priority — preserved)."""
+        info = self.get_prefetch_info(image)
+        seen: set[str] = set()
+        out: list[str] = []
+        for p in info.replace(",", "\n").split("\n"):
+            p = p.strip()
+            if p and p not in seen:
+                seen.add(p)
+                out.append(p)
+        return out
+
     def delete(self, image: str) -> None:
         with self._lock:
             self._map.pop(image, None)

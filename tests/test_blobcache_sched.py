@@ -1,0 +1,535 @@
+"""Parallel lazy-read data plane (daemon/fetch_sched.py + blobcache.py).
+
+Pins the scheduler's hard invariants: byte-identical reads vs the serial
+path under any worker count / coalesce gap / readahead window (property
+test), zero duplicate network fetches for concurrent same-extent readers
+(the singleflight regression), batched chunk-map flushing with torn-tail
+recovery, capacity-watermark LRU eviction with transparent re-fetch under
+a live reader, prefetch-replay cancellation on umount, and health-scored
+mirror failover with cooldown recovery + 429 Retry-After in the fetcher.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import struct
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.cache.manager import CacheManager
+from nydus_snapshotter_tpu.daemon import fetch_sched
+from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob, RegistryBlobFetcher
+from nydus_snapshotter_tpu.daemon.fetch_sched import (
+    FetchConfig,
+    IntervalSet,
+    PrefetchReplayer,
+)
+
+_RECORD = struct.Struct("<QI")
+
+
+def _blob(n: int, seed: int = 1) -> bytes:
+    return random.Random(seed).randbytes(n)
+
+
+class _CountingFetcher:
+    """Thread-safe fake remote: records every ranged GET."""
+
+    def __init__(self, blob: bytes, latency: float = 0.0, fail: bool = False):
+        self.blob = blob
+        self.latency = latency
+        self.fail = fail
+        self.calls: list[tuple[int, int]] = []
+        self._lock = threading.Lock()
+
+    def __call__(self, off: int, size: int) -> bytes:
+        with self._lock:
+            self.calls.append((off, size))
+        if self.latency:
+            time.sleep(self.latency)
+        if self.fail:
+            raise OSError("injected remote failure")
+        if off + size > len(self.blob):
+            raise OSError(f"range [{off}, {off + size}) past blob end {len(self.blob)}")
+        return self.blob[off : off + size]
+
+    def fetched_ranges(self) -> list[tuple[int, int]]:
+        with self._lock:
+            return [(o, o + s) for o, s in self.calls]
+
+
+class TestIntervalSet:
+    def test_randomized_against_byte_model(self):
+        rng = random.Random(0xB10B)
+        ivs, model = IntervalSet(), set()
+        for _ in range(2500):
+            s = rng.randrange(0, 4000)
+            e = s + rng.randrange(1, 250)
+            op = rng.random()
+            if op < 0.55:
+                ivs.add(s, e)
+                model.update(range(s, e))
+            elif op < 0.65:
+                removed = ivs.remove(s, e)
+                assert removed == len(model & set(range(s, e)))
+                model -= set(range(s, e))
+            else:
+                assert ivs.covered(s, e) == all(b in model for b in range(s, e))
+                gapbytes: set[int] = set()
+                for gs, ge in ivs.missing(s, e):
+                    gapbytes.update(range(gs, ge))
+                assert gapbytes == {b for b in range(s, e) if b not in model}
+        assert ivs.total_bytes() == len(model)
+
+    def test_touching_intervals_merge(self):
+        ivs = IntervalSet()
+        ivs.add(0, 10)
+        ivs.add(10, 20)
+        assert ivs.spans() == [(0, 20)]
+        ivs.add(30, 40)
+        assert len(ivs) == 2 and not ivs.covered(0, 25)
+        ivs.add(20, 30)
+        assert ivs.spans() == [(0, 40)]
+
+
+class TestSingleflight:
+    def test_concurrent_same_extent_fetches_once(self, tmp_path):
+        """The PR-3 regression: two readers missing the same extent used
+        to both hit the network; the flight table must collapse them."""
+        blob = _blob(200_000)
+        fetcher = _CountingFetcher(blob, latency=0.01)
+        cb = CachedBlob(
+            str(tmp_path), "ab" * 32, fetcher,
+            config=FetchConfig(fetch_workers=4, merge_gap=0, readahead=0),
+        )
+        results: list[bytes] = []
+        barrier = threading.Barrier(8)
+
+        def rd():
+            barrier.wait()
+            results.append(cb.read_at(4096, 32_768))
+
+        threads = [threading.Thread(target=rd) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        cb.close()
+        assert all(r == blob[4096 : 4096 + 32_768] for r in results)
+        assert len(fetcher.calls) == 1, fetcher.calls
+
+    def test_zero_duplicate_bytes_under_overlapping_readers(self, tmp_path):
+        blob = _blob(400_000, seed=3)
+        fetcher = _CountingFetcher(blob, latency=0.001)
+        cb = CachedBlob(
+            str(tmp_path), "cd" * 32, fetcher,
+            config=FetchConfig(fetch_workers=6, merge_gap=0, readahead=0),
+        )
+        errors: list[BaseException] = []
+
+        def rd(tid: int):
+            rng = random.Random(tid)
+            try:
+                for _ in range(30):
+                    off = rng.randrange(0, len(blob) - 8192)
+                    assert cb.read_at(off, 8192) == blob[off : off + 8192]
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=rd, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        cb.close()
+        assert not errors
+        # With merge_gap=0 and no readahead, no byte may be fetched twice.
+        seen = IntervalSet()
+        for a, b in fetcher.fetched_ranges():
+            assert not seen.covered(a, a + 1) and seen.missing(a, b) == [(a, b)], (
+                f"duplicate fetch of [{a}, {b})"
+            )
+            seen.add(a, b)
+
+
+@pytest.mark.parametrize(
+    "workers,merge_gap,readahead",
+    [
+        (1, 0, 0),  # the serial path
+        (2, 0, 0),
+        (4, 4096, 0),
+        (4, 65536, 32768),
+        (8, 1 << 20, 1 << 20),
+    ],
+)
+def test_reads_byte_identical_any_config(tmp_path, workers, merge_gap, readahead):
+    """Property: whatever the scheduler does (parallelism, coalescing,
+    readahead), every read returns exactly the serial path's bytes."""
+    blob = _blob(300_000, seed=workers + merge_gap + readahead)
+    fetcher = _CountingFetcher(blob)
+    cb = CachedBlob(
+        str(tmp_path), "ef" * 32, fetcher, blob_size=len(blob),
+        config=FetchConfig(
+            fetch_workers=workers, merge_gap=merge_gap, readahead=readahead
+        ),
+    )
+    rng = random.Random(0xD00D)
+    pos = 0
+    for _ in range(120):
+        if rng.random() < 0.6:  # sequential run (exercises readahead)
+            off, size = pos, rng.randrange(1, 20_000)
+        else:
+            off, size = rng.randrange(0, len(blob)), rng.randrange(1, 30_000)
+        size = min(size, len(blob) - off)
+        if size <= 0:
+            continue
+        assert cb.read_at(off, size) == blob[off : off + size], (off, size)
+        pos = off + size
+    cb.close()
+
+
+class TestBatchedChunkMap:
+    def test_one_flush_per_miss_batch_and_records_parse(self, tmp_path):
+        blob = _blob(100_000)
+        cb = CachedBlob(
+            str(tmp_path), "aa" * 32, _CountingFetcher(blob),
+            config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0),
+        )
+        cb.read_at(0, 10_000)
+        cb.read_at(50_000, 5_000)
+        # read_at flushes once per miss batch: records are durable now.
+        raw = (tmp_path / ("aa" * 32 + ".chunk_map")).read_bytes()
+        assert len(raw) % _RECORD.size == 0 and len(raw) >= 2 * _RECORD.size
+        cb.close()
+
+    def test_torn_tail_recovery_refetches(self, tmp_path):
+        blob = _blob(100_000, seed=9)
+        fetcher = _CountingFetcher(blob)
+        cb = CachedBlob(str(tmp_path), "bb" * 32, fetcher,
+                        config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0))
+        cb.read_at(0, 8_192)
+        cb.read_at(20_000, 8_192)
+        cb.close()
+        map_path = tmp_path / ("bb" * 32 + ".chunk_map")
+        # Crash mid-append: a torn record for the second extent.
+        raw = map_path.read_bytes()
+        map_path.write_bytes(raw[: _RECORD.size] + raw[_RECORD.size : _RECORD.size + 5])
+        fetcher2 = _CountingFetcher(blob)
+        cb2 = CachedBlob(str(tmp_path), "bb" * 32, fetcher2,
+                         config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0))
+        # First extent still covered (no fetch); torn extent re-fetches.
+        assert cb2.read_at(0, 8_192) == blob[:8_192]
+        assert fetcher2.calls == []
+        assert cb2.read_at(20_000, 8_192) == blob[20_000:28_192]
+        assert fetcher2.calls == [(20_000, 8_192)]
+        cb2.close()
+
+
+class TestEviction:
+    def test_watermark_evicts_lru_entries(self, tmp_path):
+        cm = CacheManager(str(tmp_path))
+        now = time.time()
+        for i, bid in enumerate(("old", "mid", "new")):
+            p = tmp_path / f"{bid}.blob.data"
+            p.write_bytes(b"x" * 10_000)
+            os.utime(p, (now - 300 + i * 100, now - 300 + i * 100))
+        removed = cm.gc_watermark(max_bytes=15_000)
+        assert any("old" in p for p in removed)
+        assert not any("new" in p for p in removed)
+        assert cm.total_usage().size <= 15_000
+
+    def test_watermark_respects_protect_set(self, tmp_path):
+        cm = CacheManager(str(tmp_path))
+        now = time.time()
+        for i, bid in enumerate(("keep", "drop")):
+            p = tmp_path / f"{bid}.blob.data"
+            p.write_bytes(b"x" * 10_000)
+            os.utime(p, (now - 300 + i, now - 300 + i))
+        removed = cm.gc_watermark(max_bytes=10_000, protect={"keep"})
+        assert all("keep" not in p for p in removed)
+        assert (tmp_path / "keep.blob.data").exists()
+
+    def test_evicted_blob_refetches_transparently(self, tmp_path):
+        """A live CachedBlob survives a watermark eviction that unlinks
+        its files: the next read notices and re-seeds the cache."""
+        blob = _blob(120_000, seed=11)
+        fetcher = _CountingFetcher(blob)
+        cb = CachedBlob(str(tmp_path), "cc" * 32, fetcher,
+                        config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0))
+        assert cb.read_at(0, 10_000) == blob[:10_000]
+        assert len(fetcher.calls) == 1
+        cm = CacheManager(str(tmp_path))
+        with failpoint.injected("blobcache.evict", "delay(0)"):
+            removed = cm.gc_watermark(max_bytes=1)
+        assert removed and failpoint.counts().get("blobcache.evict", 0) >= 1
+        failpoint.clear()
+        # Covered extent was evicted: the read re-fetches, byte-exact.
+        assert cb.read_at(0, 10_000) == blob[:10_000]
+        assert len(fetcher.calls) == 2
+        assert os.path.exists(cb.data_path)
+        cb.close()
+
+
+class TestPrefetchReplay:
+    @staticmethod
+    def _fake_index():
+        chunks = [
+            SimpleNamespace(blob_index=0, compressed_offset=i * 1000, compressed_size=1000)
+            for i in range(20)
+        ]
+        inode = lambda ci, cc: SimpleNamespace(  # noqa: E731
+            chunk_index=ci, chunk_count=cc, hardlink_target=""
+        )
+        by_path = {"/a": inode(0, 8), "/b": inode(8, 8), "/c": inode(16, 4)}
+        bootstrap = SimpleNamespace(chunks=chunks, prefetch=["/a", "/b", "/c"])
+        return bootstrap, by_path
+
+    def test_replay_warms_cache_through_scheduler(self, tmp_path):
+        blob = _blob(40_000, seed=5)
+        fetcher = _CountingFetcher(blob)
+        cb = CachedBlob(str(tmp_path), "dd" * 32, fetcher,
+                        config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0))
+        bootstrap, by_path = self._fake_index()
+        flushes: list[int] = []
+
+        def warm_chunk(rec) -> int:
+            flights = cb.warm(rec.compressed_offset, rec.compressed_size)
+            for f in flights:
+                f.wait()
+            return 0 if any(f.error for f in flights) else rec.compressed_size
+
+        rp = PrefetchReplayer(
+            bootstrap, by_path, warm_chunk,
+            on_file=lambda: (cb.flush_map(), flushes.append(1)),
+        )
+        warmed = rp.replay(["/a", "/b", "/missing"])
+        assert warmed == 16_000 and rp.files_replayed == 2
+        assert len(flushes) == 2  # one batched flush per replayed file
+        # Warmed extents are now demand hits: no further network traffic.
+        n = len(fetcher.calls)
+        assert cb.read_at(0, 8_000) == blob[:8_000]
+        assert len(fetcher.calls) == n
+        cb.close()
+
+    def test_cancel_stops_replay_promptly(self, tmp_path):
+        blob = _blob(40_000, seed=6)
+        release = threading.Event()
+        started = threading.Event()
+
+        def slow_fetch(off, size):
+            started.set()
+            release.wait(10)
+            return blob[off : off + size]
+
+        cb = CachedBlob(str(tmp_path), "ee" * 32, slow_fetch,
+                        config=FetchConfig(fetch_workers=1, merge_gap=0, readahead=0))
+        bootstrap, by_path = self._fake_index()
+
+        def warm_chunk(rec) -> int:
+            flights = cb.warm(rec.compressed_offset, rec.compressed_size)
+            for f in flights:
+                while not f.wait(0.05):
+                    if rp.cancelled:
+                        return 0
+            return rec.compressed_size
+
+        rp = PrefetchReplayer(bootstrap, by_path, warm_chunk)
+        t = threading.Thread(target=rp.replay, args=(["/a", "/b", "/c"],), daemon=True)
+        t.start()
+        assert started.wait(5)
+        rp.cancel()  # the umount path (daemon/server._Instance.close)
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert rp.files_replayed == 0  # cancelled mid-first-file
+        release.set()
+        cb.close()
+
+    def test_paths_from_trace(self, tmp_path):
+        trace = tmp_path / "trace"
+        trace.write_text("/rootfs/usr/bin/app\n/rootfs/etc/conf\n/rootfs/usr/bin/app\n")
+        paths = PrefetchReplayer.paths_from_trace(str(trace), strip_prefix="/rootfs")
+        assert paths == ["/usr/bin/app", "/etc/conf"]
+
+
+class TestRegistryFetcherHealth:
+    @staticmethod
+    def _backend(mirrors=(), origin="origin:5000"):
+        return SimpleNamespace(
+            host=origin,
+            repo="library/x",
+            scheme="http",
+            auth="",
+            skip_verify=False,
+            mirrors=[
+                SimpleNamespace(
+                    host=m, failure_limit=2, health_check_interval=10
+                )
+                for m in mirrors
+            ],
+        )
+
+    @staticmethod
+    def _wire(fetcher, behaviors):
+        """Patch per-host clients; behaviors[host] is a callable raising or
+        returning bytes for (offset, size)."""
+
+        class _Resp:
+            def __init__(self, data):
+                self.status = 206
+                self._data = data
+
+            def read(self):
+                return self._data
+
+            def close(self):
+                pass
+
+        class _Client:
+            def __init__(self, host):
+                self.host = host
+
+            def fetch_blob(self, repo, digest, byte_range=None):
+                lo, hi = byte_range
+                return _Resp(behaviors[self.host](lo, hi - lo + 1))
+
+        fetcher._client = lambda host: _Client(host)
+
+    def test_cooldown_recovery_prefers_mirror_again(self):
+        clock = [0.0]
+        f = RegistryBlobFetcher(
+            self._backend(mirrors=("mirror:5000",)), "ab" * 32,
+            clock=lambda: clock[0], sleep=lambda s: None,
+        )
+        blob = _blob(10_000, seed=8)
+        mirror_ok = [False]
+        hits: list[str] = []
+
+        def mirror(lo, n):
+            hits.append("mirror")
+            if not mirror_ok[0]:
+                raise OSError("mirror down")
+            return blob[lo : lo + n]
+
+        def origin(lo, n):
+            hits.append("origin")
+            return blob[lo : lo + n]
+
+        self._wire(f, {"mirror:5000": mirror, "origin:5000": origin})
+        # Two failures trip the mirror's failure_limit -> cooldown.
+        for _ in range(2):
+            assert f.read_range(0, 100) == blob[:100]
+        assert not f._health["mirror:5000"].available()
+        # On cooldown the mirror is skipped entirely.
+        hits.clear()
+        assert f.read_range(0, 100) == blob[:100]
+        assert hits == ["origin"]
+        # Cooldown expires -> the recovered mirror is preferred again.
+        clock[0] = 11.0
+        mirror_ok[0] = True
+        hits.clear()
+        assert f.read_range(200, 100) == blob[200:300]
+        assert hits == ["mirror"]
+
+    def test_429_retry_after_honored_in_place(self):
+        from nydus_snapshotter_tpu.remote.registry import HTTPError
+
+        slept: list[float] = []
+        f = RegistryBlobFetcher(
+            self._backend(), "cd" * 32, sleep=slept.append
+        )
+        blob = _blob(5_000, seed=12)
+        throttled = [True]
+
+        def origin(lo, n):
+            if throttled[0]:
+                throttled[0] = False
+                raise HTTPError(429, "http://origin/x", retry_after=1.5)
+            return blob[lo : lo + n]
+
+        self._wire(f, {"origin:5000": origin})
+        assert f.read_range(0, 256) == blob[:256]
+        assert slept == [1.5]
+        # A throttle is not a failure: the host's health is untouched.
+        assert f._health["origin:5000"].consecutive_failures == 0
+
+    def test_retry_after_is_capped(self):
+        from nydus_snapshotter_tpu.daemon.blobcache import RETRY_AFTER_CAP
+        from nydus_snapshotter_tpu.remote.registry import HTTPError
+
+        slept: list[float] = []
+        f = RegistryBlobFetcher(self._backend(), "ef" * 32, sleep=slept.append)
+        blob = _blob(1_000, seed=13)
+        first = [True]
+
+        def origin(lo, n):
+            if first[0]:
+                first[0] = False
+                raise HTTPError(429, "http://origin/x", retry_after=3600.0)
+            return blob[lo : lo + n]
+
+        self._wire(f, {"origin:5000": origin})
+        assert f.read_range(0, 64) == blob[:64]
+        assert slept == [RETRY_AFTER_CAP]
+
+
+class TestChaos:
+    def test_fetch_failpoint_surfaces_and_recovers(self, tmp_path):
+        blob = _blob(50_000, seed=14)
+        fetcher = _CountingFetcher(blob)
+        cb = CachedBlob(str(tmp_path), "ff" * 32, fetcher,
+                        config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0))
+        with failpoint.injected("blobcache.fetch", "error(OSError:injected)*1"):
+            with pytest.raises(OSError):
+                cb.read_at(0, 4096)
+        # The failed flight is gone from the table: the retry re-fetches.
+        assert cb.read_at(0, 4096) == blob[:4096]
+        cb.close()
+
+    def test_readahead_failure_does_not_fail_the_read(self, tmp_path):
+        blob = _blob(100_000, seed=15)
+
+        def fetch(off, size):
+            if off >= 20_000:  # readahead territory
+                raise OSError("remote hates readahead")
+            return blob[off : off + size]
+
+        cb = CachedBlob(
+            str(tmp_path), "ab" * 32, fetch, blob_size=len(blob),
+            config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=50_000),
+        )
+        assert cb.read_at(0, 10_000) == blob[:10_000]
+        # Sequential: triggers readahead past 20_000, which fails — the
+        # demand read must still succeed.
+        assert cb.read_at(10_000, 10_000) == blob[10_000:20_000]
+        cb.close()
+
+
+class TestConfigResolution:
+    def test_env_overrides_win(self, monkeypatch):
+        monkeypatch.setenv("NTPU_BLOBCACHE_WORKERS", "7")
+        monkeypatch.setenv("NTPU_BLOBCACHE_MERGE_GAP_KIB", "0")
+        monkeypatch.setenv("NTPU_BLOBCACHE_READAHEAD_KIB", "256")
+        monkeypatch.setenv("NTPU_BLOBCACHE_BUDGET_MIB", "8")
+        monkeypatch.setenv("NTPU_BLOBCACHE_PREFETCH", "off")
+        cfg = fetch_sched.resolve_config()
+        assert cfg.fetch_workers == 7
+        assert cfg.merge_gap == 0
+        assert cfg.readahead == 256 << 10
+        assert cfg.budget_bytes == 8 << 20
+        assert cfg.prefetch_replay is False
+
+    def test_blobcache_section_validates(self):
+        from nydus_snapshotter_tpu.config.config import ConfigError, load_config
+
+        cfg = load_config(overrides={"blobcache": {"fetch_workers": 2,
+                                                   "eviction_watermark_mib": 512}})
+        assert cfg.blobcache.fetch_workers == 2
+        with pytest.raises(ConfigError):
+            load_config(overrides={"blobcache": {"fetch_workers": 0}})
+        with pytest.raises(ConfigError):
+            load_config(overrides={"blobcache": {"inflight_budget_mib": 0}})

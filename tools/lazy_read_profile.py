@@ -1,0 +1,258 @@
+"""Lazy-read profile: serial vs parallel fetch scheduler over one
+simulated-latency registry, with hit ratio / coalesce factor / readahead
+accuracy from the ``ntpu_blobcache_*`` metrics.
+
+The registry is simulated in-process: every ranged GET pays a fixed
+latency (HTTP round trip) plus a bandwidth term, which is exactly the
+regime the scheduler exists for — request count and request overlap
+dominate cold-start wall time. "Serial" is the scheduler pinned to the
+pre-PR-3 behavior (1 worker, no coalescing, no readahead); the parallel
+run uses N workers with both enabled.
+
+Doubles as the CI smoke driver (the ``blobcache-smoke`` job):
+``--workers 4`` under ``PYTHONDEVMODE=1`` gates on byte identity with the
+source blob, zero duplicate fetches in the concurrent same-extent phase,
+cold-read wall improvement over serial, and no leaked fetch threads.
+
+Usage: python tools/lazy_read_profile.py [--mib 16] [--workers 4]
+           [--latency-ms 2.0] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+class SimulatedRegistry:
+    """Thread-safe ranged-GET source with per-request latency."""
+
+    def __init__(self, blob: bytes, latency_s: float, gibps: float = 1.0):
+        self.blob = blob
+        self.latency_s = latency_s
+        self.byte_s = 1.0 / (gibps * (1 << 30))
+        self.calls: list[tuple[int, int]] = []
+        self._lock = threading.Lock()
+
+    def fetch(self, off: int, size: int) -> bytes:
+        with self._lock:
+            self.calls.append((off, size))
+        time.sleep(self.latency_s + size * self.byte_s)
+        if off + size > len(self.blob):
+            raise OSError(f"range [{off}, {off + size}) past blob end")
+        return self.blob[off : off + size]
+
+
+def _chunk_plan(blob_len: int, chunk: int, seed: int) -> list[tuple[int, int]]:
+    """A container cold-start shaped read plan: mostly sequential chunk
+    walks (binary + libs) with some random hops (config files)."""
+    rng = random.Random(seed)
+    plan: list[tuple[int, int]] = []
+    pos = 0
+    while pos < blob_len:
+        if rng.random() < 0.15 and blob_len > 4 * chunk:
+            pos = rng.randrange(0, blob_len - chunk) // chunk * chunk
+        size = min(chunk, blob_len - pos)
+        plan.append((pos, size))
+        pos += size
+        if len(plan) * chunk >= blob_len:
+            break
+    return plan
+
+
+def _run_reads(cb, plan, n_threads: int) -> float:
+    """Wall time for the plan split across reader threads (the daemon's
+    request threads); raises on any byte mismatch."""
+    errors: list[BaseException] = []
+    shards = [plan[i::n_threads] for i in range(n_threads)]
+
+    def reader(shard):
+        try:
+            for off, size in shard:
+                got = cb.read_at(off, size)
+                if got != cb._profile_blob[off : off + size]:
+                    raise AssertionError(f"bytes differ at [{off}, {off + size})")
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=reader, args=(s,)) for s in shards]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return time.perf_counter() - t0
+
+
+def profile(
+    mib: int = 16,
+    workers: int = 4,
+    latency_ms: float = 2.0,
+    chunk_kib: int = 64,
+    readers: int = 4,
+    seed: int = 7,
+) -> dict:
+    import tempfile
+
+    from nydus_snapshotter_tpu.daemon import fetch_sched
+    from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+    from nydus_snapshotter_tpu.daemon.fetch_sched import FetchConfig, IntervalSet
+
+    blob = random.Random(seed).randbytes(mib << 20)
+    chunk = chunk_kib << 10
+    plan = _chunk_plan(len(blob), chunk, seed)
+    latency = latency_ms / 1000.0
+
+    def run(tag: str, cfg: FetchConfig, n_threads: int):
+        reg = SimulatedRegistry(blob, latency)
+        cb = CachedBlob(
+            tempfile.mkdtemp(prefix=f"lazyprof-{tag}-"),
+            "ab" * 32,
+            reg.fetch,
+            blob_size=len(blob),
+            config=cfg,
+        )
+        cb._profile_blob = blob  # identity oracle for _run_reads
+        before = fetch_sched.snapshot_counters()
+        cold = _run_reads(cb, plan, n_threads)
+        warm = _run_reads(cb, plan, n_threads)
+        after = fetch_sched.snapshot_counters()
+        cb.close()
+        return cb, reg, cold, warm, before, after
+
+    serial_cfg = FetchConfig(fetch_workers=1, merge_gap=0, readahead=0)
+    par_cfg = FetchConfig(fetch_workers=workers)
+
+    _, sreg, serial_cold, serial_warm, _, _ = run("serial", serial_cfg, 1)
+    _, preg, par_cold, par_warm, before, after = run("par", par_cfg, readers)
+
+    hit = after["hit_bytes"] - before["hit_bytes"]
+    miss = after["miss_bytes"] - before["miss_bytes"]
+    requests = after["fetch_requests"] - before["fetch_requests"]
+    coalesced = after["coalesced_requests"] - before["coalesced_requests"]
+
+    # Concurrent same-extent phase (merge_gap/readahead off): N readers
+    # hammer the same extents; zero duplicate fetched bytes allowed.
+    dup_reg = SimulatedRegistry(blob, latency)
+    import tempfile as _tf
+
+    cb = CachedBlob(
+        _tf.mkdtemp(prefix="lazyprof-dup-"),
+        "cd" * 32,
+        dup_reg.fetch,
+        blob_size=len(blob),
+        config=FetchConfig(fetch_workers=workers, merge_gap=0, readahead=0),
+    )
+    extents = [(i * chunk, chunk) for i in range(32)]
+    barrier = threading.Barrier(readers)
+    dup_errors: list[BaseException] = []
+
+    def hammer():
+        try:
+            barrier.wait()
+            for off, size in extents:
+                assert cb.read_at(off, size) == blob[off : off + size]
+        except BaseException as e:  # noqa: BLE001
+            dup_errors.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(readers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    cb.close()
+    if dup_errors:
+        raise dup_errors[0]
+    seen = IntervalSet()
+    duplicates = 0
+    for off, size in dup_reg.calls:
+        if seen.missing(off, off + size) != [(off, off + size)]:
+            duplicates += 1
+        seen.add(off, off + size)
+
+    leaked = [t.name for t in threading.enumerate() if t.name.startswith("ntpu-fetch")]
+    total = sum(s for _, s in plan)
+    return {
+        "blob_mib": mib,
+        "chunk_kib": chunk_kib,
+        "latency_ms": latency_ms,
+        "fetch_workers": workers,
+        "reader_threads": readers,
+        "read_plan_extents": len(plan),
+        "serial_cold_wall_s": round(serial_cold, 4),
+        "serial_warm_wall_s": round(serial_warm, 4),
+        "cold_wall_s": round(par_cold, 4),
+        "warm_wall_s": round(par_warm, 4),
+        "cold_speedup": round(serial_cold / max(1e-9, par_cold), 3),
+        "cold_mibps": round(total / par_cold / (1 << 20), 2),
+        "hit_ratio": round(hit / max(1, hit + miss), 4),
+        "coalesce_factor": round(len(plan) / max(1, requests), 3),
+        "coalesced_requests": int(coalesced),
+        "requests_serial": len(sreg.calls),
+        "requests_parallel": len(preg.calls),
+        "readahead_accuracy": after["readahead_accuracy"],
+        "duplicate_fetches": duplicates,
+        "leaked_threads": leaked,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mib", type=int, default=16, help="blob size")
+    ap.add_argument("--workers", type=int, default=4, help="fetch workers")
+    ap.add_argument("--latency-ms", type=float, default=2.0,
+                    help="simulated per-request registry latency")
+    ap.add_argument("--chunk-kib", type=int, default=64)
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args()
+
+    report = profile(
+        mib=args.mib,
+        workers=args.workers,
+        latency_ms=args.latency_ms,
+        chunk_kib=args.chunk_kib,
+    )
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(
+            f"cold: serial {report['serial_cold_wall_s']:.3f}s  "
+            f"parallel({args.workers}w) {report['cold_wall_s']:.3f}s  "
+            f"speedup {report['cold_speedup']}x"
+        )
+        print(
+            f"warm: {report['warm_wall_s']:.3f}s  hit ratio {report['hit_ratio']}  "
+            f"coalesce factor {report['coalesce_factor']} "
+            f"({report['requests_parallel']} GETs for {report['read_plan_extents']} extents)"
+        )
+        print(
+            f"readahead accuracy: {report['readahead_accuracy']}  "
+            f"duplicates: {report['duplicate_fetches']}  "
+            f"leaked: {report['leaked_threads']}"
+        )
+    if report["duplicate_fetches"]:
+        print("FAIL: duplicate network fetches for concurrent same-extent readers",
+              file=sys.stderr)
+        return 1
+    if args.workers >= 4 and report["cold_speedup"] < 1.2:
+        print(f"FAIL: cold-read speedup {report['cold_speedup']} < 1.2 "
+              f"at {args.workers} workers", file=sys.stderr)
+        return 1
+    if report["leaked_threads"]:
+        print(f"FAIL: leaked fetch threads {report['leaked_threads']}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
