@@ -774,6 +774,34 @@ def lazy_read_run(repo: str, timeout: float = 240.0) -> dict:
         return {"error": "lazy-read profile produced no JSON"}
 
 
+_SNAPSHOT_OPS_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from tools.snapshot_profile import profile
+print(json.dumps(profile(layers=8, pods=8)))
+"""
+
+
+def snapshot_ops_run(repo: str, timeout: float = 240.0) -> dict:
+    """Snapshot control-plane storm (tools/snapshot_profile.py) in a child
+    under the hard watchdog: serial vs concurrent wall plus p50/p99 per
+    op, with the identity gate evaluated in-process. A wedged prepare
+    board or usage accountant costs one timeout, not a hang."""
+    res = _run_child_watchdog(
+        [sys.executable, "-c", _SNAPSHOT_OPS_CHILD.format(repo=repo)], timeout=timeout
+    )
+    if res is None:
+        return {"error": f"snapshot profile hung >{timeout:.0f}s (watchdog killed it)"}
+    rc, stdout, stderr = res
+    if rc != 0:
+        tail = stderr.strip().splitlines()[-1] if stderr.strip() else ""
+        return {"error": f"snapshot profile exited rc={rc}: {tail}"[:200]}
+    try:
+        return json.loads(stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": "snapshot profile produced no JSON"}
+
+
 def _device_available(repo: str, timeout: float = 120.0) -> tuple[bool, str]:
     """(ok, note) — probe jax.devices() in a subprocess under the hard
     watchdog (_run_child_watchdog): a wedged device tunnel must degrade
@@ -1011,6 +1039,7 @@ def main() -> None:
     stargz_zran = stargz_zran_run(opt)
     real_image = real_image_run(opt)
     lazy_read = lazy_read_run(repo)
+    snapshot_ops = snapshot_ops_run(repo)
 
     print(
         json.dumps(
@@ -1041,6 +1070,7 @@ def main() -> None:
                     "stage_breakdown_s": stage_breakdown,
                     "pipeline": pipeline_info,
                     "lazy_read": lazy_read,
+                    "snapshot_ops": snapshot_ops,
                     "accel_profile": accel_profile,
                     "zstd_profile": zstd_profile,
                     "reference_defaults_profile": reference_defaults_profile,

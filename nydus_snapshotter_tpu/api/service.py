@@ -12,7 +12,7 @@ from __future__ import annotations
 import logging
 import re
 from concurrent import futures
-from typing import Iterator
+from typing import Iterator, Optional
 
 import grpc
 from google.protobuf import empty_pb2
@@ -204,8 +204,22 @@ def add_snapshots_service(server: grpc.Server, sn: Snapshotter) -> SnapshotsServ
     return service
 
 
-def serve(sn: Snapshotter, address: str, max_workers: int = 8) -> grpc.Server:
+def worker_count(snapshots_cfg=None) -> int:
+    """gRPC handler pool sized to the control plane: with the metastore
+    read pool and the prepare fanout absorbing concurrent RPCs, the
+    handler pool — not a global metastore lock — is the admission bound,
+    so it must be at least as wide as what the control plane can overlap."""
+    read_pool = getattr(snapshots_cfg, "read_pool", 8)
+    fanout = getattr(snapshots_cfg, "prepare_fanout", 4)
+    return max(8, read_pool + fanout)
+
+
+def serve(
+    sn: Snapshotter, address: str, max_workers: Optional[int] = None
+) -> grpc.Server:
     """Start the snapshots gRPC server on a UDS path; returns the server."""
+    if max_workers is None:
+        max_workers = worker_count()
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     add_snapshots_service(server, sn)
     server.add_insecure_port(f"unix:{address}")

@@ -261,6 +261,11 @@ def build_stack(cfg: SnapshotterConfig):
         daemon_mode=cfg.daemon_mode,
         sync_remove=cfg.snapshot.sync_remove,
         cleanup_on_close=cfg.cleanup_on_close,
+        read_pool=cfg.snapshots.read_pool,
+        prepare_fanout=cfg.snapshots.prepare_fanout,
+        usage_workers=cfg.snapshots.usage_workers,
+        cleanup_workers=cfg.snapshots.cleanup_workers,
+        ancestor_cache=cfg.snapshots.ancestor_cache,
     )
     return sn, fs, managers, db
 
@@ -304,7 +309,9 @@ def main(argv=None) -> int:
     if os.path.exists(address):
         # ensureSocketNotExists (snapshotter.go:96-117)
         os.unlink(address)
-    server = grpc_service.serve(sn, address)
+    server = grpc_service.serve(
+        sn, address, max_workers=grpc_service.worker_count(cfg.snapshots)
+    )
     logger.info("serving snapshots.v1 on unix:%s (driver=%s mode=%s)",
                 address, cfg.daemon.fs_driver, cfg.daemon_mode)
 

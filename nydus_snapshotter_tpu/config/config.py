@@ -166,6 +166,32 @@ class BlobcacheConfig:
 
 
 @dataclass
+class SnapshotsConfig:
+    """Concurrent snapshot control-plane knobs
+    (snapshot/{metastore,snapshotter,async_work}.py).
+
+    The metastore serves reads from a pool of per-connection WAL readers
+    (``read_pool``) while all mutations funnel through one serialized
+    writer; ancestor chains are memoized in a bounded LRU
+    (``ancestor_cache`` entries, 0 disables). Prepare's slow tail (daemon
+    readiness, stargz bootstrap build) overlaps on a ``prepare_fanout``
+    pool joined at ``mounts()``; commit's disk-usage scan moves to
+    ``usage_workers`` async accountants joined at ``usage()``; Cleanup
+    removes orphan dirs on ``cleanup_workers`` threads. A worker count of
+    0 (prepare/usage) restores the fully serial control plane.
+    Environment variables override per-process (``NTPU_SNAPSHOT_READ_POOL``,
+    ``NTPU_SNAPSHOT_PREPARE_FANOUT``, ``NTPU_SNAPSHOT_USAGE_WORKERS``,
+    ``NTPU_SNAPSHOT_CLEANUP_WORKERS``, ``NTPU_SNAPSHOT_ANCESTOR_CACHE``).
+    """
+
+    read_pool: int = 8
+    prepare_fanout: int = 4
+    usage_workers: int = 1
+    cleanup_workers: int = 4
+    ancestor_cache: int = 1024
+
+
+@dataclass
 class ExperimentalConfig:
     enable_stargz: bool = False
     enable_referrer_detect: bool = False
@@ -196,6 +222,7 @@ class SnapshotterConfig:
     image: ImageConfig = field(default_factory=ImageConfig)
     convert: ConvertConfig = field(default_factory=ConvertConfig)
     blobcache: BlobcacheConfig = field(default_factory=BlobcacheConfig)
+    snapshots: SnapshotsConfig = field(default_factory=SnapshotsConfig)
     experimental: ExperimentalConfig = field(default_factory=ExperimentalConfig)
 
     # -- derived paths (reference config/global.go accessors) ---------------
@@ -276,6 +303,16 @@ class SnapshotterConfig:
             raise ConfigError(
                 "blobcache.eviction_watermark_mib must be >= 0 (0 = unbounded)"
             )
+        if self.snapshots.read_pool < 1:
+            raise ConfigError("snapshots.read_pool must be >= 1")
+        if self.snapshots.prepare_fanout < 0 or self.snapshots.usage_workers < 0:
+            raise ConfigError(
+                "snapshots prepare_fanout/usage_workers must be >= 0 (0 = serial)"
+            )
+        if self.snapshots.cleanup_workers < 1:
+            raise ConfigError("snapshots.cleanup_workers must be >= 1")
+        if self.snapshots.ancestor_cache < 0:
+            raise ConfigError("snapshots.ancestor_cache must be >= 0 (0 = disabled)")
         if self.daemon.fs_driver in (constants.FS_DRIVER_BLOCKDEV, constants.FS_DRIVER_PROXY):
             # Proxy/blockdev modes run without nydusd daemons
             # (reference config.go:300-311 forces daemon_mode none).

@@ -78,6 +78,10 @@ KNOWN_SITES = (
     "blobcache.readahead",   # daemon/blobcache.py sequential window extension
     "blobcache.evict",       # cache/manager.py watermark entry eviction
     "blobcache.replay",      # daemon/fetch_sched.py prefetch-replay per file
+    "snapshot.prepare",      # snapshot/async_work.py background prepare work
+    "snapshot.commit",       # snapshot/snapshotter.py commit entry
+    "snapshot.usage",        # snapshot/async_work.py async usage scan
+    "snapshot.cleanup",      # snapshot/snapshotter.py per-dir cleanup
 )
 
 _lock = threading.Lock()

@@ -146,7 +146,29 @@ def record_daemon_event(daemon_id: str, event: str) -> None:
     data.DaemonEvent.labels(daemon_id, event).set(time.time())
 
 
+class _PairTimer:
+    """One timing window observed into several histogram children."""
+
+    def __init__(self, children):
+        self._children = children
+
+    def __enter__(self):
+        self._start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        elapsed_ms = (time.monotonic() - self._start) * 1000.0
+        for child in self._children:
+            child.observe(elapsed_ms)
+        return False
+
+
 def snapshot_timer(operation: str):
     """Latency timer wrapped around snapshotter methods
-    (collector.NewSnapshotMetricsTimer, snapshot.go:303-592)."""
-    return data.SnapshotEventElapsedHists.labels(operation).time_ms()
+    (collector.NewSnapshotMetricsTimer, snapshot.go:303-592). Lands in
+    both the reference-named histogram (dashboards keyed on the Go
+    exporter) and the ntpu_snapshot_* control-plane series."""
+    return _PairTimer((
+        data.SnapshotEventElapsedHists.labels(operation),
+        data.SnapshotOpHists.labels(operation),
+    ))

@@ -73,6 +73,34 @@ DaemonRSS = reg.register(TTLGauge(
     ttl_sec=300.0,
 ))
 
+# -- snapshot control plane (concurrent metastore + overlapped prepare) -------
+
+SnapshotOpHists = reg.register(Histogram(
+    "ntpu_snapshot_op_duration_milliseconds",
+    "Latency of snapshot control-plane operations (mounts/prepare/remove/cleanup).",
+    ("op",),
+))
+SnapshotWriteLockWait = reg.register(Histogram(
+    "ntpu_snapshot_write_lock_wait_milliseconds",
+    "Wait for the metastore's serialized writer lock.",
+))
+SnapshotReadPoolWait = reg.register(Histogram(
+    "ntpu_snapshot_read_pool_wait_milliseconds",
+    "Wait to acquire a metastore read-pool connection.",
+))
+SnapshotAncestorCacheHits = reg.register(Counter(
+    "ntpu_snapshot_ancestor_cache_hits_total",
+    "Ancestor-chain lookups served from the bounded LRU."))
+SnapshotAncestorCacheMisses = reg.register(Counter(
+    "ntpu_snapshot_ancestor_cache_misses_total",
+    "Ancestor-chain lookups that walked the parent rows."))
+SnapshotPendingPrepares = reg.register(Gauge(
+    "ntpu_snapshot_pending_prepares",
+    "Background prepare jobs not yet joined at mounts()."))
+SnapshotPendingUsageScans = reg.register(Gauge(
+    "ntpu_snapshot_pending_usage_scans",
+    "Disk-usage scans queued or running in the async accountant."))
+
 # -- inflight / hung IO (collector wiring serve.go:26, :160-189) --------------
 
 HungIOCount = reg.register(Gauge(

@@ -20,11 +20,19 @@ import os
 import shutil
 import tempfile
 import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional, Protocol
 
 from nydus_snapshotter_tpu import constants as C
+from nydus_snapshotter_tpu import failpoint
 from nydus_snapshotter_tpu.snapshot import labels as label
 from nydus_snapshotter_tpu.snapshot import metastore as ms
+from nydus_snapshotter_tpu.snapshot.async_work import (
+    PrepareBoard,
+    UsageAccountant,
+    resolve_snapshots_config,
+)
 from nydus_snapshotter_tpu.snapshot.metastore import Info, MetaStore, Snapshot, Usage
 from nydus_snapshotter_tpu.snapshot.mount import (
     KATA_IMAGE_RAW_BLOCK,
@@ -117,6 +125,11 @@ class Snapshotter:
         sync_remove: bool = False,
         cleanup_on_close: bool = False,
         nydus_overlayfs_path: str = "",
+        read_pool: Optional[int] = None,
+        prepare_fanout: Optional[int] = None,
+        usage_workers: Optional[int] = None,
+        cleanup_workers: Optional[int] = None,
+        ancestor_cache: Optional[int] = None,
     ):
         self.root = root
         self.fs = fs
@@ -127,8 +140,27 @@ class Snapshotter:
         self.sync_remove = sync_remove
         self.cleanup_on_close = cleanup_on_close
         self.nydus_overlayfs_path = nydus_overlayfs_path
+        # Control-plane concurrency knobs ([snapshots] / NTPU_SNAPSHOT*);
+        # explicit arguments win, 0 anywhere falls back to the serial path.
+        ccfg = resolve_snapshots_config()
+        self.prepare_fanout = ccfg.prepare_fanout if prepare_fanout is None else prepare_fanout
+        self.usage_workers = ccfg.usage_workers if usage_workers is None else usage_workers
+        self.cleanup_workers = max(
+            1, ccfg.cleanup_workers if cleanup_workers is None else cleanup_workers
+        )
         os.makedirs(self.snapshot_root(), exist_ok=True)
-        self.ms = MetaStore(os.path.join(root, "snapshots", "metadata.db"))
+        self.ms = MetaStore(
+            os.path.join(root, "snapshots", "metadata.db"),
+            read_pool=ccfg.read_pool if read_pool is None else read_pool,
+            ancestor_cache=ancestor_cache,
+        )
+        self._board = PrepareBoard(self.prepare_fanout)
+        self._usage_acct = UsageAccountant(
+            scan=_disk_usage,
+            write=self.ms.set_usages,
+            workers=self.usage_workers,
+            pre_wait=self._board.wait_quiet,
+        )
         # In-flight prepare temp dirs ("new-*"): the Cleanup GC must not
         # reap a sibling RPC's staging dir mid-rename (the orphan sweep
         # only targets crash leftovers, which are never in this set).
@@ -167,6 +199,9 @@ class Snapshotter:
         return self.ms.update_info(info, *fieldpaths)
 
     def usage(self, key: str) -> Usage:
+        # Join any pending async accounting scan first so the row read
+        # below reflects it (a failed scan surfaces here, once).
+        self._usage_acct.join(key)
         sid, info, usage = self.ms.get_info(key)
         if info.kind == ms.KIND_ACTIVE:
             usage = _disk_usage(self.upper_path(sid))
@@ -183,6 +218,11 @@ class Snapshotter:
         need_remote = False
         meta_sid = ""
         sid, info, _ = self.ms.get_info(key)
+        # Join point of the overlapped prepare: background work for this
+        # snapshot (daemon readiness, stargz bootstrap build) must have
+        # finished — and a failed background prepare surfaces HERE, it is
+        # never swallowed by the worker thread.
+        self._board.join(sid)
 
         if info.kind == ms.KIND_VIEW:
             if label.is_nydus_meta_layer(info.labels):
@@ -199,6 +239,7 @@ class Snapshotter:
                 need_remote, meta_sid = True, sid
         elif info.kind == ms.KIND_ACTIVE and info.parent:
             p_sid, p_info, _ = self.ms.get_info(info.parent)
+            self._board.join(p_sid)
             if label.is_nydus_meta_layer(p_info.labels):
                 self.fs.wait_until_ready(p_sid)
                 need_remote, meta_sid = True, p_sid
@@ -239,6 +280,7 @@ class Snapshotter:
 
     def view(self, key: str, parent: str, snap_labels: Optional[dict] = None) -> list[Mount]:
         p_sid, p_info, _ = self.ms.get_info(parent)
+        self._board.join(p_sid)
         need_remote = False
         meta_sid = ""
         if label.is_nydus_meta_layer(p_info.labels):
@@ -262,14 +304,18 @@ class Snapshotter:
             return self._mount_remote(base.labels, s, meta_sid, key)
         return self._mount_native(base.labels, s)
 
+    @_timed("commit")
     def commit(self, name: str, key: str, snap_labels: Optional[dict] = None) -> None:
+        failpoint.hit("snapshot.commit")
         sid, info, _ = self.ms.get_info(key)
-        usage = _disk_usage(self.upper_path(sid))
-        self.ms.commit_active(key, name, usage)
-        if snap_labels:
-            _, new_info, _ = self.ms.get_info(name)
-            new_info.labels.update(snap_labels)
-            self.ms.update_info(new_info)
+        # One timestamp and one write transaction for the whole commit
+        # (rename + label merge); the upper-dir usage scan moves off the
+        # critical path into the async accountant, which backfills the row
+        # and is joined by usage().
+        self.ms.commit_active(
+            key, name, Usage(), now=time.time(), extra_labels=snap_labels or None
+        )
+        self._usage_acct.submit(name, self.upper_path(sid), sid=sid)
 
     @_timed("remove")
     def remove(self, key: str) -> None:
@@ -281,6 +327,8 @@ class Snapshotter:
                     target=self._remove_cache_quietly, args=(blob_digest,), daemon=True
                 ).start()
         self.ms.remove(key)
+        self._board.discard(sid)
+        self._usage_acct.discard(key)
         if self.sync_remove:
             for d in self._get_cleanup_directories():
                 self._cleanup_snapshot_directory(d)
@@ -290,10 +338,27 @@ class Snapshotter:
 
     @_timed("cleanup")
     def cleanup(self) -> None:
-        for d in self._get_cleanup_directories():
-            self._cleanup_snapshot_directory(d)
+        dirs = self._get_cleanup_directories()
+        if not dirs:
+            return
+        if self.cleanup_workers > 1 and len(dirs) > 1:
+            with ThreadPoolExecutor(
+                max_workers=min(self.cleanup_workers, len(dirs)),
+                thread_name_prefix="ntpu-snap-clean",
+            ) as ex:
+                for fut in [ex.submit(self._cleanup_snapshot_directory, d) for d in dirs]:
+                    fut.result()
+        else:
+            for d in dirs:
+                self._cleanup_snapshot_directory(d)
 
     def close(self) -> None:
+        # Quiesce background work first: prepare jobs may still touch the
+        # fs facade, and pending usage scans must land in the metastore
+        # before it closes.
+        self._board.close()
+        self._usage_acct.flush()
+        self._usage_acct.close()
         if self.cleanup_on_close:
             try:
                 self.fs.teardown()
@@ -317,8 +382,14 @@ class Snapshotter:
 
         def remote_handler(sid: str, rl: dict):
             def run():
+                # Surface any failed background prep of the layer we are
+                # about to mount over, then mount synchronously (cheap
+                # registration + spawn kick; the mountpoint feeds lowerdir
+                # synthesis below). The slow part — daemon readiness — is
+                # deferred to the board, joined at mounts().
+                self._board.join(sid)
                 self.fs.mount(sid, rl, s)
-                self.fs.wait_until_ready(sid)
+                self._board.submit(s.id, functools.partial(self.fs.wait_until_ready, sid))
                 return False, self._mount_remote(rl, s, sid, key)
 
             return run
@@ -348,15 +419,35 @@ class Snapshotter:
                 if self.fs.stargz_enabled():
                     ok, blob = self.fs.is_stargz_data_layer(snap_labels)
                     if ok:
-                        try:
-                            self.fs.prepare_stargz_meta_layer(
-                                blob, self.upper_path(s.id), snap_labels
+                        if self._board.enabled:
+                            # Optimistic skip: detection already succeeded, so
+                            # the heavy TOC→bootstrap build overlaps on the
+                            # board while containerd issues the next layer's
+                            # Prepare; a failure sticks to this snapshot id
+                            # and surfaces at mounts()/the child prepare.
+                            self._board.submit(
+                                s.id,
+                                functools.partial(
+                                    self.fs.prepare_stargz_meta_layer,
+                                    blob,
+                                    self.upper_path(s.id),
+                                    dict(snap_labels),
+                                ),
                             )
-                        except Exception:
-                            logger.exception("prepare stargz layer of snapshot %s", s.id)
-                        else:
                             snap_labels[C.STARGZ_LAYER] = "true"
                             handler = skip_handler
+                        else:
+                            try:
+                                self.fs.prepare_stargz_meta_layer(
+                                    blob, self.upper_path(s.id), snap_labels
+                                )
+                            except Exception:
+                                logger.exception(
+                                    "prepare stargz layer of snapshot %s", s.id
+                                )
+                            else:
+                                snap_labels[C.STARGZ_LAYER] = "true"
+                                handler = skip_handler
                 if handler is None and self.fs.tarfs_enabled():
                     try:
                         self.fs.prepare_tarfs_layer(snap_labels, s.id, self.upper_path(s.id))
@@ -404,6 +495,9 @@ class Snapshotter:
                 and self.fs.stargz_enabled()
                 and label.is_stargz_layer(p_info.labels)
             ):
+                # The parent's bootstrap may still be building in the
+                # background — this is its other join point.
+                self._board.join(p_sid)
                 self.fs.merge_stargz_meta_layer(s)
                 handler = remote_handler(p_sid, p_info.labels)
 
@@ -414,6 +508,7 @@ class Snapshotter:
                 and self.fs.tarfs_enabled()
                 and label.is_tarfs_data_layer(p_info.labels)
             ):
+                self._board.join(p_sid)
                 self._merge_tarfs(s, p_sid, p_info)
                 handler = remote_handler(p_sid, p_info.labels)
 
@@ -678,7 +773,9 @@ class Snapshotter:
         ]
 
     def _cleanup_snapshot_directory(self, d: str) -> None:
+        failpoint.hit("snapshot.cleanup")
         sid = os.path.basename(d)
+        self._board.discard(sid)
         try:
             self.fs.umount(sid)
         except (errdefs.NotFound, FileNotFoundError):

@@ -1,0 +1,457 @@
+"""Concurrent snapshot control plane: metastore storm vs serial replay,
+ancestor-cache invalidation, async usage-accounting joins, and chaos at
+the new ``snapshot.*`` failpoint sites (a failed background prepare must
+surface at ``mounts()``, never be swallowed by a worker thread)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from nydus_snapshotter_tpu import constants as C
+from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.snapshot import metastore as ms
+from nydus_snapshotter_tpu.snapshot.metastore import MetaStore, Usage
+from nydus_snapshotter_tpu.snapshot.snapshotter import Snapshotter
+from nydus_snapshotter_tpu.utils import errdefs
+
+from tools.snapshot_profile import LatencyFs, normalize_mounts, run_storm
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoint.clear()
+    yield
+    failpoint.clear()
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = MetaStore(str(tmp_path / "metadata.db"))
+    yield s
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# MetaStore: read pool, storm vs serial replay, single-now, batching
+# ---------------------------------------------------------------------------
+
+
+def _op_log(namespaces: int, layers: int):
+    """Per-namespace op list. Namespaces are disjoint, so any interleaving
+    of the per-namespace streams is serializable to the same final state."""
+    log: dict[int, list[tuple]] = {}
+    for n in range(namespaces):
+        ops: list[tuple] = []
+        parent = ""
+        for j in range(layers):
+            key, name = f"ns{n}-prep-{j}", f"ns{n}-layer-{j}"
+            ops.append(("create", ms.KIND_ACTIVE, key, parent, {"l": str(j)}))
+            ops.append(("commit", key, name, Usage(size=100 * j, inodes=j)))
+            parent = name
+        ops.append(("create", ms.KIND_ACTIVE, f"ns{n}-rw", parent, {}))
+        ops.append(("remove", f"ns{n}-rw"))
+        ops.append(("create", ms.KIND_VIEW, f"ns{n}-view", parent, {}))
+        log[n] = ops
+    return log
+
+
+def _apply(store: MetaStore, ops) -> None:
+    for op in ops:
+        if op[0] == "create":
+            store.create_snapshot(op[1], op[2], parent=op[3], labels=op[4])
+        elif op[0] == "commit":
+            store.commit_active(op[1], op[2], op[3])
+        elif op[0] == "remove":
+            store.remove(op[1])
+
+
+class TestMetaStoreStorm:
+    def test_concurrent_storm_matches_serial_replay(self, tmp_path):
+        """N threads drive disjoint op streams; the canonical dump must be
+        byte-identical to a serial replay of the same log on a fresh
+        store — serializable semantics preserved under concurrency."""
+        log = _op_log(namespaces=8, layers=6)
+
+        conc = MetaStore(str(tmp_path / "conc.db"))
+        errors: list[BaseException] = []
+
+        def worker(ops):
+            try:
+                _apply(conc, ops)
+                for _ in range(3):  # readers riding along with the writers
+                    conc.id_map()
+                    conc.walk(lambda sid, info: None)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(ops,)) for ops in log.values()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+        serial = MetaStore(str(tmp_path / "serial.db"))
+        for n in sorted(log):
+            _apply(serial, log[n])
+        try:
+            assert conc.dump() == serial.dump()
+        finally:
+            conc.close()
+            serial.close()
+
+    def test_readers_never_see_type_confusion(self, store):
+        """The seed mutated row_factory on one shared connection per call;
+        the pool sets it once per connection. Hammer mixed read shapes."""
+        store.create_snapshot(ms.KIND_ACTIVE, "p")
+        store.commit_active("p", "base", Usage(size=7, inodes=1))
+        store.create_snapshot(ms.KIND_ACTIVE, "top", parent="base")
+        errors: list[BaseException] = []
+
+        def reader():
+            try:
+                for _ in range(100):
+                    idmap = store.id_map()
+                    assert all(
+                        isinstance(k, str) and isinstance(v, str)
+                        for k, v in idmap.items()
+                    )
+                    snap = store.get_snapshot("top")
+                    assert snap.parent_ids and all(
+                        p.isdigit() for p in snap.parent_ids
+                    )
+                    _, info, usage = store.get_info("base")
+                    assert info.name == "base" and usage.size == 7
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=reader) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+    def test_commit_and_remove_single_now(self, store):
+        store.create_snapshot(ms.KIND_ACTIVE, "k")
+        stamp = 1234567890.5
+        res = store.commit_active("k", "done", Usage(), now=stamp)
+        assert res == store.get_snapshot("done").id  # still the id string
+        assert res.now == stamp
+        _, info, _ = store.get_info("done")
+        assert info.updated == stamp
+
+        rid, kind = store.remove("done")  # historical 2-tuple unpack
+        assert kind == ms.KIND_COMMITTED
+        store.create_snapshot(ms.KIND_ACTIVE, "k2")
+        res2 = store.remove("k2", now=stamp + 1)
+        assert res2.now == stamp + 1 and res2[1] == ms.KIND_ACTIVE
+
+    def test_write_txn_batches_and_rolls_back(self, store):
+        with store.write_txn():
+            store.create_snapshot(ms.KIND_ACTIVE, "a")
+            store.create_snapshot(ms.KIND_ACTIVE, "b")
+        assert set(store.id_map().values()) == {"a", "b"}
+        with pytest.raises(RuntimeError):
+            with store.write_txn():
+                store.create_snapshot(ms.KIND_ACTIVE, "c")
+                raise RuntimeError("abort batch")
+        # the whole batch rolled back, and the store is still writable
+        assert set(store.id_map().values()) == {"a", "b"}
+        store.create_snapshot(ms.KIND_ACTIVE, "c")
+        assert "c" in store.id_map().values()
+
+    def test_set_usages_batched_backfill(self, store):
+        for n in ("x", "y"):
+            store.create_snapshot(ms.KIND_ACTIVE, f"p-{n}")
+            store.commit_active(f"p-{n}", n, Usage())
+        store.set_usages({"x": Usage(10, 1), "y": Usage(20, 2), "ghost": Usage(9, 9)})
+        assert store.usage("x").size == 10
+        assert store.usage("y").inodes == 2  # and the vanished row is ignored
+
+
+class TestAncestorCache:
+    def test_chain_cached_and_correct(self, store):
+        parent = ""
+        ids = []
+        for j in range(4):
+            s = store.create_snapshot(ms.KIND_ACTIVE, f"p{j}", parent=parent)
+            store.commit_active(f"p{j}", f"l{j}", Usage())
+            ids.append(s.id)
+            parent = f"l{j}"
+        before = store.cache_stats()
+        first = store.get_snapshot("l3").parent_ids
+        second = store.get_snapshot("l3").parent_ids
+        assert first == second == list(reversed(ids[:-1]))
+        after = store.cache_stats()
+        assert after["hits"] > before["hits"]
+
+    def test_invalidation_on_remove_and_recommit_under_reader(self, store):
+        """Commit/remove under a concurrent reader must never serve a
+        stale chain: remove a committed layer, re-commit a new snapshot
+        under the SAME name with a different parent, and the next lookup
+        must resolve the new chain."""
+        store.create_snapshot(ms.KIND_ACTIVE, "pa")
+        store.commit_active("pa", "base-a", Usage())
+        store.create_snapshot(ms.KIND_ACTIVE, "pb")
+        store.commit_active("pb", "base-b", Usage())
+        store.create_snapshot(ms.KIND_ACTIVE, "mid0", parent="base-a")
+        store.commit_active("mid0", "mid", Usage())
+        old_mid_id = store.get_snapshot("mid").id
+
+        stop = threading.Event()
+        errors: list[BaseException] = []
+
+        def reader():
+            # keep the chain cache hot while the writer churns "mid"
+            while not stop.is_set():
+                try:
+                    snap = store.get_snapshot("c-live")
+                    assert snap.parent_ids[0] == store.get_snapshot("mid").id
+                except errdefs.NotFound:
+                    pass
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+                    return
+
+        store.create_snapshot(ms.KIND_ACTIVE, "c-live", parent="mid")
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            for round_ in range(10):
+                store.remove("c-live")
+                store.remove("mid")
+                parent = "base-b" if round_ % 2 == 0 else "base-a"
+                store.create_snapshot(ms.KIND_ACTIVE, f"mid-prep-{round_}", parent=parent)
+                store.commit_active(f"mid-prep-{round_}", "mid", Usage())
+                store.create_snapshot(ms.KIND_ACTIVE, "c-live", parent="mid")
+                snap = store.get_snapshot("c-live")
+                new_mid_id = store.get_snapshot("mid").id
+                assert snap.parent_ids[0] == new_mid_id != old_mid_id
+                expected_base = store.get_snapshot(parent).id
+                assert snap.parent_ids[1] == expected_base
+        finally:
+            stop.set()
+            t.join()
+        assert not errors
+
+
+# ---------------------------------------------------------------------------
+# Snapshotter: async usage accounting + prepare board joins + chaos
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def sn(tmp_path):
+    s = Snapshotter(root=str(tmp_path), fs=LatencyFs(mount_ms=0.0, ready_ms=0.0))
+    yield s
+    s.close()
+
+
+def _fill(path: str, n: int = 3, size: int = 256) -> int:
+    total = 0
+    for i in range(n):
+        with open(os.path.join(path, f"f{i}"), "wb") as f:
+            f.write(b"x" * (size + i))
+        total += size + i
+    return total
+
+
+class TestAsyncUsage:
+    def test_usage_joins_pending_commit_scan(self, sn):
+        sn.prepare("k", "")
+        sid = sn.ms.get_snapshot("k").id
+        total = _fill(sn.upper_path(sid))
+        sn.commit("done", "k")
+        u = sn.usage("done")  # joins the async scan
+        assert u.size == total and u.inodes == 3
+
+    def test_backfill_lands_without_explicit_join(self, sn):
+        sn.prepare("k", "")
+        sid = sn.ms.get_snapshot("k").id
+        total = _fill(sn.upper_path(sid))
+        sn.commit("done", "k")
+        sn._usage_acct.flush()
+        assert sn.ms.usage("done").size == total
+
+    def test_remove_with_scan_in_flight_is_clean(self, sn):
+        sn.prepare("k", "")
+        sn.commit("done", "k")
+        sn.remove("done")  # discards the pending scan entry
+        sn._usage_acct.flush()
+        with pytest.raises(errdefs.NotFound):
+            sn.usage("done")
+
+    def test_failed_scan_surfaces_once_at_usage(self, sn):
+        sn.prepare("k", "")
+        failpoint.inject("snapshot.usage", "error(Unavailable:scan blown)*1")
+        sn.commit("done", "k")
+        with pytest.raises(errdefs.Unavailable):
+            sn.usage("done")
+        # consumed: the next usage() serves the stored row without error
+        assert sn.usage("done").size == 0
+
+    def test_serial_mode_scans_inline(self, tmp_path):
+        s = Snapshotter(
+            root=str(tmp_path), fs=LatencyFs(0, 0), usage_workers=0, prepare_fanout=0
+        )
+        try:
+            s.prepare("k", "")
+            sid = s.ms.get_snapshot("k").id
+            total = _fill(s.upper_path(sid))
+            s.commit("done", "k")
+            assert s.ms.usage("done").size == total  # no join needed
+        finally:
+            s.close()
+
+
+class TestPrepareBoardChaos:
+    def _commit_meta(self, sn, name="meta-c", ref="ref-x"):
+        meta_labels = {C.NYDUS_META_LAYER: "true", C.CRI_IMAGE_REF: "img"}
+        sn.prepare("p-meta", "", {C.TARGET_SNAPSHOT_REF: ref, **meta_labels})
+        sn.commit(name, "p-meta", meta_labels)
+        return name
+
+    def test_failed_background_prepare_surfaces_at_mounts(self, sn):
+        meta = self._commit_meta(sn)
+        failpoint.inject("snapshot.prepare", "error(Unavailable:daemon wedged)*1")
+        sn.prepare("rw", meta)  # background readiness wait blows up
+        with pytest.raises(errdefs.Unavailable):
+            sn.mounts("rw")
+        # the failure STICKS — a second Mounts must not silently succeed
+        with pytest.raises(errdefs.Unavailable):
+            sn.mounts("rw")
+        sn.remove("rw")  # discard clears the board entry
+        sn.prepare("rw2", meta)
+        assert sn.mounts("rw2")[0].type == "overlay"
+
+    def test_failed_stargz_background_prep_surfaces(self, tmp_path):
+        fs = LatencyFs(0, 0)
+        fs.stargz_enabled = lambda: True
+        fs.is_stargz_data_layer = lambda labels: (True, object())
+
+        def boom(blob, storage_path, labels):
+            raise RuntimeError("toc fetch failed")
+
+        fs.prepare_stargz_meta_layer = boom
+        s = Snapshotter(root=str(tmp_path), fs=fs)
+        try:
+            with pytest.raises(errdefs.AlreadyExists):
+                s.prepare("sgz", "", {C.TARGET_SNAPSHOT_REF: "t-sgz"})
+            # optimistic skip committed the target; the failed background
+            # build surfaces at the committed snapshot's join point
+            with pytest.raises(RuntimeError, match="toc fetch failed"):
+                s.mounts("t-sgz")
+        finally:
+            s.close()
+
+    def test_snapshot_commit_fault_is_typed_and_retryable(self, sn):
+        sn.prepare("k", "")
+        with failpoint.injected("snapshot.commit", "error(Unavailable:db down)"):
+            with pytest.raises(errdefs.Unavailable):
+                sn.commit("layer", "k")
+        _, info, _ = sn.ms.get_info("k")
+        assert info.kind == ms.KIND_ACTIVE
+        sn.commit("layer", "k")
+        sn.remove("layer")
+
+    def test_snapshot_cleanup_fault_then_parallel_cleanup(self, sn):
+        for i in range(4):
+            sn.prepare(f"gone-{i}", "")
+        sids = [sn.ms.get_snapshot(f"gone-{i}").id for i in range(4)]
+        for i in range(4):
+            sn.remove(f"gone-{i}")
+        with failpoint.injected("snapshot.cleanup", "error(Unavailable)*1"):
+            with pytest.raises(errdefs.Unavailable):
+                sn.cleanup()
+        sn.cleanup()  # parallel workers reap every orphan dir
+        for sid in sids:
+            assert not os.path.isdir(sn.snapshot_dir(sid))
+
+    def test_serial_fanout_zero_fires_prepare_site_inline(self, tmp_path):
+        s = Snapshotter(root=str(tmp_path), fs=LatencyFs(0, 0), prepare_fanout=0)
+        try:
+            meta_labels = {C.NYDUS_META_LAYER: "true", C.CRI_IMAGE_REF: "img"}
+            s.prepare("p-m", "", {C.TARGET_SNAPSHOT_REF: "r", **meta_labels})
+            s.commit("meta-c", "p-m", meta_labels)
+            with failpoint.injected("snapshot.prepare", "error(Unavailable)*1"):
+                with pytest.raises(errdefs.Unavailable):
+                    s.prepare("rw", "meta-c")
+        finally:
+            s.close()
+
+    def test_close_leaves_no_worker_threads(self, tmp_path):
+        s = Snapshotter(root=str(tmp_path), fs=LatencyFs(0, 2.0))
+        meta_labels = {C.NYDUS_META_LAYER: "true", C.CRI_IMAGE_REF: "img"}
+        s.prepare("p-m", "", {C.TARGET_SNAPSHOT_REF: "r", **meta_labels})
+        s.commit("meta-c", "p-m", meta_labels)
+        s.prepare("rw", "meta-c")
+        s.close()
+        time.sleep(0.05)
+        leaked = [
+            t.name for t in threading.enumerate() if t.name.startswith("ntpu-snap")
+        ]
+        assert not leaked
+
+
+# ---------------------------------------------------------------------------
+# Full-storm property: concurrent Snapshotter run == serial replay
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotterStorm:
+    def test_storm_identical_to_serial_replay(self, tmp_path):
+        serial_rep, serial_dump, serial_mounts = run_storm(
+            str(tmp_path / "serial"), concurrent=False,
+            layers=4, pods=4, mount_ms=0.0, ready_ms=1.0,
+        )
+        conc_rep, conc_dump, conc_mounts = run_storm(
+            str(tmp_path / "conc"), concurrent=True,
+            layers=4, pods=4, mount_ms=0.0, ready_ms=1.0,
+        )
+        assert conc_dump == serial_dump
+        assert conc_mounts == serial_mounts
+
+    def test_storm_under_chaos_keeps_store_consistent(self, tmp_path):
+        """A probabilistic fault at the background-prepare boundary must
+        only ever produce typed, surfaced errors — never a corrupt or
+        divergent metastore."""
+        failpoint.inject("snapshot.prepare", "error(Unavailable:chaos)%0.3")
+        fs = LatencyFs(0, 0)
+        sn_ = Snapshotter(root=str(tmp_path / "chaos"), fs=fs)
+        errors: list[BaseException] = []
+
+        def pod(i):
+            meta_labels = {C.NYDUS_META_LAYER: "true", C.CRI_IMAGE_REF: f"i{i}"}
+            try:
+                sn_.prepare(f"p-{i}", "", {C.TARGET_SNAPSHOT_REF: f"m-{i}", **meta_labels})
+                sn_.commit(f"meta-{i}", f"p-{i}", meta_labels)
+                sn_.prepare(f"rw-{i}", f"meta-{i}")
+                try:
+                    sn_.mounts(f"rw-{i}")
+                except errdefs.Unavailable:
+                    sn_.remove(f"rw-{i}")  # surfaced failure, clean retreat
+            except errdefs.Unavailable:
+                pass
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=pod, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        failpoint.clear()
+        try:
+            assert not errors
+            # every surviving row is readable and walkable
+            seen = []
+            sn_.walk(lambda sid, info: seen.append(info.name))
+            for name in seen:
+                sn_.ms.get_snapshot(name)
+        finally:
+            sn_.close()

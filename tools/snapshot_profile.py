@@ -1,0 +1,401 @@
+"""Snapshot control-plane profile: a K-layer x M-pod prepare/commit storm
+driven serial vs concurrent, with an identity gate and a speedup gate.
+
+The workload models a pod storm against a nydus image: per pod, K-1 data
+layers (skip-handler commits), one meta layer (prepared, written to,
+committed), one writable container layer over the meta layer (daemon
+mount + readiness), then Mounts/Usage for every snapshot — the exact RPC
+mix containerd issues during cold start. The filesystem facade simulates
+daemon latency (mount / readiness sleeps) so control-plane overlap is
+measurable without real daemons.
+
+Gates:
+
+- **identity** — the canonical metastore dump (`MetaStore.dump()`:
+  id-normalized, timestamp-free) and the normalized mount lists of the
+  concurrent run must be byte-identical to the serial replay's, at every
+  tested fanout / read-pool config;
+- **speedup** — concurrent wall must beat serial wall by ``--min-speedup``
+  (default 2.0) on the default 8x8 storm.
+
+Doubles as the CI smoke driver (``snapshot-smoke`` job, PYTHONDEVMODE=1):
+exits non-zero on identity mismatch, missed speedup, or leaked
+control-plane worker threads.
+
+Usage: python tools/snapshot_profile.py [--layers 8] [--pods 8] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import statistics
+import sys
+import tempfile
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from nydus_snapshotter_tpu import constants as C  # noqa: E402
+from nydus_snapshotter_tpu.snapshot.metastore import Usage  # noqa: E402
+from nydus_snapshotter_tpu.snapshot.snapshotter import Snapshotter  # noqa: E402
+from nydus_snapshotter_tpu.utils import errdefs  # noqa: E402
+
+
+class LatencyFs:
+    """Thread-safe FilesystemLike facade with simulated daemon latency:
+    ``mount`` costs ``mount_ms`` inline; an instance becomes ready
+    ``ready_ms`` after its mount, and ``wait_until_ready`` sleeps only the
+    remainder — once running, readiness is instant, as with a real daemon."""
+
+    def __init__(self, mount_ms: float = 3.0, ready_ms: float = 15.0):
+        self.mount_ms = mount_ms
+        self.ready_ms = ready_ms
+        self._lock = threading.Lock()
+        self._ready_at: dict[str, float] = {}
+        self.mounted: dict[str, dict] = {}
+
+    def mount(self, sid, labels, snapshot):
+        time.sleep(self.mount_ms / 1000.0)
+        with self._lock:
+            self.mounted[sid] = dict(labels)
+            self._ready_at[sid] = time.monotonic() + self.ready_ms / 1000.0
+
+    def umount(self, sid):
+        with self._lock:
+            self.mounted.pop(sid, None)
+            self._ready_at.pop(sid, None)
+
+    def wait_until_ready(self, sid):
+        with self._lock:
+            at = self._ready_at.get(sid)
+        if at is None:
+            raise errdefs.NotFound(sid)
+        delay = at - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+
+    def mount_point(self, sid):
+        with self._lock:
+            if sid in self.mounted:
+                return f"/mnt/nydus/{sid}"
+        raise errdefs.NotFound(sid)
+
+    def bootstrap_file(self, sid):
+        return f"/snap/{sid}/fs/image/image.boot"
+
+    def remove_cache(self, digest):
+        pass
+
+    def cache_usage(self, digest):
+        return Usage()
+
+    def teardown(self):
+        pass
+
+    def try_stop_shared_daemon(self):
+        pass
+
+    def check_referrer(self, labels):
+        return False
+
+    def referrer_detect_enabled(self):
+        return False
+
+    def try_fetch_metadata(self, labels, meta_path):
+        pass
+
+    def stargz_enabled(self):
+        return False
+
+    def is_stargz_data_layer(self, labels):
+        return False, None
+
+    def prepare_stargz_meta_layer(self, blob, storage_path, labels):
+        pass
+
+    def merge_stargz_meta_layer(self, snapshot):
+        pass
+
+    def tarfs_enabled(self):
+        return False
+
+    def prepare_tarfs_layer(self, labels, sid, upper):
+        pass
+
+    def merge_tarfs_layers(self, snapshot, path_fn):
+        pass
+
+    def export_block_data(self, snapshot, per_layer, labels, path_fn):
+        return []
+
+    def detach_tarfs_layer(self, sid):
+        pass
+
+    def tarfs_export_enabled(self):
+        return False
+
+    def get_instance_extra_option(self, sid):
+        return None
+
+
+_SID_PATTERNS = (re.compile(r"/snapshots/(\d+)(?=[/:,]|$)"),
+                 re.compile(r"/mnt/nydus/(\d+)(?=[/:,]|$)"))
+
+
+def normalize_mounts(mounts, id_to_key: dict[str, str], root: str):
+    """Mount lists with internal snapshot ids replaced by their keys and
+    the state root replaced by a placeholder — the id-assignment-free form
+    two runs of the same logical op history must agree on byte for byte."""
+
+    def fix(text: str) -> str:
+        text = text.replace(root, "<root>")
+        for pat in _SID_PATTERNS:
+            text = pat.sub(
+                lambda m: m.group(0).replace(m.group(1), id_to_key.get(m.group(1), m.group(1)), 1),
+                text,
+            )
+        return text
+
+    return [
+        (m.type, fix(m.source), tuple(fix(o) for o in m.options)) for m in mounts
+    ]
+
+
+def _write_layer_files(path: str, files: int, pod: int, layer: int) -> None:
+    for i in range(files):
+        with open(os.path.join(path, f"f{i:03d}.bin"), "wb") as f:
+            f.write(bytes([pod % 251]) * (512 + 16 * layer + i))
+
+
+class _OpClock:
+    """Per-op latency samples, merged across pod threads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.samples: dict[str, list[float]] = {}
+
+    def timed(self, op: str, fn, *args, **kwargs):
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            ms = (time.perf_counter() - t0) * 1000.0
+            with self._lock:
+                self.samples.setdefault(op, []).append(ms)
+
+    def percentiles(self) -> dict[str, dict[str, float]]:
+        out = {}
+        for op, vals in sorted(self.samples.items()):
+            vals = sorted(vals)
+            out[op] = {
+                "p50_ms": round(statistics.median(vals), 3),
+                "p99_ms": round(vals[min(len(vals) - 1, int(len(vals) * 0.99))], 3),
+                "n": len(vals),
+            }
+        return out
+
+
+def run_storm(
+    root: str,
+    *,
+    concurrent: bool,
+    layers: int = 8,
+    pods: int = 8,
+    fanout: int = 4,
+    read_pool: int = 8,
+    usage_workers: int = 1,
+    cleanup_workers: int = 4,
+    mount_ms: float = 3.0,
+    ready_ms: float = 15.0,
+    files_per_layer: int = 24,
+):
+    """Run the storm on a fresh root; returns (report, dump, mounts_by_key).
+
+    ``concurrent=False`` is the serial control plane: worker counts forced
+    to 0/1 and pods driven one after another — the exact op log a serial
+    replay would execute."""
+    fs = LatencyFs(mount_ms=mount_ms, ready_ms=ready_ms)
+    sn = Snapshotter(
+        root=root,
+        fs=fs,
+        prepare_fanout=fanout if concurrent else 0,
+        usage_workers=usage_workers if concurrent else 0,
+        cleanup_workers=cleanup_workers if concurrent else 1,
+        read_pool=read_pool if concurrent else 1,
+    )
+    clock = _OpClock()
+    mounts_by_key: dict[str, list] = {}
+    mounts_lock = threading.Lock()
+
+    def pod(i: int) -> None:
+        parent = ""
+        names = []
+        for j in range(layers - 1):
+            key = f"pod{i}-extract-{j}"
+            name = f"pod{i}-layer-{j}"
+            labels = {
+                C.TARGET_SNAPSHOT_REF: name,
+                C.NYDUS_DATA_LAYER: "true",
+                C.CRI_LAYER_DIGEST: f"sha256:{'%064x' % (i * 1000 + j)}",
+            }
+            try:
+                clock.timed("prepare", sn.prepare, key, parent, labels)
+            except errdefs.AlreadyExists:
+                pass  # skip handler committed under the target name
+            names.append(name)
+            parent = name
+        # topmost meta layer: prepared (bind mount), filled, committed
+        meta_key = f"pod{i}-extract-meta"
+        meta_name = f"pod{i}-meta"
+        meta_labels = {C.NYDUS_META_LAYER: "true", C.CRI_IMAGE_REF: f"img-{i}"}
+        clock.timed(
+            "prepare", sn.prepare, meta_key, parent,
+            {C.TARGET_SNAPSHOT_REF: meta_name, **meta_labels},
+        )
+        sid = sn.ms.get_snapshot(meta_key).id
+        _write_layer_files(sn.upper_path(sid), files_per_layer, i, layers - 1)
+        clock.timed("commit", sn.commit, meta_name, meta_key, meta_labels)
+        names.append(meta_name)
+        # container writable layer over the meta layer
+        ctr = f"pod{i}-ctr"
+        clock.timed("prepare", sn.prepare, ctr, meta_name, {})
+        m = clock.timed("mounts", sn.mounts, ctr)
+        with mounts_lock:
+            mounts_by_key[ctr] = m
+        for name in names:
+            clock.timed("usage", sn.usage, name)
+
+    t0 = time.perf_counter()
+    if concurrent:
+        with ThreadPoolExecutor(max_workers=pods) as ex:
+            for fut in [ex.submit(pod, i) for i in range(pods)]:
+                fut.result()
+    else:
+        for i in range(pods):
+            pod(i)
+    wall = time.perf_counter() - t0
+
+    sn._usage_acct.flush()
+    dump = sn.ms.dump()
+    id_to_key = sn.ms.id_map()
+    norm_mounts = {
+        k: normalize_mounts(v, id_to_key, root) for k, v in sorted(mounts_by_key.items())
+    }
+    cache_stats = sn.ms.cache_stats()
+    sn.close()
+    report = {
+        "wall_s": round(wall, 4),
+        "ops": clock.percentiles(),
+        "ancestor_cache": cache_stats,
+    }
+    return report, dump, norm_mounts
+
+
+def profile(
+    layers: int = 8,
+    pods: int = 8,
+    mount_ms: float = 3.0,
+    ready_ms: float = 15.0,
+    matrix: tuple = ((4, 8), (2, 2), (8, 4)),
+) -> dict:
+    """Serial baseline + one concurrent run per (fanout, read_pool) config.
+    Identity is checked for every config; the speedup is reported for the
+    first (default) config."""
+    base = tempfile.mkdtemp(prefix="ntpu-snap-profile-")
+    try:
+        serial_report, serial_dump, serial_mounts = run_storm(
+            os.path.join(base, "serial"), concurrent=False,
+            layers=layers, pods=pods, mount_ms=mount_ms, ready_ms=ready_ms,
+        )
+        runs = []
+        identical = True
+        for fanout, read_pool in matrix:
+            rep, dump, mounts = run_storm(
+                os.path.join(base, f"conc-f{fanout}-r{read_pool}"),
+                concurrent=True, layers=layers, pods=pods,
+                fanout=fanout, read_pool=read_pool,
+                mount_ms=mount_ms, ready_ms=ready_ms,
+            )
+            same = dump == serial_dump and mounts == serial_mounts
+            identical = identical and same
+            runs.append(
+                {"fanout": fanout, "read_pool": read_pool, "identical": same, **rep}
+            )
+        best = runs[0]
+        return {
+            "layers": layers,
+            "pods": pods,
+            "serial_wall_s": serial_report["wall_s"],
+            "concurrent_wall_s": best["wall_s"],
+            "speedup": round(serial_report["wall_s"] / max(1e-9, best["wall_s"]), 3),
+            "identical": identical,
+            "serial_ops": serial_report["ops"],
+            "concurrent_ops": best["ops"],
+            "ancestor_cache": best["ancestor_cache"],
+            "configs": runs,
+        }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--pods", type=int, default=8)
+    ap.add_argument("--mount-ms", type=float, default=3.0)
+    ap.add_argument("--ready-ms", type=float, default=15.0)
+    ap.add_argument("--min-speedup", type=float, default=2.0)
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args()
+
+    report = profile(
+        layers=args.layers, pods=args.pods,
+        mount_ms=args.mount_ms, ready_ms=args.ready_ms,
+    )
+    leaked = [
+        t.name for t in threading.enumerate() if t.name.startswith("ntpu-snap")
+    ]
+    report["leaked_threads"] = leaked
+
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(f"storm: {args.layers} layers x {args.pods} pods")
+        print(
+            f"serial {report['serial_wall_s']:.3f}s  concurrent "
+            f"{report['concurrent_wall_s']:.3f}s  speedup {report['speedup']}x"
+        )
+        for cfg in report["configs"]:
+            print(
+                f"  fanout={cfg['fanout']} read_pool={cfg['read_pool']} "
+                f"wall={cfg['wall_s']:.3f}s identical={cfg['identical']}"
+            )
+        print(f"ops (concurrent): {report['concurrent_ops']}")
+        print(f"ancestor cache: {report['ancestor_cache']}")
+        print(f"identical: {report['identical']}  leaked: {leaked}")
+
+    if not report["identical"]:
+        print("FAIL: concurrent metastore/mounts diverge from serial replay",
+              file=sys.stderr)
+        return 1
+    if report["speedup"] < args.min_speedup:
+        print(
+            f"FAIL: speedup {report['speedup']}x < {args.min_speedup}x",
+            file=sys.stderr,
+        )
+        return 1
+    if leaked:
+        print(f"FAIL: leaked control-plane threads {leaked}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
