@@ -802,6 +802,33 @@ def snapshot_ops_run(repo: str, timeout: float = 240.0) -> dict:
         return {"error": "snapshot profile produced no JSON"}
 
 
+_TRACE_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from tools.trace_profile import profile
+print(json.dumps(profile(layers=4, pods=4, reps=2)))
+"""
+
+
+def trace_run(repo: str, timeout: float = 240.0) -> dict:
+    """Trace overhead profile (tools/trace_profile.py) in a child under
+    the hard watchdog: enabled-vs-disabled storm overhead, spans/sec into
+    the ring, drops, and the end-to-end Prepare tree gate."""
+    res = _run_child_watchdog(
+        [sys.executable, "-c", _TRACE_CHILD.format(repo=repo)], timeout=timeout
+    )
+    if res is None:
+        return {"error": f"trace profile hung >{timeout:.0f}s (watchdog killed it)"}
+    rc, stdout, stderr = res
+    if rc != 0:
+        tail = stderr.strip().splitlines()[-1] if stderr.strip() else ""
+        return {"error": f"trace profile exited rc={rc}: {tail}"[:200]}
+    try:
+        return json.loads(stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": "trace profile produced no JSON"}
+
+
 def _device_available(repo: str, timeout: float = 120.0) -> tuple[bool, str]:
     """(ok, note) — probe jax.devices() in a subprocess under the hard
     watchdog (_run_child_watchdog): a wedged device tunnel must degrade
@@ -1040,6 +1067,7 @@ def main() -> None:
     real_image = real_image_run(opt)
     lazy_read = lazy_read_run(repo)
     snapshot_ops = snapshot_ops_run(repo)
+    trace_detail = trace_run(repo)
 
     print(
         json.dumps(
@@ -1071,6 +1099,7 @@ def main() -> None:
                     "pipeline": pipeline_info,
                     "lazy_read": lazy_read,
                     "snapshot_ops": snapshot_ops,
+                    "trace": trace_detail,
                     "accel_profile": accel_profile,
                     "zstd_profile": zstd_profile,
                     "reference_defaults_profile": reference_defaults_profile,

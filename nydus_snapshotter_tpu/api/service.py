@@ -17,6 +17,7 @@ from typing import Iterator, Optional
 import grpc
 from google.protobuf import empty_pb2
 
+from nydus_snapshotter_tpu import trace
 from nydus_snapshotter_tpu.api import snapshots_pb2 as pb
 from nydus_snapshotter_tpu.api.filters import compile_filters
 from nydus_snapshotter_tpu.snapshot import metastore as ms
@@ -76,40 +77,48 @@ class SnapshotsService:
         self.sn = sn
 
     # Each handler: (request) -> response, with errdefs mapped to gRPC codes.
+    # Every RPC opens a ROOT trace span — the tree a slow pod start hangs
+    # off: snapshotter op → metastore txns → daemon mount → blobcache
+    # fetches, including background work the prepare board finishes later.
 
     def Prepare(self, req: pb.PrepareSnapshotRequest, context) -> pb.PrepareSnapshotResponse:
-        try:
-            mounts = self.sn.prepare(req.key, req.parent, dict(req.labels))
-        except Exception as e:  # noqa: BLE001 - mapped to status codes
-            _abort_for(context, e)
+        with trace.span("grpc.Prepare", key=req.key, parent=req.parent):
+            try:
+                mounts = self.sn.prepare(req.key, req.parent, dict(req.labels))
+            except Exception as e:  # noqa: BLE001 - mapped to status codes
+                _abort_for(context, e)
         return pb.PrepareSnapshotResponse(mounts=_mounts_to_pb(mounts))
 
     def View(self, req: pb.ViewSnapshotRequest, context) -> pb.ViewSnapshotResponse:
-        try:
-            mounts = self.sn.view(req.key, req.parent, dict(req.labels))
-        except Exception as e:
-            _abort_for(context, e)
+        with trace.span("grpc.View", key=req.key, parent=req.parent):
+            try:
+                mounts = self.sn.view(req.key, req.parent, dict(req.labels))
+            except Exception as e:
+                _abort_for(context, e)
         return pb.ViewSnapshotResponse(mounts=_mounts_to_pb(mounts))
 
     def Mounts(self, req: pb.MountsRequest, context) -> pb.MountsResponse:
-        try:
-            mounts = self.sn.mounts(req.key)
-        except Exception as e:
-            _abort_for(context, e)
+        with trace.span("grpc.Mounts", key=req.key):
+            try:
+                mounts = self.sn.mounts(req.key)
+            except Exception as e:
+                _abort_for(context, e)
         return pb.MountsResponse(mounts=_mounts_to_pb(mounts))
 
     def Commit(self, req: pb.CommitSnapshotRequest, context) -> empty_pb2.Empty:
-        try:
-            self.sn.commit(req.name, req.key, dict(req.labels))
-        except Exception as e:
-            _abort_for(context, e)
+        with trace.span("grpc.Commit", key=req.key, name=req.name):
+            try:
+                self.sn.commit(req.name, req.key, dict(req.labels))
+            except Exception as e:
+                _abort_for(context, e)
         return empty_pb2.Empty()
 
     def Remove(self, req: pb.RemoveSnapshotRequest, context) -> empty_pb2.Empty:
-        try:
-            self.sn.remove(req.key)
-        except Exception as e:
-            _abort_for(context, e)
+        with trace.span("grpc.Remove", key=req.key):
+            try:
+                self.sn.remove(req.key)
+            except Exception as e:
+                _abort_for(context, e)
         return empty_pb2.Empty()
 
     def Stat(self, req: pb.StatSnapshotRequest, context) -> pb.StatSnapshotResponse:
@@ -153,17 +162,19 @@ class SnapshotsService:
             yield pb.ListSnapshotsResponse(info=infos)
 
     def Usage(self, req: pb.UsageRequest, context) -> pb.UsageResponse:
-        try:
-            usage: Usage = self.sn.usage(req.key)
-        except Exception as e:
-            _abort_for(context, e)
+        with trace.span("grpc.Usage", key=req.key):
+            try:
+                usage: Usage = self.sn.usage(req.key)
+            except Exception as e:
+                _abort_for(context, e)
         return pb.UsageResponse(size=usage.size, inodes=usage.inodes)
 
     def Cleanup(self, req: pb.CleanupRequest, context) -> empty_pb2.Empty:
-        try:
-            self.sn.cleanup()
-        except Exception as e:
-            _abort_for(context, e)
+        with trace.span("grpc.Cleanup"):
+            try:
+                self.sn.cleanup()
+            except Exception as e:
+                _abort_for(context, e)
         return empty_pb2.Empty()
 
 

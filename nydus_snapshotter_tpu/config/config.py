@@ -192,6 +192,29 @@ class SnapshotsConfig:
 
 
 @dataclass
+class TraceConfig:
+    """End-to-end request tracing knobs (trace/).
+
+    Spans propagate a trace id from the gRPC entry points through the
+    metastore, the prepare board, the daemon mount path and the lazy-read
+    fetch scheduler, land in a bounded ring of ``ring_capacity`` spans
+    (drop-oldest), and export as Chrome ``trace_event`` JSON on
+    ``/api/v1/traces``. Any root operation slower than
+    ``slow_op_threshold_ms`` gets its full span tree logged by the
+    slow-op flight recorder. ``sample_ratio`` < 1 traces that fraction of
+    roots (the decision is made once per trace). Environment variables
+    override per-process (``NTPU_TRACE``, ``NTPU_TRACE_RING_CAPACITY``,
+    ``NTPU_TRACE_SLOW_OP_MS``, ``NTPU_TRACE_SAMPLE_RATIO``) — that is
+    also how the section reaches spawned daemon processes.
+    """
+
+    enabled: bool = True
+    ring_capacity: int = 8192
+    slow_op_threshold_ms: float = 1000.0
+    sample_ratio: float = 1.0
+
+
+@dataclass
 class ExperimentalConfig:
     enable_stargz: bool = False
     enable_referrer_detect: bool = False
@@ -223,6 +246,7 @@ class SnapshotterConfig:
     convert: ConvertConfig = field(default_factory=ConvertConfig)
     blobcache: BlobcacheConfig = field(default_factory=BlobcacheConfig)
     snapshots: SnapshotsConfig = field(default_factory=SnapshotsConfig)
+    trace: TraceConfig = field(default_factory=TraceConfig)
     experimental: ExperimentalConfig = field(default_factory=ExperimentalConfig)
 
     # -- derived paths (reference config/global.go accessors) ---------------
@@ -313,6 +337,12 @@ class SnapshotterConfig:
             raise ConfigError("snapshots.cleanup_workers must be >= 1")
         if self.snapshots.ancestor_cache < 0:
             raise ConfigError("snapshots.ancestor_cache must be >= 0 (0 = disabled)")
+        if self.trace.ring_capacity < 1:
+            raise ConfigError("trace.ring_capacity must be >= 1")
+        if self.trace.slow_op_threshold_ms < 0:
+            raise ConfigError("trace.slow_op_threshold_ms must be >= 0 (0 = off)")
+        if not 0.0 <= self.trace.sample_ratio <= 1.0:
+            raise ConfigError("trace.sample_ratio must be within [0, 1]")
         if self.daemon.fs_driver in (constants.FS_DRIVER_BLOCKDEV, constants.FS_DRIVER_PROXY):
             # Proxy/blockdev modes run without nydusd daemons
             # (reference config.go:300-311 forces daemon_mode none).

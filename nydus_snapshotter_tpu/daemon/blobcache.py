@@ -28,8 +28,10 @@ import os
 import struct
 import threading
 import time
+from time import perf_counter
 from typing import Callable, Optional
 
+from nydus_snapshotter_tpu import trace
 from nydus_snapshotter_tpu.daemon import fetch_sched
 from nydus_snapshotter_tpu.daemon.fetch_sched import (
     BACKGROUND,
@@ -199,6 +201,7 @@ class CachedBlob:
         budget=None,
     ):
         os.makedirs(cache_dir, exist_ok=True)
+        self.blob_id = blob_id
         self.data_path = os.path.join(cache_dir, f"{blob_id}.blob.data")
         self.map_path = os.path.join(cache_dir, f"{blob_id}.chunk_map")
         self.fetch_range = fetch_range
@@ -301,13 +304,19 @@ class CachedBlob:
             return
         from nydus_snapshotter_tpu import failpoint
 
-        failpoint.hit("blobcache.readahead")
-        pre = {id(f) for f in self.sched.overlapping_flights(end, ra_end)}
-        for f in self.sched.plan_locked(end, ra_end, priority=BACKGROUND):
-            if id(f) not in pre and f.priority == BACKGROUND:
-                # New flights cover exactly uncovered, not-in-flight gaps.
-                fetch_sched.READAHEAD_BYTES.inc(f.end - f.start)
-                self._ra_spans.add(f.start, f.end)
+        with trace.span(
+            "blobcache.readahead", blob=self.blob_id[:8], window=(end, ra_end)
+        ) as sp:
+            failpoint.hit("blobcache.readahead")
+            planned = 0
+            pre = {id(f) for f in self.sched.overlapping_flights(end, ra_end)}
+            for f in self.sched.plan_locked(end, ra_end, priority=BACKGROUND):
+                if id(f) not in pre and f.priority == BACKGROUND:
+                    # New flights cover exactly uncovered, not-in-flight gaps.
+                    fetch_sched.READAHEAD_BYTES.inc(f.end - f.start)
+                    self._ra_spans.add(f.start, f.end)
+                    planned += f.end - f.start
+            sp.annotate(planned_bytes=planned)
 
     def _account_ra_hit_locked(self, start: int, end: int) -> None:
         hit = self._ra_spans.remove(start, end)
@@ -317,6 +326,22 @@ class CachedBlob:
     def read_at(self, offset: int, size: int) -> bytes:
         if size <= 0:
             return b""
+        # One span + one histogram sample per read, both metering the
+        # same window — the trace shows WHERE this read's time went (its
+        # fetch flights carry this context), the histogram shows the
+        # population.
+        t0 = perf_counter()
+        with trace.span(
+            "blobcache.read_at", blob=self.blob_id[:8], offset=offset, bytes=size
+        ):
+            try:
+                return self._read_at(offset, size)
+            finally:
+                fetch_sched.OP_HIST.labels("read_at").observe(
+                    (perf_counter() - t0) * 1000.0
+                )
+
+    def _read_at(self, offset: int, size: int) -> bytes:
         end = offset + size
         first_pass = True
         while True:

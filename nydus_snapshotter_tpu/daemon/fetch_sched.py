@@ -39,9 +39,11 @@ import threading
 from bisect import bisect_left, bisect_right
 from collections import deque
 from dataclasses import dataclass
+from time import perf_counter
 from typing import Callable, Optional
 
 from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu import trace
 from nydus_snapshotter_tpu.metrics import registry as _metrics
 from nydus_snapshotter_tpu.parallel.pipeline import MemoryBudget
 
@@ -120,6 +122,14 @@ EVICTED_ENTRIES = _reg.register(
     _metrics.Counter(
         "ntpu_blobcache_evicted_entries",
         "Whole blob cache entries removed by capacity-watermark eviction",
+    )
+)
+OP_HIST = _reg.register(
+    _metrics.Histogram(
+        "ntpu_blobcache_op_duration_milliseconds",
+        "Latency of lazy-read data-plane operations (read_at / fetch),"
+        " metered by the same window the trace spans record",
+        ("op",),
     )
 )
 
@@ -334,7 +344,7 @@ def shared_budget() -> MemoryBudget:
 class Flight:
     """One in-flight ranged fetch covering ``[start, end)``."""
 
-    __slots__ = ("start", "end", "priority", "coalesced", "done", "error")
+    __slots__ = ("start", "end", "priority", "coalesced", "done", "error", "ctx")
 
     def __init__(self, start: int, end: int, priority: int, coalesced: int = 1):
         self.start = start
@@ -343,6 +353,10 @@ class Flight:
         self.coalesced = coalesced  # miss gaps merged into this fetch
         self.done = threading.Event()
         self.error: Optional[BaseException] = None
+        # Trace context of the read that PLANNED this flight — a
+        # background readahead fetch thereby records which trace spawned
+        # it, even though it executes on a worker thread later.
+        self.ctx = None
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.done.wait(timeout)
@@ -412,7 +426,9 @@ class FetchScheduler:
             if pos < e:
                 gaps.append((pos, e))
         new = self._coalesce(gaps, priority)
+        ctx = trace.capture() if new else None
         for f in new:
+            f.ctx = ctx
             self._flights.append(f)
             (self._queue if priority == DEMAND else self._queue_bg).append(f)
         if new:
@@ -477,32 +493,43 @@ class FetchScheduler:
     def _run_flight(self, flight: Flight) -> None:
         n = flight.end - flight.start
         acquired = False
-        try:
-            self.budget.acquire(n, aborted=lambda: self._closed)
-            acquired = True
-            INFLIGHT_BYTES.set(self.budget.held)
-            failpoint.hit("blobcache.fetch")
-            data = self._fetch_range(flight.start, n)
-            FETCH_REQUESTS.inc()
-            if flight.coalesced > 1:
-                COALESCED_REQUESTS.inc()
-            MISS_BYTES.inc(n)
-            with self._lock:
-                if not self._closed:
-                    self._deliver(flight.start, data)
-        except BaseException as e:  # noqa: BLE001 — surfaced to waiters
-            flight.error = e if isinstance(e, Exception) else OSError(str(e))
-        finally:
-            if acquired:
-                self.budget.release(n)
+        t0 = perf_counter()
+        with trace.with_context(flight.ctx), trace.span(
+            "blobcache.fetch",
+            blob=self.name,
+            offset=flight.start,
+            bytes=n,
+            coalesced=flight.coalesced,
+            background=flight.priority == BACKGROUND,
+        ) as sp:
+            try:
+                self.budget.acquire(n, aborted=lambda: self._closed)
+                acquired = True
                 INFLIGHT_BYTES.set(self.budget.held)
-            with self._cv:
-                try:
-                    self._flights.remove(flight)
-                except ValueError:
-                    pass
-                self._cv.notify_all()
-            flight.done.set()
+                failpoint.hit("blobcache.fetch")
+                data = self._fetch_range(flight.start, n)
+                FETCH_REQUESTS.inc()
+                if flight.coalesced > 1:
+                    COALESCED_REQUESTS.inc()
+                MISS_BYTES.inc(n)
+                with self._lock:
+                    if not self._closed:
+                        self._deliver(flight.start, data)
+            except BaseException as e:  # noqa: BLE001 — surfaced to waiters
+                flight.error = e if isinstance(e, Exception) else OSError(str(e))
+                sp.annotate(error=repr(flight.error))
+            finally:
+                if acquired:
+                    self.budget.release(n)
+                    INFLIGHT_BYTES.set(self.budget.held)
+                with self._cv:
+                    try:
+                        self._flights.remove(flight)
+                    except ValueError:
+                        pass
+                    self._cv.notify_all()
+                flight.done.set()
+        OP_HIST.labels("fetch").observe((perf_counter() - t0) * 1000.0)
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -31,6 +31,7 @@ from http.server import BaseHTTPRequestHandler
 from typing import Any, Optional
 
 from nydus_snapshotter_tpu import constants
+from nydus_snapshotter_tpu import trace
 from nydus_snapshotter_tpu.converter.convert import BlobReader
 from nydus_snapshotter_tpu.daemon.types import DaemonState, FsMetrics
 from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
@@ -274,7 +275,11 @@ class _Instance:
                 "timestamp_secs": time_mod.time(),
             }
         try:
-            return self._read_locked_out(path, offset, size, blob_dir)
+            # Root span in the daemon process: FUSE and API reads funnel
+            # through here, and any blobcache fetch/readahead this read
+            # triggers lands in its trace (exported on /api/v1/traces).
+            with trace.span("nydusd.read", path=path, offset=offset, size=size):
+                return self._read_locked_out(path, offset, size, blob_dir)
         finally:
             with self._inflight_lock:
                 self._inflight.pop(token, None)
@@ -500,7 +505,13 @@ class DaemonServer:
                         )
                     body = {"prefetch_data_amount": amount}
                     body.update(fetch_sched.snapshot_counters())
+                    # Metrics → traces link: the last root trace ids whose
+                    # duration exceeded the rolling p95 (fetch them from
+                    # /api/v1/traces or /debug/pprof/trace).
+                    body["trace_exemplars"] = trace.exemplars()
                     self._reply(200, body)
+                elif u.path == "/api/v1/traces":
+                    self._reply(200, trace.chrome_trace())
                 elif u.path == "/api/v1/metrics/inflight":
                     with daemon._lock:
                         instances = list(daemon.instances.values())

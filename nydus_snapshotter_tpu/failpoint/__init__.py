@@ -115,6 +115,16 @@ def _fire(site: str, act: Action) -> None:
                 _active.pop(site, None)
         _fired[site] = _fired.get(site, 0) + 1
         kind, arg = act.kind, act.arg
+    # Tag the active trace span (if any) so chaos runs are attributable:
+    # a span whose site fired carries `failpoints=[...]` in its attrs.
+    # Lazy import: failpoint must stay import-light, and this only runs
+    # when a site actually fires.
+    try:
+        from nydus_snapshotter_tpu import trace as _trace
+
+        _trace.annotate_failpoint(site)
+    except Exception:
+        pass
     if kind == "error":
         raise build_error(arg, site)
     if kind == "delay":

@@ -29,6 +29,7 @@ from typing import Optional
 
 from nydus_snapshotter_tpu import constants as C
 from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu import trace
 from nydus_snapshotter_tpu.cache.manager import CacheManager
 from nydus_snapshotter_tpu.config.daemonconfig import DaemonRuntimeConfig
 from nydus_snapshotter_tpu.daemon.daemon import SHARED_DAEMON_ID, Daemon
@@ -240,15 +241,16 @@ class Filesystem:
         # The pending-mount count keeps try_stop_shared_daemon from tearing
         # the shared daemon down between get_shared_daemon and the refcount
         # attach inside shared_mount.
-        failpoint.hit("fs.mount")
-        with self._lock:
-            self._pending_mounts += 1
-        try:
-            with self._snapshot_lock(snapshot_id):
-                self._mount_locked(snapshot_id, snap_labels, snapshot)
-        finally:
+        with trace.span("daemon.mount", sid=snapshot_id):
+            failpoint.hit("fs.mount")
             with self._lock:
-                self._pending_mounts -= 1
+                self._pending_mounts += 1
+            try:
+                with self._snapshot_lock(snapshot_id):
+                    self._mount_locked(snapshot_id, snap_labels, snapshot)
+            finally:
+                with self._lock:
+                    self._pending_mounts -= 1
 
     def _mount_locked(self, snapshot_id: str, snap_labels: dict, snapshot=None) -> None:
         if self.instances.get(snapshot_id) is not None:
@@ -415,20 +417,21 @@ class Filesystem:
         self.instances.remove(snapshot_id)
 
     def wait_until_ready(self, snapshot_id: str) -> None:
-        rafs = self.instances.get(snapshot_id)
-        if rafs is None:
-            if self.daemon_mode == C.DAEMON_MODE_NONE:
-                return
-            raise errdefs.NotFound(f"no instance {snapshot_id}")
-        if rafs.fs_driver in (C.FS_DRIVER_FSCACHE, C.FS_DRIVER_FUSEDEV):
-            # A daemon whose restart budget is exhausted never comes back:
-            # serve the snapshot dirs as-is (nodev-style passthrough)
-            # instead of blocking the mount path on a dead socket.
-            mgr = self.managers.get(rafs.fs_driver)
-            if mgr is not None and mgr.is_degraded(rafs.daemon_id):
-                return
-            d = self.get_daemon_by_rafs(rafs)
-            d.wait_until_state(DaemonState.RUNNING)
+        with trace.span("daemon.wait_ready", sid=snapshot_id):
+            rafs = self.instances.get(snapshot_id)
+            if rafs is None:
+                if self.daemon_mode == C.DAEMON_MODE_NONE:
+                    return
+                raise errdefs.NotFound(f"no instance {snapshot_id}")
+            if rafs.fs_driver in (C.FS_DRIVER_FSCACHE, C.FS_DRIVER_FUSEDEV):
+                # A daemon whose restart budget is exhausted never comes back:
+                # serve the snapshot dirs as-is (nodev-style passthrough)
+                # instead of blocking the mount path on a dead socket.
+                mgr = self.managers.get(rafs.fs_driver)
+                if mgr is not None and mgr.is_degraded(rafs.daemon_id):
+                    return
+                d = self.get_daemon_by_rafs(rafs)
+                d.wait_until_state(DaemonState.RUNNING)
 
     def mount_point(self, snapshot_id: str) -> str:
         rafs = self.instances.get(snapshot_id)

@@ -101,6 +101,19 @@ SnapshotPendingUsageScans = reg.register(Gauge(
     "ntpu_snapshot_pending_usage_scans",
     "Disk-usage scans queued or running in the async accountant."))
 
+# -- collection plane health --------------------------------------------------
+
+MetricsCollectionErrors = reg.register(Counter(
+    "ntpu_metrics_collection_errors_total",
+    "Collector rounds that raised (per collector); a broken collector is "
+    "visible here instead of only in the log.",
+    ("collector",),
+))
+
+# -- request tracing ----------------------------------------------------------
+# (ntpu_trace_* counters are registered by trace/ and trace/ring.py; listed
+# in docs/observability.md.)
+
 # -- inflight / hung IO (collector wiring serve.go:26, :160-189) --------------
 
 HungIOCount = reg.register(Gauge(

@@ -12,6 +12,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterable, Optional
 
+from nydus_snapshotter_tpu.metrics import data
 from nydus_snapshotter_tpu.metrics.collector import (
     DaemonResourceCollector,
     FsMetricsCollector,
@@ -48,11 +49,19 @@ class MetricsServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     def collect_once(self) -> None:
-        for c in (self.sn_collector, self.fs_collector, self.daemon_collector):
+        # Per-collector isolation: one failing collector must not skip the
+        # remaining ones, and each failure is counted per collector so a
+        # broken collector is visible on the exposition, not just the log.
+        for name, c in (
+            ("snapshotter", self.sn_collector),
+            ("fs", self.fs_collector),
+            ("daemon", self.daemon_collector),
+        ):
             try:
                 c.collect()
             except Exception:
-                logger.exception("metrics collection failed")
+                data.MetricsCollectionErrors.labels(name).inc()
+                logger.exception("metrics collection failed (collector=%s)", name)
 
     def _collect_loop(self) -> None:
         while not self._stop.wait(self._collect_interval):
@@ -63,6 +72,7 @@ class MetricsServer:
             try:
                 self.inflight_collector.collect()
             except Exception:
+                data.MetricsCollectionErrors.labels("inflight").inc()
                 logger.exception("inflight metrics collection failed")
 
     def start_collecting(self) -> None:

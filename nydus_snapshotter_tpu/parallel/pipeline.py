@@ -56,6 +56,7 @@ from time import perf_counter
 from typing import Callable, Optional
 
 from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu import trace
 from nydus_snapshotter_tpu.metrics import registry as _metrics
 
 DEFAULT_QUEUE_BYTES = 32 << 20
@@ -516,11 +517,16 @@ class ConvertPipeline:
         self._assemble_wait_s = 0.0
         self._started = False
         self._wall_start = 0.0
+        self._trace_ctx = None
 
     # -- lifecycle ----------------------------------------------------------
 
     def __enter__(self) -> "ConvertPipeline":
         self._wall_start = perf_counter()
+        # Trace context of the converting caller: stage workers adopt it
+        # so their lifetime spans land in the conversion's trace (one span
+        # per WORKER, never per chunk — tracing must not tax the hot loop).
+        self._trace_ctx = trace.capture()
         n_chunk = min(self.cfg.chunk_workers, max(1, len(self.items)))
         for w in range(n_chunk):
             t = threading.Thread(
@@ -576,6 +582,12 @@ class ConvertPipeline:
         return self.items[idx]
 
     def _chunk_worker(self) -> None:
+        with trace.with_context(self._trace_ctx), trace.span(
+            "convert.chunk.worker"
+        ):
+            self._chunk_worker_loop()
+
+    def _chunk_worker_loop(self) -> None:
         st = self._stage["chunk"]
         try:
             while True:
@@ -620,6 +632,12 @@ class ConvertPipeline:
         return n + n // 255 + 64
 
     def _compress_worker(self) -> None:
+        with trace.with_context(self._trace_ctx), trace.span(
+            "convert.compress.worker"
+        ):
+            self._compress_worker_loop()
+
+    def _compress_worker_loop(self) -> None:
         st = self._stage["compress"]
         try:
             while True:

@@ -5,6 +5,13 @@ Python-runtime equivalents of the Go pprof handlers:
     /debug/pprof/threads   — all thread stacks (goroutine-profile analogue)
     /debug/pprof/profile   — cProfile sample for ?seconds=N, pstats text
     /debug/pprof/heap      — per-type object counts + gc stats
+    /debug/pprof/trace     — the request-trace ring buffer as text
+                             (span trees per trace; see docs/observability.md)
+
+Concurrent /debug/pprof/profile requests are serialized behind one lock:
+two overlapping cProfile sessions race the interpreter's global profiler
+hook, and the second would silently corrupt (or steal) the first's
+sample. Serialized, each requester gets a full, clean window.
 
 Gated by the system-controller config exactly like the reference
 (snapshot.go:254-261).
@@ -43,12 +50,16 @@ def _heap_dump(limit: int = 50) -> str:
     return "\n".join(lines)
 
 
+_profile_lock = threading.Lock()
+
+
 def _cpu_profile(seconds: float) -> str:
-    prof = cProfile.Profile()
-    done = threading.Event()
-    prof.enable()
-    done.wait(seconds)
-    prof.disable()
+    with _profile_lock:
+        prof = cProfile.Profile()
+        done = threading.Event()
+        prof.enable()
+        done.wait(seconds)
+        prof.disable()
     buf = io.StringIO()
     pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(60)
     return buf.getvalue()
@@ -74,6 +85,10 @@ def new_pprof_http_listener(addr: str) -> ThreadingHTTPServer:
             elif parsed.path == "/debug/pprof/profile":
                 secs = float(parse_qs(parsed.query).get("seconds", ["1"])[0])
                 body = _cpu_profile(min(secs, 60.0))
+            elif parsed.path == "/debug/pprof/trace":
+                from nydus_snapshotter_tpu import trace
+
+                body = trace.dump_text()
             else:
                 self.send_response(404)
                 self.end_headers()

@@ -34,6 +34,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu import trace
 from nydus_snapshotter_tpu.metrics import data as metrics_data
 
 DEFAULT_READ_POOL = 8
@@ -140,19 +141,27 @@ class PrepareBoard:
 
     def submit(self, sid: str, fn: Callable[[], None]) -> None:
         if not self.enabled or self._closed:
-            failpoint.hit("snapshot.prepare")
-            fn()
+            with trace.span("snapshot.prepare.bg", sid=sid, inline=True):
+                failpoint.hit("snapshot.prepare")
+                fn()
             return
         with self._lock:
             prev = self._pending.pop(sid, None)
+        # Executor threads have no contextvars: carry the submitting
+        # Prepare's trace context so the deferred slow tail (daemon
+        # readiness, stargz bootstrap build) lands in its span tree.
+        ctx = trace.capture()
 
         def run() -> None:
             if prev is not None:
                 # Per-sid ordering: chained work waits for (and propagates
                 # the failure of) whatever was already in flight.
                 prev.result()
-            failpoint.hit("snapshot.prepare")
-            fn()
+            with trace.with_context(ctx), trace.span(
+                "snapshot.prepare.bg", sid=sid
+            ):
+                failpoint.hit("snapshot.prepare")
+                fn()
 
         fut = self._executor().submit(run)
         with self._lock:
@@ -211,7 +220,7 @@ class PrepareBoard:
 
 
 class _Scan:
-    __slots__ = ("key", "path", "sid", "done", "exc")
+    __slots__ = ("key", "path", "sid", "done", "exc", "ctx")
 
     def __init__(self, key: str, path: str, sid: Optional[str]):
         self.key = key
@@ -219,6 +228,9 @@ class _Scan:
         self.sid = sid
         self.done = threading.Event()
         self.exc: Optional[BaseException] = None
+        # Trace context of the submitting commit, so the async usage scan
+        # is attributed to the Commit that queued it.
+        self.ctx = trace.capture()
 
 
 class UsageAccountant:
@@ -253,10 +265,11 @@ class UsageAccountant:
         metrics_data.SnapshotPendingUsageScans.set(len(self._pending))
 
     def _run_inline(self, entry: _Scan) -> None:
-        if self._pre_wait is not None:
-            self._pre_wait(entry.sid)
-        failpoint.hit("snapshot.usage")
-        self._write({entry.key: self._scan(entry.path)})
+        with trace.span("snapshot.usage.scan", key=entry.key, inline=True):
+            if self._pre_wait is not None:
+                self._pre_wait(entry.sid)
+            failpoint.hit("snapshot.usage")
+            self._write({entry.key: self._scan(entry.path)})
 
     def submit(self, key: str, path: str, sid: Optional[str] = None) -> None:
         """Queue a scan of ``path`` whose result backfills snapshot ``key``.
@@ -295,10 +308,13 @@ class UsageAccountant:
             scanned: list[_Scan] = []
             for e in batch:
                 try:
-                    if self._pre_wait is not None:
-                        self._pre_wait(e.sid)
-                    failpoint.hit("snapshot.usage")
-                    results[e.key] = self._scan(e.path)
+                    with trace.with_context(e.ctx), trace.span(
+                        "snapshot.usage.scan", key=e.key
+                    ):
+                        if self._pre_wait is not None:
+                            self._pre_wait(e.sid)
+                        failpoint.hit("snapshot.usage")
+                        results[e.key] = self._scan(e.path)
                     scanned.append(e)
                 except BaseException as exc:  # noqa: BLE001 - stored, surfaced at join
                     e.exc = exc

@@ -48,6 +48,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterator, Optional
 
 from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu import trace
 from nydus_snapshotter_tpu.metrics import data as metrics_data
 from nydus_snapshotter_tpu.snapshot.async_work import resolve_snapshots_config
 from nydus_snapshotter_tpu.utils import errdefs
@@ -333,6 +334,7 @@ class MetaStore:
 
     # -- storage API (containerd storage package parity) ---------------------
 
+    @trace.traced("metastore.create_snapshot")
     def create_snapshot(
         self, kind: str, key: str, parent: str = "", labels: Optional[dict[str, str]] = None
     ) -> Snapshot:
@@ -361,6 +363,7 @@ class MetaStore:
                 parent_ids=self._parent_ids(conn, parent) if parent else [],
             )
 
+    @trace.traced("metastore.get_snapshot")
     def get_snapshot(self, key: str) -> Snapshot:
         with self._read() as conn:
             row = self._row(conn, key)
@@ -370,11 +373,13 @@ class MetaStore:
                 parent_ids=self._parent_ids(conn, row["parent"]) if row["parent"] else [],
             )
 
+    @trace.traced("metastore.get_info")
     def get_info(self, key: str) -> tuple[str, Info, Usage]:
         with self._read() as conn:
             row = self._row(conn, key)
             return str(row["id"]), self._info(row), Usage(row["size"], row["inodes"])
 
+    @trace.traced("metastore.update_info")
     def update_info(self, info: Info, *fieldpaths: str) -> Info:
         """Update mutable snapshot fields; with fieldpaths only the named
         `labels.*` / `labels` paths change (containerd Update contract)."""
@@ -403,6 +408,7 @@ class MetaStore:
             row = self._row(conn, info.name)
             return self._info(row)
 
+    @trace.traced("metastore.commit_active")
     def commit_active(
         self,
         key: str,
@@ -439,6 +445,7 @@ class MetaStore:
         self._chain_cache.invalidate(name)
         return CommitResult(str(row["id"]), ts)
 
+    @trace.traced("metastore.remove")
     def remove(self, key: str, now: Optional[float] = None) -> RemoveResult:
         """Remove snapshot `key`; returns (id, kind) with the operation
         timestamp attached. Fails while children reference it (containerd
@@ -459,6 +466,7 @@ class MetaStore:
         self._chain_cache.invalidate(key)
         return RemoveResult(str(row["id"]), row["kind"], ts)
 
+    @trace.traced("metastore.set_usages")
     def set_usages(self, usages: dict[str, Usage], now: Optional[float] = None) -> float:
         """Backfill usage for committed snapshots — one batched write
         transaction for the whole dict (the async accountant's drain).

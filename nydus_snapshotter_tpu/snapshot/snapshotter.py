@@ -26,6 +26,7 @@ from typing import Callable, Optional, Protocol
 
 from nydus_snapshotter_tpu import constants as C
 from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu import trace
 from nydus_snapshotter_tpu.snapshot import labels as label
 from nydus_snapshotter_tpu.snapshot import metastore as ms
 from nydus_snapshotter_tpu.snapshot.async_work import (
@@ -55,13 +56,18 @@ def upper_path(root: str, sid: str) -> str:
 
 def _timed(operation: str):
     """Method-latency histogram wrapper (reference snapshot.go:303-592
-    collector.NewSnapshotMetricsTimer around Mounts/Prepare/Remove/Cleanup)."""
+    collector.NewSnapshotMetricsTimer around Mounts/Prepare/Remove/Cleanup)
+    + the op's trace span, so the histograms and the span tree meter one
+    and the same window."""
 
     def deco(fn):
         @functools.wraps(fn)
-        def wrapper(*args, **kwargs):
-            with snapshot_timer(operation):
-                return fn(*args, **kwargs)
+        def wrapper(self, *args, **kwargs):
+            attrs = {"key": args[0]} if args and isinstance(args[0], str) else {}
+            with trace.span(f"snapshot.{operation}", **attrs), snapshot_timer(
+                operation
+            ):
+                return fn(self, *args, **kwargs)
 
         return wrapper
 
@@ -342,11 +348,19 @@ class Snapshotter:
         if not dirs:
             return
         if self.cleanup_workers > 1 and len(dirs) > 1:
+            # Pool workers have no contextvars: carry the cleanup span's
+            # context so per-dir spans hang off the Cleanup root.
+            ctx = trace.capture()
+
+            def one(d: str) -> None:
+                with trace.with_context(ctx):
+                    self._cleanup_snapshot_directory(d)
+
             with ThreadPoolExecutor(
                 max_workers=min(self.cleanup_workers, len(dirs)),
                 thread_name_prefix="ntpu-snap-clean",
             ) as ex:
-                for fut in [ex.submit(self._cleanup_snapshot_directory, d) for d in dirs]:
+                for fut in [ex.submit(one, d) for d in dirs]:
                     fut.result()
         else:
             for d in dirs:
@@ -772,6 +786,7 @@ class Snapshotter:
             and not d.endswith(("-wal", "-shm"))
         ]
 
+    @trace.traced("snapshot.cleanup.dir")
     def _cleanup_snapshot_directory(self, d: str) -> None:
         failpoint.hit("snapshot.cleanup")
         sid = os.path.basename(d)

@@ -155,6 +155,13 @@ class SystemController:
                     if self.path == "/api/v1/daemons/records":
                         self._json(controller.daemon_records())
                         return
+                    if self.path == "/api/v1/traces":
+                        # The snapshotter process's span ring as a Chrome
+                        # trace_event document (open in Perfetto).
+                        from nydus_snapshotter_tpu import trace
+
+                        self._json(trace.chrome_trace())
+                        return
                     m = _BACKEND_RE.match(self.path)
                     if m:
                         backend = controller.get_backend(m.group(1))

@@ -1,0 +1,492 @@
+"""Trace core tests: ring-buffer concurrency, context propagation across
+the thread-pool boundaries (PrepareBoard / pipeline workers / fetch
+flights), the slow-op flight recorder, sampling, failpoint annotation,
+Chrome export, the /api/v1/traces + /debug/pprof/trace endpoints, and the
+metrics-collection error counter satellite.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import socket
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from nydus_snapshotter_tpu import failpoint, trace
+from nydus_snapshotter_tpu.metrics import data as metrics_data
+from nydus_snapshotter_tpu.trace.export import ExemplarStore, to_chrome_trace
+from nydus_snapshotter_tpu.trace.ring import SpanRing
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    trace.configure(enabled=True, ring_capacity=4096, slow_op_threshold_ms=0)
+    yield
+    trace.reset()
+
+
+# ------------------------------------------------------------------ ring buffer
+
+
+def _fake_span(i: int):
+    return SimpleNamespace(start=float(i))
+
+
+def test_ring_concurrent_writers_no_lost_update():
+    ring = SpanRing(1024)
+    threads_n, per = 8, 5000
+
+    def writer(base):
+        for i in range(per):
+            ring.push(_fake_span(base * per + i))
+
+    ts = [threading.Thread(target=writer, args=(i,)) for i in range(threads_n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    total = threads_n * per
+    # Drop-oldest accounting is exact under contention: nothing vanishes
+    # without being counted, nothing is double-counted.
+    assert len(ring) + ring.dropped() == total
+    assert len(ring) <= ring.capacity
+    assert ring.dropped() == total - len(ring)
+
+
+def test_ring_capacity_one_and_snapshot_order():
+    ring = SpanRing(4, stripes=1)
+    for i in range(10):
+        ring.push(_fake_span(i))
+    assert len(ring) == 4
+    assert ring.dropped() == 6
+    assert [s.start for s in ring.snapshot()] == [6.0, 7.0, 8.0, 9.0]
+    ring.clear()
+    assert len(ring) == 0
+
+
+# ------------------------------------------------------- spans + context basics
+
+
+def test_span_tree_parent_links():
+    with trace.span("root") as root:
+        with trace.span("child") as child:
+            with trace.span("grandchild"):
+                pass
+    spans = {s.name: s for s in trace.snapshot_spans()}
+    assert spans["root"].parent_id == 0
+    assert spans["child"].parent_id == spans["root"].span_id
+    assert spans["grandchild"].parent_id == spans["child"].span_id
+    assert (
+        spans["root"].trace_id
+        == spans["child"].trace_id
+        == spans["grandchild"].trace_id
+    )
+    assert root.span.trace_id == child.span.trace_id
+
+
+def test_span_records_error_attr():
+    with pytest.raises(ValueError):
+        with trace.span("boom"):
+            raise ValueError("nope")
+    (sp,) = trace.snapshot_spans()
+    assert "ValueError" in sp.attrs["error"]
+
+
+def test_start_span_end():
+    sp = trace.start_span("manual", k=1)
+    sp.end()
+    (rec,) = trace.snapshot_spans()
+    assert rec.name == "manual" and rec.attrs["k"] == 1
+
+
+def test_sample_ratio_zero_produces_zero_spans():
+    trace.configure(enabled=True, sample_ratio=0.0)
+    for _ in range(20):
+        with trace.span("root"):
+            with trace.span("child"):
+                pass
+    assert trace.snapshot_spans() == []
+
+
+def test_disabled_is_noop_and_capture_none():
+    trace.configure(enabled=False)
+    assert not trace.enabled()
+    with trace.span("x") as sp:
+        sp.annotate(a=1)
+        assert trace.capture() is None
+    assert trace.snapshot_spans() == []
+    with trace.with_context(None):
+        pass
+
+
+def test_env_resolution(monkeypatch):
+    trace.reset()
+    monkeypatch.setenv("NTPU_TRACE", "0")
+    assert not trace.enabled()
+    trace.reset()
+    monkeypatch.setenv("NTPU_TRACE", "1")
+    monkeypatch.setenv("NTPU_TRACE_RING_CAPACITY", "77")
+    monkeypatch.setenv("NTPU_TRACE_SLOW_OP_MS", "123")
+    monkeypatch.setenv("NTPU_TRACE_SAMPLE_RATIO", "0.5")
+    cfg = trace.resolve_trace_config()
+    assert cfg.enabled and cfg.ring_capacity == 77
+    assert cfg.slow_op_threshold_ms == 123.0 and cfg.sample_ratio == 0.5
+
+
+# ------------------------------------------------- propagation across the pools
+
+
+def test_propagation_across_prepare_board():
+    from nydus_snapshotter_tpu.snapshot.async_work import PrepareBoard
+
+    board = PrepareBoard(2)
+    seen = {}
+
+    def work():
+        ctx = trace.capture()
+        seen["trace_id"] = ctx.trace_id if ctx else None
+
+    try:
+        with trace.span("grpc.Prepare") as root:
+            board.submit("sid1", work)
+            board.join("sid1")
+    finally:
+        board.close()
+    assert seen["trace_id"] == root.span.trace_id
+    bg = [s for s in trace.snapshot_spans() if s.name == "snapshot.prepare.bg"]
+    assert bg and bg[0].trace_id == root.span.trace_id
+
+
+def test_propagation_across_usage_accountant():
+    from nydus_snapshotter_tpu.snapshot.async_work import UsageAccountant
+
+    scans = []
+
+    def scan(path):
+        ctx = trace.capture()
+        scans.append(ctx.trace_id if ctx else None)
+        return SimpleNamespace(size=1, inodes=1)
+
+    acct = UsageAccountant(scan=scan, write=lambda d: None, workers=1)
+    try:
+        with trace.span("grpc.Commit") as root:
+            acct.submit("k1", "/nowhere")
+        acct.join("k1")
+    finally:
+        acct.close()
+    assert scans == [root.span.trace_id]
+    spans = [s for s in trace.snapshot_spans() if s.name == "snapshot.usage.scan"]
+    assert spans and spans[0].trace_id == root.span.trace_id
+
+
+def test_propagation_across_pipeline_workers():
+    from nydus_snapshotter_tpu.parallel.pipeline import (
+        ConvertPipeline,
+        PipelineConfig,
+    )
+
+    pipe = ConvertPipeline(
+        items=[(0, 4), (1, 4)],
+        chunk_fn=lambda k: [(b"data", None)],
+        config=PipelineConfig(enabled=True, chunk_workers=2, compress_workers=1),
+    )
+    with trace.span("convert.pack") as root:
+        with pipe:
+            pipe.chunks_for(0)
+            pipe.chunks_for(1)
+    workers = [
+        s for s in trace.snapshot_spans() if s.name == "convert.chunk.worker"
+    ]
+    assert workers
+    assert all(s.trace_id == root.span.trace_id for s in workers)
+
+
+def test_propagation_across_fetch_flights(tmp_path):
+    from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+    from nydus_snapshotter_tpu.daemon.fetch_sched import FetchConfig
+    from nydus_snapshotter_tpu.parallel.pipeline import MemoryBudget
+
+    blob = bytes(range(256)) * 512  # 128 KiB
+    cb = CachedBlob(
+        str(tmp_path),
+        "traceblob",
+        lambda off, size: blob[off : off + size],
+        blob_size=len(blob),
+        config=FetchConfig(
+            fetch_workers=2, merge_gap=4096, readahead=16384, budget_bytes=1 << 20
+        ),
+        budget=MemoryBudget(1 << 20),
+    )
+    try:
+        with trace.span("nydusd.read") as root:
+            assert cb.read_at(0, 4096) == blob[:4096]
+            assert cb.read_at(4096, 4096) == blob[4096:8192]  # sequential → readahead
+    finally:
+        cb.close()
+    spans = trace.snapshot_spans()
+    fetches = [s for s in spans if s.name == "blobcache.fetch"]
+    assert fetches and all(s.trace_id == root.span.trace_id for s in fetches)
+    # The background readahead flight is attributed to the trace that
+    # spawned it, and marked as background.
+    assert any(s.attrs.get("background") for s in fetches)
+    reads = [s for s in spans if s.name == "blobcache.read_at"]
+    assert reads and all(s.trace_id == root.span.trace_id for s in reads)
+
+
+# ----------------------------------------------------------- slow-op recorder
+
+
+def test_slow_op_recorder_fires_exactly_once_per_slow_root():
+    trace.configure(enabled=True, slow_op_threshold_ms=5.0)
+    before = trace.SLOW_OPS.value()
+    with trace.span("slow.root"):
+        with trace.span("slow.child"):
+            time.sleep(0.012)
+    assert len(trace.slow_ops()) == 1
+    assert trace.SLOW_OPS.value() == before + 1
+    rec = trace.slow_ops()[0]
+    assert rec["op"] == "slow.root" and "slow.child" in rec["tree"]
+    # A fast root does not fire; a second slow root fires once more.
+    with trace.span("fast.root"):
+        pass
+    with trace.span("slow.root"):
+        time.sleep(0.012)
+    assert len(trace.slow_ops()) == 2
+    assert trace.SLOW_OPS.value() == before + 2
+
+
+def test_slow_op_recorder_logs_tree(caplog):
+    trace.configure(enabled=True, slow_op_threshold_ms=1.0)
+    with caplog.at_level("WARNING", logger="nydus_snapshotter_tpu.trace.export"):
+        with trace.span("slow.logged"):
+            time.sleep(0.005)
+    assert any("slow op slow.logged" in r.message for r in caplog.records)
+
+
+# ------------------------------------------------------- failpoint annotation
+
+
+def test_failpoint_fire_annotates_current_span():
+    with failpoint.injected("snapshot.commit", "delay(0)"):
+        with trace.span("chaos.op"):
+            failpoint.hit("snapshot.commit")
+    (sp,) = [s for s in trace.snapshot_spans() if s.name == "chaos.op"]
+    assert sp.attrs["failpoints"] == ["snapshot.commit"]
+
+
+def test_failpoint_error_annotates_before_raise():
+    with failpoint.injected("snapshot.commit", "error(OSError)"):
+        with pytest.raises(OSError):
+            with trace.span("chaos.err"):
+                failpoint.hit("snapshot.commit")
+    (sp,) = [s for s in trace.snapshot_spans() if s.name == "chaos.err"]
+    assert sp.attrs["failpoints"] == ["snapshot.commit"]
+    assert "error" in sp.attrs
+
+
+# ------------------------------------------------------------- chrome export
+
+
+def test_chrome_trace_export_roundtrip():
+    with trace.span("grpc.Prepare", key="k"):
+        with trace.span("snapshot.prepare"):
+            pass
+    doc = json.loads(json.dumps(trace.chrome_trace()))
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(events) == 2
+    for e in events:
+        assert {"name", "cat", "ph", "ts", "dur", "pid", "tid", "args"} <= set(e)
+        assert e["args"]["trace_id"]
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "M"}
+    assert "thread_name" in names
+    # Durations are microseconds and children nest inside the root window.
+    root = next(e for e in events if e["name"] == "grpc.Prepare")
+    child = next(e for e in events if e["name"] == "snapshot.prepare")
+    assert root["ts"] <= child["ts"]
+    assert child["ts"] + child["dur"] <= root["ts"] + root["dur"] + 1.0
+
+
+def test_dump_text_contains_tree():
+    with trace.span("root.op"):
+        with trace.span("child.op"):
+            pass
+    text = trace.dump_text()
+    assert "root.op" in text and "  child.op" in text
+
+
+# ----------------------------------------------------------------- exemplars
+
+
+def test_exemplar_store_records_over_p95():
+    store = ExemplarStore(window=64, keep=4, min_window=20)
+    for i in range(40):
+        store.record(SimpleNamespace(trace_id=f"t{i}", name="op", duration_ms=10.0))
+    assert store.exemplars() == []  # uniform: nothing exceeds p95
+    store.record(SimpleNamespace(trace_id="slow", name="op", duration_ms=100.0))
+    ex = store.exemplars()
+    assert ex and ex[0]["trace_id"] == "slow" and ex[0]["duration_ms"] == 100.0
+
+
+def test_trace_exemplars_surface():
+    trace.configure(enabled=True, slow_op_threshold_ms=0)
+    for _ in range(30):
+        with trace.span("fast"):
+            pass
+    with trace.span("slow"):
+        time.sleep(0.01)
+    ex = trace.exemplars()
+    assert ex and ex[0]["op"] == "slow"
+
+
+# ------------------------------------------------------------------ endpoints
+
+
+def _uds_get(sock_path: str, path: str) -> tuple[int, bytes]:
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        s.settimeout(5)
+        s.connect(sock_path)
+        s.sendall(f"GET {path} HTTP/1.1\r\nHost: uds\r\n\r\n".encode())
+        resp = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            resp += chunk
+            if b"\r\n\r\n" in resp:
+                head, _, rest = resp.partition(b"\r\n\r\n")
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        want = int(line.split(b":")[1])
+                        if len(rest) >= want:
+                            return int(head.split()[1]), rest[:want]
+        return (int(resp.split()[1]) if resp else 0), b""
+    finally:
+        s.close()
+
+
+def test_system_controller_traces_endpoint(tmp_path):
+    from nydus_snapshotter_tpu.system.system import SystemController
+
+    with trace.span("grpc.Mounts", key="k"):
+        pass
+    sock = str(tmp_path / "system.sock")
+    sc = SystemController(managers=[], sock_path=sock)
+    sc.run()
+    try:
+        status, body = _uds_get(sock, "/api/v1/traces")
+        assert status == 200
+        doc = json.loads(body)
+        assert any(
+            e.get("name") == "grpc.Mounts"
+            for e in doc["traceEvents"]
+            if e.get("ph") == "X"
+        )
+    finally:
+        sc.stop()
+
+
+def test_daemon_traces_and_exemplars_endpoint(tmp_path):
+    from nydus_snapshotter_tpu.daemon.server import DaemonServer
+
+    with trace.span("nydusd.read", path="/x"):
+        pass
+    sock = str(tmp_path / "api.sock")
+    server = DaemonServer("d-trace", sock, workdir=str(tmp_path))
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while not os.path.exists(sock) and time.time() < deadline:
+        time.sleep(0.01)
+    try:
+        status, body = _uds_get(sock, "/api/v1/traces")
+        assert status == 200
+        doc = json.loads(body)
+        assert any(
+            e.get("name") == "nydusd.read"
+            for e in doc["traceEvents"]
+            if e.get("ph") == "X"
+        )
+        status, body = _uds_get(sock, "/api/v1/metrics/blobcache")
+        assert status == 200
+        assert "trace_exemplars" in json.loads(body)
+    finally:
+        server.shutdown()
+        t.join(timeout=5)
+
+
+def test_pprof_trace_endpoint_and_profile_serialization():
+    from nydus_snapshotter_tpu.pprof import listener as pl
+
+    with trace.span("pprof.visible"):
+        pass
+    httpd = pl.new_pprof_http_listener("127.0.0.1:0")
+    try:
+        host, port = httpd.server_address[:2]
+
+        def get(path):
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            return resp.status, body
+
+        status, body = get("/debug/pprof/trace")
+        assert status == 200 and b"pprof.visible" in body
+
+        # Two overlapping profile requests serialize on the global
+        # profiler lock: both succeed, and the total wall reflects
+        # back-to-back (not interleaved) windows.
+        results = []
+
+        def prof():
+            results.append(get("/debug/pprof/profile?seconds=0.2"))
+
+        t0 = time.monotonic()
+        ts = [threading.Thread(target=prof) for _ in range(2)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+        elapsed = time.monotonic() - t0
+        assert all(status == 200 for status, _ in results)
+        assert elapsed >= 0.4  # serialized, not concurrent
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ------------------------------------------------ metrics collection errors
+
+
+def test_collector_failure_counted_and_isolated(tmp_path):
+    from nydus_snapshotter_tpu.metrics.serve import MetricsServer
+
+    server = MetricsServer(managers=[], cache_dir=str(tmp_path))
+
+    calls = []
+
+    class Boom:
+        def collect(self):
+            calls.append("boom")
+            raise RuntimeError("broken collector")
+
+    class Ok:
+        def collect(self):
+            calls.append("ok")
+
+    server.sn_collector = Boom()
+    server.fs_collector = Ok()
+    server.daemon_collector = Ok()
+    before = metrics_data.MetricsCollectionErrors.value("snapshotter")
+    server.collect_once()
+    # The broken collector is counted AND the remaining ones still ran.
+    assert metrics_data.MetricsCollectionErrors.value("snapshotter") == before + 1
+    assert calls == ["boom", "ok", "ok"]
+    assert "ntpu_metrics_collection_errors_total" in server.registry.render()

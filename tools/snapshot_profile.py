@@ -43,6 +43,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from nydus_snapshotter_tpu import constants as C  # noqa: E402
+from nydus_snapshotter_tpu import trace  # noqa: E402
 from nydus_snapshotter_tpu.snapshot.metastore import Usage  # noqa: E402
 from nydus_snapshotter_tpu.snapshot.snapshotter import Snapshotter  # noqa: E402
 from nydus_snapshotter_tpu.utils import errdefs  # noqa: E402
@@ -294,6 +295,10 @@ def run_storm(
         "wall_s": round(wall, 4),
         "ops": clock.percentiles(),
         "ancestor_cache": cache_stats,
+        # Metrics → traces link: the root trace ids slower than the
+        # rolling p95 (empty when tracing is off), so a slow percentile
+        # row can be chased to its span tree on /api/v1/traces.
+        "trace_exemplars": trace.exemplars(),
     }
     return report, dump, norm_mounts
 
@@ -339,6 +344,7 @@ def profile(
             "serial_ops": serial_report["ops"],
             "concurrent_ops": best["ops"],
             "ancestor_cache": best["ancestor_cache"],
+            "trace_exemplars": best["trace_exemplars"],
             "configs": runs,
         }
     finally:
