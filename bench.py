@@ -829,6 +829,34 @@ def trace_run(repo: str, timeout: float = 240.0) -> dict:
         return {"error": "trace profile produced no JSON"}
 
 
+_CHUNK_DICT_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from tools.chunk_dict_profile import profile
+print(json.dumps(profile(entries_m=2.0, grow_k=200)))
+"""
+
+
+def chunk_dict_run(repo: str, timeout: float = 240.0) -> dict:
+    """Chunk-dict growth + service profile (tools/chunk_dict_profile.py)
+    in a child under the hard watchdog: incremental-vs-rebuild best-rep
+    ratio, identity gates, and the DictService round-trip byte-identity.
+    A wedged UDS server costs one timeout, not a hang."""
+    res = _run_child_watchdog(
+        [sys.executable, "-c", _CHUNK_DICT_CHILD.format(repo=repo)], timeout=timeout
+    )
+    if res is None:
+        return {"error": f"chunk-dict profile hung >{timeout:.0f}s (watchdog killed it)"}
+    rc, stdout, stderr = res
+    if rc != 0:
+        tail = stderr.strip().splitlines()[-1] if stderr.strip() else ""
+        return {"error": f"chunk-dict profile exited rc={rc}: {tail}"[:200]}
+    try:
+        return json.loads(stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": "chunk-dict profile produced no JSON"}
+
+
 def _device_available(repo: str, timeout: float = 120.0) -> tuple[bool, str]:
     """(ok, note) — probe jax.devices() in a subprocess under the hard
     watchdog (_run_child_watchdog): a wedged device tunnel must degrade
@@ -1068,6 +1096,7 @@ def main() -> None:
     lazy_read = lazy_read_run(repo)
     snapshot_ops = snapshot_ops_run(repo)
     trace_detail = trace_run(repo)
+    chunk_dict_detail = chunk_dict_run(repo)
 
     print(
         json.dumps(
@@ -1100,6 +1129,7 @@ def main() -> None:
                     "lazy_read": lazy_read,
                     "snapshot_ops": snapshot_ops,
                     "trace": trace_detail,
+                    "chunk_dict": chunk_dict_detail,
                     "accel_profile": accel_profile,
                     "zstd_profile": zstd_profile,
                     "reference_defaults_profile": reference_defaults_profile,

@@ -289,12 +289,25 @@ def main(argv=None) -> int:
         metrics_server.serve(cfg.metrics.address)
         metrics_server.start_collecting()
         logger.info("metrics exporter on %s", cfg.metrics.address)
+    # Shared chunk-dict service (parallel/dict_service.py): one growable
+    # registry-wide dedup table per namespace, served to converter workers
+    # over the [chunk_dict].service UDS and mounted on the system
+    # controller's socket alongside the ops routes.
+    dict_service = None
+    if cfg.chunk_dict.service:
+        from nydus_snapshotter_tpu.parallel.dict_service import DictService
+
+        dict_service = DictService()
+        dict_service.run(cfg.chunk_dict.service)
     system_controller = None
     if cfg.system.enable:
         from nydus_snapshotter_tpu.system import SystemController
 
         system_controller = SystemController(
-            fs=fs, managers=list(managers.values()), sock_path=cfg.system.address
+            fs=fs,
+            managers=list(managers.values()),
+            sock_path=cfg.system.address,
+            dict_service=dict_service,
         )
         system_controller.run()
         logger.info("system controller on unix:%s", cfg.system.address)
@@ -330,6 +343,8 @@ def main(argv=None) -> int:
             metrics_server.stop()
         if system_controller is not None:
             system_controller.stop()
+        if dict_service is not None:
+            dict_service.stop()
         sn.close()
         for mgr in managers.values():
             mgr.stop()

@@ -215,6 +215,34 @@ class TraceConfig:
 
 
 @dataclass
+class ChunkDictConfig:
+    """Growable cross-repo chunk dictionary knobs
+    (parallel/{sharded_dict,dict_service}.py).
+
+    The dict builds its open-addressing tables with ``headroom``× spare
+    capacity and grows in place: incremental inserts open-address into the
+    spare slots (cost proportional to the inserted batch) until occupancy
+    crosses ``load_factor``, at which point the table does one
+    value-preserving rebuild with fresh headroom. ``service`` names the
+    UDS address of a shared :class:`DictService` so converter workers
+    dedup against one registry-wide table per ``namespace`` instead of
+    per-process copies ("" = in-process dict, no service).
+    ``service_backend`` picks the service's probe arm (``auto`` = native
+    host probe on one shard, the mesh-routed ``device`` probe on a multi-
+    chip mesh). Environment variables override per-process
+    (``NTPU_DICT_LOAD_FACTOR``, ``NTPU_DICT_HEADROOM``,
+    ``NTPU_DICT_SERVICE``, ``NTPU_DICT_NAMESPACE``) — that is also how
+    the section reaches spawned converter processes.
+    """
+
+    load_factor: float = 0.85
+    headroom: float = 2.0
+    service: str = ""
+    namespace: str = "default"
+    service_backend: str = "auto"
+
+
+@dataclass
 class ExperimentalConfig:
     enable_stargz: bool = False
     enable_referrer_detect: bool = False
@@ -247,6 +275,7 @@ class SnapshotterConfig:
     blobcache: BlobcacheConfig = field(default_factory=BlobcacheConfig)
     snapshots: SnapshotsConfig = field(default_factory=SnapshotsConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
+    chunk_dict: ChunkDictConfig = field(default_factory=ChunkDictConfig)
     experimental: ExperimentalConfig = field(default_factory=ExperimentalConfig)
 
     # -- derived paths (reference config/global.go accessors) ---------------
@@ -343,6 +372,14 @@ class SnapshotterConfig:
             raise ConfigError("trace.slow_op_threshold_ms must be >= 0 (0 = off)")
         if not 0.0 <= self.trace.sample_ratio <= 1.0:
             raise ConfigError("trace.sample_ratio must be within [0, 1]")
+        if not 0.0 < self.chunk_dict.load_factor < 1.0:
+            raise ConfigError("chunk_dict.load_factor must be within (0, 1)")
+        if self.chunk_dict.headroom < 1.0:
+            raise ConfigError("chunk_dict.headroom must be >= 1.0")
+        if self.chunk_dict.service_backend not in ("auto", "host", "device", "pallas"):
+            raise ConfigError(
+                f"invalid chunk_dict.service_backend {self.chunk_dict.service_backend!r}"
+            )
         if self.daemon.fs_driver in (constants.FS_DRIVER_BLOCKDEV, constants.FS_DRIVER_PROXY):
             # Proxy/blockdev modes run without nydusd daemons
             # (reference config.go:300-311 forces daemon_mode none).

@@ -28,6 +28,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Optional
 
+from nydus_snapshotter_tpu import trace
 from nydus_snapshotter_tpu.models.bootstrap import (
     Bootstrap,
     BatchRecord,
@@ -96,6 +97,11 @@ class GrowingChunkDict:
                     self.bootstrap.ciphers.append(CipherRecord())
                 self.bootstrap.ciphers.append(cipher or CipherRecord())
         return idx
+
+    def add_bootstrap_bytes(self, data: bytes) -> int:
+        """Merge a serialized bootstrap (the shape converter results and
+        the dict-service merge RPC both ship)."""
+        return self.add_bootstrap(Bootstrap.from_bytes(data))
 
     def add_bootstrap(self, source: Bootstrap) -> int:
         """Merge a converted image's chunks into the dict (first-wins per
@@ -180,6 +186,15 @@ class BatchConverter:
     concurrently packing layers (0/None = the pool default);
     ``memory_budget_mib`` sizes a converter-private budget instead of the
     process-shared one.
+
+    With a dict SERVICE configured (``dict_service=`` UDS address, or the
+    ``[chunk_dict] service`` / ``NTPU_DICT_SERVICE`` setting), the dict is
+    a :class:`~nydus_snapshotter_tpu.parallel.dict_service.ServiceChunkDict`
+    mirror of one registry-wide table instead of a private copy: probes
+    stay local (the dict is read-only inside an image), each converted
+    image merges through one batched RPC, and the mirror re-syncs by
+    replaying the service's append-only record tail — many converter
+    processes/hosts then dedup against each other's chunks.
     """
 
     def __init__(
@@ -189,12 +204,15 @@ class BatchConverter:
         max_workers: Optional[int] = None,
         memory_budget_mib: Optional[int] = None,
         layer_fanout: Optional[int] = None,
+        dict_service: Optional[str] = None,
+        namespace: Optional[str] = None,
     ):
         if opt.chunk_dict_path:
             raise ConvertError(
                 "BatchConverter owns the chunk dict; use dict_path= instead "
                 "of PackOption.chunk_dict_path"
             )
+        from nydus_snapshotter_tpu.parallel import dict_service as dict_service_mod
         from nydus_snapshotter_tpu.parallel import pipeline as pipeline_mod
 
         self.opt = opt
@@ -205,38 +223,61 @@ class BatchConverter:
             if memory_budget_mib
             else pipeline_mod.shared_budget()
         )
-        self.dict = (
-            GrowingChunkDict.load(dict_path) if dict_path else GrowingChunkDict()
-        )
+        dcfg = dict_service_mod.resolve_dict_config()
+        service = dict_service if dict_service is not None else dcfg.service
+        if service:
+            if dict_path:
+                raise ConvertError(
+                    "dict_path seeds a private dict; a service-backed batch "
+                    "seeds through the service (merge the seed bootstrap "
+                    "into the namespace instead)"
+                )
+            self.dict = dict_service_mod.ServiceChunkDict(
+                dict_service_mod.DictClient(service),
+                namespace or dcfg.namespace,
+            )
+        else:
+            self.dict = (
+                GrowingChunkDict.load(dict_path) if dict_path else GrowingChunkDict()
+            )
 
     def convert_image(self, name: str, layer_tars: list[bytes]) -> ImageResult:
         if not layer_tars:
             raise ConvertError(f"image {name}: no layers")
 
         def pack_one(tar: bytes) -> tuple[bytes, PackResult]:
-            out = io.BytesIO()
-            res = Pack(
-                out,
-                tar,
-                self.opt,
+            ctx = trace.capture()
+
+            def run() -> tuple[bytes, PackResult]:
+                with trace.with_context(ctx):
+                    out = io.BytesIO()
+                    res = Pack(
+                        out,
+                        tar,
+                        self.opt,
+                        chunk_dict=self.dict if len(self.dict) else None,
+                        budget=self.budget,
+                    )
+                    return out.getvalue(), res
+
+            return run
+
+        with trace.span("convert", image=name, layers=len(layer_tars)):
+            thunks = [pack_one(t)
+                      for t in layer_tars]
+            if len(layer_tars) > 1:
+                fanout = self.layer_fanout or self.max_workers
+                with ThreadPoolExecutor(max_workers=fanout) as pool:
+                    packed = list(pool.map(lambda fn: fn(), thunks))
+            else:
+                packed = [thunks[0]()]
+
+            merged = Merge(
+                [blob for blob, _ in packed],
+                MergeOption(fs_version=self.opt.fs_version),
                 chunk_dict=self.dict if len(self.dict) else None,
-                budget=self.budget,
             )
-            return out.getvalue(), res
-
-        if len(layer_tars) > 1:
-            fanout = self.layer_fanout or self.max_workers
-            with ThreadPoolExecutor(max_workers=fanout) as pool:
-                packed = list(pool.map(pack_one, layer_tars))
-        else:
-            packed = [pack_one(layer_tars[0])]
-
-        merged = Merge(
-            [blob for blob, _ in packed],
-            MergeOption(fs_version=self.opt.fs_version),
-            chunk_dict=self.dict if len(self.dict) else None,
-        )
-        added = self.dict.add_bootstrap(Bootstrap.from_bytes(merged.bootstrap))
+            added = self.dict.add_bootstrap_bytes(merged.bootstrap)
         layer_blobs = {
             res.blob_id: blob for blob, res in packed if res.blob_id
         }

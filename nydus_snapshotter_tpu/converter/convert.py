@@ -415,7 +415,9 @@ def Merge(
     if not layers:
         raise ConvertError("merge needs at least one layer")
     if chunk_dict is None and opt.chunk_dict_path:
-        chunk_dict = ChunkDict.from_path(parse_chunk_dict_arg(opt.chunk_dict_path))
+        from nydus_snapshotter_tpu.parallel.dict_service import open_chunk_dict
+
+        chunk_dict = open_chunk_dict(opt.chunk_dict_path)
     from nydus_snapshotter_tpu.models.nydus_real import load_any_bootstrap
 
     parent: Optional[Bootstrap] = None

@@ -745,7 +745,11 @@ def pack_stream(
         src_tar = io.BytesIO(src_tar)
 
     if chunk_dict is None and opt.chunk_dict_path:
-        chunk_dict = ChunkDict.from_path(parse_chunk_dict_arg(opt.chunk_dict_path))
+        # service://<uds>[#namespace] connects a shared-dict mirror; any
+        # other shape is the file-based dict as before.
+        from nydus_snapshotter_tpu.parallel.dict_service import open_chunk_dict
+
+        chunk_dict = open_chunk_dict(opt.chunk_dict_path)
     from nydus_snapshotter_tpu.converter.convert import _make_compressor
 
     out = _CountingWriter(dest)

@@ -82,6 +82,9 @@ KNOWN_SITES = (
     "snapshot.commit",       # snapshot/snapshotter.py commit entry
     "snapshot.usage",        # snapshot/async_work.py async usage scan
     "snapshot.cleanup",      # snapshot/snapshotter.py per-dir cleanup
+    "dict.insert",           # parallel/sharded_dict.py incremental insert batch
+    "dict.rebuild",          # parallel/sharded_dict.py load-factor/overflow rebuild
+    "dict.rpc",              # parallel/dict_service.py service request entry
 )
 
 _lock = threading.Lock()

@@ -557,6 +557,99 @@ int64_t ntpu_dict_build(const uint32_t *digests, int64_t n,
   return 0;
 }
 
+// Incremental insert into an already-built table (same layout as
+// ntpu_dict_build): place k entries carrying EXPLICIT stored values
+// (+1 form — the caller numbers them as first-occurrence positions of
+// the concatenated insertion sequence, so previously issued indices
+// never move). Cost is proportional to k, not the table — the
+// insert-proportional arm that replaces the full rebuild on growth.
+// An equal key already in the table is skipped (idempotent re-insert).
+// Values are release-stored AFTER the 32-byte key write so a concurrent
+// lock-free probe never pairs a live value with a torn key (it treats
+// value==0 as empty and linearizes before the insert).
+// Returns the deepest chain reached (>= 0) on success, or -1 when any
+// entry overflowed max_probe (caller falls back to a value-preserving
+// rebuild; entries placed before the overflow are in the table, which
+// the rebuild's occupancy scan collects).
+int64_t ntpu_dict_insert(const uint32_t *digests, const int32_t *vals,
+                         int64_t k, int64_t n_shards, int64_t cap,
+                         int64_t max_probe, uint32_t *keys, int32_t *values) {
+  int64_t depth = 0;
+  for (int64_t idx = 0; idx < k; ++idx) {
+    const uint32_t *d = digests + idx * 8;
+    const uint64_t shard = d[0] % (uint64_t)n_shards;
+    const uint64_t base = d[1] & (uint64_t)(cap - 1);
+    bool placed = false;
+    for (int64_t j = 0; j < max_probe; ++j) {
+      const uint64_t lin = shard * (uint64_t)cap + ((base + j) & (uint64_t)(cap - 1));
+      if (values[lin] == 0) {
+        std::memcpy(keys + lin * 8, d, 32);
+#if defined(__GNUC__) || defined(__clang__)
+        __atomic_store_n(&values[lin], vals[idx], __ATOMIC_RELEASE);
+#else
+        values[lin] = vals[idx];
+#endif
+        if (j + 1 > depth) depth = j + 1;
+        placed = true;
+        break;
+      }
+      if (std::memcmp(keys + lin * 8, d, 32) == 0) {
+        placed = true;  // already present: first insertion wins
+        break;
+      }
+    }
+    if (!placed) return -1;
+  }
+  return depth;
+}
+
+// Fused probe-or-insert over one batch (the insert_u32 hot path): for
+// each digest in order, walk its chain once — a key match answers with
+// the stored index (batch-internal duplicates resolve to the entry just
+// placed, so values are first-occurrence positions of the concatenated
+// sequence with NO host-side pre-dedup or separate lookup pass); an
+// empty slot inserts value base+idx+1 and answers base+idx. out_idx[k]
+// receives every answer. Returns (depth << 32) | n_new on success
+// (depth = deepest chain reached, n_new = fresh slots consumed), or -1
+// when any chain overflowed max_probe (entries before the overflow are
+// placed with their final values — the caller's fallback path sees them
+// as ordinary hits, so the partial work is semantically idempotent).
+int64_t ntpu_dict_upsert(const uint32_t *digests, int64_t n, int64_t base,
+                         int64_t n_shards, int64_t cap, int64_t max_probe,
+                         uint32_t *keys, int32_t *values, int64_t *out_idx) {
+  int64_t depth = 0;
+  int64_t n_new = 0;
+  for (int64_t idx = 0; idx < n; ++idx) {
+    const uint32_t *d = digests + idx * 8;
+    const uint64_t shard = d[0] % (uint64_t)n_shards;
+    const uint64_t slot0 = d[1] & (uint64_t)(cap - 1);
+    bool placed = false;
+    for (int64_t j = 0; j < max_probe; ++j) {
+      const uint64_t lin = shard * (uint64_t)cap + ((slot0 + j) & (uint64_t)(cap - 1));
+      if (values[lin] == 0) {
+        std::memcpy(keys + lin * 8, d, 32);
+#if defined(__GNUC__) || defined(__clang__)
+        __atomic_store_n(&values[lin], (int32_t)(base + idx + 1), __ATOMIC_RELEASE);
+#else
+        values[lin] = (int32_t)(base + idx + 1);
+#endif
+        out_idx[idx] = base + idx;
+        if (j + 1 > depth) depth = j + 1;
+        ++n_new;
+        placed = true;
+        break;
+      }
+      if (std::memcmp(keys + lin * 8, d, 32) == 0) {
+        out_idx[idx] = (int64_t)values[lin] - 1;
+        placed = true;
+        break;
+      }
+    }
+    if (!placed) return -1;
+  }
+  return (depth << 32) | n_new;
+}
+
 // Probe a batch of digests against a built table (same layout as
 // ntpu_dict_build). Writes the stored value-1 (= dict chunk index) per
 // query, or -1 on miss. This is the single-node latency arm of the dedup

@@ -9,7 +9,12 @@
 #include <cstdint>
 #include <cstring>
 
-#if defined(__x86_64__) || defined(_M_X64)
+// The SHA-NI arm dispatches at runtime via __builtin_cpu_supports("sha"),
+// a feature name GCC only learned in 11 (clang has it throughout). On
+// older GCC the whole SHA-NI arm gates off at compile time and the scalar
+// compress below carries the load — same bytes, no runtime dispatch.
+#if (defined(__x86_64__) || defined(_M_X64)) && \
+    (defined(__clang__) || !defined(__GNUC__) || __GNUC__ >= 11)
 #include <immintrin.h>
 #define NTPU_X86 1
 #endif

@@ -478,12 +478,18 @@ class FusedDeviceEngine:
         chunk_dict: tuple[np.ndarray, np.ndarray] | None = None,
         depth: int = 8,
         probe_kernel: str = "auto",  # "auto" | "xla" | "pallas" | "pallas-interpret"
+        dict_epoch: int | None = None,
     ):
         """Pass 2: per-bucket digest states + optional dict probe.
 
         ``probe_kernel``: auto = the DMA-pipelined Pallas probe on real
         TPU, the XLA gather elsewhere; "pallas-interpret" forces the
         Pallas lowering in interpret mode (CPU differential tests).
+
+        ``dict_epoch``: the dict's mutation epoch (ShardedChunkDict
+        ``fused_probe_tables``). Incremental inserts mutate the table
+        arrays IN PLACE, so the staged-table cache must key on the epoch
+        — identity alone would keep serving the pre-insert device copy.
         """
         offs = tuple(jnp.asarray(b.offsets) for b in buckets)
         sizes = tuple(jnp.asarray(b.sizes) for b in buckets)
@@ -504,7 +510,7 @@ class FusedDeviceEngine:
                 use_pallas = True
                 probe_interpret = probe_kernel == "pallas-interpret"
             if use_pallas:
-                tk, tv = self._padded_tables(keys, vals, depth)
+                tk, tv = self._padded_tables(keys, vals, depth, dict_epoch)
             else:
                 tk, tv = jnp.asarray(keys), jnp.asarray(vals)
         states, probe = _pass2(
@@ -514,11 +520,19 @@ class FusedDeviceEngine:
         )
         return states, probe
 
-    def _padded_tables(self, keys: np.ndarray, vals: np.ndarray, depth: int):
+    def _padded_tables(
+        self,
+        keys: np.ndarray,
+        vals: np.ndarray,
+        depth: int,
+        dict_epoch: int | None = None,
+    ):
         """Wrap-free padded device tables for the Pallas probe, cached per
-        (dict identity, depth) — padding copies tens of MB for million-
-        entry dicts and repeated digest_probe calls (the bench loop) must
-        not pay it, or the H2D re-upload, per dispatch."""
+        (dict identity, depth, epoch) — padding copies tens of MB for
+        million-entry dicts and repeated digest_probe calls (the bench
+        loop) must not pay it, or the H2D re-upload, per dispatch. The
+        epoch term invalidates staged copies when incremental inserts
+        mutate the arrays in place (same identity, new contents)."""
         from nydus_snapshotter_tpu.ops import probe_pallas
 
         cached = getattr(self, "_table_cache", None)
@@ -527,11 +541,12 @@ class FusedDeviceEngine:
             and cached[0] is keys  # identity: the cache keeps them alive,
             and cached[1] is vals  # so `is` cannot alias freed objects
             and cached[2] == depth
+            and cached[3] == dict_epoch
         ):
-            return cached[3], cached[4]
+            return cached[4], cached[5]
         keys_pad, vals_pad = probe_pallas.pad_tables(keys, vals, depth)
         tk, tv = jnp.asarray(keys_pad), jnp.asarray(vals_pad)
-        self._table_cache = (keys, vals, depth, tk, tv)
+        self._table_cache = (keys, vals, depth, dict_epoch, tk, tv)
         return tk, tv
 
     def _digest_bytes(self, state_row: np.ndarray) -> bytes:
@@ -547,6 +562,7 @@ class FusedDeviceEngine:
         chunk_dict: tuple[np.ndarray, np.ndarray] | None = None,
         depth: int = 8,
         probe_kernel: str = "auto",
+        dict_epoch: int | None = None,
     ) -> FusedResult:
         from time import perf_counter as _pc
 
@@ -577,7 +593,7 @@ class FusedDeviceEngine:
         buckets, order = self.plan_buckets(table, cuts)
         _t2 = _pc()
         states, probe = self.digest_probe(
-            buffer_dev, buckets, chunk_dict, depth, probe_kernel
+            buffer_dev, buckets, chunk_dict, depth, probe_kernel, dict_epoch
         )
         _record_dispatch(n, _t1 - _t0, _t2 - _t1, _pc() - _t2)
         by_cap = {

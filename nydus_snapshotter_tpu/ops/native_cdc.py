@@ -85,6 +85,20 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # shards, cap, max_probe
                 ctypes.c_void_p, ctypes.c_void_p,  # keys, values
             ]
+        if hasattr(lib, "ntpu_dict_insert"):
+            lib.ntpu_dict_insert.restype = ctypes.c_int64
+            lib.ntpu_dict_insert.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,  # digests, vals, k
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # shards, cap, max_probe
+                ctypes.c_void_p, ctypes.c_void_p,  # keys, values
+            ]
+        if hasattr(lib, "ntpu_dict_upsert"):
+            lib.ntpu_dict_upsert.restype = ctypes.c_int64
+            lib.ntpu_dict_upsert.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,  # digests, n, base
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # shards, cap, max_probe
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,  # keys, values, out
+            ]
         if hasattr(lib, "ntpu_dict_probe"):
             lib.ntpu_dict_probe.restype = None
             lib.ntpu_dict_probe.argtypes = [
@@ -508,6 +522,69 @@ def dict_build_native(
         keys.ctypes.data, values.ctypes.data,
     )
     return rc == 0
+
+
+def dict_insert_available() -> bool:
+    lib = load()
+    return lib is not None and hasattr(lib, "ntpu_dict_insert")
+
+
+def dict_insert_native(
+    digests: np.ndarray, values_i32: np.ndarray,
+    n_shards: int, cap: int, max_probe: int,
+    keys: np.ndarray, values: np.ndarray,
+) -> int:
+    """Incremental insert of unique absent digests with explicit stored
+    values (+1 form) into a built table — the insert-proportional growth
+    arm (cost O(batch), never O(table)). Returns the deepest chain
+    reached, or -1 on a max_probe overflow (caller rebuilds)."""
+    lib = load()
+    if lib is None or not hasattr(lib, "ntpu_dict_insert"):
+        raise RuntimeError("libchunk_engine.so not built or too old")
+    assert digests.dtype == np.uint32 and digests.flags.c_contiguous
+    assert values_i32.dtype == np.int32 and values_i32.flags.c_contiguous
+    assert keys.dtype == np.uint32 and keys.flags.c_contiguous
+    assert values.dtype == np.int32 and values.flags.c_contiguous
+    return int(
+        lib.ntpu_dict_insert(
+            digests.ctypes.data, values_i32.ctypes.data, len(digests),
+            n_shards, cap, max_probe,
+            keys.ctypes.data, values.ctypes.data,
+        )
+    )
+
+
+def dict_upsert_available() -> bool:
+    lib = load()
+    return lib is not None and hasattr(lib, "ntpu_dict_upsert")
+
+
+def dict_upsert_native(
+    digests: np.ndarray, base: int,
+    n_shards: int, cap: int, max_probe: int,
+    keys: np.ndarray, values: np.ndarray,
+) -> "tuple[int, int, np.ndarray] | None":
+    """Fused probe-or-insert of a whole batch in one sequential pass:
+    returns (depth, n_new, indices i64[n]) or None on chain overflow
+    (the placed prefix carries final values — semantically idempotent,
+    the caller's fallback sees those entries as ordinary hits)."""
+    lib = load()
+    if lib is None or not hasattr(lib, "ntpu_dict_upsert"):
+        raise RuntimeError("libchunk_engine.so not built or too old")
+    assert digests.dtype == np.uint32 and digests.flags.c_contiguous
+    assert keys.dtype == np.uint32 and keys.flags.c_contiguous
+    assert values.dtype == np.int32 and values.flags.c_contiguous
+    out = np.empty(len(digests), dtype=np.int64)
+    rc = int(
+        lib.ntpu_dict_upsert(
+            digests.ctypes.data, len(digests), base,
+            n_shards, cap, max_probe,
+            keys.ctypes.data, values.ctypes.data, out.ctypes.data,
+        )
+    )
+    if rc < 0:
+        return None
+    return rc >> 32, rc & 0xFFFFFFFF, out
 
 
 def dict_probe_available() -> bool:

@@ -1,0 +1,738 @@
+"""Shared chunk-dictionary service: one registry-wide dedup table per
+namespace, grown incrementally, served to converter workers over a UDS.
+
+The reference's chunk dict is a bootstrap file each ``nydus-image``
+invocation re-reads (``--chunk-dict bootstrap=…``, pkg/converter/tool/
+builder.go:122-123): every converter holds a private copy and an operator
+refreshes the file out of band. At registry scale images land continuously
+on many hosts, so here the dict is a process-level SERVICE:
+
+- **ServiceDict** (one per namespace) pairs the record store — a
+  :class:`~nydus_snapshotter_tpu.converter.batch.GrowingChunkDict`
+  bootstrap holding the chunk/blob/batch/cipher tables — with a
+  :class:`~nydus_snapshotter_tpu.parallel.sharded_dict.ShardedChunkDict`
+  probe index grown via ``insert_digests`` (insert-proportional cost; a
+  full rebuild only on load-factor breach). The index value of a digest
+  IS its position in the record store's chunk table: merges insert only
+  the records the merge actually appended, in append order.
+- **DictService** exposes the namespaces over HTTP on a unix socket —
+  the same UDS/API plumbing as the system controller (system/system.py
+  mounts the ``/api/v1/dict`` routes; the service also runs standalone).
+  Probe and insert RPCs are BATCHED (one request per image, not per
+  chunk) and carry trace context in headers, so a ``convert``-rooted
+  span tree spans the RPC into the service's ``dict.rpc.*`` spans.
+- **ServiceChunkDict** is the converter-facing proxy: a local MIRROR of
+  the namespace's tables that Pack/Merge probe exactly like a private
+  GrowingChunkDict (probe locally — the dict is read-only inside one
+  image), reconciled against the service between images by replaying the
+  append-only record tail (``/entries``, cost proportional to what the
+  mirror is missing — the epoch story of sharded_dict.save_incremental,
+  applied to live converters). ``add_bootstrap`` ships the merged
+  bootstrap to the service, whose merge (first-wins per digest) is the
+  single ordering authority across every converter process — which is
+  what makes service-backed batch output byte-identical to the
+  per-process path on the same image order.
+
+Wire format: probe bodies/answers are raw little-endian arrays (32-byte
+digests in, int64 indices out); record deltas are fixed-width structured
+rows (``_CHUNK_DT`` et al) — converters across hosts replay them into
+mirrors at memcpy speed, no JSON on the hot path.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import os
+import re
+import socket
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler
+from time import perf_counter
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from nydus_snapshotter_tpu import failpoint, trace
+from nydus_snapshotter_tpu.metrics import registry as _metrics
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_NAMESPACE = "default"
+_NS_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,100}$")
+_DICT_ROUTE = re.compile(r"^/api/v1/dict(?:/([^/]+)(?:/([a-z]+))?)?$")
+
+# Fixed-width delta rows (all little-endian; digests/keys as u1 lanes —
+# numpy S-dtypes strip trailing NULs, which raw SHA bytes may contain).
+_CHUNK_DT = np.dtype([
+    ("digest", "u1", 32), ("blob_index", "<u4"), ("flags", "<u4"),
+    ("uoff", "<u8"), ("coff", "<u8"), ("usize", "<u4"), ("csize", "<u4"),
+])
+_BLOB_DT = np.dtype([
+    ("blob_id", "S64"), ("csize", "<u8"), ("usize", "<u8"),
+    ("chunk_count", "<u4"), ("flags", "<u4"),
+])
+_BATCH_DT = np.dtype([
+    ("blob_index", "<u8"), ("coff", "<u8"), ("ubase", "<u8"), ("usize", "<u8"),
+])
+_CIPHER_DT = np.dtype([("algo", "<u4"), ("key", "u1", 32), ("iv", "u1", 16)])
+# Delta header: n_chunks, n_blobs, n_batches, n_ciphers, epoch,
+# rebuild_epoch, chunk_size, reserved.
+_DELTA_HDR_FIELDS = 8
+
+_RPC_TOTAL = _metrics.Counter(
+    "ntpu_dict_rpc_total", "Chunk-dict service RPCs served", ("op",)
+)
+_RPC_ERRORS = _metrics.Counter(
+    "ntpu_dict_rpc_errors_total", "Chunk-dict service RPCs that failed", ("op",)
+)
+_RPC_MS = _metrics.Histogram(
+    "ntpu_dict_rpc_duration_milliseconds",
+    "Chunk-dict service RPC handler latency",
+    ("op",),
+)
+
+
+class DictServiceError(RuntimeError):
+    """An RPC failed on the service side (the message carries the op)."""
+
+
+# ---------------------------------------------------------------------------
+# Config resolution (env > [chunk_dict] config > defaults)
+# ---------------------------------------------------------------------------
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+def _global_chunk_dict_config():
+    try:
+        from nydus_snapshotter_tpu.config import config as _cfg
+
+        return _cfg.get_global_config().chunk_dict
+    except Exception:
+        return None
+
+
+class DictRuntimeConfig:
+    """Resolved ``[chunk_dict]`` knobs for this process."""
+
+    __slots__ = ("load_factor", "headroom", "service", "namespace", "backend")
+
+    def __init__(self, load_factor, headroom, service, namespace, backend):
+        self.load_factor = load_factor
+        self.headroom = headroom
+        self.service = service
+        self.namespace = namespace
+        self.backend = backend
+
+
+def resolve_dict_config() -> DictRuntimeConfig:
+    """env (``NTPU_DICT*``) > ``[chunk_dict]`` global config > defaults.
+    Env overrides are also how the section reaches spawned converter
+    processes, which have no global snapshotter config."""
+    cd = _global_chunk_dict_config()
+    return DictRuntimeConfig(
+        load_factor=_env_float(
+            "NTPU_DICT_LOAD_FACTOR", getattr(cd, "load_factor", 0.85)
+        ),
+        headroom=_env_float("NTPU_DICT_HEADROOM", getattr(cd, "headroom", 2.0)),
+        service=os.environ.get("NTPU_DICT_SERVICE", getattr(cd, "service", "")),
+        namespace=os.environ.get(
+            "NTPU_DICT_NAMESPACE", getattr(cd, "namespace", DEFAULT_NAMESPACE)
+        ),
+        backend=os.environ.get(
+            "NTPU_DICT_BACKEND", getattr(cd, "service_backend", "auto")
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# ServiceDict: one namespace's registry-wide table
+# ---------------------------------------------------------------------------
+
+
+class ServiceDict:
+    """Record store + growable probe index for one namespace.
+
+    The GrowingChunkDict bootstrap is the ordering/merge authority
+    (first-wins per digest, append-only tables); the ShardedChunkDict
+    index is its probe accelerator, fed exactly the appended digests so
+    index values equal chunk-table positions. One lock serializes
+    mutation; probes read the index's lock-free table snapshot.
+    """
+
+    def __init__(
+        self,
+        namespace: str = DEFAULT_NAMESPACE,
+        cfg: Optional[DictRuntimeConfig] = None,
+        mesh=None,
+    ):
+        from nydus_snapshotter_tpu.converter.batch import GrowingChunkDict
+        from nydus_snapshotter_tpu.parallel import mesh as mesh_lib
+        from nydus_snapshotter_tpu.parallel.sharded_dict import ShardedChunkDict
+
+        cfg = cfg or resolve_dict_config()
+        self.namespace = namespace
+        self.records = GrowingChunkDict()
+        self.index = ShardedChunkDict(
+            np.zeros((0, 8), dtype=np.uint32),
+            mesh if mesh is not None else mesh_lib.make_mesh(1),
+            capacity_factor=cfg.headroom,
+            probe_backend=cfg.backend,
+            load_factor=cfg.load_factor,
+        )
+        self._mu = threading.Lock()
+
+    # -- mutation ------------------------------------------------------------
+
+    def merge_bootstrap_bytes(self, data: bytes) -> dict:
+        """Merge one converted image's bootstrap (first-wins per digest);
+        the digests the merge appends grow the probe index incrementally
+        in the same order. Returns the post-merge stats."""
+        from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
+
+        source = Bootstrap.from_bytes(data)
+        with self._mu:
+            added = self.records.add_bootstrap(source)
+            if added:
+                new = self.records.bootstrap.chunks[-added:]
+                got = self.index.insert_digests([c.digest for c in new])
+                # Index values are +0-based chunk positions; the appended
+                # records occupy the tail, so the assignment is dense.
+                base = len(self.records.bootstrap.chunks) - added
+                if got[0] != base:  # pragma: no cover - invariant guard
+                    raise DictServiceError(
+                        f"index/record skew: insert returned {got[0]}, "
+                        f"records at {base}"
+                    )
+            return self._stats_locked(added=added)
+
+    # -- reads ---------------------------------------------------------------
+
+    def probe(self, digests: bytes) -> np.ndarray:
+        """Batched probe: concatenated raw 32-byte digests -> int64 chunk
+        positions (-1 = miss). Lock-free against concurrent merges (the
+        index publishes table snapshots atomically)."""
+        if len(digests) % 32:
+            raise ValueError("probe body must be a multiple of 32 bytes")
+        q = np.frombuffer(digests, dtype="<u4").reshape(-1, 8)
+        return self.index.lookup_u32(q)
+
+    def _stats_locked(self, added: Optional[int] = None) -> dict:
+        bs = self.records.bootstrap
+        out = {
+            "namespace": self.namespace,
+            "chunks": len(bs.chunks),
+            "blobs": len(bs.blobs),
+            "batches": len(bs.batches),
+            "ciphers": len(bs.ciphers),
+            "chunk_size": bs.chunk_size,
+            "epoch": self.index.epoch,
+            "rebuild_epoch": self.index.rebuild_epoch,
+            "index_capacity": self.index.capacity * self.index.n_shards,
+        }
+        if added is not None:
+            out["added"] = added
+        return out
+
+    def stats(self) -> dict:
+        with self._mu:
+            return self._stats_locked()
+
+    def entries_delta(
+        self, chunks: int, blobs: int, batches: int, ciphers: int
+    ) -> bytes:
+        """The append-only record tail past the caller's counts, as one
+        header + four fixed-width sections — a mirror replays it and is
+        exactly the service's tables (cost proportional to the tail)."""
+        with self._mu:
+            bs = self.records.bootstrap
+            c_rows = bs.chunks[chunks:]
+            b_rows = bs.blobs[blobs:]
+            t_rows = bs.batches[batches:]
+            e_rows = bs.ciphers[ciphers:]
+            epoch, rebuild_epoch = self.index.epoch, self.index.rebuild_epoch
+            chunk_size = bs.chunk_size
+        ca = np.zeros(len(c_rows), dtype=_CHUNK_DT)
+        for i, r in enumerate(c_rows):
+            ca[i] = (
+                np.frombuffer(r.digest, dtype=np.uint8),
+                r.blob_index, r.flags, r.uncompressed_offset,
+                r.compressed_offset, r.uncompressed_size, r.compressed_size,
+            )
+        ba = np.zeros(len(b_rows), dtype=_BLOB_DT)
+        for i, r in enumerate(b_rows):
+            ba[i] = (r.blob_id.encode(), r.compressed_size, r.uncompressed_size,
+                     r.chunk_count, r.flags)
+        ta = np.zeros(len(t_rows), dtype=_BATCH_DT)
+        for i, r in enumerate(t_rows):
+            ta[i] = (r.blob_index, r.compressed_offset, r.uncompressed_base,
+                     r.uncompressed_size)
+        ea = np.zeros(len(e_rows), dtype=_CIPHER_DT)
+        for i, r in enumerate(e_rows):
+            key = np.zeros(32, np.uint8)
+            iv = np.zeros(16, np.uint8)
+            if r.algo:
+                key = np.frombuffer(r.key, dtype=np.uint8)
+                iv = np.frombuffer(r.iv, dtype=np.uint8)
+            ea[i] = (r.algo, key, iv)
+        hdr = np.asarray(
+            [len(c_rows), len(b_rows), len(t_rows), len(e_rows),
+             epoch, rebuild_epoch, chunk_size, 0],
+            dtype=np.uint64,
+        )
+        return b"".join(
+            [hdr.tobytes(), ca.tobytes(), ba.tobytes(), ta.tobytes(), ea.tobytes()]
+        )
+
+    def save(self, path: str) -> dict:
+        """Persist both faces: the dict-image bootstrap (reference interop,
+        ``--chunk-dict bootstrap=…`` shape) at ``path`` and the
+        epoch-stamped probe index at ``path + '.idx'`` via the incremental
+        append path (full rewrite only after a rebuild/shape change)."""
+        with self._mu:
+            self.records.save(path)
+            idx = self.index.save_incremental(path + ".idx")
+        return {"bootstrap": path, "index": path + ".idx", "index_save": idx}
+
+
+# ---------------------------------------------------------------------------
+# DictService: HTTP-over-UDS front end
+# ---------------------------------------------------------------------------
+
+
+class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+
+    def finish_request(self, request, client_address):
+        self.RequestHandlerClass(request, ("uds", 0), self)
+
+
+class DictService:
+    """One dict per namespace behind batched HTTP RPCs.
+
+    ``handle()`` is transport-agnostic so the system controller mounts
+    the same routes on its socket; ``run()`` serves standalone on a
+    dedicated UDS (the ``[chunk_dict] service`` address).
+    """
+
+    def __init__(self, cfg: Optional[DictRuntimeConfig] = None, mesh=None):
+        self.cfg = cfg or resolve_dict_config()
+        self._mesh = mesh
+        self._dicts: dict[str, ServiceDict] = {}
+        self._mu = threading.Lock()
+        self._httpd: Optional[_UnixHTTPServer] = None
+        self.sock_path = ""
+
+    def dict_for(self, namespace: str) -> ServiceDict:
+        if not _NS_RE.match(namespace):
+            raise ValueError(f"invalid dict namespace {namespace!r}")
+        with self._mu:
+            sd = self._dicts.get(namespace)
+            if sd is None:
+                sd = self._dicts[namespace] = ServiceDict(
+                    namespace, self.cfg, mesh=self._mesh
+                )
+            return sd
+
+    # -- request dispatch -----------------------------------------------------
+
+    def handle(
+        self, method: str, path: str, headers, body: bytes
+    ) -> tuple[int, str, bytes]:
+        """(method, path?query, headers, body) -> (status, ctype, payload).
+        Adopts the caller's trace context from the ``x-ntpu-*`` headers so
+        the server-side span joins the converter's ``convert`` root."""
+        parsed = urlparse(path)
+        m = _DICT_ROUTE.match(parsed.path)
+        if not m:
+            return 404, "application/json", b'{"message": "no such endpoint"}'
+        ns, op = m.group(1), m.group(2)
+        if ns is None:
+            op = "list"
+        elif op is None:
+            op = "stats"
+        try:
+            tid = int(headers.get("x-ntpu-trace-id", "0"), 16)
+            pid = int(headers.get("x-ntpu-parent-id", "0"), 16)
+        except ValueError:
+            tid = pid = 0
+        t0 = perf_counter()
+        try:
+            with trace.with_context(trace.remote_context(tid, pid)):
+                with trace.span(f"dict.rpc.{op}", namespace=ns or "*"):
+                    failpoint.hit("dict.rpc")
+                    payload = self._dispatch(method, op, ns, parsed.query, body)
+            _RPC_TOTAL.labels(op).inc()
+            _RPC_MS.labels(op).observe((perf_counter() - t0) * 1000.0)
+        except (ValueError, KeyError) as e:
+            _RPC_ERRORS.labels(op).inc()
+            return 400, "application/json", json.dumps({"message": str(e)}).encode()
+        except Exception as e:  # noqa: BLE001 - mapped to a wire status
+            logger.exception("dict service %s %s", method, path)
+            _RPC_ERRORS.labels(op).inc()
+            return 500, "application/json", json.dumps({"message": str(e)}).encode()
+        if isinstance(payload, bytes):
+            return 200, "application/octet-stream", payload
+        return 200, "application/json", json.dumps(payload).encode()
+
+    def _dispatch(self, method: str, op: str, ns: Optional[str], query: str, body: bytes):
+        if op == "list":
+            with self._mu:
+                names = sorted(self._dicts)
+            return [self._dicts[n].stats() for n in names]
+        sd = self.dict_for(ns)
+        if op == "stats" and method == "GET":
+            return sd.stats()
+        if op == "probe" and method == "POST":
+            return sd.probe(body).astype("<i8").tobytes()
+        if op == "merge" and method == "POST":
+            return sd.merge_bootstrap_bytes(body)
+        if op == "entries" and method == "GET":
+            q = parse_qs(query)
+
+            def count(name: str) -> int:
+                v = int(q.get(name, ["0"])[0])
+                if v < 0:
+                    raise ValueError(f"{name} must be >= 0")
+                return v
+
+            return sd.entries_delta(
+                count("chunks"), count("blobs"), count("batches"), count("ciphers")
+            )
+        if op == "save" and method == "POST":
+            req = json.loads(body or b"{}")
+            path = req.get("path", "")
+            if not path:
+                raise ValueError("save needs a path")
+            return sd.save(path)
+        raise ValueError(f"no such dict op {method} {op!r}")
+
+    # -- standalone UDS server ------------------------------------------------
+
+    def run(self, sock_path: str) -> None:
+        os.makedirs(os.path.dirname(sock_path) or ".", exist_ok=True)
+        try:
+            os.remove(sock_path)
+        except FileNotFoundError:
+            pass
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _serve(self, body: bytes) -> None:
+                status, ctype, payload = service.handle(
+                    self.command, self.path, self.headers, body
+                )
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._serve(b"")
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                self._serve(self.rfile.read(length))
+
+        self._httpd = _UnixHTTPServer(sock_path, Handler)
+        self.sock_path = sock_path
+        threading.Thread(
+            target=self._httpd.serve_forever, name="dict-service", daemon=True
+        ).start()
+        logger.info("chunk-dict service on unix:%s", sock_path)
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self.sock_path:
+            try:
+                os.remove(self.sock_path)
+            except OSError:
+                pass
+            self.sock_path = ""
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+class _UDSHTTPConnection(http.client.HTTPConnection):
+    def __init__(self, sock_path: str, timeout: float):
+        super().__init__("localhost", timeout=timeout)
+        self._sock_path = sock_path
+
+    def connect(self) -> None:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.timeout)
+        s.connect(self._sock_path)
+        self.sock = s
+
+
+class DictClient:
+    """Batched RPCs to a :class:`DictService` over its UDS, with the
+    caller's trace context carried in headers. One persistent HTTP/1.1
+    connection per client, re-dialed on error (NOT thread-safe — one
+    client per converter thread, like an HTTPConnection)."""
+
+    def __init__(self, sock_path: str, timeout: float = 60.0):
+        self.sock_path = sock_path
+        self.timeout = timeout
+        self._conn: Optional[_UDSHTTPConnection] = None
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    def _request(self, method: str, path: str, body: bytes = b"") -> tuple[str, bytes]:
+        headers = {"Content-Length": str(len(body))}
+        ctx = trace.capture()
+        if ctx is not None and ctx.sampled:
+            headers["x-ntpu-trace-id"] = f"{ctx.trace_id:x}"
+            headers["x-ntpu-parent-id"] = f"{ctx.span_id:x}"
+        for attempt in (0, 1):
+            if self._conn is None:
+                self._conn = _UDSHTTPConnection(self.sock_path, self.timeout)
+            try:
+                self._conn.request(method, path, body=body, headers=headers)
+                resp = self._conn.getresponse()
+                payload = resp.read()
+                break
+            except (http.client.HTTPException, OSError):
+                # stale kept-alive connection: re-dial once
+                self.close()
+                if attempt:
+                    raise
+        if resp.status != 200:
+            try:
+                message = json.loads(payload).get("message", "")
+            except ValueError:
+                message = payload[:200].decode("utf-8", "replace")
+            raise DictServiceError(
+                f"dict service {method} {path} -> {resp.status}: {message}"
+            )
+        return resp.headers.get("Content-Type", ""), payload
+
+    def namespaces(self) -> list[dict]:
+        return json.loads(self._request("GET", "/api/v1/dict")[1])
+
+    def stats(self, namespace: str = DEFAULT_NAMESPACE) -> dict:
+        return json.loads(
+            self._request("GET", f"/api/v1/dict/{namespace}/stats")[1]
+        )
+
+    def probe(self, digests: list[bytes], namespace: str = DEFAULT_NAMESPACE) -> np.ndarray:
+        if not digests:
+            return np.zeros(0, dtype=np.int64)
+        _ctype, payload = self._request(
+            "POST", f"/api/v1/dict/{namespace}/probe", b"".join(digests)
+        )
+        return np.frombuffer(payload, dtype="<i8")
+
+    def merge(self, bootstrap: bytes, namespace: str = DEFAULT_NAMESPACE) -> dict:
+        return json.loads(
+            self._request("POST", f"/api/v1/dict/{namespace}/merge", bootstrap)[1]
+        )
+
+    def entries(
+        self,
+        namespace: str = DEFAULT_NAMESPACE,
+        chunks: int = 0,
+        blobs: int = 0,
+        batches: int = 0,
+        ciphers: int = 0,
+    ) -> tuple[dict, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        _ctype, payload = self._request(
+            "GET",
+            f"/api/v1/dict/{namespace}/entries?chunks={chunks}&blobs={blobs}"
+            f"&batches={batches}&ciphers={ciphers}",
+        )
+        hdr = np.frombuffer(payload, dtype=np.uint64, count=_DELTA_HDR_FIELDS)
+        nc, nb, nt, ne = (int(x) for x in hdr[:4])
+        off = hdr.nbytes
+        ca = np.frombuffer(payload, dtype=_CHUNK_DT, count=nc, offset=off)
+        off += ca.nbytes
+        ba = np.frombuffer(payload, dtype=_BLOB_DT, count=nb, offset=off)
+        off += ba.nbytes
+        ta = np.frombuffer(payload, dtype=_BATCH_DT, count=nt, offset=off)
+        off += ta.nbytes
+        ea = np.frombuffer(payload, dtype=_CIPHER_DT, count=ne, offset=off)
+        meta = {
+            "epoch": int(hdr[4]),
+            "rebuild_epoch": int(hdr[5]),
+            "chunk_size": int(hdr[6]),
+        }
+        return meta, ca, ba, ta, ea
+
+    def save(self, path: str, namespace: str = DEFAULT_NAMESPACE) -> dict:
+        return json.loads(
+            self._request(
+                "POST",
+                f"/api/v1/dict/{namespace}/save",
+                json.dumps({"path": path}).encode(),
+            )[1]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Converter-facing proxy
+# ---------------------------------------------------------------------------
+
+
+class ServiceChunkDict:
+    """GrowingChunkDict-shaped view of one service namespace.
+
+    Pack/Merge probe the local mirror (``get``/``blob_id_for``/
+    ``.bootstrap``) exactly as they would a private dict — the dict is
+    read-only inside one image, so no RPC sits on the per-chunk path.
+    ``add_bootstrap*`` ships the merged image to the service and
+    ``sync()`` replays the append-only tail the mirror is missing, which
+    also picks up what OTHER converters merged in the meantime.
+    """
+
+    def __init__(
+        self,
+        client: DictClient,
+        namespace: str = DEFAULT_NAMESPACE,
+        sync_on_init: bool = True,
+    ):
+        from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
+
+        self.client = client
+        self.namespace = namespace
+        self.bootstrap = Bootstrap(inodes=[])
+        self._by_digest: dict[bytes, object] = {}
+        self.epoch = 0
+        if sync_on_init:
+            self.sync()
+
+    # -- probe interface (mirror-local) --------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.bootstrap.chunks)
+
+    def __contains__(self, digest: bytes) -> bool:
+        return digest in self._by_digest
+
+    def get(self, digest: bytes):
+        return self._by_digest.get(digest)
+
+    def blob_id_for(self, chunk) -> str:
+        return self.bootstrap.blobs[chunk.blob_index].blob_id
+
+    def digests_u32(self):
+        return self.bootstrap.chunk_digests_u32()
+
+    def blob_ids(self) -> list[str]:
+        return [b.blob_id for b in self.bootstrap.blobs]
+
+    # -- reconciliation ------------------------------------------------------
+
+    def sync(self) -> int:
+        """Replay the service tail into the mirror; returns how many chunk
+        records arrived."""
+        from nydus_snapshotter_tpu.models.bootstrap import (
+            BatchRecord,
+            BlobRecord,
+            ChunkRecord,
+            CipherRecord,
+        )
+
+        bs = self.bootstrap
+        meta, ca, ba, ta, ea = self.client.entries(
+            self.namespace,
+            chunks=len(bs.chunks),
+            blobs=len(bs.blobs),
+            batches=len(bs.batches),
+            ciphers=len(bs.ciphers),
+        )
+        if meta["chunk_size"]:
+            bs.chunk_size = meta["chunk_size"]
+        for row in ba:
+            bs.blobs.append(
+                BlobRecord(
+                    blob_id=row["blob_id"].decode(),
+                    compressed_size=int(row["csize"]),
+                    uncompressed_size=int(row["usize"]),
+                    chunk_count=int(row["chunk_count"]),
+                    flags=int(row["flags"]),
+                )
+            )
+        for row in ea:
+            algo = int(row["algo"])
+            bs.ciphers.append(
+                CipherRecord(
+                    algo=algo,
+                    key=row["key"].tobytes() if algo else b"",
+                    iv=row["iv"].tobytes() if algo else b"",
+                )
+            )
+        for row in ca:
+            rec = ChunkRecord(
+                digest=row["digest"].tobytes(),
+                blob_index=int(row["blob_index"]),
+                flags=int(row["flags"]),
+                uncompressed_offset=int(row["uoff"]),
+                compressed_offset=int(row["coff"]),
+                uncompressed_size=int(row["usize"]),
+                compressed_size=int(row["csize"]),
+            )
+            bs.chunks.append(rec)
+            self._by_digest.setdefault(rec.digest, rec)
+        for row in ta:
+            bs.batches.append(
+                BatchRecord(
+                    int(row["blob_index"]), int(row["coff"]),
+                    int(row["ubase"]), int(row["usize"]),
+                )
+            )
+        self.epoch = meta["epoch"]
+        return len(ca)
+
+    def add_bootstrap_bytes(self, data: bytes) -> int:
+        """Merge a converted image into the SERVICE dict, then pull the
+        resulting tail (including anything other converters added first)
+        into the mirror. Returns how many chunks this merge added."""
+        res = self.client.merge(data, self.namespace)
+        self.sync()
+        return int(res.get("added", 0))
+
+    def add_bootstrap(self, source) -> int:
+        return self.add_bootstrap_bytes(source.to_bytes())
+
+    def save(self, path: str) -> None:
+        """Service-side persistence: bootstrap interop file + epoch-stamped
+        probe index (see :meth:`ServiceDict.save`)."""
+        self.client.save(path, self.namespace)
+
+
+def open_chunk_dict(arg: str):
+    """Resolve a ``chunk_dict_path``-shaped argument: the
+    ``service://<uds-path>[#namespace]`` scheme connects a
+    :class:`ServiceChunkDict` mirror; anything else is the file-based
+    dict (``bootstrap=…`` prefixed or bare path, as before)."""
+    if arg.startswith("service://"):
+        rest = arg[len("service://"):]
+        sock, _, ns = rest.partition("#")
+        return ServiceChunkDict(DictClient(sock), ns or DEFAULT_NAMESPACE)
+    from nydus_snapshotter_tpu.models.bootstrap import ChunkDict, parse_chunk_dict_arg
+
+    return ChunkDict.from_path(parse_chunk_dict_arg(arg))

@@ -7,6 +7,9 @@ Reference pkg/system/system.go:36-446. Endpoints:
     PUT  /api/v1/daemons/upgrade       — rolling live-upgrade {nydusd_path, version, policy}
     PUT  /api/v1/prefetch              — prefetch list from the NRI plugin
     GET  /api/v1/daemons/{id}/backend  — secret-filtered storage backend config
+    */*  /api/v1/dict/...               — shared chunk-dict service routes
+                                          (parallel/dict_service.py), when a
+                                          DictService is attached
 """
 
 from __future__ import annotations
@@ -41,10 +44,16 @@ class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
 
 
 class SystemController:
-    def __init__(self, fs=None, managers: Iterable = (), sock_path: str = ""):
+    def __init__(
+        self, fs=None, managers: Iterable = (), sock_path: str = "", dict_service=None
+    ):
         self.fs = fs
         self.managers = list(managers)
         self.sock_path = sock_path
+        # Optional parallel/dict_service.DictService: its /api/v1/dict
+        # routes are served on this controller's socket too, so one UDS
+        # carries both the ops surface and the shared-dict RPCs.
+        self.dict_service = dict_service
         self._httpd: Optional[_UnixHTTPServer] = None
 
     # -- handlers -------------------------------------------------------------
@@ -147,8 +156,23 @@ class SystemController:
             def _error(self, message: str, status: int):
                 self._json({"code": "Unknown", "message": message}, status)
 
+            def _dict_route(self, body: bytes) -> bool:
+                if not self.path.startswith("/api/v1/dict") or controller.dict_service is None:
+                    return False
+                status, ctype, payload = controller.dict_service.handle(
+                    self.command, self.path, self.headers, body
+                )
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                return True
+
             def do_GET(self):
                 try:
+                    if self._dict_route(b""):
+                        return
                     if self.path == "/api/v1/daemons":
                         self._json(controller.describe_daemons())
                         return
@@ -173,6 +197,17 @@ class SystemController:
                     self._error("no such endpoint", 404)
                 except Exception as e:
                     logger.exception("system controller GET %s", self.path)
+                    self._error(str(e), 500)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    if self._dict_route(body):
+                        return
+                    self._error("no such endpoint", 404)
+                except Exception as e:
+                    logger.exception("system controller POST %s", self.path)
                     self._error(str(e), 500)
 
             def do_PUT(self):

@@ -76,6 +76,7 @@ __all__ = [
     "dump_text",
     "enabled",
     "exemplars",
+    "remote_context",
     "reset",
     "resolve_trace_config",
     "slow_ops",
@@ -449,6 +450,17 @@ def with_context(ctx: Optional[SpanContext]) -> Iterator[None]:
         yield
     finally:
         _current.reset(token)
+
+
+def remote_context(trace_id: int, span_id: int) -> Optional[SpanContext]:
+    """Reconstruct a propagated context from wire-carried ids (the dict
+    service's RPC headers): spans opened under ``with_context(...)`` on
+    the serving side join the caller's trace across the socket boundary,
+    so one ``convert``-rooted tree spans the service RPC. Zero/absent ids
+    (caller untraced) yield None, which :func:`with_context` no-ops."""
+    if not trace_id or not span_id:
+        return None
+    return SpanContext(int(trace_id), int(span_id), True, None)
 
 
 def annotate(**attrs) -> None:

@@ -420,3 +420,94 @@ class TestLoopResilience:
         assert set(d._meta_binds) == {"fsid-b"}
         # fsid-b still resolvable as a meta cookie path
         assert d._meta_binds["fsid-b"] == str(boot)
+
+
+class TestKernelUapiWireFormat:
+    """Byte-for-byte validation of the daemon's wire structs against
+    C-packed ctypes mirrors of the kernel uapi definitions
+    (include/uapi/linux/cachefiles.h). The kernel lays these out with
+    natural alignment; every field is u32/u64 so the packed mirror and
+    the aligned struct coincide — the checks below prove the daemon's
+    little-endian struct.Struct codecs match the C layout exactly, so a
+    drift in either side (or a future field addition) fails CI instead
+    of corrupting the ondemand handshake on a real kernel."""
+
+    def _mirrors(self):
+        import ctypes
+
+        class CachefilesMsg(ctypes.LittleEndianStructure):
+            _pack_ = 1
+            _fields_ = [
+                ("msg_id", ctypes.c_uint32),
+                ("object_id", ctypes.c_uint32),
+                ("opcode", ctypes.c_uint32),
+                ("len", ctypes.c_uint32),
+            ]
+
+        class CachefilesOpen(ctypes.LittleEndianStructure):
+            _pack_ = 1
+            _fields_ = [
+                ("volume_key_size", ctypes.c_uint32),
+                ("cookie_key_size", ctypes.c_uint32),
+                ("fd", ctypes.c_uint32),
+                ("flags", ctypes.c_uint32),
+            ]
+
+        class CachefilesRead(ctypes.LittleEndianStructure):
+            _pack_ = 1
+            _fields_ = [
+                ("off", ctypes.c_uint64),
+                ("len", ctypes.c_uint64),
+            ]
+
+        return CachefilesMsg, CachefilesOpen, CachefilesRead
+
+    def test_struct_sizes_match_uapi(self):
+        msg, opn, read = self._mirrors()
+        import ctypes
+
+        assert ctypes.sizeof(msg) == cf._MSG_HDR.size == 16
+        assert ctypes.sizeof(opn) == cf._OPEN_HDR.size == 16
+        assert ctypes.sizeof(read) == cf._READ_REQ.size == 16
+        # natural alignment adds no padding: the aligned (non-packed)
+        # layout must coincide with the packed mirror, or the daemon's
+        # flat little-endian codec would misread a real kernel message
+        import ctypes as c
+
+        class _AlignedMsg(c.LittleEndianStructure):
+            _fields_ = msg._fields_
+
+        class _AlignedRead(c.LittleEndianStructure):
+            _fields_ = read._fields_
+
+        assert c.sizeof(_AlignedMsg) == c.sizeof(msg)
+        assert c.sizeof(_AlignedRead) == c.sizeof(read)
+
+    def test_msg_header_bytes_identical(self):
+        msg_cls, _opn, _read = self._mirrors()
+        m = msg_cls(msg_id=7, object_id=42, opcode=cf.OP_READ, len=32)
+        assert bytes(m) == cf._MSG_HDR.pack(7, 42, cf.OP_READ, 32)
+        # and the daemon's decoder reads the ctypes bytes back exactly
+        assert cf._MSG_HDR.unpack(bytes(m)) == (7, 42, cf.OP_READ, 32)
+
+    def test_open_payload_bytes_identical(self):
+        _msg, opn_cls, _read = self._mirrors()
+        o = opn_cls(volume_key_size=9, cookie_key_size=12, fd=5, flags=0)
+        keys = b"erofs,doma\x00blob-cookie\x00"
+        wire = bytes(o) + keys
+        assert wire[: cf._OPEN_HDR.size] == cf._OPEN_HDR.pack(9, 12, 5, 0)
+        vks, cks, fd, flags = cf._OPEN_HDR.unpack_from(wire)
+        assert (vks, cks, fd, flags) == (9, 12, 5, 0)
+
+    def test_read_payload_bytes_identical(self):
+        _msg, _opn, read_cls = self._mirrors()
+        r = read_cls(off=1 << 40, len=0x100000)
+        assert bytes(r) == cf._READ_REQ.pack(1 << 40, 0x100000)
+        assert cf._READ_REQ.unpack(bytes(r)) == (1 << 40, 0x100000)
+
+    def test_read_complete_ioctl_number(self):
+        # _IOW(0x98, 1, int) recomputed from the uapi encoding macros
+        ioc_write = 1
+        nr, ioc_type, size = 1, 0x98, 4  # sizeof(int)
+        expect = (ioc_write << 30) | (size << 16) | (ioc_type << 8) | nr
+        assert cf.CACHEFILES_IOC_READ_COMPLETE == expect

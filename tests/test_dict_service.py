@@ -1,0 +1,339 @@
+"""Shared chunk-dict service: RPC round trips, converter byte-identity,
+cross-converter dedup, namespaces, trace propagation and chaos."""
+
+import io
+import json
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu import failpoint, trace
+from nydus_snapshotter_tpu.converter.batch import BatchConverter
+from nydus_snapshotter_tpu.converter.types import ConvertError, PackOption
+from nydus_snapshotter_tpu.parallel.dict_service import (
+    DictClient,
+    DictService,
+    DictServiceError,
+    ServiceChunkDict,
+    ServiceDict,
+    open_chunk_dict,
+    resolve_dict_config,
+)
+
+RNG = np.random.default_rng(17)
+POOL = [
+    RNG.integers(0, 256, int(RNG.integers(4_000, 80_000)), dtype=np.uint8).tobytes()
+    for _ in range(24)
+]
+
+
+def mk_image(seed: int, layers: int = 2, files: int = 6) -> list[bytes]:
+    r = np.random.default_rng(seed)
+    out = []
+    for _li in range(layers):
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+            for fi in range(files):
+                data = POOL[int(r.integers(0, len(POOL)))]
+                ti = tarfile.TarInfo(f"d/f{seed}_{fi}")
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        out.append(buf.getvalue())
+    return out
+
+
+OPT = PackOption(chunk_size=0x10000, chunking="cdc")
+
+
+@pytest.fixture()
+def service(tmp_path):
+    svc = DictService()
+    svc.run(str(tmp_path / "dict.sock"))
+    yield svc
+    svc.stop()
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoint.clear()
+    yield
+    failpoint.clear()
+
+
+class TestServiceRPC:
+    def test_probe_merge_stats_roundtrip(self, service):
+        cli = DictClient(service.sock_path)
+        bc = BatchConverter(OPT)
+        res = bc.convert_image("img", mk_image(1))
+        out = cli.merge(res.bootstrap, "ns1")
+        assert out["added"] > 0
+        assert out["epoch"] == 1
+        st = cli.stats("ns1")
+        assert st["chunks"] == out["chunks"] == len(bc.dict)
+        digs = [c.digest for c in bc.dict.bootstrap.chunks]
+        ans = cli.probe(digs, "ns1")
+        assert np.array_equal(ans, np.arange(len(digs)))
+        miss = [bytes(RNG.integers(0, 256, 32, dtype=np.uint8)) for _ in range(5)]
+        assert (cli.probe(miss, "ns1") == -1).all()
+
+    def test_merge_is_idempotent_per_digest(self, service):
+        cli = DictClient(service.sock_path)
+        bc = BatchConverter(OPT)
+        res = bc.convert_image("img", mk_image(2))
+        first = cli.merge(res.bootstrap, "ns")
+        again = cli.merge(res.bootstrap, "ns")
+        assert again["added"] == 0
+        assert again["chunks"] == first["chunks"]
+        assert again["epoch"] == first["epoch"]  # no-op merges bump nothing
+
+    def test_namespaces_are_isolated(self, service):
+        cli = DictClient(service.sock_path)
+        bc = BatchConverter(OPT)
+        res = bc.convert_image("img", mk_image(3))
+        cli.merge(res.bootstrap, "a")
+        digs = [c.digest for c in bc.dict.bootstrap.chunks[:4]]
+        assert (cli.probe(digs, "a") >= 0).all()
+        assert (cli.probe(digs, "b") == -1).all()
+        names = {d["namespace"] for d in cli.namespaces()}
+        assert {"a", "b"} <= names
+
+    def test_invalid_namespace_rejected(self, service):
+        cli = DictClient(service.sock_path)
+        # ".." has no route match (404); an in-charset-but-invalid name
+        # like a leading dot is caught by the namespace check (400).
+        with pytest.raises(DictServiceError, match="404"):
+            cli.stats("../escape")
+        with pytest.raises(DictServiceError, match="400|invalid"):
+            cli.stats(".hidden")
+
+    def test_probe_body_must_be_digest_multiple(self, service):
+        cli = DictClient(service.sock_path)
+        with pytest.raises(DictServiceError, match="multiple of 32"):
+            cli._request("POST", "/api/v1/dict/ns/probe", b"short")
+
+    def test_save_writes_bootstrap_and_index(self, service, tmp_path):
+        from nydus_snapshotter_tpu.models.bootstrap import ChunkDict
+        from nydus_snapshotter_tpu.parallel.sharded_dict import ShardedChunkDict
+
+        cli = DictClient(service.sock_path)
+        bc = BatchConverter(OPT)
+        res = bc.convert_image("img", mk_image(4))
+        cli.merge(res.bootstrap, "ns")
+        path = str(tmp_path / "dict.boot")
+        out = cli.save(path, "ns")
+        assert out["index_save"]["mode"] in ("append", "full")
+        cd = ChunkDict.from_path(path)
+        assert len(cd) == cli.stats("ns")["chunks"]
+        idx = ShardedChunkDict.load(path + ".idx", probe_backend="host")
+        digs = [c.digest for c in cd.bootstrap.chunks]
+        assert np.array_equal(idx.lookup_digests(digs), np.arange(len(digs)))
+
+    def test_rpc_chaos_surfaces_and_service_survives(self, service):
+        cli = DictClient(service.sock_path)
+        failpoint.inject("dict.rpc", "error(OSError:chaos)*1")
+        with pytest.raises(DictServiceError, match="chaos"):
+            cli.stats("ns")
+        # the fault was one-shot; the service keeps serving
+        assert cli.stats("ns")["chunks"] == 0
+
+
+class TestServiceChunkDictMirror:
+    def test_mirror_replays_service_tail(self, service):
+        cli = DictClient(service.sock_path)
+        bc = BatchConverter(OPT)
+        res = bc.convert_image("img", mk_image(5))
+        cli.merge(res.bootstrap, "ns")
+        mirror = ServiceChunkDict(DictClient(service.sock_path), "ns")
+        assert len(mirror) == len(bc.dict)
+        for c in bc.dict.bootstrap.chunks:
+            hit = mirror.get(c.digest)
+            assert hit is not None
+            assert mirror.blob_id_for(hit) == bc.dict.blob_id_for(
+                bc.dict.get(c.digest)
+            )
+        # incremental: a second image lands server-side, sync picks it up
+        res2 = bc.convert_image("img2", mk_image(6))
+        cli.merge(res2.bootstrap, "ns")
+        got = mirror.sync()
+        assert got > 0
+        assert len(mirror) == len(bc.dict)
+
+    def test_two_converters_share_one_table(self, service):
+        """Converter B dedups against chunks converter A merged — the
+        registry-wide sharing the per-process dict can never give."""
+        a = BatchConverter(OPT, dict_service=service.sock_path, namespace="shared")
+        b = BatchConverter(OPT, dict_service=service.sock_path, namespace="shared")
+        res_a = a.convert_image("a", mk_image(7))
+        assert res_a.new_dict_chunks > 0
+        b.dict.sync()
+        res_b = b.convert_image("b", mk_image(7, files=6))  # same content pool
+        # image b's chunks were already in the shared dict via a
+        assert res_b.new_dict_chunks < res_a.new_dict_chunks
+        assert len(a.dict) <= len(b.dict)
+
+
+class TestBatchByteIdentity:
+    def test_service_path_identical_to_private_dict_path(self, service):
+        images = [(f"img{k}", mk_image(100 + k)) for k in range(6)]
+        bc_local = BatchConverter(OPT)
+        r_local = bc_local.convert_many(images)
+        bc_svc = BatchConverter(OPT, dict_service=service.sock_path, namespace="bi")
+        r_svc = bc_svc.convert_many(images)
+        assert [r.bootstrap for r in r_local] == [r.bootstrap for r in r_svc]
+        assert [r.blob_digests for r in r_local] == [r.blob_digests for r in r_svc]
+        assert [r.new_dict_chunks for r in r_local] == [
+            r.new_dict_chunks for r in r_svc
+        ]
+        assert len(bc_local.dict) == len(bc_svc.dict)
+        # cross-image dedup really engaged
+        assert any(r.new_dict_chunks == 0 or len(r.blob_digests) > 1 for r in r_svc[1:])
+
+    def test_dict_path_plus_service_rejected(self, service, tmp_path):
+        seed = str(tmp_path / "seed.boot")
+        BatchConverter(OPT).save_dict(seed)
+        with pytest.raises(ConvertError, match="service"):
+            BatchConverter(OPT, dict_path=seed, dict_service=service.sock_path)
+
+
+class TestTracePropagation:
+    def test_convert_root_spans_the_rpc(self, service):
+        bc = BatchConverter(OPT, dict_service=service.sock_path, namespace="tr")
+        trace.reset()
+        bc.convert_image("img", mk_image(9))
+        spans = trace.snapshot_spans()
+        root = next(s for s in spans if not s.parent_id and s.name == "convert")
+        rpc = [s for s in spans if s.name.startswith("dict.rpc.")]
+        assert rpc, "no service-side spans recorded"
+        assert all(s.trace_id == root.trace_id for s in rpc)
+        ops = {s.name for s in rpc}
+        assert "dict.rpc.merge" in ops
+
+    def test_untraced_caller_is_fine(self, service):
+        cli = DictClient(service.sock_path)
+        assert cli.stats("x")["chunks"] == 0  # no active span: headers absent
+
+
+class TestSystemControllerMount:
+    def test_dict_routes_on_system_socket(self, tmp_path):
+        from nydus_snapshotter_tpu.system import SystemController
+
+        svc = DictService()
+        sock = str(tmp_path / "system.sock")
+        ctl = SystemController(sock_path=sock, dict_service=svc)
+        ctl.run()
+        try:
+            cli = DictClient(sock)
+            bc = BatchConverter(OPT)
+            res = bc.convert_image("img", mk_image(11))
+            out = cli.merge(res.bootstrap, "sys")
+            assert out["added"] > 0
+            st = cli.stats("sys")
+            assert st["chunks"] == out["chunks"]
+            # the ops routes still answer on the same socket
+            _ctype, payload = cli._request("GET", "/api/v1/daemons")
+            assert json.loads(payload) == []
+        finally:
+            ctl.stop()
+
+    def test_without_dict_service_routes_404(self, tmp_path):
+        from nydus_snapshotter_tpu.system import SystemController
+
+        sock = str(tmp_path / "system.sock")
+        ctl = SystemController(sock_path=sock)
+        ctl.run()
+        try:
+            cli = DictClient(sock)
+            with pytest.raises(DictServiceError, match="404"):
+                cli.stats("ns")
+        finally:
+            ctl.stop()
+
+
+class TestConfigResolution:
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("NTPU_DICT_LOAD_FACTOR", "0.5")
+        monkeypatch.setenv("NTPU_DICT_HEADROOM", "4.0")
+        monkeypatch.setenv("NTPU_DICT_SERVICE", "/tmp/x.sock")
+        monkeypatch.setenv("NTPU_DICT_NAMESPACE", "team-a")
+        cfg = resolve_dict_config()
+        assert cfg.load_factor == 0.5
+        assert cfg.headroom == 4.0
+        assert cfg.service == "/tmp/x.sock"
+        assert cfg.namespace == "team-a"
+
+    def test_config_section_validation(self):
+        from nydus_snapshotter_tpu.config.config import ConfigError, load_config
+
+        with pytest.raises(ConfigError, match="load_factor"):
+            load_config(overrides={"chunk_dict": {"load_factor": 1.5}})
+        with pytest.raises(ConfigError, match="headroom"):
+            load_config(overrides={"chunk_dict": {"headroom": 0.5}})
+        cfg = load_config(
+            overrides={"chunk_dict": {"service": "/run/dict.sock", "headroom": 3.0}}
+        )
+        assert cfg.chunk_dict.service == "/run/dict.sock"
+
+    def test_service_dict_honors_headroom(self):
+        from nydus_snapshotter_tpu.parallel.dict_service import DictRuntimeConfig
+
+        sd = ServiceDict(
+            "ns", DictRuntimeConfig(0.7, 3.0, "", "ns", "host")
+        )
+        assert sd.index.load_factor == 0.7
+        assert sd.index.capacity_factor == 3.0
+
+
+class TestOpenChunkDict:
+    def test_service_scheme_connects_mirror(self, service):
+        cli = DictClient(service.sock_path)
+        bc = BatchConverter(OPT)
+        res = bc.convert_image("img", mk_image(13))
+        cli.merge(res.bootstrap, "pth")
+        cd = open_chunk_dict(f"service://{service.sock_path}#pth")
+        assert isinstance(cd, ServiceChunkDict)
+        assert len(cd) == len(bc.dict)
+
+    def test_pack_dedups_through_service_scheme(self, service):
+        """opt.chunk_dict_path = service://… routes a plain Pack through
+        the shared table: a layer of already-known content produces real
+        foreign-blob references."""
+        from nydus_snapshotter_tpu.converter.convert import (
+            Pack,
+            bootstrap_from_layer_blob,
+        )
+
+        cli = DictClient(service.sock_path)
+        bc = BatchConverter(OPT)
+        layers = mk_image(15)
+        res = bc.convert_image("img", layers)
+        cli.merge(res.bootstrap, "pk")
+        opt = PackOption(
+            chunk_size=0x10000,
+            chunking="cdc",
+            chunk_dict_path=f"service://{service.sock_path}#pk",
+        )
+        out = io.BytesIO()
+        # layers[1] is the overlay winner (both layers share file names),
+        # so its per-file chunks are exactly what the merged image — and
+        # therefore the service dict — holds.
+        pres = Pack(out, layers[1], opt)
+        bs = bootstrap_from_layer_blob(out.getvalue())
+        foreign = {
+            bs.blobs[c.blob_index].blob_id
+            for c in bs.chunks
+            if bs.blobs[c.blob_index].blob_id != pres.blob_id
+        }
+        assert foreign, "no dedup hits through the service-backed dict"
+
+    def test_file_path_still_loads(self, tmp_path):
+        from nydus_snapshotter_tpu.models.bootstrap import ChunkDict
+
+        bc = BatchConverter(OPT)
+        bc.convert_image("img", mk_image(16))
+        p = str(tmp_path / "d.boot")
+        bc.save_dict(p)
+        cd = open_chunk_dict(p)
+        assert isinstance(cd, ChunkDict)
+        assert len(cd) == len(bc.dict)

@@ -193,6 +193,16 @@ share = os.environ["SHARE_DIR"]  # the storage boundary (registry stand-in)
 opt = PackOption(chunk_size=0x10000)
 
 
+def _result(payload):
+    # Per-worker result FILE, written atomically: stdout of a multihost
+    # child interleaves worker prints with jax/absl logging, and scraping
+    # it flaked (VERDICT r5 #7). The parent reads RESULT_PATH instead.
+    path = os.environ["RESULT_PATH"]
+    with open(path + ".tmp", "w") as f:
+        json.dump(payload, f)
+    os.rename(path + ".tmp", path)
+
+
 def image_tar(seed, pool):
     rng = np.random.default_rng(seed)
     buf = io.BytesIO()
@@ -218,8 +228,8 @@ if rt.index == 0:
         f.write(merged.bootstrap)
     os.rename(os.path.join(share, "dict.boot.tmp"), os.path.join(share, "dict.boot"))
     rt.barrier("dict-published")
-    print("RESULT " + json.dumps({"index": 0, "dict_chunks": len(
-        ChunkDict(Bootstrap.from_bytes(merged.bootstrap)))}))
+    _result({"index": 0, "dict_chunks": len(
+        ChunkDict(Bootstrap.from_bytes(merged.bootstrap)))})
 else:
     rt.barrier("dict-published")  # wait for host 0's artifact
     cdict = ChunkDict.from_path(os.path.join(share, "dict.boot"))
@@ -232,11 +242,11 @@ else:
         if bs.blobs[c.blob_index].blob_id != res.blob_id
     )
     total = sum(c.uncompressed_size for c in bs.chunks)
-    print("RESULT " + json.dumps({
+    _result({
         "index": 1, "dedup_bytes": foreign, "total_bytes": total,
         "referenced": sorted({bs.blobs[c.blob_index].blob_id for c in bs.chunks}),
         "own": res.blob_id,
-    }))
+    })
 """
 
 
@@ -258,9 +268,16 @@ def test_cross_host_chunk_dict_over_storage_boundary(tmp_path):
         "SHARE_DIR": share,
     }
     procs = []
+    result_paths = []
     for idx in range(2):
         env = dict(env_base)
         env["PID_IDX"] = str(idx)
+        # Per-worker result file, not stdout scraping: multihost children
+        # interleave prints with jax/absl logging on the same fd, and the
+        # RESULT line intermittently arrived torn (VERDICT r5 #7).
+        result_path = str(tmp_path / f"result{idx}.json")
+        env["RESULT_PATH"] = result_path
+        result_paths.append(result_path)
         procs.append(
             subprocess.Popen(
                 [sys.executable, "-c", _DICT_CHILD],
@@ -272,11 +289,11 @@ def test_cross_host_chunk_dict_over_storage_boundary(tmp_path):
             )
         )
     results = {}
-    for p in procs:
+    for p, result_path in zip(procs, result_paths):
         out, err = p.communicate(timeout=240)
         assert p.returncode == 0, (out[-500:], err[-2000:])
-        line = next(l for l in out.splitlines() if l.startswith("RESULT "))
-        r = json.loads(line[len("RESULT ") :])
+        with open(result_path) as f:
+            r = json.load(f)
         results[r["index"]] = r
     assert results[0]["dict_chunks"] > 0
     r1 = results[1]

@@ -1,0 +1,212 @@
+"""Chunk-dict growth + service smoke profile (CI `dict-smoke`, bench
+`detail.chunk_dict`).
+
+A scaled-down version of tools/registry_scale.py's growth evidence that
+runs in seconds: build a base dict, grow it incrementally, and gate
+
+- determinism/identity: probes byte-identical to a fresh full build over
+  the concatenated sequence, old indices stable, reload after an
+  incremental (append-only) save probe-identical;
+- cost: incremental growth beats the rebuild arm by `--min-speedup`
+  (paired best-rep ratio — both arms timed in this run, min over reps)
+  AND stays insert-proportional per the analytic per-entry bound;
+- service: a DictService round trip (merge + probe + mirror sync) over a
+  real UDS yields batch-convert output byte-identical to the private
+  per-process dict path.
+
+Exits nonzero on any gate failure; prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def growth_profile(entries: int, grow: int, reps: int = 3) -> dict:
+    from nydus_snapshotter_tpu.parallel import mesh as mesh_lib
+    from nydus_snapshotter_tpu.parallel.sharded_dict import ShardedChunkDict
+
+    rng = np.random.default_rng(11)
+    mesh = mesh_lib.make_mesh(1)
+    digests = rng.integers(0, 2**32, (entries, 8), dtype=np.uint32)
+    batch = rng.integers(0, 2**32, (grow, 8), dtype=np.uint32)
+    sd = ShardedChunkDict(digests, mesh, probe_backend="host")
+
+    # Rebuild arm: fresh build over the concatenated sequence, best-of-reps.
+    t_rebuild = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sd_rebuilt = ShardedChunkDict(
+            np.concatenate([digests, batch]), mesh, probe_backend="host"
+        )
+        t_rebuild = min(t_rebuild, time.perf_counter() - t0)
+
+    # Incremental arm: paired reps on deep copies of the same base table.
+    t_inc = float("inf")
+    for _ in range(reps):
+        trial = sd.copy()
+        t0 = time.perf_counter()
+        trial.insert_u32(batch)
+        t_inc = min(t_inc, time.perf_counter() - t0)
+    sd.insert_u32(batch)  # the instance the identity gates run against
+
+    q = np.concatenate(
+        [digests[::7], batch[::5], rng.integers(0, 2**32, (5000, 8), dtype=np.uint32)]
+    )
+    probe_identical = bool(np.array_equal(sd.lookup_u32(q), sd_rebuilt.lookup_u32(q)))
+    old_stable = bool(
+        np.array_equal(sd.lookup_u32(digests[::11]), np.arange(entries)[::11])
+    )
+
+    # Reload-after-incremental-save: base snapshot + appended tail.
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "dict.bin")
+        pre = ShardedChunkDict(digests, mesh, probe_backend="host")
+        pre.save(path)
+        pre.insert_u32(batch)
+        save_res = pre.save_incremental(path)
+        reloaded = ShardedChunkDict.load(path, mesh, probe_backend="host")
+        reload_identical = bool(
+            np.array_equal(reloaded.lookup_u32(q), sd.lookup_u32(q))
+        )
+
+    # Analytic insert-proportional bound: per-entry incremental cost must
+    # not exceed the rebuild's per-TABLE-entry cost — an O(table) insert
+    # (the bug this gate exists to catch) would cost ~the rebuild itself.
+    per_entry_inc_us = t_inc / grow * 1e6
+    per_entry_rebuild_us = t_rebuild / (entries + grow) * 1e6
+    return {
+        "entries": entries,
+        "grow_entries": grow,
+        "rebuild_s": round(t_rebuild, 3),
+        "incremental_s": round(t_inc, 4),
+        "speedup_x": round(t_rebuild / t_inc, 1),
+        "per_entry_inc_us": round(per_entry_inc_us, 3),
+        "per_entry_rebuild_us": round(per_entry_rebuild_us, 3),
+        "save_mode": save_res["mode"],
+        "probe_identical_to_fresh_build": probe_identical,
+        "grown_old_indices_stable": old_stable,
+        "reload_after_incremental_save_identical": reload_identical,
+        "epoch": sd.epoch,
+    }
+
+
+def service_profile(images: int = 6) -> dict:
+    import io
+    import tarfile
+
+    from nydus_snapshotter_tpu.converter.batch import BatchConverter
+    from nydus_snapshotter_tpu.converter.types import PackOption
+    from nydus_snapshotter_tpu.parallel.dict_service import DictClient, DictService
+
+    rng = np.random.default_rng(23)
+    pool = [
+        rng.integers(0, 256, int(rng.integers(4_000, 120_000)), dtype=np.uint8).tobytes()
+        for _ in range(32)
+    ]
+
+    def mk_image(seed: int) -> list[bytes]:
+        r = np.random.default_rng(seed)
+        layers = []
+        for _li in range(2):
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+                for fi in range(8):
+                    data = pool[int(r.integers(0, len(pool)))]
+                    ti = tarfile.TarInfo(f"d/f{seed}_{fi}")
+                    ti.size = len(data)
+                    tf.addfile(ti, io.BytesIO(data))
+            layers.append(buf.getvalue())
+        return layers
+
+    corpus = [(f"img{k}", mk_image(500 + k)) for k in range(images)]
+    opt = PackOption(chunk_size=0x10000, chunking="cdc")
+    local = BatchConverter(opt)
+    t0 = time.perf_counter()
+    r_local = local.convert_many(corpus)
+    t_local = time.perf_counter() - t0
+
+    with tempfile.TemporaryDirectory() as td:
+        svc = DictService()
+        svc.run(os.path.join(td, "dict.sock"))
+        try:
+            via = BatchConverter(opt, dict_service=svc.sock_path, namespace="smoke")
+            t0 = time.perf_counter()
+            r_svc = via.convert_many(corpus)
+            t_svc = time.perf_counter() - t0
+            cli = DictClient(svc.sock_path)
+            stats = cli.stats("smoke")
+            digs = [c.digest for c in via.dict.bootstrap.chunks[:64]]
+            probe_ok = bool(
+                np.array_equal(cli.probe(digs, "smoke"), np.arange(len(digs)))
+            )
+            cli.close()
+            via.dict.client.close()
+        finally:
+            svc.stop()
+    return {
+        "images": images,
+        "bootstraps_identical": [r.bootstrap for r in r_local]
+        == [r.bootstrap for r in r_svc],
+        "blob_digest_lists_identical": [r.blob_digests for r in r_local]
+        == [r.blob_digests for r in r_svc],
+        "cross_image_dedup": any(r.new_dict_chunks == 0 for r in r_svc[1:])
+        or len({d for r in r_svc for d in r.blob_digests})
+        < sum(len(r.blob_digests) for r in r_svc),
+        "dict_chunks": stats["chunks"],
+        "service_epoch": stats["epoch"],
+        "probe_rpc_exact": probe_ok,
+        "convert_s_private": round(t_local, 2),
+        "convert_s_service": round(t_svc, 2),
+    }
+
+
+def profile(entries_m: float = 2.0, grow_k: int = 200, min_speedup: float = 5.0) -> dict:
+    g = growth_profile(int(entries_m * 1_000_000), grow_k * 1000)
+    s = service_profile()
+    gates = {
+        "probe_identical_to_fresh_build": g["probe_identical_to_fresh_build"],
+        "grown_old_indices_stable": g["grown_old_indices_stable"],
+        "reload_after_incremental_save_identical": g[
+            "reload_after_incremental_save_identical"
+        ],
+        "incremental_append_save": g["save_mode"] == "append",
+        "speedup": g["speedup_x"] >= min_speedup,
+        "insert_proportional": g["per_entry_inc_us"]
+        <= 4.0 * g["per_entry_rebuild_us"],
+        "service_bootstraps_identical": s["bootstraps_identical"],
+        "service_blob_digests_identical": s["blob_digest_lists_identical"],
+        "service_cross_image_dedup": s["cross_image_dedup"],
+        "service_probe_rpc_exact": s["probe_rpc_exact"],
+    }
+    return {"growth": g, "service": s, "gates": gates, "ok": all(gates.values())}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--entries-m", type=float, default=2.0)
+    ap.add_argument("--grow-k", type=int, default=200)
+    ap.add_argument("--min-speedup", type=float, default=5.0)
+    args = ap.parse_args()
+    out = profile(args.entries_m, args.grow_k, args.min_speedup)
+    print(json.dumps(out))
+    if not out["ok"]:
+        raise SystemExit(f"chunk-dict gates failed: {out['gates']}")
+
+
+if __name__ == "__main__":
+    main()

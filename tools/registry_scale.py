@@ -84,18 +84,91 @@ def host_phase(entries_m: int, tmpdir: str) -> dict:
         t_load = min(t_load, time.perf_counter() - t0)
     reload_identical = bool(np.array_equal(sd2.lookup_u32(queries), r1))
 
-    # Incremental growth: append 2M new entries; old indices must be
-    # stable (first-wins insertion order is the merge-output order).
+    # Growth, both arms. REBUILD arm (the pre-PR-6 cost): a fresh full
+    # build over the concatenated sequence — the 67.8s that is fatal at
+    # registry scale.
     grow = rng.integers(0, 2**32, (2_000_000, 8), dtype=np.uint32)
-    t0 = time.perf_counter()
-    sd3 = ShardedChunkDict(np.concatenate([digests, grow]), mesh, probe_backend="host")
-    t_grow = time.perf_counter() - t0
+    t_grow_reps = []
+    for _rep in range(2):  # paired best-rep: both growth arms take the min
+        t0 = time.perf_counter()
+        sd3 = ShardedChunkDict(
+            np.concatenate([digests, grow]), mesh, probe_backend="host"
+        )
+        t_grow_reps.append(time.perf_counter() - t0)
+    t_grow = min(t_grow_reps)
     grown_old_stable = bool(np.array_equal(sd3.lookup_u32(queries), r1))
     grown_new_found = bool(
         np.array_equal(
             sd3.lookup_u32(grow[:1000]), np.arange(n, n + 1000, dtype=np.int64)
         )
     )
+
+    # INCREMENTAL arm: insert the same 2M entries into sd's spare
+    # capacity. Gating discipline for this ~2x-wall-noise box: best-of-3
+    # paired reps (three successive fresh 2M batches into the same table
+    # — later reps insert into a strictly FULLER table, so the min is
+    # conservative) plus an analytic insert-proportional bound calibrated
+    # on a small table (see below); identity gates are exact.
+    grow_q = np.concatenate([grow[::41], rng.integers(0, 2**32, (50_000, 8), dtype=np.uint32)])
+    t0 = time.perf_counter()
+    inc_idx = sd.insert_u32(grow)
+    t_inc_reps = [time.perf_counter() - t0]
+    # Identity gates against the rebuild arm, byte-for-byte.
+    inc_old_stable = bool(np.array_equal(sd.lookup_u32(queries), r1))
+    inc_probe_identical = bool(
+        np.array_equal(sd.lookup_u32(grow_q), sd3.lookup_u32(grow_q))
+    )
+    inc_indices_match_rebuild = bool(np.array_equal(inc_idx, sd3.lookup_u32(grow)))
+    del sd3  # return the rebuild arm's ~2.4 GiB before the reload gate
+
+    # Reload-after-incremental-save: append only the inserted tail to the
+    # pre-growth snapshot, reload, probe-identical to the live dict.
+    t0 = time.perf_counter()
+    inc_save = sd.save_incremental(path)
+    t_inc_save = time.perf_counter() - t0
+    sd4 = ShardedChunkDict.load(path, mesh, probe_backend="host")
+    inc_reload_identical = bool(
+        np.array_equal(sd4.lookup_u32(grow_q), sd.lookup_u32(grow_q))
+        and np.array_equal(sd4.lookup_u32(queries), r1)
+    )
+    del sd4
+
+    for _rep in range(2):  # best-of-3: two more fresh 2M batches
+        more = rng.integers(0, 2**32, (2_000_000, 8), dtype=np.uint32)
+        t0 = time.perf_counter()
+        sd.insert_u32(more)
+        t_inc_reps.append(time.perf_counter() - t0)
+    t_inc = min(t_inc_reps)
+
+    # Analytic insert-proportional bound: calibrate per-entry insert cost
+    # on a 2M-entry table (16x smaller); if incremental cost is O(batch)
+    # — not O(table) — the 32M-table per-entry cost stays within wall
+    # noise of the model. 4x = the paired ~2x noise on both sides.
+    small = ShardedChunkDict(digests[:2_000_000], mesh, probe_backend="host")
+    small_batch = rng.integers(0, 2**32, (200_000, 8), dtype=np.uint32)
+    t_small = float("inf")
+    for _rep in range(3):
+        probe_copy = small.copy()
+        t0 = time.perf_counter()
+        probe_copy.insert_u32(small_batch)
+        t_small = min(t_small, time.perf_counter() - t0)
+    per_entry_small_us = t_small / len(small_batch) * 1e6
+    per_entry_inc_us = t_inc / len(grow) * 1e6
+    del small
+
+    speedup = t_grow / t_inc
+    gates = {
+        "speedup_vs_rebuild_ge_20x": bool(speedup >= 20.0),
+        "insert_proportional_cost": bool(
+            per_entry_inc_us <= 4.0 * per_entry_small_us
+        ),
+        "grown_old_indices_stable": inc_old_stable,
+        "probe_identical_to_fresh_build": inc_probe_identical
+        and inc_indices_match_rebuild,
+        "reload_after_incremental_save_identical": inc_reload_identical,
+    }
+    if not all(gates.values()):
+        raise SystemExit(f"incremental-growth gates failed: {gates}")
 
     size_bytes = os.path.getsize(path)
     return {
@@ -115,9 +188,17 @@ def host_phase(entries_m: int, tmpdir: str) -> dict:
         "reload_probe_identical": reload_identical,
         "grow_entries": len(grow),
         "grow_rebuild_s": round(t_grow, 2),
-        "grow_single_run": True,
+        "grow_rebuild_reps_s": [round(t, 2) for t in t_grow_reps],
         "grown_old_indices_stable": grown_old_stable,
         "grown_new_entries_found": grown_new_found,
+        "grow_incremental_s": round(t_inc, 3),
+        "grow_incremental_reps_s": [round(t, 3) for t in t_inc_reps],
+        "grow_incremental_speedup_x": round(speedup, 1),
+        "grow_incremental_per_entry_us": round(per_entry_inc_us, 3),
+        "grow_small_table_per_entry_us": round(per_entry_small_us, 3),
+        "grow_incremental_save_s": round(t_inc_save, 3),
+        "grow_incremental_save_mode": inc_save["mode"],
+        "grow_gates": gates,
     }
 
 
@@ -247,6 +328,47 @@ def batch_determinism_phase(tmpdir: str) -> dict:
 
     boots1, digs1, dict1, t1 = run()
     boots2, digs2, dict2, _t2 = run()
+
+    # Service arm: the SAME 100-image corpus through one shared
+    # DictService over a real UDS. Output must be byte-identical to the
+    # per-process dict path, dedup decisions included, and every
+    # dict.rpc.* span must hang off a `convert` root (one trace spans the
+    # service boundary).
+    from nydus_snapshotter_tpu import trace
+    from nydus_snapshotter_tpu.parallel.dict_service import DictService
+
+    svc = DictService()
+    svc.run(os.path.join(tmpdir, "dict.sock"))
+    try:
+        via = BatchConverter(opt, dict_service=svc.sock_path, namespace="scale")
+        trace.reset()  # after init-time mirror sync: gate convert-time RPCs
+        t0 = time.perf_counter()
+        r_svc = via.convert_many(images)
+        t_svc = time.perf_counter() - t0
+        svc_chunks = len(via.dict)
+        via.dict.client.close()
+    finally:
+        svc.stop()
+    boots_svc = [r.bootstrap for r in r_svc]
+    digs_svc = [r.blob_digests for r in r_svc]
+    spans = trace.snapshot_spans()
+    convert_roots = {
+        s.trace_id for s in spans if not s.parent_id and s.name == "convert"
+    }
+    rpc_spans = [s for s in spans if s.name.startswith("dict.rpc.")]
+    trace_spans_rpc = bool(rpc_spans) and all(
+        s.trace_id in convert_roots for s in rpc_spans
+    )
+
+    gates = {
+        "service_bootstraps_identical": boots_svc == boots1,
+        "service_blob_digest_lists_identical": digs_svc == digs1,
+        "service_dict_chunks_match": svc_chunks == dict1,
+        "service_trace_convert_rooted_rpc": trace_spans_rpc,
+    }
+    if not all(gates.values()):
+        raise SystemExit(f"dict-service batch gates failed: {gates}")
+
     total_bytes = sum(len(t) for _n, ls in images for t in ls)
     return {
         "images": len(images),
@@ -260,6 +382,13 @@ def batch_determinism_phase(tmpdir: str) -> dict:
             set(digs1[i]) & set(d for ds in digs1[:i] for d in ds)
             for i in range(1, len(digs1))
         ),
+        "service_convert_s": round(t_svc, 2),
+        "service_bootstraps_identical": gates["service_bootstraps_identical"],
+        "service_blob_digest_lists_identical": gates[
+            "service_blob_digest_lists_identical"
+        ],
+        "service_dict_chunks": svc_chunks,
+        "service_trace_convert_rooted_rpc": trace_spans_rpc,
     }
 
 
