@@ -157,11 +157,16 @@ def build_stack(cfg: SnapshotterConfig):
     )
     if gc_period_sec > 0:
         # Age GC keeps the reference behavior; the capacity watermark
-        # ([blobcache].eviction_watermark_mib) additionally evicts whole
-        # LRU entries once total usage crosses it (cache/manager.py).
+        # ([blobcache].eviction_watermark_mib, NTPU_BLOBCACHE_WATERMARK_MIB
+        # env override) additionally evicts whole LRU entries once total
+        # usage crosses it (cache/manager.py).
+        from nydus_snapshotter_tpu.daemon.fetch_sched import resolve_watermark_bytes
+
         cache_mgr.start_gc(
             max_age_sec=gc_period_sec,
-            watermark_bytes=cfg.blobcache.eviction_watermark_mib << 20,
+            watermark_bytes=resolve_watermark_bytes(
+                cfg.blobcache.eviction_watermark_mib
+            ),
         )
 
     # Bootstrap signature verifier (snapshot.go:65) + daemon cgroup

@@ -32,6 +32,7 @@ from time import perf_counter
 from typing import Callable, Optional
 
 from nydus_snapshotter_tpu import trace
+from nydus_snapshotter_tpu.analysis import runtime as _an
 from nydus_snapshotter_tpu.daemon import fetch_sched
 from nydus_snapshotter_tpu.daemon.fetch_sched import (
     BACKGROUND,
@@ -87,7 +88,7 @@ class RegistryBlobFetcher:
                 clock=clock,
             )
         self._health[backend.host] = HostHealth(clock=clock)
-        self._lock = threading.Lock()
+        self._lock = _an.make_lock(f"blobcache.fetcher[{blob_id[:8]}]")
 
     def _client(self, host: str):
         from nydus_snapshotter_tpu.auth import keychain as authmod
@@ -206,8 +207,11 @@ class CachedBlob:
         self.map_path = os.path.join(cache_dir, f"{blob_id}.chunk_map")
         self.fetch_range = fetch_range
         self.blob_size = max(0, int(blob_size))
-        self._lock = threading.Lock()
+        self._lock = _an.make_lock(f"blobcache.blob[{blob_id[:8]}]")
         self._intervals = IntervalSet()
+        # Lockset annotation: interval/chunk-map state is only ever
+        # touched under self._lock (shared with the fetch scheduler).
+        self._intervals_shared = _an.shared(f"blobcache.intervals[{blob_id[:8]}]")
         self._ra_spans = IntervalSet()  # readahead-fetched, not yet read
         self._data_fd = os.open(self.data_path, os.O_RDWR | os.O_CREAT, 0o644)
         self._map_f = open(self.map_path, "ab")
@@ -250,6 +254,7 @@ class CachedBlob:
     def _deliver(self, offset: int, data: bytes) -> None:
         """Persist one completed flight (runs under self._lock): sparse
         pwrite + chunk-map append (flushed per batch, not per record)."""
+        self._intervals_shared.write()
         os.pwrite(self._data_fd, data, offset)
         self._map_f.write(_RECORD.pack(offset, len(data)))
         self._map_dirty = True
@@ -349,6 +354,7 @@ class CachedBlob:
                 if self._closed:
                     raise OSError(f"blob cache {self.data_path} is closed")
                 self._revalidate_locked()
+                self._intervals_shared.write()
                 sequential = offset == self._last_end
                 self._last_end = end
                 if self._intervals.covered(offset, end):
@@ -371,6 +377,7 @@ class CachedBlob:
                 if self._closed:
                     raise OSError(f"blob cache {self.data_path} is closed")
                 self._flush_map_locked()
+                self._intervals_shared.read()
                 # A concurrent eviction can drop coverage between flight
                 # delivery and this pread — replan instead of returning
                 # holes (the while-loop re-checks under the lock).
@@ -387,6 +394,7 @@ class CachedBlob:
         with self._lock:
             if self._closed:
                 return []
+            self._intervals_shared.read()
             if self._intervals.covered(offset, offset + size):
                 return []
             try:

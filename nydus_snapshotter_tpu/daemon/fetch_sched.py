@@ -44,6 +44,7 @@ from typing import Callable, Optional
 
 from nydus_snapshotter_tpu import failpoint
 from nydus_snapshotter_tpu import trace
+from nydus_snapshotter_tpu.analysis import runtime as _an
 from nydus_snapshotter_tpu.metrics import registry as _metrics
 from nydus_snapshotter_tpu.parallel.pipeline import MemoryBudget
 
@@ -321,6 +322,16 @@ def resolve_config() -> FetchConfig:
     )
 
 
+def resolve_watermark_bytes(config_mib: int) -> int:
+    """``[blobcache].eviction_watermark_mib`` with its documented
+    ``NTPU_BLOBCACHE_WATERMARK_MIB`` env override (env > config, like
+    every other blobcache knob; 0 disables capacity eviction)."""
+    mib = _env_int("NTPU_BLOBCACHE_WATERMARK_MIB", -1)
+    if mib < 0:
+        mib = max(0, int(config_mib))
+    return mib << 20
+
+
 _shared_budget: Optional[MemoryBudget] = None
 _shared_budget_lock = threading.Lock()
 
@@ -394,6 +405,9 @@ class FetchScheduler:
         self._flights: list[Flight] = []  # active (queued or fetching)
         self._queue: deque[Flight] = deque()  # demand FIFO
         self._queue_bg: deque[Flight] = deque()  # background FIFO
+        # Lockset annotation: flight table + queues must only ever be
+        # touched under the shared lock (NTPU_ANALYZE=1 verifies).
+        self._flights_shared = _an.shared(f"fetch.flights[{name}]")
         self._threads: list[threading.Thread] = []
         self._idle = 0
         self._closed = False
@@ -411,6 +425,7 @@ class FetchScheduler:
         gap fetches). Caller holds the shared lock."""
         if self._closed:
             raise OSError(f"fetch scheduler {self.name!r} is closed")
+        self._flights_shared.write()
         waiters = self.overlapping_flights(start, end)
         if waiters and priority == DEMAND:
             SINGLEFLIGHT_WAITS.inc()
@@ -487,6 +502,7 @@ class FetchScheduler:
                         self._idle -= 1
                 if self._closed and not self._queue and not self._queue_bg:
                     return
+                self._flights_shared.write()
                 flight = (self._queue or self._queue_bg).popleft()
             self._run_flight(flight)
 
@@ -523,6 +539,7 @@ class FetchScheduler:
                     self.budget.release(n)
                     INFLIGHT_BYTES.set(self.budget.held)
                 with self._cv:
+                    self._flights_shared.write()
                     try:
                         self._flights.remove(flight)
                     except ValueError:
@@ -538,6 +555,7 @@ class FetchScheduler:
         NOT hold the shared lock (workers need it to finish delivering)."""
         with self._cv:
             self._closed = True
+            self._flights_shared.write()
             aborted = list(self._queue) + list(self._queue_bg)
             self._queue.clear()
             self._queue_bg.clear()

@@ -25,6 +25,7 @@ import time
 from contextlib import contextmanager
 from typing import Iterator, Optional, Union
 
+from nydus_snapshotter_tpu.analysis import runtime as _an
 from nydus_snapshotter_tpu.failpoint.spec import (
     Action,
     Panic,
@@ -87,7 +88,7 @@ KNOWN_SITES = (
     "dict.rpc",              # parallel/dict_service.py service request entry
 )
 
-_lock = threading.Lock()
+_lock = _an.make_lock("failpoint.table")
 _active: dict[str, Action] = {}
 _fired: dict[str, int] = {}
 _rng = random.random  # patchable for deterministic probability tests

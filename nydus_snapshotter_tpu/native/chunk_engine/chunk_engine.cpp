@@ -656,6 +656,21 @@ int64_t ntpu_dict_upsert(const uint32_t *digests, int64_t n, int64_t base,
 // probe: XLA TPU gathers execute element-serially (~1 µs/element measured
 // on v5e), so host probing wins until the dict is sharded across chips
 // (parallel/sharded_dict.py's all_to_all path).
+// The probe side of the lock-free protocol: values are ACQUIRE-loaded so
+// a nonzero value happens-after the inserter's 32-byte key memcpy (which
+// the inserter sequences before its RELEASE store). A plain load would
+// let the compiler/TSan-visible ordering pair a live value with a torn
+// key; acquire is free on x86 (plain mov) and what the release store has
+// always assumed. Verified under ThreadSanitizer by the concurrent
+// upsert-vs-probe battery in tests/test_native_sanitizers.py.
+static inline int32_t ntpu_value_acquire(const int32_t *p) {
+#if defined(__GNUC__) || defined(__clang__)
+  return __atomic_load_n(p, __ATOMIC_ACQUIRE);
+#else
+  return *p;
+#endif
+}
+
 void ntpu_dict_probe(const uint32_t *queries, int64_t m,
                      const uint32_t *keys, const int32_t *values,
                      int64_t n_shards, int64_t cap, int64_t max_probe,
@@ -667,9 +682,10 @@ void ntpu_dict_probe(const uint32_t *queries, int64_t m,
     int64_t ans = -1;
     for (int64_t j = 0; j < max_probe; ++j) {
       const uint64_t lin = shard * (uint64_t)cap + ((base + j) & (uint64_t)(cap - 1));
-      if (values[lin] == 0) break;  // empty slot terminates the chain
+      const int32_t v = ntpu_value_acquire(values + lin);
+      if (v == 0) break;  // empty slot terminates the chain
       if (std::memcmp(keys + lin * 8, q, 32) == 0) {
-        ans = (int64_t)values[lin] - 1;
+        ans = (int64_t)v - 1;
         break;
       }
     }

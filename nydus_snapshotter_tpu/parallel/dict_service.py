@@ -57,6 +57,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from nydus_snapshotter_tpu import failpoint, trace
+from nydus_snapshotter_tpu.analysis import runtime as _an
 from nydus_snapshotter_tpu.metrics import registry as _metrics
 
 logger = logging.getLogger(__name__)
@@ -189,7 +190,11 @@ class ServiceDict:
             probe_backend=cfg.backend,
             load_factor=cfg.load_factor,
         )
-        self._mu = threading.Lock()
+        self._mu = _an.make_lock("dict_service.namespace")
+        # Lockset annotation: the record store + probe index pair must
+        # only ever be mutated under self._mu (probes stay lock-free and
+        # are deliberately NOT annotated — TSan covers that claim).
+        self._records_shared = _an.shared("dict_service.records")
 
     # -- mutation ------------------------------------------------------------
 
@@ -201,6 +206,7 @@ class ServiceDict:
 
         source = Bootstrap.from_bytes(data)
         with self._mu:
+            self._records_shared.write()
             added = self.records.add_bootstrap(source)
             if added:
                 new = self.records.bootstrap.chunks[-added:]
@@ -254,6 +260,7 @@ class ServiceDict:
         header + four fixed-width sections — a mirror replays it and is
         exactly the service's tables (cost proportional to the tail)."""
         with self._mu:
+            self._records_shared.read()
             bs = self.records.bootstrap
             c_rows = bs.chunks[chunks:]
             b_rows = bs.blobs[blobs:]
@@ -328,7 +335,7 @@ class DictService:
         self.cfg = cfg or resolve_dict_config()
         self._mesh = mesh
         self._dicts: dict[str, ServiceDict] = {}
-        self._mu = threading.Lock()
+        self._mu = _an.make_lock("dict_service.registry")
         self._httpd: Optional[_UnixHTTPServer] = None
         self.sock_path = ""
 

@@ -56,6 +56,7 @@ from time import perf_counter
 from typing import Callable, Optional
 
 from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.analysis import runtime as _an
 from nydus_snapshotter_tpu import trace
 from nydus_snapshotter_tpu.metrics import registry as _metrics
 
@@ -149,7 +150,7 @@ class MemoryBudget:
     def __init__(self, total_bytes: int):
         self.total = max(1, int(total_bytes))
         self._held = 0
-        self._cv = threading.Condition()
+        self._cv = _an.make_condition("pipeline.memory_budget")
 
     @property
     def held(self) -> int:
@@ -215,7 +216,7 @@ class ByteBoundedQueue:
         self.high_water = 0
         self._items: deque = deque()
         self._bytes = 0
-        self._cv = threading.Condition()
+        self._cv = _an.make_condition(f"pipeline.queue[{name}]")
         self._closed = False
         self._exc: Optional[BaseException] = None
 
@@ -400,7 +401,7 @@ class _CompCache:
 
     def __init__(self, pipeline: "ConvertPipeline"):
         self._p = pipeline
-        self._cv = threading.Condition()
+        self._cv = _an.make_condition("pipeline.comp_cache")
         self._submitted: set[bytes] = set()
         self._results: dict[bytes, object] = {}
         self._charges: dict[bytes, int] = {}
@@ -508,8 +509,8 @@ class ConvertPipeline:
         self._next = 0  # index into items, guarded by _lock
         self._results: dict = {}
         self._result_charge: dict = {}
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = _an.make_lock("pipeline.assembly")
+        self._cv = _an.make_condition("pipeline.assembly", self._lock)
         self._error: Optional[BaseException] = None
         self._abort = threading.Event()
         self._threads: list[threading.Thread] = []

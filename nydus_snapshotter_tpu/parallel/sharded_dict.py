@@ -61,6 +61,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.analysis import runtime as _an
 from nydus_snapshotter_tpu.metrics import registry as _metrics
 from nydus_snapshotter_tpu.parallel import mesh as mesh_lib
 
@@ -377,7 +378,12 @@ class ShardedChunkDict:
         # since the last rebuild — feeds save_incremental/entries_since.
         self._journal: list[tuple[int, np.ndarray, np.ndarray]] = []
         self._n_unique: "int | None" = None  # occupied slots (lazy for v4 loads)
-        self._mu = threading.Lock()  # serializes mutation; probes are lock-free
+        self._mu = _an.make_lock("dict.mutate")  # serializes mutation; probes are lock-free
+        # Lockset annotation: entry counts / epoch / journal only mutate
+        # under _mu. The probe TABLES are deliberately not annotated:
+        # they are lock-free by design (key-before-value release stores,
+        # verified under TSan in tests/test_native_sanitizers.py).
+        self._meta_shared = _an.shared("dict.meta")
 
     def _put_tables(
         self, keys: np.ndarray, values: np.ndarray, max_depth: "int | None" = None
@@ -449,6 +455,7 @@ class ShardedChunkDict:
             return np.zeros(0, dtype=np.int64)
         failpoint.hit("dict.insert")
         with self._mu:
+            self._meta_shared.write()
             base = self.n_entries
             if base + n + 1 >= 1 << 31:
                 raise DictBuildError("chunk dict exceeds int32 index space")
@@ -659,6 +666,7 @@ class ShardedChunkDict:
         Raises :class:`DictEpochError` when the epoch predates the last
         rebuild (the journal was compacted; caller must full-resync)."""
         with self._mu:
+            self._meta_shared.read()
             if since_epoch < self.rebuild_epoch:
                 raise DictEpochError(
                     f"epoch {since_epoch} predates last rebuild "

@@ -35,6 +35,7 @@ from typing import Callable, Optional
 
 from nydus_snapshotter_tpu import failpoint
 from nydus_snapshotter_tpu import trace
+from nydus_snapshotter_tpu.analysis import runtime as _an
 from nydus_snapshotter_tpu.metrics import data as metrics_data
 
 DEFAULT_READ_POOL = 8
@@ -119,9 +120,12 @@ class PrepareBoard:
 
     def __init__(self, fanout: int):
         self.fanout = max(0, fanout)
-        self._lock = threading.Lock()
+        self._lock = _an.make_lock("snapshot.prepare_board")
         self._exec: Optional[ThreadPoolExecutor] = None
         self._pending: dict[str, Future] = {}
+        # Lockset annotation: the pending-futures board is only ever
+        # touched under self._lock (NTPU_ANALYZE=1 verifies).
+        self._pending_shared = _an.shared("snapshot.prepare_board.pending")
         self._closed = False
 
     @property
@@ -146,6 +150,7 @@ class PrepareBoard:
                 fn()
             return
         with self._lock:
+            self._pending_shared.write()
             prev = self._pending.pop(sid, None)
         # Executor threads have no contextvars: carry the submitting
         # Prepare's trace context so the deferred slow tail (daemon
@@ -165,6 +170,7 @@ class PrepareBoard:
 
         fut = self._executor().submit(run)
         with self._lock:
+            self._pending_shared.write()
             self._pending[sid] = fut
             self._gauge()
 
@@ -173,6 +179,7 @@ class PrepareBoard:
         failure. Success clears the entry; failure sticks (every later
         join raises again) until :meth:`discard`."""
         with self._lock:
+            self._pending_shared.read()
             fut = self._pending.get(sid)
         if fut is None:
             return
@@ -255,7 +262,7 @@ class UsageAccountant:
         self._write = write
         self._pre_wait = pre_wait
         self.workers = max(0, workers)
-        self._cond = threading.Condition()
+        self._cond = _an.make_condition("snapshot.usage_accountant")
         self._queue: deque[_Scan] = deque()
         self._pending: dict[str, _Scan] = {}
         self._threads: list[threading.Thread] = []

@@ -48,6 +48,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterator, Optional
 
 from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.analysis import runtime as _an
 from nydus_snapshotter_tpu import trace
 from nydus_snapshotter_tpu.metrics import data as metrics_data
 from nydus_snapshotter_tpu.snapshot.async_work import resolve_snapshots_config
@@ -115,7 +116,7 @@ class _AncestorCache:
 
     def __init__(self, maxsize: int):
         self.maxsize = max(0, maxsize)
-        self._lock = threading.Lock()
+        self._lock = _an.make_lock("metastore.ancestor_cache")
         self._map: OrderedDict[str, tuple[str, ...]] = OrderedDict()
 
     def get(self, key: str) -> Optional[tuple[str, ...]]:
@@ -229,7 +230,7 @@ class MetaStore:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         cfg = resolve_snapshots_config()
         self._path = path
-        self._wlock = threading.RLock()
+        self._wlock = _an.make_rlock("metastore.wlock")
         self._txn_depth = 0
         self._writer = _connect(path)
         with self._writer:

@@ -63,6 +63,13 @@ DEFAULT_STRIPES = 8
 
 
 class _Stripe:
+    # Deliberately NOT instrumented by analysis.runtime (NTPU_ANALYZE):
+    # the stripe lock is taken once per recorded span — the hottest lock
+    # in the process — and per-acquire detector bookkeeping inside a
+    # kernel-FUSE daemon's serve loop measurably destabilizes real-mount
+    # timing (the takeover-storm suite wedges its 5s reader alarms).
+    # The ring's concurrency invariant (len + dropped == pushes under
+    # any interleaving) is pinned directly by tests/test_trace.py.
     __slots__ = ("lock", "items", "cap", "drops", "pushes")
 
     def __init__(self, cap: int):

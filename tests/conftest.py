@@ -30,3 +30,17 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: slow chaos/e2e sweeps excluded from tier-1 (-m 'not slow')"
     )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Lockset race gate: with NTPU_ANALYZE=1 (the CI analyze job runs
+    the stress suites under it), any race or lock-order cycle the runtime
+    detector recorded fails the whole session."""
+    from nydus_snapshotter_tpu.analysis import runtime as _an
+
+    if not _an.ENABLED:
+        return
+    report = _an.report()
+    if report:
+        print("\nNTPU_ANALYZE runtime findings:\n" + report, file=sys.stderr)
+        session.exitstatus = 3

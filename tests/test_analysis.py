@@ -1,0 +1,534 @@
+"""Concurrency invariant analyzer (nydus_snapshotter_tpu/analysis/).
+
+Two halves:
+
+1. **planted bugs** — fixture modules written to a temp package, each
+   containing exactly the defect a detector exists for (a two-lock
+   cycle, a ``queue.put`` under a lock, an undocumented ``ntpu_*``
+   metric, an unregistered failpoint site, an uncarried trace context
+   across a Thread spawn) — every detector must fire, and the matched
+   clean variants must NOT fire;
+2. **the real tree** — ``tools/analyze.py`` run over the actual package
+   must produce zero findings outside the reviewed baseline (the same
+   gate the CI ``analyze`` job enforces), and the baseline file itself
+   must be well-formed (every suppression justified, none stale).
+
+Plus the runtime (Eraser-style) lockset detector: planted races are
+caught, lock-discipline-clean accesses are not, runtime lock-order
+cycles are recorded, and the instrumented wrappers compose with
+``threading.Condition``.
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+import threading
+
+import pytest
+
+from nydus_snapshotter_tpu.analysis import baseline as baseline_mod
+from nydus_snapshotter_tpu.analysis import runtime as an_rt
+from nydus_snapshotter_tpu.analysis.drift import (
+    find_config_drift,
+    find_failpoint_drift,
+    find_metric_drift,
+    find_trace_carry_drift,
+)
+from nydus_snapshotter_tpu.analysis.locks import (
+    find_blocking_findings,
+    find_lock_order_findings,
+)
+from nydus_snapshotter_tpu.analysis.package import PackageModel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_pkg(root, files: dict[str, str]) -> str:
+    pkg = os.path.join(str(root), "fixtures")
+    os.makedirs(pkg, exist_ok=True)
+    open(os.path.join(pkg, "__init__.py"), "w").close()
+    for rel, src in files.items():
+        path = os.path.join(pkg, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(textwrap.dedent(src))
+    os.makedirs(os.path.join(str(root), "docs"), exist_ok=True)
+    return str(root)
+
+
+class TestPlantedLockBugs:
+    def test_two_lock_cycle_detected(self, tmp_path):
+        root = _write_pkg(tmp_path, {"bugs.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._la = threading.Lock()
+                    self._lb = threading.Lock()
+
+                def one(self):
+                    with self._la:
+                        with self._lb:
+                            pass
+
+                def two(self):
+                    with self._lb:
+                        with self._la:
+                            pass
+            """})
+        model = PackageModel(root, "fixtures")
+        found = find_lock_order_findings(model)
+        assert any("inversion" in f.detail and "_la" in f.detail for f in found), found
+
+    def test_interprocedural_cycle_detected(self, tmp_path):
+        root = _write_pkg(tmp_path, {"bugs.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._la = threading.Lock()
+                    self._lb = threading.Lock()
+
+                def fwd(self):
+                    with self._la:
+                        self._grab_b()
+
+                def _grab_b(self):
+                    with self._lb:
+                        pass
+
+                def rev(self):
+                    with self._lb:
+                        self._grab_a()
+
+                def _grab_a(self):
+                    with self._la:
+                        pass
+            """})
+        found = find_lock_order_findings(PackageModel(root, "fixtures"))
+        assert any("inversion" in f.detail for f in found), found
+
+    def test_self_reacquire_detected(self, tmp_path):
+        root = _write_pkg(tmp_path, {"bugs.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def boom(self):
+                    with self._lock:
+                        self._again()
+
+                def _again(self):
+                    with self._lock:
+                        pass
+            """})
+        found = find_lock_order_findings(PackageModel(root, "fixtures"))
+        assert any(f.detail.startswith("self:") for f in found), found
+
+    def test_rlock_reacquire_not_flagged(self, tmp_path):
+        root = _write_pkg(tmp_path, {"ok.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def fine(self):
+                    with self._lock:
+                        self._again()
+
+                def _again(self):
+                    with self._lock:
+                        pass
+            """})
+        found = find_lock_order_findings(PackageModel(root, "fixtures"))
+        assert not found, found
+
+    def test_consistent_order_not_flagged(self, tmp_path):
+        root = _write_pkg(tmp_path, {"ok.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._la = threading.Lock()
+                    self._lb = threading.Lock()
+
+                def one(self):
+                    with self._la:
+                        with self._lb:
+                            pass
+
+                def two(self):
+                    with self._la:
+                        with self._lb:
+                            pass
+            """})
+        found = find_lock_order_findings(PackageModel(root, "fixtures"))
+        assert not found, found
+
+    def test_queue_put_under_lock_detected(self, tmp_path):
+        root = _write_pkg(tmp_path, {"bugs.py": """
+            import queue
+            import threading
+
+            class B:
+                def __init__(self):
+                    self._q = queue.Queue(maxsize=4)
+                    self._lock = threading.Lock()
+
+                def send(self, item):
+                    with self._lock:
+                        self._q.put(item)
+
+                def ok_send(self, item):
+                    self._q.put(item)
+            """})
+        found = find_blocking_findings(PackageModel(root, "fixtures"))
+        assert len(found) == 1 and found[0].qualname == "B.send", found
+        assert found[0].detail.startswith("queue.put"), found
+
+    def test_future_result_under_contextmanager_lock_detected(self, tmp_path):
+        # The metastore shape: a generator contextmanager holds the lock
+        # at its yield; a join inside the with-block blocks under it.
+        root = _write_pkg(tmp_path, {"bugs.py": """
+            import threading
+            from contextlib import contextmanager
+
+            class C:
+                def __init__(self):
+                    self._wlock = threading.Lock()
+
+                @contextmanager
+                def txn(self):
+                    self._wlock.acquire()
+                    try:
+                        yield
+                    finally:
+                        self._wlock.release()
+
+                def join_under_txn(self, fut):
+                    with self.txn():
+                        fut.result()
+
+                def ok_join(self, fut):
+                    with self.txn():
+                        pass
+                    fut.result()
+            """})
+        found = find_blocking_findings(PackageModel(root, "fixtures"))
+        assert len(found) == 1 and found[0].qualname == "C.join_under_txn", found
+
+    def test_cv_wait_on_own_condition_excused(self, tmp_path):
+        root = _write_pkg(tmp_path, {"ok.py": """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self._items = []
+
+                def pop(self):
+                    with self._cv:
+                        while not self._items:
+                            self._cv.wait()
+                        return self._items.pop()
+            """})
+        found = find_blocking_findings(PackageModel(root, "fixtures"))
+        assert not found, found
+
+    def test_cv_wait_with_second_lock_held_flagged(self, tmp_path):
+        root = _write_pkg(tmp_path, {"bugs.py": """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self._cv = threading.Condition()
+
+                def bad_wait(self):
+                    with self._mu:
+                        with self._cv:
+                            self._cv.wait()
+            """})
+        found = find_blocking_findings(PackageModel(root, "fixtures"))
+        assert any(
+            f.qualname == "W.bad_wait" and "_mu" in f.message for f in found
+        ), found
+
+
+class TestPlantedDriftBugs:
+    def test_undocumented_metric_detected(self, tmp_path):
+        root = _write_pkg(tmp_path, {"met.py": """
+            from nydus_snapshotter_tpu.metrics.registry import Counter
+
+            BOGUS = Counter("ntpu_bogus_total", "planted undocumented metric")
+            GOOD = Counter("ntpu_documented_total", "documented metric")
+            """})
+        with open(os.path.join(root, "docs", "obs.md"), "w") as f:
+            f.write("We export `ntpu_documented_total` and nothing else.\n")
+        found = find_metric_drift(PackageModel(root, "fixtures"), root)
+        assert [f.qualname for f in found] == ["ntpu_bogus_total"], found
+
+    def test_stale_doc_metric_detected(self, tmp_path):
+        root = _write_pkg(tmp_path, {"met.py": "x = 1\n"})
+        with open(os.path.join(root, "docs", "obs.md"), "w") as f:
+            f.write("Watch `ntpu_ghost_total` closely.\n")
+        found = find_metric_drift(PackageModel(root, "fixtures"), root)
+        assert any(f.detail == "stale-doc:ntpu_ghost_total" for f in found), found
+
+    def test_unregistered_and_undocumented_failpoint_detected(self, tmp_path):
+        root = _write_pkg(tmp_path, {
+            "failpoint/__init__.py": """
+                KNOWN_SITES = ("a.known",)
+
+                def hit(site):
+                    pass
+                """,
+            "mod.py": """
+                from fixtures import failpoint
+
+                def work():
+                    failpoint.hit("a.known")
+                    failpoint.hit("b.rogue")
+                """,
+        })
+        with open(os.path.join(root, "docs", "robustness.md"), "w") as f:
+            f.write("no sites documented here\n")
+        found = find_failpoint_drift(PackageModel(root, "fixtures"), root)
+        details = {f.detail for f in found}
+        assert "unregistered:b.rogue" in details, found
+        assert "undocumented:a.known" in details, found
+        assert "untested:a.known" in details, found  # no tests/ dir in fixture
+
+    def test_undocumented_config_key_detected(self, tmp_path):
+        root = _write_pkg(tmp_path, {"config/config.py": """
+            from dataclasses import dataclass, field
+
+            @dataclass
+            class FooConfig:
+                mystery_knob: int = 7
+                documented_knob: int = 1
+
+            @dataclass
+            class SnapshotterConfig:
+                foo: FooConfig = field(default_factory=FooConfig)
+            """})
+        with open(os.path.join(root, "docs", "configure.md"), "w") as f:
+            f.write("## `[foo]`\n\n| `documented_knob` | 1 |\n")
+        os.makedirs(os.path.join(root, "misc", "snapshotter"), exist_ok=True)
+        with open(os.path.join(root, "misc", "snapshotter", "config.toml"), "w") as f:
+            f.write("[foo]\ndocumented_knob = 1\n# mystery_knob = 7\n")
+        found = find_config_drift(PackageModel(root, "fixtures"), root)
+        assert [f.detail for f in found] == ["key-undocumented:foo.mystery_knob"], found
+
+    def test_uncarried_trace_context_detected(self, tmp_path):
+        root = _write_pkg(tmp_path, {"spawny.py": """
+            import threading
+
+            from nydus_snapshotter_tpu import trace
+
+            def worker():
+                with trace.span("fixture.op"):
+                    pass
+
+            def spawn_uncarried():
+                t = threading.Thread(target=worker)
+                t.start()
+                return t
+
+            def carried_worker(ctx):
+                with trace.with_context(ctx), trace.span("fixture.op"):
+                    pass
+
+            def spawn_carried():
+                ctx = trace.capture()
+                t = threading.Thread(target=lambda: carried_worker(ctx))
+                t.start()
+                return t
+
+            def untraced_worker():
+                return 2 + 2
+
+            def spawn_untraced():
+                t = threading.Thread(target=untraced_worker)
+                t.start()
+                return t
+            """})
+        found = find_trace_carry_drift(PackageModel(root, "fixtures"))
+        assert len(found) == 1 and found[0].qualname == "spawn_uncarried", found
+
+
+class TestRealTree:
+    def test_zero_new_findings_with_reviewed_baseline(self):
+        """The CI gate, as a tier-1 test: the actual package has no
+        analyzer findings outside analysis/baseline.toml, every
+        suppression is justified, and none are stale."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "ntpu_tools_analyze", os.path.join(REPO, "tools", "analyze.py")
+        )
+        tool = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tool)
+
+        rep = tool.run(REPO)
+        baseline = baseline_mod.load_baseline()  # raises on missing justification
+        rep.apply_baseline(baseline)
+        assert not rep.findings, "new analyzer findings:\n" + "\n".join(
+            f.render() for f in rep.findings
+        )
+        assert not rep.stale_suppressions, rep.stale_suppressions
+
+    def test_every_known_failpoint_site_is_chaos_covered(self):
+        """Kept alongside the drift gate on purpose: the failpoint drift
+        detector over the real tree must stay finding-free (registered ==
+        fired == documented == tested)."""
+        model = PackageModel(REPO, "nydus_snapshotter_tpu")
+        assert not find_failpoint_drift(model, REPO)
+
+    def test_baseline_requires_justification(self, tmp_path):
+        bad = tmp_path / "baseline.toml"
+        bad.write_text('[[suppress]]\nid = "x:y:z:w"\njustification = ""\n')
+        with pytest.raises(baseline_mod.BaselineError):
+            baseline_mod.load_baseline(str(bad))
+
+
+class TestLocksetRuntime:
+    @pytest.fixture(autouse=True)
+    def _enabled(self):
+        an_rt.reset()
+        an_rt.enable(True)
+        yield
+        an_rt.enable(
+            os.environ.get("NTPU_ANALYZE", "") not in ("", "0", "off", "false")
+        )
+        an_rt.reset()
+
+    def test_planted_unlocked_write_race_detected(self):
+        def w():
+            for _ in range(200):
+                an_rt.note_write("planted.counter")
+
+        ts = [threading.Thread(target=w) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert any(r["var"] == "planted.counter" for r in an_rt.races())
+
+    def test_lock_disciplined_access_is_clean(self):
+        lk = an_rt.make_lock("guard")
+
+        def w():
+            for _ in range(200):
+                with lk:
+                    an_rt.note_write("guarded.counter")
+
+        ts = [threading.Thread(target=w) for _ in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not an_rt.races(), an_rt.races()
+
+    def test_reader_writer_with_common_lock_is_clean(self):
+        lk = an_rt.make_lock("rw")
+        extra = an_rt.make_lock("extra")
+
+        def w():
+            for _ in range(100):
+                with lk:
+                    an_rt.note_write("rw.var")
+
+        def r():
+            for _ in range(100):
+                with extra:
+                    with lk:
+                        an_rt.note_read("rw.var")
+
+        ts = [threading.Thread(target=w), threading.Thread(target=r)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # Locksets intersect to {rw}, never empty.
+        assert not an_rt.races(), an_rt.races()
+
+    def test_runtime_lock_order_cycle_detected(self):
+        a = an_rt.make_lock("order.A")
+        b = an_rt.make_lock("order.B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        v = an_rt.order_violations()
+        assert v and sorted(v[0]["locks"]) == ["order.A", "order.B"], v
+
+    def test_consistent_runtime_order_is_clean(self):
+        a = an_rt.make_lock("c.A")
+        b = an_rt.make_lock("c.B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert not an_rt.order_violations()
+
+    def test_condition_over_instrumented_lock(self):
+        lk = an_rt.make_lock("cv.lock")
+        cv = an_rt.make_condition("cv", lk)
+        hits = []
+
+        def consumer():
+            with cv:
+                while not hits:
+                    cv.wait(timeout=5)
+                an_rt.note_write("cv.shared")
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        with cv:
+            an_rt.note_write("cv.shared")
+            hits.append(1)
+            cv.notify()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert not an_rt.races(), an_rt.races()
+
+    def test_rlock_reentry(self):
+        rl = an_rt.make_rlock("re.lock")
+        with rl:
+            with rl:
+                an_rt.note_write("re.var")
+            # still held after inner release
+            an_rt.note_write("re.var")
+        assert not an_rt.races()
+
+    def test_disabled_factories_return_plain_primitives(self):
+        an_rt.enable(False)
+        assert type(an_rt.make_lock("x")) is type(threading.Lock())
+        assert isinstance(
+            an_rt.make_condition("x"), threading.Condition
+        )
+
+    def test_report_renders_findings(self):
+        an_rt.note_write("rep.var")
+
+        def other():
+            an_rt.note_write("rep.var")
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        text = an_rt.report()
+        assert "rep.var" in text
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

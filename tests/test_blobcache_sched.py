@@ -508,6 +508,63 @@ class TestChaos:
         assert cb.read_at(10_000, 10_000) == blob[10_000:20_000]
         cb.close()
 
+    def test_coalesce_failpoint_fires_and_read_recovers(self, tmp_path):
+        """blobcache.coalesce chaos coverage: an error injected at the
+        miss-gap merge fails that read; the flight table recovers and the
+        retry merges + fetches normally."""
+        blob = _blob(60_000, seed=16)
+        fetcher = _CountingFetcher(blob)
+        cb = CachedBlob(str(tmp_path), "cc" * 32, fetcher,
+                        config=FetchConfig(fetch_workers=2, merge_gap=1 << 20,
+                                           readahead=0))
+        assert cb.read_at(8_000, 4_000) == blob[8_000:12_000]
+        with failpoint.injected("blobcache.coalesce", "error(OSError:merge)*1"):
+            with pytest.raises(OSError):
+                cb.read_at(0, 20_000)  # gaps [0,8k)+[12k,20k) coalesce
+        assert failpoint.counts().get("blobcache.coalesce", 0) == 1
+        assert cb.read_at(0, 20_000) == blob[:20_000]
+        failpoint.clear()
+        cb.close()
+
+    def test_readahead_failpoint_fires_at_planning(self, tmp_path):
+        """blobcache.readahead chaos coverage: the site fires inside the
+        sequential-window planner; a delay injection must not corrupt the
+        read."""
+        blob = _blob(100_000, seed=17)
+        fetcher = _CountingFetcher(blob)
+        cb = CachedBlob(str(tmp_path), "da" * 32, fetcher, blob_size=len(blob),
+                        config=FetchConfig(fetch_workers=2, merge_gap=0,
+                                           readahead=30_000))
+        with failpoint.injected("blobcache.readahead", "delay(0)"):
+            assert cb.read_at(0, 10_000) == blob[:10_000]
+            assert cb.read_at(10_000, 10_000) == blob[10_000:20_000]  # sequential
+            assert failpoint.counts().get("blobcache.readahead", 0) >= 1
+        failpoint.clear()
+        cb.close()
+
+    def test_replay_failpoint_fires_per_file(self, tmp_path):
+        """blobcache.replay chaos coverage: the site fires once per
+        replayed path; a delay injection leaves the warm result intact."""
+        blob = _blob(40_000, seed=18)
+        fetcher = _CountingFetcher(blob)
+        cb = CachedBlob(str(tmp_path), "ea" * 32, fetcher,
+                        config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0))
+        bootstrap, by_path = TestPrefetchReplay._fake_index()
+
+        def warm_chunk(rec) -> int:
+            flights = cb.warm(rec.compressed_offset, rec.compressed_size)
+            for f in flights:
+                f.wait()
+            return 0 if any(f.error for f in flights) else rec.compressed_size
+
+        rp = PrefetchReplayer(bootstrap, by_path, warm_chunk)
+        with failpoint.injected("blobcache.replay", "delay(0)"):
+            warmed = rp.replay(["/a", "/b"])
+        assert warmed == 16_000 and rp.files_replayed == 2
+        assert failpoint.counts().get("blobcache.replay", 0) == 2
+        failpoint.clear()
+        cb.close()
+
 
 class TestConfigResolution:
     def test_env_overrides_win(self, monkeypatch):
@@ -522,6 +579,16 @@ class TestConfigResolution:
         assert cfg.readahead == 256 << 10
         assert cfg.budget_bytes == 8 << 20
         assert cfg.prefetch_replay is False
+
+    def test_watermark_env_override_wins(self, monkeypatch):
+        """NTPU_BLOBCACHE_WATERMARK_MIB (documented with the rest of the
+        NTPU_BLOBCACHE* family) overrides the config watermark — and is
+        how the knob reaches spawned daemon processes."""
+        assert fetch_sched.resolve_watermark_bytes(512) == 512 << 20
+        monkeypatch.setenv("NTPU_BLOBCACHE_WATERMARK_MIB", "64")
+        assert fetch_sched.resolve_watermark_bytes(512) == 64 << 20
+        monkeypatch.setenv("NTPU_BLOBCACHE_WATERMARK_MIB", "0")
+        assert fetch_sched.resolve_watermark_bytes(512) == 0  # disable
 
     def test_blobcache_section_validates(self):
         from nydus_snapshotter_tpu.config.config import ConfigError, load_config

@@ -19,14 +19,42 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NATIVE = os.path.join(REPO, "nydus_snapshotter_tpu", "native")
 SAN_SO = os.path.join(NATIVE, "bin", "libchunk_engine_san.so")
+TSAN_SO = os.path.join(NATIVE, "bin", "libchunk_engine_tsan.so")
 
 
-def _libasan_path() -> str:
+def _san_lib_path(name: str) -> str:
     out = subprocess.run(
-        ["g++", "-print-file-name=libasan.so"], capture_output=True, text=True
+        ["g++", f"-print-file-name={name}"], capture_output=True, text=True
     )
     p = out.stdout.strip()
     return p if p and os.path.sep in p else ""
+
+
+def _libasan_path() -> str:
+    return _san_lib_path("libasan.so")
+
+
+def _tsan_usable() -> str:
+    """libtsan path when a TSan-preloaded CPython child actually starts
+    (older libtsan/kernel combinations abort on startup mappings — skip
+    gracefully there instead of failing the build arm)."""
+    p = _san_lib_path("libtsan.so")
+    if not p:
+        return ""
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = p
+    env["TSAN_OPTIONS"] = "exitcode=66"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "print('ok')"],
+            capture_output=True,
+            text=True,
+            timeout=60,
+            env=env,
+        )
+    except Exception:
+        return ""
+    return p if out.returncode == 0 and "ok" in out.stdout else ""
 
 
 _CHILD = r"""
@@ -233,6 +261,117 @@ def test_engine_differentials_under_asan_ubsan():
     assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-3000:])
     assert "SANITIZED-ENGINE-OK" in out.stdout
     assert "runtime error" not in out.stderr  # UBSan report marker
+
+
+_TSAN_CHILD = r"""
+import os, sys, threading
+sys.path.insert(0, os.environ["NTPU_REPO"])
+import numpy as np
+from nydus_snapshotter_tpu.ops import native_cdc
+from nydus_snapshotter_tpu.parallel.sharded_dict import INSERT_MAX_PROBE
+
+lib = native_cdc.load()
+assert lib is not None, "tsan engine failed to load"
+
+# --- lock-free dict protocol: ONE writer upserting (the ShardedChunkDict
+# _mu discipline) racing several lock-free probe threads over the same
+# table memory. ctypes releases the GIL during the foreign calls, so the
+# probes genuinely overlap the key-memcpy + value release-store windows;
+# TSan sees the pthread/sem HB edges Python's joins provide and must see
+# the acquire/release pairing inside the slot protocol — a plain load or
+# a value-before-key store order is a reported race.
+rng = np.random.default_rng(7)
+n_shards, cap = 4, 1 << 13
+keys = np.zeros((n_shards, cap, 8), dtype=np.uint32)
+values = np.zeros((n_shards, cap), dtype=np.int32)
+
+seed = rng.integers(1, 2**32, (4096, 8), dtype=np.uint32)
+out = np.empty(len(seed), dtype=np.int64)
+r = lib.ntpu_dict_upsert(seed.ctypes.data, len(seed), 0, n_shards, cap,
+                         INSERT_MAX_PROBE, keys.ctypes.data,
+                         values.ctypes.data, out.ctypes.data)
+assert r >= 0
+
+stop = threading.Event()
+errs = []
+
+def prober(tid):
+    qr = np.random.default_rng(100 + tid)
+    while not stop.is_set():
+        q = np.ascontiguousarray(np.concatenate([
+            seed[qr.integers(0, len(seed), 256)],
+            qr.integers(1, 2**32, (256, 8), dtype=np.uint32),
+        ]))
+        ans = np.empty(len(q), dtype=np.int64)
+        lib.ntpu_dict_probe(q.ctypes.data, len(q), keys.ctypes.data,
+                            values.ctypes.data, n_shards, cap,
+                            INSERT_MAX_PROBE, ans.ctypes.data)
+        # Seeded keys must always answer with a live index: the protocol
+        # promises a probe never pairs a value with a torn key.
+        if (ans[:256] < 0).any():
+            errs.append("probe missed a present key")
+            stop.set()
+            return
+
+probers = [threading.Thread(target=prober, args=(i,)) for i in range(3)]
+for t in probers:
+    t.start()
+
+base = len(seed)
+for step in range(50):
+    batch = rng.integers(1, 2**32, (256, 8), dtype=np.uint32)
+    outb = np.empty(len(batch), dtype=np.int64)
+    r = lib.ntpu_dict_upsert(batch.ctypes.data, len(batch), base, n_shards,
+                             cap, INSERT_MAX_PROBE, keys.ctypes.data,
+                             values.ctypes.data, outb.ctypes.data)
+    assert r >= 0, step
+    base += len(batch)
+stop.set()
+for t in probers:
+    t.join()
+assert not errs, errs
+
+# --- threaded pack_section arm under TSan: internal worker threads
+# assembling into one shared output buffer at bound-spaced offsets.
+src0 = rng.integers(0, 256, 1 << 19, dtype=np.uint8)
+src1 = rng.integers(0, 256, 4096, dtype=np.uint8)
+ext = np.asarray([(0, 0, 65536), (1, 0, 4096), (0, 65536, 200000),
+                  (0, 265536, 150000)], dtype=np.int64)
+a = native_cdc.pack_section(src0, src1, ext, 0, 1, 1)
+b = native_cdc.pack_section(src0, src1, ext, 0, 1, 4)
+assert a is not None and b is not None
+assert a[0].tobytes() == b[0].tobytes()
+print("TSAN-ENGINE-OK")
+"""
+
+
+@pytest.mark.skipif(not _tsan_usable(), reason="usable libtsan not available")
+def test_dict_upsert_probe_protocol_under_tsan():
+    """The ntpu_dict_upsert key-before-value release-store claim, actually
+    run under ThreadSanitizer: concurrent lock-free probes against a live
+    single-writer upsert stream must produce no TSan report."""
+    build = subprocess.run(
+        ["make", "-C", NATIVE, "tsan"], capture_output=True, text=True
+    )
+    assert build.returncode == 0, build.stderr[-2000:]
+    env = dict(os.environ)
+    env["NTPU_REPO"] = REPO
+    env["NTPU_CHUNK_ENGINE_SO"] = TSAN_SO
+    env["LD_PRELOAD"] = _tsan_usable()
+    # Any race report fails the child via the exit code; history_size
+    # bumps the per-thread event ring so long probe loops keep stacks.
+    env["TSAN_OPTIONS"] = "halt_on_error=1,exitcode=66,history_size=4"
+    out = subprocess.run(
+        [sys.executable, "-c", _TSAN_CHILD],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-3000:])
+    assert "TSAN-ENGINE-OK" in out.stdout
+    assert "WARNING: ThreadSanitizer" not in out.stderr
 
 
 if __name__ == "__main__":

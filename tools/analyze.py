@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Concurrency invariant analyzer CLI (docs/static_analysis.md).
+
+Runs the whole-package static detectors in milliseconds:
+
+    python tools/analyze.py                  # report everything
+    python tools/analyze.py --fail-on-new    # CI gate: exit 1 on findings
+                                             # not in analysis/baseline.toml
+    python tools/analyze.py --json out.json  # machine-readable findings
+    python tools/analyze.py --write-baseline # refresh the baseline, keeping
+                                             # existing justifications (new
+                                             # entries get TODO markers that
+                                             # fail the next load until a
+                                             # human writes the reason)
+
+Detectors: lock-order (inter-procedural acquisition cycles/inversions),
+blocking-under-lock (incl. failpoint-injectable sites), and the four
+drift gates (metrics/config/failpoints/trace-carry). The runtime lockset
+race detector is separate: set NTPU_ANALYZE=1 and run the stress suites
+(see docs/static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from nydus_snapshotter_tpu.analysis import baseline as baseline_mod  # noqa: E402
+from nydus_snapshotter_tpu.analysis.drift import find_all_drift  # noqa: E402
+from nydus_snapshotter_tpu.analysis.locks import (  # noqa: E402
+    find_blocking_findings,
+    find_lock_order_findings,
+)
+from nydus_snapshotter_tpu.analysis.model import Report  # noqa: E402
+from nydus_snapshotter_tpu.analysis.package import PackageModel  # noqa: E402
+
+
+def run(root: str, package: str = "nydus_snapshotter_tpu", drift: bool = True) -> Report:
+    model = PackageModel(root, package)
+    rep = Report()
+    rep.extend(find_lock_order_findings(model))
+    rep.extend(find_blocking_findings(model))
+    if drift:
+        rep.extend(find_all_drift(model, root))
+    return rep
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=REPO, help="repository root")
+    ap.add_argument("--package", default="nydus_snapshotter_tpu")
+    ap.add_argument("--baseline", default=baseline_mod.DEFAULT_PATH)
+    ap.add_argument("--fail-on-new", action="store_true",
+                    help="exit non-zero when findings outside the baseline exist")
+    ap.add_argument("--fail-on-stale", action="store_true",
+                    help="also exit non-zero on stale baseline entries")
+    ap.add_argument("--no-drift", action="store_true",
+                    help="skip the drift gates (lock analysis only)")
+    ap.add_argument("--json", metavar="PATH", help="write findings as JSON")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings")
+    args = ap.parse_args(argv)
+
+    t0 = time.perf_counter()
+    rep = run(args.root, args.package, drift=not args.no_drift)
+    total = len(rep.findings)
+    baseline = baseline_mod.load_baseline(args.baseline)
+
+    if args.write_baseline:
+        merged: dict[str, str] = {}
+        for f in rep.findings:
+            merged[f.fingerprint] = baseline.get(
+                f.fingerprint, "TODO: justify or fix"
+            )
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            fh.write(baseline_mod.render_baseline(merged))
+        print(f"wrote {len(merged)} suppressions to {args.baseline}")
+        return 0
+
+    rep.apply_baseline(baseline)
+    elapsed_ms = (time.perf_counter() - t0) * 1000.0
+
+    if args.json:
+        payload = {
+            "new": [vars(f) | {"fingerprint": f.fingerprint} for f in rep.findings],
+            "suppressed": [f.fingerprint for f in rep.suppressed],
+            "stale_suppressions": rep.stale_suppressions,
+            "elapsed_ms": elapsed_ms,
+        }
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2)
+
+    for f in rep.findings:
+        print(f.render())
+    print(
+        f"analyze: {total} findings ({len(rep.findings)} new, "
+        f"{len(rep.suppressed)} baselined) in {elapsed_ms:.0f} ms"
+    )
+    for fid in rep.stale_suppressions:
+        print(f"stale suppression (no longer matches anything): {fid}")
+
+    if args.fail_on_new and rep.findings:
+        print("FAIL: new analyzer findings — fix them or add a justified "
+              "suppression to analysis/baseline.toml", file=sys.stderr)
+        return 1
+    if args.fail_on_stale and rep.stale_suppressions:
+        print("FAIL: stale baseline suppressions", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
