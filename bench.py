@@ -837,6 +837,35 @@ print(json.dumps(profile(entries_m=2.0, grow_k=200)))
 """
 
 
+_PEER_STORM_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from tools.cluster_storm_profile import profile
+print(json.dumps(profile(pods=8, mib=1, reps=2)))
+"""
+
+
+def peer_storm_run(repo: str, timeout: float = 240.0) -> dict:
+    """Cluster deploy-storm profile (tools/cluster_storm_profile.py) in
+    a child under the hard watchdog: registry egress ratio (peers on vs
+    off), aggregate storm wall + paired best-rep/analytic speedup, and
+    the weighted-tenant fairness spread. Dozens of UDS servers and fetch
+    pools spin up — a wedge must cost one timeout, not a hang."""
+    res = _run_child_watchdog(
+        [sys.executable, "-c", _PEER_STORM_CHILD.format(repo=repo)], timeout=timeout
+    )
+    if res is None:
+        return {"error": f"peer storm hung >{timeout:.0f}s (watchdog killed it)"}
+    rc, stdout, stderr = res
+    if rc != 0:
+        tail = stderr.strip().splitlines()[-1] if stderr.strip() else ""
+        return {"error": f"peer storm exited rc={rc}: {tail}"[:200]}
+    try:
+        return json.loads(stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": "peer storm produced no JSON"}
+
+
 def chunk_dict_run(repo: str, timeout: float = 240.0) -> dict:
     """Chunk-dict growth + service profile (tools/chunk_dict_profile.py)
     in a child under the hard watchdog: incremental-vs-rebuild best-rep
@@ -1097,6 +1126,7 @@ def main() -> None:
     snapshot_ops = snapshot_ops_run(repo)
     trace_detail = trace_run(repo)
     chunk_dict_detail = chunk_dict_run(repo)
+    peer_storm = peer_storm_run(repo)
 
     print(
         json.dumps(
@@ -1130,6 +1160,7 @@ def main() -> None:
                     "snapshot_ops": snapshot_ops,
                     "trace": trace_detail,
                     "chunk_dict": chunk_dict_detail,
+                    "peer_storm": peer_storm,
                     "accel_profile": accel_profile,
                     "zstd_profile": zstd_profile,
                     "reference_defaults_profile": reference_defaults_profile,

@@ -248,7 +248,9 @@ def find_lock_order_findings(model: PackageModel) -> list[Finding]:
     g = build_lock_graph(model)
     findings: list[Finding] = []
 
-    # Self-deadlock: A -> A on a non-reentrant lock.
+    # Self-deadlock: A -> A on a non-reentrant lock. (Module-level locks
+    # carry an empty class slot in their id — kept a string so sorting a
+    # module that mixes them with class locks stays well-defined.)
     for (a, b), prov in sorted(g.edges.items()):
         if a == b:
             name = _lock_name(model, a)

@@ -214,12 +214,12 @@ class PackageModel:
                 continue
             ctor = self._ctor_name(mm, node.value)
             if ctor in LOCK_CTORS:
-                lid = (mm.name, None, tgt.id)
+                lid = (mm.name, "", tgt.id)
                 mm.locks[tgt.id] = self.lock_defs.setdefault(
                     lid, LockDef(lid, LOCK_CTORS[ctor], node.lineno)
                 )
             elif ctor in COND_CTORS:
-                lid = (mm.name, None, tgt.id)
+                lid = (mm.name, "", tgt.id)
                 mm.locks[tgt.id] = self.lock_defs.setdefault(
                     lid, LockDef(lid, "condition", node.lineno)
                 )
@@ -406,7 +406,7 @@ class _FunctionWalker:
                 return ld
             # lock received as a function parameter named like a lock
             if expr.id in LOCKISH_PARAMS:
-                lid = (self.fi.module, None, f"<param:{expr.id}>")
+                lid = (self.fi.module, "", f"<param:{expr.id}>")
                 return self.model.lock_defs.setdefault(lid, LockDef(lid, "lock"))
             return None
         if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):

@@ -304,6 +304,21 @@ def main(argv=None) -> int:
 
         dict_service = DictService()
         dict_service.run(cfg.chunk_dict.service)
+    # Peer chunk tier (daemon/peer.py): serve locally cached chunk ranges
+    # to cluster peers and route this node's lazy-read misses through the
+    # registry -> peer -> local-cache waterfall. The section reaches the
+    # spawned daemon processes via the NTPU_PEER* environment, which the
+    # daemon resolves itself (daemon/server.py) — here we start the
+    # snapshotter-process server (shared daemon mode runs the data plane
+    # in-process) and pre-resolve the router.
+    peer_server = None
+    if cfg.peer.enable:
+        from nydus_snapshotter_tpu.daemon import peer as peer_mod
+
+        peer_server = peer_mod.start_from_config()
+        peer_mod.default_router()
+        if peer_server is not None:
+            logger.info("peer chunk server on %s", peer_server.address)
     system_controller = None
     if cfg.system.enable:
         from nydus_snapshotter_tpu.system import SystemController
@@ -350,6 +365,10 @@ def main(argv=None) -> int:
             system_controller.stop()
         if dict_service is not None:
             dict_service.stop()
+        if peer_server is not None:
+            from nydus_snapshotter_tpu.daemon import peer as peer_mod
+
+            peer_mod.stop_default()
         sn.close()
         for mgr in managers.values():
             mgr.stop()

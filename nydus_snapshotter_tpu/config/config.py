@@ -166,6 +166,42 @@ class BlobcacheConfig:
 
 
 @dataclass
+class PeerConfig:
+    """Peer chunk tier + QoS admission knobs (daemon/peer.py,
+    daemon/fetch_sched.AdmissionGate).
+
+    With ``enable`` on, the node serves ranged reads for locally cached
+    chunk extents on ``listen`` (a UDS path or ``host:port``) and routes
+    its own misses through the static ``peers`` list before the registry
+    (registry -> peer -> local-cache waterfall): region ownership is
+    rendezvous-hashed per ``region_kib`` region, the owner pull-throughs
+    cold extents (``pull_through``) so a chunk leaves the origin at most
+    ~once per cluster, and every peer read is bounded by ``timeout_ms``
+    with transparent registry fallback. ``max_concurrent`` (0 = default
+    64) bounds operations admitted through the node's QoS gate, of which
+    ``demand_reserve`` slots only demand reads may use;
+    ``tenant_weights`` sets weighted in-flight byte fairness between
+    tenants (unlisted tenants weigh 1.0). Environment variables override
+    per-process (``NTPU_PEER_ENABLE``, ``NTPU_PEER_LISTEN``,
+    ``NTPU_PEER_PEERS``, ``NTPU_PEER_REGION_KIB``,
+    ``NTPU_PEER_TIMEOUT_MS``, ``NTPU_PEER_PULL_THROUGH``,
+    ``NTPU_PEER_MAX_CONCURRENT``, ``NTPU_PEER_DEMAND_RESERVE``,
+    ``NTPU_PEER_TENANT_WEIGHTS``) — that is also how the section reaches
+    spawned daemon processes.
+    """
+
+    enable: bool = False
+    listen: str = ""
+    peers: list[str] = field(default_factory=list)
+    region_kib: int = 512
+    timeout_ms: int = 1500
+    pull_through: bool = True
+    max_concurrent: int = 0
+    demand_reserve: int = 1
+    tenant_weights: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
 class SnapshotsConfig:
     """Concurrent snapshot control-plane knobs
     (snapshot/{metastore,snapshotter,async_work}.py).
@@ -273,6 +309,7 @@ class SnapshotterConfig:
     image: ImageConfig = field(default_factory=ImageConfig)
     convert: ConvertConfig = field(default_factory=ConvertConfig)
     blobcache: BlobcacheConfig = field(default_factory=BlobcacheConfig)
+    peer: PeerConfig = field(default_factory=PeerConfig)
     snapshots: SnapshotsConfig = field(default_factory=SnapshotsConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
     chunk_dict: ChunkDictConfig = field(default_factory=ChunkDictConfig)
@@ -356,6 +393,20 @@ class SnapshotterConfig:
             raise ConfigError(
                 "blobcache.eviction_watermark_mib must be >= 0 (0 = unbounded)"
             )
+        if self.peer.enable and not self.peer.listen and not self.peer.peers:
+            raise ConfigError(
+                "peer.enable needs a listen address and/or a peers list"
+            )
+        if self.peer.region_kib <= 0:
+            raise ConfigError("peer.region_kib must be positive")
+        if self.peer.timeout_ms <= 0:
+            raise ConfigError("peer.timeout_ms must be positive")
+        if self.peer.max_concurrent < 0 or self.peer.demand_reserve < 0:
+            raise ConfigError(
+                "peer.max_concurrent/demand_reserve must be >= 0"
+            )
+        if any(w <= 0 for w in self.peer.tenant_weights.values()):
+            raise ConfigError("peer.tenant_weights must all be positive")
         if self.snapshots.read_pool < 1:
             raise ConfigError("snapshots.read_pool must be >= 1")
         if self.snapshots.prepare_fanout < 0 or self.snapshots.usage_workers < 0:

@@ -37,10 +37,12 @@ from nydus_snapshotter_tpu.daemon import fetch_sched
 from nydus_snapshotter_tpu.daemon.fetch_sched import (
     BACKGROUND,
     DEMAND,
+    PREFETCH,
     FetchConfig,
     FetchScheduler,
     IntervalSet,
 )
+from nydus_snapshotter_tpu.remote import mirror as mirror_mod
 from nydus_snapshotter_tpu.remote.mirror import HostHealth
 
 logger = logging.getLogger(__name__)
@@ -71,7 +73,14 @@ class RegistryBlobFetcher:
     client itself opens one connection per request).
     """
 
-    def __init__(self, backend, blob_id: str, clock=time.monotonic, sleep=time.sleep):
+    def __init__(
+        self,
+        backend,
+        blob_id: str,
+        clock=time.monotonic,
+        sleep=time.sleep,
+        health_registry=None,
+    ):
         self.backend = backend
         self.blob_id = blob_id
         self._sleep = sleep
@@ -80,14 +89,24 @@ class RegistryBlobFetcher:
         hosts.append(backend.host)
         self._hosts = hosts
         self._clients: dict[str, object] = {}
+        # Host health lives in the PROCESS-WIDE registry shared with the
+        # converter transport (remote/transport.Pool) and the peer router
+        # (daemon/peer.py): a host one component demotes is avoided by
+        # all. A custom clock (tests) gets a private table instead.
+        if health_registry is None:
+            if clock is time.monotonic:
+                health_registry = mirror_mod.global_health_registry()
+            else:
+                health_registry = mirror_mod.HostHealthRegistry(clock=clock)
+        self._registry = health_registry
         self._health: dict[str, HostHealth] = {}
         for m in mirrors:
-            self._health[m.host] = HostHealth(
+            self._health[m.host] = health_registry.health_for(
+                m.host,
                 failure_limit=getattr(m, "failure_limit", 5),
                 cooldown=float(getattr(m, "health_check_interval", 5)),
-                clock=clock,
             )
-        self._health[backend.host] = HostHealth(clock=clock)
+        self._health[backend.host] = health_registry.health_for(backend.host)
         self._lock = _an.make_lock(f"blobcache.fetcher[{blob_id[:8]}]")
 
     def _client(self, host: str):
@@ -200,6 +219,8 @@ class CachedBlob:
         blob_size: int = 0,
         config: Optional[FetchConfig] = None,
         budget=None,
+        gate=None,
+        tenant: str = "default",
     ):
         os.makedirs(cache_dir, exist_ok=True)
         self.blob_id = blob_id
@@ -228,6 +249,8 @@ class CachedBlob:
             config=config,
             budget=budget,
             name=blob_id[:8],
+            gate=gate,
+            tenant=tenant,
         )
 
     # -- persistence ---------------------------------------------------------
@@ -328,7 +351,11 @@ class CachedBlob:
         if hit:
             fetch_sched.READAHEAD_HIT_BYTES.inc(hit)
 
-    def read_at(self, offset: int, size: int) -> bytes:
+    def read_at(self, offset: int, size: int, lane: int = DEMAND) -> bytes:
+        """Serve ``[offset, offset+size)``. ``lane`` is the QoS lane the
+        miss fetches run at: DEMAND for real reads, PEER_SERVE when a
+        peer chunk server pulls through on behalf of another node
+        (daemon/peer.py) — local demand must always outrank it."""
         if size <= 0:
             return b""
         # One span + one histogram sample per read, both metering the
@@ -340,13 +367,13 @@ class CachedBlob:
             "blobcache.read_at", blob=self.blob_id[:8], offset=offset, bytes=size
         ):
             try:
-                return self._read_at(offset, size)
+                return self._read_at(offset, size, lane)
             finally:
                 fetch_sched.OP_HIST.labels("read_at").observe(
                     (perf_counter() - t0) * 1000.0
                 )
 
-    def _read_at(self, offset: int, size: int) -> bytes:
+    def _read_at(self, offset: int, size: int, lane: int = DEMAND) -> bytes:
         end = offset + size
         first_pass = True
         while True:
@@ -355,17 +382,21 @@ class CachedBlob:
                     raise OSError(f"blob cache {self.data_path} is closed")
                 self._revalidate_locked()
                 self._intervals_shared.write()
-                sequential = offset == self._last_end
-                self._last_end = end
+                # Peer-serve pull-throughs must not pollute the LOCAL
+                # sequential-reader detector (readahead is a demand-lane
+                # heuristic).
+                sequential = lane == DEMAND and offset == self._last_end
+                if lane == DEMAND:
+                    self._last_end = end
                 if self._intervals.covered(offset, end):
                     if first_pass:
                         fetch_sched.HIT_BYTES.inc(size)
                     self._account_ra_hit_locked(offset, end)
-                    if sequential:
+                    if sequential and lane == DEMAND:
                         self._plan_readahead_locked(end)
                     return os.pread(self._data_fd, size, offset)
-                flights = self.sched.plan_locked(offset, end, priority=DEMAND)
-                if sequential and first_pass:
+                flights = self.sched.plan_locked(offset, end, priority=lane)
+                if sequential and first_pass and lane == DEMAND:
                     self._plan_readahead_locked(end)
             first_pass = False
             for f in flights:
@@ -385,10 +416,29 @@ class CachedBlob:
                     self._account_ra_hit_locked(offset, end)
                     return os.pread(self._data_fd, size, offset)
 
+    def covered(self, offset: int, size: int) -> bool:
+        """Whether ``[offset, offset+size)`` is resident locally — the
+        peer chunk server (daemon/peer.py) answers cover-only requests
+        from this, never fetching on a stranger's behalf."""
+        with self._lock:
+            if self._closed:
+                return False
+            self._intervals_shared.read()
+            return self._intervals.covered(offset, offset + size)
+
+    def coverage_bytes(self) -> int:
+        """Total resident bytes (peer announce/stat endpoint)."""
+        with self._lock:
+            if self._closed:
+                return 0
+            self._intervals_shared.read()
+            return self._intervals.total_bytes()
+
     def warm(self, offset: int, size: int) -> list:
-        """Schedule ``[offset, offset+size)`` residency at BACKGROUND
-        priority (prefetch replay); returns the flights to optionally
-        wait on. Never raises on a closed cache — warming is advisory."""
+        """Schedule ``[offset, offset+size)`` residency at PREFETCH
+        priority (prefetch-list replay — below the readahead lane, above
+        peer-serve); returns the flights to optionally wait on. Never
+        raises on a closed cache — warming is advisory."""
         if size <= 0:
             return []
         with self._lock:
@@ -398,7 +448,7 @@ class CachedBlob:
             if self._intervals.covered(offset, offset + size):
                 return []
             try:
-                return self.sched.plan_locked(offset, offset + size, priority=BACKGROUND)
+                return self.sched.plan_locked(offset, offset + size, priority=PREFETCH)
             except OSError:
                 return []
 

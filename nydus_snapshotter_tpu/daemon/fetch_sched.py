@@ -24,12 +24,19 @@ the convert path uses):
   cache through the same scheduler at background priority, cancellable on
   umount.
 
-Demand flights always dispatch before background ones; a demand read that
-lands on a queued background flight promotes it. Observability lands in
-``metrics/registry.default_registry`` as ``ntpu_blobcache_*``;
-``failpoint.hit`` fires at the fetch / coalesce / readahead boundaries
-(``blobcache.{fetch,coalesce,readahead}``) so the overlap is
-chaos-testable (docs/robustness.md).
+Flights dispatch in strict lane order (demand > readahead > prefetch
+replay > peer serve); a demand read that lands on a queued lower-lane
+flight promotes it. On top of the per-blob scheduling sits the process
+QoS layer (:class:`AdmissionGate`): every fetch passes a global
+concurrency + byte admission gate with strict priority across lanes and
+weighted-tenant fairness inside a lane, so a thousand-pod deploy storm
+queues gracefully instead of oversubscribing the node (docs/lazy_read.md;
+the peer chunk tier in daemon/peer.py serves through the same gate).
+Observability lands in ``metrics/registry.default_registry`` as
+``ntpu_blobcache_*`` and ``ntpu_admission_*``; ``failpoint.hit`` fires at
+the fetch / coalesce / readahead / admission boundaries
+(``blobcache.{fetch,coalesce,readahead}``, ``peer.admit``) so the overlap
+is chaos-testable (docs/robustness.md).
 """
 
 from __future__ import annotations
@@ -53,10 +60,23 @@ DEFAULT_MERGE_GAP = 128 << 10
 DEFAULT_READAHEAD = 1 << 20
 DEFAULT_BUDGET_BYTES = 64 << 20
 MAX_FETCH_WORKERS = 32
+DEFAULT_ADMIT_CONCURRENT = 64
+DEFAULT_DEMAND_RESERVE = 1
+DEFAULT_TENANT = "default"
 
-# Flight priorities: demand reads outrank readahead/prefetch warming.
+# Flight priority lanes, strictly ordered: a demand read outranks the
+# sequential readahead window, which outranks prefetch-list replay, which
+# outranks serving chunk ranges to cluster peers (daemon/peer.py). Lane
+# order is both the scheduler's queue-pop order and the admission gate's
+# strict-priority order. BACKGROUND is the pre-QoS name of the readahead
+# lane, kept as an alias.
 DEMAND = 0
-BACKGROUND = 1
+READAHEAD = 1
+PREFETCH = 2
+PEER_SERVE = 3
+BACKGROUND = READAHEAD
+N_LANES = 4
+LANE_NAMES = ("demand", "readahead", "prefetch", "peer_serve")
 
 _reg = _metrics.default_registry
 HIT_BYTES = _reg.register(
@@ -131,6 +151,35 @@ OP_HIST = _reg.register(
         "Latency of lazy-read data-plane operations (read_at / fetch),"
         " metered by the same window the trace spans record",
         ("op",),
+    )
+)
+ADMITTED = _reg.register(
+    _metrics.Counter(
+        "ntpu_admission_admitted_total",
+        "Fetch/serve operations admitted through the QoS gate, per lane",
+        ("lane",),
+    )
+)
+ADMIT_WAIT_MS = _reg.register(
+    _metrics.Histogram(
+        "ntpu_admission_wait_milliseconds",
+        "Time operations queued in the QoS admission gate before a slot,"
+        " per lane",
+        ("lane",),
+    )
+)
+ADMIT_QUEUED = _reg.register(
+    _metrics.Gauge(
+        "ntpu_admission_queued",
+        "Operations currently waiting in the QoS admission gate, per lane",
+        ("lane",),
+    )
+)
+ADMIT_TENANT_BYTES = _reg.register(
+    _metrics.Gauge(
+        "ntpu_admission_tenant_inflight_bytes",
+        "In-flight bytes currently admitted per tenant",
+        ("tenant",),
     )
 )
 
@@ -348,6 +397,289 @@ def shared_budget() -> MemoryBudget:
 
 
 # ---------------------------------------------------------------------------
+# QoS admission control
+# ---------------------------------------------------------------------------
+
+
+def parse_tenant_weights(spec: str) -> dict[str, float]:
+    """``"team-a=2,team-b=1"`` → weight map (bad entries ignored; an
+    unlisted tenant weighs 1.0)."""
+    out: dict[str, float] = {}
+    for part in spec.split(","):
+        name, _, w = part.strip().partition("=")
+        if not name or not w:
+            continue
+        try:
+            val = float(w)
+        except ValueError:
+            continue
+        if val > 0:
+            out[name] = val
+    return out
+
+
+def _global_peer_config():
+    try:
+        from nydus_snapshotter_tpu.config import config as _cfg
+
+        return _cfg.get_global_config().peer
+    except Exception:
+        return None
+
+
+def resolve_admission() -> tuple[int, int, dict[str, float]]:
+    """(max_concurrent, demand_reserve, tenant_weights) for the process
+    admission gate: env (``NTPU_PEER_MAX_CONCURRENT``,
+    ``NTPU_PEER_DEMAND_RESERVE``, ``NTPU_PEER_TENANT_WEIGHTS``) >
+    ``[peer]`` config > defaults. Env is also how the section reaches
+    spawned daemon processes, like every other blobcache knob."""
+    pc = _global_peer_config()
+    max_c = _env_int(
+        "NTPU_PEER_MAX_CONCURRENT",
+        getattr(pc, "max_concurrent", 0) or DEFAULT_ADMIT_CONCURRENT,
+    )
+    reserve = _env_int(
+        "NTPU_PEER_DEMAND_RESERVE",
+        getattr(pc, "demand_reserve", DEFAULT_DEMAND_RESERVE),
+    )
+    weights = dict(getattr(pc, "tenant_weights", None) or {})
+    env_w = os.environ.get("NTPU_PEER_TENANT_WEIGHTS", "")
+    if env_w:
+        weights = parse_tenant_weights(env_w)
+    return max(1, max_c), max(0, reserve), weights
+
+
+class _Ticket:
+    __slots__ = ("tenant", "lane", "n", "seq")
+
+    def __init__(self, tenant: str, lane: int, n: int, seq: int):
+        self.tenant = tenant
+        self.lane = lane
+        self.n = n
+        self.seq = seq
+
+
+class AdmissionGate:
+    """Cross-pod QoS admission: strict priority lanes + weighted-tenant
+    fairness + a global concurrency gate, layered on the shared
+    :class:`MemoryBudget`.
+
+    A thousand-pod deploy storm must queue gracefully, not oversubscribe:
+    every fetch/serve operation passes ``acquire(n, tenant, lane)`` before
+    touching the network, and is admitted only when
+
+    - **strict priority** holds: no waiter in a higher lane (demand >
+      readahead > prefetch-replay > peer-serve) is queued;
+    - a **concurrency slot** is free — at most ``max_concurrent`` admitted
+      operations, of which ``demand_reserve`` slots only the demand lane
+      may use (so a demand read never waits behind more than the
+      non-reserved in-service operations);
+    - the **byte cap** holds: admitted bytes fit the budget's total, with
+      the bounded-queue degrade-to-serial discipline (one op larger than
+      the whole cap is admitted alone rather than deadlocking);
+    - **weighted fairness** holds: among waiting tenants in the same
+      lane, the tenant with the smallest in-flight-bytes/weight score is
+      admitted first (weighted fair queuing on in-flight byte service),
+      unless that tenant cannot currently fit (no slot / bytes) — an
+      oversized under-served waiter never wedges the lane.
+
+    The gate does its own accounting under one condition variable and
+    settles the byte grant against the shared ``MemoryBudget`` AFTER the
+    admission decision, outside the gate lock, so budget co-users (other
+    schedulers without a gate) still see one consistent byte pool.
+    """
+
+    def __init__(
+        self,
+        budget: Optional[MemoryBudget] = None,
+        max_concurrent: int = 0,
+        demand_reserve: int = DEFAULT_DEMAND_RESERVE,
+        weights: Optional[dict[str, float]] = None,
+        name: str = "gate",
+    ):
+        self.budget = budget or shared_budget()
+        self.cap = self.budget.total
+        self.max_concurrent = max(1, max_concurrent or DEFAULT_ADMIT_CONCURRENT)
+        self.demand_reserve = min(max(0, demand_reserve), self.max_concurrent - 1)
+        self.weights = dict(weights or {})
+        self.name = name
+        self._cv = _an.make_condition(f"fetch.admission[{name}]")
+        # Lockset annotation: every gate field below is only ever touched
+        # under the condition's lock (NTPU_ANALYZE=1 verifies).
+        self._state_shared = _an.shared(f"fetch.admission.state[{name}]")
+        self._waiters: list[_Ticket] = []
+        self._seq = 0
+        self._in_service = 0
+        self._held = 0
+        self._tenant_bytes: dict[str, int] = {}
+        self._tenant_service: dict[str, int] = {}
+        self._admitted = [0] * N_LANES
+
+    def weight(self, tenant: str) -> float:
+        return max(1e-9, float(self.weights.get(tenant, 1.0)))
+
+    # -- admission predicate (caller holds self._cv) -------------------------
+
+    def _fits(self, t: _Ticket) -> bool:
+        """Slot + byte feasibility, ignoring priority/fairness."""
+        if self._in_service >= self.max_concurrent:
+            return False
+        if t.lane != DEMAND and self._in_service >= (
+            self.max_concurrent - self.demand_reserve
+        ):
+            return False
+        return self._held == 0 or self._held + t.n <= self.cap
+
+    def _admissible(self, t: _Ticket) -> bool:
+        for w in self._waiters:
+            if w.lane < t.lane:
+                return False  # strict priority: higher lanes drain first
+        if not self._fits(t):
+            return False
+        score = self._tenant_bytes.get(t.tenant, 0) / self.weight(t.tenant)
+        for w in self._waiters:
+            if w is t or w.lane != t.lane or w.tenant == t.tenant:
+                continue
+            ws = self._tenant_bytes.get(w.tenant, 0) / self.weight(w.tenant)
+            if (ws < score or (ws == score and w.seq < t.seq)) and self._fits(w):
+                return False  # the under-served tenant goes first
+        return True
+
+    # -- acquire / release ---------------------------------------------------
+
+    def acquire(
+        self,
+        n: int,
+        tenant: str = DEFAULT_TENANT,
+        lane: int = DEMAND,
+        aborted: Optional[Callable[[], bool]] = None,
+    ) -> float:
+        """Block until admitted; returns seconds spent queued. Raises
+        OSError when ``aborted()`` flips while waiting."""
+        failpoint.hit("peer.admit")
+        n = max(0, int(n))
+        lane = min(max(0, int(lane)), N_LANES - 1)
+        t0 = perf_counter()
+        with self._cv:
+            self._state_shared.write()
+            self._seq += 1
+            t = _Ticket(tenant, lane, n, self._seq)
+            self._waiters.append(t)
+            ADMIT_QUEUED.labels(LANE_NAMES[lane]).set(
+                sum(1 for w in self._waiters if w.lane == lane)
+            )
+            try:
+                while not self._admissible(t):
+                    if aborted is not None and aborted():
+                        raise OSError(
+                            f"admission gate {self.name!r} wait aborted"
+                        )
+                    # Short poll: an aborted() flip has no notifier.
+                    self._cv.wait(0.05)
+                self._in_service += 1
+                self._held += n
+                self._tenant_bytes[tenant] = self._tenant_bytes.get(tenant, 0) + n
+                self._tenant_service[tenant] = (
+                    self._tenant_service.get(tenant, 0) + n
+                )
+                self._admitted[lane] += 1
+            finally:
+                self._waiters.remove(t)
+                ADMIT_QUEUED.labels(LANE_NAMES[lane]).set(
+                    sum(1 for w in self._waiters if w.lane == lane)
+                )
+                # The waiter set changed either way: strict-priority and
+                # fairness predicates of other waiters may now pass.
+                self._cv.notify_all()
+            ADMIT_TENANT_BYTES.labels(tenant).set(self._tenant_bytes[tenant])
+        waited = perf_counter() - t0
+        ADMITTED.labels(LANE_NAMES[lane]).inc()
+        ADMIT_WAIT_MS.labels(LANE_NAMES[lane]).observe(waited * 1000.0)
+        # Settle against the shared byte pool OUTSIDE the gate lock; the
+        # gate's own cap makes this non-blocking unless budget co-users
+        # (ungated schedulers) hold bytes.
+        try:
+            self.budget.acquire(n, aborted=aborted)
+        except BaseException:
+            with self._cv:
+                self._state_shared.write()
+                self._in_service -= 1
+                self._held -= n
+                self._tenant_bytes[tenant] = max(
+                    0, self._tenant_bytes.get(tenant, 0) - n
+                )
+                self._cv.notify_all()
+            raise
+        return waited
+
+    def release(self, n: int, tenant: str = DEFAULT_TENANT) -> None:
+        n = max(0, int(n))
+        self.budget.release(n)
+        with self._cv:
+            self._state_shared.write()
+            self._in_service = max(0, self._in_service - 1)
+            self._held = max(0, self._held - n)
+            self._tenant_bytes[tenant] = max(
+                0, self._tenant_bytes.get(tenant, 0) - n
+            )
+            ADMIT_TENANT_BYTES.labels(tenant).set(self._tenant_bytes[tenant])
+            self._cv.notify_all()
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            self._state_shared.read()
+            return {
+                "max_concurrent": self.max_concurrent,
+                "demand_reserve": self.demand_reserve,
+                "in_service": self._in_service,
+                "held_bytes": self._held,
+                "queued": len(self._waiters),
+                "admitted_per_lane": dict(
+                    zip(LANE_NAMES, self._admitted)
+                ),
+                "tenant_inflight_bytes": dict(self._tenant_bytes),
+                "tenant_service_bytes": dict(self._tenant_service),
+            }
+
+    def service_bytes(self, tenant: str) -> int:
+        """Cumulative admitted bytes for ``tenant`` (fairness gauges
+        delta this around a saturation window)."""
+        with self._cv:
+            self._state_shared.read()
+            return self._tenant_service.get(tenant, 0)
+
+
+_shared_gate: Optional[AdmissionGate] = None
+_shared_gate_lock = threading.Lock()
+
+
+def shared_gate() -> AdmissionGate:
+    """Process-wide admission gate every scheduler without an explicit
+    gate/budget shares — the storm-wide concurrency, priority and
+    fairness decisions are per NODE, not per blob."""
+    global _shared_gate
+    with _shared_gate_lock:
+        if _shared_gate is not None:
+            return _shared_gate
+    # Build outside the lock (shared_budget takes its own module lock —
+    # never nest the two); publish first-wins.
+    max_c, reserve, weights = resolve_admission()
+    gate = AdmissionGate(
+        budget=shared_budget(),
+        max_concurrent=max_c,
+        demand_reserve=reserve,
+        weights=weights,
+        name="shared",
+    )
+    with _shared_gate_lock:
+        if _shared_gate is None:
+            _shared_gate = gate
+        return _shared_gate
+
+
+# ---------------------------------------------------------------------------
 # Flights + scheduler
 # ---------------------------------------------------------------------------
 
@@ -393,9 +725,21 @@ class FetchScheduler:
         config: Optional[FetchConfig] = None,
         budget: Optional[MemoryBudget] = None,
         name: str = "",
+        gate: Optional[AdmissionGate] = None,
+        tenant: str = DEFAULT_TENANT,
     ):
         self.cfg = config or resolve_config()
-        self.budget = budget or shared_budget()
+        # QoS admission: an explicit gate wins; an explicit budget gets a
+        # private pass-through gate (pre-QoS byte semantics preserved for
+        # callers that isolate their budget); otherwise the process gate.
+        if gate is not None:
+            self.gate = gate
+        elif budget is not None:
+            self.gate = AdmissionGate(budget=budget, name=name or "private")
+        else:
+            self.gate = shared_gate()
+        self.budget = self.gate.budget
+        self.tenant = tenant
         self.name = name
         self._lock = lock
         self._cv = threading.Condition(lock)
@@ -403,8 +747,10 @@ class FetchScheduler:
         self._fetch_range = fetch_range
         self._deliver = deliver
         self._flights: list[Flight] = []  # active (queued or fetching)
-        self._queue: deque[Flight] = deque()  # demand FIFO
-        self._queue_bg: deque[Flight] = deque()  # background FIFO
+        # One FIFO per priority lane, popped in lane order.
+        self._queues: tuple[deque[Flight], ...] = tuple(
+            deque() for _ in range(N_LANES)
+        )
         # Lockset annotation: flight table + queues must only ever be
         # touched under the shared lock (NTPU_ANALYZE=1 verifies).
         self._flights_shared = _an.shared(f"fetch.flights[{name}]")
@@ -445,7 +791,7 @@ class FetchScheduler:
         for f in new:
             f.ctx = ctx
             self._flights.append(f)
-            (self._queue if priority == DEMAND else self._queue_bg).append(f)
+            self._queues[f.priority].append(f)
         if new:
             self._spawn_workers(len(new))
             self._cv.notify_all()
@@ -467,14 +813,14 @@ class FetchScheduler:
         return flights
 
     def _promote(self, flights: list[Flight]) -> None:
-        """A demand read waits on these: background flights still queued
+        """A demand read waits on these: lower-lane flights still queued
         jump to the demand queue so the reader isn't stuck behind other
-        warming work."""
+        warming or peer-serve work."""
         for f in flights:
-            if f.priority == BACKGROUND and f in self._queue_bg:
-                self._queue_bg.remove(f)
+            if f.priority != DEMAND and f in self._queues[f.priority]:
+                self._queues[f.priority].remove(f)
                 f.priority = DEMAND
-                self._queue.append(f)
+                self._queues[DEMAND].append(f)
 
     # -- worker pool ---------------------------------------------------------
 
@@ -494,16 +840,16 @@ class FetchScheduler:
     def _worker(self) -> None:
         while True:
             with self._cv:
-                while not self._closed and not self._queue and not self._queue_bg:
+                while not self._closed and not any(self._queues):
                     self._idle += 1
                     try:
                         self._cv.wait()
                     finally:
                         self._idle -= 1
-                if self._closed and not self._queue and not self._queue_bg:
+                if self._closed and not any(self._queues):
                     return
                 self._flights_shared.write()
-                flight = (self._queue or self._queue_bg).popleft()
+                flight = next(q for q in self._queues if q).popleft()
             self._run_flight(flight)
 
     def _run_flight(self, flight: Flight) -> None:
@@ -516,11 +862,19 @@ class FetchScheduler:
             offset=flight.start,
             bytes=n,
             coalesced=flight.coalesced,
-            background=flight.priority == BACKGROUND,
+            lane=LANE_NAMES[flight.priority],
+            background=flight.priority != DEMAND,
         ) as sp:
             try:
-                self.budget.acquire(n, aborted=lambda: self._closed)
+                waited = self.gate.acquire(
+                    n,
+                    tenant=self.tenant,
+                    lane=flight.priority,
+                    aborted=lambda: self._closed,
+                )
                 acquired = True
+                if waited > 0.001:
+                    sp.annotate(admission_wait_ms=round(waited * 1000.0, 3))
                 INFLIGHT_BYTES.set(self.budget.held)
                 failpoint.hit("blobcache.fetch")
                 data = self._fetch_range(flight.start, n)
@@ -536,7 +890,7 @@ class FetchScheduler:
                 sp.annotate(error=repr(flight.error))
             finally:
                 if acquired:
-                    self.budget.release(n)
+                    self.gate.release(n, tenant=self.tenant)
                     INFLIGHT_BYTES.set(self.budget.held)
                 with self._cv:
                     self._flights_shared.write()
@@ -556,9 +910,9 @@ class FetchScheduler:
         with self._cv:
             self._closed = True
             self._flights_shared.write()
-            aborted = list(self._queue) + list(self._queue_bg)
-            self._queue.clear()
-            self._queue_bg.clear()
+            aborted = [f for q in self._queues for f in q]
+            for q in self._queues:
+                q.clear()
             for f in aborted:
                 try:
                     self._flights.remove(f)

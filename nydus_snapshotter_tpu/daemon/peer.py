@@ -1,0 +1,773 @@
+"""Peer chunk tier: nodes serve each other's lazy-read chunk fetches.
+
+PR 3 made one node's cold reads fast; at cluster scale a new image deploy
+makes thousands of nodes hammer the registry for the SAME chunks at the
+same moment, so aggregate registry egress scales as N x unique bytes and
+the storm collapses the origin. This module adds the second cache tier of
+the registry -> peer -> local-cache waterfall:
+
+- **PeerChunkServer** — every node serves ranged reads for extents its
+  :class:`~nydus_snapshotter_tpu.daemon.blobcache.CachedBlob`\\ s already
+  cover, over the same HTTP-over-UDS/TCP machinery the chunk-dict service
+  uses (parallel/dict_service.py). With ``pull_through`` on, the REGION
+  OWNER of a cold extent fetches it from the registry on behalf of the
+  cluster — through its own CachedBlob, whose per-blob singleflight table
+  collapses every concurrent peer request into one origin GET, so a chunk
+  is fetched from origin at most ~once per cluster.
+- **PeerRouter** — the peer-announce/lookup map: a static peer list from
+  the ``[peer]`` config (no gossip protocol), rendezvous-hashed per
+  ``(blob, region)`` so every node independently agrees which peer owns a
+  region. Peers are scored through the process-wide
+  :class:`~nydus_snapshotter_tpu.remote.mirror.HostHealthRegistry` —
+  the same table the registry-mirror failover and the converter transport
+  score through — so a dead peer goes on cooldown and the ranking walks
+  to the next owner (or the origin) instead of timing out every read.
+- **PeerAwareFetcher** — the planner's waterfall: each planned flight
+  tries the healthy region owner first and falls back to the registry on
+  miss / timeout / error / corrupt payload (CRC32-trailer verified), so a
+  dead peer can never fail a read, only slow it by one bounded timeout.
+
+Serving peers is the LOWEST QoS lane: the chunk server admits its bytes
+through the node's :class:`~nydus_snapshotter_tpu.daemon.fetch_sched.
+AdmissionGate` at PEER_SERVE priority, below local demand, readahead and
+prefetch replay — a node under local pressure sheds peer traffic first
+(requesters transparently fall back to the registry).
+
+Failpoint sites ``peer.{serve,fetch,admit}`` make every boundary
+chaos-testable (docs/robustness.md); metrics land as ``ntpu_peer_*``;
+trace context rides the same ``x-ntpu-trace-*`` headers the dict service
+uses, so a peer-served read's span tree spans both nodes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import logging
+import os
+import socket
+import socketserver
+import threading
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from time import perf_counter
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from nydus_snapshotter_tpu import failpoint, trace
+from nydus_snapshotter_tpu.analysis import runtime as _an
+from nydus_snapshotter_tpu.daemon import fetch_sched
+from nydus_snapshotter_tpu.daemon.fetch_sched import PEER_SERVE
+from nydus_snapshotter_tpu.metrics import registry as _metrics
+from nydus_snapshotter_tpu.remote import mirror as mirror_mod
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_REGION_KIB = 512
+DEFAULT_TIMEOUT_MS = 1500
+PEER_FAILURE_LIMIT = 3
+PEER_COOLDOWN_SECS = 2.0
+MAX_SERVE_BYTES = 64 << 20  # one ranged peer read, not a blob mirror
+
+_reg = _metrics.default_registry
+SERVE_REQUESTS = _reg.register(
+    _metrics.Counter(
+        "ntpu_peer_serve_requests",
+        "Ranged peer-read requests served by this node's chunk server,"
+        " by outcome (hit / pull / miss / error)",
+        ("outcome",),
+    )
+)
+SERVED_BYTES = _reg.register(
+    _metrics.Counter(
+        "ntpu_peer_served_bytes",
+        "Bytes this node served to cluster peers",
+    )
+)
+FETCH_REQUESTS = _reg.register(
+    _metrics.Counter(
+        "ntpu_peer_fetch_requests",
+        "Ranged reads this node attempted against a peer",
+    )
+)
+FETCH_BYTES = _reg.register(
+    _metrics.Counter(
+        "ntpu_peer_fetch_bytes",
+        "Bytes this node fetched from cluster peers instead of the registry",
+    )
+)
+FETCH_FALLBACKS = _reg.register(
+    _metrics.Counter(
+        "ntpu_peer_fetch_fallbacks",
+        "Peer reads that fell back to the registry, by reason"
+        " (miss / timeout / error / corrupt)",
+        ("reason",),
+    )
+)
+SERVE_MS = _reg.register(
+    _metrics.Histogram(
+        "ntpu_peer_serve_duration_milliseconds",
+        "Peer chunk-server request latency",
+        ("outcome",),
+    )
+)
+
+
+def snapshot_counters() -> dict:
+    """Cumulative ``ntpu_peer_*`` values (tools delta these around runs)."""
+    return {
+        "serve_hit": SERVE_REQUESTS.value("hit"),
+        "serve_pull": SERVE_REQUESTS.value("pull"),
+        "serve_miss": SERVE_REQUESTS.value("miss"),
+        "serve_error": SERVE_REQUESTS.value("error"),
+        "served_bytes": SERVED_BYTES.value(),
+        "fetch_requests": FETCH_REQUESTS.value(),
+        "fetch_bytes": FETCH_BYTES.value(),
+        "fallback_miss": FETCH_FALLBACKS.value("miss"),
+        "fallback_timeout": FETCH_FALLBACKS.value("timeout"),
+        "fallback_error": FETCH_FALLBACKS.value("error"),
+        "fallback_corrupt": FETCH_FALLBACKS.value("corrupt"),
+    }
+
+
+class PeerError(OSError):
+    """A peer request failed (connection, protocol, or server error)."""
+
+
+class PeerMiss(PeerError):
+    """The peer does not cover the requested extent (HTTP 404)."""
+
+
+# ---------------------------------------------------------------------------
+# Config resolution (env > [peer] config > defaults)
+# ---------------------------------------------------------------------------
+
+
+class PeerRuntimeConfig:
+    """Resolved ``[peer]`` knobs for this process."""
+
+    __slots__ = (
+        "enable", "listen", "peers", "region_bytes", "timeout_s",
+        "pull_through",
+    )
+
+    def __init__(self, enable, listen, peers, region_bytes, timeout_s,
+                 pull_through):
+        self.enable = enable
+        self.listen = listen
+        self.peers = peers
+        self.region_bytes = region_bytes
+        self.timeout_s = timeout_s
+        self.pull_through = pull_through
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name, "")
+    if not v:
+        return default
+    return v not in ("0", "off", "false")
+
+
+def _global_peer_config():
+    try:
+        from nydus_snapshotter_tpu.config import config as _cfg
+
+        return _cfg.get_global_config().peer
+    except Exception:
+        return None
+
+
+def resolve_peer_config() -> PeerRuntimeConfig:
+    """env (``NTPU_PEER*``) > ``[peer]`` global config > defaults. Env
+    overrides are also how the section reaches the spawned daemon
+    processes, which have no global snapshotter config."""
+    pc = _global_peer_config()
+    peers_env = os.environ.get("NTPU_PEER_PEERS", "")
+    if peers_env:
+        peers = [p.strip() for p in peers_env.split(",") if p.strip()]
+    else:
+        peers = list(getattr(pc, "peers", None) or [])
+    region_kib = fetch_sched._env_int(
+        "NTPU_PEER_REGION_KIB",
+        getattr(pc, "region_kib", 0) or DEFAULT_REGION_KIB,
+    )
+    timeout_ms = fetch_sched._env_int(
+        "NTPU_PEER_TIMEOUT_MS",
+        getattr(pc, "timeout_ms", 0) or DEFAULT_TIMEOUT_MS,
+    )
+    return PeerRuntimeConfig(
+        enable=_env_bool("NTPU_PEER_ENABLE", bool(getattr(pc, "enable", False))),
+        listen=os.environ.get("NTPU_PEER_LISTEN", getattr(pc, "listen", "")),
+        peers=peers,
+        region_bytes=max(1, region_kib) << 10,
+        timeout_s=max(1, timeout_ms) / 1000.0,
+        pull_through=_env_bool(
+            "NTPU_PEER_PULL_THROUGH", bool(getattr(pc, "pull_through", True))
+        ),
+    )
+
+
+def _normalize_addr(addr: str) -> str:
+    """``uds:///run/x.sock`` / ``/run/x.sock`` / ``host:port`` — strip the
+    scheme so an address compares equal however it was written."""
+    if addr.startswith("uds://"):
+        return addr[len("uds://"):]
+    return addr
+
+
+def _is_uds(addr: str) -> bool:
+    return "/" in addr
+
+
+# ---------------------------------------------------------------------------
+# Local export map: which blobs this node can serve
+# ---------------------------------------------------------------------------
+
+
+class PeerExport:
+    """blob_id -> live CachedBlob announce map for the local chunk server.
+
+    The daemon registers every registry-backed CachedBlob it opens and
+    unregisters on instance close; the server resolves requests against
+    this map only (a blob nobody lazily reads here is a 404, never a
+    registry fetch on a stranger's behalf)."""
+
+    def __init__(self):
+        self._mu = _an.make_lock("peer.export")
+        # Lockset annotation: the blob map is only ever touched under
+        # self._mu (NTPU_ANALYZE=1 verifies).
+        self._blobs_shared = _an.shared("peer.export.blobs")
+        self._blobs: dict[str, object] = {}
+
+    def register(self, blob_id: str, cached_blob) -> None:
+        with self._mu:
+            self._blobs_shared.write()
+            self._blobs[blob_id] = cached_blob
+
+    def unregister(self, blob_id: str, cached_blob=None) -> None:
+        """Drop the announce; with ``cached_blob`` given, only when the
+        map still points at that instance (two instances of one blob:
+        closing the first must not unannounce the survivor)."""
+        with self._mu:
+            self._blobs_shared.write()
+            if cached_blob is None or self._blobs.get(blob_id) is cached_blob:
+                self._blobs.pop(blob_id, None)
+
+    def get(self, blob_id: str):
+        with self._mu:
+            self._blobs_shared.read()
+            return self._blobs.get(blob_id)
+
+    def stats(self) -> dict:
+        with self._mu:
+            self._blobs_shared.read()
+            blobs = dict(self._blobs)
+        return {
+            "blobs": {
+                bid: {"covered_bytes": cb.coverage_bytes()}
+                for bid, cb in blobs.items()
+            }
+        }
+
+
+# ---------------------------------------------------------------------------
+# Chunk server (HTTP over UDS or TCP)
+# ---------------------------------------------------------------------------
+
+
+_BLOB_ROUTE = "/api/v1/peer/blob/"
+_STAT_ROUTE = "/api/v1/peer/stat"
+
+
+def _crc32(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    # The default backlog of 5 overflows when a whole deploy storm's
+    # worth of peers dials the region owner at once: excess connects
+    # fail instead of queueing (same fix as the daemon API server).
+    request_queue_size = 128
+
+    def finish_request(self, request, client_address):
+        self.RequestHandlerClass(request, ("uds", 0), self)
+
+
+class _TCPHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    request_queue_size = 128
+
+
+class PeerChunkServer:
+    """Serves ranged chunk reads for locally cached extents to peers.
+
+    ``handle()`` is transport-agnostic (the same split as DictService);
+    ``run(address)`` serves on a UDS path (contains ``/``) or a TCP
+    ``host:port``. Responses carry an ``x-ntpu-peer-crc32`` trailer header
+    so a requester detects transit corruption and falls back to the
+    registry instead of caching poisoned bytes.
+    """
+
+    def __init__(
+        self,
+        export: PeerExport,
+        gate=None,
+        pull_through: Optional[bool] = None,
+        tenant: str = "peer",
+    ):
+        cfg = resolve_peer_config()
+        self.export = export
+        self.gate = gate if gate is not None else fetch_sched.shared_gate()
+        self.pull_through = (
+            cfg.pull_through if pull_through is None else pull_through
+        )
+        self.tenant = tenant
+        self._httpd = None
+        self._closed = False
+        self.address = ""
+
+    # -- request handling ----------------------------------------------------
+
+    def handle(self, method: str, path: str, headers) -> tuple[int, dict, bytes]:
+        """(method, path?query, headers) -> (status, extra headers, body)."""
+        parsed = urlparse(path)
+        if parsed.path == _STAT_ROUTE:
+            body = json.dumps(self.export.stats()).encode()
+            return 200, {"Content-Type": "application/json"}, body
+        if not parsed.path.startswith(_BLOB_ROUTE) or method != "GET":
+            return 404, {}, b'{"message": "no such endpoint"}'
+        blob_id = parsed.path[len(_BLOB_ROUTE):]
+        q = parse_qs(parsed.query)
+        try:
+            offset = int(q.get("offset", ["-1"])[0])
+            size = int(q.get("size", ["0"])[0])
+            depth = int(headers.get("x-ntpu-peer-depth", "0"))
+        except ValueError:
+            return 400, {}, b'{"message": "bad range"}'
+        if offset < 0 or size <= 0 or size > MAX_SERVE_BYTES:
+            return 400, {}, b'{"message": "bad range"}'
+        try:
+            tid = int(headers.get("x-ntpu-trace-id", "0"), 16)
+            pid = int(headers.get("x-ntpu-parent-id", "0"), 16)
+        except ValueError:
+            tid = pid = 0
+        t0 = perf_counter()
+        outcome = "error"
+        try:
+            with trace.with_context(trace.remote_context(tid, pid)):
+                with trace.span(
+                    "peer.serve", blob=blob_id[:8], offset=offset, bytes=size
+                ) as sp:
+                    failpoint.hit("peer.serve")
+                    cb = self.export.get(blob_id)
+                    if cb is None:
+                        outcome = "miss"
+                        return 404, {}, b'{"message": "unknown blob"}'
+                    covered = cb.covered(offset, size)
+                    if not covered and (depth > 0 or not self.pull_through):
+                        # Cover-only serving: never fetch on behalf of a
+                        # forwarded request — bounds the relay depth.
+                        outcome = "miss"
+                        return 404, {}, b'{"message": "extent not cached"}'
+                    if covered:
+                        outcome = "hit"
+                        # Serving cached bytes still consumes this node's
+                        # uplink: admit it at the lowest lane.
+                        self.gate.acquire(
+                            size,
+                            tenant=self.tenant,
+                            lane=PEER_SERVE,
+                            aborted=lambda: self._closed,
+                        )
+                        try:
+                            data = cb.read_at(offset, size, lane=PEER_SERVE)
+                        finally:
+                            self.gate.release(size, tenant=self.tenant)
+                    else:
+                        # Pull-through: this node is the region owner —
+                        # fetch once through the local CachedBlob (its
+                        # singleflight table collapses the cluster's
+                        # concurrent requests); the flight itself admits
+                        # at PEER_SERVE lane.
+                        outcome = "pull"
+                        data = cb.read_at(offset, size, lane=PEER_SERVE)
+                    sp.annotate(outcome=outcome)
+                    SERVED_BYTES.inc(len(data))
+                    return 200, {
+                        "Content-Type": "application/octet-stream",
+                        "x-ntpu-peer-crc32": f"{_crc32(data):08x}",
+                        "x-ntpu-peer-outcome": outcome,
+                    }, data
+        except Exception as e:  # noqa: BLE001 - mapped to a wire status
+            outcome = "error"
+            logger.warning("peer serve %s[%d,+%d) failed: %s",
+                           blob_id[:12], offset, size, e)
+            return 500, {}, json.dumps({"message": str(e)}).encode()
+        finally:
+            SERVE_REQUESTS.labels(outcome).inc()
+            SERVE_MS.labels(outcome).observe((perf_counter() - t0) * 1000.0)
+
+    # -- server lifecycle ----------------------------------------------------
+
+    def run(self, address: str) -> None:
+        """Serve on ``address``: a UDS path or ``host:port``."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                status, extra, payload = server.handle(
+                    self.command, self.path, self.headers
+                )
+                self.send_response(status)
+                if "Content-Type" not in extra:
+                    self.send_header("Content-Type", "application/json")
+                for k, v in extra.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        addr = _normalize_addr(address)
+        if _is_uds(addr):
+            os.makedirs(os.path.dirname(addr) or ".", exist_ok=True)
+            try:
+                os.remove(addr)
+            except FileNotFoundError:
+                pass
+            self._httpd = _UnixHTTPServer(addr, Handler)
+        else:
+            host, _, port = addr.rpartition(":")
+            self._httpd = _TCPHTTPServer((host or "0.0.0.0", int(port)), Handler)
+        self.address = addr
+        threading.Thread(
+            target=self._httpd.serve_forever, name="ntpu-peer-serve", daemon=True
+        ).start()
+        logger.info("peer chunk server on %s", addr)
+
+    def stop(self) -> None:
+        self._closed = True
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self.address and _is_uds(self.address):
+            try:
+                os.remove(self.address)
+            except OSError:
+                pass
+        self.address = ""
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+class _UDSHTTPConnection(http.client.HTTPConnection):
+    def __init__(self, sock_path: str, timeout: float):
+        super().__init__("localhost", timeout=timeout)
+        self._sock_path = sock_path
+
+    def connect(self) -> None:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.timeout)
+        try:
+            s.connect(self._sock_path)
+        except BaseException:
+            # A dead peer must not leak the half-made socket (close()
+            # only knows about self.sock once the connect succeeded).
+            s.close()
+            raise
+        self.sock = s
+
+
+class PeerClient:
+    """One ranged read against one peer. Connections are per-call (peer
+    reads fan out across fetch workers; a UDS/TCP dial is cheap next to
+    the range it carries) and every phase is bounded by ``timeout_s``."""
+
+    def __init__(self, address: str, timeout_s: float = DEFAULT_TIMEOUT_MS / 1000.0):
+        self.address = _normalize_addr(address)
+        self.timeout_s = timeout_s
+
+    def _connect(self) -> http.client.HTTPConnection:
+        if _is_uds(self.address):
+            return _UDSHTTPConnection(self.address, self.timeout_s)
+        host, _, port = self.address.rpartition(":")
+        return http.client.HTTPConnection(
+            host or "localhost", int(port), timeout=self.timeout_s
+        )
+
+    def read_range(
+        self, blob_id: str, offset: int, size: int, depth: int = 0
+    ) -> bytes:
+        """Bytes of ``blob_id[offset, offset+size)`` from this peer.
+        Raises :class:`PeerMiss` when the peer doesn't cover the extent,
+        :class:`PeerError` on any transport/server/integrity failure."""
+        headers = {"x-ntpu-peer-depth": str(depth)}
+        ctx = trace.capture()
+        if ctx is not None and ctx.sampled:
+            headers["x-ntpu-trace-id"] = f"{ctx.trace_id:x}"
+            headers["x-ntpu-parent-id"] = f"{ctx.span_id:x}"
+        conn = self._connect()
+        try:
+            conn.request(
+                "GET",
+                f"{_BLOB_ROUTE}{blob_id}?offset={offset}&size={size}",
+                headers=headers,
+            )
+            resp = conn.getresponse()
+            payload = resp.read()
+            if resp.status == 404:
+                raise PeerMiss(f"peer {self.address} misses {blob_id}[{offset})")
+            if resp.status != 200:
+                raise PeerError(
+                    f"peer {self.address} -> {resp.status}: {payload[:120]!r}"
+                )
+            want_crc = resp.headers.get("x-ntpu-peer-crc32", "")
+        except (http.client.HTTPException, OSError) as e:
+            if isinstance(e, PeerError):
+                raise
+            raise PeerError(f"peer {self.address} request failed: {e}") from e
+        finally:
+            conn.close()
+        if len(payload) != size:
+            raise PeerError(
+                f"peer {self.address} returned {len(payload)} bytes, wanted {size}"
+            )
+        # Deliberately NOT the server's _crc32 helper: the two sides must
+        # compute independently for the check to mean anything (tests
+        # inject corruption by patching the server-side helper).
+        if want_crc and f"{zlib.crc32(payload) & 0xFFFFFFFF:08x}" != want_crc:
+            raise PeerError(f"peer {self.address} payload failed CRC32 check")
+        return payload
+
+    def stat(self) -> dict:
+        conn = self._connect()
+        try:
+            conn.request("GET", _STAT_ROUTE)
+            resp = conn.getresponse()
+            payload = resp.read()
+        except (http.client.HTTPException, OSError) as e:
+            raise PeerError(f"peer {self.address} stat failed: {e}") from e
+        finally:
+            conn.close()
+        if resp.status != 200:
+            raise PeerError(f"peer {self.address} stat -> {resp.status}")
+        return json.loads(payload)
+
+
+# ---------------------------------------------------------------------------
+# Router: which peer owns which region
+# ---------------------------------------------------------------------------
+
+
+class PeerRouter:
+    """Static peer list + rendezvous region ownership + shared health.
+
+    Every node, given the same ``[peer]`` list, independently computes the
+    same owner for a ``(blob, region)`` — the lookup map that needs no
+    gossip. Ownership walks the rendezvous ranking past unhealthy peers
+    (cooldown via the process-wide HostHealthRegistry), and returns None
+    when this node itself ranks first (fetch from origin: we ARE the
+    serve point for this region).
+    """
+
+    def __init__(
+        self,
+        peers: list[str],
+        self_address: str = "",
+        region_bytes: int = DEFAULT_REGION_KIB << 10,
+        health_registry=None,
+    ):
+        self.self_address = _normalize_addr(self_address)
+        self.peers = [
+            a for a in (_normalize_addr(p) for p in peers) if a
+        ]
+        self.region_bytes = max(1, int(region_bytes))
+        self.health = (
+            health_registry
+            if health_registry is not None
+            else mirror_mod.global_health_registry()
+        )
+
+    @staticmethod
+    def _score(addr: str, blob_id: str, region: int) -> int:
+        h = hashlib.blake2b(
+            f"{addr}|{blob_id}|{region}".encode(), digest_size=8
+        )
+        return int.from_bytes(h.digest(), "little")
+
+    def ranked(self, blob_id: str, offset: int) -> list[str]:
+        region = offset // self.region_bytes
+        members = set(self.peers)
+        if self.self_address:
+            members.add(self.self_address)
+        return sorted(
+            members,
+            key=lambda a: self._score(a, blob_id, region),
+            reverse=True,
+        )
+
+    def route(self, blob_id: str, offset: int) -> Optional[str]:
+        """The healthy peer to ask for this extent, or None for the
+        registry (self-owned region, or every peer cooling down)."""
+        for addr in self.ranked(blob_id, offset):
+            if addr == self.self_address:
+                return None
+            if self.health.health_for(
+                addr,
+                failure_limit=PEER_FAILURE_LIMIT,
+                cooldown=PEER_COOLDOWN_SECS,
+            ).available():
+                return addr
+        return None
+
+    def record(self, addr: str, ok: bool) -> None:
+        h = self.health.health_for(
+            addr, failure_limit=PEER_FAILURE_LIMIT, cooldown=PEER_COOLDOWN_SECS
+        )
+        if ok:
+            h.record_success()
+        else:
+            h.record_failure()
+
+
+# ---------------------------------------------------------------------------
+# The waterfall: registry -> peer -> local cache
+# ---------------------------------------------------------------------------
+
+
+class PeerAwareFetcher:
+    """Wraps a blob's origin ``fetch_range`` with the peer tier.
+
+    Drop-in for the callable CachedBlob takes: the fetch scheduler's
+    flights call ``read_range`` concurrently, each flight first trying
+    the extent's healthy region owner and falling back to the origin
+    fetcher on any failure — transparently, so a dead/slow/corrupt peer
+    never fails a read (chaos-pinned via the ``peer.fetch`` site).
+    """
+
+    def __init__(
+        self,
+        blob_id: str,
+        origin_fetch: Callable[[int, int], bytes],
+        router: PeerRouter,
+        timeout_s: float = 0.0,
+    ):
+        self.blob_id = blob_id
+        self.origin_fetch = origin_fetch
+        self.router = router
+        self.timeout_s = timeout_s or resolve_peer_config().timeout_s
+
+    def read_range(self, offset: int, size: int) -> bytes:
+        addr = self.router.route(self.blob_id, offset)
+        if addr is not None:
+            FETCH_REQUESTS.inc()
+            with trace.span(
+                "peer.fetch",
+                blob=self.blob_id[:8],
+                peer=addr,
+                offset=offset,
+                bytes=size,
+            ) as sp:
+                try:
+                    failpoint.hit("peer.fetch")
+                    data = PeerClient(addr, self.timeout_s).read_range(
+                        self.blob_id, offset, size
+                    )
+                    self.router.record(addr, ok=True)
+                    FETCH_BYTES.inc(size)
+                    sp.annotate(outcome="hit")
+                    return data
+                except Exception as e:  # noqa: BLE001 — any peer failure
+                    # degrades to the registry, never to the reader
+                    reason = self._reason(e)
+                    # A miss is an honest answer, not ill health.
+                    self.router.record(addr, ok=isinstance(e, PeerMiss))
+                    FETCH_FALLBACKS.labels(reason).inc()
+                    sp.annotate(outcome=f"fallback:{reason}")
+        return self.origin_fetch(offset, size)
+
+    @staticmethod
+    def _reason(e: Exception) -> str:
+        if isinstance(e, PeerMiss):
+            return "miss"
+        msg = str(e).lower()
+        if "timed out" in msg or "timeout" in msg:
+            return "timeout"
+        if "crc32" in msg:
+            return "corrupt"
+        return "error"
+
+
+# ---------------------------------------------------------------------------
+# Process wiring (cmd/snapshotter.py + daemon/server.py)
+# ---------------------------------------------------------------------------
+
+_default_lock = threading.Lock()
+_default_export: Optional[PeerExport] = None
+_default_router: Optional[PeerRouter] = None
+_default_server: Optional[PeerChunkServer] = None
+_default_resolved = False
+
+
+def default_export() -> PeerExport:
+    """The process-wide announce map local CachedBlobs register with."""
+    global _default_export
+    with _default_lock:
+        if _default_export is None:
+            _default_export = PeerExport()
+        return _default_export
+
+
+def default_router() -> Optional[PeerRouter]:
+    """The configured peer router, or None when the peer tier is off.
+    Resolved once per process from env/``[peer]`` config."""
+    global _default_router, _default_resolved
+    with _default_lock:
+        if not _default_resolved:
+            _default_resolved = True
+            cfg = resolve_peer_config()
+            if cfg.enable and cfg.peers:
+                _default_router = PeerRouter(
+                    cfg.peers,
+                    self_address=cfg.listen,
+                    region_bytes=cfg.region_bytes,
+                )
+        return _default_router
+
+
+def start_from_config() -> Optional[PeerChunkServer]:
+    """Start the chunk server when ``[peer]`` enables one (idempotent);
+    returns the running server (caller stops it on shutdown)."""
+    global _default_server
+    cfg = resolve_peer_config()
+    if not (cfg.enable and cfg.listen):
+        return None
+    with _default_lock:
+        if _default_server is not None:
+            return _default_server
+    server = PeerChunkServer(default_export(), pull_through=cfg.pull_through)
+    server.run(cfg.listen)
+    with _default_lock:
+        _default_server = server
+    return server
+
+
+def stop_default() -> None:
+    global _default_server, _default_router, _default_resolved
+    with _default_lock:
+        server = _default_server
+        _default_server = None
+        _default_router = None
+        _default_resolved = False
+    if server is not None:
+        server.stop()

@@ -129,6 +129,12 @@ class _Instance:
             self._cached_by_index.clear()
         # CachedBlob.close joins fetch workers; doing that under
         # _reader_lock would deadlock against a worker delivering.
+        if cached_blobs:
+            from nydus_snapshotter_tpu.daemon import peer as peer_mod
+
+            export = peer_mod.default_export()
+            for cached in cached_blobs:
+                export.unregister(cached.blob_id, cached)
         for cached in cached_blobs:
             try:
                 cached.close()
@@ -171,18 +177,35 @@ class _Instance:
                         RegistryBlobFetcher,
                     )
 
+                    from nydus_snapshotter_tpu.daemon import peer as peer_mod
+
                     cache_dir = cfg.cache.work_dir or os.path.join(blob_dir, "cache")
                     fetcher = RegistryBlobFetcher(cfg.backend, blob_id)
+                    fetch_range = fetcher.read_range
+                    # Peer waterfall: try the extent's healthy region
+                    # owner before the registry (daemon/peer.py); the
+                    # origin fetcher stays the transparent fallback.
+                    router = peer_mod.default_router()
+                    if router is not None:
+                        fetch_range = peer_mod.PeerAwareFetcher(
+                            blob_id, fetch_range, router
+                        ).read_range
                     cached = CachedBlob(
                         cache_dir,
                         blob_id,
-                        fetcher.read_range,
+                        fetch_range,
                         # Clamps readahead at the blob's end (the record's
                         # compressed_size IS the published data section).
                         blob_size=self.bootstrap.blobs[blob_index].compressed_size,
+                        # QoS tenant: the image repository — per-image
+                        # weighted fairness under a deploy storm.
+                        tenant=getattr(cfg.backend, "repo", "") or "default",
                     )
                     self._cached_blobs.append(cached)
                     self._cached_by_index[blob_index] = cached
+                    # Announce to the local peer chunk server: this node
+                    # can now serve the extents it caches.
+                    peer_mod.default_export().register(blob_id, cached)
                     read_at = cached.read_at
                 else:
                     f = open(os.path.join(blob_dir, blob_id), "rb")
@@ -208,8 +231,9 @@ class _Instance:
         """Warm the bootstrap's prefetch-table files (reference nydusd's
         --prefetch-files behavior) through the background replayer
         (daemon/fetch_sched.PrefetchReplayer): registry-backed blobs are
-        warmed at BACKGROUND fetch priority so demand reads always win the
-        worker pool, any other backend reads through the blob reader.
+        warmed at the PREFETCH lane (below demand and readahead) so
+        demand reads always win the worker pool and the admission gate,
+        any other backend reads through the blob reader.
         Returns bytes warmed; cancelled by umount. Errors are contained
         per file (hints, not requirements), warming counts only into
         prefetch_data_amount — not the fs read metrics, which track
@@ -872,6 +896,13 @@ def main(argv=None) -> int:
         workdir=args.workdir,
         upgrade=args.upgrade,
     )
+    # Peer chunk tier: the daemon process reaches the [peer] section via
+    # the NTPU_PEER* environment (like every blobcache knob); when it
+    # names a listen address, this daemon serves its cached extents to
+    # cluster peers (daemon/peer.py).
+    from nydus_snapshotter_tpu.daemon import peer as peer_mod
+
+    peer_mod.start_from_config()
     # shutdown() must not run on the main (serve_forever) thread: the signal
     # handler interrupts serve_forever's select, and BaseServer.shutdown()
     # then waits for a loop exit that can never happen — deadlock, daemon
@@ -883,6 +914,7 @@ def main(argv=None) -> int:
     try:
         server.serve_forever()
     finally:
+        peer_mod.stop_default()
         try:
             os.unlink(args.apisock)
         except OSError:

@@ -86,6 +86,9 @@ KNOWN_SITES = (
     "dict.insert",           # parallel/sharded_dict.py incremental insert batch
     "dict.rebuild",          # parallel/sharded_dict.py load-factor/overflow rebuild
     "dict.rpc",              # parallel/dict_service.py service request entry
+    "peer.serve",            # daemon/peer.py chunk-server request entry
+    "peer.fetch",            # daemon/peer.py peer-tier ranged read attempt
+    "peer.admit",            # daemon/fetch_sched.py AdmissionGate.acquire entry
 )
 
 _lock = _an.make_lock("failpoint.table")

@@ -60,6 +60,71 @@ def split_mirror_host(mirror_host: str) -> tuple[str, bool]:
     return mirror_host, False
 
 
+class HostHealthRegistry:
+    """Process-wide host → :class:`HostHealth` table.
+
+    The converter transport (``remote/transport.Pool``), the lazy-read
+    data plane (``daemon/blobcache.RegistryBlobFetcher``) and the peer
+    chunk tier (``daemon/peer.PeerRouter``) all score hosts through ONE
+    shared table, so a registry/mirror/peer demoted by any component is
+    avoided by every other one. The first caller's limits stick for a
+    host (limits are per-host deployment facts, not per-caller)."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._health: dict[str, HostHealth] = {}
+
+    def health_for(
+        self, host: str, failure_limit: int = 5, cooldown: float = 5.0
+    ) -> HostHealth:
+        with self._lock:
+            h = self._health.get(host)
+            if h is None:
+                h = HostHealth(
+                    failure_limit=failure_limit,
+                    cooldown=cooldown,
+                    clock=self._clock,
+                )
+                self._health[host] = h
+            return h
+
+    def health(self, host: str) -> Optional[HostHealth]:
+        with self._lock:
+            return self._health.get(host)
+
+    def record(self, host: str, ok: bool) -> None:
+        h = self.health_for(host)
+        if ok:
+            h.record_success()
+        else:
+            h.record_failure()
+
+    def available(self, host: str) -> bool:
+        return self.health_for(host).available()
+
+    def snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            return {
+                host: {
+                    "available": h.available(),
+                    "consecutive_failures": h.consecutive_failures,
+                    "down_until": h.down_until,
+                }
+                for host, h in self._health.items()
+            }
+
+
+_global_health = HostHealthRegistry()
+
+
+def global_health_registry() -> HostHealthRegistry:
+    """The one process-wide health table (see :class:`HostHealthRegistry`).
+    Components with an injected test clock build private registries
+    instead, so fake-clock tests never pollute the process table."""
+    return _global_health
+
+
 class MirrorRouter:
     """Orders mirror candidates per upstream registry host, health-aware."""
 
@@ -67,12 +132,20 @@ class MirrorRouter:
         self,
         mirrors_config_dir: str = "",
         clock: Callable[[], float] = time.monotonic,
+        health_registry: Optional["HostHealthRegistry"] = None,
     ):
         self.mirrors_config_dir = mirrors_config_dir
         self._clock = clock
         self._lock = threading.Lock()
         self._mirrors: dict[str, list[MirrorConfig]] = {}
-        self._health: dict[str, HostHealth] = {}
+        # Score through the process-wide table so the data plane sees the
+        # same demotions; a custom clock (tests) gets a private table.
+        if health_registry is not None:
+            self._registry = health_registry
+        elif clock is time.monotonic:
+            self._registry = global_health_registry()
+        else:
+            self._registry = HostHealthRegistry(clock=clock)
 
     def mirrors_for(self, registry_host: str) -> list[MirrorConfig]:
         """Configured mirrors for ``registry_host`` (cached per host)."""
@@ -95,20 +168,14 @@ class MirrorRouter:
         ]
 
     def _health_for(self, mirror: MirrorConfig) -> HostHealth:
-        with self._lock:
-            h = self._health.get(mirror.host)
-            if h is None:
-                h = HostHealth(
-                    failure_limit=mirror.failure_limit,
-                    cooldown=float(mirror.health_check_interval),
-                    clock=self._clock,
-                )
-                self._health[mirror.host] = h
-            return h
+        return self._registry.health_for(
+            mirror.host,
+            failure_limit=mirror.failure_limit,
+            cooldown=float(mirror.health_check_interval),
+        )
 
     def health(self, mirror_host: str) -> Optional[HostHealth]:
-        with self._lock:
-            return self._health.get(mirror_host)
+        return self._registry.health(mirror_host)
 
     def record(self, mirror: MirrorConfig, ok: bool) -> None:
         h = self._health_for(mirror)

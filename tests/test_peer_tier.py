@@ -1,0 +1,611 @@
+"""Peer chunk tier + QoS admission control (ISSUE 8).
+
+Covers the cluster data plane end to end, in-process:
+
+- AdmissionGate: strict priority lanes (the starvation property — demand
+  reads are never blocked behind prefetch or peer-serve traffic under a
+  saturated gate), demand-reserved slots, weighted-tenant fairness,
+  byte-cap serial degradation, abort;
+- PeerChunkServer/PeerClient: covered serves (CRC-verified), cover-only
+  vs pull-through, singleflight collapse of concurrent peer pulls;
+- the registry -> peer -> local-cache waterfall with chaos at the new
+  failpoint sites ``peer.serve`` / ``peer.fetch`` / ``peer.admit``:
+  failing, slow and corrupt peers all fall back to the registry with
+  byte-identical reads;
+- unified host-health scoring: transport, blobcache fetcher and peer
+  router share one process-wide HostHealthRegistry;
+- a mini in-process deploy storm (identity + bounded egress).
+"""
+
+import os
+import random
+import tempfile
+import threading
+import time
+
+import pytest
+
+from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.daemon import peer
+from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob, RegistryBlobFetcher
+from nydus_snapshotter_tpu.daemon.fetch_sched import (
+    DEMAND,
+    PEER_SERVE,
+    PREFETCH,
+    READAHEAD,
+    AdmissionGate,
+    FetchConfig,
+    MemoryBudget,
+    parse_tenant_weights,
+)
+from nydus_snapshotter_tpu.remote.mirror import (
+    HostHealthRegistry,
+    MirrorRouter,
+    global_health_registry,
+)
+
+BLOB = random.Random(11).randbytes(1 << 20)
+BLOB_ID = "cd" * 32
+
+
+def _gate(**kw):
+    kw.setdefault("budget", MemoryBudget(64 << 20))
+    kw.setdefault("name", "test")
+    return AdmissionGate(**kw)
+
+
+def _cached_blob(tmp, fetch, gate=None, tenant="default", **cfg_kw):
+    cfg_kw.setdefault("fetch_workers", 2)
+    cfg_kw.setdefault("merge_gap", 0)
+    cfg_kw.setdefault("readahead", 0)
+    return CachedBlob(
+        str(tmp),
+        BLOB_ID,
+        fetch,
+        blob_size=len(BLOB),
+        config=FetchConfig(**cfg_kw),
+        gate=gate or _gate(),
+        tenant=tenant,
+    )
+
+
+def _serving_pod(tmp, pull_through=True, warm_bytes=0):
+    """A pod with a CachedBlob (optionally pre-warmed) behind a running
+    chunk server on a fresh UDS. Returns (server, cached_blob, sock)."""
+    cb = _cached_blob(tmp, lambda off, n: BLOB[off : off + n])
+    if warm_bytes:
+        assert cb.read_at(0, warm_bytes) == BLOB[:warm_bytes]
+    export = peer.PeerExport()
+    export.register(BLOB_ID, cb)
+    srv = peer.PeerChunkServer(export, gate=cb.sched.gate, pull_through=pull_through)
+    sock = os.path.join(str(tmp), "peer.sock")
+    srv.run(sock)
+    return srv, cb, sock
+
+
+class _Origin:
+    """Counting origin fetcher (the simulated registry)."""
+
+    def __init__(self):
+        self.calls = []
+        self._mu = threading.Lock()
+
+    def fetch(self, off, n):
+        with self._mu:
+            self.calls.append((off, n))
+        return BLOB[off : off + n]
+
+
+# ---------------------------------------------------------------------------
+# Admission gate
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionGate:
+    def test_starvation_property_demand_never_behind_lower_lanes(self):
+        """Property: with the gate saturated and prefetch/peer-serve
+        waiters ALREADY queued, an arriving demand acquire is admitted
+        before any of them, every round."""
+        rng = random.Random(3)
+        for round_ in range(12):
+            gate = _gate(max_concurrent=1, demand_reserve=0, name=f"starve{round_}")
+            release_holder = threading.Event()
+            holder_in = threading.Event()
+            order = []
+            olock = threading.Lock()
+
+            def holder():
+                gate.acquire(1024, tenant="h", lane=PREFETCH)
+                holder_in.set()
+                release_holder.wait(10)
+                gate.release(1024, tenant="h")
+
+            def low(lane, tag):
+                gate.acquire(1024, tenant="bg", lane=lane)
+                with olock:
+                    order.append(tag)
+                gate.release(1024, tenant="bg")
+
+            def demand():
+                gate.acquire(1024, tenant="fg", lane=DEMAND)
+                with olock:
+                    order.append("demand")
+                gate.release(1024, tenant="fg")
+
+            ht = threading.Thread(target=holder)
+            ht.start()
+            assert holder_in.wait(5)
+            n_low = rng.randint(2, 5)
+            lows = [
+                threading.Thread(
+                    target=low,
+                    args=(rng.choice((PREFETCH, PEER_SERVE, READAHEAD)), f"low{i}"),
+                )
+                for i in range(n_low)
+            ]
+            for t in lows:
+                t.start()
+            # Lower-lane waiters are queued on the saturated gate first...
+            deadline = time.monotonic() + 5
+            while gate.snapshot()["queued"] < n_low:
+                assert time.monotonic() < deadline, "lower waiters never queued"
+                time.sleep(0.005)
+            # ...then demand arrives, then the slot frees.
+            dt = threading.Thread(target=demand)
+            dt.start()
+            while gate.snapshot()["queued"] < n_low + 1:
+                assert time.monotonic() < deadline, "demand never queued"
+                time.sleep(0.005)
+            release_holder.set()
+            for t in [ht, dt, *lows]:
+                t.join(10)
+                assert not t.is_alive(), "gate wedged"
+            assert order[0] == "demand", f"round {round_}: demand behind {order}"
+
+    def test_strict_priority_order_across_all_lanes(self):
+        gate = _gate(max_concurrent=1, demand_reserve=0, name="lanes")
+        gate.acquire(1, tenant="h", lane=DEMAND)
+        order = []
+        olock = threading.Lock()
+
+        def waiter(lane, tag):
+            gate.acquire(1, tenant=tag, lane=lane)
+            with olock:
+                order.append(lane)
+            time.sleep(0.01)  # hold so lower lanes stay blocked behind us
+            gate.release(1, tenant=tag)
+
+        threads = []
+        # Queue in REVERSE lane order so FIFO would invert priorities.
+        for lane in (PEER_SERVE, PREFETCH, READAHEAD, DEMAND):
+            t = threading.Thread(target=waiter, args=(lane, f"t{lane}"))
+            t.start()
+            threads.append(t)
+            deadline = time.monotonic() + 5
+            while gate.snapshot()["queued"] < len(threads):
+                assert time.monotonic() < deadline
+                time.sleep(0.002)
+        gate.release(1, tenant="h")
+        for t in threads:
+            t.join(10)
+            assert not t.is_alive()
+        assert order == [DEMAND, READAHEAD, PREFETCH, PEER_SERVE]
+
+    def test_demand_reserve_slot_is_off_limits_to_lower_lanes(self):
+        gate = _gate(max_concurrent=2, demand_reserve=1, name="reserve")
+        gate.acquire(1, tenant="bg", lane=PREFETCH)
+        # The second slot is demand-reserved: a lower lane must queue...
+        done = threading.Event()
+
+        def second_low():
+            gate.acquire(1, tenant="bg2", lane=PEER_SERVE)
+            done.set()
+            gate.release(1, tenant="bg2")
+
+        t = threading.Thread(target=second_low)
+        t.start()
+        time.sleep(0.1)
+        assert not done.is_set(), "lower lane took the demand-reserved slot"
+        # ...while demand sails straight through it.
+        waited = gate.acquire(1, tenant="fg", lane=DEMAND)
+        assert waited < 0.05
+        gate.release(1, tenant="fg")
+        gate.release(1, tenant="bg")
+        t.join(10)
+        assert done.is_set()
+
+    def test_weighted_fairness_two_to_one(self):
+        gate = _gate(
+            max_concurrent=3,
+            demand_reserve=1,
+            weights={"a": 2.0, "b": 1.0},
+            name="fair",
+        )
+        stop = threading.Event()
+
+        def worker(tenant):
+            while not stop.is_set():
+                gate.acquire(4096, tenant=tenant, lane=DEMAND)
+                try:
+                    time.sleep(0.002)
+                finally:
+                    gate.release(4096, tenant=tenant)
+
+        threads = [
+            threading.Thread(target=worker, args=(t,), daemon=True)
+            for t in ("a", "a", "a", "b", "b", "b")
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        base_a, base_b = gate.service_bytes("a"), gate.service_bytes("b")
+        time.sleep(1.0)
+        got_a = gate.service_bytes("a") - base_a
+        got_b = gate.service_bytes("b") - base_b
+        stop.set()
+        for t in threads:
+            t.join(10)
+        share = got_a / max(1, got_a + got_b)
+        assert abs(share - 2 / 3) / (2 / 3) < 0.25, (got_a, got_b, share)
+
+    def test_byte_cap_degrades_to_serial_not_deadlock(self):
+        gate = _gate(budget=MemoryBudget(1 << 20), max_concurrent=4, name="cap")
+        # One op bigger than the whole cap is admitted alone.
+        assert gate.acquire(8 << 20, tenant="big") >= 0
+        done = threading.Event()
+
+        def second():
+            gate.acquire(1 << 10, tenant="small")
+            done.set()
+            gate.release(1 << 10, tenant="small")
+
+        t = threading.Thread(target=second)
+        t.start()
+        time.sleep(0.05)
+        assert not done.is_set(), "byte cap ignored while oversized op held"
+        gate.release(8 << 20, tenant="big")
+        t.join(10)
+        assert done.is_set()
+
+    def test_abort_surfaces_as_oserror(self):
+        gate = _gate(max_concurrent=1, name="abort")
+        gate.acquire(1, tenant="h")
+        with pytest.raises(OSError, match="aborted"):
+            gate.acquire(1, tenant="x", aborted=lambda: True)
+        gate.release(1, tenant="h")
+
+    def test_admit_failpoint_delay_and_error(self):
+        gate = _gate(name="fp")
+        with failpoint.injected("peer.admit", "delay(0.01)"):
+            assert gate.acquire(1, tenant="t") >= 0
+        gate.release(1, tenant="t")
+        with failpoint.injected("peer.admit", "error(OSError)"):
+            with pytest.raises(OSError):
+                gate.acquire(1, tenant="t")
+
+    def test_parse_tenant_weights(self):
+        assert parse_tenant_weights("a=2,b=1.5, c=3 ,bad,x=0,y=-1") == {
+            "a": 2.0,
+            "b": 1.5,
+            "c": 3.0,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Chunk server + client
+# ---------------------------------------------------------------------------
+
+
+class TestPeerServer:
+    def test_covered_extent_served_byte_identical(self, tmp_path):
+        srv, cb, sock = _serving_pod(tmp_path, warm_bytes=256 << 10)
+        try:
+            cli = peer.PeerClient(sock, 5.0)
+            got = cli.read_range(BLOB_ID, 4096, 64 << 10)
+            assert got == BLOB[4096 : 4096 + (64 << 10)]
+            stat = cli.stat()
+            assert stat["blobs"][BLOB_ID]["covered_bytes"] >= 256 << 10
+        finally:
+            srv.stop()
+            cb.close()
+
+    def test_unknown_blob_and_cover_only_miss(self, tmp_path):
+        srv, cb, sock = _serving_pod(tmp_path, warm_bytes=4096)
+        try:
+            cli = peer.PeerClient(sock, 5.0)
+            with pytest.raises(peer.PeerMiss):
+                cli.read_range("ff" * 32, 0, 4096)
+            # depth=1 forbids pull-through: uncovered extent is a miss,
+            # and the server must NOT have fetched it on our behalf.
+            with pytest.raises(peer.PeerMiss):
+                cli.read_range(BLOB_ID, 512 << 10, 4096, depth=1)
+            assert not cb.covered(512 << 10, 4096)
+        finally:
+            srv.stop()
+            cb.close()
+
+    def test_pull_through_disabled_is_cover_only(self, tmp_path):
+        srv, cb, sock = _serving_pod(tmp_path, pull_through=False, warm_bytes=4096)
+        try:
+            with pytest.raises(peer.PeerMiss):
+                peer.PeerClient(sock, 5.0).read_range(BLOB_ID, 512 << 10, 4096)
+        finally:
+            srv.stop()
+            cb.close()
+
+    def test_pull_through_singleflights_concurrent_peers(self, tmp_path):
+        origin = _Origin()
+        cb = _cached_blob(tmp_path, origin.fetch)
+        export = peer.PeerExport()
+        export.register(BLOB_ID, cb)
+        srv = peer.PeerChunkServer(export, gate=cb.sched.gate, pull_through=True)
+        sock = os.path.join(str(tmp_path), "pull.sock")
+        srv.run(sock)
+        try:
+            results = []
+            errors = []
+            barrier = threading.Barrier(6)
+
+            def puller():
+                try:
+                    barrier.wait(5)
+                    results.append(
+                        peer.PeerClient(sock, 10.0).read_range(BLOB_ID, 8192, 4096)
+                    )
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [threading.Thread(target=puller) for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(20)
+            assert not errors, errors
+            assert all(r == BLOB[8192 : 8192 + 4096] for r in results)
+            # The cluster's concurrent pulls collapsed into ONE origin GET.
+            assert len(origin.calls) == 1, origin.calls
+        finally:
+            srv.stop()
+            cb.close()
+
+    def test_export_unregister_only_drops_own_instance(self, tmp_path):
+        export = peer.PeerExport()
+        a, b = object(), object()
+        export.register("x", a)
+        export.register("x", b)  # replaces
+        export.unregister("x", a)  # stale close: must not drop b
+        assert export.get("x") is b
+        export.unregister("x", b)
+        assert export.get("x") is None
+
+
+# ---------------------------------------------------------------------------
+# Waterfall + chaos at peer.{serve,fetch,admit}
+# ---------------------------------------------------------------------------
+
+
+def _client_router(sock, registry=None):
+    """Router that sends every region to the one peer (client-only pod)."""
+    return peer.PeerRouter(
+        [sock],
+        self_address="",
+        region_bytes=64 << 10,
+        health_registry=registry or HostHealthRegistry(),
+    )
+
+
+def _read_all(cb, chunk=64 << 10):
+    out = []
+    for off in range(0, len(BLOB), chunk):
+        out.append(cb.read_at(off, min(chunk, len(BLOB) - off)))
+    return b"".join(out)
+
+
+class TestPeerWaterfall:
+    def test_peer_hit_skips_origin(self, tmp_path):
+        srv, scb, sock = _serving_pod(tmp_path / "srv", warm_bytes=len(BLOB))
+        origin = _Origin()
+        fetcher = peer.PeerAwareFetcher(
+            BLOB_ID, origin.fetch, _client_router(sock), timeout_s=5.0
+        )
+        cb = _cached_blob(tmp_path / "cli", fetcher.read_range)
+        try:
+            assert _read_all(cb) == BLOB
+            assert origin.calls == [], "origin contacted despite full peer"
+        finally:
+            srv.stop()
+            scb.close()
+            cb.close()
+
+    def test_dead_peer_falls_back_and_cools_down(self, tmp_path):
+        origin = _Origin()
+        registry = HostHealthRegistry()
+        sock = os.path.join(str(tmp_path), "never-started.sock")
+        router = _client_router(sock, registry)
+        fetcher = peer.PeerAwareFetcher(BLOB_ID, origin.fetch, router, timeout_s=0.5)
+        cb = _cached_blob(tmp_path / "cli", fetcher.read_range)
+        try:
+            assert _read_all(cb) == BLOB
+            assert origin.calls, "no registry fallback"
+            # After failure_limit consecutive errors the peer cools down
+            # and later extents route straight to the registry.
+            assert not registry.available(sock)
+            assert router.route(BLOB_ID, 0) is None
+        finally:
+            cb.close()
+
+    def test_slow_peer_times_out_to_registry(self, tmp_path):
+        srv, scb, sock = _serving_pod(tmp_path / "srv", warm_bytes=len(BLOB))
+        origin = _Origin()
+        fetcher = peer.PeerAwareFetcher(
+            BLOB_ID, origin.fetch, _client_router(sock), timeout_s=0.2
+        )
+        cb = _cached_blob(tmp_path / "cli", fetcher.read_range)
+        try:
+            with failpoint.injected("peer.serve", "delay(1.5)"):
+                assert cb.read_at(0, 4096) == BLOB[:4096]
+            assert origin.calls, "slow peer did not fall back"
+        finally:
+            srv.stop()
+            scb.close()
+            cb.close()
+
+    def test_failing_peer_falls_back_byte_identical(self, tmp_path):
+        srv, scb, sock = _serving_pod(tmp_path / "srv", warm_bytes=len(BLOB))
+        origin = _Origin()
+        fetcher = peer.PeerAwareFetcher(
+            BLOB_ID, origin.fetch, _client_router(sock), timeout_s=5.0
+        )
+        cb = _cached_blob(tmp_path / "cli", fetcher.read_range)
+        try:
+            with failpoint.injected("peer.serve", "error(OSError)"):
+                assert _read_all(cb) == BLOB
+            assert len(origin.calls) == len(BLOB) // (64 << 10)
+        finally:
+            srv.stop()
+            scb.close()
+            cb.close()
+
+    def test_corrupt_peer_payload_fails_crc_and_falls_back(
+        self, tmp_path, monkeypatch
+    ):
+        srv, scb, sock = _serving_pod(tmp_path / "srv", warm_bytes=len(BLOB))
+        origin = _Origin()
+        fetcher = peer.PeerAwareFetcher(
+            BLOB_ID, origin.fetch, _client_router(sock), timeout_s=5.0
+        )
+        cb = _cached_blob(tmp_path / "cli", fetcher.read_range)
+        before = peer.FETCH_FALLBACKS.value("corrupt")
+        try:
+            # The server stamps a wrong checksum: transit corruption as
+            # seen by the client's independent CRC pass.
+            monkeypatch.setattr(peer, "_crc32", lambda data: 0xDEADBEEF)
+            assert cb.read_at(0, 4096) == BLOB[:4096]
+            assert origin.calls, "corrupt payload was accepted"
+            assert peer.FETCH_FALLBACKS.value("corrupt") == before + 1
+        finally:
+            srv.stop()
+            scb.close()
+            cb.close()
+
+    def test_fetch_failpoint_falls_back(self, tmp_path):
+        srv, scb, sock = _serving_pod(tmp_path / "srv", warm_bytes=len(BLOB))
+        origin = _Origin()
+        fetcher = peer.PeerAwareFetcher(
+            BLOB_ID, origin.fetch, _client_router(sock), timeout_s=5.0
+        )
+        cb = _cached_blob(tmp_path / "cli", fetcher.read_range)
+        try:
+            with failpoint.injected("peer.fetch", "error(OSError)*2"):
+                assert cb.read_at(0, 128 << 10) == BLOB[: 128 << 10]
+            assert origin.calls, "peer.fetch chaos did not fall back"
+        finally:
+            srv.stop()
+            scb.close()
+            cb.close()
+
+    def test_admit_chaos_delay_keeps_reads_identical(self, tmp_path):
+        origin = _Origin()
+        cb = _cached_blob(tmp_path, origin.fetch)
+        try:
+            with failpoint.injected("peer.admit", "delay(0.005)"):
+                assert cb.read_at(0, 128 << 10) == BLOB[: 128 << 10]
+        finally:
+            cb.close()
+
+    def test_self_owned_region_goes_to_origin(self, tmp_path):
+        router = peer.PeerRouter(
+            ["peerA", "peerB"],
+            self_address="peerA",
+            region_bytes=4096,
+            health_registry=HostHealthRegistry(),
+        )
+        routes = {router.route(BLOB_ID, off) for off in range(0, 1 << 20, 4096)}
+        # Some regions are self-owned (None -> registry), the rest go to
+        # the other peer; we never route to ourselves.
+        assert None in routes
+        assert "peerB" in routes
+        assert "peerA" not in routes
+
+
+# ---------------------------------------------------------------------------
+# Unified host-health scoring (satellite: one process-wide table)
+# ---------------------------------------------------------------------------
+
+
+class TestHostHealthUnification:
+    def test_fetcher_and_mirror_router_share_the_global_table(self):
+        from types import SimpleNamespace
+
+        host = "unify-test-host.invalid"
+        backend = SimpleNamespace(
+            host=host, repo="r", scheme="https", auth="", skip_verify=False,
+            mirrors=[],
+        )
+        fetcher = RegistryBlobFetcher(backend, "ab" * 32)
+        router = MirrorRouter()
+        shared = global_health_registry().health_for(host)
+        assert fetcher._health[host] is shared
+        # A demotion recorded by one component is seen by the other.
+        for _ in range(shared.failure_limit):
+            global_health_registry().record(host, ok=False)
+        assert not fetcher._health[host].available()
+        assert router._registry.health_for(host) is shared
+        global_health_registry().record(host, ok=True)  # clean up
+
+    def test_custom_clock_gets_a_private_table(self):
+        from types import SimpleNamespace
+
+        host = "private-clock-host.invalid"
+        fake_now = [0.0]
+        backend = SimpleNamespace(
+            host=host, repo="r", scheme="https", auth="", skip_verify=False,
+            mirrors=[],
+        )
+        fetcher = RegistryBlobFetcher(backend, "ab" * 32, clock=lambda: fake_now[0])
+        assert global_health_registry().health(host) is None
+        assert fetcher._health[host] is not None
+
+    def test_peer_router_scores_through_the_given_table(self):
+        registry = HostHealthRegistry()
+        router = peer.PeerRouter(
+            ["p1"], self_address="", health_registry=registry
+        )
+        for _ in range(peer.PEER_FAILURE_LIMIT):
+            router.record("p1", ok=False)
+        assert not registry.available("p1")
+        assert router.route(BLOB_ID, 0) is None
+
+
+# ---------------------------------------------------------------------------
+# Mini in-process deploy storm
+# ---------------------------------------------------------------------------
+
+
+class TestMiniStorm:
+    def test_four_pod_storm_identity_and_bounded_egress(self, tmp_path):
+        import hashlib
+
+        from tools.cluster_storm_profile import StormRegistry, _run_storm
+
+        blob = random.Random(5).randbytes(512 << 10)
+        registry = StormRegistry(blob, latency_s=0.001, mibps=64.0)
+        wall, egress, calls, digests = _run_storm(
+            str(tmp_path), blob, "ee" * 32, 4, True, registry
+        )
+        oracle = hashlib.sha256(blob).hexdigest()
+        assert all(d == oracle for d in digests)
+        assert egress <= 1.5 * len(blob), (egress, len(blob))
+
+    def test_four_pod_storm_peer_kill_falls_back(self, tmp_path):
+        import hashlib
+
+        from tools.cluster_storm_profile import StormRegistry, _run_storm
+
+        blob = random.Random(6).randbytes(256 << 10)
+        registry = StormRegistry(blob, latency_s=0.001, mibps=64.0)
+        _, _, _, digests = _run_storm(
+            str(tmp_path), blob, "ee" * 32, 4, True, registry, kill_at_frac=0.25
+        )
+        oracle = hashlib.sha256(blob).hexdigest()
+        assert all(d == oracle for d in digests)

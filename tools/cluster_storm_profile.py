@@ -1,0 +1,494 @@
+"""Cluster deploy-storm profile: N simulated pods cold-start the same
+image against one bandwidth-constrained registry, peers on vs peers off.
+
+Each "pod" is a thread-simulated node in the style of
+``tools/lazy_read_profile.py``: its own cache dir + CachedBlob, its own
+admission gate, its own peer chunk server on a UDS, and a PeerRouter over
+the full static pod list — the registry -> peer -> local-cache waterfall
+exactly as deployed (daemon/peer.py). The registry is simulated
+in-process with a serialized-uplink bandwidth model: concurrent requests
+queue on one origin pipe, which is the regime a deploy storm collapses.
+
+Gates (abort-on-fail, per ISSUE 8 acceptance):
+
+- **identity**: every pod's reassembled reads are byte-identical to the
+  serial single-node path;
+- **egress**: with peers on, registry egress <= ``EGRESS_FACTOR`` x the
+  unique chunk bytes (vs ~N x with peers off);
+- **speedup**: the aggregate storm wall is >= ``SPEEDUP_MIN`` x faster
+  than the peers-off path — measured with paired best-rep ratios PLUS
+  the wall-noise-free analytic bound (egress_bytes / bandwidth ratio,
+  which is what the serialized origin pipe physically enforces);
+- **failover**: with every peer killed mid-storm the run still completes
+  byte-identical via registry fallback;
+- **fairness**: two tenants at 2:1 weights under a saturated admission
+  gate receive in-flight byte service within 25% of their configured
+  share, and demand-read p95 latency under storm-lane load stays within
+  2x the unloaded p95 (demand-reserved slots + strict priority lanes).
+
+Usage: python tools/cluster_storm_profile.py [--pods 16] [--mib 2]
+           [--reps 2] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CHUNK = 64 << 10
+# Constrained origin uplink: the regime the storm gate measures. 12 MiB/s
+# makes the peers-off arm pipe-bound (N x blob / bw) while the peers-on
+# arm pays it ~once, so the ratio reflects egress, not Python overhead.
+BANDWIDTH_MIBPS = 12.0
+LATENCY_S = 0.002
+PEER_TIMEOUT_S = 10.0
+EGRESS_FACTOR = 1.5
+SPEEDUP_MIN = 3.0
+FAIRNESS_TOL = 0.25
+QOS_P95_FACTOR = 2.0
+
+
+class StormRegistry:
+    """Shared origin with a serialized uplink: every ranged GET pays a
+    fixed latency plus queued pipe time (size / bandwidth) on ONE pipe,
+    so aggregate egress directly bounds aggregate wall — the analytic
+    arm of the speedup gate."""
+
+    def __init__(self, blob: bytes, latency_s: float, mibps: float):
+        self.blob = blob
+        self.latency_s = latency_s
+        self.byte_s = 1.0 / (mibps * (1 << 20))
+        self.egress = 0
+        self.calls = 0
+        self._lock = threading.Lock()
+        self._pipe_free_at = 0.0
+
+    def reset(self) -> None:
+        with self._lock:
+            self.egress = 0
+            self.calls = 0
+            self._pipe_free_at = 0.0
+
+    def fetch(self, off: int, size: int) -> bytes:
+        if off + size > len(self.blob):
+            raise OSError(f"range [{off}, {off + size}) past blob end")
+        now = time.perf_counter()
+        with self._lock:
+            self.egress += size
+            self.calls += 1
+            start = max(now, self._pipe_free_at)
+            self._pipe_free_at = start + size * self.byte_s
+            free_at = self._pipe_free_at
+        time.sleep(max(0.0, free_at - now) + self.latency_s)
+        return self.blob[off : off + size]
+
+
+class Pod:
+    """One simulated node: CachedBlob + admission gate + peer server."""
+
+    def __init__(self, idx, workdir, blob_id, blob_len, registry, addrs,
+                 peers_on, region_bytes):
+        from nydus_snapshotter_tpu.daemon import peer
+        from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+        from nydus_snapshotter_tpu.daemon.fetch_sched import (
+            AdmissionGate,
+            FetchConfig,
+            MemoryBudget,
+        )
+
+        self.idx = idx
+        self.addr = addrs[idx]
+        self.gate = AdmissionGate(
+            budget=MemoryBudget(64 << 20),
+            max_concurrent=8,
+            demand_reserve=1,
+            name=f"pod{idx}",
+        )
+        fetch_range = registry.fetch
+        if peers_on:
+            # Pods share one health table per storm (a cluster-wide view
+            # would be per-node; sharing only makes failover stricter).
+            self.router = peer.PeerRouter(
+                addrs,
+                self_address=self.addr,
+                region_bytes=region_bytes,
+                health_registry=_STORM_HEALTH,
+            )
+            fetch_range = peer.PeerAwareFetcher(
+                blob_id, registry.fetch, self.router, timeout_s=PEER_TIMEOUT_S
+            ).read_range
+        self.cb = CachedBlob(
+            os.path.join(workdir, f"pod{idx}"),
+            blob_id,
+            fetch_range,
+            blob_size=blob_len,
+            config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0),
+            gate=self.gate,
+            tenant=f"pod{idx}",
+        )
+        self.server = None
+        if peers_on:
+            export = peer.PeerExport()
+            export.register(blob_id, self.cb)
+            self.server = peer.PeerChunkServer(
+                export, gate=self.gate, pull_through=True
+            )
+            self.server.run(self.addr)
+
+    def stop_server(self) -> None:
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+
+    def close(self) -> None:
+        self.stop_server()
+        self.cb.close()
+
+
+_STORM_HEALTH = None
+
+
+def _chunk_plan(blob_len: int) -> list:
+    return [
+        (off, min(CHUNK, blob_len - off)) for off in range(0, blob_len, CHUNK)
+    ]
+
+
+def _run_storm(workdir, blob, blob_id, pods, peers_on, registry,
+               kill_at_frac=None):
+    """One storm rep: all pods cold-read the full chunk plan
+    concurrently. Returns (wall_s, egress_bytes, origin_calls,
+    per-pod sha256 list)."""
+    import hashlib
+
+    global _STORM_HEALTH
+    from nydus_snapshotter_tpu.remote.mirror import HostHealthRegistry
+
+    _STORM_HEALTH = HostHealthRegistry()
+    registry.reset()
+    sockdir = tempfile.mkdtemp(prefix="storm-sock-", dir="/tmp")
+    addrs = [os.path.join(sockdir, f"p{i}.sock") for i in range(pods)]
+    region_bytes = CHUNK
+    nodes = [
+        Pod(i, workdir, blob_id, len(blob), registry, addrs, peers_on,
+            region_bytes)
+        for i in range(pods)
+    ]
+    plan = _chunk_plan(len(blob))
+    digests = [None] * pods
+    errors = []
+    kill_idx = (
+        int(len(plan) * kill_at_frac) if kill_at_frac is not None else None
+    )
+    killed = threading.Event()
+
+    def run_pod(i):
+        h = hashlib.sha256()
+        try:
+            for n, (off, size) in enumerate(plan):
+                # Pod 0 plays the chaos monkey: one killer, every server.
+                if (
+                    i == 0
+                    and kill_idx is not None
+                    and n >= kill_idx
+                    and not killed.is_set()
+                ):
+                    killed.set()
+                    for node in nodes:
+                        node.stop_server()
+                h.update(nodes[i].cb.read_at(off, size))
+            digests[i] = h.hexdigest()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(f"pod{i}: {e!r}")
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=run_pod, args=(i,)) for i in range(pods)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    for node in nodes:
+        node.close()
+    shutil.rmtree(sockdir, ignore_errors=True)
+    if errors:
+        raise AssertionError(f"storm pod failures: {errors[:4]}")
+    return wall, registry.egress, registry.calls, digests
+
+
+def _fairness_phase() -> dict:
+    """Saturate one gate with two weighted tenants; measure service
+    split and demand p95 under lower-lane load vs unloaded."""
+    from nydus_snapshotter_tpu.daemon.fetch_sched import (
+        DEMAND,
+        PEER_SERVE,
+        PREFETCH,
+        AdmissionGate,
+        MemoryBudget,
+    )
+
+    gate = AdmissionGate(
+        budget=MemoryBudget(64 << 20),
+        max_concurrent=3,
+        demand_reserve=1,
+        weights={"team-a": 2.0, "team-b": 1.0},
+        name="fairness",
+    )
+    op_s = 0.004
+    n_bytes = 64 << 10
+    stop = threading.Event()
+
+    def tenant_worker(tenant):
+        while not stop.is_set():
+            gate.acquire(n_bytes, tenant=tenant, lane=DEMAND)
+            try:
+                time.sleep(op_s)
+            finally:
+                gate.release(n_bytes, tenant=tenant)
+
+    workers = [
+        threading.Thread(target=tenant_worker, args=(t,), daemon=True)
+        for t in ("team-a", "team-a", "team-a", "team-b", "team-b", "team-b")
+    ]
+    for w in workers:
+        w.start()
+    time.sleep(0.3)  # warm-up out of the virgin state
+    base_a = gate.service_bytes("team-a")
+    base_b = gate.service_bytes("team-b")
+    time.sleep(1.5)
+    got_a = gate.service_bytes("team-a") - base_a
+    got_b = gate.service_bytes("team-b") - base_b
+    stop.set()
+    for w in workers:
+        w.join()
+    share_a = got_a / max(1, got_a + got_b)
+    want_a = 2.0 / 3.0
+
+    # Demand p95 under storm-lane load vs unloaded, same gate shape.
+    def demand_p95(loaded: bool) -> float:
+        g = AdmissionGate(
+            budget=MemoryBudget(64 << 20),
+            max_concurrent=3,
+            demand_reserve=1,
+            name="qos",
+        )
+        stop2 = threading.Event()
+
+        def flood(lane):
+            while not stop2.is_set():
+                g.acquire(n_bytes, tenant="bg", lane=lane)
+                try:
+                    time.sleep(op_s)
+                finally:
+                    g.release(n_bytes, tenant="bg")
+
+        floods = []
+        if loaded:
+            floods = [
+                threading.Thread(target=flood, args=(lane,), daemon=True)
+                for lane in (PREFETCH, PREFETCH, PEER_SERVE, PEER_SERVE)
+            ]
+            for f in floods:
+                f.start()
+            time.sleep(0.1)
+        lat = []
+        for _ in range(150):
+            t0 = time.perf_counter()
+            g.acquire(n_bytes, tenant="fg", lane=DEMAND)
+            try:
+                time.sleep(op_s)
+            finally:
+                g.release(n_bytes, tenant="fg")
+            lat.append(time.perf_counter() - t0)
+        stop2.set()
+        for f in floods:
+            f.join()
+        lat.sort()
+        return lat[int(len(lat) * 0.95)]
+
+    p95_unloaded = demand_p95(loaded=False)
+    p95_storm = demand_p95(loaded=True)
+    return {
+        "service_bytes": {"team-a": got_a, "team-b": got_b},
+        "share_a": round(share_a, 4),
+        "share_a_target": round(want_a, 4),
+        "share_err": round(abs(share_a - want_a) / want_a, 4),
+        "demand_p95_unloaded_ms": round(p95_unloaded * 1000, 3),
+        "demand_p95_storm_ms": round(p95_storm * 1000, 3),
+        "p95_ratio": round(p95_storm / max(1e-9, p95_unloaded), 3),
+    }
+
+
+def profile(pods: int = 16, mib: int = 2, reps: int = 2, seed: int = 7) -> dict:
+    assert pods >= 2, "storm needs at least 2 pods"
+    blob = random.Random(seed).randbytes(mib << 20)
+    blob_id = "ab" * 32
+    registry = StormRegistry(blob, LATENCY_S, BANDWIDTH_MIBPS)
+    gates: list[str] = []
+
+    workroot = tempfile.mkdtemp(prefix="cluster-storm-")
+    try:
+        # Serial single-node oracle (1 pod, peers off).
+        import hashlib
+
+        serial_wall, serial_egress, _, serial_digests = _run_storm(
+            os.path.join(workroot, "serial"), blob, blob_id, 1, False, registry
+        )
+        oracle = hashlib.sha256(blob).hexdigest()
+        if serial_digests[0] != oracle:
+            gates.append("serial path not byte-identical to the source blob")
+
+        # Paired reps, interleaved: off, on, off, on ... best rep each.
+        walls_off, walls_on = [], []
+        egress_off = egress_on = 0
+        calls_on = 0
+        for r in range(reps):
+            w_off, e_off, _, d_off = _run_storm(
+                os.path.join(workroot, f"off{r}"), blob, blob_id, pods,
+                False, registry,
+            )
+            walls_off.append(w_off)
+            egress_off = e_off
+            if any(d != oracle for d in d_off):
+                gates.append(f"peers-off rep {r}: pod bytes differ from serial")
+            w_on, e_on, c_on, d_on = _run_storm(
+                os.path.join(workroot, f"on{r}"), blob, blob_id, pods,
+                True, registry,
+            )
+            walls_on.append(w_on)
+            egress_on = e_on
+            calls_on = c_on
+            if any(d != oracle for d in d_on):
+                gates.append(f"peers-on rep {r}: pod bytes differ from serial")
+
+        unique = len(blob)
+        egress_ratio_on = egress_on / unique
+        egress_ratio_off = egress_off / unique
+        if egress_ratio_on > EGRESS_FACTOR:
+            gates.append(
+                f"egress {egress_ratio_on:.2f}x unique bytes with peers on "
+                f"(gate {EGRESS_FACTOR}x)"
+            )
+        best_off, best_on = min(walls_off), min(walls_on)
+        measured_ratio = best_off / max(1e-9, best_on)
+        # Analytic bound: the serialized pipe makes wall >= egress/bw on
+        # both arms, so the egress ratio IS the noise-free speedup floor.
+        analytic_ratio = egress_off / max(1, egress_on)
+        # Scale the gate for mini storms (CI runs --pods 4): the win is
+        # bounded by pod count; at >=16 pods the full 3x gate applies.
+        speedup_gate = SPEEDUP_MIN if pods >= 16 else min(
+            SPEEDUP_MIN, pods / 2.0
+        )
+        if measured_ratio < speedup_gate:
+            gates.append(
+                f"measured storm speedup {measured_ratio:.2f}x < "
+                f"{speedup_gate}x (best-rep paired)"
+            )
+        if analytic_ratio < speedup_gate:
+            gates.append(
+                f"analytic egress-bound speedup {analytic_ratio:.2f}x < "
+                f"{speedup_gate}x"
+            )
+
+        # Failover: kill every peer server ~30% into the storm.
+        _, kill_egress, _, kill_digests = _run_storm(
+            os.path.join(workroot, "kill"), blob, blob_id,
+            max(2, pods // 2), True, registry, kill_at_frac=0.3,
+        )
+        if any(d != oracle for d in kill_digests):
+            gates.append("mid-storm peer kill: pod bytes differ from serial")
+
+        fairness = _fairness_phase()
+        if fairness["share_err"] > FAIRNESS_TOL:
+            gates.append(
+                f"tenant share error {fairness['share_err']:.2%} > "
+                f"{FAIRNESS_TOL:.0%} of the 2:1 target"
+            )
+        if fairness["p95_ratio"] > QOS_P95_FACTOR:
+            gates.append(
+                f"demand p95 under storm {fairness['p95_ratio']}x unloaded "
+                f"(gate {QOS_P95_FACTOR}x)"
+            )
+
+        leaked = [
+            t.name
+            for t in threading.enumerate()
+            if t.name.startswith(("ntpu-fetch", "ntpu-peer"))
+        ]
+        if leaked:
+            gates.append(f"leaked threads: {leaked}")
+
+        return {
+            "pods": pods,
+            "blob_mib": mib,
+            "chunk_kib": CHUNK >> 10,
+            "bandwidth_mibps": BANDWIDTH_MIBPS,
+            "reps": reps,
+            "serial_wall_s": round(serial_wall, 4),
+            "storm_wall_off_s": [round(w, 4) for w in walls_off],
+            "storm_wall_on_s": [round(w, 4) for w in walls_on],
+            "best_wall_off_s": round(best_off, 4),
+            "best_wall_on_s": round(best_on, 4),
+            "egress_off_bytes": egress_off,
+            "egress_on_bytes": egress_on,
+            "egress_ratio_off": round(egress_ratio_off, 3),
+            "egress_ratio_on": round(egress_ratio_on, 3),
+            "origin_calls_on": calls_on,
+            "measured_speedup": round(measured_ratio, 3),
+            "analytic_speedup": round(analytic_ratio, 3),
+            "speedup_gate": speedup_gate,
+            "kill_egress_bytes": kill_egress,
+            "fairness": fairness,
+            "identity": "byte-identical across serial/off/on/kill",
+            "gates_failed": gates,
+        }
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=16, help="simulated nodes")
+    ap.add_argument("--mib", type=int, default=2, help="image blob size")
+    ap.add_argument("--reps", type=int, default=2, help="paired reps per arm")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    report = profile(pods=args.pods, mib=args.mib, reps=args.reps)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(
+            f"storm({args.pods} pods, {args.mib} MiB): "
+            f"off best {report['best_wall_off_s']:.3f}s  "
+            f"on best {report['best_wall_on_s']:.3f}s  "
+            f"speedup {report['measured_speedup']}x "
+            f"(analytic {report['analytic_speedup']}x)"
+        )
+        print(
+            f"egress: off {report['egress_ratio_off']}x  "
+            f"on {report['egress_ratio_on']}x unique bytes "
+            f"({report['origin_calls_on']} origin GETs)"
+        )
+        f = report["fairness"]
+        print(
+            f"fairness: share_a {f['share_a']} (target {f['share_a_target']}, "
+            f"err {f['share_err']:.1%})  demand p95 {f['p95_ratio']}x unloaded"
+        )
+    for g in report["gates_failed"]:
+        print(f"FAIL: {g}", file=sys.stderr)
+    return 1 if report["gates_failed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
