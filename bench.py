@@ -866,6 +866,35 @@ def peer_storm_run(repo: str, timeout: float = 240.0) -> dict:
         return {"error": "peer storm produced no JSON"}
 
 
+_FLEET_OBS_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from tools.fleet_obs_profile import profile
+print(json.dumps(profile(layers=4, pods=4, reps=2)))
+"""
+
+
+def fleet_obs_run(repo: str, timeout: float = 240.0) -> dict:
+    """Fleet observability profile (tools/fleet_obs_profile.py) in a
+    child under the hard watchdog: federation scrape + trace aggregation
+    overhead on a snapshot storm (paired best-rep + duty-cycle bound)
+    plus the spawned-member ntpuctl smoke. Two daemon subprocesses and a
+    controller spin up — a wedge must cost one timeout, not a hang."""
+    res = _run_child_watchdog(
+        [sys.executable, "-c", _FLEET_OBS_CHILD.format(repo=repo)], timeout=timeout
+    )
+    if res is None:
+        return {"error": f"fleet obs profile hung >{timeout:.0f}s (watchdog killed it)"}
+    rc, stdout, stderr = res
+    if rc != 0:
+        tail = stderr.strip().splitlines()[-1] if stderr.strip() else ""
+        return {"error": f"fleet obs profile exited rc={rc}: {tail}"[:200]}
+    try:
+        return json.loads(stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": "fleet obs profile produced no JSON"}
+
+
 def chunk_dict_run(repo: str, timeout: float = 240.0) -> dict:
     """Chunk-dict growth + service profile (tools/chunk_dict_profile.py)
     in a child under the hard watchdog: incremental-vs-rebuild best-rep
@@ -1127,6 +1156,7 @@ def main() -> None:
     trace_detail = trace_run(repo)
     chunk_dict_detail = chunk_dict_run(repo)
     peer_storm = peer_storm_run(repo)
+    fleet_obs = fleet_obs_run(repo)
 
     print(
         json.dumps(
@@ -1161,6 +1191,7 @@ def main() -> None:
                     "trace": trace_detail,
                     "chunk_dict": chunk_dict_detail,
                     "peer_storm": peer_storm,
+                    "fleet_obs": fleet_obs,
                     "accel_profile": accel_profile,
                     "zstd_profile": zstd_profile,
                     "reference_defaults_profile": reference_defaults_profile,

@@ -21,7 +21,11 @@ import threading
 from nydus_snapshotter_tpu import constants as C
 from nydus_snapshotter_tpu.api import service as grpc_service
 from nydus_snapshotter_tpu.cache.manager import CacheManager
-from nydus_snapshotter_tpu.config.config import SnapshotterConfig, load_config
+from nydus_snapshotter_tpu.config.config import (
+    SnapshotterConfig,
+    load_config,
+    set_global_config,
+)
 from nydus_snapshotter_tpu.config.daemonconfig import DaemonRuntimeConfig
 from nydus_snapshotter_tpu.filesystem import Filesystem
 from nydus_snapshotter_tpu.manager.manager import Manager
@@ -278,6 +282,12 @@ def build_stack(cfg: SnapshotterConfig):
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
+    # Publish the parsed config behind the package-global accessor BEFORE
+    # anything lazily resolves a section: the resolve_*_config() helpers
+    # (trace, blobcache, peer, fleet, slo, chunk_dict) read env over
+    # `get_global_config()`, and without this call the TOML sections
+    # never reached them in the real process.
+    set_global_config(cfg)
     setup_logging(cfg)
 
     sn, fs, managers, _db = build_stack(cfg)
@@ -294,6 +304,27 @@ def main(argv=None) -> int:
         metrics_server.serve(cfg.metrics.address)
         metrics_server.start_collecting()
         logger.info("metrics exporter on %s", cfg.metrics.address)
+    # Fleet observability plane (fleet/, docs/observability.md): member
+    # registry + federated metrics + merged traces + SLO engine, mounted
+    # on the system controller's socket below. Built BEFORE the dict/peer
+    # services start so this process's one member slot is claimed first
+    # (a peer server in this process must not re-register it over HTTP).
+    # The controller address is exported via NTPU_FLEET_CONTROLLER so
+    # spawned daemon processes self-register.
+    fleet_plane = None
+    if cfg.fleet.enable and cfg.system.enable:
+        from nydus_snapshotter_tpu import fleet
+
+        fleet_plane = fleet.FleetPlane(metrics_server=metrics_server)
+        fleet_plane.register_local("snapshotter")
+        fleet_plane.start()
+        os.environ.setdefault("NTPU_FLEET_CONTROLLER", cfg.system.address)
+        logger.info(
+            "fleet plane on unix:%s (scrape every %.1fs, %d slo objectives)",
+            cfg.system.address,
+            fleet_plane.cfg.scrape_interval_secs,
+            len(fleet_plane.slo.objectives),
+        )
     # Shared chunk-dict service (parallel/dict_service.py): one growable
     # registry-wide dedup table per namespace, served to converter workers
     # over the [chunk_dict].service UDS and mounted on the system
@@ -328,6 +359,7 @@ def main(argv=None) -> int:
             managers=list(managers.values()),
             sock_path=cfg.system.address,
             dict_service=dict_service,
+            fleet=fleet_plane,
         )
         system_controller.run()
         logger.info("system controller on unix:%s", cfg.system.address)
@@ -361,6 +393,8 @@ def main(argv=None) -> int:
         server.stop(grace=2).wait()
         if metrics_server is not None:
             metrics_server.stop()
+        if fleet_plane is not None:
+            fleet_plane.stop()
         if system_controller is not None:
             system_controller.stop()
         if dict_service is not None:

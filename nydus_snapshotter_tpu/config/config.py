@@ -279,6 +279,60 @@ class ChunkDictConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Fleet observability plane knobs (fleet/, metrics/federation.py,
+    trace/aggregate.py).
+
+    With ``enable`` on, the system controller keeps a member registry
+    (spawned daemons, standalone dict services and peer servers
+    self-register over the controller UDS), scrapes every member's
+    metrics endpoint every ``scrape_interval_secs`` and serves the
+    federated exposition (``node``/``component`` labels), the derived
+    health scoreboard and the cluster-merged Chrome trace on
+    ``/api/v1/fleet/...``. A member whose last successful scrape is
+    older than ``stale_after_secs`` is flagged stale (the scoreboard
+    degrades; the scrape never wedges). The scoreboard's local-process
+    rows come from one cached ``collect_once`` snapshot at most
+    ``scoreboard_max_age_secs`` old, so a slow collector cannot stall
+    concurrent scrapes. ``controller`` is the member-side knob: the
+    controller UDS a non-snapshotter process registers itself with
+    ("" = don't register). Environment variables override per-process
+    (``NTPU_FLEET``, ``NTPU_FLEET_CONTROLLER``, ``NTPU_FLEET_MEMBER``,
+    ``NTPU_FLEET_SCRAPE_INTERVAL_SECS``, ``NTPU_FLEET_STALE_AFTER_SECS``,
+    ``NTPU_FLEET_SCOREBOARD_MAX_AGE_SECS``) — the env is also how the
+    controller address reaches spawned daemon processes.
+    """
+
+    enable: bool = False
+    scrape_interval_secs: float = 15.0
+    stale_after_secs: float = 45.0
+    scoreboard_max_age_secs: float = 5.0
+    controller: str = ""
+
+
+@dataclass
+class SloConfig:
+    """Declarative service-level objectives (metrics/slo.py).
+
+    Each ``[[slo.objectives]]`` table names an op-duration histogram
+    (``metric`` + optional ``labels`` filter), a latency ``threshold_ms``
+    that must align to a bucket boundary, and a ``target`` compliance
+    fraction evaluated over a sliding ``window_secs`` window (plus a
+    ``long_window_factor``× long window). The engine ticks every
+    ``eval_interval_secs``, exports ``ntpu_slo_*`` series, accounts the
+    error budget, and raises a breach event — with the slow-op flight
+    recorder dump attached — when the burn rate exceeds
+    ``burn_threshold`` on BOTH windows. Environment variables override
+    per-process (``NTPU_SLO``, ``NTPU_SLO_EVAL_INTERVAL_SECS``,
+    ``NTPU_SLO_OBJECTIVES`` — a JSON list of objective tables).
+    """
+
+    enable: bool = False
+    eval_interval_secs: float = 10.0
+    objectives: list[dict] = field(default_factory=list)
+
+
+@dataclass
 class ExperimentalConfig:
     enable_stargz: bool = False
     enable_referrer_detect: bool = False
@@ -313,6 +367,8 @@ class SnapshotterConfig:
     snapshots: SnapshotsConfig = field(default_factory=SnapshotsConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
     chunk_dict: ChunkDictConfig = field(default_factory=ChunkDictConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
+    slo: SloConfig = field(default_factory=SloConfig)
     experimental: ExperimentalConfig = field(default_factory=ExperimentalConfig)
 
     # -- derived paths (reference config/global.go accessors) ---------------
@@ -423,6 +479,20 @@ class SnapshotterConfig:
             raise ConfigError("trace.slow_op_threshold_ms must be >= 0 (0 = off)")
         if not 0.0 <= self.trace.sample_ratio <= 1.0:
             raise ConfigError("trace.sample_ratio must be within [0, 1]")
+        if self.fleet.scrape_interval_secs <= 0:
+            raise ConfigError("fleet.scrape_interval_secs must be positive")
+        if self.fleet.stale_after_secs <= 0:
+            raise ConfigError("fleet.stale_after_secs must be positive")
+        if self.fleet.scoreboard_max_age_secs < 0:
+            raise ConfigError(
+                "fleet.scoreboard_max_age_secs must be >= 0 (0 = always fresh)"
+            )
+        if self.slo.eval_interval_secs <= 0:
+            raise ConfigError("slo.eval_interval_secs must be positive")
+        if not isinstance(self.slo.objectives, list) or any(
+            not isinstance(o, dict) for o in self.slo.objectives
+        ):
+            raise ConfigError("slo.objectives must be an array of tables")
         if not 0.0 < self.chunk_dict.load_factor < 1.0:
             raise ConfigError("chunk_dict.load_factor must be within (0, 1)")
         if self.chunk_dict.headroom < 1.0:

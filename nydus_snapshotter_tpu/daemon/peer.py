@@ -336,6 +336,14 @@ class PeerChunkServer:
         if parsed.path == _STAT_ROUTE:
             body = json.dumps(self.export.stats()).encode()
             return 200, {"Content-Type": "application/json"}, body
+        if parsed.path == "/api/v1/traces":
+            # A standalone peer server is a fleet member: its process's
+            # span ring joins the cluster-merged trace (trace/aggregate.py).
+            body = trace.chrome_trace_bytes()
+            return 200, {"Content-Type": "application/json"}, body
+        if parsed.path in ("/metrics", "/v1/metrics"):
+            body = _reg.render().encode()
+            return 200, {"Content-Type": "text/plain; version=0.0.4"}, body
         if not parsed.path.startswith(_BLOB_ROUTE) or method != "GET":
             return 404, {}, b'{"message": "no such endpoint"}'
         blob_id = parsed.path[len(_BLOB_ROUTE):]
@@ -759,6 +767,13 @@ def start_from_config() -> Optional[PeerChunkServer]:
     server.run(cfg.listen)
     with _default_lock:
         _default_server = server
+    # Fleet plane: a standalone peer-server process self-registers with
+    # the controller so its metrics/traces federate. No-op when this
+    # process already registered under another role (daemon/snapshotter):
+    # one process is ONE member — one ring, one registry.
+    from nydus_snapshotter_tpu import fleet
+
+    fleet.register_self("peer", server.address)
     return server
 
 

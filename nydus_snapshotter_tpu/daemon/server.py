@@ -536,6 +536,20 @@ class DaemonServer:
                     self._reply(200, body)
                 elif u.path == "/api/v1/traces":
                     self._reply(200, trace.chrome_trace())
+                elif u.path in ("/metrics", "/v1/metrics"):
+                    # Prometheus text exposition of this daemon process's
+                    # registry — the fleet federator's per-member scrape
+                    # target (metrics/federation.py).
+                    from nydus_snapshotter_tpu.metrics.registry import (
+                        default_registry,
+                    )
+
+                    body = default_registry.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif u.path == "/api/v1/metrics/inflight":
                     with daemon._lock:
                         instances = list(daemon.instances.values())
@@ -900,6 +914,15 @@ def main(argv=None) -> int:
     # the NTPU_PEER* environment (like every blobcache knob); when it
     # names a listen address, this daemon serves its cached extents to
     # cluster peers (daemon/peer.py).
+    # Fleet plane: when NTPU_FLEET_CONTROLLER names the controller UDS
+    # (exported by cmd/snapshotter.py when [fleet] is on), this daemon
+    # self-registers so the controller scrapes its metrics and pulls its
+    # trace ring into the cluster-merged view (fleet/__init__.py).
+    # Registered BEFORE the peer server starts: one process is one
+    # member, and the daemon role (full API surface) must win the slot.
+    from nydus_snapshotter_tpu import fleet
+
+    fleet.register_self("daemon", args.apisock, name=args.id)
     from nydus_snapshotter_tpu.daemon import peer as peer_mod
 
     peer_mod.start_from_config()
@@ -914,6 +937,7 @@ def main(argv=None) -> int:
     try:
         server.serve_forever()
     finally:
+        fleet.deregister_self()
         peer_mod.stop_default()
         try:
             os.unlink(args.apisock)

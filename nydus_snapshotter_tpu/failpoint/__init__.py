@@ -89,6 +89,8 @@ KNOWN_SITES = (
     "peer.serve",            # daemon/peer.py chunk-server request entry
     "peer.fetch",            # daemon/peer.py peer-tier ranged read attempt
     "peer.admit",            # daemon/fetch_sched.py AdmissionGate.acquire entry
+    "fleet.scrape",          # metrics/federation.py per-member metrics scrape
+    "fleet.collect",         # trace/aggregate.py per-member trace-ring pull
 )
 
 _lock = _an.make_lock("failpoint.table")

@@ -191,6 +191,26 @@ class Histogram(_Metric):
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
 
+    def cumulative_le(self, threshold: float) -> dict[tuple, tuple[int, int]]:
+        """{label_values: (observations in buckets <= threshold, total)}.
+
+        ``threshold`` is resolved to the smallest bucket upper bound that
+        is >= it (the SLO engine aligns thresholds to bucket boundaries);
+        past the last bucket every observation qualifies.
+        """
+        idx = None
+        for i, ub in enumerate(self.buckets):
+            if threshold <= ub:
+                idx = i
+                break
+        with self._lock:
+            out = {}
+            for key, counts in self._counts.items():
+                total = self._totals.get(key, 0)
+                good = total if idx is None else counts[idx]
+                out[key] = (good, total)
+            return out
+
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
         with self._lock:

@@ -9,9 +9,11 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterable, Optional
 
+from nydus_snapshotter_tpu.analysis import runtime as _an
 from nydus_snapshotter_tpu.metrics import data
 from nydus_snapshotter_tpu.metrics.collector import (
     DaemonResourceCollector,
@@ -47,6 +49,40 @@ class MetricsServer:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._httpd: Optional[ThreadingHTTPServer] = None
+        # Cached collect_once+render snapshot (see snapshot()): the fleet
+        # scoreboard and other summary consumers share ONE collection
+        # round per max-age window instead of re-running the collectors
+        # inline per request.
+        self._snap_lock = _an.make_lock("metrics.snapshot")
+        self._snap_text = ""
+        self._snap_time = -1.0e18
+        self._snap_refreshing = False
+
+    def snapshot(self, max_age_sec: float = 5.0) -> tuple[str, float]:
+        """(rendered registry text, age in seconds) from a cached
+        collection round at most ``max_age_sec`` old.
+
+        At most one caller refreshes at a time, and the collectors run
+        OUTSIDE the cache lock: while a refresh is in flight (a slow
+        collector, a hung daemon RPC), every concurrent caller gets the
+        previous snapshot immediately instead of queueing behind it.
+        """
+        now = time.monotonic()
+        with self._snap_lock:
+            age = now - self._snap_time
+            if age <= max_age_sec or self._snap_refreshing:
+                return self._snap_text, max(0.0, age)
+            self._snap_refreshing = True
+        try:
+            self.collect_once()
+            text = self.registry.render()
+        finally:
+            with self._snap_lock:
+                self._snap_refreshing = False
+        with self._snap_lock:
+            self._snap_text = text
+            self._snap_time = time.monotonic()
+            return self._snap_text, 0.0
 
     def collect_once(self) -> None:
         # Per-collector isolation: one failing collector must not skip the

@@ -1,0 +1,375 @@
+"""Declarative SLOs over the op-duration histograms, with error budgets
+and multi-window burn-rate alerting.
+
+The profile tools already gate ad-hoc latency bounds ("demand p95 under
+storm <= 2x unloaded"); this module makes such objectives a *deployed*
+contract: the ``[slo]`` config section declares objectives over the
+histograms the planes already export (``ntpu_snapshot_op_duration_*``,
+``ntpu_blobcache_op_duration_*``, ...), and the engine evaluates them
+continuously:
+
+- **sliding windows**: every tick snapshots the objective's cumulative
+  (observations <= threshold, total) pair; window compliance is the diff
+  between now and the sample just outside the window — no per-request
+  bookkeeping, the histograms the hot paths already feed are the only
+  data source;
+- **error budget**: an objective with ``target`` 0.99 has a 1% budget;
+  the **burn rate** is (bad fraction in window) / budget — burn 1.0
+  consumes the budget exactly at the window's length, Google-SRE style;
+- **multi-window alerting**: a breach fires only when the burn rate
+  exceeds ``burn_threshold`` on BOTH the short window and the
+  ``long_window_factor``x long window — a latency spike shorter than the
+  long window's smoothing can't page, a sustained regression can't hide;
+- **flight-recorder attachment**: each breach event carries the slow-op
+  recorder's current dumps and the over-p95 trace exemplars, so the page
+  arrives WITH the span trees of the requests that burned the budget.
+
+Histogram sources are pluggable: the default reads this process's
+registry; the fleet plane (metrics/federation.py) supplies a federated
+source summing ``<metric>_bucket``/``<metric>_count`` samples across
+scraped members (deduplicated by pid), so one objective can span every
+daemon in the deployment.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from nydus_snapshotter_tpu import trace
+from nydus_snapshotter_tpu.analysis import runtime as _an
+from nydus_snapshotter_tpu.metrics import registry as _metrics
+
+logger = logging.getLogger(__name__)
+
+_reg = _metrics.default_registry
+
+SLO_COMPLIANCE = _reg.register(
+    _metrics.Gauge(
+        "ntpu_slo_compliance_ratio",
+        "Fraction of operations within the objective's threshold over the "
+        "short window",
+        ("objective",),
+    )
+)
+SLO_BUDGET_REMAINING = _reg.register(
+    _metrics.Gauge(
+        "ntpu_slo_error_budget_remaining",
+        "Unburned fraction of the objective's error budget over the long "
+        "window (1 = untouched, 0 = exhausted)",
+        ("objective",),
+    )
+)
+SLO_BURN_RATE = _reg.register(
+    _metrics.Gauge(
+        "ntpu_slo_burn_rate",
+        "Error-budget burn rate per evaluation window (1.0 consumes the "
+        "budget in exactly one window length)",
+        ("objective", "window"),
+    )
+)
+SLO_BREACHES = _reg.register(
+    _metrics.Counter(
+        "ntpu_slo_breaches_total",
+        "Multi-window burn-rate alerts raised, per objective",
+        ("objective",),
+    )
+)
+
+
+class SloSpecError(ValueError):
+    """A malformed ``[[slo.objectives]]`` table."""
+
+
+class SloObjective:
+    """One declarative objective, parsed from a ``[[slo.objectives]]``
+    table (or the ``NTPU_SLO_OBJECTIVES`` JSON)."""
+
+    def __init__(
+        self,
+        name: str,
+        metric: str,
+        threshold_ms: float,
+        target: float = 0.99,
+        labels: Optional[dict] = None,
+        window_secs: float = 300.0,
+        long_window_factor: float = 12.0,
+        burn_threshold: float = 2.0,
+    ):
+        if not name or not metric:
+            raise SloSpecError("slo objective needs name and metric")
+        if threshold_ms <= 0:
+            raise SloSpecError(f"{name}: threshold_ms must be positive")
+        if not 0.0 < target < 1.0:
+            raise SloSpecError(f"{name}: target must be within (0, 1)")
+        if window_secs <= 0 or long_window_factor < 1.0 or burn_threshold <= 0:
+            raise SloSpecError(f"{name}: bad window/burn parameters")
+        self.name = name
+        self.metric = metric
+        self.threshold_ms = float(threshold_ms)
+        self.target = float(target)
+        self.labels = dict(labels or {})
+        self.window_secs = float(window_secs)
+        self.long_window_secs = float(window_secs) * float(long_window_factor)
+        self.burn_threshold = float(burn_threshold)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SloObjective":
+        known = {
+            "name", "metric", "threshold_ms", "target", "labels",
+            "window_secs", "long_window_factor", "burn_threshold",
+        }
+        unknown = set(d) - known
+        if unknown:
+            raise SloSpecError(
+                f"slo objective {d.get('name', '?')!r}: unknown keys {sorted(unknown)}"
+            )
+        try:
+            return cls(**d)
+        except TypeError as e:
+            raise SloSpecError(f"slo objective {d.get('name', '?')!r}: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# Histogram sources
+# ---------------------------------------------------------------------------
+
+
+def local_source(registry: Optional[_metrics.Registry] = None):
+    """(objective) -> (good, total) cumulative pair from this process's
+    registry. Label filter matches a subset of the histogram's labels."""
+    reg = registry or _reg
+
+    def read(obj: SloObjective) -> tuple[float, float]:
+        metric = reg._metrics.get(obj.metric)  # noqa: SLF001 — same package
+        if not isinstance(metric, _metrics.Histogram):
+            return 0.0, 0.0
+        names = metric.label_names
+        want = [(names.index(k), v) for k, v in obj.labels.items() if k in names]
+        if len(want) != len(obj.labels):
+            return 0.0, 0.0
+        good = total = 0.0
+        for key, (g, t) in metric.cumulative_le(obj.threshold_ms).items():
+            if all(key[i] == v for i, v in want):
+                good += g
+                total += t
+        return good, total
+
+    return read
+
+
+def federated_source(federator, members: Callable[[], list]):
+    """(objective) -> (good, total) summed across every scraped member's
+    last-good samples, counting each OS process (pid) once."""
+
+    def read(obj: SloObjective) -> tuple[float, float]:
+        by_member = federator.member_samples()
+        listing = {m.name: m for m in members()}
+        good = total = 0.0
+        seen_pids: set[int] = set()
+        fmt = _metrics._fmt_value  # noqa: SLF001 — bucket le formatting
+        le = fmt(obj.threshold_ms)
+        for name in sorted(by_member):
+            member = listing.get(name)
+            if member is None or member.pid in seen_pids:
+                continue
+            seen_pids.add(member.pid)
+            samples = by_member[name]
+            for labels, v in samples.get(f"{obj.metric}_bucket", ()):
+                if labels.get("le") != le:
+                    continue
+                if any(labels.get(k) != s for k, s in obj.labels.items()):
+                    continue
+                good += v
+            for labels, v in samples.get(f"{obj.metric}_count", ()):
+                if any(labels.get(k) != s for k, s in obj.labels.items()):
+                    continue
+                total += v
+        return good, total
+
+    return read
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+class _ObjectiveState:
+    __slots__ = ("samples", "breached", "last_status")
+
+    def __init__(self):
+        # (t, good, total) cumulative snapshots, oldest first.
+        self.samples: deque = deque()
+        self.breached = False
+        self.last_status: dict = {}
+
+
+class SloEngine:
+    """Evaluates objectives on :meth:`tick`; serves ``/api/v1/fleet/slo``."""
+
+    def __init__(
+        self,
+        objectives: list[SloObjective],
+        source: Optional[Callable[[SloObjective], tuple[float, float]]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        keep_events: int = 32,
+    ):
+        self.objectives = list(objectives)
+        self._source = source or local_source()
+        self._clock = clock
+        self._lock = _an.make_lock("slo.engine")
+        self._state_shared = _an.shared("slo.engine.state")
+        self._state = {o.name: _ObjectiveState() for o in self.objectives}
+        self._events: deque = deque(maxlen=keep_events)
+
+    def _window(self, st: _ObjectiveState, now: float, secs: float):
+        """(good delta, total delta) between now's snapshot and the
+        newest snapshot at least ``secs`` old (None until one exists —
+        a window with no history must not alert)."""
+        newest = st.samples[-1]
+        base = None
+        for t, good, total in st.samples:
+            if now - t >= secs:
+                base = (good, total)
+            else:
+                break
+        if base is None:
+            return None
+        return newest[1] - base[0], newest[2] - base[1]
+
+    def tick(self) -> list[dict]:
+        """One evaluation round; returns breach events raised this tick."""
+        now = self._clock()
+        raised = []
+        for obj in self.objectives:
+            good, total = self._source(obj)
+            st = self._state[obj.name]
+            with self._lock:
+                self._state_shared.write()
+                st.samples.append((now, good, total))
+                horizon = now - obj.long_window_secs * 1.5
+                while len(st.samples) > 2 and st.samples[1][0] <= horizon:
+                    st.samples.popleft()
+            budget = 1.0 - obj.target
+            status = {
+                "objective": obj.name,
+                "metric": obj.metric,
+                "threshold_ms": obj.threshold_ms,
+                "target": obj.target,
+                "window_secs": obj.window_secs,
+                "long_window_secs": obj.long_window_secs,
+                "burn_threshold": obj.burn_threshold,
+                "total_ops": total,
+            }
+            burns = {}
+            for label, secs in (
+                ("short", obj.window_secs),
+                ("long", obj.long_window_secs),
+            ):
+                delta = self._window(st, now, secs)
+                if delta is None or delta[1] <= 0:
+                    # No traffic / no history: compliant by definition.
+                    compliance, burn = 1.0, 0.0
+                else:
+                    compliance = max(0.0, min(1.0, delta[0] / delta[1]))
+                    burn = (1.0 - compliance) / budget
+                burns[label] = burn
+                status[f"compliance_{label}"] = round(compliance, 6)
+                status[f"burn_{label}"] = round(burn, 4)
+                SLO_BURN_RATE.labels(obj.name, label).set(burn)
+            remaining = max(0.0, 1.0 - burns["long"])
+            status["budget_remaining"] = round(remaining, 4)
+            SLO_COMPLIANCE.labels(obj.name).set(status["compliance_short"])
+            SLO_BUDGET_REMAINING.labels(obj.name).set(remaining)
+            breach = (
+                burns["short"] > obj.burn_threshold
+                and burns["long"] > obj.burn_threshold
+            )
+            status["breached"] = breach
+            with self._lock:
+                self._state_shared.write()
+                transition = breach and not st.breached
+                st.breached = breach
+                st.last_status = status
+            if transition:
+                SLO_BREACHES.labels(obj.name).inc()
+                event = {
+                    "objective": obj.name,
+                    "at": now,
+                    "status": dict(status),
+                    # The page arrives WITH the evidence: the slow-op
+                    # recorder's reconstructed trees and the over-p95
+                    # trace ids current at breach time.
+                    "slow_ops": trace.slow_ops(),
+                    "trace_exemplars": trace.exemplars(),
+                }
+                with self._lock:
+                    self._state_shared.write()
+                    self._events.append(event)
+                raised.append(event)
+                logger.warning(
+                    "SLO breach: %s burn short=%.2f long=%.2f (threshold %.2f)",
+                    obj.name, burns["short"], burns["long"], obj.burn_threshold,
+                )
+        return raised
+
+    def status(self) -> dict:
+        with self._lock:
+            self._state_shared.read()
+            return {
+                "objectives": [
+                    dict(self._state[o.name].last_status)
+                    for o in self.objectives
+                    if self._state[o.name].last_status
+                ],
+                "breaches": [dict(e) for e in self._events],
+            }
+
+
+# ---------------------------------------------------------------------------
+# Config resolution (env > [slo] config > defaults)
+# ---------------------------------------------------------------------------
+
+
+def resolve_slo_objectives() -> tuple[bool, float, list[SloObjective]]:
+    """(enabled, eval interval, objectives) from ``NTPU_SLO*`` env over
+    the ``[slo]`` section. Malformed objective tables are skipped loudly:
+    a typo in one objective must not take the others (or the process)
+    down."""
+    enabled = False
+    interval = 10.0
+    raw: list[dict] = []
+    try:
+        from nydus_snapshotter_tpu.config import config as _cfg
+
+        sc = _cfg.get_global_config().slo
+        enabled = bool(sc.enable)
+        interval = float(sc.eval_interval_secs)
+        raw = list(sc.objectives)
+    except Exception:
+        pass
+    env = os.environ.get("NTPU_SLO", "")
+    if env:
+        enabled = env not in ("0", "off", "false")
+    try:
+        interval = float(os.environ["NTPU_SLO_EVAL_INTERVAL_SECS"])
+    except (KeyError, ValueError):
+        pass
+    env_obj = os.environ.get("NTPU_SLO_OBJECTIVES", "")
+    if env_obj:
+        try:
+            raw = json.loads(env_obj)
+        except ValueError:
+            logger.warning("ignoring unparseable NTPU_SLO_OBJECTIVES")
+    objectives = []
+    for d in raw:
+        try:
+            objectives.append(SloObjective.from_dict(dict(d)))
+        except SloSpecError as e:
+            logger.warning("skipping slo objective: %s", e)
+    return enabled, max(0.1, interval), objectives

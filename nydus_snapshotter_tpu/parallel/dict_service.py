@@ -359,6 +359,16 @@ class DictService:
         Adopts the caller's trace context from the ``x-ntpu-*`` headers so
         the server-side span joins the converter's ``convert`` root."""
         parsed = urlparse(path)
+        if parsed.path == "/api/v1/traces" and method == "GET":
+            # A standalone dict-service process is a fleet member: its
+            # span ring (dict.rpc.* spans) joins the cluster-merged trace.
+            return 200, "application/json", trace.chrome_trace_bytes()
+        if parsed.path in ("/metrics", "/v1/metrics") and method == "GET":
+            return (
+                200,
+                "text/plain; version=0.0.4",
+                _metrics.default_registry.render().encode(),
+            )
         m = _DICT_ROUTE.match(parsed.path)
         if not m:
             return 404, "application/json", b'{"message": "no such endpoint"}'
@@ -462,6 +472,13 @@ class DictService:
             target=self._httpd.serve_forever, name="dict-service", daemon=True
         ).start()
         logger.info("chunk-dict service on unix:%s", sock_path)
+        # Fleet plane: a standalone dict-service process self-registers
+        # with the controller (no-op when this process already holds a
+        # member slot — e.g. the service mounted on the controller's own
+        # socket in cmd/snapshotter.py).
+        from nydus_snapshotter_tpu import fleet
+
+        fleet.register_self("dict", sock_path)
 
     def stop(self) -> None:
         if self._httpd is not None:

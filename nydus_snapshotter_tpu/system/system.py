@@ -10,6 +10,10 @@ Reference pkg/system/system.go:36-446. Endpoints:
     */*  /api/v1/dict/...               — shared chunk-dict service routes
                                           (parallel/dict_service.py), when a
                                           DictService is attached
+    */*  /api/v1/fleet/...              — fleet observability plane (member
+                                          registry, federated metrics,
+                                          merged traces, SLO status) when a
+                                          fleet.FleetPlane is attached
 """
 
 from __future__ import annotations
@@ -45,7 +49,12 @@ class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
 
 class SystemController:
     def __init__(
-        self, fs=None, managers: Iterable = (), sock_path: str = "", dict_service=None
+        self,
+        fs=None,
+        managers: Iterable = (),
+        sock_path: str = "",
+        dict_service=None,
+        fleet=None,
     ):
         self.fs = fs
         self.managers = list(managers)
@@ -54,6 +63,9 @@ class SystemController:
         # routes are served on this controller's socket too, so one UDS
         # carries both the ops surface and the shared-dict RPCs.
         self.dict_service = dict_service
+        # Optional fleet.FleetPlane: member registry + /api/v1/fleet
+        # surface (federated metrics, merged traces, SLO status).
+        self.fleet = fleet
         self._httpd: Optional[_UnixHTTPServer] = None
 
     # -- handlers -------------------------------------------------------------
@@ -156,6 +168,19 @@ class SystemController:
             def _error(self, message: str, status: int):
                 self._json({"code": "Unknown", "message": message}, status)
 
+            def _fleet_route(self, body: bytes) -> bool:
+                if not self.path.startswith("/api/v1/fleet") or controller.fleet is None:
+                    return False
+                status, ctype, payload = controller.fleet.handle(
+                    self.command, self.path, self.headers, body
+                )
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                return True
+
             def _dict_route(self, body: bytes) -> bool:
                 if not self.path.startswith("/api/v1/dict") or controller.dict_service is None:
                     return False
@@ -171,7 +196,7 @@ class SystemController:
 
             def do_GET(self):
                 try:
-                    if self._dict_route(b""):
+                    if self._fleet_route(b"") or self._dict_route(b""):
                         return
                     if self.path == "/api/v1/daemons":
                         self._json(controller.describe_daemons())
@@ -203,11 +228,22 @@ class SystemController:
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
                 try:
-                    if self._dict_route(body):
+                    if self._fleet_route(body) or self._dict_route(body):
                         return
                     self._error("no such endpoint", 404)
                 except Exception as e:
                     logger.exception("system controller POST %s", self.path)
+                    self._error(str(e), 500)
+
+            def do_DELETE(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    if self._fleet_route(body):
+                        return
+                    self._error("no such endpoint", 404)
+                except Exception as e:
+                    logger.exception("system controller DELETE %s", self.path)
                     self._error(str(e), 500)
 
             def do_PUT(self):

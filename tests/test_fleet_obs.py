@@ -1,0 +1,468 @@
+"""Fleet observability plane: member registry, metrics federation,
+cross-process trace aggregation, member-failure degradation, the cached
+collect_once snapshot, and the ntpuctl surface.
+
+Member "processes" here are UDS servers inside this test process (the
+real two-OS-process join is gated end to end by
+tools/cluster_storm_profile.py and tools/fleet_obs_profile.py); what
+these tests pin is the plane's contracts: per-member isolation, stale
+flagging, label injection, single-tree merging, and that no member
+failure ever propagates to a serving endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+
+import pytest
+
+from nydus_snapshotter_tpu import failpoint, fleet, trace
+from nydus_snapshotter_tpu.metrics import federation as fed
+from nydus_snapshotter_tpu.metrics.registry import default_registry
+from nydus_snapshotter_tpu.trace import aggregate as agg
+from nydus_snapshotter_tpu.utils import udshttp
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    trace.configure(enabled=True, ring_capacity=4096, slow_op_threshold_ms=0)
+    yield
+    trace.reset()
+
+
+class CannedServer:
+    """Minimal HTTP-over-UDS member: fixed body per path."""
+
+    def __init__(self, sock_path: str, routes: dict[str, bytes]):
+        routes = dict(routes)
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                line = self.rfile.readline().decode()
+                while self.rfile.readline() not in (b"\r\n", b"\n", b""):
+                    pass
+                path = line.split()[1].split("?")[0] if len(line.split()) > 1 else "/"
+                body = routes.get(path)
+                if body is None:
+                    head, body = b"HTTP/1.1 404 NF", b"{}"
+                else:
+                    head = b"HTTP/1.1 200 OK"
+                self.wfile.write(
+                    head + b"\r\nContent-Length: " + str(len(body)).encode()
+                    + b"\r\n\r\n" + body
+                )
+
+        class Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+
+        self.httpd = Server(sock_path, Handler)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+EXPO_A = b"""# HELP ntpu_blobcache_hit_bytes x
+# TYPE ntpu_blobcache_hit_bytes counter
+ntpu_blobcache_hit_bytes 3000
+ntpu_blobcache_miss_bytes 1000
+ntpu_blobcache_readahead_bytes 100
+ntpu_blobcache_readahead_hit_bytes 80
+ntpu_admission_queued{lane="demand"} 2
+ntpu_peer_served_bytes 500
+ntpu_peer_fetch_bytes 250
+"""
+
+
+def _plane(tmp_path, stale_after=30.0, clock=time.monotonic, **kw):
+    cfg = fleet.FleetRuntimeConfig(
+        enable=True, scrape_interval_secs=60.0, stale_after_secs=stale_after
+    )
+    plane = fleet.FleetPlane(cfg=cfg, clock=clock, **kw)
+    return plane
+
+
+# ------------------------------------------------------------------ registry
+
+
+def test_registry_register_replace_deregister():
+    reg = fleet.FleetRegistry()
+    reg.register(fleet.Member(name="d1", component="daemon", address="/a", pid=1))
+    reg.register(fleet.Member(name="d1", component="daemon", address="/b", pid=2))
+    reg.register(fleet.Member(name="a0", component="peer", address="/c", pid=3))
+    members = reg.members()
+    assert [m.name for m in members] == ["a0", "d1"]  # sorted by name
+    assert reg.get("d1").pid == 2  # latest registration wins
+    assert reg.deregister("d1") is True
+    assert reg.deregister("d1") is False
+    assert [m.name for m in reg.members()] == ["a0"]
+
+
+def test_member_http_registration_roundtrip(tmp_path):
+    from nydus_snapshotter_tpu.system.system import SystemController
+
+    plane = _plane(tmp_path)
+    sock = str(tmp_path / "system.sock")
+    sc = SystemController(managers=[], sock_path=sock, fleet=plane)
+    sc.run()
+    try:
+        udshttp.post_json(sock, fleet.MEMBERS_PATH,
+                          {"name": "d1", "component": "daemon",
+                           "address": "/tmp/d1.sock", "pid": 99})
+        listed = udshttp.get_json(sock, fleet.MEMBERS_PATH)
+        assert [m["name"] for m in listed] == ["d1"]
+        status, _ = udshttp.request(sock, f"{fleet.MEMBERS_PATH}?name=d1",
+                                    method="DELETE")
+        assert status == 200
+        assert udshttp.get_json(sock, fleet.MEMBERS_PATH) == []
+    finally:
+        sc.stop()
+
+
+def test_register_self_is_idempotent_per_process(tmp_path, monkeypatch):
+    from nydus_snapshotter_tpu.system.system import SystemController
+
+    monkeypatch.setattr(fleet, "_self_member", None)
+    plane = _plane(tmp_path)
+    sock = str(tmp_path / "system.sock")
+    sc = SystemController(managers=[], sock_path=sock, fleet=plane)
+    sc.run()
+    try:
+        assert fleet.register_self("daemon", "/tmp/api.sock", name="d9",
+                                   controller=sock)
+        # Second role in the same process: one member slot, first wins.
+        assert not fleet.register_self("peer", "/tmp/peer.sock", controller=sock)
+        deadline = time.time() + 5
+        while not plane.registry.get("d9") and time.time() < deadline:
+            time.sleep(0.02)
+        assert plane.registry.get("d9").component == "daemon"
+        fleet.deregister_self()
+        deadline = time.time() + 5
+        while plane.registry.get("d9") and time.time() < deadline:
+            time.sleep(0.02)
+        assert plane.registry.get("d9") is None
+    finally:
+        sc.stop()
+
+
+# ---------------------------------------------------------------- federation
+
+
+def test_parse_exposition_and_label_injection():
+    samples = fed.parse_exposition(EXPO_A.decode())
+    assert samples["ntpu_blobcache_hit_bytes"] == [({}, 3000.0)]
+    assert samples["ntpu_admission_queued"] == [({"lane": "demand"}, 2.0)]
+    out = fed._inject_labels(EXPO_A.decode(), {"node": "d1", "component": "daemon"})
+    assert 'ntpu_blobcache_hit_bytes{node="d1",component="daemon"} 3000' in out
+    assert ('ntpu_admission_queued{node="d1",component="daemon",lane="demand"} 2'
+            in out)
+    assert out.splitlines()[0].startswith("# HELP")  # comments pass through
+
+
+def test_federation_scrape_render_scoreboard(tmp_path):
+    plane = _plane(tmp_path)
+    sock = str(tmp_path / "m1.sock")
+    server = CannedServer(sock, {"/metrics": EXPO_A})
+    plane.registry.register(
+        fleet.Member(name="m1", component="daemon", address=sock, pid=777)
+    )
+    try:
+        out = plane.federator.scrape_once()
+        assert out == {"members": 1, "errors": 0}
+        text = plane.federator.render()
+        assert 'ntpu_blobcache_hit_bytes{node="m1",component="daemon"} 3000' in text
+        board = plane.federator.scoreboard()
+        row = board["members"]["m1"]
+        assert row["up"] and not row["stale"]
+        assert row["cache"]["hit_rate"] == 0.75
+        assert row["cache"]["readahead_accuracy"] == 0.8
+        assert row["peer"]["egress_ratio"] == 2.0
+        assert row["admission"]["queued"] == {"demand": 2.0}
+        assert board["fleet"]["up"] == 1
+    finally:
+        server.stop()
+
+
+def test_dead_member_degrades_not_wedges(tmp_path):
+    """ISSUE 9 satellite: a dead member marks stale, the endpoints still
+    answer, no exception reaches the serve loop, and
+    ntpu_fleet_scrape_errors_total{member} increments."""
+    from nydus_snapshotter_tpu.system.system import SystemController
+
+    plane = _plane(tmp_path)
+    plane.register_local("snapshotter")
+    dead_sock = str(tmp_path / "dead.sock")  # nothing ever listens
+    plane.registry.register(
+        fleet.Member(name="deadbeef", component="daemon", address=dead_sock, pid=1)
+    )
+    csock = str(tmp_path / "system.sock")
+    sc = SystemController(managers=[], sock_path=csock, fleet=plane)
+    sc.run()
+    try:
+        before = fed.FLEET_SCRAPE_ERRORS.value("deadbeef")
+        out = plane.federator.scrape_once()  # must not raise
+        assert out["errors"] == 1
+        assert fed.FLEET_SCRAPE_ERRORS.value("deadbeef") == before + 1
+        board = udshttp.get_json(csock, "/api/v1/fleet/scoreboard")
+        dead = board["members"]["deadbeef"]
+        assert not dead["up"] and dead["stale"] and dead["last_err"]
+        assert board["members"]["snapshotter"]["up"]
+        # Trace pull over the same dead socket: collect degrades too.
+        before = fed.FLEET_SCRAPE_ERRORS.value("deadbeef")
+        doc = udshttp.get_json(csock, "/api/v1/fleet/traces")
+        assert doc["fleet"]["errors"] == 1
+        assert fed.FLEET_SCRAPE_ERRORS.value("deadbeef") == before + 1
+        status, _ = udshttp.request(csock, "/api/v1/fleet/metrics")
+        assert status == 200
+    finally:
+        sc.stop()
+
+
+def test_member_killed_mid_run_goes_stale(tmp_path):
+    fake_now = [100.0]
+    plane = _plane(tmp_path, stale_after=10.0, clock=lambda: fake_now[0])
+    sock = str(tmp_path / "m1.sock")
+    server = CannedServer(sock, {"/metrics": EXPO_A})
+    plane.registry.register(
+        fleet.Member(name="m1", component="daemon", address=sock, pid=5)
+    )
+    plane.federator.scrape_once()
+    assert plane.federator.scoreboard()["members"]["m1"]["up"]
+    server.stop()
+    os.unlink(sock)
+    plane.federator.scrape_once()
+    row = plane.federator.scoreboard()["members"]["m1"]
+    assert not row["up"] and row["stale"]
+    # Last-good series stay in the federated view while flagged.
+    assert 'node="m1"' in plane.federator.render()
+    # And purely by age: a member that stops being scraped goes stale.
+    fake_now[0] += 100.0
+    assert plane.federator.scoreboard()["members"]["m1"]["stale"]
+
+
+def test_fleet_scrape_failpoint_isolates_per_member(tmp_path):
+    plane = _plane(tmp_path)
+    plane.register_local("snapshotter")
+    before = fed.FLEET_SCRAPE_ERRORS.value("snapshotter")
+    with failpoint.injected("fleet.scrape", "error(OSError)"):
+        out = plane.federator.scrape_once()
+    assert out["errors"] == 1
+    assert fed.FLEET_SCRAPE_ERRORS.value("snapshotter") == before + 1
+    out = plane.federator.scrape_once()
+    assert out["errors"] == 0
+    assert plane.federator.scoreboard()["members"]["snapshotter"]["up"]
+
+
+def test_fleet_collect_failpoint_isolates_per_member(tmp_path):
+    plane = _plane(tmp_path)
+    plane.register_local("snapshotter")
+    with trace.span("grpc.Prepare", key="x"):
+        pass
+    before = fed.FLEET_SCRAPE_ERRORS.value("snapshotter")
+    with failpoint.injected("fleet.collect", "error(OSError)"):
+        doc = plane.collector.collect()
+    assert doc["fleet"] == {
+        "members": 0, "errors": 1, "collect_ms": doc["fleet"]["collect_ms"]
+    }
+    assert fed.FLEET_SCRAPE_ERRORS.value("snapshotter") == before + 1
+    doc = plane.collector.collect()
+    assert doc["fleet"]["errors"] == 0
+    assert any(
+        e.get("name") == "grpc.Prepare"
+        for e in doc["traceEvents"] if e.get("ph") == "X"
+    )
+
+
+# ----------------------------------------------------------- trace aggregation
+
+
+def _canned_member_doc(trace_id: str, parent_id: str) -> bytes:
+    """A remote member's chrome doc: one span joining the local trace."""
+    return json.dumps({
+        "traceEvents": [
+            {"name": "thread_name", "ph": "M", "pid": 4242, "tid": 1,
+             "args": {"name": "peer-serve"}},
+            {"name": "peer.serve", "cat": "peer", "ph": "X", "ts": 10.0,
+             "dur": 5.0, "pid": 4242, "tid": 1,
+             "args": {"trace_id": trace_id, "span_id": "fff1",
+                      "parent_id": parent_id}},
+        ],
+        "displayTimeUnit": "ms",
+    }).encode()
+
+
+def test_cross_member_merge_joins_one_tree(tmp_path):
+    plane = _plane(tmp_path)
+    plane.register_local("requester")
+    with trace.span("nydusd.read", path="/x") as root:
+        tid = f"{root.span.trace_id:x}"
+        with trace.span("peer.fetch") as pf:
+            parent = f"{pf.span.span_id:x}"
+    sock = str(tmp_path / "owner.sock")
+    server = CannedServer(
+        sock, {"/api/v1/traces": _canned_member_doc(tid, parent)}
+    )
+    plane.registry.register(
+        fleet.Member(name="owner", component="peer", address=sock, pid=4242)
+    )
+    try:
+        doc = plane.collector.collect()
+        trees = agg.trace_trees(doc)
+        tree = trees[tid]
+        assert tree["processes"] == 2
+        assert tree["single_tree"]
+        assert tree["roots"] == ["nydusd.read"]
+        procs = {
+            e["args"]["name"]
+            for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        assert any("owner (peer" in p for p in procs)
+        assert any("requester" in p for p in procs)
+        # trace_id filter narrows to exactly this tree.
+        narrowed = plane.collector.collect(trace_id=tid)
+        xs = [e for e in narrowed["traceEvents"] if e.get("ph") == "X"]
+        assert {e["args"]["trace_id"] for e in xs} == {tid}
+        assert len(xs) == tree["spans"]
+    finally:
+        server.stop()
+
+
+def test_merge_lane_assignment_is_deterministic():
+    class M:
+        def __init__(self, name):
+            self.name = name
+            self.component = "daemon"
+
+    doc_a = {"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 1, "dur": 1, "pid": 10, "tid": 3,
+         "args": {"trace_id": "t", "span_id": "1", "parent_id": ""}}]}
+    doc_b = {"traceEvents": [
+        {"name": "b", "ph": "X", "ts": 2, "dur": 1, "pid": 10, "tid": 3,
+         "args": {"trace_id": "t", "span_id": "2", "parent_id": "1"}}]}
+    m1 = agg.merge_member_traces([(M("alpha"), doc_a), (M("beta"), doc_b)])
+    m2 = agg.merge_member_traces([(M("beta"), doc_b), (M("alpha"), doc_a)])
+    lanes1 = {e["name"]: e["pid"] for e in m1["traceEvents"] if e["ph"] == "X"}
+    lanes2 = {e["name"]: e["pid"] for e in m2["traceEvents"] if e["ph"] == "X"}
+    assert lanes1 == lanes2  # name-sorted, not arrival-ordered
+    assert lanes1["a"] != lanes1["b"]
+
+
+# ------------------------------------------------- cached collect_once snapshot
+
+
+def test_metrics_snapshot_cached_and_non_blocking(tmp_path):
+    from nydus_snapshotter_tpu.metrics.serve import MetricsServer
+
+    server = MetricsServer(managers=[], cache_dir=str(tmp_path))
+    calls = []
+    gate = threading.Event()
+
+    def slow_collect():
+        calls.append(1)
+        gate.wait(timeout=5)
+
+    server.sn_collector.collect = slow_collect  # type: ignore[method-assign]
+    server.fs_collector.collect = lambda: None  # type: ignore[method-assign]
+    server.daemon_collector.collect = lambda: None  # type: ignore[method-assign]
+
+    gate.set()
+    text, age = server.snapshot(max_age_sec=60.0)
+    assert "ntpu_" in text and age == 0.0 and len(calls) == 1
+    # Within max-age: cached, no second collection.
+    text2, age2 = server.snapshot(max_age_sec=60.0)
+    assert text2 == text and len(calls) == 1
+
+    # A slow refresh must NOT stall concurrent callers: they get the
+    # stale snapshot immediately while one thread waits on the collector.
+    gate.clear()
+    results = []
+
+    def refresher():
+        results.append(server.snapshot(max_age_sec=0.0))
+
+    t = threading.Thread(target=refresher)
+    t.start()
+    deadline = time.time() + 5
+    while len(calls) < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    t0 = time.perf_counter()
+    stale_text, stale_age = server.snapshot(max_age_sec=0.0)
+    waited = time.perf_counter() - t0
+    assert waited < 1.0  # did not queue behind the stuck collector
+    assert stale_text == text
+    gate.set()
+    t.join(timeout=5)
+    assert results
+
+
+# ------------------------------------------------------------------- ntpuctl
+
+
+def _ctl(sock, *argv):
+    import contextlib
+    import io
+
+    import tools.ntpuctl as ctl
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = ctl.main(["--sock", sock, "--json", *argv])
+    assert rc == 0, f"ntpuctl {argv} rc={rc}"
+    return json.loads(buf.getvalue())
+
+
+def test_ntpuctl_against_live_controller(tmp_path):
+    from nydus_snapshotter_tpu.system.system import SystemController
+
+    plane = _plane(tmp_path)
+    plane.register_local("snapshotter")
+    csock = str(tmp_path / "system.sock")
+    sc = SystemController(managers=[], sock_path=csock, fleet=plane)
+    sc.run()
+    try:
+        with trace.span("grpc.Prepare", key="ctl") as root:
+            tid = f"{root.span.trace_id:x}"
+        plane.federator.scrape_once()
+        members = _ctl(csock, "members")
+        assert [m["name"] for m in members] == ["snapshotter"]
+        assert _ctl(csock, "daemons") == []
+        board = _ctl(csock, "top", "--iterations", "1")
+        assert "snapshotter" in board["members"]
+        doc = _ctl(csock, "trace", tid)
+        assert any(
+            e.get("args", {}).get("trace_id") == tid
+            for e in doc["traceEvents"] if e.get("ph") == "X"
+        )
+        assert "objectives" in _ctl(csock, "slo")
+        assert "snapshotter" in _ctl(csock, "blobcache")
+    finally:
+        sc.stop()
+
+
+def test_ntpuctl_against_bare_daemon_socket(tmp_path):
+    from nydus_snapshotter_tpu.daemon.server import DaemonServer
+
+    sock = str(tmp_path / "api.sock")
+    server = DaemonServer("d-ctl", sock, workdir=str(tmp_path))
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while not os.path.exists(sock) and time.time() < deadline:
+        time.sleep(0.01)
+    try:
+        # Member fallback path: the daemon's own summary endpoint.
+        out = _ctl(sock, "blobcache")
+        assert "prefetch_data_amount" in out
+        # The daemon's /metrics exposition serves the federator's scrape.
+        status, body = udshttp.request(sock, "/metrics")
+        assert status == 200 and b"ntpu_trace_spans_total" in body
+    finally:
+        server.shutdown()
+        t.join(timeout=5)

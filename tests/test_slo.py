@@ -1,0 +1,261 @@
+"""SLO engine: objective parsing, sliding windows, error budgets,
+multi-window burn alerting, and the acceptance gate — a latency
+regression injected via failpoints raises a burn alert with the slow-op
+flight recorder attached, and a clean run stays quiet.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from nydus_snapshotter_tpu import failpoint, trace
+from nydus_snapshotter_tpu.metrics import slo as slo_mod
+from nydus_snapshotter_tpu.metrics.registry import Histogram, Registry
+from nydus_snapshotter_tpu.metrics.slo import SloEngine, SloObjective, SloSpecError
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    trace.configure(enabled=True, ring_capacity=4096, slow_op_threshold_ms=0)
+    yield
+    trace.reset()
+
+
+def _objective(**kw):
+    base = dict(
+        name="demand-read-p95",
+        metric="op_ms",
+        threshold_ms=100.0,
+        target=0.9,
+        window_secs=10.0,
+        long_window_factor=1.0,
+        burn_threshold=1.0,
+    )
+    base.update(kw)
+    return SloObjective(**base)
+
+
+def _engine(objective, hist, clock):
+    reg = Registry()
+    reg.register(hist)
+    return SloEngine(
+        [objective], source=slo_mod.local_source(reg), clock=clock
+    )
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+# -------------------------------------------------------------------- parsing
+
+
+def test_objective_validation():
+    with pytest.raises(SloSpecError):
+        SloObjective(name="", metric="m", threshold_ms=1)
+    with pytest.raises(SloSpecError):
+        _objective(target=1.5)
+    with pytest.raises(SloSpecError):
+        _objective(threshold_ms=0)
+    with pytest.raises(SloSpecError):
+        SloObjective.from_dict({"name": "x", "metric": "m", "threshold_ms": 10,
+                                "bogus_key": 1})
+    obj = SloObjective.from_dict(
+        {"name": "x", "metric": "m", "threshold_ms": 10,
+         "labels": {"op": "read_at"}, "long_window_factor": 3.0}
+    )
+    assert obj.long_window_secs == 900.0
+
+
+def test_resolve_env_objectives(monkeypatch):
+    monkeypatch.setenv("NTPU_SLO", "1")
+    monkeypatch.setenv(
+        "NTPU_SLO_OBJECTIVES",
+        '[{"name": "a", "metric": "m", "threshold_ms": 50},'
+        ' {"name": "", "metric": "m", "threshold_ms": 50}]',
+    )
+    enabled, _interval, objectives = slo_mod.resolve_slo_objectives()
+    assert enabled
+    # The malformed second table is skipped, not fatal.
+    assert [o.name for o in objectives] == ["a"]
+
+
+# ---------------------------------------------------------- histogram windows
+
+
+def test_cumulative_le_bucket_alignment():
+    h = Histogram("op_ms", "t", ("op",), buckets=(50, 100, 500))
+    h.labels("read").observe(10)
+    h.labels("read").observe(90)
+    h.labels("read").observe(400)
+    h.labels("other").observe(1)
+    assert h.cumulative_le(100)[("read",)] == (2, 3)
+    assert h.cumulative_le(1000)[("read",)] == (3, 3)  # past last bucket
+
+
+def test_window_compliance_and_budget(tmp_path):
+    clock = FakeClock()
+    h = Histogram("op_ms", "t", buckets=(100, 500))
+    obj = _objective()
+    eng = _engine(obj, h, clock)
+    # Baseline tick, then fast traffic only: compliant.
+    eng.tick()
+    for _ in range(20):
+        h.observe(10)
+    clock.now += 10
+    events = eng.tick()
+    assert events == []
+    st = eng.status()["objectives"][0]
+    assert st["compliance_short"] == 1.0
+    assert st["budget_remaining"] == 1.0
+    # Regress: every op over threshold. Budget is 10%, bad fraction 50%
+    # over the window -> burn 5x > threshold 1.
+    for _ in range(20):
+        h.observe(400)
+    clock.now += 10
+    events = eng.tick()
+    assert len(events) == 1
+    st = eng.status()["objectives"][0]
+    assert st["breached"] and st["burn_short"] > 1.0
+    assert st["budget_remaining"] < 1.0
+    # Still breached: no re-fire until it clears (alert on transition).
+    clock.now += 1
+    assert eng.tick() == []
+    # Recovery: fast traffic pushes the window back under the threshold.
+    for _ in range(400):
+        h.observe(10)
+    clock.now += 10
+    assert eng.tick() == []
+    assert not eng.status()["objectives"][0]["breached"]
+
+
+def test_multi_window_suppresses_short_spike():
+    """A spike shorter than the long window must not page: the short
+    window burns hot, the long window stays under threshold."""
+    clock = FakeClock()
+    h = Histogram("op_ms", "t", buckets=(100, 500))
+    obj = _objective(long_window_factor=6.0, burn_threshold=2.0)
+    eng = _engine(obj, h, clock)
+    # Long history of good traffic filling the long window.
+    for _ in range(7):
+        for _ in range(100):
+            h.observe(10)
+        eng.tick()
+        clock.now += 10
+    # One short window of pure badness.
+    for _ in range(20):
+        h.observe(400)
+    events = eng.tick()
+    st = eng.status()["objectives"][0]
+    assert st["burn_short"] > 2.0  # the spike is visible...
+    assert st["burn_long"] < 2.0  # ...but diluted over the long window
+    assert events == [] and not st["breached"]
+
+
+def test_no_traffic_is_compliant():
+    clock = FakeClock()
+    h = Histogram("op_ms", "t", buckets=(100,))
+    eng = _engine(_objective(), h, clock)
+    for _ in range(3):
+        eng.tick()
+        clock.now += 10
+    st = eng.status()["objectives"][0]
+    assert st["compliance_short"] == 1.0 and not st["breached"]
+
+
+def test_federated_source_sums_and_dedupes_pids():
+    class Fed:
+        def member_samples(self):
+            bucket = "op_ms_bucket"
+            count = "op_ms_count"
+            return {
+                "a": {bucket: [({"le": "100"}, 5)], count: [({}, 10)]},
+                "b": {bucket: [({"le": "100"}, 3)], count: [({}, 3)]},
+                # Same pid as "a": a second role in one process — its
+                # identical counters must not double.
+                "a-peer": {bucket: [({"le": "100"}, 5)], count: [({}, 10)]},
+            }
+
+    class M:
+        def __init__(self, name, pid):
+            self.name, self.pid = name, pid
+
+    members = [M("a", 1), M("a-peer", 1), M("b", 2)]
+    src = slo_mod.federated_source(Fed(), lambda: members)
+    good, total = src(_objective())
+    assert (good, total) == (8.0, 13.0)
+
+
+# ---------------------------------------------- acceptance: failpoint regression
+
+
+def _drive_reads(cb, offsets, chunk):
+    for off in offsets:
+        cb.read_at(off * chunk, chunk)
+
+
+def test_burn_alert_on_injected_latency_regression(tmp_path):
+    """ISSUE 9 acceptance: the SLO engine raises a burn alert when a
+    failpoint injects a latency regression into the real lazy-read path,
+    and stays quiet on the clean run before it. The breach event carries
+    the slow-op flight-recorder dump."""
+    from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+    from nydus_snapshotter_tpu.daemon.fetch_sched import OP_HIST, FetchConfig
+
+    trace.configure(enabled=True, ring_capacity=4096, slow_op_threshold_ms=50)
+    chunk = 4 << 10
+    blob = os.urandom(64 * chunk)
+    cb = CachedBlob(
+        str(tmp_path / "cache"),
+        "ab" * 32,
+        lambda off, size: blob[off : off + size],
+        blob_size=len(blob),
+        config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0),
+    )
+    clock = FakeClock()
+    obj = SloObjective(
+        name="demand-read-p95",
+        metric="ntpu_blobcache_op_duration_milliseconds",
+        labels={"op": "read_at"},
+        threshold_ms=100.0,
+        target=0.9,
+        window_secs=10.0,
+        long_window_factor=1.0,
+        burn_threshold=1.0,
+    )
+    # OP_MS is the process-global histogram the real data plane feeds;
+    # windows diff cumulative counts, so prior tests' traffic cancels.
+    assert OP_HIST.name == obj.metric
+    eng = SloEngine([obj], clock=clock)
+    try:
+        eng.tick()  # baseline snapshot
+        # Clean run: cold reads without injected latency stay fast.
+        _drive_reads(cb, range(16), chunk)
+        clock.now += 10
+        assert eng.tick() == []
+        assert not eng.status()["objectives"][0]["breached"]
+        # Regression: every origin fetch now stalls 150ms > threshold.
+        with failpoint.injected("blobcache.fetch", "delay(0.15)"):
+            _drive_reads(cb, range(16, 32), chunk)
+        clock.now += 10
+        events = eng.tick()
+        assert len(events) == 1
+        event = events[0]
+        assert event["objective"] == "demand-read-p95"
+        # The flight recorder dump rides on the breach: the slow reads
+        # crossed the 50ms slow-op threshold, so their trees are attached.
+        assert event["slow_ops"], "breach event missing flight-recorder dump"
+        assert any(
+            "blobcache" in rec["op"] or "read" in rec["op"]
+            for rec in event["slow_ops"]
+        )
+        status = eng.status()
+        assert status["breaches"] and status["objectives"][0]["breached"]
+    finally:
+        cb.close()

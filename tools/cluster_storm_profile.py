@@ -24,7 +24,13 @@ Gates (abort-on-fail, per ISSUE 8 acceptance):
 - **fairness**: two tenants at 2:1 weights under a saturated admission
   gate receive in-flight byte service within 25% of their configured
   share, and demand-read p95 latency under storm-lane load stays within
-  2x the unloaded p95 (demand-reserved slots + strict priority lanes).
+  2x the unloaded p95 (demand-reserved slots + strict priority lanes);
+- **unified timeline**: a demand read served by a REAL second OS process
+  (this file re-executes itself as ``--member-server``: a peer chunk
+  server + fleet member in its own process) must reconstruct as ONE tree
+  from the controller's ``/api/v1/fleet/traces`` — requester root span,
+  peer fetch, and the owner process's ``peer.serve`` joined by the
+  propagated trace id across the process boundary (ISSUE 9 acceptance).
 
 Usage: python tools/cluster_storm_profile.py [--pods 16] [--mib 2]
            [--reps 2] [--json]
@@ -329,6 +335,166 @@ def _fairness_phase() -> dict:
     }
 
 
+def _member_server_main(argv: list) -> int:
+    """Child-process mode: one peer chunk server owning a fully cached
+    copy of the storm blob, registered as a fleet member. The parent's
+    demand reads pull through this OS process, so the merged fleet trace
+    must join spans from two pids into one tree."""
+    import argparse as _ap
+
+    ap = _ap.ArgumentParser()
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--controller", required=True)
+    ap.add_argument("--blob-kib", type=int, required=True)
+    ap.add_argument("--seed", type=int, required=True)
+    ap.add_argument("--workdir", required=True)
+    args = ap.parse_args(argv)
+
+    import signal as _signal
+
+    from nydus_snapshotter_tpu import fleet
+    from nydus_snapshotter_tpu.daemon import peer
+    from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+    from nydus_snapshotter_tpu.daemon.fetch_sched import FetchConfig
+
+    blob = random.Random(args.seed).randbytes(args.blob_kib << 10)
+    blob_id = "ab" * 32
+    cb = CachedBlob(
+        os.path.join(args.workdir, "owner-cache"),
+        blob_id,
+        lambda off, size: blob[off : off + size],
+        blob_size=len(blob),
+        config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0),
+    )
+    cb.read_at(0, len(blob))  # fully warmed: serves cover-only hits
+    export = peer.PeerExport()
+    export.register(blob_id, cb)
+    server = peer.PeerChunkServer(export, pull_through=True)
+    server.run(args.addr)
+    fleet.register_self(
+        "peer", args.addr, name="owner", controller=args.controller
+    )
+    stop = threading.Event()
+    _signal.signal(_signal.SIGTERM, lambda *_: stop.set())
+    print("READY", flush=True)
+    stop.wait()
+    server.stop()
+    cb.close()
+    return 0
+
+
+def _fleet_phase(workroot: str, seed: int) -> dict:
+    """Unified-timeline gate: demand read crossing two OS processes,
+    reconstructed as one tree from /api/v1/fleet/traces."""
+    import hashlib
+    import subprocess
+
+    from nydus_snapshotter_tpu import fleet, trace
+    from nydus_snapshotter_tpu.daemon import peer
+    from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+    from nydus_snapshotter_tpu.daemon.fetch_sched import FetchConfig
+    from nydus_snapshotter_tpu.system.system import SystemController
+    from nydus_snapshotter_tpu.trace.aggregate import trace_trees
+    from nydus_snapshotter_tpu.utils import udshttp
+
+    trace.configure(enabled=True, ring_capacity=8192, slow_op_threshold_ms=0)
+    blob_kib = 256
+    blob = random.Random(seed).randbytes(blob_kib << 10)
+    blob_id = "ab" * 32
+    base = os.path.join(workroot, "fleet")
+    os.makedirs(base, exist_ok=True)
+    csock = os.path.join(base, "system.sock")
+    osock = os.path.join(base, "owner.sock")
+
+    cfg = fleet.FleetRuntimeConfig(enable=True, scrape_interval_secs=1.0,
+                                   stale_after_secs=10.0)
+    plane = fleet.FleetPlane(cfg=cfg)
+    plane.register_local("requester")
+    sc = SystemController(managers=[], sock_path=csock, fleet=plane)
+    sc.run()
+    proc = subprocess.Popen(
+        [
+            sys.executable, os.path.abspath(__file__), "--member-server",
+            "--addr", osock, "--controller", csock,
+            "--blob-kib", str(blob_kib), "--seed", str(seed),
+            "--workdir", base,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        cwd=REPO,
+        start_new_session=True,
+    )
+    cb = None
+    try:
+        line = proc.stdout.readline()
+        if b"READY" not in line:
+            raise AssertionError("member server never became ready")
+        deadline = time.perf_counter() + 15
+        while plane.registry.get("owner") is None:
+            if time.perf_counter() > deadline:
+                raise AssertionError("owner never registered with the controller")
+            time.sleep(0.05)
+
+        # Demand reads through the real waterfall: every region is owned
+        # by the child process (it is the only peer), so each flight's
+        # peer.fetch crosses the process boundary into its peer.serve.
+        router = peer.PeerRouter([osock], self_address="")
+        fetcher = peer.PeerAwareFetcher(
+            blob_id, lambda off, size: blob[off : off + size], router,
+            timeout_s=10.0,
+        )
+        cb = CachedBlob(
+            os.path.join(base, "requester-cache"),
+            blob_id,
+            fetcher.read_range,
+            blob_size=len(blob),
+            config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0),
+        )
+        with trace.span("nydusd.read", path="/storm-demand", size=4 * CHUNK) as root:
+            root_trace = f"{root.span.trace_id:x}"
+            got = cb.read_at(0, 4 * CHUNK)
+        identical = (
+            hashlib.sha256(got).hexdigest()
+            == hashlib.sha256(blob[: 4 * CHUNK]).hexdigest()
+        )
+
+        doc = udshttp.get_json(
+            csock, f"/api/v1/fleet/traces?trace_id={root_trace}", timeout=10.0
+        )
+        trees = trace_trees(doc)
+        tree = trees.get(root_trace, {})
+        names = {
+            e["name"]
+            for e in doc.get("traceEvents", ())
+            if e.get("ph") == "X"
+        }
+        return {
+            "trace_id": root_trace,
+            "identical": identical,
+            "spans": tree.get("spans", 0),
+            "processes": tree.get("processes", 0),
+            "single_tree": tree.get("single_tree", False),
+            "roots": tree.get("roots", []),
+            "span_names": sorted(names),
+            "members": sorted(m.name for m in plane.registry.members()),
+        }
+    finally:
+        if cb is not None:
+            cb.close()
+        try:
+            os.killpg(proc.pid, 15)
+            proc.wait(timeout=10)
+        except Exception:  # noqa: BLE001 — teardown
+            try:
+                os.killpg(proc.pid, 9)
+            except OSError:
+                pass
+        proc.stdout.close()
+        sc.stop()
+        plane.stop()
+        trace.reset()
+
+
 def profile(pods: int = 16, mib: int = 2, reps: int = 2, seed: int = 7) -> dict:
     assert pods >= 2, "storm needs at least 2 pods"
     blob = random.Random(seed).randbytes(mib << 20)
@@ -420,6 +586,26 @@ def profile(pods: int = 16, mib: int = 2, reps: int = 2, seed: int = 7) -> dict:
                 f"(gate {QOS_P95_FACTOR}x)"
             )
 
+        # Unified timeline: one demand-read tree across two OS processes
+        # from the controller's merged /api/v1/fleet/traces.
+        fleet_trace = _fleet_phase(workroot, seed)
+        if not fleet_trace["identical"]:
+            gates.append("fleet-phase demand read bytes differ from source")
+        if fleet_trace["processes"] < 2:
+            gates.append(
+                f"merged demand-read tree spans {fleet_trace['processes']} "
+                "process(es), need >= 2 (requester -> peer owner)"
+            )
+        if not fleet_trace["single_tree"]:
+            gates.append(
+                "cross-process demand-read spans do not join into one tree: "
+                f"{fleet_trace['span_names']}"
+            )
+        if "nydusd.read" not in fleet_trace["roots"]:
+            gates.append(
+                f"demand-read root missing from merged tree: {fleet_trace['roots']}"
+            )
+
         leaked = [
             t.name
             for t in threading.enumerate()
@@ -449,6 +635,7 @@ def profile(pods: int = 16, mib: int = 2, reps: int = 2, seed: int = 7) -> dict:
             "speedup_gate": speedup_gate,
             "kill_egress_bytes": kill_egress,
             "fairness": fairness,
+            "fleet_trace": fleet_trace,
             "identity": "byte-identical across serial/off/on/kill",
             "gates_failed": gates,
         }
@@ -457,6 +644,8 @@ def profile(pods: int = 16, mib: int = 2, reps: int = 2, seed: int = 7) -> dict:
 
 
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--member-server":
+        return _member_server_main(sys.argv[2:])
     ap = argparse.ArgumentParser()
     ap.add_argument("--pods", type=int, default=16, help="simulated nodes")
     ap.add_argument("--mib", type=int, default=2, help="image blob size")
@@ -484,6 +673,11 @@ def main() -> int:
         print(
             f"fairness: share_a {f['share_a']} (target {f['share_a_target']}, "
             f"err {f['share_err']:.1%})  demand p95 {f['p95_ratio']}x unloaded"
+        )
+        ft = report["fleet_trace"]
+        print(
+            f"fleet trace: {ft['spans']} spans across {ft['processes']} "
+            f"processes single_tree={ft['single_tree']} roots={ft['roots']}"
         )
     for g in report["gates_failed"]:
         print(f"FAIL: {g}", file=sys.stderr)

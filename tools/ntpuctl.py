@@ -1,0 +1,403 @@
+"""ntpuctl — live introspection CLI for the fleet observability plane.
+
+The reference project ships ``nydusctl`` for poking a live nydusd over
+its UDS; this is the fleet-scale equivalent: point it at the system
+controller (default socket) for cluster-wide views, or at any member
+socket (a daemon apisock, a peer server, a standalone dict service) for
+that process alone.
+
+    ntpuctl daemons                     # daemon + instance inventory
+    ntpuctl members                     # fleet member registry
+    ntpuctl blobcache                   # lazy-read cache counters
+    ntpuctl peers                       # peer chunk-tier stats
+    ntpuctl dict                        # shared chunk-dict namespaces
+    ntpuctl slo                         # objectives, budgets, breaches
+    ntpuctl trace 5ce100000001          # one merged cross-process tree
+    ntpuctl top                         # scoreboard, refreshed in place
+    ntpuctl --sock /run/.../d1.sock blobcache
+    ntpuctl --json members              # machine-readable everything
+
+Subcommands degrade with the deployment: against a controller they use
+the ``/api/v1/fleet`` surface, against a bare member they fall back to
+the member's own endpoints; either way the output shape is the same.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from nydus_snapshotter_tpu import constants as C  # noqa: E402
+from nydus_snapshotter_tpu.utils import udshttp  # noqa: E402
+
+
+class CtlError(RuntimeError):
+    pass
+
+
+def _get(sock: str, path: str, timeout: float):
+    try:
+        status, body = udshttp.request(sock, path, timeout=timeout)
+    except OSError as e:
+        raise CtlError(f"cannot reach {sock}: {e}") from e
+    if status == 404:
+        return None
+    if status != 200:
+        raise CtlError(f"{sock} {path} -> {status}: {body[:200].decode(errors='replace')}")
+    try:
+        return json.loads(body)
+    except ValueError:
+        return body.decode(errors="replace")
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return "?"
+
+
+def _fmt_ratio(r) -> str:
+    return "-" if r is None else f"{100.0 * r:.1f}%"
+
+
+def _table(rows: list[list[str]], header: list[str]) -> str:
+    widths = [
+        max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))
+    ]
+    out = ["  ".join(str(c).ljust(w) for c, w in zip(header, widths))]
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def _emit(args, payload, human: str) -> None:
+    print(json.dumps(payload) if args.json else human)
+
+
+# ---------------------------------------------------------------------------
+# Subcommands
+# ---------------------------------------------------------------------------
+
+
+def cmd_daemons(args) -> int:
+    daemons = _get(args.sock, "/api/v1/daemons", args.timeout)
+    if daemons is None:
+        raise CtlError("no /api/v1/daemons here — point --sock at the controller")
+    rows = [
+        [
+            d.get("id", "?"),
+            d.get("pid", 0),
+            d.get("reference", 0),
+            len(d.get("instances", {})),
+            f"{d.get('memory_rss_kb', 0):.0f}K",
+            f"{d.get('read_data_kb', 0):.0f}K",
+            d.get("api_socket", ""),
+        ]
+        for d in daemons
+    ]
+    _emit(args, daemons, _table(
+        rows, ["ID", "PID", "REFS", "INSTANCES", "RSS", "READ", "SOCKET"]
+    ))
+    return 0
+
+
+def cmd_members(args) -> int:
+    members = _get(args.sock, "/api/v1/fleet/members", args.timeout)
+    if members is None:
+        raise CtlError("no fleet plane here — point --sock at the controller "
+                       "and enable [fleet]")
+    rows = [
+        [
+            m["name"], m["component"], m["pid"],
+            "local" if m.get("local") else m.get("address", ""),
+        ]
+        for m in members
+    ]
+    _emit(args, members, _table(rows, ["NAME", "COMPONENT", "PID", "ADDRESS"]))
+    return 0
+
+
+def _scoreboard(args) -> dict:
+    board = _get(args.sock, "/api/v1/fleet/scoreboard", args.timeout)
+    if board is None:
+        raise CtlError("no fleet scoreboard here — point --sock at the "
+                       "controller and enable [fleet]")
+    return board
+
+
+def cmd_blobcache(args) -> int:
+    # A daemon apisock answers directly; the controller serves the
+    # per-member view from the scoreboard's last scrape.
+    direct = _get(args.sock, "/api/v1/metrics/blobcache", args.timeout)
+    if direct is not None:
+        human = "\n".join(f"{k}: {v}" for k, v in sorted(direct.items()))
+        _emit(args, direct, human)
+        return 0
+    board = _scoreboard(args)
+    rows = []
+    payload = {}
+    for name, m in sorted(board["members"].items()):
+        c = m["cache"]
+        payload[name] = c
+        rows.append([
+            name,
+            _fmt_ratio(c["hit_rate"]),
+            _fmt_bytes(c["hit_bytes"]),
+            _fmt_bytes(c["miss_bytes"]),
+            _fmt_ratio(c["readahead_accuracy"]),
+            _fmt_bytes(c["evicted_bytes"]),
+            "stale" if m["stale"] else ("up" if m["up"] else "down"),
+        ])
+    _emit(args, payload, _table(
+        rows, ["MEMBER", "HIT%", "HIT", "MISS", "RA-ACC", "EVICTED", "STATE"]
+    ))
+    return 0
+
+
+def cmd_peers(args) -> int:
+    direct = _get(args.sock, "/api/v1/peer/stat", args.timeout)
+    if direct is not None:
+        _emit(args, direct, "\n".join(f"{k}: {v}" for k, v in sorted(direct.items())))
+        return 0
+    board = _scoreboard(args)
+    rows = []
+    payload = {}
+    for name, m in sorted(board["members"].items()):
+        p = m["peer"]
+        payload[name] = p
+        rows.append([
+            name,
+            _fmt_bytes(p["served_bytes"]),
+            _fmt_bytes(p["fetched_bytes"]),
+            "-" if p["egress_ratio"] is None else f"{p['egress_ratio']:.2f}x",
+            p["fallbacks"] if p["fallbacks"] is not None else "-",
+            "stale" if m["stale"] else ("up" if m["up"] else "down"),
+        ])
+    cooldowns = board["fleet"].get("host_cooldowns", {})
+    human = _table(rows, ["MEMBER", "SERVED", "FETCHED", "EGRESS", "FALLBACKS", "STATE"])
+    if cooldowns:
+        human += "\ncooling down: " + ", ".join(sorted(cooldowns))
+    payload["host_cooldowns"] = cooldowns
+    _emit(args, payload, human)
+    return 0
+
+
+def cmd_dict(args) -> int:
+    direct = _get(args.sock, "/api/v1/dict", args.timeout)
+    if direct is not None:
+        rows = [
+            [
+                ns.get("namespace", "?"), ns.get("chunks", 0),
+                ns.get("blobs", 0), ns.get("epoch", 0),
+            ]
+            for ns in direct
+        ]
+        _emit(args, direct, _table(rows, ["NAMESPACE", "CHUNKS", "BLOBS", "EPOCH"]))
+        return 0
+    board = _scoreboard(args)
+    rows = []
+    payload = {}
+    for name, m in sorted(board["members"].items()):
+        d = m["dict"]
+        if all(v is None for v in d.values()):
+            continue
+        payload[name] = d
+        rows.append([
+            name, d["rpcs"] or 0, d["rpc_errors"] or 0,
+            d["insert_entries"] or 0, d["rebuilds"] or 0,
+        ])
+    _emit(args, payload, _table(
+        rows, ["MEMBER", "RPCS", "ERRORS", "INSERTS", "REBUILDS"]
+    ))
+    return 0
+
+
+def cmd_slo(args) -> int:
+    status = _get(args.sock, "/api/v1/fleet/slo", args.timeout)
+    if status is None:
+        raise CtlError("no SLO engine here — point --sock at the controller "
+                       "and enable [fleet]/[slo]")
+    rows = [
+        [
+            o["objective"],
+            f"{o['threshold_ms']:.0f}ms",
+            f"{100 * o['target']:.2f}%",
+            _fmt_ratio(o.get("compliance_short")),
+            f"{o.get('burn_short', 0):.2f}",
+            f"{o.get('burn_long', 0):.2f}",
+            _fmt_ratio(o.get("budget_remaining")),
+            "BREACH" if o.get("breached") else "ok",
+        ]
+        for o in status["objectives"]
+    ]
+    human = _table(rows, [
+        "OBJECTIVE", "THRESHOLD", "TARGET", "COMPLIANCE",
+        "BURN-S", "BURN-L", "BUDGET", "STATE",
+    ])
+    breaches = status.get("breaches", [])
+    if breaches:
+        human += f"\n{len(breaches)} breach event(s); latest: " + json.dumps(
+            {k: breaches[-1][k] for k in ("objective", "at")}
+        )
+    _emit(args, status, human)
+    return 0
+
+
+def _render_tree(doc: dict, trace_id: str) -> str:
+    procs = {
+        e["pid"]: e["args"]["name"]
+        for e in doc.get("traceEvents", ())
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    spans = [e for e in doc.get("traceEvents", ()) if e.get("ph") == "X"]
+    if not spans:
+        return f"trace {trace_id}: no spans (evicted from every ring, or wrong id)"
+    by_id = {e["args"].get("span_id"): e for e in spans}
+    children: dict[str, list] = {}
+    roots, detached = [], []
+    for e in spans:
+        parent = e["args"].get("parent_id")
+        if not parent:
+            roots.append(e)
+        elif parent in by_id:
+            children.setdefault(parent, []).append(e)
+        else:
+            detached.append(e)
+    lines = [f"trace {trace_id}: {len(spans)} spans across "
+             f"{len({e['pid'] for e in spans})} process(es)"]
+
+    def walk(e, depth):
+        proc = procs.get(e["pid"], f"pid{e['pid']}")
+        lines.append(
+            "  " * depth
+            + f"{e['name']} {e.get('dur', 0) / 1000.0:.2f}ms [{proc}]"
+        )
+        for c in sorted(children.get(e["args"].get("span_id"), ()),
+                        key=lambda x: x.get("ts", 0)):
+            walk(c, depth + 1)
+
+    for r in sorted(roots, key=lambda x: x.get("ts", 0)):
+        walk(r, 1)
+    if detached:
+        lines.append("  (detached — parent span not in any ring)")
+        for e in sorted(detached, key=lambda x: x.get("ts", 0)):
+            walk(e, 2)
+    return "\n".join(lines)
+
+
+def cmd_trace(args) -> int:
+    tid = args.trace_id.lower().removeprefix("0x")
+    doc = _get(args.sock, f"/api/v1/fleet/traces?trace_id={tid}", args.timeout)
+    if doc is None:
+        # Bare member: its own ring, filtered here.
+        doc = _get(args.sock, "/api/v1/traces", args.timeout)
+        if doc is None:
+            raise CtlError("no trace endpoint on this socket")
+        doc = {
+            "traceEvents": [
+                e for e in doc.get("traceEvents", ())
+                if e.get("ph") != "X" or e.get("args", {}).get("trace_id") == tid
+            ]
+        }
+    _emit(args, doc, _render_tree(doc, tid))
+    return 0
+
+
+def cmd_top(args) -> int:
+    iterations = args.iterations
+    n = 0
+    while True:
+        board = _scoreboard(args)
+        if args.json:
+            print(json.dumps(board), flush=True)
+        else:
+            f = board["fleet"]
+            rows = []
+            for name, m in sorted(board["members"].items()):
+                state = "stale" if m["stale"] else ("up" if m["up"] else "down")
+                rows.append([
+                    name, m["component"], state, f"{m['age_s']:.0f}s",
+                    _fmt_ratio(m["cache"]["hit_rate"]),
+                    _fmt_ratio(m["cache"]["readahead_accuracy"]),
+                    _fmt_bytes(m["peer"]["served_bytes"]),
+                    sum(m["admission"]["queued"].values() or [0]),
+                    m["traces"]["dropped"] or 0,
+                    m["scrape_errors"],
+                ])
+            slo_rows = board.get("slo", {}).get("objectives", [])
+            breached = [o["objective"] for o in slo_rows if o.get("breached")]
+            out = [
+                time.strftime("%H:%M:%S")
+                + f"  members {f['up']}/{f['registered']} up, {f['stale']} stale"
+                + (f"  SLO BREACH: {', '.join(breached)}" if breached else ""),
+                _table(rows, [
+                    "MEMBER", "ROLE", "STATE", "AGE", "HIT%", "RA-ACC",
+                    "P2P-OUT", "QUEUED", "DROPS", "SCRAPE-ERR",
+                ]),
+            ]
+            if n > 0 and sys.stdout.isatty():
+                print("\x1b[2J\x1b[H", end="")
+            print("\n".join(out), flush=True)
+        n += 1
+        if iterations and n >= iterations:
+            return 0
+        time.sleep(args.interval)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ntpuctl", description="fleet observability introspection"
+    )
+    ap.add_argument(
+        "--sock", default=C.DEFAULT_SYSTEM_CONTROLLER_ADDRESS,
+        help="controller or member socket (UDS path or host:port)",
+    )
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("daemons")
+    sub.add_parser("members")
+    sub.add_parser("blobcache")
+    sub.add_parser("peers")
+    sub.add_parser("dict")
+    sub.add_parser("slo")
+    tr = sub.add_parser("trace")
+    tr.add_argument("trace_id")
+    top = sub.add_parser("top")
+    top.add_argument("--interval", type=float, default=2.0)
+    top.add_argument("--iterations", type=int, default=0,
+                     help="refresh count (0 = until interrupted)")
+    args = ap.parse_args(argv)
+
+    handlers = {
+        "daemons": cmd_daemons,
+        "members": cmd_members,
+        "blobcache": cmd_blobcache,
+        "peers": cmd_peers,
+        "dict": cmd_dict,
+        "slo": cmd_slo,
+        "trace": cmd_trace,
+        "top": cmd_top,
+    }
+    try:
+        return handlers[args.cmd](args)
+    except CtlError as e:
+        print(f"ntpuctl: {e}", file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())
