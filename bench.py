@@ -915,6 +915,35 @@ def chunk_dict_run(repo: str, timeout: float = 240.0) -> dict:
         return {"error": "chunk-dict profile produced no JSON"}
 
 
+_COMPRESSION_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from tools.compression_profile import profile
+print(json.dumps(profile(mib=12, reps=2)))
+"""
+
+
+def compression_adaptive_run(repo: str, timeout: float = 240.0) -> dict:
+    """Adaptive-codec profile (tools/compression_profile.py) in a child
+    under the hard watchdog: paired best-rep + analytic speedup at
+    reference defaults, roundtrip identity on every arm, bypass
+    discipline, trained-dict loud-failure and DCtx-pool gates. A wedged
+    codec costs one timeout, not a hang."""
+    res = _run_child_watchdog(
+        [sys.executable, "-c", _COMPRESSION_CHILD.format(repo=repo)], timeout=timeout
+    )
+    if res is None:
+        return {"error": f"compression profile hung >{timeout:.0f}s (watchdog killed it)"}
+    rc, stdout, stderr = res
+    if rc != 0:
+        tail = stderr.strip().splitlines()[-1] if stderr.strip() else ""
+        return {"error": f"compression profile exited rc={rc}: {tail}"[:200]}
+    try:
+        return json.loads(stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": "compression profile produced no JSON"}
+
+
 def _device_available(repo: str, timeout: float = 120.0) -> tuple[bool, str]:
     """(ok, note) — probe jax.devices() in a subprocess under the hard
     watchdog (_run_child_watchdog): a wedged device tunnel must degrade
@@ -1157,6 +1186,9 @@ def main() -> None:
     chunk_dict_detail = chunk_dict_run(repo)
     peer_storm = peer_storm_run(repo)
     fleet_obs = fleet_obs_run(repo)
+    # Adaptive-codec engine numbers ride under detail.compression next
+    # to the per-codec economics they change.
+    compression_economics["adaptive"] = compression_adaptive_run(repo)
 
     print(
         json.dumps(

@@ -141,6 +141,47 @@ class ConvertConfig:
 
 
 @dataclass
+class CompressionConfig:
+    """Adaptive per-chunk codec knobs (converter/codec.py).
+
+    With ``adaptive`` on (and the pack compressor ``zstd``), every chunk
+    gets a cheap compressibility probe — a sampled level-1
+    trial-compress (``probe = "sample"``) or a byte-entropy estimate
+    (``"entropy"``) — and is then stored raw (predicted ratio ≥
+    ``bypass_ratio``: the incompressibility bypass), compressed at
+    ``level_fast`` (≥ ``low_gain_ratio``), at ``level_best`` (≤
+    ``high_gain_ratio``) or at ``level_default`` (0 = the fixed
+    reference level). ``dict_path`` loads an epoch-stamped corpus-trained
+    zstd dictionary; ``train`` trains one per namespace from chunk
+    samples during batch convert (``train_dict_kib`` target size,
+    ``train_sample_mib`` sample budget) and shares it through the dict
+    service. OFF by default: pack output stays byte-identical to the
+    reference lane. Enabling trained dictionaries is a chunk-frame
+    format change — frames carry a versioned ``nZD1`` header and readers
+    without the dictionary fail loudly. Environment variables override
+    per-process (``NTPU_COMPRESS_ADAPTIVE``, ``NTPU_COMPRESS_PROBE``,
+    ``NTPU_COMPRESS_PROBE_SAMPLE_KIB``, ``NTPU_COMPRESS_BYPASS_RATIO``,
+    ``NTPU_COMPRESS_DICT``, ``NTPU_COMPRESS_TRAIN``,
+    ``NTPU_COMPRESS_LEVELS`` — "fast,default,best" triple) — that is
+    also how the section reaches spawned converter processes.
+    """
+
+    adaptive: bool = False
+    probe: str = "sample"  # sample | entropy | off
+    probe_sample_kib: int = 16
+    bypass_ratio: float = 0.97
+    low_gain_ratio: float = 0.85
+    high_gain_ratio: float = 0.35
+    level_fast: int = 1
+    level_default: int = 0  # 0 = constants.ZSTD_LEVEL
+    level_best: int = 3  # ratio-neutral default; raise to trade speed → ratio
+    dict_path: str = ""
+    train: bool = False
+    train_dict_kib: int = 112
+    train_sample_mib: int = 8
+
+
+@dataclass
 class BlobcacheConfig:
     """Lazy-read data plane knobs (daemon/fetch_sched.py).
 
@@ -362,6 +403,7 @@ class SnapshotterConfig:
     cache_manager: CacheManagerConfig = field(default_factory=CacheManagerConfig)
     image: ImageConfig = field(default_factory=ImageConfig)
     convert: ConvertConfig = field(default_factory=ConvertConfig)
+    compression: CompressionConfig = field(default_factory=CompressionConfig)
     blobcache: BlobcacheConfig = field(default_factory=BlobcacheConfig)
     peer: PeerConfig = field(default_factory=PeerConfig)
     snapshots: SnapshotsConfig = field(default_factory=SnapshotsConfig)
@@ -439,6 +481,37 @@ class SnapshotterConfig:
             or self.convert.window_mib <= 0
         ):
             raise ConfigError("convert queue/budget/window MiB must be positive")
+        if self.compression.probe not in ("sample", "entropy", "off"):
+            raise ConfigError(
+                f"invalid compression.probe {self.compression.probe!r} "
+                "(sample | entropy | off)"
+            )
+        if self.compression.probe_sample_kib < 1:
+            raise ConfigError("compression.probe_sample_kib must be >= 1")
+        if not (
+            0.0
+            < self.compression.high_gain_ratio
+            < self.compression.low_gain_ratio
+            < self.compression.bypass_ratio
+            <= 1.0
+        ):
+            raise ConfigError(
+                "compression ratios must satisfy 0 < high_gain_ratio < "
+                "low_gain_ratio < bypass_ratio <= 1"
+            )
+        if not (
+            1 <= self.compression.level_fast <= 19
+            and 0 <= self.compression.level_default <= 19
+            and 1 <= self.compression.level_best <= 19
+        ):
+            raise ConfigError(
+                "compression levels must be in [1, 19] (level_default: 0 = "
+                "the fixed reference level)"
+            )
+        if self.compression.train_dict_kib < 1 or self.compression.train_sample_mib < 1:
+            raise ConfigError(
+                "compression.train_dict_kib/train_sample_mib must be >= 1"
+            )
         if self.blobcache.fetch_workers < 1:
             raise ConfigError("blobcache.fetch_workers must be >= 1")
         if self.blobcache.merge_gap_kib < 0 or self.blobcache.readahead_kib < 0:

@@ -206,12 +206,14 @@ class BatchConverter:
         layer_fanout: Optional[int] = None,
         dict_service: Optional[str] = None,
         namespace: Optional[str] = None,
+        codec=None,
     ):
         if opt.chunk_dict_path:
             raise ConvertError(
                 "BatchConverter owns the chunk dict; use dict_path= instead "
                 "of PackOption.chunk_dict_path"
             )
+        from nydus_snapshotter_tpu.converter import codec as codec_mod
         from nydus_snapshotter_tpu.parallel import dict_service as dict_service_mod
         from nydus_snapshotter_tpu.parallel import pipeline as pipeline_mod
 
@@ -225,6 +227,11 @@ class BatchConverter:
         )
         dcfg = dict_service_mod.resolve_dict_config()
         service = dict_service if dict_service is not None else dcfg.service
+        self.namespace = namespace or dcfg.namespace
+        # Adaptive codec engine (off by default): one codec for the whole
+        # batch so the dict trainer samples across images and the trained
+        # dictionary applies to everything converted after it.
+        self.codec = codec if codec is not None else codec_mod.resolve_codec(opt)
         if service:
             if dict_path:
                 raise ConvertError(
@@ -234,8 +241,14 @@ class BatchConverter:
                 )
             self.dict = dict_service_mod.ServiceChunkDict(
                 dict_service_mod.DictClient(service),
-                namespace or dcfg.namespace,
+                self.namespace,
             )
+            if self.codec is not None and self.codec.trained is None:
+                # Cross-host sharing: adopt the namespace's already-trained
+                # dictionary (epoch-stamped) before converting anything.
+                blob = self.dict.client.get_zdict(self.namespace)
+                if blob:
+                    self.codec.set_trained(codec_mod.TrainedDict.deserialize(blob))
         else:
             self.dict = (
                 GrowingChunkDict.load(dict_path) if dict_path else GrowingChunkDict()
@@ -257,6 +270,7 @@ class BatchConverter:
                         self.opt,
                         chunk_dict=self.dict if len(self.dict) else None,
                         budget=self.budget,
+                        codec=self.codec,
                     )
                     return out.getvalue(), res
 
@@ -278,6 +292,7 @@ class BatchConverter:
                 chunk_dict=self.dict if len(self.dict) else None,
             )
             added = self.dict.add_bootstrap_bytes(merged.bootstrap)
+        self._maybe_train_codec()
         layer_blobs = {
             res.blob_id: blob for blob, res in packed if res.blob_id
         }
@@ -289,9 +304,45 @@ class BatchConverter:
             new_dict_chunks=added,
         )
 
+    def _maybe_train_codec(self, force: bool = False):
+        """Between-images dictionary training: once the codec's sample
+        reservoir fills, train the namespace dictionary and (when
+        service-backed) publish it so converters on other hosts adopt it.
+        Training failure is non-fatal — the batch continues untrained
+        (chaos-pinned at ``compress.train``)."""
+        if self.codec is None:
+            return None
+        td = self.codec.maybe_train(force=force)
+        if td is None:
+            return None
+        client = getattr(self.dict, "client", None)
+        if client is not None:
+            try:
+                client.put_zdict(td.serialize(), self.namespace)
+            except Exception:
+                # The dictionary still applies locally; sharing is
+                # best-effort (the service may predate the endpoint).
+                pass
+        return td
+
+    def train_codec_dict(self):
+        """Force dictionary training NOW from whatever the sampler holds
+        (the between-images path waits for a full sample budget).
+        Returns the TrainedDict, or None (no codec / no samples /
+        training failed — the batch continues untrained)."""
+        return self._maybe_train_codec(force=True)
+
     def convert_many(self, images: list[tuple[str, list[bytes]]]) -> list[ImageResult]:
         """Caller order IS the dedup order; results come back in it too."""
         return [self.convert_image(name, layers) for name, layers in images]
 
     def save_dict(self, path: str) -> None:
         self.dict.save(path)
+
+    def save_trained_dict(self, path: str) -> bool:
+        """Persist the codec's trained dictionary (epoch-stamped,
+        alongside the chunk dict); False when none was trained."""
+        if self.codec is None or self.codec.trained is None:
+            return False
+        self.codec.trained.save(path)
+        return True

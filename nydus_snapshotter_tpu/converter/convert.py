@@ -60,9 +60,15 @@ class MergeResult:
     blob_digests: list[str]  # referenced blob ids after dedup, table order
 
 
-def _make_compressor(compressor: str, lz4_accel: int = 1):
+def _make_compressor(compressor: str, lz4_accel: int = 1, codec=None):
     """One reusable codec per Pack — a fresh zstd context per chunk costs
-    allocation/init for every one of the thousands of chunks in a layer."""
+    allocation/init for every one of the thousands of chunks in a layer.
+
+    ``codec``: an :class:`~nydus_snapshotter_tpu.converter.codec.AdaptiveCodec`
+    takes over the zstd lane (probe/bypass/per-class levels/trained
+    dict); ``None`` is the byte-identical fixed-level default."""
+    if codec is not None and compressor == "zstd":
+        return codec.encode
     if compressor == "zstd":
         from nydus_snapshotter_tpu.utils import zstd as zstd_native
 
@@ -90,16 +96,24 @@ class ThreadSafeCompressor:
     ZstdCompressor instances are not safe for concurrent calls; output is
     still deterministic across contexts (same level, single-threaded
     contexts), so racing threads produce identical bytes.
+
+    With an adaptive ``codec`` the call routes straight to
+    ``codec.encode`` — the codec engine keeps its own per-worker pinned
+    contexts and is deterministic in chunk content, so the same racing
+    invariant holds.
     """
 
-    def __init__(self, compressor: str, lz4_accel: int = 1):
+    def __init__(self, compressor: str, lz4_accel: int = 1, codec=None):
         import threading
 
         self._kind = compressor
         self._lz4_accel = lz4_accel
+        self._codec = codec if (codec is not None and compressor == "zstd") else None
         self._tls = threading.local()
 
     def __call__(self, data):
+        if self._codec is not None:
+            return self._codec.encode(data)
         fn = getattr(self._tls, "fn", None)
         if fn is None:
             fn = _make_compressor(self._kind, self._lz4_accel)
@@ -110,7 +124,30 @@ class ThreadSafeCompressor:
 def _decompress_chunk(data: bytes, flags: int, expect_size: int) -> bytes:
     comp = flags & constants.COMPRESSOR_MASK
     if comp == constants.COMPRESSOR_ZSTD:
-        return zstandard.ZstdDecompressor().decompress(data, max_output_size=max(expect_size, 1))
+        from nydus_snapshotter_tpu.converter import codec as codec_mod
+        from nydus_snapshotter_tpu.utils import zstdcompat
+
+        if codec_mod.is_trained_frame(data):
+            # Versioned trained-dict frame (nZD1 header): decodes only
+            # with the dictionary it was trained with — a reader that
+            # lacks it must fail loudly, never emit garbage bytes.
+            try:
+                return codec_mod.decode_trained_frame(data, expect_size)
+            except codec_mod.CodecError as e:
+                raise ConvertError(str(e)) from e
+        try:
+            # Pooled-DCtx decode path: no per-call context allocation
+            # (the previous per-call ZstdDecompressor() construction was
+            # measurable on the lazy-read hot path).
+            return zstdcompat.decompress_block(
+                data, max_output_size=max(expect_size, 1)
+            )
+        except Exception:
+            # Any conforming frame decodes identically on the package
+            # decompressor; keep it as the compatibility net.
+            return zstandard.ZstdDecompressor().decompress(
+                data, max_output_size=max(expect_size, 1)
+            )
     if comp == constants.COMPRESSOR_LZ4_BLOCK:
         return lz4.decompress_block(data, expect_size)
     if comp == constants.COMPRESSOR_GZIP:
@@ -264,6 +301,7 @@ def Pack(
     chunk_dict=None,
     stats: dict | None = None,
     budget=None,
+    codec=None,
 ) -> PackResult:
     """Convert one OCI layer tar into a nydus blob stream written to dest.
 
@@ -275,14 +313,24 @@ def Pack(
     streaming callers alike. On multi-worker hosts the per-layer stages
     overlap through the stage-parallel executor (parallel/pipeline.py);
     ``budget`` optionally pins that executor to a caller-owned
-    MemoryBudget (batch conversion shares one across layers).
+    MemoryBudget (batch conversion shares one across layers). ``codec``
+    optionally pins an adaptive codec engine (converter/codec.py) for
+    the zstd lane; ``None`` resolves from config/env (and stays the
+    byte-identical fixed-level lane when the engine is off, the
+    default).
     """
     from nydus_snapshotter_tpu import failpoint
     from nydus_snapshotter_tpu.converter.stream import pack_stream
 
     failpoint.hit("converter.pack")
     return pack_stream(
-        dest, src_tar, opt, chunk_dict=chunk_dict, stats=stats, budget=budget
+        dest,
+        src_tar,
+        opt,
+        chunk_dict=chunk_dict,
+        stats=stats,
+        budget=budget,
+        codec=codec,
     )
 
 
@@ -292,10 +340,14 @@ def pack_layer(
     chunk_dict=None,
     stats: dict | None = None,
     budget=None,
+    codec=None,
 ) -> tuple[bytes, PackResult]:
     """Convenience: Pack to bytes."""
     out = io.BytesIO()
-    res = Pack(out, src_tar, opt, chunk_dict=chunk_dict, stats=stats, budget=budget)
+    res = Pack(
+        out, src_tar, opt, chunk_dict=chunk_dict, stats=stats, budget=budget,
+        codec=codec,
+    )
     return out.getvalue(), res
 
 

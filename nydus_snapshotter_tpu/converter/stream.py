@@ -705,6 +705,7 @@ def pack_stream(
     chunk_dict=None,
     stats: "Optional[dict]" = None,
     budget=None,
+    codec=None,
 ):
     """Stream one OCI layer tar into a nydus blob written to ``dest``.
 
@@ -726,6 +727,15 @@ def pack_stream(
     conversion passes ONE budget for every concurrently packing layer so
     aggregate convert memory stays independent of layer count. ``None``
     draws from the process-wide shared budget.
+
+    ``codec``: optional :class:`converter.codec.AdaptiveCodec` — the
+    adaptive per-chunk zstd engine (probe/bypass/per-class levels/
+    trained dict). ``None`` resolves it from config/env; when the engine
+    is off (the default) the pack keeps the fixed-level lane and its
+    byte-identity invariant, including the native deferred/fused section
+    arms. An ACTIVE codec owns the chunk-frame decisions, so the pack
+    routes through the Python section writer (the codec-stage interface
+    a device-offloaded codec would implement too).
     """
     import io
     from time import perf_counter as _pc
@@ -752,14 +762,22 @@ def pack_stream(
         chunk_dict = open_chunk_dict(opt.chunk_dict_path)
     from nydus_snapshotter_tpu.converter.convert import _make_compressor
 
+    if codec is None:
+        from nydus_snapshotter_tpu.converter import codec as codec_mod
+
+        codec = codec_mod.resolve_codec(opt)
+
     out = _CountingWriter(dest)
     from nydus_snapshotter_tpu.ops import native_cdc
 
-    compress = _make_compressor(opt.compressor, opt.lz4_acceleration)
+    compress = _make_compressor(opt.compressor, opt.lz4_acceleration, codec=codec)
     align_needed = opt.aligned_chunk and opt.fs_version == layout.RAFS_V5
     if (
         raw is not None
         and opt.compressor in ("none", "lz4_block", "zstd")
+        # the adaptive codec owns per-chunk frame decisions — the native
+        # section arms compress at one fixed level and would bypass it
+        and codec is None
         and not opt.encrypt
         and not opt.batch_size
         and not align_needed
@@ -1144,7 +1162,7 @@ def pack_stream(
                     )
 
                     compress_fn = ThreadSafeCompressor(
-                        opt.compressor, opt.lz4_acceleration
+                        opt.compressor, opt.lz4_acceleration, codec=codec
                     )
                     batch_limit = opt.batch_size
 

@@ -69,6 +69,9 @@ KNOWN_SITES = (
     "metastore.commit",      # snapshot/metastore.py commit_active
     "metastore.remove",      # snapshot/metastore.py remove
     "converter.pack",        # converter/convert.py Pack dispatch
+    "compress.probe",        # converter/codec.py per-chunk compressibility probe
+    "compress.train",        # converter/codec.py ZDICT corpus training
+    "compress.encode",       # converter/codec.py adaptive encode entry
     "pipeline.chunk",        # parallel/pipeline.py chunk-worker item entry
     "pipeline.queue",        # parallel/pipeline.py ByteBoundedQueue.put
     "pipeline.compress",     # parallel/pipeline.py compress-worker item entry

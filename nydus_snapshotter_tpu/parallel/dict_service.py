@@ -195,6 +195,12 @@ class ServiceDict:
         # only ever be mutated under self._mu (probes stay lock-free and
         # are deliberately NOT annotated — TSan covers that claim).
         self._records_shared = _an.shared("dict_service.records")
+        # Corpus-trained zstd dictionary for this namespace (serialized
+        # epoch-stamped TrainedDict blob, converter/codec.py): trained
+        # once by some batch converter, adopted by every converter that
+        # joins the namespace afterward. Highest epoch wins.
+        self._zdict: Optional[bytes] = None
+        self._zdict_meta: Optional[tuple[int, int]] = None  # (dict_id, epoch)
 
     # -- mutation ------------------------------------------------------------
 
@@ -247,11 +253,39 @@ class ServiceDict:
         }
         if added is not None:
             out["added"] = added
+        if self._zdict_meta is not None:
+            out["zdict_id"], out["zdict_epoch"] = self._zdict_meta
         return out
 
     def stats(self) -> dict:
         with self._mu:
             return self._stats_locked()
+
+    # -- trained compression dictionary --------------------------------------
+
+    def put_zdict(self, blob: bytes) -> dict:
+        """Adopt a serialized epoch-stamped trained dictionary
+        (converter/codec.TrainedDict wire format; validated). An older
+        epoch never replaces a newer one."""
+        from nydus_snapshotter_tpu.converter import codec as codec_mod
+
+        td = codec_mod.TrainedDict.deserialize(blob)
+        with self._mu:
+            if self._zdict_meta is None or td.epoch >= self._zdict_meta[1]:
+                self._zdict = bytes(blob)
+                self._zdict_meta = (td.dict_id, td.epoch)
+            dict_id, epoch = self._zdict_meta
+            return {
+                "namespace": self.namespace,
+                "zdict_id": dict_id,
+                "zdict_epoch": epoch,
+                "bytes": len(self._zdict or b""),
+            }
+
+    def get_zdict(self) -> bytes:
+        """The namespace's trained dictionary blob (b'' when untrained)."""
+        with self._mu:
+            return self._zdict or b""
 
     def entries_delta(
         self, chunks: int, blobs: int, batches: int, ciphers: int
@@ -308,7 +342,17 @@ class ServiceDict:
         with self._mu:
             self.records.save(path)
             idx = self.index.save_incremental(path + ".idx")
-        return {"bootstrap": path, "index": path + ".idx", "index_save": idx}
+            zd = self._zdict
+        out = {"bootstrap": path, "index": path + ".idx", "index_save": idx}
+        if zd:
+            # The trained codec dictionary persists alongside the chunk
+            # dict (already epoch-stamped + checksummed in its own blob).
+            tmp = path + ".zdict.tmp"
+            with open(tmp, "wb") as f:
+                f.write(zd)
+            os.replace(tmp, path + ".zdict")
+            out["zdict"] = path + ".zdict"
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -431,6 +475,15 @@ class DictService:
             if not path:
                 raise ValueError("save needs a path")
             return sd.save(path)
+        if op == "zdict" and method == "GET":
+            return sd.get_zdict()
+        if op == "zdict" and method == "POST":
+            from nydus_snapshotter_tpu.converter.codec import CodecError
+
+            try:
+                return sd.put_zdict(body)
+            except CodecError as e:
+                raise ValueError(str(e)) from e
         raise ValueError(f"no such dict op {method} {op!r}")
 
     # -- standalone UDS server ------------------------------------------------
@@ -614,6 +667,19 @@ class DictClient:
                 json.dumps({"path": path}).encode(),
             )[1]
         )
+
+    def put_zdict(self, blob: bytes, namespace: str = DEFAULT_NAMESPACE) -> dict:
+        """Publish a serialized trained compression dictionary
+        (converter/codec.TrainedDict.serialize) to the namespace."""
+        return json.loads(
+            self._request("POST", f"/api/v1/dict/{namespace}/zdict", blob)[1]
+        )
+
+    def get_zdict(self, namespace: str = DEFAULT_NAMESPACE) -> "Optional[bytes]":
+        """The namespace's trained compression dictionary blob, or None
+        when the namespace is untrained."""
+        _ctype, payload = self._request("GET", f"/api/v1/dict/{namespace}/zdict")
+        return payload or None
 
 
 # ---------------------------------------------------------------------------

@@ -31,6 +31,9 @@ class ZstdError(ValueError):
 
 _LIB_CANDIDATES = ("libzstd.so.1", "libzstd.so", "libzstd.dylib")
 
+_CONTENTSIZE_UNKNOWN = 2**64 - 1
+_CONTENTSIZE_ERROR = 2**64 - 2
+
 
 class _Api:
     # A CCtx is not concurrency-safe and each one holds a multi-MiB
@@ -38,6 +41,9 @@ class _Api:
     # thread-locals: short-lived pool threads (the per-layer speculative
     # compression executors) would otherwise strand one leaked context
     # per dead thread. Contexts beyond the cap are freed immediately.
+    # (The adaptive codec engine PINS one pooled context per compress
+    # worker for its whole run — converter/codec.py — so the hot loop
+    # pays neither the create nor the pool lock per chunk.)
     POOL_CAP = 8
 
     def __init__(self, lib: ctypes.CDLL):
@@ -63,6 +69,86 @@ class _Api:
         self.lib = lib
         self._lock = threading.Lock()
         self._pool: list[int] = []
+        # Decompress contexts: one-shot ZSTD_decompress allocates and
+        # frees an internal DCtx per call — pooling them is the
+        # decompress-path analog of the CCtx pool (lazy-read daemons
+        # decode thousands of chunk frames per mount).
+        self._dpool: list[int] = []
+        self.dctx_reuses = 0
+        self.dctx_creates = 0
+        self.has_dctx = self._bind_dctx(lib)
+        self.has_dict = self._bind_dict(lib)
+        self.has_zdict = self._bind_zdict(lib)
+
+    @staticmethod
+    def _bind_dctx(lib) -> bool:
+        try:
+            lib.ZSTD_createDCtx.restype = ctypes.c_void_p
+            lib.ZSTD_freeDCtx.restype = ctypes.c_size_t
+            lib.ZSTD_freeDCtx.argtypes = [ctypes.c_void_p]
+            lib.ZSTD_decompressDCtx.restype = ctypes.c_size_t
+            lib.ZSTD_decompressDCtx.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.c_void_p, ctypes.c_size_t,
+            ]
+            lib.ZSTD_getFrameContentSize.restype = ctypes.c_ulonglong
+            lib.ZSTD_getFrameContentSize.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t,
+            ]
+        except AttributeError:
+            return False
+        return True
+
+    @staticmethod
+    def _bind_dict(lib) -> bool:
+        """Digested-dictionary arms: CDict/DDict pre-process the trained
+        dictionary ONCE, so per-chunk dict compression costs no dict load."""
+        try:
+            lib.ZSTD_createCDict.restype = ctypes.c_void_p
+            lib.ZSTD_createCDict.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int,
+            ]
+            lib.ZSTD_freeCDict.restype = ctypes.c_size_t
+            lib.ZSTD_freeCDict.argtypes = [ctypes.c_void_p]
+            lib.ZSTD_compress_usingCDict.restype = ctypes.c_size_t
+            lib.ZSTD_compress_usingCDict.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.c_void_p,
+            ]
+            lib.ZSTD_createDDict.restype = ctypes.c_void_p
+            lib.ZSTD_createDDict.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+            lib.ZSTD_freeDDict.restype = ctypes.c_size_t
+            lib.ZSTD_freeDDict.argtypes = [ctypes.c_void_p]
+            lib.ZSTD_decompress_usingDDict.restype = ctypes.c_size_t
+            lib.ZSTD_decompress_usingDDict.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.c_void_p,
+            ]
+        except AttributeError:
+            return False
+        return True
+
+    @staticmethod
+    def _bind_zdict(lib) -> bool:
+        try:
+            lib.ZDICT_trainFromBuffer.restype = ctypes.c_size_t
+            lib.ZDICT_trainFromBuffer.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_size_t), ctypes.c_uint,
+            ]
+            lib.ZDICT_isError.restype = ctypes.c_uint
+            lib.ZDICT_isError.argtypes = [ctypes.c_size_t]
+            lib.ZDICT_getDictID.restype = ctypes.c_uint
+            lib.ZDICT_getDictID.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+        except AttributeError:
+            return False
+        return True
 
     def acquire(self) -> int:
         with self._lock:
@@ -81,6 +167,26 @@ class _Api:
                 self._pool.append(ctx)
                 return
         self.lib.ZSTD_freeCCtx(ctx)
+
+    def acquire_d(self) -> int:
+        with self._lock:
+            if self._dpool:
+                self.dctx_reuses += 1
+                return self._dpool.pop()
+            self.dctx_creates += 1
+        ctx = self.lib.ZSTD_createDCtx()
+        if not ctx:
+            raise ZstdError("ZSTD_createDCtx failed (out of memory)")
+        return ctx
+
+    def release_d(self, ctx: int) -> None:
+        if not ctx:
+            return
+        with self._lock:
+            if len(self._dpool) < self.POOL_CAP:
+                self._dpool.append(ctx)
+                return
+        self.lib.ZSTD_freeDCtx(ctx)
 
 
 def _load():
@@ -113,6 +219,35 @@ def compress_block(data: bytes | memoryview, level: int = LEVEL) -> bytes:
     ZSTD_compress at the same level, minus the per-call context cost)."""
     if _API is None:
         raise ZstdError("system libzstd not available")
+    ctx = _API.acquire()
+    try:
+        return compress_with_ctx(ctx, data, level)
+    finally:
+        _API.release(ctx)
+
+
+# ---------------------------------------------------------------------------
+# Caller-owned contexts (per-worker reuse, converter/codec.py)
+# ---------------------------------------------------------------------------
+
+
+def cctx_acquire() -> int:
+    """Take a compression context out of the pool for exclusive, pinned
+    use (one per compress worker); return it with :func:`cctx_release`."""
+    if _API is None:
+        raise ZstdError("system libzstd not available")
+    return _API.acquire()
+
+
+def cctx_release(ctx: int) -> None:
+    if _API is not None:
+        _API.release(ctx)
+
+
+def compress_with_ctx(ctx: int, data: bytes | memoryview, level: int = LEVEL) -> bytes:
+    """One zstd frame on a caller-owned CCtx — the per-worker hot path:
+    no context allocation, no pool lock. Output is byte-identical to
+    :func:`compress_block` at the same level."""
     import numpy as np
 
     # zero-copy source: memoryview chunk slices of the tar buffer go
@@ -121,13 +256,215 @@ def compress_block(data: bytes | memoryview, level: int = LEVEL) -> bytes:
     n = src.size
     cap = _API.lib.ZSTD_compressBound(n)
     buf = np.empty(cap, dtype=np.uint8)  # uninitialized: no bound memset
-    ctx = _API.acquire()
-    try:
-        w = _API.lib.ZSTD_compressCCtx(
-            ctx, buf.ctypes.data, cap, src.ctypes.data, n, level
-        )
-    finally:
-        _API.release(ctx)
+    w = _API.lib.ZSTD_compressCCtx(
+        ctx, buf.ctypes.data, cap, src.ctypes.data, n, level
+    )
     if _API.lib.ZSTD_isError(w):
         raise ZstdError(f"zstd compress failed for {n}-byte input")
+    return buf[:w].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Trained dictionaries (ZDICT) + digested dict handles
+# ---------------------------------------------------------------------------
+
+
+def dict_support() -> bool:
+    """True when the bound libzstd exposes the dictionary arms this
+    module needs (ZDICT training + CDict/DDict digested handles)."""
+    return _API is not None and _API.has_dict and _API.has_zdict and _API.has_dctx
+
+
+def train_dict(samples: "list[bytes]", capacity_bytes: int) -> bytes:
+    """ZDICT_trainFromBuffer over concatenated samples → dictionary bytes.
+
+    Raises :class:`ZstdError` when training fails (too few / too uniform
+    samples — callers fall back to untrained compression)."""
+    if not dict_support():
+        raise ZstdError("system libzstd lacks ZDICT support")
+    if not samples:
+        raise ZstdError("cannot train a dictionary from zero samples")
+    import numpy as np
+
+    joined = np.frombuffer(b"".join(samples), dtype=np.uint8)
+    sizes = (ctypes.c_size_t * len(samples))(*[len(s) for s in samples])
+    cap = max(1024, int(capacity_bytes))
+    out = np.empty(cap, dtype=np.uint8)
+    w = _API.lib.ZDICT_trainFromBuffer(
+        out.ctypes.data, cap, joined.ctypes.data, sizes, len(samples)
+    )
+    if _API.lib.ZDICT_isError(w):
+        raise ZstdError(
+            f"ZDICT training failed over {len(samples)} samples "
+            f"({joined.size} bytes)"
+        )
+    return out[:w].tobytes()
+
+
+def dict_id_of(dict_bytes: bytes) -> int:
+    """The dictionary's embedded ZDICT id (0 = not a ZDICT dictionary)."""
+    if _API is None or not _API.has_zdict:
+        raise ZstdError("system libzstd lacks ZDICT support")
+    import numpy as np
+
+    arr = np.frombuffer(dict_bytes, dtype=np.uint8)
+    return int(_API.lib.ZDICT_getDictID(arr.ctypes.data, arr.size))
+
+
+class CDict:
+    """A digested compression dictionary at one level: the dictionary is
+    pre-processed ONCE, so per-chunk dict compression pays no dict load."""
+
+    def __init__(self, dict_bytes: bytes, level: int = LEVEL):
+        import weakref
+
+        import numpy as np
+
+        if not dict_support():
+            raise ZstdError("system libzstd lacks dictionary support")
+        self._keep = np.frombuffer(dict_bytes, dtype=np.uint8)  # pin memory
+        self.level = level
+        self.handle = _API.lib.ZSTD_createCDict(
+            self._keep.ctypes.data, self._keep.size, level
+        )
+        if not self.handle:
+            raise ZstdError("ZSTD_createCDict failed")
+        self._fin = weakref.finalize(self, _API.lib.ZSTD_freeCDict, self.handle)
+
+
+class DDict:
+    """A digested decompression dictionary (level-independent)."""
+
+    def __init__(self, dict_bytes: bytes):
+        import weakref
+
+        import numpy as np
+
+        if not dict_support():
+            raise ZstdError("system libzstd lacks dictionary support")
+        self._keep = np.frombuffer(dict_bytes, dtype=np.uint8)
+        self.handle = _API.lib.ZSTD_createDDict(
+            self._keep.ctypes.data, self._keep.size
+        )
+        if not self.handle:
+            raise ZstdError("ZSTD_createDDict failed")
+        self._fin = weakref.finalize(self, _API.lib.ZSTD_freeDDict, self.handle)
+
+
+def compress_with_cdict(ctx: int, data: bytes | memoryview, cdict: CDict) -> bytes:
+    """One dict-trained zstd frame on a caller-owned CCtx. The frame
+    header carries the dictionary id, so decoding without the dictionary
+    fails instead of producing garbage."""
+    import numpy as np
+
+    src = np.frombuffer(data, dtype=np.uint8)
+    n = src.size
+    cap = _API.lib.ZSTD_compressBound(n)
+    buf = np.empty(cap, dtype=np.uint8)
+    w = _API.lib.ZSTD_compress_usingCDict(
+        ctx, buf.ctypes.data, cap, src.ctypes.data, n, cdict.handle
+    )
+    if _API.lib.ZSTD_isError(w):
+        raise ZstdError(f"zstd dict compress failed for {n}-byte input")
+    return buf[:w].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Decompression (pooled DCtx)
+# ---------------------------------------------------------------------------
+
+
+def dctx_available() -> bool:
+    return _API is not None and _API.has_dctx
+
+
+def dctx_stats() -> dict:
+    """Pool accounting for the decompress path ({'reuses', 'creates'}) —
+    the profile tool's ctx-reuse micro-gate reads this."""
+    if _API is None:
+        return {"reuses": 0, "creates": 0}
+    with _API._lock:
+        return {"reuses": _API.dctx_reuses, "creates": _API.dctx_creates}
+
+
+def _frame_capacity(src, n: int, max_output_size: int) -> int:
+    size = _API.lib.ZSTD_getFrameContentSize(src.ctypes.data, n)
+    if size == _CONTENTSIZE_ERROR:
+        raise ZstdError("not a valid zstd frame")
+    if size == _CONTENTSIZE_UNKNOWN:
+        if max_output_size <= 0:
+            raise ZstdError("could not determine content size in frame header")
+        return max_output_size
+    if 0 < max_output_size < int(size):
+        # Same contract as the zstandard package: a frame whose declared
+        # content exceeds the caller's bound is an error, not a big alloc.
+        raise ZstdError(
+            f"decompressed size {int(size)} would exceed max_output_size "
+            f"{max_output_size}"
+        )
+    return max(int(size), 1)
+
+
+def decompress_block(
+    data: bytes | memoryview, max_output_size: int = 0, pooled: bool = True
+) -> bytes:
+    """One zstd frame → bytes via a pooled DCtx (``pooled=False`` forces
+    a fresh context create+free per call — the micro-gate's baseline)."""
+    if not dctx_available():
+        raise ZstdError("system libzstd decompress contexts not available")
+    import numpy as np
+
+    src = np.frombuffer(data, dtype=np.uint8)
+    n = src.size
+    if n == 0:
+        raise ZstdError("empty zstd frame")
+    cap = _frame_capacity(src, n, max_output_size)
+    buf = np.empty(cap, dtype=np.uint8)
+    if pooled:
+        ctx = _API.acquire_d()
+    else:
+        ctx = _API.lib.ZSTD_createDCtx()
+        if not ctx:
+            raise ZstdError("ZSTD_createDCtx failed (out of memory)")
+    try:
+        w = _API.lib.ZSTD_decompressDCtx(
+            ctx, buf.ctypes.data, cap, src.ctypes.data, n
+        )
+    finally:
+        if pooled:
+            _API.release_d(ctx)
+        else:
+            _API.lib.ZSTD_freeDCtx(ctx)
+    if _API.lib.ZSTD_isError(w):
+        raise ZstdError(f"zstd decompress failed for {n}-byte input")
+    return buf[:w].tobytes()
+
+
+def decompress_with_ddict(
+    data: bytes | memoryview, ddict: DDict, max_output_size: int = 0
+) -> bytes:
+    """One dict-trained zstd frame → bytes (pooled DCtx + digested
+    DDict). Raises when the frame needs a different dictionary."""
+    if not dict_support():
+        raise ZstdError("system libzstd lacks dictionary support")
+    import numpy as np
+
+    src = np.frombuffer(data, dtype=np.uint8)
+    n = src.size
+    if n == 0:
+        raise ZstdError("empty zstd frame")
+    cap = _frame_capacity(src, n, max_output_size)
+    buf = np.empty(cap, dtype=np.uint8)
+    ctx = _API.acquire_d()
+    try:
+        w = _API.lib.ZSTD_decompress_usingDDict(
+            ctx, buf.ctypes.data, cap, src.ctypes.data, n, ddict.handle
+        )
+    finally:
+        _API.release_d(ctx)
+    if _API.lib.ZSTD_isError(w):
+        raise ZstdError(
+            f"zstd dict decompress failed for {n}-byte input "
+            "(wrong or missing dictionary?)"
+        )
     return buf[:w].tobytes()

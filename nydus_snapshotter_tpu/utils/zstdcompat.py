@@ -61,8 +61,42 @@ def _load_lib():
     return lib
 
 
+# Loaded unconditionally: the pooled decompress path below (used by the
+# converter's chunk decode even when the real package is installed) binds
+# the same system library utils/zstd.py does.
+_LIB = _load_lib()
+
+import threading as _threading
+
+_TLS = _threading.local()
+
+
+def decompress_block(data, max_output_size: int = 0) -> bytes:
+    """One zstd frame → bytes WITHOUT a per-call context allocation.
+
+    The chunk-decode hot path (converter/convert._decompress_chunk, i.e.
+    every lazy read of a zstd chunk) used to construct a fresh
+    ``ZstdDecompressor`` per call; this routes through the pooled system
+    ``ZSTD_DCtx`` (utils/zstd.py) when available, else a per-thread
+    cached package decompressor, else the one-shot shim. Any conforming
+    frame decodes identically on every arm.
+    """
+    from nydus_snapshotter_tpu.utils import zstd as zstd_native
+
+    if zstd_native.dctx_available():
+        try:
+            return zstd_native.decompress_block(data, max_output_size)
+        except zstd_native.ZstdError as e:
+            raise _ShimError(str(e)) from e
+    if _HAVE_PACKAGE:
+        dctx = getattr(_TLS, "dctx", None)
+        if dctx is None:
+            dctx = _TLS.dctx = zstandard.ZstdDecompressor()
+        return dctx.decompress(data, max_output_size=max(max_output_size, 1))
+    return _ShimDecompressor().decompress(data, max_output_size)
+
+
 if not _HAVE_PACKAGE:
-    _LIB = _load_lib()
 
     class _ShimCompressor:
         def __init__(self, level: int = 3):
@@ -82,6 +116,14 @@ if not _HAVE_PACKAGE:
                 raise _ShimError("neither zstandard nor system libzstd available")
 
         def decompress(self, data, max_output_size: int = 0) -> bytes:
+            from nydus_snapshotter_tpu.utils import zstd as zstd_native
+
+            if zstd_native.dctx_available():
+                # Pooled DCtx fast path (no per-call context allocation).
+                try:
+                    return zstd_native.decompress_block(data, max_output_size)
+                except zstd_native.ZstdError as e:
+                    raise _ShimError(str(e)) from e
             import numpy as np
 
             src = np.frombuffer(data, dtype=np.uint8)
@@ -124,4 +166,4 @@ def available() -> bool:
     return _LIB is not None and zstd_native.available()
 
 
-__all__ = ["zstandard", "available"]
+__all__ = ["zstandard", "available", "decompress_block"]

@@ -1,0 +1,540 @@
+"""Adaptive compression engine (converter/codec.py): probe/bypass
+classes, per-worker context reuse, corpus-trained dictionaries with the
+versioned ``nZD1`` frame, format read-compat, and the chaos fallbacks
+(``compress.{probe,train,encode}``).
+
+The hard invariants pinned here:
+
+- default config (adaptive off) stays byte-identical — ``resolve_codec``
+  returns None and the fixed-level lane runs untouched;
+- adaptive output is *content*-identical (Unpack equality) on every
+  corpus class, and deterministic across serial/pipelined packs;
+- trained-dict frames decode only with their dictionary and fail LOUDLY
+  without it;
+- probe failure degrades to always-compress, training failure degrades
+  to untrained — conversion never fails because adaptivity did.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu import constants, failpoint
+from nydus_snapshotter_tpu.converter import codec as codec_mod
+from nydus_snapshotter_tpu.converter.convert import (
+    Unpack,
+    _decompress_chunk,
+    blob_data_from_layer_blob,
+    bootstrap_from_layer_blob,
+    pack_layer,
+)
+from nydus_snapshotter_tpu.converter.types import ConvertError, PackOption
+from nydus_snapshotter_tpu.utils import zstd as zstd_native
+from nydus_snapshotter_tpu.utils import zstdcompat
+
+pytestmark = pytest.mark.skipif(
+    not zstd_native.available(), reason="system libzstd not available"
+)
+
+needs_dict = pytest.mark.skipif(
+    not zstd_native.dict_support(), reason="libzstd lacks ZDICT/CDict support"
+)
+
+_rng = np.random.default_rng(1234)
+_WORDS = [
+    bytes(_rng.integers(97, 123, int(_rng.integers(3, 10)), dtype=np.uint8))
+    for _ in range(300)
+]
+
+
+def textgen(n: int, seed: int) -> bytes:
+    r = np.random.default_rng(seed)
+    return b" ".join(_WORDS[int(i)] for i in r.integers(0, 300, n // 6))[:n]
+
+
+def randgen(n: int, seed: int) -> bytes:
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+def mktar(files) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+        for name, data in files:
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+    return buf.getvalue()
+
+
+def unpack(blob: bytes) -> bytes:
+    bs = bootstrap_from_layer_blob(blob)
+    data = blob_data_from_layer_blob(blob)
+    return Unpack(bs, {bs.blobs[0].blob_id: data} if bs.blobs else {})
+
+
+def adaptive_codec(**kw) -> codec_mod.AdaptiveCodec:
+    return codec_mod.AdaptiveCodec(codec_mod.CodecConfig(adaptive=True, **kw))
+
+
+OPT = dict(compressor="zstd", chunk_size=0x10000)
+
+
+def trained_dict(seed: int = 0, epoch: int = 7) -> codec_mod.TrainedDict:
+    samples = [textgen(2048, 1000 + seed * 500 + i) for i in range(300)]
+    return codec_mod.TrainedDict(
+        zstd_native.train_dict(samples, 32 << 10), epoch=epoch
+    )
+
+
+# ---------------------------------------------------------------------------
+# Probe + classes
+# ---------------------------------------------------------------------------
+
+
+class TestProbe:
+    def test_random_bypasses_text_compresses(self):
+        c = adaptive_codec()
+        assert c.classify(randgen(64 << 10, 1)) == "bypass"
+        cls = c.classify(textgen(64 << 10, 2))
+        assert cls in ("default", "best")
+
+    def test_probe_deterministic(self):
+        c = adaptive_codec()
+        data = randgen(128 << 10, 3)
+        assert {c.classify(data) for _ in range(5)} == {"bypass"}
+
+    def test_tiny_chunks_skip_probe(self):
+        c = adaptive_codec()
+        assert c.classify(b"z" * 100) == "default"
+
+    def test_probe_off(self):
+        c = adaptive_codec(probe="off")
+        assert c.classify(randgen(64 << 10, 4)) == "default"
+
+    def test_entropy_probe_bypasses_random(self):
+        c = adaptive_codec(probe="entropy")
+        assert c.classify(randgen(64 << 10, 5)) == "bypass"
+        assert c.classify(textgen(64 << 10, 6)) != "bypass"
+
+
+# ---------------------------------------------------------------------------
+# Encode/decode roundtrip properties
+# ---------------------------------------------------------------------------
+
+
+class TestEncodeRoundtrip:
+    @pytest.mark.parametrize(
+        "data",
+        [
+            b"",
+            b"x",
+            b"ab" * 10,
+            randgen(64 << 10, 10),  # incompressible
+            textgen(64 << 10, 11),  # highly compressible
+            randgen(100, 12) + textgen(200 << 10, 13),  # mixed big
+        ],
+        ids=["empty", "one", "tiny", "incompressible", "compressible", "mixed"],
+    )
+    def test_roundtrip(self, data):
+        c = adaptive_codec()
+        payload, flag = c.encode(data)
+        assert _decompress_chunk(payload, flag, len(data)) == data
+
+    def test_incompressible_stored_raw(self):
+        c = adaptive_codec()
+        data = randgen(64 << 10, 14)
+        payload, flag = c.encode(data)
+        assert flag == constants.COMPRESSOR_NONE and payload == data
+
+    def test_never_grows_payload(self):
+        c = adaptive_codec()
+        for seed in range(5):
+            data = randgen(32 << 10, 20 + seed)
+            payload, flag = c.encode(data)
+            assert len(payload) <= max(len(data), 1)
+
+    def test_ctx_reuse_counted(self):
+        c = adaptive_codec()
+        before = codec_mod.CTX_REUSE.value()
+        for i in range(4):
+            c.encode(textgen(32 << 10, 30 + i))
+        assert codec_mod.CTX_REUSE.value() >= before + 3
+
+    def test_threaded_encode_deterministic(self):
+        import concurrent.futures
+
+        c = adaptive_codec()
+        chunks = [textgen(32 << 10, 40 + i) for i in range(8)] + [
+            randgen(32 << 10, 50 + i) for i in range(8)
+        ]
+        serial = [c.encode(d) for d in chunks]
+        with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+            threaded = list(pool.map(c.encode, chunks))
+        assert serial == threaded
+
+
+# ---------------------------------------------------------------------------
+# Pack-level behavior
+# ---------------------------------------------------------------------------
+
+
+def _mixed_tar(seed: int = 0) -> bytes:
+    return mktar(
+        [
+            ("a/text1.txt", textgen(180 << 10, 100 + seed)),
+            ("a/rand.bin", randgen(200 << 10, 101 + seed)),
+            ("b/text2.txt", textgen(50 << 10, 102 + seed)),
+            ("b/more.bin", randgen(64 << 10, 103 + seed)),
+        ]
+    )
+
+
+class TestPackAdaptive:
+    def test_default_config_resolves_no_codec(self):
+        assert codec_mod.resolve_codec(PackOption(**OPT)) is None
+        assert codec_mod.resolve_codec(PackOption(compressor="lz4_block")) is None
+
+    def test_default_pack_byte_stable(self):
+        tar = _mixed_tar()
+        a, _ = pack_layer(tar, PackOption(**OPT))
+        b, _ = pack_layer(tar, PackOption(**OPT), codec=None)
+        assert a == b
+
+    def test_adaptive_content_identity(self):
+        tar = _mixed_tar(1)
+        off, _ = pack_layer(tar, PackOption(**OPT))
+        on, _ = pack_layer(tar, PackOption(**OPT), codec=adaptive_codec())
+        assert unpack(off) == unpack(on)
+
+    def test_bypass_engages_on_incompressible_corpus(self):
+        tar = mktar([(f"r/{i}", randgen(96 << 10, 200 + i)) for i in range(4)])
+        c = adaptive_codec()
+        blob, _ = pack_layer(tar, PackOption(**OPT), codec=c)
+        assert c.counts["bypass"] > 0
+        bs = bootstrap_from_layer_blob(blob)
+        flags = {r.flags & constants.COMPRESSOR_MASK for r in bs.chunks}
+        assert constants.COMPRESSOR_NONE in flags
+        assert unpack(blob) == unpack(pack_layer(tar, PackOption(**OPT))[0])
+
+    def test_bypass_never_fires_on_compressible_corpus(self):
+        tar = mktar([(f"t/{i}", textgen(96 << 10, 300 + i)) for i in range(4)])
+        c = adaptive_codec()
+        blob, _ = pack_layer(tar, PackOption(**OPT), codec=c)
+        assert c.counts["bypass"] == 0 and c.class_bytes["bypass"] == 0
+        bs = bootstrap_from_layer_blob(blob)
+        assert all(
+            r.flags & constants.COMPRESSOR_MASK == constants.COMPRESSOR_ZSTD
+            for r in bs.chunks
+        )
+
+    def test_adaptive_pipelined_matches_serial(self, monkeypatch):
+        tar = _mixed_tar(2)
+        serial_cdc = adaptive_codec()
+        serial, _ = pack_layer(tar, PackOption(**OPT), codec=serial_cdc)
+        monkeypatch.setenv("NTPU_PACK_THREADS", "4")
+        monkeypatch.setenv("NTPU_PACK_THREADS_FORCE", "1")
+        piped, _ = pack_layer(tar, PackOption(**OPT), codec=adaptive_codec())
+        assert serial == piped
+
+    def test_blake3_reference_defaults_arm(self):
+        # The BENCH reference-default arm: blake3 digester + zstd.
+        tar = _mixed_tar(3)
+        opt = PackOption(compressor="zstd", chunk_size=0x10000, digester="blake3")
+        off, _ = pack_layer(tar, opt)
+        on, _ = pack_layer(tar, opt, codec=adaptive_codec())
+        assert unpack(off) == unpack(on)
+
+
+# ---------------------------------------------------------------------------
+# Trained dictionaries + format versioning
+# ---------------------------------------------------------------------------
+
+
+@needs_dict
+class TestTrainedDict:
+    def test_serialize_roundtrip(self, tmp_path):
+        td = trained_dict()
+        td2 = codec_mod.TrainedDict.deserialize(td.serialize())
+        assert (td2.dict_id, td2.epoch, td2.bytes) == (td.dict_id, td.epoch, td.bytes)
+        p = str(tmp_path / "zd")
+        td.save(p)
+        td3 = codec_mod.TrainedDict.load(p)
+        assert (td3.dict_id, td3.epoch) == (td.dict_id, td.epoch)
+
+    def test_corrupt_blob_rejected(self):
+        blob = bytearray(trained_dict().serialize())
+        blob[len(blob) // 2] ^= 0xFF
+        with pytest.raises(codec_mod.CodecError, match="checksum|id skew"):
+            codec_mod.TrainedDict.deserialize(bytes(blob))
+
+    def test_unknown_format_version_rejected(self):
+        blob = bytearray(trained_dict().serialize())
+        blob[8] = 99  # version field
+        with pytest.raises(codec_mod.CodecError, match="unsupported"):
+            codec_mod.TrainedDict.deserialize(bytes(blob))
+
+    def test_dict_frames_carry_versioned_header(self):
+        td = trained_dict(seed=1)
+        c = codec_mod.AdaptiveCodec(
+            codec_mod.CodecConfig(adaptive=True), trained=td
+        )
+        try:
+            data = textgen(64 << 10, 400)
+            payload, flag = c.encode(data)
+            assert flag == constants.COMPRESSOR_ZSTD
+            assert payload[:4] == codec_mod.TRAINED_FRAME_MAGIC
+            assert codec_mod.is_trained_frame(payload)
+            assert _decompress_chunk(payload, flag, len(data)) == data
+        finally:
+            codec_mod.unregister_trained_dict(td.dict_id)
+
+    def test_decode_without_dict_fails_loudly(self):
+        td = trained_dict(seed=2)
+        c = codec_mod.AdaptiveCodec(
+            codec_mod.CodecConfig(adaptive=True), trained=td
+        )
+        data = textgen(64 << 10, 401)
+        payload, flag = c.encode(data)
+        codec_mod.unregister_trained_dict(td.dict_id)
+        with pytest.raises(ConvertError, match=str(td.dict_id)):
+            _decompress_chunk(payload, flag, len(data))
+        # and a plain-frame reader path never misclassifies it as zstd
+        with pytest.raises(codec_mod.CodecError, match="not loaded"):
+            codec_mod.decode_trained_frame(payload, len(data))
+
+    def test_plain_frames_never_look_trained(self):
+        # Read-compat pin: v1 (plain) zstd chunk frames keep decoding —
+        # the nZD1 check can never collide with the zstd magic.
+        frame = zstd_native.compress_block(textgen(32 << 10, 402))
+        assert not codec_mod.is_trained_frame(frame)
+        blob, _ = pack_layer(_mixed_tar(4), PackOption(**OPT))
+        bs = bootstrap_from_layer_blob(blob)
+        data = blob_data_from_layer_blob(blob)
+        for rec in bs.chunks:
+            raw = data[
+                rec.compressed_offset : rec.compressed_offset + rec.compressed_size
+            ]
+            if rec.flags & constants.COMPRESSOR_MASK == constants.COMPRESSOR_ZSTD:
+                assert not codec_mod.is_trained_frame(raw)
+                assert len(
+                    _decompress_chunk(raw, rec.flags, rec.uncompressed_size)
+                ) == rec.uncompressed_size
+
+    def test_pack_with_dict_content_identity(self):
+        td = trained_dict(seed=3)
+        try:
+            tar = _mixed_tar(5)
+            off, _ = pack_layer(tar, PackOption(**OPT))
+            c = codec_mod.AdaptiveCodec(
+                codec_mod.CodecConfig(adaptive=True), trained=td
+            )
+            on, _ = pack_layer(tar, PackOption(**OPT), codec=c)
+            assert unpack(off) == unpack(on)
+        finally:
+            codec_mod.unregister_trained_dict(td.dict_id)
+
+
+# ---------------------------------------------------------------------------
+# Chaos: probe/train/encode failpoints
+# ---------------------------------------------------------------------------
+
+
+class TestChaos:
+    def test_probe_failure_falls_back_to_always_compress(self):
+        tar = mktar([("r/big.bin", randgen(128 << 10, 500))])
+        c = adaptive_codec()
+        with failpoint.injected("compress.probe", "error(OSError:probe died)"):
+            blob, _ = pack_layer(tar, PackOption(**OPT), codec=c)
+        assert c.counts["fallback"] > 0 and c.counts["bypass"] == 0
+        # fallback = always-compress at the default level; content intact
+        assert unpack(blob) == unpack(pack_layer(tar, PackOption(**OPT))[0])
+
+    def test_encode_failure_fails_the_pack(self):
+        tar = _mixed_tar(6)
+        with failpoint.injected("compress.encode", "error(OSError:codec died)"):
+            with pytest.raises(OSError, match="codec died"):
+                pack_layer(tar, PackOption(**OPT), codec=adaptive_codec())
+
+    @needs_dict
+    def test_train_failure_falls_back_to_untrained(self):
+        from nydus_snapshotter_tpu.converter.batch import BatchConverter
+
+        cfg = codec_mod.CodecConfig(
+            adaptive=True, train=True, train_sample_mib=1, train_dict_kib=16
+        )
+        c = codec_mod.AdaptiveCodec(cfg)
+        c.attach_trainer()
+        bc = BatchConverter(PackOption(**OPT), codec=c)
+        layers = [mktar([(f"f{i}", textgen(20 << 10, 600 + i)) for i in range(48)])]
+        bc.convert_image("img1", layers)
+        before = codec_mod.TRAIN_TOTAL.value("failed")
+        with failpoint.injected("compress.train", "error(OSError:train died)"):
+            assert bc.train_codec_dict() is None
+        assert codec_mod.TRAIN_TOTAL.value("failed") == before + 1
+        assert c.trained is None
+        # the batch continues untrained — and never retries the failed arm
+        r2 = bc.convert_image(
+            "img2", [mktar([(f"g{i}", textgen(20 << 10, 700 + i)) for i in range(8)])]
+        )
+        assert r2.bootstrap
+
+    @needs_dict
+    def test_train_success_after_sampling(self):
+        from nydus_snapshotter_tpu.converter.batch import BatchConverter
+
+        cfg = codec_mod.CodecConfig(
+            adaptive=True, train=True, train_sample_mib=1, train_dict_kib=16
+        )
+        c = codec_mod.AdaptiveCodec(cfg)
+        c.attach_trainer()
+        bc = BatchConverter(PackOption(**OPT), codec=c)
+        layers = [mktar([(f"f{i}", textgen(20 << 10, 800 + i)) for i in range(60)])]
+        r1 = bc.convert_image("img1", layers)
+        td = bc.train_codec_dict()
+        assert td is not None and c.trained is td
+        try:
+            before = codec_mod.DICT_BYTES.value()
+            r2 = bc.convert_image(
+                "img2",
+                [mktar([(f"g{i}", textgen(20 << 10, 900 + i)) for i in range(8)])],
+            )
+            assert codec_mod.DICT_BYTES.value() > before
+            assert r1.bootstrap and r2.bootstrap
+        finally:
+            codec_mod.unregister_trained_dict(td.dict_id)
+
+
+# ---------------------------------------------------------------------------
+# Decompress-path context reuse (utils/zstdcompat satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestDecompressPool:
+    def test_pooled_equals_fresh(self):
+        data = textgen(256 << 10, 1000)
+        frame = zstd_native.compress_block(data)
+        assert zstd_native.decompress_block(frame) == data
+        assert zstd_native.decompress_block(frame, pooled=False) == data
+        assert zstdcompat.decompress_block(frame, len(data)) == data
+
+    def test_pool_reuses_contexts(self):
+        frame = zstd_native.compress_block(textgen(32 << 10, 1001))
+        zstd_native.decompress_block(frame)  # warm the pool
+        before = zstd_native.dctx_stats()
+        for _ in range(16):
+            zstd_native.decompress_block(frame)
+        after = zstd_native.dctx_stats()
+        assert after["reuses"] >= before["reuses"] + 16
+        assert after["creates"] == before["creates"]
+
+    def test_max_output_bound_enforced(self):
+        data = textgen(64 << 10, 1002)
+        frame = zstd_native.compress_block(data)
+        with pytest.raises(zstd_native.ZstdError, match="exceed"):
+            zstd_native.decompress_block(frame, max_output_size=100)
+
+
+# ---------------------------------------------------------------------------
+# Dict-service zdict sharing
+# ---------------------------------------------------------------------------
+
+
+@needs_dict
+class TestServiceZdict:
+    def test_put_get_epoch_precedence(self):
+        from nydus_snapshotter_tpu.parallel.dict_service import DictService
+
+        svc = DictService()
+        td = trained_dict(seed=4, epoch=50)
+        sd = svc.dict_for("nsz")
+        assert sd.get_zdict() == b""
+        out = sd.put_zdict(td.serialize())
+        assert out["zdict_epoch"] == 50 and out["zdict_id"] == td.dict_id
+        old = codec_mod.TrainedDict(td.bytes, epoch=9)
+        assert sd.put_zdict(old.serialize())["zdict_epoch"] == 50
+        got = codec_mod.TrainedDict.deserialize(sd.get_zdict())
+        assert got.epoch == 50
+        status, _ctype, payload = svc.handle(
+            "GET", "/api/v1/dict/nsz/zdict", {}, b""
+        )
+        assert status == 200 and payload == td.serialize()
+
+    def test_garbage_zdict_rejected(self):
+        from nydus_snapshotter_tpu.parallel.dict_service import DictService
+
+        svc = DictService()
+        status, _ctype, payload = svc.handle(
+            "POST", "/api/v1/dict/nsz/zdict", {}, b"not a dict blob"
+        )
+        assert status == 400
+
+    def test_batch_converter_adopts_service_dict(self, tmp_path):
+        from nydus_snapshotter_tpu.converter.batch import BatchConverter
+        from nydus_snapshotter_tpu.parallel.dict_service import DictService
+
+        sock = str(tmp_path / "dict.sock")
+        svc = DictService()
+        svc.run(sock)
+        td = trained_dict(seed=5, epoch=60)
+        try:
+            svc.dict_for("default").put_zdict(td.serialize())
+            bc = BatchConverter(
+                PackOption(**OPT),
+                dict_service=sock,
+                codec=adaptive_codec(),
+            )
+            assert bc.codec.trained is not None
+            assert bc.codec.trained.dict_id == td.dict_id
+            r = bc.convert_image(
+                "img", [mktar([("f", textgen(64 << 10, 1100))])]
+            )
+            assert r.bootstrap
+            bc.dict.client.close()
+        finally:
+            svc.stop()
+            codec_mod.unregister_trained_dict(td.dict_id)
+
+
+# ---------------------------------------------------------------------------
+# Config surface
+# ---------------------------------------------------------------------------
+
+
+class TestConfig:
+    def test_validation(self):
+        from nydus_snapshotter_tpu.config.config import ConfigError, SnapshotterConfig
+
+        cfg = SnapshotterConfig()
+        cfg.validate()  # defaults are valid
+        cfg.compression.probe = "magic"
+        with pytest.raises(ConfigError, match="compression.probe"):
+            cfg.validate()
+        cfg.compression.probe = "sample"
+        cfg.compression.bypass_ratio = 0.2  # below low_gain
+        with pytest.raises(ConfigError, match="ratios"):
+            cfg.validate()
+        cfg.compression.bypass_ratio = 0.97
+        cfg.compression.level_best = 99
+        with pytest.raises(ConfigError, match="levels"):
+            cfg.validate()
+
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("NTPU_COMPRESS_ADAPTIVE", "1")
+        monkeypatch.setenv("NTPU_COMPRESS_PROBE", "entropy")
+        monkeypatch.setenv("NTPU_COMPRESS_BYPASS_RATIO", "0.9")
+        monkeypatch.setenv("NTPU_COMPRESS_LEVELS", "2,4,8")
+        cfg = codec_mod.resolve_codec_config()
+        assert cfg.adaptive and cfg.probe == "entropy"
+        assert cfg.bypass_ratio == 0.9
+        assert (cfg.level_fast, cfg.level_default, cfg.level_best) == (2, 4, 8)
+        c = codec_mod.resolve_codec(PackOption(**OPT))
+        assert c is not None and c.cfg.probe == "entropy"
+
+    def test_adaptive_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("NTPU_COMPRESS_ADAPTIVE", raising=False)
+        assert not codec_mod.resolve_codec_config().adaptive
