@@ -275,11 +275,30 @@ def _time_engine_child(repo: str, chunk_size: int, kwargs: dict):
 
 
 def calibrate_engine(chunk_size: int, repo: str, device_ok: bool):
-    """(winning arm name, device_executes, timings) from the end-to-end
-    race. ``device_executes`` is False when every device arm failed
-    outright (not merely lost) — the device must then not be used for
-    anything, including the dict probe."""
+    """(winning arm name, device_executes, timings, probe_order) from the
+    end-to-end race. ``device_executes`` is False when every device arm
+    failed outright (not merely lost) — the device must then not be used
+    for anything, including the dict probe.
+
+    Probe ordering (VERDICT r5 top_next): the FUSED FULL-PATH arm is the
+    FIRST child dispatched into a device tunnel window — five rounds of
+    ``device:false`` were spent on kernel micro-stages before the one
+    number the north star needs, and windows last ~100 s. The dispatched
+    order is returned so the bench JSON records it and a regression back
+    to micro-stages-first is visible in the artifact diff."""
     from nydus_snapshotter_tpu.ops.chunker import ChunkDigestEngine
+
+    # fullpath first, micro arms after — the host arm runs in-process
+    # and never burns tunnel time, so it is not part of the window order
+    device_order = ("device_fused", "device_digest", "device_all")
+    probe_order: list[str] = []
+    times = {}
+    if device_ok:
+        for arm in device_order:
+            probe_order.append(arm)
+            dt = _time_engine_child(repo, chunk_size, ENGINE_ARMS[arm])
+            if dt is not None:
+                times[arm] = dt
 
     rng = np.random.default_rng(7)
     sample = [rng.integers(0, 256, CALIBRATE_MIB << 19, dtype=np.uint8).tobytes()
@@ -288,16 +307,16 @@ def calibrate_engine(chunk_size: int, repo: str, device_ok: bool):
     host.process_many(sample)  # thread-pool / build warm-up
     t = time.time()
     host.process_many(sample)
-    times = {"host": time.time() - t}
+    times["host"] = time.time() - t
 
-    if device_ok:
-        for arm in ("device_digest", "device_all", "device_fused"):
-            dt = _time_engine_child(repo, chunk_size, ENGINE_ARMS[arm])
-            if dt is not None:
-                times[arm] = dt
     winner = min(times, key=times.get)
     device_executes = any(k != "host" for k in times)
-    return winner, device_executes, {k: round(v, 3) for k, v in times.items()}
+    return (
+        winner,
+        device_executes,
+        {k: round(v, 3) for k, v in times.items()},
+        probe_order,
+    )
 
 
 def build_probe(dict_digest_bytes: bytes, device_ok: bool):
@@ -982,7 +1001,9 @@ def main() -> None:
     from nydus_snapshotter_tpu.ops.chunker import ChunkDigestEngine
 
     device_ok, device_note = _device_available(repo)
-    winner, device_executes, cal = calibrate_engine(CHUNK_SIZE, repo, device_ok)
+    winner, device_executes, cal, probe_order = calibrate_engine(
+        CHUNK_SIZE, repo, device_ok
+    )
     if device_ok and not device_executes:
         device_note += "; every device arm failed calibration"
     elif device_ok and winner == "host":
@@ -1214,6 +1235,10 @@ def main() -> None:
                     "probe_arm": probe_arm,
                     "device": device_ok,
                     "device_note": device_note,
+                    # order device children were dispatched into the
+                    # tunnel window: the full-path fused arm MUST be
+                    # first (VERDICT r5); empty when no window opened
+                    "device_probe_order": probe_order,
                     "calibration": cal,
                     "engine_flat": engine_detail,
                     "stage_breakdown_s": stage_breakdown,

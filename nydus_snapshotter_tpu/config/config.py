@@ -374,6 +374,29 @@ class SloConfig:
 
 
 @dataclass
+class MeshConfig:
+    """Device-mesh convert sharding knobs (ops/mesh_pack.py,
+    __graft_entry__.sharded_convert_step).
+
+    ``pack`` picks the pass-2 corpus operand layout: ``extent`` (default)
+    gives each device only its contiguous byte shard plus the read-span
+    halo (no operand is device-count-replicated; per-device addressable
+    bytes stay ≤ corpus/devices + halo), ``replicated`` keeps the legacy
+    whole-corpus broadcast (the differential / paired-measurement arm).
+    ``devices`` caps how many local devices a default-constructed mesh
+    uses (0 = all). ``halo_kib`` widens the shard halo beyond the
+    engine's computed maximum read span (0 = auto) — the planner never
+    shrinks it below the no-clamp minimum. Environment variables override
+    per-process (``NTPU_MESH_PACK``, ``NTPU_MESH_DEVICES``,
+    ``NTPU_MESH_HALO_KIB``).
+    """
+
+    pack: str = "extent"
+    devices: int = 0
+    halo_kib: int = 0
+
+
+@dataclass
 class ExperimentalConfig:
     enable_stargz: bool = False
     enable_referrer_detect: bool = False
@@ -411,6 +434,7 @@ class SnapshotterConfig:
     chunk_dict: ChunkDictConfig = field(default_factory=ChunkDictConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
     slo: SloConfig = field(default_factory=SloConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
     experimental: ExperimentalConfig = field(default_factory=ExperimentalConfig)
 
     # -- derived paths (reference config/global.go accessors) ---------------
@@ -566,6 +590,14 @@ class SnapshotterConfig:
             not isinstance(o, dict) for o in self.slo.objectives
         ):
             raise ConfigError("slo.objectives must be an array of tables")
+        if self.mesh.pack not in ("extent", "replicated"):
+            raise ConfigError(
+                f"invalid mesh.pack {self.mesh.pack!r} (extent | replicated)"
+            )
+        if self.mesh.devices < 0:
+            raise ConfigError("mesh.devices must be >= 0 (0 = all local devices)")
+        if self.mesh.halo_kib < 0:
+            raise ConfigError("mesh.halo_kib must be >= 0 (0 = auto read span)")
         if not 0.0 < self.chunk_dict.load_factor < 1.0:
             raise ConfigError("chunk_dict.load_factor must be within (0, 1)")
         if self.chunk_dict.headroom < 1.0:

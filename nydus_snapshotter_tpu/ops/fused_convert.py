@@ -347,6 +347,17 @@ class FusedDeviceEngine:
             return blake3_jax.n_leaves(size)
         return sha256.n_padded_blocks(size)
 
+    def max_read_span(self) -> int:
+        """Largest pass-2 gather span any bucket can issue, in bytes —
+        the guard this engine's layout() pads for, and the shard halo
+        ops/mesh_pack must append to every per-device slab so a chunk
+        cut at a shard boundary still gathers without clamping."""
+        if self.digester == "blake3":
+            from nydus_snapshotter_tpu.ops import blake3_jax
+
+            return self._blocks_of(self.params.max_size) * blake3_jax.LEAF_BYTES
+        return self._blocks_of(self.params.max_size) * 64
+
     # -- planning ------------------------------------------------------------
 
     def layout(self, arrs: list[np.ndarray]) -> tuple[np.ndarray, list[tuple[int, int]]]:

@@ -29,9 +29,18 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     twice would need distinct axes, so the dictionary shards along the same
     physical axis — each chip holds one dict shard *and* processes its slice
     of the window batch).
+
+    With ``n_devices`` unset, the ``[mesh] devices`` knob (env
+    ``NTPU_MESH_DEVICES``) caps the mesh width; 0 keeps every device.
     """
     devs = list(devices if devices is not None else jax.devices())
-    if n_devices is not None:
+    if n_devices is None:
+        from nydus_snapshotter_tpu.ops.mesh_pack import resolve_mesh_config
+
+        cap = resolve_mesh_config().devices
+        if cap:
+            devs = devs[: min(cap, len(devs))]
+    else:
         if n_devices > len(devs):
             raise ValueError(f"requested {n_devices} devices, have {len(devs)}")
         devs = devs[:n_devices]

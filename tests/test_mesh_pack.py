@@ -1,0 +1,246 @@
+"""Extent-packed per-device convert sharding (ops/mesh_pack +
+__graft_entry__.sharded_convert_step).
+
+Property under test: repartitioning the pass-2 gather onto per-device
+byte shards (plus the read-span halo) changes WHERE bytes live and
+nothing else — cuts, digests and the emitted bootstrap stay byte-
+identical to both the legacy replicated-operand arm and the
+single-device host oracle, at every mesh size, while no device ever
+holds more than corpus/devices + halo bytes of the corpus.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as graft  # noqa: E402
+from nydus_snapshotter_tpu.ops import fused_convert, mesh_pack  # noqa: E402
+from nydus_snapshotter_tpu.ops.chunker import ChunkDigestEngine  # noqa: E402
+from nydus_snapshotter_tpu.parallel import mesh as mesh_lib  # noqa: E402
+
+CHUNK = 0x1000
+
+
+def _mk_files(seed: int, n: int, scale: int = 8192) -> list[bytes]:
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(
+            0, 256, int(rng.integers(1, 5)) * scale + int(rng.integers(0, 997)),
+            dtype=np.uint8,
+        ).tobytes()
+        for _ in range(n)
+    ]
+
+
+def _oracle(files):
+    eng = ChunkDigestEngine(chunk_size=CHUNK, backend="numpy", digest_backend="numpy")
+    truth = eng.process_many(files)
+    cuts = [
+        np.asarray([m.offset + m.size for m in metas], dtype=np.int64)
+        for metas in truth
+    ]
+    digs = [[m.digest for m in metas] for metas in truth]
+    return cuts, digs
+
+
+def _plan_for(files, n_devices, chunk=CHUNK):
+    eng = fused_convert.FusedDeviceEngine(chunk_size=chunk)
+    table = []
+    total = 0
+    for f in files:
+        table.append((total, len(f)))
+        total += len(f)
+    cuts, _ = _oracle(files)
+    buckets, order = eng.plan_buckets(table, cuts)
+    plan = mesh_pack.plan_mesh_pack(
+        buckets, order, total, n_devices, halo_bytes=eng.max_read_span()
+    )
+    return plan, buckets, order, total
+
+
+class TestPlanner:
+    """Host-side geometry: pure numpy, no mesh involved."""
+
+    def test_local_offsets_and_devices(self):
+        files = _mk_files(1, 6)
+        n = 4
+        plan, buckets, order, total = _plan_for(files, n)
+        assert plan.shard_bytes == -(-total // n)
+        assert plan.pack_len == plan.shard_bytes + plan.halo_bytes
+        for b, sb in zip(buckets, plan.buckets):
+            assert sum(sb.counts) == b.count
+            for d in range(n):
+                lo = d * sb.rows_per_device
+                for i in range(sb.counts[d]):
+                    row = lo + i
+                    off = int(sb.offsets_abs[row])
+                    assert plan.device_of(off) == d
+                    assert sb.offsets_local[row] == off - d * plan.shard_bytes
+                    # the no-clamp invariant: every gather fits the slab
+                    assert (
+                        sb.offsets_local[row] + sb.cap_blocks * 64 <= plan.pack_len
+                    )
+
+    def test_order_covers_every_chunk_once(self):
+        files = _mk_files(2, 5)
+        n = 8
+        plan, buckets, _order, _total = _plan_for(files, n)
+        n_chunks = sum(b.count for b in buckets)
+        assert len(plan.order) == n_chunks
+        seen = set()
+        for cap, row in plan.order:
+            assert (cap, row) not in seen
+            seen.add((cap, row))
+            sb = next(b for b in plan.buckets if b.cap_blocks == cap)
+            d, i = divmod(row, sb.rows_per_device)
+            assert i < sb.counts[d], "order points at a padding row"
+
+    def test_pack_buffers_shard_plus_halo(self):
+        files = _mk_files(3, 4)
+        n = 4
+        plan, _b, _o, total = _plan_for(files, n)
+        buf = np.frombuffer(b"".join(files), dtype=np.uint8)
+        packed = mesh_pack.pack_buffers(buf, plan)
+        assert packed.shape == (n, plan.pack_len)
+        S = plan.shard_bytes
+        for d in range(n):
+            lo = d * S
+            hi = min(lo + plan.pack_len, total)
+            want = buf[lo:hi]
+            assert (packed[d, : hi - lo] == want).all()
+            assert (packed[d, hi - lo :] == 0).all()
+
+    def test_chunk_spanning_shard_cut_stays_whole(self):
+        """A chunk whose bytes straddle k*S must be gatherable entirely
+        from device k's slab — that is the halo rule."""
+        files = _mk_files(4, 6)
+        n = 4
+        plan, buckets, _o, total = _plan_for(files, n)
+        S = plan.shard_bytes
+        straddlers = 0
+        for b in buckets:
+            for off, size in zip(b.offsets[: b.count], b.sizes[: b.count]):
+                d = plan.device_of(int(off))
+                if int(off) + int(size) > (d + 1) * S:
+                    straddlers += 1
+                    assert int(off) - d * S + b.cap_blocks * 64 <= plan.pack_len
+        assert straddlers > 0, "corpus produced no shard-cut straddler; enlarge it"
+
+    def test_unordered_bucket_rejected(self):
+        b = fused_convert.Bucket(
+            cap_blocks=2,
+            offsets=np.asarray([500, 100], np.int32),
+            sizes=np.asarray([64, 64], np.int32),
+            count=2,
+        )
+        with pytest.raises(ValueError, match="offset-ordered"):
+            mesh_pack.plan_mesh_pack([b], [(2, 0), (2, 1)], 600, 2)
+
+    def test_more_devices_than_bytes(self):
+        b = fused_convert.Bucket(
+            cap_blocks=1,
+            offsets=np.asarray([0, 2], np.int32),
+            sizes=np.asarray([2, 3], np.int32),
+            count=2,
+        )
+        plan = mesh_pack.plan_mesh_pack([b], [(1, 0), (1, 1)], 5, 8)
+        assert plan.shard_bytes == 1
+        devs = [plan.device_of(0), plan.device_of(2)]
+        assert devs == [0, 2]
+        assert sum(plan.buckets[0].counts) == 2
+
+
+@pytest.mark.parametrize("n_devices", [1, 2, 4, 8])
+class TestByteIdentity:
+    """extent == replicated == host oracle across mesh sizes."""
+
+    def test_convert_identity_and_bytes_bound(self, n_devices):
+        files = _mk_files(10 + n_devices, max(2, n_devices))
+        mesh = mesh_lib.make_mesh(n_devices)
+        rep_e: dict = {}
+        cuts_e, digs_e, boot_e = graft.sharded_convert_step(
+            files, CHUNK, n_devices, mesh, pack="extent", report=rep_e
+        )
+        rep_r: dict = {}
+        cuts_r, digs_r, boot_r = graft.sharded_convert_step(
+            files, CHUNK, n_devices, mesh, pack="replicated", report=rep_r
+        )
+        cuts_t, digs_t = _oracle(files)
+        for a, b, t in zip(cuts_e, cuts_r, cuts_t):
+            assert (np.asarray(a) == t).all()
+            assert (np.asarray(b) == t).all()
+        assert digs_e == digs_t
+        assert digs_r == digs_t
+        assert boot_e == boot_r
+        # the no-replication gate, and proof the gate DETECTS replication
+        assert rep_e["max_device_bytes"] <= rep_e["bound_bytes"]
+        if n_devices > 1:
+            assert rep_r["max_device_bytes"] > rep_e["bound_bytes"], (
+                "replicated arm should trip the addressable-bytes bound"
+            )
+
+
+class TestEdgeCases:
+    def test_empty_file_in_batch(self):
+        files = [b"", _mk_files(20, 1)[0], b""]
+        mesh = mesh_lib.make_mesh(2)
+        cuts, digs, boot = graft.sharded_convert_step(
+            files, CHUNK, 2, mesh, pack="extent"
+        )
+        cuts_t, digs_t = _oracle(files)
+        assert [len(c) for c in cuts] == [0, len(cuts_t[1]), 0]
+        assert digs == digs_t
+
+    def test_all_empty_batch(self):
+        mesh = mesh_lib.make_mesh(2)
+        cuts, digs, boot = graft.sharded_convert_step(
+            [b"", b""], CHUNK, 2, mesh, pack="extent"
+        )
+        assert digs == [[], []]
+        assert isinstance(boot, bytes) and boot
+
+    def test_files_smaller_than_one_extent(self):
+        # every file far below shard_bytes: chunks cluster on low devices,
+        # the plan must still cover all of them and stay byte-identical
+        rng = np.random.default_rng(7)
+        files = [
+            rng.integers(0, 256, int(rng.integers(1100, 2500)), np.uint8).tobytes()
+            for _ in range(5)
+        ]
+        mesh = mesh_lib.make_mesh(8)
+        rep: dict = {}
+        cuts, digs, _boot = graft.sharded_convert_step(
+            files, CHUNK, 8, mesh, pack="extent", report=rep
+        )
+        cuts_t, digs_t = _oracle(files)
+        assert digs == digs_t
+        assert rep["max_device_bytes"] <= rep["bound_bytes"]
+
+    def test_env_pack_override(self, monkeypatch):
+        monkeypatch.setenv("NTPU_MESH_PACK", "replicated")
+        assert mesh_pack.resolve_mesh_config().pack == "replicated"
+        files = _mk_files(30, 2)
+        mesh = mesh_lib.make_mesh(2)
+        rep: dict = {}
+        graft.sharded_convert_step(files, CHUNK, 2, mesh, report=rep)
+        assert rep["pack"] == "replicated"
+        monkeypatch.setenv("NTPU_MESH_PACK", "extent")
+        rep2: dict = {}
+        graft.sharded_convert_step(files, CHUNK, 2, mesh, report=rep2)
+        assert rep2["pack"] == "extent"
+
+    def test_env_halo_override(self, monkeypatch):
+        monkeypatch.setenv("NTPU_MESH_HALO_KIB", "64")
+        files = _mk_files(31, 2)
+        mesh = mesh_lib.make_mesh(2)
+        rep: dict = {}
+        cuts, digs, _ = graft.sharded_convert_step(
+            files, CHUNK, 2, mesh, pack="extent", report=rep
+        )
+        assert rep["halo_bytes"] >= 64 << 10
+        _cuts_t, digs_t = _oracle(files)
+        assert digs == digs_t

@@ -131,11 +131,24 @@ def run_stages(window_note: str) -> list[dict]:
         )
         return rc
 
-    # Cheapest first: small sizes so a re-wedge mid-window still leaves data.
-    # The 2026-07-31 window measured a ~125 ms per-dispatch floor through
-    # the tunnel with a ~31 GiB/s incremental streaming rate — so the big
-    # sizes below are where the recorded headline actually amortizes the
-    # floor (512 MiB -> ~3.5 GiB/s expected vs 0.49 at 64 MiB).
+    # THE composition number FIRST (VERDICT r5 top_next): the r4 window
+    # lasted ~100 s and died on kernel micro-stages before the one number
+    # the north star needs. fullpath-512 — gear → compaction → host cut
+    # resolve → gather → sha256 → dict probe, corpus device-generated,
+    # 512 MiB so the ~125 ms dispatch floor amortizes — is the first
+    # probe of ANY window; everything else is gravy after it.
+    stage("fullpath-512", [sys.executable, drb, "--stage", "fullpath", "--mib", "512"])
+    # then the protocol VERDICT #6 staged behind it: probe lowering smoke
+    # (bench_probe prints its Mosaic-lowering line before timing) and b3
+    stage("dict-probe", [sys.executable, drb, "--stage", "probe"])
+    stage("b3-64", [sys.executable, drb, "--stage", "b3", "--mib", "64"])
+    stage("fullpath-64", [sys.executable, drb, "--stage", "fullpath", "--mib", "64"])
+    stage("b3-512", [sys.executable, drb, "--stage", "b3", "--mib", "512"])
+    # kernel micro-stages only once the headline composition is banked
+    # (small sizes first so a re-wedge mid-window still leaves data; the
+    # 2026-07-31 window measured a ~125 ms per-dispatch floor with a
+    # ~31 GiB/s incremental streaming rate, so 512 MiB+ is where the
+    # recorded micro headline amortizes the floor).
     stage("gear-pallas-16", [sys.executable, drb, "--stage", "gear", "--mib", "16"])
     stage("sha-xla-16", [sys.executable, drb, "--stage", "sha", "--mib", "16"])
     stage("gear-pallas-64", [sys.executable, drb, "--stage", "gear", "--mib", "64"])
@@ -145,14 +158,6 @@ def run_stages(window_note: str) -> list[dict]:
     stage("gear-pallas-2048", [sys.executable, drb, "--stage", "gear", "--mib", "2048"])
     stage("sha-pallas-64", [sys.executable, drb, "--stage", "sha-pallas", "--mib", "64"])
     stage("sha-pallas-512", [sys.executable, drb, "--stage", "sha-pallas", "--mib", "512"])
-    stage("b3-64", [sys.executable, drb, "--stage", "b3", "--mib", "64"])
-    stage("b3-512", [sys.executable, drb, "--stage", "b3", "--mib", "512"])
-    stage("dict-probe", [sys.executable, drb, "--stage", "probe"])
-    # THE composition number (VERDICT r4 #1): full-path convert as the
-    # two-dispatch fused program — gear → compaction → host cut resolve →
-    # gather → sha256 → dict probe, corpus device-generated.
-    stage("fullpath-64", [sys.executable, drb, "--stage", "fullpath", "--mib", "64"])
-    stage("fullpath-512", [sys.executable, drb, "--stage", "fullpath", "--mib", "512"])
     # 1536 MiB is the largest batch whose padded layout stays inside
     # int32 device addressing (the fused engine's per-dispatch cap)
     stage(
