@@ -885,6 +885,35 @@ def peer_storm_run(repo: str, timeout: float = 240.0) -> dict:
         return {"error": "peer storm produced no JSON"}
 
 
+_SOCI_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from tools.soci_profile import profile
+print(json.dumps(profile(pods=4, mib=4, reps=2)))
+"""
+
+
+def soci_run(repo: str, timeout: float = 300.0) -> dict:
+    """Seekable-OCI profile (tools/soci_profile.py) in a child under the
+    hard watchdog: index build MiB/s vs the banked stargz_zran line,
+    cold first-file-read latency curve vs full pull, and the mini
+    indexed-storm origin-egress ratio on unconverted images. Peer UDS
+    servers and fetch pools spin up — a wedge costs one timeout."""
+    res = _run_child_watchdog(
+        [sys.executable, "-c", _SOCI_CHILD.format(repo=repo)], timeout=timeout
+    )
+    if res is None:
+        return {"error": f"soci profile hung >{timeout:.0f}s (watchdog killed it)"}
+    rc, stdout, stderr = res
+    if rc != 0:
+        tail = stderr.strip().splitlines()[-1] if stderr.strip() else ""
+        return {"error": f"soci profile exited rc={rc}: {tail}"[:200]}
+    try:
+        return json.loads(stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": "soci profile produced no JSON"}
+
+
 _FLEET_OBS_CHILD = """
 import json, sys
 sys.path.insert(0, {repo!r})
@@ -1207,6 +1236,7 @@ def main() -> None:
     chunk_dict_detail = chunk_dict_run(repo)
     peer_storm = peer_storm_run(repo)
     fleet_obs = fleet_obs_run(repo)
+    soci_detail = soci_run(repo)
     # Adaptive-codec engine numbers ride under detail.compression next
     # to the per-codec economics they change.
     compression_economics["adaptive"] = compression_adaptive_run(repo)
@@ -1249,6 +1279,7 @@ def main() -> None:
                     "chunk_dict": chunk_dict_detail,
                     "peer_storm": peer_storm,
                     "fleet_obs": fleet_obs,
+                    "soci": soci_detail,
                     "accel_profile": accel_profile,
                     "zstd_profile": zstd_profile,
                     "reference_defaults_profile": reference_defaults_profile,

@@ -22,8 +22,11 @@ from typing import Optional
 from nydus_snapshotter_tpu import failpoint
 from nydus_snapshotter_tpu.snapshot.metastore import Usage
 
-# Companion-file suffixes of one blob cache entry (manager.go:99-120).
-_SUFFIXES = ("", ".blob.data", ".chunk_map", ".blob.meta", ".image.disk", ".layer.disk")
+# Companion-file suffixes of one blob cache entry (manager.go:99-120,
+# plus the seekable-OCI checkpoint index — soci/index.py — which must be
+# accounted, GC'd and watermark-evicted with the blob it describes).
+_SUFFIXES = ("", ".blob.data", ".chunk_map", ".blob.meta", ".image.disk",
+             ".layer.disk", ".soci.idx")
 
 
 class CacheManager:

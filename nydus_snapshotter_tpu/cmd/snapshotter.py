@@ -199,17 +199,42 @@ def build_stack(cfg: SnapshotterConfig):
             logger.warning("cgroup disabled: %s", e)
 
     # Optional lazy-pull adaptors (fs.go:58-194 wiring of stargz/referrer).
+    # Their resolvers must share the [remote] transport settings — the
+    # mirror config dir (the only route to plain-http registries) and
+    # skip_ssl_verify — or a deployment's registry simply never resolves
+    # and the arm silently declines every layer.
+    def _resolver_pool():
+        from nydus_snapshotter_tpu.remote import transport
+
+        return transport.Pool(
+            mirrors_config_dir=cfg.remote.mirrors_config_dir,
+            insecure_tls=cfg.remote.skip_ssl_verify,
+        )
+
     stargz_resolver = None
     stargz_adaptor = None
     if cfg.experimental.enable_stargz:
         from nydus_snapshotter_tpu.snapshot.snapshotter import upper_path
         from nydus_snapshotter_tpu.stargz import Resolver, StargzAdaptor
 
-        stargz_resolver = Resolver()
+        stargz_resolver = Resolver(pool=_resolver_pool())
         stargz_adaptor = StargzAdaptor(
             lambda sid: upper_path(cfg.root, sid),
             cache_dir=cfg.cache_root,
             fs_driver=cfg.daemon.fs_driver,
+        )
+    soci_resolver = None
+    soci_adaptor = None
+    if cfg.soci.enable:
+        from nydus_snapshotter_tpu.snapshot.snapshotter import upper_path
+        from nydus_snapshotter_tpu.soci import SociAdaptor, SociResolver
+
+        soci_resolver = SociResolver(pool=_resolver_pool())
+        soci_adaptor = SociAdaptor(
+            lambda sid: upper_path(cfg.root, sid),
+            cache_dir=cfg.cache_root,
+            fs_driver=cfg.daemon.fs_driver,
+            stride=cfg.soci.stride_kib << 10,
         )
     referrer_mgr = None
     if cfg.experimental.enable_referrer_detect:
@@ -253,6 +278,8 @@ def build_stack(cfg: SnapshotterConfig):
         verifier=verifier,
         stargz_resolver=stargz_resolver,
         stargz_adaptor=stargz_adaptor,
+        soci_resolver=soci_resolver,
+        soci_adaptor=soci_adaptor,
         referrer_mgr=referrer_mgr,
         tarfs_mgr=tarfs_mgr,
         tarfs_export=cfg.experimental.tarfs_export_mode != "",
@@ -350,6 +377,16 @@ def main(argv=None) -> int:
         peer_mod.default_router()
         if peer_server is not None:
             logger.info("peer chunk server on %s", peer_server.address)
+    # Seekable-OCI backend (soci/): the spawned daemon process resolves
+    # the section from the NTPU_SOCI* environment, like every blobcache
+    # knob — export it so daemons mount checkpoint-indexed readers and
+    # replicate indexes through the peer tier.
+    if cfg.soci.enable:
+        os.environ.setdefault("NTPU_SOCI_ENABLE", "1")
+        os.environ.setdefault("NTPU_SOCI_STRIDE_KIB", str(cfg.soci.stride_kib))
+        os.environ.setdefault(
+            "NTPU_SOCI_REPLICATE", "1" if cfg.soci.replicate else "0"
+        )
     system_controller = None
     if cfg.system.enable:
         from nydus_snapshotter_tpu.system import SystemController

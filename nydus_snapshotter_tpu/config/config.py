@@ -243,6 +243,34 @@ class PeerConfig:
 
 
 @dataclass
+class SociConfig:
+    """Seekable-OCI backend knobs (soci/).
+
+    With ``enable`` on, plain OCI ``.tar.gz`` layers that carry no nydus,
+    estargz or tarfs cooperation are claimed at Prepare and lazily served
+    WITHOUT conversion: the first pull builds a persisted, checksummed
+    zran checkpoint index (gzip inflate resume points every
+    ``stride_kib`` of decompressed output + a per-layer
+    file→decompressed-extent map) into the cache dir next to the blob's
+    chunk map, and runtime reads resolve to compressed byte ranges of
+    the original layer, fetched through the ordinary lazy-read data
+    plane (fetch scheduler, eviction, peer tier, QoS lanes). A smaller
+    stride means less read amplification but a bigger index (~32 KiB of
+    window per checkpoint, compressed). With ``replicate`` on, a pod
+    missing an index asks the blob's peer-tier region owner before
+    rebuilding, so one pod's first-pull build amortizes across the
+    fleet. Environment variables override per-process
+    (``NTPU_SOCI_ENABLE``, ``NTPU_SOCI_STRIDE_KIB``,
+    ``NTPU_SOCI_REPLICATE``) — that is also how the section reaches
+    spawned daemon processes.
+    """
+
+    enable: bool = False
+    stride_kib: int = 1024
+    replicate: bool = True
+
+
+@dataclass
 class SnapshotsConfig:
     """Concurrent snapshot control-plane knobs
     (snapshot/{metastore,snapshotter,async_work}.py).
@@ -429,6 +457,7 @@ class SnapshotterConfig:
     compression: CompressionConfig = field(default_factory=CompressionConfig)
     blobcache: BlobcacheConfig = field(default_factory=BlobcacheConfig)
     peer: PeerConfig = field(default_factory=PeerConfig)
+    soci: SociConfig = field(default_factory=SociConfig)
     snapshots: SnapshotsConfig = field(default_factory=SnapshotsConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
     chunk_dict: ChunkDictConfig = field(default_factory=ChunkDictConfig)
@@ -560,6 +589,10 @@ class SnapshotterConfig:
             )
         if any(w <= 0 for w in self.peer.tenant_weights.values()):
             raise ConfigError("peer.tenant_weights must all be positive")
+        if self.soci.stride_kib < 64:
+            # Checkpoints below one deflate window apart are pure index
+            # bloat: the window alone is 32 KiB.
+            raise ConfigError("soci.stride_kib must be >= 64")
         if self.snapshots.read_pool < 1:
             raise ConfigError("snapshots.read_pool must be >= 1")
         if self.snapshots.prepare_fanout < 0 or self.snapshots.usage_workers < 0:

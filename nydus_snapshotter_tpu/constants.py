@@ -99,6 +99,9 @@ NYDUS_IMAGE_PULL_SECRET = "containerd.io/snapshot/pullsecret"
 NYDUS_IMAGE_PULL_USERNAME = "containerd.io/snapshot/pullusername"
 # Marks a snapshot holding an estargz layer (label.go:54).
 STARGZ_LAYER = "containerd.io/snapshot/stargz"
+# Marks a snapshot holding a seekable-OCI indexed plain gzip layer
+# (soci/adaptor.py — this framework's backend, no reference equivalent).
+SOCI_LAYER = "containerd.io/snapshot/ntpu-soci"
 # Builder hint that an image should run in tarfs mode (label.go:63-65).
 TARFS_HINT = "containerd.io/snapshot/tarfs-hint"
 

@@ -197,6 +197,7 @@ class BlobReader:
         blob_index: int,
         read_at: Callable[[int, int], bytes],
         batch_map: Optional[dict[tuple[int, int], tuple[int, int]]] = None,
+        gzip_stream=None,
     ):
         self.bootstrap = bootstrap
         self.blob_index = blob_index
@@ -212,9 +213,22 @@ class BlobReader:
         self._batch_lock = threading.Lock()
         self._batch_cache: "OrderedDict[int, bytes]" = OrderedDict()
         self._batch_cache_bytes = 0
-        # OCIRef blobs: a checkpointed cursor into the original gzip stream.
-        self._gzip_stream = None
+        # OCIRef blobs: a checkpointed cursor into the original gzip
+        # stream. The default is the in-process GzipStreamReader (built
+        # lazily, serialized by _gzip_lock — its inflate cursor is
+        # stateful); a caller holding a persisted soci index injects a
+        # SociStreamReader instead, whose `concurrent` flag skips the
+        # lock (each read owns its own inflate state).
+        self._gzip_stream = gzip_stream
         self._gzip_lock = threading.Lock()
+
+    def mount_gzip_stream(self, stream) -> None:
+        """Swap in a checkpoint-indexed gzip reader (soci/blob.py) after
+        construction: the daemon resolves the index store off its reader
+        lock, so the stream arrives late. The attribute swap is atomic;
+        reads served before it used the sequential path — identical
+        bytes, just without checkpoint resume."""
+        self._gzip_stream = stream
 
     def _read_plain(self, offset: int, size: int) -> bytes:
         raw = self.read_at(offset, size)
@@ -239,6 +253,10 @@ class BlobReader:
         if rec.flags & CHUNK_FLAG_GZIP_STREAM:
             # OCIRef: offsets address the decompressed stream of the
             # original .tar.gz blob (converter/zran.py).
+            if getattr(self._gzip_stream, "concurrent", False):
+                return self._gzip_stream.read_range(
+                    rec.uncompressed_offset, rec.uncompressed_size
+                )
             with self._gzip_lock:
                 if self._gzip_stream is None:
                     self._gzip_stream = GzipStreamReader(

@@ -125,14 +125,19 @@ class GzipStreamReader:
         return bytes(out)
 
 
-def pack_gzip_layer(raw_gzip: bytes, opt: PackOption, engine=None) -> Bootstrap:
+def pack_gzip_layer(
+    raw_gzip: bytes, opt: PackOption, engine=None, tar_bytes: Optional[bytes] = None
+) -> Bootstrap:
     """Index an original ``.tar.gz`` layer without re-storing its data.
 
     Returns the layer Bootstrap, whose single blob IS the original
     compressed layer (blob id = its sha256). The decompressed stream is
     chunked per-file (the reference's targz-ref chunks the uncompressed
     content) and digested through ``engine`` when supplied
-    (batched/device) or hashlib otherwise.
+    (batched/device) or hashlib otherwise. ``tar_bytes`` lets a caller
+    that already inflated the stream (the soci index build is itself one
+    full inflate pass) hand the output over instead of paying a second
+    decompression of a multi-hundred-MiB layer.
     """
     opt.validate()
     if opt.encrypt:
@@ -140,10 +145,11 @@ def pack_gzip_layer(raw_gzip: bytes, opt: PackOption, engine=None) -> Bootstrap:
         # claiming encryption would mislabel it (hooks annotates encrypted
         # blobs) and consumers would decrypt plaintext into garbage.
         raise ConvertError("oci_ref cannot be combined with encrypt")
-    try:
-        tar_bytes = gzip.decompress(raw_gzip)
-    except (OSError, EOFError, zlib.error) as e:
-        raise ConvertError(f"OCIRef layer is not valid gzip: {e}") from e
+    if tar_bytes is None:
+        try:
+            tar_bytes = gzip.decompress(raw_gzip)
+        except (OSError, EOFError, zlib.error) as e:
+            raise ConvertError(f"OCIRef layer is not valid gzip: {e}") from e
 
     entries: dict[str, fstree.FileEntry] = {}
     # (path, decompressed data offset, size) per regular file, chunked.

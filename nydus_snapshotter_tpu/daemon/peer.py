@@ -239,6 +239,9 @@ class PeerExport:
         # self._mu (NTPU_ANALYZE=1 verifies).
         self._blobs_shared = _an.shared("peer.export.blobs")
         self._blobs: dict[str, object] = {}
+        # blob_id -> persisted soci index path this node can replicate
+        # (checksummed on the wire by the requester's index load).
+        self._soci: dict[str, str] = {}
 
     def register(self, blob_id: str, cached_blob) -> None:
         with self._mu:
@@ -259,15 +262,34 @@ class PeerExport:
             self._blobs_shared.read()
             return self._blobs.get(blob_id)
 
+    def register_soci(self, blob_id: str, index_path: str) -> None:
+        """Announce a persisted soci index: peers missing one replicate
+        it instead of re-pulling the whole layer to rebuild."""
+        with self._mu:
+            self._blobs_shared.write()
+            self._soci[blob_id] = index_path
+
+    def unregister_soci(self, blob_id: str) -> None:
+        with self._mu:
+            self._blobs_shared.write()
+            self._soci.pop(blob_id, None)
+
+    def soci_path(self, blob_id: str):
+        with self._mu:
+            self._blobs_shared.read()
+            return self._soci.get(blob_id)
+
     def stats(self) -> dict:
         with self._mu:
             self._blobs_shared.read()
             blobs = dict(self._blobs)
+            soci = dict(self._soci)
         return {
             "blobs": {
                 bid: {"covered_bytes": cb.coverage_bytes()}
                 for bid, cb in blobs.items()
-            }
+            },
+            "soci_indexes": sorted(soci),
         }
 
 
@@ -277,6 +299,7 @@ class PeerExport:
 
 
 _BLOB_ROUTE = "/api/v1/peer/blob/"
+_SOCI_ROUTE = "/api/v1/peer/soci/"
 _STAT_ROUTE = "/api/v1/peer/stat"
 
 
@@ -344,6 +367,28 @@ class PeerChunkServer:
         if parsed.path in ("/metrics", "/v1/metrics"):
             body = _reg.render().encode()
             return 200, {"Content-Type": "text/plain; version=0.0.4"}, body
+        if parsed.path.startswith(_SOCI_ROUTE) and method == "GET":
+            # Seekable-OCI index replication: serve the persisted,
+            # checksummed artifact so one pod's first-pull build
+            # amortizes across the fleet. The requester revalidates the
+            # embedded SHA-256 before adopting (a corrupt relay costs a
+            # local rebuild, never a poisoned read).
+            path = self.export.soci_path(parsed.path[len(_SOCI_ROUTE):])
+            if path is None:
+                SERVE_REQUESTS.labels("miss").inc()
+                return 404, {}, b'{"message": "no soci index"}'
+            try:
+                with open(path, "rb") as f:
+                    body = f.read()
+            except OSError as e:
+                SERVE_REQUESTS.labels("error").inc()
+                return 500, {}, json.dumps({"message": str(e)}).encode()
+            SERVE_REQUESTS.labels("hit").inc()
+            SERVED_BYTES.inc(len(body))
+            return 200, {
+                "Content-Type": "application/octet-stream",
+                "x-ntpu-peer-crc32": f"{_crc32(body):08x}",
+            }, body
         if not parsed.path.startswith(_BLOB_ROUTE) or method != "GET":
             return 404, {}, b'{"message": "no such endpoint"}'
         blob_id = parsed.path[len(_BLOB_ROUTE):]
@@ -555,6 +600,32 @@ class PeerClient:
         # inject corruption by patching the server-side helper).
         if want_crc and f"{zlib.crc32(payload) & 0xFFFFFFFF:08x}" != want_crc:
             raise PeerError(f"peer {self.address} payload failed CRC32 check")
+        return payload
+
+    def fetch_soci_index(self, blob_id: str) -> bytes:
+        """The peer's persisted soci index artifact for ``blob_id``
+        (serialized; the caller revalidates its embedded checksum).
+        Raises :class:`PeerMiss`/:class:`PeerError` like ``read_range``."""
+        conn = self._connect()
+        try:
+            conn.request("GET", f"{_SOCI_ROUTE}{blob_id}")
+            resp = conn.getresponse()
+            payload = resp.read()
+            if resp.status == 404:
+                raise PeerMiss(f"peer {self.address} has no index for {blob_id}")
+            if resp.status != 200:
+                raise PeerError(
+                    f"peer {self.address} -> {resp.status}: {payload[:120]!r}"
+                )
+            want_crc = resp.headers.get("x-ntpu-peer-crc32", "")
+        except (http.client.HTTPException, OSError) as e:
+            if isinstance(e, PeerError):
+                raise
+            raise PeerError(f"peer {self.address} request failed: {e}") from e
+        finally:
+            conn.close()
+        if want_crc and f"{zlib.crc32(payload) & 0xFFFFFFFF:08x}" != want_crc:
+            raise PeerError(f"peer {self.address} index failed CRC32 check")
         return payload
 
     def stat(self) -> dict:

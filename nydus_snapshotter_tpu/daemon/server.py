@@ -65,6 +65,10 @@ class _Instance:
         self.prefetched_bytes = 0
         self._cached_blobs: list = []  # CachedBlob instances (registry backend)
         self._cached_by_index: dict[int, object] = {}  # blob_index -> CachedBlob
+        # blob_index -> SociStreamReader for gzip-stream blobs with a
+        # persisted checkpoint index (soci backend): cold reads resume at
+        # the nearest inflate checkpoint instead of from byte 0.
+        self._soci_by_index: dict[int, object] = {}
         self._replayer = None  # PrefetchReplayer while a replay is running
         # In-flight data-plane requests (API and FUSE reads both funnel
         # through read()); the inflight metrics endpoint snapshots this so
@@ -135,6 +139,7 @@ class _Instance:
             export = peer_mod.default_export()
             for cached in cached_blobs:
                 export.unregister(cached.blob_id, cached)
+                export.unregister_soci(cached.blob_id)
         for cached in cached_blobs:
             try:
                 cached.close()
@@ -159,6 +164,7 @@ class _Instance:
         return self._cfg_cache
 
     def _reader(self, blob_index: int, blob_dir: str) -> BlobReader:
+        soci_args = None
         with self._reader_lock:
             if self._closed:
                 # A read racing a legitimate unmount: fail instead of
@@ -209,6 +215,7 @@ class _Instance:
                     read_at = cached.read_at
                 else:
                     f = open(os.path.join(blob_dir, blob_id), "rb")
+                    cache_dir = os.path.join(blob_dir, "cache")
 
                     def read_at(off: int, size: int, _f=f) -> bytes:
                         # pread is positional: no seek state, no lock, one
@@ -216,10 +223,88 @@ class _Instance:
                         return os.pread(_f.fileno(), size, off)
 
                 reader = BlobReader(
-                    self.bootstrap, blob_index, read_at, batch_map=self._batch_map
+                    self.bootstrap, blob_index, read_at,
+                    batch_map=self._batch_map,
                 )
                 self._readers[blob_index] = reader
+                soci_args = (blob_id, read_at, [cache_dir, blob_dir])
+        if soci_args is not None:
+            # Index store OFF the reader lock: resolving it may touch the
+            # peer tier or (rebuild-once) the origin, and other blobs'
+            # reads must not queue behind that. Reads racing ahead of the
+            # mount use the sequential path — identical bytes, then the
+            # checkpointed reader takes over.
+            stream = self._soci_stream(blob_index, *soci_args)
+            if stream is not None:
+                reader.mount_gzip_stream(stream)
         return reader
+
+    def _soci_stream(self, blob_index: int, blob_id: str, read_at, dirs):
+        """A checkpoint-indexed stream reader for a gzip-stream (soci /
+        OCIRef) blob, when an index can be had: persisted locally by the
+        first-pull build, replicated from the blob's peer-tier region
+        owner, or — with the backend enabled — rebuilt once from the
+        original bytes. Returns None when this blob has no gzip-stream
+        chunks or no index is obtainable (BlobReader then falls back to
+        the sequential in-process reader; correctness never depends on
+        the index)."""
+        from nydus_snapshotter_tpu.converter.zran import CHUNK_FLAG_GZIP_STREAM
+
+        if not any(
+            rec.blob_index == blob_index and rec.flags & CHUNK_FLAG_GZIP_STREAM
+            for rec in self.bootstrap.chunks
+        ):
+            return None
+        from nydus_snapshotter_tpu.daemon import peer as peer_mod
+        from nydus_snapshotter_tpu.soci import blob as soci_blob
+        from nydus_snapshotter_tpu.soci.index import index_path
+
+        cfg = soci_blob.resolve_soci_config()
+        csize = self.bootstrap.blobs[blob_index].compressed_size
+        fetch_remote = None
+        if cfg.enable and cfg.replicate:
+            router = peer_mod.default_router()
+            if router is not None:
+                owner = router.route(blob_id, 0)
+                if owner is not None:
+                    fetch_remote = lambda: peer_mod.PeerClient(  # noqa: E731
+                        owner
+                    ).fetch_soci_index(blob_id)
+        try:
+            index, outcome = soci_blob.load_or_build_index(
+                [d for d in dirs if d],
+                blob_id,
+                csize=csize,
+                # Rebuild-once (evicted/corrupt index) only when the
+                # backend is on: it costs one full pull of the original
+                # blob, written through the chunk cache like any fetch.
+                builder=(
+                    (lambda: read_at(0, csize)) if cfg.enable and csize else None
+                ),
+                fetch_remote=fetch_remote,
+                stride=cfg.stride_bytes,
+            )
+        except Exception:  # noqa: BLE001 — incl. an armed soci.index
+            # failpoint: a broken index STORE degrades this blob to the
+            # sequential in-process reader; it must never fail reads.
+            logger.warning("soci index store failed for %s; serving "
+                           "sequentially", blob_id[:12], exc_info=True)
+            return None
+        if index is None:
+            return None
+        stream = soci_blob.SociStreamReader(index, read_at, name=blob_id[:8])
+        self._soci_by_index[blob_index] = stream
+        # Announce the index itself to the peer tier: one pod's build
+        # amortizes across the fleet.
+        for d in dirs:
+            if d and os.path.exists(index_path(d, blob_id)):
+                peer_mod.default_export().register_soci(
+                    blob_id, index_path(d, blob_id)
+                )
+                break
+        logger.info("soci index for %s: %s (%d checkpoints)",
+                    blob_id[:12], outcome, len(index.checkpoints))
+        return stream
 
     def blob_dir(self, default_dir: str) -> str:
         cfg = self._parsed_config()
@@ -243,19 +328,41 @@ class _Instance:
         blob_dir = self.blob_dir(default_blob_dir)
 
         def warm_chunk(rec) -> int:
+            from nydus_snapshotter_tpu.converter.zran import (
+                CHUNK_FLAG_GZIP_STREAM,
+            )
+
             # Ensure the blob's reader (and CachedBlob, for registry
             # backends) exists; raises after close(), ending the replay.
             reader = self._reader(rec.blob_index, blob_dir)
             cached = self._cached_by_index.get(rec.blob_index)
+            if cached is not None and rec.flags & CHUNK_FLAG_GZIP_STREAM:
+                # Gzip-stream (soci/OCIRef) chunks address the DECOMPRESSED
+                # stream; warming those offsets against the compressed blob
+                # would warm garbage. Translate through the checkpoint
+                # index when one is mounted, else warm through the reader
+                # (sequential, still background-lane contained).
+                soci = self._soci_by_index.get(rec.blob_index)
+                if soci is not None:
+                    c0, c1 = soci.resolve_compressed(
+                        rec.uncompressed_offset, rec.uncompressed_size
+                    )
+                    rec_off, rec_size = c0, max(0, c1 - c0)
+                else:
+                    n = len(reader.chunk_data(rec))
+                    self.prefetched_bytes += n
+                    return n
+            else:
+                rec_off, rec_size = rec.compressed_offset, rec.compressed_size
             if cached is not None:
-                flights = cached.warm(rec.compressed_offset, rec.compressed_size)
+                flights = cached.warm(rec_off, rec_size)
                 for f in flights:
                     while not f.wait(0.1):
                         if replayer.cancelled:
                             return 0
                 if any(f.error is not None for f in flights):
                     return 0
-                n = rec.compressed_size
+                n = rec_size
             else:
                 n = len(reader.chunk_data(rec))
             self.prefetched_bytes += n
@@ -522,6 +629,7 @@ class DaemonServer:
                     self._reply(200, daemon.fs_metrics(mp))
                 elif u.path == "/api/v1/metrics/blobcache":
                     from nydus_snapshotter_tpu.daemon import fetch_sched
+                    from nydus_snapshotter_tpu.soci import blob as soci_blob
 
                     with daemon._lock:
                         amount = sum(
@@ -529,6 +637,7 @@ class DaemonServer:
                         )
                     body = {"prefetch_data_amount": amount}
                     body.update(fetch_sched.snapshot_counters())
+                    body["soci"] = soci_blob.snapshot_counters()
                     # Metrics → traces link: the last root trace ids whose
                     # duration exceeded the rolling p95 (fetch them from
                     # /api/v1/traces or /debug/pprof/trace).

@@ -64,6 +64,8 @@ class Filesystem:
         verifier=None,
         stargz_resolver=None,
         stargz_adaptor=None,
+        soci_resolver=None,
+        soci_adaptor=None,
         tarfs_mgr=None,
         referrer_mgr=None,
         root_mountpoint: str = "",
@@ -79,6 +81,8 @@ class Filesystem:
         self.verifier = verifier
         self.stargz_resolver = stargz_resolver
         self.stargz_adaptor = stargz_adaptor
+        self.soci_resolver = soci_resolver
+        self.soci_adaptor = soci_adaptor
         self.tarfs_mgr = tarfs_mgr
         self.referrer_mgr = referrer_mgr
         self.root_mountpoint = root_mountpoint or os.path.join(root, "mnt")
@@ -561,6 +565,37 @@ class Filesystem:
         if self.stargz_adaptor is None:
             raise errdefs.Unavailable("stargz support is not enabled")
         self.stargz_adaptor.merge_meta_layer(snapshot)
+
+    def soci_enabled(self) -> bool:
+        return self.soci_resolver is not None
+
+    def is_soci_data_layer(self, snap_labels: dict):
+        """Whether this layer is claimable by the seekable-OCI backend:
+        any plain gzip layer with image/digest labels qualifies — the
+        whole point is that the image was never rewritten. Runs AFTER
+        the nydus/stargz arms in the processor routing, so cooperative
+        formats keep their richer paths."""
+        if not self.soci_enabled():
+            return False, None
+        ref = snap_labels.get(C.CRI_IMAGE_REF, "")
+        digest = snap_labels.get(C.CRI_LAYER_DIGEST, "")
+        if not ref or not digest:
+            return False, None
+        try:
+            blob = self.soci_resolver.get_blob(ref, digest, snap_labels)
+            return blob is not None, blob
+        except Exception:
+            return False, None
+
+    def prepare_soci_meta_layer(self, blob, storage_path: str, snap_labels: dict) -> None:
+        if self.soci_adaptor is None:
+            raise errdefs.Unavailable("soci support is not enabled")
+        self.soci_adaptor.prepare_meta_layer(blob, storage_path, snap_labels)
+
+    def merge_soci_meta_layer(self, snapshot) -> None:
+        if self.soci_adaptor is None:
+            raise errdefs.Unavailable("soci support is not enabled")
+        self.soci_adaptor.merge_meta_layer(snapshot)
 
     def tarfs_enabled(self) -> bool:
         return self.tarfs_mgr is not None

@@ -36,5 +36,9 @@ def is_stargz_layer(labels: Mapping[str, str]) -> bool:
     return C.STARGZ_LAYER in labels
 
 
+def is_soci_layer(labels: Mapping[str, str]) -> bool:
+    return C.SOCI_LAYER in labels
+
+
 def is_volatile(labels: Mapping[str, str]) -> bool:
     return C.OVERLAYFS_VOLATILE_OPT in labels

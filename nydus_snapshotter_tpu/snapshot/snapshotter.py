@@ -95,6 +95,10 @@ class FilesystemLike(Protocol):
     def is_stargz_data_layer(self, labels: dict) -> tuple[bool, object]: ...
     def prepare_stargz_meta_layer(self, blob, storage_path: str, labels: dict) -> None: ...
     def merge_stargz_meta_layer(self, snapshot: Snapshot) -> None: ...
+    def soci_enabled(self) -> bool: ...
+    def is_soci_data_layer(self, labels: dict) -> tuple[bool, object]: ...
+    def prepare_soci_meta_layer(self, blob, storage_path: str, labels: dict) -> None: ...
+    def merge_soci_meta_layer(self, snapshot: Snapshot) -> None: ...
     def tarfs_enabled(self) -> bool: ...
     def prepare_tarfs_layer(self, labels: dict, snapshot_id: str, upper_path: str) -> None: ...
     def merge_tarfs_layers(self, snapshot: Snapshot, path_fn: Callable[[str], str]) -> None: ...
@@ -462,6 +466,42 @@ class Snapshotter:
                             else:
                                 snap_labels[C.STARGZ_LAYER] = "true"
                                 handler = skip_handler
+                if handler is None and self.fs.soci_enabled():
+                    # Seekable-OCI: claim the ordinary gzip layer nobody
+                    # will ever convert. Runs after the stargz arm so
+                    # cooperative estargz images keep their TOC path; the
+                    # detection is a 2-byte gzip-magic ranged read.
+                    ok, blob = self.fs.is_soci_data_layer(snap_labels)
+                    if ok:
+                        if self._board.enabled:
+                            # Optimistic skip, like stargz: the heavy
+                            # first-pull index build overlaps on the board
+                            # while containerd issues the next layer's
+                            # Prepare; a failure sticks to this snapshot
+                            # id and surfaces at mounts()/child prepare.
+                            self._board.submit(
+                                s.id,
+                                functools.partial(
+                                    self.fs.prepare_soci_meta_layer,
+                                    blob,
+                                    self.upper_path(s.id),
+                                    dict(snap_labels),
+                                ),
+                            )
+                            snap_labels[C.SOCI_LAYER] = "true"
+                            handler = skip_handler
+                        else:
+                            try:
+                                self.fs.prepare_soci_meta_layer(
+                                    blob, self.upper_path(s.id), snap_labels
+                                )
+                            except Exception:
+                                logger.exception(
+                                    "prepare soci layer of snapshot %s", s.id
+                                )
+                            else:
+                                snap_labels[C.SOCI_LAYER] = "true"
+                                handler = skip_handler
                 if handler is None and self.fs.tarfs_enabled():
                     try:
                         self.fs.prepare_tarfs_layer(snap_labels, s.id, self.upper_path(s.id))
@@ -513,6 +553,19 @@ class Snapshotter:
                 # background — this is its other join point.
                 self._board.join(p_sid)
                 self.fs.merge_stargz_meta_layer(s)
+                handler = remote_handler(p_sid, p_info.labels)
+
+            if (
+                handler is None
+                and p_err is None
+                and p_info is not None
+                and self.fs.soci_enabled()
+                and label.is_soci_layer(p_info.labels)
+            ):
+                # The parent's index-on-first-pull build may still be
+                # running in the background — this is its join point.
+                self._board.join(p_sid)
+                self.fs.merge_soci_meta_layer(s)
                 handler = remote_handler(p_sid, p_info.labels)
 
             if (

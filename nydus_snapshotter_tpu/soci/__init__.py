@@ -1,0 +1,43 @@
+"""Seekable-OCI backend: lazy-load plain OCI gzip layers, convert nothing.
+
+Every other lazy path in this tree (RAFS, eStargz, tarfs) needs the image
+rewritten or annotated first. This package is the backend for the
+registry's millions of images that never will be: on FIRST PULL the layer
+is indexed — a zran/gzip checkpoint index (inflate resume points at a
+configurable stride) plus a per-layer file→decompressed-extent map — and
+from then on file reads resolve to compressed byte ranges of the ORIGINAL
+``.tar.gz`` blob, fetched through the ordinary lazy-read data plane
+(daemon/fetch_sched.py: singleflight, coalescing, readahead, watermark
+eviction, peer tier, QoS admission lanes). The index is the only new
+artifact; no RAFS blob is ever written.
+
+Modules:
+
+- :mod:`~nydus_snapshotter_tpu.soci.zran` — ctypes binding of the SYSTEM
+  libz (the same discipline as utils/zstd.py): checkpoint capture with
+  ``Z_BLOCK`` during one sequential inflate, bit-exact mid-stream resume
+  via ``inflatePrime`` + ``inflateSetDictionary``;
+- :mod:`~nydus_snapshotter_tpu.soci.index` — the persisted, checksummed
+  ``<blob_id>.soci.idx`` artifact (tail-first/header-last torn-write
+  hardening like the v5 dict format) and the read→compressed-range
+  resolve geometry;
+- :mod:`~nydus_snapshotter_tpu.soci.blob` — :class:`SociStreamReader`
+  (the concurrent decompressed-domain reader the daemon's BlobReader
+  mounts) and the index store: local load → peer-tier replication →
+  rebuild-once, never poisoning reads;
+- :mod:`~nydus_snapshotter_tpu.soci.adaptor` — the snapshotter-side
+  driver (resolver probe + index-on-first-pull prepare + layer merge),
+  routed by ``filesystem/fs.py`` exactly like the stargz adaptor.
+
+Failpoint sites ``soci.{index,resolve,fetch}`` (docs/robustness.md),
+metrics ``ntpu_soci_*`` (docs/observability.md), config ``[soci]`` with
+``NTPU_SOCI*`` env overrides (docs/configure.md).
+"""
+
+from nydus_snapshotter_tpu.soci.adaptor import SociAdaptor, SociResolver  # noqa: F401
+from nydus_snapshotter_tpu.soci.blob import (  # noqa: F401
+    SociStreamReader,
+    load_or_build_index,
+    resolve_soci_config,
+)
+from nydus_snapshotter_tpu.soci.index import SociIndex, SociIndexError  # noqa: F401

@@ -1,0 +1,168 @@
+"""Snapshotter-side soci driver: probe, index-on-first-pull, merge.
+
+The exact shape of the stargz adaptor pair (stargz/{resolver,adaptor}.py),
+for layers that carry NO cooperation from the image builder:
+
+- :class:`SociResolver` detects a claimable layer the cheapest possible
+  way — one 2-byte ranged read proving the blob is gzip. Any plain OCI
+  ``.tar.gz`` layer qualifies; there is nothing to parse because the
+  whole point is that the image was never rewritten.
+- :class:`SociAdaptor.prepare_meta_layer` is the **one** full pull the
+  backend ever performs: stream the original blob, run the single zran
+  build pass (checkpoints + decompressed bytes in one inflate), emit the
+  layer bootstrap from that same pass via
+  :func:`~nydus_snapshotter_tpu.converter.zran.pack_gzip_layer` — the
+  blob referenced is the ORIGINAL registry layer, nothing is converted
+  or re-stored — and persist the checkpoint index into the cache dir
+  next to where the blob's chunk map will live. Subsequent pods skip
+  even this: the index replicates through the peer tier
+  (:func:`~nydus_snapshotter_tpu.soci.blob.load_or_build_index`).
+- ``merge_meta_layer`` is byte-for-byte the stargz merge (per-layer
+  bootstraps named by digest hex → ``image.boot``), reused by
+  composition: zran bootstraps and TOC bootstraps merge identically
+  (pinned since the ``test_merge_mixes_zran_and_packed_layers`` days).
+
+When the system libz lacks zran support the adaptor still claims the
+layer — the bootstrap alone makes it lazily readable via the sequential
+in-process reader — it just cannot persist checkpoints (documented
+degraded mode).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from typing import Callable, Mapping, Optional
+
+from nydus_snapshotter_tpu import constants
+from nydus_snapshotter_tpu.converter.types import PackOption
+from nydus_snapshotter_tpu.converter.zran import pack_gzip_layer
+from nydus_snapshotter_tpu.soci import blob as soci_blob
+from nydus_snapshotter_tpu.soci import zran
+from nydus_snapshotter_tpu.soci.index import index_path
+from nydus_snapshotter_tpu.stargz.adaptor import StargzAdaptor
+from nydus_snapshotter_tpu.stargz.resolver import Blob, Resolver, _blob_size
+from nydus_snapshotter_tpu.utils import errdefs
+
+logger = logging.getLogger(__name__)
+
+_GZIP_MAGIC = b"\x1f\x8b"
+
+
+class SociError(errdefs.NydusError):
+    pass
+
+
+class SociResolver(Resolver):
+    """Ranged-blob resolver accepting ANY gzip layer (no footer needed)."""
+
+    def get_blob(
+        self, ref: str, digest: str, labels: Optional[Mapping[str, str]] = None
+    ) -> Blob:
+        from nydus_snapshotter_tpu.auth import keychain as authmod
+        from nydus_snapshotter_tpu.remote.reference import parse_docker_ref
+
+        parsed = parse_docker_ref(ref)
+        kc = authmod.get_keychain_by_ref(ref, dict(labels or {}))
+        _, client = self.pool.resolve(parsed, digest, keychain=kc)
+        repo = parsed.path
+        size = _blob_size(client, repo, digest)
+
+        def read_at(offset: int, length: int) -> bytes:
+            if length <= 0:
+                return b""
+            r = client.fetch_blob(
+                repo, digest, byte_range=(offset, offset + length - 1)
+            )
+            try:
+                return r.read()
+            finally:
+                r.close()
+
+        # Detection is two bytes: a non-gzip layer (zstd, uncompressed
+        # tar, foreign media type) must fail here, cheaply, not later in
+        # the prepare path.
+        head = read_at(0, 2)
+        if head != _GZIP_MAGIC:
+            raise SociError(f"blob {digest} is not a gzip layer")
+        return Blob(ref, digest, read_at, size)
+
+
+class SociAdaptor:
+    def __init__(
+        self,
+        upper_path_fn: Callable[[str], str],
+        cache_dir: str = "",
+        fs_driver: str = constants.FS_DRIVER_FUSEDEV,
+        chunk_size: int = constants.CHUNK_SIZE_DEFAULT,
+        stride: int = 0,
+    ):
+        self.upper_path = upper_path_fn
+        self.cache_dir = cache_dir
+        self.fs_driver = fs_driver
+        self.chunk_size = chunk_size
+        self.stride = stride  # 0 = resolve from [soci]/env at build time
+        # The merge half is format-agnostic bootstrap plumbing — reuse it.
+        self._merge = StargzAdaptor(
+            upper_path_fn, cache_dir=cache_dir, fs_driver=fs_driver,
+            chunk_size=chunk_size,
+        )
+
+    # -- prepare (index on first pull) ---------------------------------------
+
+    def prepare_meta_layer(
+        self, blob: Blob, storage_path: str,
+        _labels: Optional[Mapping[str, str]] = None,
+    ) -> None:
+        blob_id = blob.get_digest().split(":", 1)[-1]
+        os.makedirs(storage_path, exist_ok=True)
+        converted = os.path.join(storage_path, blob_id)
+        if os.path.exists(converted):
+            return
+
+        # The one full pull. Everything after this is ranged.
+        raw = blob.read_at(0, blob.size)
+        if len(raw) != blob.size:
+            raise SociError(
+                f"blob {blob_id[:12]} short pull: {len(raw)} of {blob.size}"
+            )
+
+        index = None
+        tar_bytes = None
+        stride = self.stride or soci_blob.resolve_soci_config().stride_bytes
+        if zran.available():
+            index, tar_bytes = soci_blob.build_index_from_gzip(
+                blob_id, raw, stride=stride
+            )
+
+        opt = PackOption(chunk_size=self.chunk_size, oci_ref=True)
+        bootstrap = pack_gzip_layer(raw, opt, tar_bytes=tar_bytes)
+
+        if index is not None and self.cache_dir:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            soci_blob.INDEX_BYTES.inc(
+                index.save(index_path(self.cache_dir, blob_id))
+            )
+            soci_blob.INDEX_EVENTS.labels("built").inc()
+        elif index is None:
+            logger.warning(
+                "libz zran unavailable: soci layer %s gets no checkpoint "
+                "index (sequential cold reads)", blob_id[:12],
+            )
+
+        fd, tmp = tempfile.mkstemp(prefix="converting-soci", dir=storage_path)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(bootstrap.to_bytes())
+            os.rename(tmp, converted)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        os.chmod(converted, 0o440)
+
+    # -- merge ---------------------------------------------------------------
+
+    def merge_meta_layer(self, snapshot) -> None:
+        self._merge.merge_meta_layer(snapshot)

@@ -269,6 +269,18 @@ class FakeFs:
     def merge_stargz_meta_layer(self, snapshot):
         pass
 
+    def soci_enabled(self):
+        return False
+
+    def is_soci_data_layer(self, labels):
+        return False, None
+
+    def prepare_soci_meta_layer(self, blob, storage_path, labels):
+        pass
+
+    def merge_soci_meta_layer(self, snapshot):
+        pass
+
     def tarfs_enabled(self):
         return False
 

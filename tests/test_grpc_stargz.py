@@ -13,6 +13,9 @@ blob (reference stargz_adaptor.go:165-260 + the runtime read path).
 """
 
 import os
+import signal
+import subprocess
+import sys
 
 import grpc
 import numpy as np
@@ -84,6 +87,53 @@ def _mk_stargz_stack(tmp_path):
 
 class TestStargzOverGrpc:
     def test_lazy_pull_merge_mount_and_read(self, tmp_path, registry):
+        """Known-env-failure #15 (docs/known_env_failures.md): this
+        scenario passes in isolation but flakes under full-suite
+        interleaving on the 1-core box — cross-test interference with
+        the optimistic-skip + backgrounded stargz TOC build. Same fix
+        as the PR-8 kernel-FUSE takeover storm: the outer test re-executes
+        itself in a FRESH pytest interpreter (full isolation, no
+        dependence on suite ordering), and the scenario body only runs
+        directly when NTPU_STARGZ_ISOLATED marks the inner process."""
+        if os.environ.get("NTPU_STARGZ_ISOLATED") != "1":
+            self._rerun_isolated()
+            return
+        self._run_scenario(tmp_path, registry)
+
+    def _rerun_isolated(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        node = (
+            f"{os.path.abspath(__file__)}::TestStargzOverGrpc::"
+            "test_lazy_pull_merge_mount_and_read"
+        )
+        env = dict(os.environ, NTPU_STARGZ_ISOLATED="1")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider", node],
+            env=env,
+            cwd=repo,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            start_new_session=True,  # a wedge is killed as a whole pgroup
+        )
+        try:
+            out, _ = proc.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            out, _ = proc.communicate()
+            pytest.fail(
+                "isolated stargz grpc scenario wedged (>300s), pgroup "
+                "killed:\n" + out[-4000:]
+            )
+        assert proc.returncode == 0, (
+            f"isolated stargz grpc scenario failed rc={proc.returncode}:\n"
+            + out[-4000:]
+        )
+        if " skipped" in out and " passed" not in out:
+            # Mirror an inner environment-skip outward honestly.
+            pytest.skip("isolated stargz scenario skipped:\n" + out[-600:])
+
+    def _run_scenario(self, tmp_path, registry):
         raw = build_estargz(FILES)
         digest = registry.add_blob(raw)
         ref = f"{registry.host}/lazy/img:latest"
@@ -108,11 +158,17 @@ class TestStargzOverGrpc:
             assert info.labels.get(C.STARGZ_LAYER) == "true"
             blob_hex = digest.split(":", 1)[1]
             converted = os.path.join(upper_path(cfg.root, sid), blob_hex)
-            assert os.path.exists(converted), "per-layer TOC bootstrap missing"
 
-            # container writable layer: merge -> image.boot -> rafs mount
+            # container writable layer: merge -> image.boot -> rafs mount.
+            # This Prepare is the optimistic-skip's JOIN POINT: the TOC
+            # bootstrap build runs in the background on the prepare board
+            # and is only guaranteed on disk after the child prepare (or
+            # mounts()) joins it — asserting `converted` before this call
+            # was the source of the historic ordering flake (known_env_
+            # failures.md #15): the assertion raced the background build.
             ctr_key = "ctr-stargz"
             client.prepare(ctr_key, chain, labels={C.CRI_IMAGE_REF: ref})
+            assert os.path.exists(converted), "per-layer TOC bootstrap missing"
             merged = os.path.join(upper_path(cfg.root, sid), "image.boot")
             assert os.path.exists(merged), "merged bootstrap missing"
             mounts = client.mounts(ctr_key)

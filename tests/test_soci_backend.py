@@ -1,0 +1,691 @@
+"""Seekable-OCI backend: checkpoint geometry, persisted-index hardening,
+full-stack byte identity, peer replication, chaos, and the gRPC
+end-to-end flow on an UNCONVERTED plain gzip layer.
+
+The contract under test (soci/): on first pull the original ``.tar.gz``
+layer gets a persisted, checksummed zran checkpoint index — nothing is
+converted, the registry blob stays the only data artifact — and runtime
+reads resolve through the index to compressed ranges fetched via the
+ordinary lazy-read data plane. A corrupt/torn/stale index fails loudly,
+is rebuilt once, and never poisons reads.
+"""
+
+import gzip
+import io
+import os
+import random
+import tarfile
+import threading
+
+import pytest
+
+from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.soci import zran
+from nydus_snapshotter_tpu.soci.blob import (
+    SociStreamReader,
+    build_index_from_gzip,
+    load_or_build_index,
+    snapshot_counters,
+)
+from nydus_snapshotter_tpu.soci.index import (
+    SociIndex,
+    SociIndexError,
+    index_path,
+)
+
+pytestmark = pytest.mark.skipif(
+    not zran.available(), reason="system libz with inflatePrime required"
+)
+
+STRIDE = 128 << 10
+BLOB_ID = "ab" * 32
+
+
+def build_layer(n_files=200, seed=7):
+    """(tar bytes, gzip bytes, {path: content}) — compressible+binary mix
+    shaped like a real layer."""
+    rng = random.Random(seed)
+    contents = {}
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:") as tf:
+        for i in range(n_files):
+            data = (b"lib line %04d " % i) * rng.randrange(50, 400) + rng.randbytes(
+                rng.randrange(100, 4000)
+            )
+            name = f"usr/lib/f{i:04d}.so"
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+            contents["/" + name] = data
+    raw = buf.getvalue()
+    return raw, gzip.compress(raw, 6), contents
+
+
+@pytest.fixture(scope="module")
+def layer():
+    return build_layer()
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint + resolve geometry
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointGeometry:
+    def test_stride_spacing_and_monotonicity(self, layer):
+        raw, gz, _ = layer
+        cps, out = zran.build(gz, stride=STRIDE)
+        assert out == raw
+        assert cps, "a multi-stride layer must produce checkpoints"
+        last_u, last_c = 0, 0
+        for cp in cps:
+            assert cp.uout - last_u >= STRIDE  # stride is a lower bound
+            assert cp.cin > last_c
+            assert 0 <= cp.bits < 8
+            assert len(cp.window) <= zran.WINDOW_SIZE
+            last_u, last_c = cp.uout, cp.cin
+
+    def test_resolve_geometry(self, layer):
+        raw, gz, _ = layer
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        assert len(idx.checkpoints) >= 3, "layer too small for this test"
+        # Before the first checkpoint: stream-start resume.
+        cp, cs, ce = idx.resolve(0, 10)
+        assert cp is None and cs == 0
+        assert ce == idx.checkpoints[0].cin
+        # Mid-stream: nearest checkpoint at or before the offset; the
+        # compressed window ends at the first checkpoint past the read.
+        mid = idx.checkpoints[1].uout + 17
+        cp, cs, ce = idx.resolve(mid, 1000)
+        assert cp is idx.checkpoints[1]
+        assert cs == cp.cin - (1 if cp.bits else 0)
+        assert ce == idx.checkpoints[2].cin
+        # Tail: bounded by the blob size.
+        cp, cs, ce = idx.resolve(len(raw) - 10, 10)
+        assert ce == len(gz)
+        # A read exactly AT a checkpoint uses it.
+        cp, _, _ = idx.resolve(idx.checkpoints[0].uout, 1)
+        assert cp is idx.checkpoints[0]
+
+    def test_extract_pulls_only_resolved_range(self, layer):
+        raw, gz, _ = layer
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        off, size = idx.checkpoints[0].uout + 100, 64 << 10
+        cp, cs, ce = idx.resolve(off, size)
+        pulled = []
+
+        def tracking(pos, n):
+            pulled.append((pos, n))
+            assert cs <= pos and pos + n <= max(ce, cs + 1)
+            return gz[pos : pos + n]
+
+        got = zran.extract(tracking, len(gz), cp, off, size, comp_end=ce)
+        assert got == raw[off : off + size]
+        assert sum(n for _, n in pulled) <= (ce - cs) + 1
+        # The whole point: far less than the blob.
+        assert sum(n for _, n in pulled) < len(gz) / 2
+
+    def test_random_extract_identity(self, layer):
+        raw, gz, _ = layer
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        reader = SociStreamReader(idx, lambda o, s: gz[o : o + s])
+        rng = random.Random(1)
+        for _ in range(40):
+            off = rng.randrange(0, len(raw) - 1)
+            size = rng.randrange(1, min(200_000, len(raw) - off))
+            assert reader.read_range(off, size) == raw[off : off + size]
+
+    def test_multi_member_gzip(self, layer):
+        raw, _, _ = layer
+        mm = b"".join(
+            gzip.compress(raw[i : i + 150_000], 1)
+            for i in range(0, len(raw), 150_000)
+        )
+        idx, out = build_index_from_gzip(BLOB_ID, mm, stride=64 << 10)
+        assert out == raw
+        reader = SociStreamReader(idx, lambda o, s: mm[o : o + s])
+        rng = random.Random(2)
+        for _ in range(20):
+            off = rng.randrange(0, len(raw) - 1)
+            size = rng.randrange(1, min(100_000, len(raw) - off))
+            assert reader.read_range(off, size) == raw[off : off + size]
+
+    def test_read_past_end_fails_loudly(self, layer):
+        raw, gz, _ = layer
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        reader = SociStreamReader(idx, lambda o, s: gz[o : o + s])
+        with pytest.raises(SociIndexError):
+            reader.read_range(len(raw) - 5, 10)
+
+    def test_file_map_matches_tar(self, layer):
+        raw, gz, contents = layer
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        assert set(idx.files) == set(contents)
+        reader = SociStreamReader(idx, lambda o, s: gz[o : o + s])
+        for path, (off, size) in idx.files.items():
+            assert reader.read_range(off, size) == contents[path], path
+
+
+# ---------------------------------------------------------------------------
+# Persistence hardening
+# ---------------------------------------------------------------------------
+
+
+class TestIndexPersistence:
+    def _saved(self, tmp_path, layer):
+        _, gz, _ = layer
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        path = index_path(str(tmp_path), BLOB_ID)
+        idx.save(path)
+        return idx, path, gz
+
+    def test_roundtrip(self, tmp_path, layer):
+        idx, path, gz = self._saved(tmp_path, layer)
+        got = SociIndex.load(path, blob_id=BLOB_ID, csize=len(gz))
+        assert len(got.checkpoints) == len(idx.checkpoints)
+        assert got.files == idx.files
+        assert got.uncompressed_size == idx.uncompressed_size
+        for a, b in zip(got.checkpoints, idx.checkpoints):
+            assert (a.uout, a.cin, a.bits, a.window, a.fresh) == (
+                b.uout, b.cin, b.bits, b.window, b.fresh
+            )
+
+    @pytest.mark.parametrize("mutation", ["truncate", "flip_payload",
+                                          "flip_header", "empty"])
+    def test_corruption_fails_loudly(self, tmp_path, layer, mutation):
+        _, path, gz = self._saved(tmp_path, layer)
+        raw = bytearray(open(path, "rb").read())
+        if mutation == "truncate":
+            raw = raw[: len(raw) // 2]
+        elif mutation == "flip_payload":
+            raw[len(raw) // 2] ^= 0xFF
+        elif mutation == "flip_header":
+            raw[0] ^= 0xFF
+        else:
+            raw = bytearray()
+        open(path, "wb").write(bytes(raw))
+        with pytest.raises(SociIndexError):
+            SociIndex.load(path, blob_id=BLOB_ID, csize=len(gz))
+
+    def test_stale_index_rejected(self, tmp_path, layer):
+        _, path, gz = self._saved(tmp_path, layer)
+        with pytest.raises(SociIndexError):
+            SociIndex.load(path, blob_id="cd" * 32)
+        with pytest.raises(SociIndexError):
+            # Re-pushed blob with different size: geometry is stale.
+            SociIndex.load(path, blob_id=BLOB_ID, csize=len(gz) + 1)
+
+    def test_corrupt_index_rebuilt_once_never_poisons(self, tmp_path, layer):
+        raw, gz, _ = layer
+        _, path, _ = self._saved(tmp_path, layer)
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(path, "wb").write(bytes(blob))
+        builds = []
+
+        def builder():
+            builds.append(1)
+            return gz
+
+        idx, outcome = load_or_build_index(
+            [str(tmp_path)], BLOB_ID, csize=len(gz), builder=builder,
+            stride=STRIDE,
+        )
+        assert outcome == "rebuilt" and len(builds) == 1
+        # The rebuilt artifact is immediately good: loaded, not rebuilt.
+        idx2, outcome2 = load_or_build_index(
+            [str(tmp_path)], BLOB_ID, csize=len(gz), builder=builder,
+        )
+        assert outcome2 == "loaded" and len(builds) == 1
+        reader = SociStreamReader(idx2, lambda o, s: gz[o : o + s])
+        assert reader.read_range(1000, 5000) == raw[1000:6000]
+
+    def test_missing_without_builder_degrades(self, tmp_path):
+        idx, outcome = load_or_build_index([str(tmp_path)], BLOB_ID, csize=1)
+        assert idx is None and outcome == "missing"
+
+
+# ---------------------------------------------------------------------------
+# Full stack: index over a CachedBlob (fetch scheduler underneath)
+# ---------------------------------------------------------------------------
+
+
+CONFIG_MATRIX = [
+    # (workers, merge_gap, readahead) incl. the 1-worker serial shape
+    (1, 0, 0),
+    (4, 0, 0),
+    (4, 64 << 10, 256 << 10),
+    (2, 128 << 10, 1 << 20),
+]
+
+
+def _cached_blob(tmp_path, gz, tag, workers, gap, ra, fetch=None):
+    from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+    from nydus_snapshotter_tpu.daemon.fetch_sched import FetchConfig
+
+    return CachedBlob(
+        os.path.join(str(tmp_path), tag),
+        BLOB_ID,
+        fetch or (lambda o, s: gz[o : o + s]),
+        blob_size=len(gz),
+        config=FetchConfig(fetch_workers=workers, merge_gap=gap, readahead=ra),
+    )
+
+
+class TestFullStackIdentity:
+    @pytest.mark.parametrize("workers,gap,ra", CONFIG_MATRIX)
+    def test_byte_identity_property(self, tmp_path, layer, workers, gap, ra):
+        raw, gz, contents = layer
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        cb = _cached_blob(tmp_path, gz, f"w{workers}g{gap}r{ra}", workers, gap, ra)
+        try:
+            reader = SociStreamReader(idx, cb.read_at)
+            with tarfile.open(fileobj=io.BytesIO(raw)) as tf:
+                for m in tf.getmembers()[::7]:  # every 7th file: fast + broad
+                    if not m.isreg():
+                        continue
+                    off, size = idx.files["/" + m.name]
+                    assert reader.read_range(off, size) == contents["/" + m.name]
+            rng = random.Random(workers)
+            for _ in range(10):
+                off = rng.randrange(0, len(raw) - 1)
+                size = rng.randrange(1, min(150_000, len(raw) - off))
+                assert reader.read_range(off, size) == raw[off : off + size]
+        finally:
+            cb.close()
+
+    def test_concurrent_readers_lock_free(self, tmp_path, layer):
+        raw, gz, _ = layer
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        cb = _cached_blob(tmp_path, gz, "conc", 4, 0, 0)
+        reader = SociStreamReader(idx, cb.read_at)
+        assert reader.concurrent  # BlobReader skips its serializing lock
+        errors = []
+
+        def worker(seed):
+            rng = random.Random(seed)
+            try:
+                for _ in range(12):
+                    off = rng.randrange(0, len(raw) - 1)
+                    size = rng.randrange(1, min(100_000, len(raw) - off))
+                    assert reader.read_range(off, size) == raw[off : off + size]
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        cb.close()
+        assert not errors, errors
+
+    def test_eviction_while_reading_indexed_layer(self, tmp_path, layer):
+        """A watermark eviction unlinking the blob's cache files (and the
+        index companion) under a live indexed reader must never produce
+        wrong bytes — the CachedBlob revalidates and re-fetches."""
+        raw, gz, _ = layer
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        cb = _cached_blob(tmp_path, gz, "evict", 2, 0, 0)
+        reader = SociStreamReader(idx, cb.read_at)
+        rng = random.Random(3)
+        for i in range(15):
+            if i % 5 == 2:
+                # Evict mid-run: exactly what cache/manager.py does.
+                for sfx in (".blob.data", ".chunk_map", ".soci.idx"):
+                    try:
+                        os.unlink(os.path.join(
+                            str(tmp_path), "evict", BLOB_ID + sfx))
+                    except FileNotFoundError:
+                        pass
+            off = rng.randrange(0, len(raw) - 1)
+            size = rng.randrange(1, min(100_000, len(raw) - off))
+            assert reader.read_range(off, size) == raw[off : off + size]
+        cb.close()
+
+    def test_blobreader_mounts_soci_stream(self, layer):
+        """BlobReader serves gzip-stream chunks through an injected
+        checkpoint reader (and without it, through the sequential one) —
+        byte-identically."""
+        from nydus_snapshotter_tpu.converter.convert import BlobReader
+        from nydus_snapshotter_tpu.converter.types import PackOption
+        from nydus_snapshotter_tpu.converter.zran import pack_gzip_layer
+
+        raw, gz, contents = layer
+        bs = pack_gzip_layer(gz, PackOption(chunk_size=0x10000, oci_ref=True),
+                             tar_bytes=raw)
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        read_at = lambda o, s: gz[o : o + s]  # noqa: E731
+        plain = BlobReader(bs, 0, read_at)
+        indexed = BlobReader(
+            bs, 0, read_at, gzip_stream=SociStreamReader(idx, read_at)
+        )
+        for rec in bs.chunks[:: max(1, len(bs.chunks) // 25)]:
+            assert indexed.chunk_data(rec) == plain.chunk_data(rec)
+
+
+# ---------------------------------------------------------------------------
+# Peer replication of the index artifact
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def peer_server(tmp_path):
+    from nydus_snapshotter_tpu.daemon import peer
+
+    export = peer.PeerExport()
+    server = peer.PeerChunkServer(export, pull_through=False)
+    sock = os.path.join(str(tmp_path), "peer.sock")
+    server.run(sock)
+    yield export, server, sock
+    server.stop()
+
+
+class TestPeerReplication:
+    def test_index_replicates_from_owner(self, tmp_path, layer, peer_server):
+        from nydus_snapshotter_tpu.daemon.peer import PeerClient
+
+        _, gz, _ = layer
+        export, _server, sock = peer_server
+        owner_dir = os.path.join(str(tmp_path), "owner")
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        path = index_path(owner_dir, BLOB_ID)
+        idx.save(path)
+        export.register_soci(BLOB_ID, path)
+
+        local_dir = os.path.join(str(tmp_path), "local")
+        os.makedirs(local_dir)
+        got, outcome = load_or_build_index(
+            [local_dir], BLOB_ID, csize=len(gz),
+            fetch_remote=lambda: PeerClient(sock).fetch_soci_index(BLOB_ID),
+        )
+        assert outcome == "replicated"
+        assert len(got.checkpoints) == len(idx.checkpoints)
+        # Adopted replica persisted: the next pod-local open just loads.
+        _, outcome2 = load_or_build_index([local_dir], BLOB_ID, csize=len(gz))
+        assert outcome2 == "loaded"
+
+    def test_corrupt_replica_falls_back_to_build(self, tmp_path, layer,
+                                                 peer_server):
+        from nydus_snapshotter_tpu.daemon.peer import PeerClient
+
+        raw, gz, _ = layer
+        export, _server, sock = peer_server
+        owner_dir = os.path.join(str(tmp_path), "owner")
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        path = index_path(owner_dir, BLOB_ID)
+        idx.save(path)
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF  # owner's artifact is corrupt
+        open(path, "wb").write(bytes(blob))
+        export.register_soci(BLOB_ID, path)
+
+        local_dir = os.path.join(str(tmp_path), "local")
+        os.makedirs(local_dir)
+        builds = []
+
+        def builder():
+            builds.append(1)
+            return gz
+
+        got, outcome = load_or_build_index(
+            [local_dir], BLOB_ID, csize=len(gz),
+            fetch_remote=lambda: PeerClient(sock).fetch_soci_index(BLOB_ID),
+            builder=builder, stride=STRIDE,
+        )
+        # The checksum rejects the poisoned replica; the local build wins
+        # and reads stay correct.
+        assert outcome == "built" and len(builds) == 1
+        reader = SociStreamReader(got, lambda o, s: gz[o : o + s])
+        assert reader.read_range(500, 4000) == raw[500:4500]
+
+    def test_peer_miss_walks_to_builder(self, tmp_path, layer, peer_server):
+        from nydus_snapshotter_tpu.daemon.peer import PeerClient
+
+        _, gz, _ = layer
+        _export, _server, sock = peer_server  # nothing registered
+        local_dir = os.path.join(str(tmp_path), "local")
+        os.makedirs(local_dir)
+        got, outcome = load_or_build_index(
+            [local_dir], BLOB_ID, csize=len(gz),
+            fetch_remote=lambda: PeerClient(sock).fetch_soci_index(BLOB_ID),
+            builder=lambda: gz, stride=STRIDE,
+        )
+        assert outcome == "built" and got is not None
+
+
+# ---------------------------------------------------------------------------
+# Chaos: soci.{index,resolve,fetch}
+# ---------------------------------------------------------------------------
+
+
+class TestChaos:
+    def test_index_site_fails_store_loudly(self, tmp_path, layer):
+        _, gz, _ = layer
+        with failpoint.injected("soci.index", "error(OSError)"):
+            with pytest.raises(OSError):
+                load_or_build_index([str(tmp_path)], BLOB_ID, csize=len(gz),
+                                    builder=lambda: gz)
+        # Disarmed: the same call succeeds (build + persist).
+        idx, outcome = load_or_build_index(
+            [str(tmp_path)], BLOB_ID, csize=len(gz), builder=lambda: gz,
+            stride=STRIDE,
+        )
+        assert idx is not None and outcome == "built"
+
+    def test_index_site_fails_build_at_prepare(self, layer):
+        _, gz, _ = layer
+        with failpoint.injected("soci.index", "error(OSError)"):
+            with pytest.raises(OSError):
+                build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+
+    def test_resolve_site_fails_read_never_wrong_bytes(self, tmp_path, layer):
+        raw, gz, _ = layer
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        reader = SociStreamReader(idx, lambda o, s: gz[o : o + s])
+        with failpoint.injected("soci.resolve", "error(OSError)*1"):
+            with pytest.raises(OSError):
+                reader.read_range(100, 100)
+        assert reader.read_range(100, 100) == raw[100:200]
+
+    def test_fetch_site_fails_read_then_recovers(self, tmp_path, layer):
+        raw, gz, _ = layer
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        cb = _cached_blob(tmp_path, gz, "chaos", 2, 0, 0)
+        reader = SociStreamReader(idx, cb.read_at)
+        with failpoint.injected("soci.fetch", "error(OSError)*1"):
+            with pytest.raises(OSError):
+                reader.read_range(0, 1000)
+        assert reader.read_range(0, 1000) == raw[:1000]
+        cb.close()
+
+    def test_daemon_store_chaos_degrades_to_sequential(self, tmp_path, layer):
+        """An armed soci.index site must not fail daemon reads: the
+        instance falls back to the sequential in-process reader."""
+        from nydus_snapshotter_tpu.converter.types import PackOption
+        from nydus_snapshotter_tpu.converter.zran import pack_gzip_layer
+        from nydus_snapshotter_tpu.daemon.server import _Instance
+
+        raw, gz, contents = layer
+        import hashlib
+
+        blob_hex = hashlib.sha256(gz).hexdigest()
+        bs = pack_gzip_layer(gz, PackOption(chunk_size=0x10000, oci_ref=True),
+                             tar_bytes=raw)
+        blob_dir = str(tmp_path)
+        with open(os.path.join(blob_dir, blob_hex), "wb") as f:
+            f.write(gz)
+        boot = os.path.join(blob_dir, "boot")
+        with open(boot, "wb") as f:
+            f.write(bs.to_bytes())
+        # Index present next to the blob, but the store is chaos-armed.
+        idx, _ = build_index_from_gzip(blob_hex, gz, stride=STRIDE)
+        idx.save(index_path(blob_dir, blob_hex))
+        path, want = next(iter(contents.items()))
+        with failpoint.injected("soci.index", "error(OSError)"):
+            inst = _Instance("/mnt/x", boot, "{}")
+            got = inst.read(path, 0, -1, blob_dir)
+            assert got == want  # degraded, correct
+            assert not inst._soci_by_index  # sequential fallback took over
+        inst.close()
+
+    def test_cache_manager_accounts_index_companion(self, tmp_path):
+        from nydus_snapshotter_tpu.cache.manager import CacheManager
+
+        mgr = CacheManager(str(tmp_path / "cache"))
+        for sfx in ("", ".blob.data", ".soci.idx"):
+            with open(os.path.join(mgr.cache_dir, "aa" * 32 + sfx), "wb") as f:
+                f.write(b"x" * 10)
+        assert mgr.cache_usage("aa" * 32).inodes == 3
+        mgr.remove_blob_cache("aa" * 32)
+        assert mgr.cache_usage("aa" * 32).inodes == 0
+
+
+# ---------------------------------------------------------------------------
+# End to end over the real gRPC snapshotter: claim, index, merge, read —
+# with zero conversion performed.
+# ---------------------------------------------------------------------------
+
+
+class TestSociOverGrpc:
+    def test_plain_gzip_layer_lazy_pull_merge_mount_read(self, tmp_path):
+        import grpc
+        import json  # noqa: F401
+
+        from nydus_snapshotter_tpu import constants as C
+        from nydus_snapshotter_tpu.api.client import SnapshotsClient
+        from nydus_snapshotter_tpu.api.service import serve
+        from nydus_snapshotter_tpu.cache.manager import CacheManager
+        from nydus_snapshotter_tpu.config.config import SnapshotterConfig
+        from nydus_snapshotter_tpu.config.daemonconfig import DaemonRuntimeConfig
+        from nydus_snapshotter_tpu.filesystem.fs import Filesystem
+        from nydus_snapshotter_tpu.manager.manager import Manager
+        from nydus_snapshotter_tpu.remote import transport
+        from nydus_snapshotter_tpu.snapshot.snapshotter import (
+            Snapshotter,
+            upper_path,
+        )
+        from nydus_snapshotter_tpu.soci import SociAdaptor, SociResolver
+        from nydus_snapshotter_tpu.store.database import Database
+        from tests.test_remote import FakeRegistry
+
+        raw, gz, contents = build_layer(n_files=30, seed=11)
+        registry = FakeRegistry(require_auth=False)
+        try:
+            digest = registry.add_blob(gz)
+            blob_hex = digest.split(":", 1)[1]
+            ref = f"{registry.host}/plain/img:latest"
+
+            root = str(tmp_path / "r")
+            os.makedirs(root, exist_ok=True)
+            cfg = SnapshotterConfig(root=root)
+            cfg.soci.enable = True
+            cfg.validate()
+            db = Database(cfg.database_path)
+            mgr = Manager(cfg, db, fs_driver=C.FS_DRIVER_FUSEDEV)
+            cache_mgr = CacheManager(cfg.cache_root)
+            fs = Filesystem(
+                managers={C.FS_DRIVER_FUSEDEV: mgr},
+                cache_mgr=cache_mgr,
+                root=cfg.root,
+                fs_driver=C.FS_DRIVER_FUSEDEV,
+                daemon_mode=C.DAEMON_MODE_SHARED,
+                daemon_config=DaemonRuntimeConfig.from_dict(
+                    {"device": {"backend": {"type": "localfs"}}},
+                    C.FS_DRIVER_FUSEDEV,
+                ),
+                soci_resolver=SociResolver(pool=transport.Pool(plain_http=True)),
+                soci_adaptor=SociAdaptor(
+                    lambda sid: upper_path(cfg.root, sid),
+                    cache_dir=cfg.cache_root,
+                    stride=STRIDE,
+                ),
+            )
+            fs.startup()
+            mgr.run_death_handler()
+            sn = Snapshotter(root=cfg.root, fs=fs)
+            sock = os.path.join(cfg.root, "grpc.sock")
+            server = serve(sn, sock)
+            client = SnapshotsClient(sock, timeout=30.0)
+            try:
+                chain = "sha256:soci-chain"
+                labels = {
+                    C.CRI_IMAGE_REF: ref,
+                    C.CRI_LAYER_DIGEST: digest,
+                    C.TARGET_SNAPSHOT_REF: chain,
+                }
+                before = snapshot_counters()  # adaptor-side (this process)
+                # containerd's extract-style Prepare of the PLAIN gzip
+                # data layer: the soci arm claims it ("already exists" =
+                # skip the tar download) and indexes on first pull.
+                with pytest.raises(grpc.RpcError) as exc_info:
+                    client.prepare("extract-soci-meta", "", labels=labels)
+                assert exc_info.value.code() == grpc.StatusCode.ALREADY_EXISTS
+                sid, info, _ = sn.ms.get_info(chain)
+                assert info.labels.get(C.SOCI_LAYER) == "true"
+
+                # container writable layer: merge (this is the background
+                # build's join point) -> image.boot -> rafs mount
+                ctr_key = "ctr-soci"
+                client.prepare(ctr_key, chain, labels={C.CRI_IMAGE_REF: ref})
+                converted = os.path.join(upper_path(cfg.root, sid), blob_hex)
+                assert os.path.exists(converted), "per-layer bootstrap missing"
+                merged = os.path.join(upper_path(cfg.root, sid), "image.boot")
+                assert os.path.exists(merged), "merged bootstrap missing"
+                mounts = client.mounts(ctr_key)
+                assert any(
+                    o for m in mounts for o in m.options
+                    if o.startswith("lowerdir=")
+                ), mounts
+
+                # ZERO CONVERSION: the first-pull artifacts are exactly
+                # the bootstrap + the checkpoint index; no RAFS blob was
+                # written anywhere (the registry blob stays the only
+                # data artifact, referenced by its own sha256).
+                from nydus_snapshotter_tpu.models.nydus_real import (
+                    load_any_bootstrap,
+                )
+
+                with open(converted, "rb") as f:
+                    layer_bs = load_any_bootstrap(f.read())
+                assert [b.blob_id for b in layer_bs.blobs] == [blob_hex]
+                idx_file = index_path(cfg.cache_root, blob_hex)
+                assert os.path.exists(idx_file), "persisted index missing"
+                upper_files = set(os.listdir(upper_path(cfg.root, sid)))
+                assert upper_files == {blob_hex, "image.boot"}, upper_files
+                cache_files = set(os.listdir(cfg.cache_root))
+                assert cache_files == {blob_hex + ".soci.idx"}, cache_files
+                assert (
+                    snapshot_counters()["index_built"] - before["index_built"]
+                    == 1
+                )
+
+                # The daemon serves file reads whose gzip ranges come out
+                # of the ORIGINAL blob, resumed at persisted checkpoints
+                # (stage it where the localfs blob_dir points — in a real
+                # deploy the registry backend fetches these ranges).
+                os.makedirs(fs.cache_mgr.cache_dir, exist_ok=True)
+                with open(os.path.join(fs.cache_mgr.cache_dir, blob_hex),
+                          "wb") as f:
+                    f.write(gz)
+                daemon = fs.get_shared_daemon(C.FS_DRIVER_FUSEDEV)
+                rafs = fs.instances.list()[0]
+                for name, want in list(contents.items())[::5]:
+                    got = daemon.client().read_file(
+                        f"/{rafs.snapshot_id}", name
+                    )
+                    assert got == want, name
+                # The shared daemon is its own PROCESS: its soci counters
+                # (served via the blobcache metrics endpoint) prove reads
+                # resumed at the persisted checkpoints, not from byte 0.
+                soci_stats = daemon.client().cache_metrics().get("soci", {})
+                assert soci_stats.get("index_loaded", 0) >= 1, soci_stats
+                assert soci_stats.get("read_bytes", 0) > 0, soci_stats
+            finally:
+                client.close()
+                server.stop(grace=None)
+                fs.teardown()
+                sn.close()
+                mgr.stop()
+        finally:
+            registry.close()

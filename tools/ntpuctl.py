@@ -10,6 +10,7 @@ that process alone.
     ntpuctl members                     # fleet member registry
     ntpuctl blobcache                   # lazy-read cache counters
     ntpuctl peers                       # peer chunk-tier stats
+    ntpuctl soci                        # seekable-OCI index/read counters
     ntpuctl dict                        # shared chunk-dict namespaces
     ntpuctl slo                         # objectives, budgets, breaches
     ntpuctl trace 5ce100000001          # one merged cross-process tree
@@ -193,6 +194,36 @@ def cmd_peers(args) -> int:
     return 0
 
 
+def cmd_soci(args) -> int:
+    """Seekable-OCI backend counters: a daemon apisock answers from its
+    blobcache endpoint's ``soci`` section; a peer server lists which
+    index artifacts it can replicate."""
+    direct = _get(args.sock, "/api/v1/metrics/blobcache", args.timeout)
+    if direct is not None and "soci" in direct:
+        s = direct["soci"]
+        amp = (
+            s["compressed_fetch_bytes"] / s["read_bytes"]
+            if s.get("read_bytes")
+            else None
+        )
+        human = "\n".join(f"{k}: {v}" for k, v in sorted(s.items()))
+        human += "\nfetch_amplification: " + (
+            f"{amp:.3f}x" if amp is not None else "-"
+        )
+        _emit(args, dict(s, fetch_amplification=amp), human)
+        return 0
+    stat = _get(args.sock, "/api/v1/peer/stat", args.timeout)
+    if stat is not None and "soci_indexes" in stat:
+        idxs = stat["soci_indexes"]
+        _emit(args, {"soci_indexes": idxs},
+              "replicable soci indexes:\n" + "\n".join(
+                  f"  {b[:16]}…" for b in idxs) if idxs
+              else "no replicable soci indexes")
+        return 0
+    raise CtlError("no soci counters on this socket — point --sock at a "
+                   "daemon apisock or a peer server")
+
+
 def cmd_dict(args) -> int:
     direct = _get(args.sock, "/api/v1/dict", args.timeout)
     if direct is not None:
@@ -370,6 +401,7 @@ def main(argv=None) -> int:
     sub.add_parser("members")
     sub.add_parser("blobcache")
     sub.add_parser("peers")
+    sub.add_parser("soci")
     sub.add_parser("dict")
     sub.add_parser("slo")
     tr = sub.add_parser("trace")
@@ -385,6 +417,7 @@ def main(argv=None) -> int:
         "members": cmd_members,
         "blobcache": cmd_blobcache,
         "peers": cmd_peers,
+        "soci": cmd_soci,
         "dict": cmd_dict,
         "slo": cmd_slo,
         "trace": cmd_trace,

@@ -1,0 +1,535 @@
+"""Seekable-OCI profile: gates the no-conversion lazy path end to end.
+
+Four phases, every gate abort-on-fail (the noisy-box discipline: paired
+best-rep ratios for anything wall-clock, plus a wall-noise-free analytic
+bound wherever the physics gives one):
+
+1. **identity** — every file of the layer, lazily read through the
+   persisted checkpoint index over the full CachedBlob/fetch-scheduler
+   stack, must be byte-identical to direct tar extraction, across a
+   worker/merge-gap/readahead config matrix including the 1-worker
+   serial shape.
+2. **index build** — one-pass build throughput (MiB/s of compressed
+   input) against the banked 65 MiB/s ``stargz_zran`` line
+   (BENCH r03+), gated by the paired in-process inflate bound: the
+   build IS one inflate pass plus window copies, so it must stay within
+   a constant factor of plain ``gzip.decompress`` measured in the same
+   rep.
+3. **cold start** — first-file-read latency curve at several depths
+   over a simulated latency+bandwidth registry: the indexed lazy read
+   must beat the full-pull path by BOTH the paired best-rep wall ratio
+   AND the analytic bytes-fetched/bandwidth bound (it fetches one
+   checkpoint span, not the blob). The RAFS-equivalent analytic
+   (file's bytes only — what a converted layer would fetch) is
+   reported alongside as the amplification reference.
+4. **storm** — N pods cold-read the whole UNCONVERTED image through the
+   peer tier (rendezvous-routed chunk serving + index replication: one
+   pod built the index, every other pod adopts it over the peer route):
+   origin egress must stay ≤ ``EGRESS_FACTOR`` × unique compressed
+   bytes, every pod byte-identical, all fetch memory under the
+   per-pod bounded budget, and ZERO conversion performed — asserted by
+   walking every artifact written: nothing but ``.blob.data`` /
+   ``.chunk_map`` / ``.soci.idx`` companions may exist (no RAFS blob).
+
+Usage: python tools/soci_profile.py [--pods 16] [--mib 8] [--reps 2]
+           [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import hashlib
+import io
+import json
+import os
+import random
+import shutil
+import sys
+import tarfile
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CHUNK = 64 << 10
+LATENCY_S = 0.002
+BANDWIDTH_MIBPS = 24.0
+EGRESS_FACTOR = 1.5
+POD_BUDGET_MIB = 8
+BANKED_ZRAN_MIBPS = 65.0  # BENCH r03+ stargz_zran line (1-core box)
+
+CONFIG_MATRIX = [
+    (1, 0, 0),  # the serial shape
+    (4, 0, 0),
+    (4, 64 << 10, 256 << 10),
+    (2, 128 << 10, 1 << 20),
+]
+
+
+def build_layer(mib: int, seed: int = 7):
+    """Container-shaped tar.gz: compressible text+binary mix."""
+    rng = random.Random(seed)
+    contents: dict[str, bytes] = {}
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:") as tf:
+        i = 0
+        while buf.tell() < mib << 20:
+            data = (b"shared lib text %06d " % i) * rng.randrange(80, 600) \
+                + rng.randbytes(rng.randrange(512, 8192))
+            name = f"usr/lib/pkg{i // 64:03d}/f{i:05d}.so"
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+            contents["/" + name] = data
+            i += 1
+    raw = buf.getvalue()
+    return raw, gzip.compress(raw, 6), contents
+
+
+class SimRegistry:
+    """Serialized-uplink origin (the cluster_storm_profile model): every
+    ranged GET pays latency plus queued pipe time, so aggregate egress
+    directly bounds aggregate wall — the analytic arm of every gate."""
+
+    def __init__(self, blob: bytes, latency_s: float, mibps: float):
+        self.blob = blob
+        self.latency_s = latency_s
+        self.byte_s = 1.0 / (mibps * (1 << 20))
+        self.egress = 0
+        self.calls = 0
+        self._lock = threading.Lock()
+        self._pipe_free_at = 0.0
+
+    def reset(self):
+        with self._lock:
+            self.egress = 0
+            self.calls = 0
+            self._pipe_free_at = 0.0
+
+    def fetch(self, off: int, size: int) -> bytes:
+        if off + size > len(self.blob):
+            raise OSError(f"range [{off}, {off + size}) past blob end")
+        now = time.perf_counter()
+        with self._lock:
+            self.egress += size
+            self.calls += 1
+            start = max(now, self._pipe_free_at)
+            self._pipe_free_at = start + size * self.byte_s
+            free_at = self._pipe_free_at
+        time.sleep(max(0.0, free_at - now) + self.latency_s)
+        return self.blob[off : off + size]
+
+
+def _phase_identity(workroot, gz, raw, contents, index, gates):
+    from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+    from nydus_snapshotter_tpu.daemon.fetch_sched import FetchConfig
+    from nydus_snapshotter_tpu.soci.blob import SociStreamReader
+
+    blob_id = hashlib.sha256(gz).hexdigest()
+    configs = []
+    for workers, gap, ra in CONFIG_MATRIX:
+        cb = CachedBlob(
+            os.path.join(workroot, f"id-w{workers}g{gap}r{ra}"),
+            blob_id,
+            lambda o, s: gz[o : o + s],
+            blob_size=len(gz),
+            config=FetchConfig(fetch_workers=workers, merge_gap=gap,
+                               readahead=ra),
+        )
+        try:
+            reader = SociStreamReader(index, cb.read_at)
+            bad = 0
+            for path, (off, size) in index.files.items():
+                if reader.read_range(off, size) != contents[path]:
+                    bad += 1
+            if bad:
+                gates.append(
+                    f"identity: {bad} files differ from tar extraction at "
+                    f"workers={workers} gap={gap} readahead={ra}"
+                )
+            configs.append({"workers": workers, "gap": gap, "readahead": ra,
+                            "files": len(index.files), "mismatches": bad})
+        finally:
+            cb.close()
+    return configs
+
+
+def _phase_build(gz, reps, stride, gates):
+    from nydus_snapshotter_tpu.soci.blob import build_index_from_gzip
+
+    build_walls, inflate_walls = [], []
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        gzip.decompress(gz)
+        inflate_walls.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        build_index_from_gzip("cd" * 32, gz, stride=stride)
+        build_walls.append(time.perf_counter() - t0)
+    mib = len(gz) / (1 << 20)
+    build_mibps = mib / min(build_walls)
+    inflate_mibps = mib / min(inflate_walls)
+    # Analytic bound: the build is one inflate pass + bounded window
+    # copies (32 KiB per stride of output) — it must stay within a
+    # constant factor of the bare inflate measured in the same process.
+    ratio = build_mibps / max(1e-9, inflate_mibps)
+    if ratio < 0.15:
+        gates.append(
+            f"index build {build_mibps:.1f} MiB/s is {ratio:.2f}x the "
+            f"paired bare-inflate rate {inflate_mibps:.1f} MiB/s (gate 0.15x)"
+        )
+    return {
+        "build_mibps": round(build_mibps, 1),
+        "paired_inflate_mibps": round(inflate_mibps, 1),
+        "build_vs_inflate": round(ratio, 3),
+        "banked_stargz_zran_mibps": BANKED_ZRAN_MIBPS,
+        "vs_banked_line": round(build_mibps / BANKED_ZRAN_MIBPS, 2),
+    }
+
+
+def _phase_cold_start(workroot, gz, raw, index, reps, gates):
+    from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+    from nydus_snapshotter_tpu.daemon.fetch_sched import FetchConfig
+    from nydus_snapshotter_tpu.soci.blob import SociStreamReader
+
+    blob_id = hashlib.sha256(gz).hexdigest()
+    registry = SimRegistry(gz, LATENCY_S, BANDWIDTH_MIBPS)
+    byfile = sorted(index.files.items(), key=lambda kv: kv[1][0])
+    depths = {
+        "25%": byfile[len(byfile) // 4],
+        "50%": byfile[len(byfile) // 2],
+        "75%": byfile[3 * len(byfile) // 4],
+        "tail": byfile[-1],
+    }
+    curve = {}
+    n = 0
+    for tag, (path, (off, size)) in depths.items():
+        soci_walls, full_walls = [], []
+        soci_fetched = 0
+        for r in range(max(1, reps)):
+            # Paired, interleaved: soci arm then full-pull arm per rep.
+            registry.reset()
+            cb = CachedBlob(
+                os.path.join(workroot, f"cold-{n}-{r}"),
+                blob_id,
+                registry.fetch,
+                blob_size=len(gz),
+                config=FetchConfig(fetch_workers=4, merge_gap=64 << 10,
+                                   readahead=0),
+            )
+            try:
+                reader = SociStreamReader(index, cb.read_at)
+                t0 = time.perf_counter()
+                got = reader.read_range(off, size)
+                soci_walls.append(time.perf_counter() - t0)
+                soci_fetched = registry.egress
+                if got != raw[off : off + size]:
+                    gates.append(f"cold-start {tag}: lazily-read bytes differ")
+            finally:
+                cb.close()
+            registry.reset()
+            t0 = time.perf_counter()
+            whole = bytearray()
+            pos = 0
+            while pos < len(gz):
+                step = min(1 << 20, len(gz) - pos)
+                whole += registry.fetch(pos, step)
+                pos += step
+            full = gzip.decompress(bytes(whole))
+            if full[off : off + size] != raw[off : off + size]:
+                gates.append(f"cold-start {tag}: full-pull bytes differ")
+            full_walls.append(time.perf_counter() - t0)
+        n += 1
+        measured_ratio = min(full_walls) / max(1e-9, min(soci_walls))
+        analytic_ratio = len(gz) / max(1, soci_fetched)
+        curve[tag] = {
+            "file": path,
+            "uoffset": off,
+            "bytes": size,
+            "soci_first_read_ms": round(min(soci_walls) * 1000, 1),
+            "full_pull_ms": round(min(full_walls) * 1000, 1),
+            "soci_fetched_bytes": soci_fetched,
+            "measured_speedup": round(measured_ratio, 2),
+            "analytic_bytes_ratio": round(analytic_ratio, 2),
+            # What a converted (RAFS) layer would fetch for this read:
+            # roughly the file's share of compressed bytes + one RTT.
+            "rafs_equiv_ms": round(
+                (size * len(gz) / len(raw) / (BANDWIDTH_MIBPS * (1 << 20))
+                 + LATENCY_S) * 1000, 1),
+        }
+        if measured_ratio <= 1.0:
+            gates.append(
+                f"cold-start {tag}: indexed first read "
+                f"{curve[tag]['soci_first_read_ms']}ms did not beat full "
+                f"pull {curve[tag]['full_pull_ms']}ms (paired best-rep)"
+            )
+        if analytic_ratio <= 1.0:
+            gates.append(
+                f"cold-start {tag}: fetched {soci_fetched} bytes >= the "
+                f"whole {len(gz)}-byte blob — no bytes-fetched advantage"
+            )
+    return curve
+
+
+class _BudgetProbe(threading.Thread):
+    """Samples a MemoryBudget's held bytes; the storm's bounded-memory
+    evidence (Bounded-Memory Parallel Image Pulling discipline)."""
+
+    def __init__(self, budgets):
+        super().__init__(daemon=True)
+        self.budgets = budgets
+        self.peak = 0
+        self._halt = threading.Event()  # NB: Thread owns a private _stop
+
+    def run(self):
+        while not self._halt.is_set():
+            held = max((b.held for b in self.budgets), default=0)
+            self.peak = max(self.peak, held)
+            time.sleep(0.005)
+
+    def stop(self):
+        self._halt.set()
+        self.join()
+
+
+def _phase_storm(workroot, gz, raw, index, pods, gates):
+    from nydus_snapshotter_tpu.daemon import peer
+    from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+    from nydus_snapshotter_tpu.daemon.fetch_sched import (
+        AdmissionGate,
+        FetchConfig,
+        MemoryBudget,
+    )
+    from nydus_snapshotter_tpu.remote.mirror import HostHealthRegistry
+    from nydus_snapshotter_tpu.soci.blob import SociStreamReader
+    from nydus_snapshotter_tpu.soci.index import SociIndex, index_path
+
+    blob_id = hashlib.sha256(gz).hexdigest()
+    registry = SimRegistry(gz, LATENCY_S, BANDWIDTH_MIBPS)
+    health = HostHealthRegistry()
+    sockdir = tempfile.mkdtemp(prefix="soci-storm-", dir="/tmp")
+    addrs = [os.path.join(sockdir, f"p{i}.sock") for i in range(pods)]
+    oracle = hashlib.sha256(raw).hexdigest()
+
+    # Pod 0 is the cluster's FIRST PULL: it owns the only built index and
+    # announces it; every other pod replicates over the peer route.
+    storm_root = os.path.join(workroot, "storm")
+    os.makedirs(storm_root, exist_ok=True)
+    pod0_dir = os.path.join(storm_root, "pod0")
+    os.makedirs(pod0_dir)
+    index.save(index_path(pod0_dir, blob_id))
+
+    budgets, nodes, exports = [], [], []
+    for i in range(pods):
+        budget = MemoryBudget(POD_BUDGET_MIB << 20)
+        budgets.append(budget)
+        gate = AdmissionGate(budget=budget, max_concurrent=8,
+                             demand_reserve=1, name=f"soci-pod{i}")
+        router = peer.PeerRouter(addrs, self_address=addrs[i],
+                                 region_bytes=CHUNK, health_registry=health)
+        fetch = peer.PeerAwareFetcher(blob_id, registry.fetch, router,
+                                      timeout_s=10.0).read_range
+        cb = CachedBlob(
+            os.path.join(storm_root, f"pod{i}"),
+            blob_id,
+            fetch,
+            blob_size=len(gz),
+            config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0),
+            gate=gate,
+            tenant=f"pod{i}",
+        )
+        export = peer.PeerExport()
+        export.register(blob_id, cb)
+        if i == 0:
+            export.register_soci(blob_id, index_path(pod0_dir, blob_id))
+        server = peer.PeerChunkServer(export, gate=gate, pull_through=True)
+        server.run(addrs[i])
+        nodes.append((cb, server, router))
+        exports.append(export)
+
+    probe = _BudgetProbe(budgets)
+    probe.start()
+    digests = [None] * pods
+    replicated = [0] * pods
+    errors: list[str] = []
+
+    def run_pod(i):
+        from nydus_snapshotter_tpu.soci.blob import load_or_build_index
+
+        cb, _server, router = nodes[i]
+        try:
+            pod_dir = os.path.join(storm_root, f"pod{i}")
+            if i == 0:
+                idx = SociIndex.load(index_path(pod0_dir, blob_id),
+                                     blob_id=blob_id, csize=len(gz))
+            else:
+                # Index replication: ask the announce map's owner (pod 0
+                # registered it; rendezvous routing would find it within
+                # a hop in a real fleet — here every pod lists pod 0).
+                idx, outcome = load_or_build_index(
+                    [pod_dir], blob_id, csize=len(gz),
+                    fetch_remote=lambda: peer.PeerClient(
+                        addrs[0], timeout_s=10.0
+                    ).fetch_soci_index(blob_id),
+                )
+                if outcome == "replicated":
+                    replicated[i] = 1
+                if idx is None:
+                    raise AssertionError(f"pod{i}: no index obtainable")
+                exports[i].register_soci(
+                    blob_id, index_path(pod_dir, blob_id))
+            reader = SociStreamReader(idx, cb.read_at)
+            h = hashlib.sha256()
+            for off in range(0, idx.uncompressed_size, CHUNK):
+                h.update(reader.read_range(
+                    off, min(CHUNK, idx.uncompressed_size - off)))
+            digests[i] = h.hexdigest()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(f"pod{i}: {e!r}")
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=run_pod, args=(i,)) for i in range(pods)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    probe.stop()
+    for cb, server, _router in nodes:
+        server.stop()
+        cb.close()
+    shutil.rmtree(sockdir, ignore_errors=True)
+
+    if errors:
+        gates.append(f"storm pod failures: {errors[:4]}")
+    if any(d != oracle for d in digests):
+        gates.append("storm: pod bytes differ from direct tar content")
+    egress_ratio = registry.egress / len(gz)
+    if egress_ratio > EGRESS_FACTOR:
+        gates.append(
+            f"storm origin egress {egress_ratio:.2f}x unique compressed "
+            f"bytes (gate {EGRESS_FACTOR}x at {pods} pods)"
+        )
+    if pods > 1 and sum(replicated) != pods - 1:
+        gates.append(
+            f"index replication: {sum(replicated)}/{pods - 1} pods adopted "
+            "the first-pull index over the peer tier"
+        )
+    if probe.peak > POD_BUDGET_MIB << 20:
+        gates.append(
+            f"storm in-flight bytes {probe.peak} exceeded the per-pod "
+            f"{POD_BUDGET_MIB} MiB bounded budget"
+        )
+    # ZERO CONVERSION: walk every artifact the storm wrote. Anything
+    # other than the original-blob cache companions + the replicated
+    # index would be a conversion output (a RAFS blob/bootstrap).
+    allowed = (".blob.data", ".chunk_map", ".soci.idx")
+    alien = []
+    for dirpath, _dirnames, filenames in os.walk(storm_root):
+        for fn in filenames:
+            if not fn.endswith(allowed):
+                alien.append(os.path.join(dirpath, fn))
+    if alien:
+        gates.append(f"conversion artifacts written during storm: {alien[:5]}")
+    return {
+        "pods": pods,
+        "wall_s": round(wall, 3),
+        "origin_egress_bytes": registry.egress,
+        "origin_calls": registry.calls,
+        "egress_ratio": round(egress_ratio, 3),
+        "indexes_replicated": sum(replicated),
+        "budget_mib": POD_BUDGET_MIB,
+        "peak_inflight_bytes": probe.peak,
+        "no_rafs_blob_written": not alien,
+    }
+
+
+def profile(pods: int = 16, mib: int = 8, reps: int = 2, seed: int = 7) -> dict:
+    from nydus_snapshotter_tpu.soci import zran
+    from nydus_snapshotter_tpu.soci.blob import build_index_from_gzip
+
+    if not zran.available():
+        return {"error": "system libz with inflatePrime unavailable",
+                "gates_failed": ["zran unavailable on this host"]}
+    gates: list[str] = []
+    raw, gz, contents = build_layer(mib, seed)
+    stride = 256 << 10
+    index, tar_bytes = build_index_from_gzip(
+        hashlib.sha256(gz).hexdigest(), gz, stride=stride
+    )
+    if tar_bytes != raw:
+        gates.append("index build pass decompressed bytes != source tar")
+
+    workroot = tempfile.mkdtemp(prefix="soci-prof-")
+    try:
+        identity = _phase_identity(workroot, gz, raw, contents, index, gates)
+        build = _phase_build(gz, reps, stride, gates)
+        cold = _phase_cold_start(workroot, gz, raw, index, reps, gates)
+        storm = _phase_storm(workroot, gz, raw, index, pods, gates)
+        leaked = [
+            t.name for t in threading.enumerate()
+            if t.name.startswith(("ntpu-fetch", "ntpu-peer"))
+        ]
+        if leaked:
+            gates.append(f"leaked threads: {leaked}")
+        return {
+            "layer_mib": round(len(raw) / (1 << 20), 2),
+            "gzip_mib": round(len(gz) / (1 << 20), 2),
+            "files": len(contents),
+            "stride_kib": stride >> 10,
+            "checkpoints": len(index.checkpoints),
+            "index_bytes": len(index.to_bytes()),
+            "identity": identity,
+            "index_build": build,
+            "cold_start": cold,
+            "storm": storm,
+            "gates_failed": gates,
+        }
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=16, help="storm pod count")
+    ap.add_argument("--mib", type=int, default=8, help="decompressed layer size")
+    ap.add_argument("--reps", type=int, default=2, help="paired reps per arm")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    report = profile(pods=args.pods, mib=args.mib, reps=args.reps)
+    if args.json:
+        print(json.dumps(report))
+    elif "error" not in report:
+        b = report["index_build"]
+        print(
+            f"index build: {b['build_mibps']} MiB/s "
+            f"({b['build_vs_inflate']}x paired inflate, "
+            f"{b['vs_banked_line']}x the banked {BANKED_ZRAN_MIBPS} MiB/s "
+            f"zran line); {report['checkpoints']} checkpoints, "
+            f"{report['index_bytes']} index bytes"
+        )
+        for tag, c in report["cold_start"].items():
+            print(
+                f"cold {tag}: soci {c['soci_first_read_ms']}ms vs full pull "
+                f"{c['full_pull_ms']}ms ({c['measured_speedup']}x measured, "
+                f"{c['analytic_bytes_ratio']}x bytes bound, rafs-equiv "
+                f"{c['rafs_equiv_ms']}ms)"
+            )
+        s = report["storm"]
+        print(
+            f"storm({s['pods']} pods): egress {s['egress_ratio']}x unique "
+            f"compressed bytes, {s['indexes_replicated']} indexes "
+            f"replicated, peak inflight {s['peak_inflight_bytes']}B "
+            f"(budget {s['budget_mib']} MiB/pod), no_rafs_blob_written="
+            f"{s['no_rafs_blob_written']}"
+        )
+    for g in report["gates_failed"]:
+        print(f"FAIL: {g}", file=sys.stderr)
+    return 1 if report["gates_failed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
