@@ -373,10 +373,31 @@ def main(argv=None) -> int:
     if cfg.peer.enable:
         from nydus_snapshotter_tpu.daemon import peer as peer_mod
 
+        # Dynamic membership reaches spawned daemons the same way every
+        # peer knob does — via the environment (the controller address is
+        # already in NTPU_FLEET_CONTROLLER when [fleet] is on).
+        os.environ.setdefault("NTPU_PEER_MEMBERSHIP", cfg.peer.membership)
+        os.environ.setdefault(
+            "NTPU_PEER_MEMBERSHIP_REFRESH_MS",
+            str(int(cfg.peer.membership_refresh_secs * 1000)),
+        )
         peer_server = peer_mod.start_from_config()
         peer_mod.default_router()
         if peer_server is not None:
             logger.info("peer chunk server on %s", peer_server.address)
+    # SLO actuation (metrics/slo.py): the controller's fleet plane sheds
+    # QoS lanes on burn-rate breach; spawned daemons follow the published
+    # state when [slo] actuate+follow are on (env is their config path).
+    if cfg.slo.actuate:
+        os.environ.setdefault("NTPU_SLO_ACTUATE", "1")
+        os.environ.setdefault("NTPU_SLO_FOLLOW", "1" if cfg.slo.follow else "0")
+        if cfg.slo.shed_lanes:
+            os.environ.setdefault(
+                "NTPU_SLO_SHED_LANES", ",".join(cfg.slo.shed_lanes)
+            )
+        os.environ.setdefault(
+            "NTPU_SLO_RESTORE_BURN", str(cfg.slo.restore_burn)
+        )
     # Seekable-OCI backend (soci/): the spawned daemon process resolves
     # the section from the NTPU_SOCI* environment, like every blobcache
     # knob — export it so daemons mount checkpoint-indexed readers and

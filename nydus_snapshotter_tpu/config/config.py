@@ -240,6 +240,14 @@ class PeerConfig:
     max_concurrent: int = 0
     demand_reserve: int = 1
     tenant_weights: dict[str, float] = field(default_factory=dict)
+    # Dynamic membership (daemon/peer.PeerMembership): "fleet" discovers
+    # the live peer set from the member registry (the static ``peers``
+    # list stays as the seed/fallback), "static" pins the pre-dynamic
+    # behavior, "auto" (default) goes dynamic exactly when a fleet
+    # controller address is known to this process. Env overrides:
+    # ``NTPU_PEER_MEMBERSHIP``, ``NTPU_PEER_MEMBERSHIP_REFRESH_MS``.
+    membership: str = "auto"
+    membership_refresh_secs: float = 2.0
 
 
 @dataclass
@@ -399,6 +407,20 @@ class SloConfig:
     enable: bool = False
     eval_interval_secs: float = 10.0
     objectives: list[dict] = field(default_factory=list)
+    # Close the loop (metrics/slo.SloActuator): with ``actuate`` on, a
+    # multi-window breach sheds one more lane from ``shed_lanes`` per
+    # evaluation tick (least-important first; the demand lane is not
+    # sheddable) on the controller's admission gate, and member processes
+    # following the published state (``follow``, applied by spawned
+    # daemons) shed the same lanes on theirs. Lanes restore one per tick
+    # once every objective's short-window burn drops under
+    # ``restore_burn``. Env overrides: ``NTPU_SLO_ACTUATE``,
+    # ``NTPU_SLO_SHED_LANES``, ``NTPU_SLO_RESTORE_BURN``,
+    # ``NTPU_SLO_FOLLOW``.
+    actuate: bool = False
+    shed_lanes: list[str] = field(default_factory=list)
+    restore_burn: float = 1.0
+    follow: bool = True
 
 
 @dataclass
@@ -589,6 +611,13 @@ class SnapshotterConfig:
             )
         if any(w <= 0 for w in self.peer.tenant_weights.values()):
             raise ConfigError("peer.tenant_weights must all be positive")
+        if self.peer.membership not in ("auto", "static", "fleet"):
+            raise ConfigError(
+                f"invalid peer.membership {self.peer.membership!r} "
+                "(auto | static | fleet)"
+            )
+        if self.peer.membership_refresh_secs <= 0:
+            raise ConfigError("peer.membership_refresh_secs must be positive")
         if self.soci.stride_kib < 64:
             # Checkpoints below one deflate window apart are pure index
             # bloat: the window alone is 32 KiB.
@@ -623,6 +652,14 @@ class SnapshotterConfig:
             not isinstance(o, dict) for o in self.slo.objectives
         ):
             raise ConfigError("slo.objectives must be an array of tables")
+        if not isinstance(self.slo.shed_lanes, list) or any(
+            not isinstance(s, str) for s in self.slo.shed_lanes
+        ):
+            raise ConfigError("slo.shed_lanes must be an array of lane names")
+        if "demand" in self.slo.shed_lanes:
+            raise ConfigError("slo.shed_lanes: the demand lane is not sheddable")
+        if self.slo.restore_burn < 0:
+            raise ConfigError("slo.restore_burn must be >= 0")
         if self.mesh.pack not in ("extent", "replicated"):
             raise ConfigError(
                 f"invalid mesh.pack {self.mesh.pack!r} (extent | replicated)"

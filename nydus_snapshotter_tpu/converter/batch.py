@@ -239,8 +239,15 @@ class BatchConverter:
                     "seeds through the service (merge the seed bootstrap "
                     "into the namespace instead)"
                 )
+            # Comma-separated addresses = a rendezvous-sharded namespace
+            # (one DictService process per shard); one address keeps the
+            # single-service path byte-for-byte.
             self.dict = dict_service_mod.ServiceChunkDict(
-                dict_service_mod.DictClient(service),
+                [
+                    dict_service_mod.DictClient(s.strip())
+                    for s in service.split(",")
+                    if s.strip()
+                ],
                 self.namespace,
             )
             if self.codec is not None and self.codec.trained is None:

@@ -403,7 +403,22 @@ class CachedBlob:
                 f.wait()
             errors = [f.error for f in flights if f.error is not None]
             if errors:
-                raise errors[0]
+                hard = [
+                    e for e in errors
+                    if not isinstance(e, fetch_sched.LaneShedError)
+                ]
+                if hard:
+                    raise hard[0]
+                if lane != DEMAND:
+                    # This read's own lane is shed: degrade like any other
+                    # background failure (prefetch warms nothing, a peer
+                    # requester falls back to the registry).
+                    raise errors[0]
+                # A demand read piggybacked on a background flight that SLO
+                # actuation shed: replan — the while loop re-plans the
+                # still-uncovered extent at DEMAND priority, which is never
+                # shed, so actuation cannot fail or starve a real read.
+                continue
             with self._lock:
                 if self._closed:
                     raise OSError(f"blob cache {self.data_path} is closed")

@@ -182,6 +182,29 @@ ADMIT_TENANT_BYTES = _reg.register(
         ("tenant",),
     )
 )
+ADMIT_SHED = _reg.register(
+    _metrics.Counter(
+        "ntpu_admission_shed_total",
+        "Operations rejected because their lane was shed by SLO actuation",
+        ("lane",),
+    )
+)
+ADMIT_LANE_CAP = _reg.register(
+    _metrics.Gauge(
+        "ntpu_admission_lane_cap",
+        "Current per-lane concurrency cap (-1 = unlimited, 0 = lane shed)",
+        ("lane",),
+    )
+)
+
+
+class LaneShedError(OSError):
+    """The operation's QoS lane is currently shed by SLO actuation.
+
+    Non-demand callers degrade exactly as they do on any other transient
+    failure: a shed readahead/prefetch flight is replanned at demand
+    priority only when a real read needs the bytes, a shed peer-serve
+    request makes the requester fall back to the registry."""
 
 
 def snapshot_counters() -> dict:
@@ -514,9 +537,46 @@ class AdmissionGate:
         self._tenant_bytes: dict[str, int] = {}
         self._tenant_service: dict[str, int] = {}
         self._admitted = [0] * N_LANES
+        # SLO actuation state: per-lane concurrency caps (None = unlimited,
+        # 0 = lane shed — new acquires raise LaneShedError immediately).
+        # The demand lane is never cappable: actuation protects demand by
+        # construction, it must not be able to starve it.
+        self._lane_caps: list[Optional[int]] = [None] * N_LANES
+        self._lane_in_service = [0] * N_LANES
+        self._shed_total = [0] * N_LANES
 
     def weight(self, tenant: str) -> float:
         return max(1e-9, float(self.weights.get(tenant, 1.0)))
+
+    # -- SLO actuation --------------------------------------------------------
+
+    def set_lane_cap(self, lane: int, cap: Optional[int]) -> None:
+        """Actuate one lane: ``None`` restores it, ``0`` sheds it (new
+        acquires fail fast with :class:`LaneShedError`), ``k > 0`` bounds
+        its in-service operations. The DEMAND lane cannot be actuated."""
+        lane = int(lane)
+        if lane == DEMAND or not 0 < lane < N_LANES:
+            raise ValueError(f"lane {lane} is not actuatable")
+        with self._cv:
+            self._state_shared.write()
+            self._lane_caps[lane] = None if cap is None else max(0, int(cap))
+            self._cv.notify_all()
+        ADMIT_LANE_CAP.labels(LANE_NAMES[lane]).set(
+            -1 if cap is None else max(0, int(cap))
+        )
+
+    def lane_state(self) -> dict:
+        """{lane: {cap, in_service, shed_total}} actuation view."""
+        with self._cv:
+            self._state_shared.read()
+            return {
+                LANE_NAMES[i]: {
+                    "cap": self._lane_caps[i],
+                    "in_service": self._lane_in_service[i],
+                    "shed_total": self._shed_total[i],
+                }
+                for i in range(N_LANES)
+            }
 
     # -- admission predicate (caller holds self._cv) -------------------------
 
@@ -527,6 +587,9 @@ class AdmissionGate:
         if t.lane != DEMAND and self._in_service >= (
             self.max_concurrent - self.demand_reserve
         ):
+            return False
+        cap = self._lane_caps[t.lane]
+        if cap is not None and self._lane_in_service[t.lane] >= cap:
             return False
         return self._held == 0 or self._held + t.n <= self.cap
 
@@ -562,6 +625,15 @@ class AdmissionGate:
         t0 = perf_counter()
         with self._cv:
             self._state_shared.write()
+            if self._lane_caps[lane] == 0:
+                # Lane shed by SLO actuation: fail fast instead of queueing
+                # — background callers degrade, peer requesters fall back.
+                self._shed_total[lane] += 1
+                ADMIT_SHED.labels(LANE_NAMES[lane]).inc()
+                raise LaneShedError(
+                    f"admission gate {self.name!r}: lane "
+                    f"{LANE_NAMES[lane]} is shed"
+                )
             self._seq += 1
             t = _Ticket(tenant, lane, n, self._seq)
             self._waiters.append(t)
@@ -570,6 +642,14 @@ class AdmissionGate:
             )
             try:
                 while not self._admissible(t):
+                    if self._lane_caps[lane] == 0:
+                        # Shed while queued: same fail-fast contract.
+                        self._shed_total[lane] += 1
+                        ADMIT_SHED.labels(LANE_NAMES[lane]).inc()
+                        raise LaneShedError(
+                            f"admission gate {self.name!r}: lane "
+                            f"{LANE_NAMES[lane]} is shed"
+                        )
                     if aborted is not None and aborted():
                         raise OSError(
                             f"admission gate {self.name!r} wait aborted"
@@ -577,6 +657,7 @@ class AdmissionGate:
                     # Short poll: an aborted() flip has no notifier.
                     self._cv.wait(0.05)
                 self._in_service += 1
+                self._lane_in_service[lane] += 1
                 self._held += n
                 self._tenant_bytes[tenant] = self._tenant_bytes.get(tenant, 0) + n
                 self._tenant_service[tenant] = (
@@ -604,6 +685,9 @@ class AdmissionGate:
             with self._cv:
                 self._state_shared.write()
                 self._in_service -= 1
+                self._lane_in_service[lane] = max(
+                    0, self._lane_in_service[lane] - 1
+                )
                 self._held -= n
                 self._tenant_bytes[tenant] = max(
                     0, self._tenant_bytes.get(tenant, 0) - n
@@ -612,12 +696,16 @@ class AdmissionGate:
             raise
         return waited
 
-    def release(self, n: int, tenant: str = DEFAULT_TENANT) -> None:
+    def release(
+        self, n: int, tenant: str = DEFAULT_TENANT, lane: int = DEMAND
+    ) -> None:
         n = max(0, int(n))
+        lane = min(max(0, int(lane)), N_LANES - 1)
         self.budget.release(n)
         with self._cv:
             self._state_shared.write()
             self._in_service = max(0, self._in_service - 1)
+            self._lane_in_service[lane] = max(0, self._lane_in_service[lane] - 1)
             self._held = max(0, self._held - n)
             self._tenant_bytes[tenant] = max(
                 0, self._tenant_bytes.get(tenant, 0) - n
@@ -639,6 +727,11 @@ class AdmissionGate:
                 "admitted_per_lane": dict(
                     zip(LANE_NAMES, self._admitted)
                 ),
+                "lane_caps": dict(zip(LANE_NAMES, self._lane_caps)),
+                "lane_in_service": dict(
+                    zip(LANE_NAMES, self._lane_in_service)
+                ),
+                "shed_per_lane": dict(zip(LANE_NAMES, self._shed_total)),
                 "tenant_inflight_bytes": dict(self._tenant_bytes),
                 "tenant_service_bytes": dict(self._tenant_service),
             }
@@ -890,7 +983,9 @@ class FetchScheduler:
                 sp.annotate(error=repr(flight.error))
             finally:
                 if acquired:
-                    self.gate.release(n, tenant=self.tenant)
+                    self.gate.release(
+                        n, tenant=self.tenant, lane=flight.priority
+                    )
                     INFLIGHT_BYTES.set(self.budget.held)
                 with self._cv:
                     self._flights_shared.write()

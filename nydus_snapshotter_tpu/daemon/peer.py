@@ -112,6 +112,26 @@ SERVE_MS = _reg.register(
         ("outcome",),
     )
 )
+MEMBERSHIP_EPOCH = _reg.register(
+    _metrics.Gauge(
+        "ntpu_peer_membership_epoch",
+        "Region-ownership epoch: bumps whenever the live peer set changes",
+    )
+)
+MEMBERSHIP_PEERS = _reg.register(
+    _metrics.Gauge(
+        "ntpu_peer_membership_peers",
+        "Peers in the current live membership view (incl. this node)",
+    )
+)
+MEMBERSHIP_EVENTS = _reg.register(
+    _metrics.Counter(
+        "ntpu_peer_membership_events_total",
+        "Peer membership transitions observed, by kind"
+        " (join / leave / down / refresh_error)",
+        ("kind",),
+    )
+)
 
 
 def snapshot_counters() -> dict:
@@ -149,17 +169,22 @@ class PeerRuntimeConfig:
 
     __slots__ = (
         "enable", "listen", "peers", "region_bytes", "timeout_s",
-        "pull_through",
+        "pull_through", "membership", "membership_refresh_s",
     )
 
     def __init__(self, enable, listen, peers, region_bytes, timeout_s,
-                 pull_through):
+                 pull_through, membership="auto", membership_refresh_s=2.0):
         self.enable = enable
         self.listen = listen
         self.peers = peers
         self.region_bytes = region_bytes
         self.timeout_s = timeout_s
         self.pull_through = pull_through
+        # "static" = the [peer] list only; "fleet" = the member registry
+        # (seeded by the list); "auto" = fleet when a controller address
+        # is known, static otherwise.
+        self.membership = membership
+        self.membership_refresh_s = membership_refresh_s
 
 
 def _env_bool(name: str, default: bool) -> bool:
@@ -196,6 +221,10 @@ def resolve_peer_config() -> PeerRuntimeConfig:
         "NTPU_PEER_TIMEOUT_MS",
         getattr(pc, "timeout_ms", 0) or DEFAULT_TIMEOUT_MS,
     )
+    refresh_ms = fetch_sched._env_int(
+        "NTPU_PEER_MEMBERSHIP_REFRESH_MS",
+        int(float(getattr(pc, "membership_refresh_secs", 0) or 2.0) * 1000),
+    )
     return PeerRuntimeConfig(
         enable=_env_bool("NTPU_PEER_ENABLE", bool(getattr(pc, "enable", False))),
         listen=os.environ.get("NTPU_PEER_LISTEN", getattr(pc, "listen", "")),
@@ -205,6 +234,10 @@ def resolve_peer_config() -> PeerRuntimeConfig:
         pull_through=_env_bool(
             "NTPU_PEER_PULL_THROUGH", bool(getattr(pc, "pull_through", True))
         ),
+        membership=os.environ.get(
+            "NTPU_PEER_MEMBERSHIP", getattr(pc, "membership", "auto") or "auto"
+        ),
+        membership_refresh_s=max(0.05, refresh_ms / 1000.0),
     )
 
 
@@ -339,6 +372,7 @@ class PeerChunkServer:
         gate=None,
         pull_through: Optional[bool] = None,
         tenant: str = "peer",
+        router: Optional["PeerRouter"] = None,
     ):
         cfg = resolve_peer_config()
         self.export = export
@@ -347,6 +381,9 @@ class PeerChunkServer:
             cfg.pull_through if pull_through is None else pull_through
         )
         self.tenant = tenant
+        # Introspection only: the stat route surfaces this node's dynamic
+        # membership view + admission actuation state (ntpuctl peers).
+        self.router = router
         self._httpd = None
         self._closed = False
         self.address = ""
@@ -357,7 +394,11 @@ class PeerChunkServer:
         """(method, path?query, headers) -> (status, extra headers, body)."""
         parsed = urlparse(path)
         if parsed.path == _STAT_ROUTE:
-            body = json.dumps(self.export.stats()).encode()
+            stat = self.export.stats()
+            stat["admission"] = self.gate.lane_state()
+            if self.router is not None and self.router.membership is not None:
+                stat["membership"] = self.router.membership.snapshot()
+            body = json.dumps(stat).encode()
             return 200, {"Content-Type": "application/json"}, body
         if parsed.path == "/api/v1/traces":
             # A standalone peer server is a fleet member: its process's
@@ -437,7 +478,9 @@ class PeerChunkServer:
                         try:
                             data = cb.read_at(offset, size, lane=PEER_SERVE)
                         finally:
-                            self.gate.release(size, tenant=self.tenant)
+                            self.gate.release(
+                                size, tenant=self.tenant, lane=PEER_SERVE
+                            )
                     else:
                         # Pull-through: this node is the region owner —
                         # fetch once through the local CachedBlob (its
@@ -644,19 +687,187 @@ class PeerClient:
 
 
 # ---------------------------------------------------------------------------
+# Dynamic membership: the fleet registry as the peer discovery source
+# ---------------------------------------------------------------------------
+
+
+class PeerMembership:
+    """Live peer-address view driven by the fleet member registry.
+
+    The static ``[peer] peers`` list is kept as the SEED: it is the
+    membership whenever the registry is unreachable or empty (fresh
+    cluster, controller restarting), so a config-only deployment keeps
+    working unchanged. With a reachable controller, the registry IS the
+    membership — peers joining (self-registering) and leaving
+    (deregistering) re-shape rendezvous region ownership without a config
+    edit, and members the fleet plane flags down/stale are pushed onto
+    the shared :class:`~nydus_snapshotter_tpu.remote.mirror.
+    HostHealthRegistry` cooldown so routing walks past them immediately.
+
+    ``fetch`` returns ``[{"address", "up", "stale"}, ...]`` rows; the
+    default implementation pulls the controller's
+    ``/api/v1/fleet/peers`` route. Refreshes are rate-limited to
+    ``refresh_secs`` and serialized (concurrent callers reuse the cached
+    view); a failing refresh keeps the last-good membership — discovery
+    outages degrade to a stale view, never to an empty cluster.
+    """
+
+    def __init__(
+        self,
+        seed: Optional[list] = None,
+        controller: str = "",
+        fetch=None,
+        refresh_secs: float = 2.0,
+        clock=None,
+        health_registry=None,
+        stale_cooldown: float = PEER_COOLDOWN_SECS,
+    ):
+        from time import monotonic
+
+        self.seed = sorted(
+            {a for a in (_normalize_addr(p) for p in (seed or [])) if a}
+        )
+        self.controller = controller
+        self._fetch = fetch if fetch is not None else self._fetch_controller
+        self.refresh_secs = max(0.0, float(refresh_secs))
+        self._clock = clock or monotonic
+        self._health = (
+            health_registry
+            if health_registry is not None
+            else mirror_mod.global_health_registry()
+        )
+        self.stale_cooldown = float(stale_cooldown)
+        self._mu = _an.make_lock("peer.membership")
+        # Lockset annotation: the live view + event log only mutate under
+        # self._mu (the refresh fetch itself runs outside it).
+        self._view_shared = _an.shared("peer.membership.view")
+        self._live: list[str] = list(self.seed)
+        self._epoch = 0
+        self._events: list[dict] = []
+        self._last_refresh = float("-inf")
+        self._last_error = ""
+        self._refreshing = False
+
+    def _fetch_controller(self) -> list[dict]:
+        if not self.controller:
+            return []
+        from nydus_snapshotter_tpu.utils import udshttp
+
+        rows = udshttp.get_json(
+            self.controller, "/api/v1/fleet/peers", timeout=2.0
+        )
+        return rows if isinstance(rows, list) else []
+
+    def _maybe_refresh(self) -> None:
+        now = self._clock()
+        with self._mu:
+            self._view_shared.read()
+            if now - self._last_refresh < self.refresh_secs or self._refreshing:
+                return
+            self._refreshing = True
+        rows: Optional[list] = None
+        err = ""
+        try:
+            failpoint.hit("peer.member")
+            rows = self._fetch()
+        except Exception as e:  # noqa: BLE001 — keep the last-good view
+            err = str(e)
+            MEMBERSHIP_EVENTS.labels("refresh_error").inc()
+        down: list[str] = []
+        live: Optional[list[str]] = None
+        if rows is not None:
+            addrs = set()
+            for r in rows:
+                addr = _normalize_addr(str(r.get("address", "")))
+                if not addr:
+                    continue
+                if r.get("up", True) and not r.get("stale", False):
+                    addrs.add(addr)
+                else:
+                    # Crashed-but-registered: keep it OUT of the live set
+                    # (its regions re-own immediately) and cool it down in
+                    # the shared health table so an in-flight route walks
+                    # past it instead of timing out.
+                    down.append(addr)
+            # Registry empty (or only down members) => the seed list is
+            # the fallback floor, exactly the pre-dynamic behavior.
+            live = sorted(addrs) if addrs else list(self.seed)
+        for addr in down:
+            self._health.health_for(
+                addr,
+                failure_limit=PEER_FAILURE_LIMIT,
+                cooldown=PEER_COOLDOWN_SECS,
+            ).mark_down(self.stale_cooldown)
+            MEMBERSHIP_EVENTS.labels("down").inc()
+        with self._mu:
+            self._view_shared.write()
+            self._refreshing = False
+            self._last_refresh = now
+            self._last_error = err
+            if live is not None and live != self._live:
+                prev = set(self._live)
+                cur = set(live)
+                for addr in sorted(cur - prev):
+                    self._events.append(
+                        {"at": now, "kind": "join", "address": addr}
+                    )
+                    MEMBERSHIP_EVENTS.labels("join").inc()
+                for addr in sorted(prev - cur):
+                    self._events.append(
+                        {"at": now, "kind": "leave", "address": addr}
+                    )
+                    MEMBERSHIP_EVENTS.labels("leave").inc()
+                del self._events[:-64]
+                self._live = live
+                self._epoch += 1
+                MEMBERSHIP_EPOCH.set(self._epoch)
+            MEMBERSHIP_PEERS.set(len(self._live))
+
+    def addresses(self) -> list[str]:
+        """The current live peer set (refreshing if the view is stale)."""
+        self._maybe_refresh()
+        with self._mu:
+            self._view_shared.read()
+            return list(self._live)
+
+    @property
+    def epoch(self) -> int:
+        with self._mu:
+            self._view_shared.read()
+            return self._epoch
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            self._view_shared.read()
+            return {
+                "epoch": self._epoch,
+                "peers": list(self._live),
+                "seed": list(self.seed),
+                "events": [dict(e) for e in self._events[-16:]],
+                "last_error": self._last_error,
+                "controller": self.controller,
+            }
+
+
+# ---------------------------------------------------------------------------
 # Router: which peer owns which region
 # ---------------------------------------------------------------------------
 
 
 class PeerRouter:
-    """Static peer list + rendezvous region ownership + shared health.
+    """Rendezvous region ownership over a (possibly dynamic) peer set.
 
-    Every node, given the same ``[peer]`` list, independently computes the
-    same owner for a ``(blob, region)`` — the lookup map that needs no
-    gossip. Ownership walks the rendezvous ranking past unhealthy peers
-    (cooldown via the process-wide HostHealthRegistry), and returns None
-    when this node itself ranks first (fetch from origin: we ARE the
-    serve point for this region).
+    Every node, given the same peer set, independently computes the same
+    owner for a ``(blob, region)`` — the lookup map that needs no gossip.
+    The set comes from the static ``[peer]`` list, or — with a
+    :class:`PeerMembership` attached — from the live fleet registry, so
+    autoscaling re-shapes ownership with minimal churn: rendezvous
+    hashing moves only the ~K/n regions the joining/leaving peer wins or
+    owned (property-tested in tests/test_peer_membership.py). Ownership
+    walks the rendezvous ranking past unhealthy peers (cooldown via the
+    process-wide HostHealthRegistry), and returns None when this node
+    itself ranks first (fetch from origin: we ARE the serve point for
+    this region).
     """
 
     def __init__(
@@ -665,12 +876,14 @@ class PeerRouter:
         self_address: str = "",
         region_bytes: int = DEFAULT_REGION_KIB << 10,
         health_registry=None,
+        membership: Optional[PeerMembership] = None,
     ):
         self.self_address = _normalize_addr(self_address)
         self.peers = [
             a for a in (_normalize_addr(p) for p in peers) if a
         ]
         self.region_bytes = max(1, int(region_bytes))
+        self.membership = membership
         self.health = (
             health_registry
             if health_registry is not None
@@ -684,9 +897,16 @@ class PeerRouter:
         )
         return int.from_bytes(h.digest(), "little")
 
+    def current_peers(self) -> list[str]:
+        """The peer set ownership hashes over right now: the live
+        membership view when one is attached, else the static list."""
+        if self.membership is not None:
+            return self.membership.addresses()
+        return list(self.peers)
+
     def ranked(self, blob_id: str, offset: int) -> list[str]:
         region = offset // self.region_bytes
-        members = set(self.peers)
+        members = set(self.current_peers())
         if self.self_address:
             members.add(self.self_address)
         return sorted(
@@ -807,20 +1027,52 @@ def default_export() -> PeerExport:
         return _default_export
 
 
+def _fleet_controller() -> str:
+    """The controller UDS this process would register itself with —
+    the same resolution fleet.register_self uses."""
+    try:
+        from nydus_snapshotter_tpu import fleet
+
+        return fleet.resolve_fleet_config().controller
+    except Exception:
+        return os.environ.get("NTPU_FLEET_CONTROLLER", "")
+
+
+def build_membership(cfg: PeerRuntimeConfig) -> Optional[PeerMembership]:
+    """The dynamic membership view for this config, or None when
+    ``[peer] membership`` resolves static (no controller under "auto",
+    or "static" pinned)."""
+    if cfg.membership == "static":
+        return None
+    controller = _fleet_controller()
+    if not controller and cfg.membership != "fleet":
+        return None
+    return PeerMembership(
+        seed=cfg.peers,
+        controller=controller,
+        refresh_secs=cfg.membership_refresh_s,
+    )
+
+
 def default_router() -> Optional[PeerRouter]:
     """The configured peer router, or None when the peer tier is off.
-    Resolved once per process from env/``[peer]`` config."""
+    Resolved once per process from env/``[peer]`` config. With dynamic
+    membership configured, the router needs no static peer list — the
+    fleet registry is the discovery source."""
     global _default_router, _default_resolved
     with _default_lock:
         if not _default_resolved:
             _default_resolved = True
             cfg = resolve_peer_config()
-            if cfg.enable and cfg.peers:
-                _default_router = PeerRouter(
-                    cfg.peers,
-                    self_address=cfg.listen,
-                    region_bytes=cfg.region_bytes,
-                )
+            if cfg.enable:
+                membership = build_membership(cfg)
+                if cfg.peers or membership is not None:
+                    _default_router = PeerRouter(
+                        cfg.peers,
+                        self_address=cfg.listen,
+                        region_bytes=cfg.region_bytes,
+                        membership=membership,
+                    )
         return _default_router
 
 
@@ -834,17 +1086,25 @@ def start_from_config() -> Optional[PeerChunkServer]:
     with _default_lock:
         if _default_server is not None:
             return _default_server
-    server = PeerChunkServer(default_export(), pull_through=cfg.pull_through)
+    server = PeerChunkServer(
+        default_export(), pull_through=cfg.pull_through, router=default_router()
+    )
     server.run(cfg.listen)
     with _default_lock:
         _default_server = server
     # Fleet plane: a standalone peer-server process self-registers with
     # the controller so its metrics/traces federate. No-op when this
     # process already registered under another role (daemon/snapshotter):
-    # one process is ONE member — one ring, one registry.
+    # one process is ONE member — one ring, one registry. Either way the
+    # serve address is annotated on the member record, which is what the
+    # controller's /api/v1/fleet/peers route (dynamic peer discovery)
+    # lists for the cluster.
     from nydus_snapshotter_tpu import fleet
 
-    fleet.register_self("peer", server.address)
+    fleet.register_self(
+        "peer", server.address, extra={"peer_listen": server.address}
+    )
+    fleet.annotate_self("peer_listen", server.address)
     return server
 
 

@@ -384,10 +384,61 @@ class _Instance:
         self._replayer = replayer
         try:
             paths = list(self.bootstrap.prefetch) + list(extra_paths or ())
+            # Index-mapped paths warm straight from the soci file→extent
+            # table (and accrue into replayer.warmed_bytes); the replay
+            # below handles whatever the index couldn't translate.
+            paths = self._prefetch_via_soci_index(paths, replayer)
             return replayer.replay(paths)
         finally:
             flush_maps()
             self._replayer = None
+
+    def _prefetch_via_soci_index(self, paths: list, replayer) -> list:
+        """The soci index as a prefetch-trace source: paths the mounted
+        checkpoint index maps are warmed straight from its file →
+        extent table — ONE compressed range per file at PREFETCH lane,
+        no per-chunk bootstrap walk — and dropped from the bootstrap
+        replay. Paths the index doesn't know fall through unchanged
+        (hints, not requirements; a failed warm is contained)."""
+        if not self._soci_by_index or not paths:
+            return paths
+        from nydus_snapshotter_tpu.soci import blob as soci_blob
+
+        remaining = list(paths)
+        with self._reader_lock:
+            soci_streams = dict(self._soci_by_index)
+        for blob_index, stream in soci_streams.items():
+            cached = self._cached_by_index.get(blob_index)
+            if cached is None:
+                continue
+            try:
+                warms, remaining = soci_blob.warm_list_from_index(
+                    stream.index, remaining
+                )
+            except Exception:  # noqa: BLE001 — a bad map is a bad hint
+                logger.warning("soci prefetch-map translation failed",
+                               exc_info=True)
+                continue
+            for _path, c0, c1 in warms:
+                if replayer.cancelled:
+                    return []
+                try:
+                    flights = cached.warm(c0, max(0, c1 - c0))
+                    for f in flights:
+                        while not f.wait(0.1):
+                            if replayer.cancelled:
+                                return []
+                    if all(f.error is None for f in flights):
+                        n = max(0, c1 - c0)
+                        self.prefetched_bytes += n
+                        replayer.warmed_bytes += n
+                        from nydus_snapshotter_tpu.daemon import fetch_sched
+
+                        fetch_sched.PREFETCH_BYTES.inc(n)
+                        replayer.files_replayed += 1
+                except Exception:  # noqa: BLE001 — contained per file
+                    logger.warning("soci prefetch warm failed", exc_info=True)
+        return remaining
 
     def inflight_snapshot(self) -> list[dict]:
         with self._inflight_lock:
@@ -1035,6 +1086,19 @@ def main(argv=None) -> int:
     from nydus_snapshotter_tpu.daemon import peer as peer_mod
 
     peer_mod.start_from_config()
+    # SLO actuation follower: when the controller actuates (sheds QoS
+    # lanes on burn-rate breach, [slo] actuate + follow), this daemon
+    # applies the published lane state to its OWN shared admission gate,
+    # so actuation reaches the processes actually moving bytes.
+    from nydus_snapshotter_tpu.metrics import slo as slo_mod
+
+    slo_follower = None
+    _controller = os.environ.get("NTPU_FLEET_CONTROLLER", "")
+    if _controller and slo_mod.resolve_slo_actuation()[0] and os.environ.get(
+        "NTPU_SLO_FOLLOW", "1"
+    ) not in ("0", "off", "false"):
+        slo_follower = slo_mod.SloActuationFollower(_controller)
+        slo_follower.start()
     # shutdown() must not run on the main (serve_forever) thread: the signal
     # handler interrupts serve_forever's select, and BaseServer.shutdown()
     # then waits for a loop exit that can never happen — deadlock, daemon
@@ -1046,6 +1110,8 @@ def main(argv=None) -> int:
     try:
         server.serve_forever()
     finally:
+        if slo_follower is not None:
+            slo_follower.stop()
         fleet.deregister_self()
         peer_mod.stop_default()
         try:

@@ -92,6 +92,9 @@ KNOWN_SITES = (
     "peer.serve",            # daemon/peer.py chunk-server request entry
     "peer.fetch",            # daemon/peer.py peer-tier ranged read attempt
     "peer.admit",            # daemon/fetch_sched.py AdmissionGate.acquire entry
+    "peer.member",           # daemon/peer.py membership registry refresh
+    "dict.shard",            # parallel/dict_service.py per-shard batch routing
+    "slo.actuate",           # metrics/slo.py lane shed/restore transition
     "soci.index",            # soci/blob.py index build / store boundary
     "soci.resolve",          # soci/blob.py read -> compressed-range resolution
     "soci.fetch",            # soci/blob.py compressed-range pull for a lazy read

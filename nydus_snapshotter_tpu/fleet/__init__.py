@@ -170,6 +170,16 @@ class FleetRegistry:
             self._members_shared.read()
             return self._members.get(name)
 
+    def annotate(self, name: str, key: str, value) -> bool:
+        """Merge one ``extra`` key into a member's record in place."""
+        with self._lock:
+            self._members_shared.write()
+            member = self._members.get(name)
+            if member is None:
+                return False
+            member.extra = {**member.extra, key: value}
+            return True
+
 
 # ---------------------------------------------------------------------------
 # The plane: registry + federator + collector + SLO engine + HTTP surface
@@ -210,6 +220,11 @@ class FleetPlane:
             or _slo.federated_source(self.federator, self.registry.members),
             clock=clock,
         )
+        # Close the loop: burn-rate breaches actuate the controller
+        # process's admission gate (shed non-demand lanes before demand
+        # suffers; restore on budget recovery). Member processes follow
+        # the published actuation state (metrics/slo.SloActuationFollower).
+        self.actuator = _slo.build_actuator(self.slo, clock=clock)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -228,7 +243,7 @@ class FleetPlane:
         # Claim this process's one member slot so a dict service or peer
         # server started later in the SAME process doesn't register the
         # process a second time over HTTP.
-        _claim_self(name)
+        _claim_self(name, registry=self.registry)
         return self.registry.register(
             Member(name=name, component=component, address="", pid=os.getpid(),
                    local=True)
@@ -243,6 +258,8 @@ class FleetPlane:
             try:
                 self.federator.scrape_once()
                 self.slo.tick()
+                if self.actuator is not None:
+                    self.actuator.tick()
             except Exception:  # noqa: BLE001 — the loop must survive anything
                 logger.exception("fleet scrape round failed")
             if self._stop.wait(self.cfg.scrape_interval_secs):
@@ -310,11 +327,44 @@ class FleetPlane:
                 doc = self.collector.collect(q.get("trace_id", [""])[0])
                 return self._json(doc)
             if route == "/api/v1/fleet/slo":
-                return self._json(self.slo.status())
+                status = self.slo.status()
+                if self.actuator is not None:
+                    status["actuation"] = self.actuator.state()
+                return self._json(status)
+            if route == "/api/v1/fleet/peers":
+                return self._json(self.peer_listing())
             return self._json({"message": "no such endpoint"}, 404)
         except Exception as e:  # noqa: BLE001 — the serve loop stays up
             logger.exception("fleet route %s failed", route)
             return self._json({"message": str(e)}, 500)
+
+    def peer_listing(self) -> list[dict]:
+        """Dynamic peer discovery: every member with a peer serve address
+        (component ``peer``, or any member annotated ``peer_listen``),
+        flagged with the federator's liveness so routers drop crashed
+        peers without waiting for a deregistration that never came."""
+        liveness = self.federator.liveness()
+        rows = []
+        for m in self.registry.members():
+            addr = m.extra.get("peer_listen", "") or (
+                m.address if m.component == "peer" else ""
+            )
+            if not addr:
+                continue
+            live = liveness.get(m.name)
+            rows.append(
+                {
+                    "name": m.name,
+                    "component": m.component,
+                    "address": addr,
+                    "pid": m.pid,
+                    # Never scraped yet (racing the first round) counts as
+                    # up: a joining peer must not be shunned at birth.
+                    "up": True if live is None else bool(live["up"]),
+                    "stale": False if live is None else bool(live["stale"]),
+                }
+            )
+        return rows
 
     @staticmethod
     def _json(payload, status: int = 200) -> tuple[int, str, bytes]:
@@ -338,15 +388,52 @@ _self_lock = _an.make_lock("fleet.self")
 _self_member: Optional[dict] = None
 
 
-def _claim_self(name: str) -> bool:
+def _claim_self(name: str, registry: Optional[FleetRegistry] = None) -> bool:
     """Take this process's member slot without an HTTP registration (the
-    controller process registers itself locally)."""
+    controller process registers itself locally; ``registry`` lets
+    annotate_self update the local record in place)."""
     global _self_member
     with _self_lock:
         if _self_member is not None:
             return False
-        _self_member = {"name": name, "controller": ""}
+        _self_member = {"name": name, "controller": "", "registry": registry}
         return True
+
+
+def annotate_self(key: str, value) -> bool:
+    """Merge one ``extra`` key into this process's member record and
+    re-push the registration (registry replace-by-name). This is how a
+    process that registered under one role advertises another it later
+    grew — e.g. a daemon member annotating ``peer_listen`` when its peer
+    chunk server starts, which the ``/api/v1/fleet/peers`` discovery
+    route lists for the cluster. No-op (False) when this process never
+    registered at all. The controller process itself (a LOCAL member)
+    annotates its registry record in place."""
+    with _self_lock:
+        member = _self_member
+        if member is None:
+            return False
+        if not member.get("controller"):
+            registry = member.get("registry")
+            if registry is not None:
+                return registry.annotate(member["name"], key, value)
+            return False
+        payload = member.get("payload")
+        if payload is None:
+            return False
+        payload.setdefault("extra", {})[key] = value
+        payload = dict(payload)
+
+    def push():
+        for _ in range(5):
+            try:
+                udshttp.post_json(member["controller"], MEMBERS_PATH, payload)
+                return
+            except Exception:  # noqa: BLE001 — retry briefly
+                time.sleep(0.25)
+
+    threading.Thread(target=push, name="ntpu-fleet-annotate", daemon=True).start()
+    return True
 
 
 def register_self(
@@ -356,6 +443,7 @@ def register_self(
     controller: str = "",
     retries: int = 20,
     retry_delay_s: float = 0.25,
+    extra: Optional[dict] = None,
 ) -> bool:
     """Register this process with the controller resolved from
     ``controller`` / env / config; returns whether a registration was
@@ -370,16 +458,18 @@ def register_self(
     if not controller or controller == address:
         return False
     name = name or cfg.member_name or f"{component}-{os.getpid()}"
-    with _self_lock:
-        if _self_member is not None:
-            return False
-        _self_member = {"name": name, "controller": controller}
     payload = {
         "name": name,
         "component": component,
         "address": address,
         "pid": os.getpid(),
     }
+    if extra:
+        payload["extra"] = dict(extra)
+    with _self_lock:
+        if _self_member is not None:
+            return False
+        _self_member = {"name": name, "controller": controller, "payload": payload}
 
     def push():
         for _ in range(max(1, retries)):

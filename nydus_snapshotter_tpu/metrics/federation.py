@@ -293,6 +293,22 @@ class FleetFederator:
         the SLO engine's federated histogram source."""
         return {name: st.samples for name, st in self._snapshot().items() if st.ok or st.samples}
 
+    def liveness(self) -> dict[str, dict]:
+        """{member: {"up", "stale", "age_s"}} from the last scrape round —
+        the cheap health view dynamic peer membership routes on (a member
+        never scraped yet is up-but-stale-unknown: treated live so a peer
+        racing the first scrape round isn't shunned at birth)."""
+        now = self._clock()
+        out: dict[str, dict] = {}
+        for name, st in self._snapshot().items():
+            age = (now - st.last_ok) if st.last_ok else None
+            out[name] = {
+                "up": st.ok,
+                "stale": (not st.ok) or (age is not None and age > self.stale_after),
+                "age_s": None if age is None else round(age, 3),
+            }
+        return out
+
     def scoreboard(self) -> dict:
         """Derived per-member health view. Every field is best-effort:
         a ratio whose inputs a member doesn't export is None, a member

@@ -36,6 +36,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 from collections import deque
 from typing import Callable, Optional
@@ -77,6 +78,20 @@ SLO_BREACHES = _reg.register(
         "ntpu_slo_breaches_total",
         "Multi-window burn-rate alerts raised, per objective",
         ("objective",),
+    )
+)
+SLO_ACTUATIONS = _reg.register(
+    _metrics.Counter(
+        "ntpu_slo_actuations_total",
+        "Admission-gate lane actuations driven by SLO burn state",
+        ("action", "lane"),
+    )
+)
+SLO_LANE_SHED = _reg.register(
+    _metrics.Gauge(
+        "ntpu_slo_lane_shed",
+        "1 while SLO actuation holds the lane shed, 0 when restored",
+        ("lane",),
     )
 )
 
@@ -329,6 +344,278 @@ class SloEngine:
                 ],
                 "breaches": [dict(e) for e in self._events],
             }
+
+    def breached(self) -> list[str]:
+        """Objectives currently in multi-window breach (the actuator's
+        escalate/hold signal)."""
+        with self._lock:
+            self._state_shared.read()
+            return [o.name for o in self.objectives if self._state[o.name].breached]
+
+    def max_burn_short(self) -> float:
+        """The worst short-window burn across objectives right now (the
+        actuator's restore signal: recovery must show on the fast
+        window, not wait out the long one)."""
+        with self._lock:
+            self._state_shared.read()
+            burns = [
+                self._state[o.name].last_status.get("burn_short", 0.0)
+                for o in self.objectives
+                if self._state[o.name].last_status
+            ]
+            return max(burns, default=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Actuation: burn-rate alerts close the loop onto the admission gate
+# ---------------------------------------------------------------------------
+
+
+class SloActuator:
+    """Sheds AdmissionGate lanes on sustained burn, restores on recovery.
+
+    The engine observes; this closes ROADMAP item 4's loop: while ANY
+    objective is in multi-window breach, one more lane from
+    ``shed_lanes`` (least-important first — peer_serve, then prefetch,
+    then readahead; the demand lane is not actuatable by construction)
+    is shed per tick, so pressure is removed incrementally before demand
+    latency suffers. Once every objective's SHORT-window burn drops
+    under ``restore_burn`` the most recently shed lane is restored per
+    tick — recovery reads the fast window so the budget refills without
+    waiting out the long window's smoothing.
+
+    Every transition fires the ``slo.actuate`` failpoint, records a
+    ``slo.actuate`` trace span, bumps ``ntpu_slo_actuations_total`` and
+    lands in the event log the fleet surface serves
+    (``/api/v1/fleet/slo`` → ``actuation``).
+    """
+
+    def __init__(
+        self,
+        engine: SloEngine,
+        gate=None,
+        shed_lanes: Optional[list[str]] = None,
+        restore_burn: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+        keep_events: int = 64,
+    ):
+        from nydus_snapshotter_tpu.daemon import fetch_sched
+
+        self.engine = engine
+        self._gate = gate  # resolved lazily: shared_gate() builds config
+        self._fetch_sched = fetch_sched
+        lanes = shed_lanes or ["peer_serve", "prefetch", "readahead"]
+        self.shed_lanes = []
+        for name in lanes:
+            if name not in fetch_sched.LANE_NAMES:
+                raise SloSpecError(f"unknown slo shed lane {name!r}")
+            lane = fetch_sched.LANE_NAMES.index(name)
+            if lane == fetch_sched.DEMAND:
+                raise SloSpecError("the demand lane is not sheddable")
+            self.shed_lanes.append(lane)
+        self.restore_burn = float(restore_burn)
+        self._clock = clock
+        self._lock = _an.make_lock("slo.actuator")
+        self._state_shared = _an.shared("slo.actuator.state")
+        self._shed_depth = 0  # how many of shed_lanes are currently shed
+        self._events: deque = deque(maxlen=keep_events)
+
+    @property
+    def gate(self):
+        if self._gate is None:
+            self._gate = self._fetch_sched.shared_gate()
+        return self._gate
+
+    def _transition(self, action: str, lane: int, reason: str) -> None:
+        from nydus_snapshotter_tpu import failpoint, trace
+
+        lane_name = self._fetch_sched.LANE_NAMES[lane]
+        with trace.span("slo.actuate", action=action, lane=lane_name):
+            failpoint.hit("slo.actuate")
+            self.gate.set_lane_cap(lane, 0 if action == "shed" else None)
+        SLO_ACTUATIONS.labels(action, lane_name).inc()
+        SLO_LANE_SHED.labels(lane_name).set(1 if action == "shed" else 0)
+        event = {
+            "at": self._clock(),
+            "action": action,
+            "lane": lane_name,
+            "reason": reason,
+        }
+        with self._lock:
+            self._state_shared.write()
+            self._events.append(event)
+        logger.warning("SLO actuation: %s lane %s (%s)", action, lane_name, reason)
+
+    def tick(self) -> Optional[dict]:
+        """One actuation decision; returns the transition event if any.
+        Call after :meth:`SloEngine.tick` on the same cadence."""
+        breached = self.engine.breached()
+        with self._lock:
+            self._state_shared.read()
+            depth = self._shed_depth
+        if breached and depth < len(self.shed_lanes):
+            lane = self.shed_lanes[depth]
+            self._transition("shed", lane, f"breach: {', '.join(breached)}")
+            with self._lock:
+                self._state_shared.write()
+                self._shed_depth = depth + 1
+                return dict(self._events[-1])
+        if not breached and depth > 0:
+            burn = self.engine.max_burn_short()
+            if burn < self.restore_burn:
+                lane = self.shed_lanes[depth - 1]
+                self._transition(
+                    "restore", lane, f"burn_short {burn:.2f} < {self.restore_burn}"
+                )
+                with self._lock:
+                    self._state_shared.write()
+                    self._shed_depth = depth - 1
+                    return dict(self._events[-1])
+        return None
+
+    def state(self) -> dict:
+        """The actuation view the fleet surface publishes (and member
+        followers apply to their local gates)."""
+        with self._lock:
+            self._state_shared.read()
+            depth = self._shed_depth
+            events = [dict(e) for e in self._events]
+        names = self._fetch_sched.LANE_NAMES
+        return {
+            "shed_lanes": [names[lane] for lane in self.shed_lanes[:depth]],
+            "shed_depth": depth,
+            "restore_burn": self.restore_burn,
+            "events": events[-16:],
+        }
+
+
+class SloActuationFollower:
+    """Member-side actuation: polls the controller's published actuation
+    state and applies it to this process's shared admission gate, so a
+    breach the CONTROLLER detects (federated histograms span every
+    daemon) sheds lanes fleet-wide, not just in the controller process.
+    A poll failure keeps the last applied state (an unreachable
+    controller must not flap lanes); stop() restores everything."""
+
+    def __init__(
+        self,
+        controller: str,
+        gate=None,
+        poll_secs: float = 2.0,
+        fetch=None,
+    ):
+        from nydus_snapshotter_tpu.daemon import fetch_sched
+
+        self._fetch_sched = fetch_sched
+        self.controller = controller
+        self._gate = gate
+        self.poll_secs = max(0.05, float(poll_secs))
+        self._fetch = fetch if fetch is not None else self._fetch_controller
+        self._applied: set[str] = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def gate(self):
+        if self._gate is None:
+            self._gate = self._fetch_sched.shared_gate()
+        return self._gate
+
+    def _fetch_controller(self) -> dict:
+        from nydus_snapshotter_tpu.utils import udshttp
+
+        status = udshttp.get_json(self.controller, "/api/v1/fleet/slo", timeout=2.0)
+        return status.get("actuation", {}) if isinstance(status, dict) else {}
+
+    def poll_once(self) -> bool:
+        """One poll+apply round; returns whether the state changed."""
+        try:
+            want = set(self._fetch().get("shed_lanes", ()))
+        except Exception:  # noqa: BLE001 — keep last applied state
+            return False
+        names = self._fetch_sched.LANE_NAMES
+        changed = False
+        for name in sorted(want - self._applied):
+            if name in names and names.index(name) != self._fetch_sched.DEMAND:
+                self.gate.set_lane_cap(names.index(name), 0)
+                SLO_ACTUATIONS.labels("follow_shed", name).inc()
+                changed = True
+        for name in sorted(self._applied - want):
+            if name in names and names.index(name) != self._fetch_sched.DEMAND:
+                self.gate.set_lane_cap(names.index(name), None)
+                SLO_ACTUATIONS.labels("follow_restore", name).inc()
+                changed = True
+        self._applied = {n for n in want if n in names}
+        return changed
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_secs):
+            self.poll_once()
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="ntpu-slo-follow", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+        # Never leave lanes shed behind a dead follower.
+        names = self._fetch_sched.LANE_NAMES
+        for name in self._applied:
+            self.gate.set_lane_cap(names.index(name), None)
+        self._applied.clear()
+
+
+def resolve_slo_actuation() -> tuple[bool, list[str], float]:
+    """(actuate, shed_lanes, restore_burn) from ``NTPU_SLO_ACTUATE`` /
+    ``NTPU_SLO_SHED_LANES`` / ``NTPU_SLO_RESTORE_BURN`` env over the
+    ``[slo]`` section."""
+    actuate = False
+    lanes = ["peer_serve", "prefetch", "readahead"]
+    restore = 1.0
+    try:
+        from nydus_snapshotter_tpu.config import config as _cfg
+
+        sc = _cfg.get_global_config().slo
+        actuate = bool(sc.actuate)
+        if sc.shed_lanes:
+            lanes = list(sc.shed_lanes)
+        restore = float(sc.restore_burn)
+    except Exception:
+        pass
+    env = os.environ.get("NTPU_SLO_ACTUATE", "")
+    if env:
+        actuate = env not in ("0", "off", "false")
+    env_lanes = os.environ.get("NTPU_SLO_SHED_LANES", "")
+    if env_lanes:
+        lanes = [p.strip() for p in env_lanes.split(",") if p.strip()]
+    try:
+        restore = float(os.environ["NTPU_SLO_RESTORE_BURN"])
+    except (KeyError, ValueError):
+        pass
+    return actuate, lanes, max(0.0, restore)
+
+
+def build_actuator(engine: SloEngine, gate=None, clock=time.monotonic):
+    """The config-resolved actuator for the fleet plane, or None when
+    ``[slo] actuate`` is off (the engine then only observes, the
+    pre-actuation behavior)."""
+    actuate, lanes, restore = resolve_slo_actuation()
+    if not actuate:
+        return None
+    try:
+        return SloActuator(
+            engine, gate=gate, shed_lanes=lanes, restore_burn=restore, clock=clock
+        )
+    except SloSpecError as e:
+        logger.warning("slo actuation disabled: %s", e)
+        return None
 
 
 # ---------------------------------------------------------------------------

@@ -41,6 +41,7 @@ mirrors at memcpy speed, no JSON on the hot path.
 
 from __future__ import annotations
 
+import hashlib
 import http.client
 import json
 import logging
@@ -95,10 +96,85 @@ _RPC_MS = _metrics.Histogram(
     "Chunk-dict service RPC handler latency",
     ("op",),
 )
+_SHARD_BATCHES = _metrics.Counter(
+    "ntpu_dict_shard_batches_total",
+    "Per-shard batches the sharded client routed, by op (merge / sync)",
+    ("op",),
+)
+# since-RPC binary header: n_entries, epoch, rebuild_epoch, reserved.
+_SINCE_HDR_FIELDS = 4
 
 
 class DictServiceError(RuntimeError):
     """An RPC failed on the service side (the message carries the op)."""
+
+
+# ---------------------------------------------------------------------------
+# Shard routing: namespace key-space split across N service processes
+# ---------------------------------------------------------------------------
+
+
+# splitmix64 finalizer constants: the rendezvous score is
+# mix(digest[:8] ^ addr_key) per shard — a content digest is already
+# uniform, so one integer mix gives rendezvous-quality spreading while
+# staying numpy-vectorizable (a per-digest blake2b partition was ~10x
+# the probe RPC itself at 50k-digest batches).
+_MIX_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_MIX_M2 = np.uint64(0x94D049BB133111EB)
+
+
+def _mix_u64(x: np.ndarray) -> np.ndarray:
+    x = x ^ (x >> np.uint64(30))
+    x = x * _MIX_M1
+    x = x ^ (x >> np.uint64(27))
+    x = x * _MIX_M2
+    return x ^ (x >> np.uint64(31))
+
+
+def _addr_key(addr: str) -> np.uint64:
+    """64-bit key of the FULL shard address (blake2b once per addr, not
+    per digest; hashing the whole string — truncation would collapse
+    shards whose long UDS paths share a prefix)."""
+    h = hashlib.blake2b(addr.encode(), digest_size=8)
+    return np.uint64(int.from_bytes(h.digest(), "little"))
+
+
+def _shard_owners(digests: list[bytes], addrs: list[str]) -> np.ndarray:
+    """Rendezvous owner index per digest, vectorized over the batch."""
+    if all(len(d) == 32 for d in digests[:8]) and len(digests) * 32 == sum(
+        map(len, digests)
+    ):
+        d64 = np.frombuffer(b"".join(digests), dtype="<u8")[::4]
+    else:  # non-32-byte digests: slow path
+        d64 = np.asarray(
+            [int.from_bytes(d[:8].ljust(8, b"\0"), "little") for d in digests],
+            dtype=np.uint64,
+        )
+    with np.errstate(over="ignore"):
+        scores = np.stack([_mix_u64(d64 ^ _addr_key(a)) for a in addrs])
+    return np.argmax(scores, axis=0)
+
+
+def shard_for(digest: bytes, addrs: list[str]) -> int:
+    """Rendezvous owner of ``digest`` among ``addrs`` (index into the
+    list). Every client, given the same shard list, independently routes
+    a digest to the same shard — first-wins merge ordering per digest is
+    therefore global even though each shard serializes independently,
+    which is what keeps sharded converter output byte-identical to the
+    single-service path."""
+    if len(addrs) == 1:
+        return 0
+    return int(_shard_owners([digest], addrs)[0])
+
+
+def partition_digests(digests: list[bytes], addrs: list[str]) -> list[list[int]]:
+    """Positions of ``digests`` grouped by owning shard (order kept)."""
+    if not digests:
+        return [[] for _ in addrs]
+    if len(addrs) == 1:
+        return [list(range(len(digests)))]
+    owners = _shard_owners(digests, addrs)
+    return [np.flatnonzero(owners == i).tolist() for i in range(len(addrs))]
 
 
 # ---------------------------------------------------------------------------
@@ -302,6 +378,7 @@ class ServiceDict:
             e_rows = bs.ciphers[ciphers:]
             epoch, rebuild_epoch = self.index.epoch, self.index.rebuild_epoch
             chunk_size = bs.chunk_size
+            total_chunks = len(bs.chunks)
         ca = np.zeros(len(c_rows), dtype=_CHUNK_DT)
         for i, r in enumerate(c_rows):
             ca[i] = (
@@ -325,13 +402,39 @@ class ServiceDict:
                 key = np.frombuffer(r.key, dtype=np.uint8)
                 iv = np.frombuffer(r.iv, dtype=np.uint8)
             ea[i] = (r.algo, key, iv)
+        # Final field: the service's TOTAL chunk count. A mirror holding
+        # more than the service knows has outlived a service restart —
+        # epoch alone can't prove that (a young table reaches any epoch).
         hdr = np.asarray(
             [len(c_rows), len(b_rows), len(t_rows), len(e_rows),
-             epoch, rebuild_epoch, chunk_size, 0],
+             epoch, rebuild_epoch, chunk_size, total_chunks],
             dtype=np.uint64,
         )
         return b"".join(
             [hdr.tobytes(), ca.tobytes(), ba.tobytes(), ta.tobytes(), ea.tobytes()]
+        )
+
+    def entries_since(self, since_epoch: int, count_only: bool = False) -> bytes:
+        """The probe-index journal tail past ``since_epoch``, riding the
+        v5 epoch/journal format over the wire: header (n, epoch,
+        rebuild_epoch, 0) + raw digests (u32 n×8) + stored values
+        (i64 n) unless ``count_only``. This is the replication tail a
+        mirror/replica polls to stay epoch-consistent; an epoch that
+        predates the last rebuild raises
+        :class:`~nydus_snapshotter_tpu.parallel.sharded_dict.
+        DictEpochError` (wire status 409) — the caller reloads a full
+        snapshot instead of replaying a journal that was compacted away."""
+        with self._mu:
+            digs, vals, epoch = self.index.entries_since(int(since_epoch))
+            rebuild_epoch = self.index.rebuild_epoch
+        hdr = np.asarray(
+            [len(vals), epoch, rebuild_epoch, 0], dtype=np.uint64
+        )
+        if count_only:
+            return hdr.tobytes()
+        return b"".join(
+            [hdr.tobytes(), np.ascontiguousarray(digs, dtype="<u4").tobytes(),
+             np.ascontiguousarray(vals, dtype="<i8").tobytes()]
         )
 
     def save(self, path: str) -> dict:
@@ -438,8 +541,19 @@ class DictService:
             _RPC_ERRORS.labels(op).inc()
             return 400, "application/json", json.dumps({"message": str(e)}).encode()
         except Exception as e:  # noqa: BLE001 - mapped to a wire status
-            logger.exception("dict service %s %s", method, path)
             _RPC_ERRORS.labels(op).inc()
+            from nydus_snapshotter_tpu.parallel.sharded_dict import DictEpochError
+
+            if isinstance(e, DictEpochError):
+                # Epoch-consistency contract: a journal tail that was
+                # compacted away is a 409 — the caller must resync from a
+                # full snapshot, not silently miss entries.
+                return (
+                    409,
+                    "application/json",
+                    json.dumps({"message": str(e)}).encode(),
+                )
+            logger.exception("dict service %s %s", method, path)
             return 500, "application/json", json.dumps({"message": str(e)}).encode()
         if isinstance(payload, bytes):
             return 200, "application/octet-stream", payload
@@ -469,6 +583,13 @@ class DictService:
             return sd.entries_delta(
                 count("chunks"), count("blobs"), count("batches"), count("ciphers")
             )
+        if op == "since" and method == "GET":
+            q = parse_qs(query)
+            epoch = int(q.get("epoch", ["0"])[0])
+            if epoch < 0:
+                raise ValueError("epoch must be >= 0")
+            count_only = q.get("count_only", ["0"])[0] not in ("", "0")
+            return sd.entries_since(epoch, count_only=count_only)
         if op == "save" and method == "POST":
             req = json.loads(body or b"{}")
             path = req.get("path", "")
@@ -656,8 +777,47 @@ class DictClient:
             "epoch": int(hdr[4]),
             "rebuild_epoch": int(hdr[5]),
             "chunk_size": int(hdr[6]),
+            "total_chunks": int(hdr[7]),
         }
         return meta, ca, ba, ta, ea
+
+    def entries_since(
+        self,
+        namespace: str = DEFAULT_NAMESPACE,
+        epoch: int = 0,
+        count_only: bool = False,
+    ) -> tuple[dict, np.ndarray, np.ndarray]:
+        """The probe-index journal tail past ``epoch`` (the v5
+        epoch/journal replication tail over the wire): (meta, digests
+        u32[k, 8], values i64[k]); empty arrays with ``count_only``.
+        Raises :class:`~nydus_snapshotter_tpu.parallel.sharded_dict.
+        DictEpochError` when the epoch predates the service's last
+        rebuild/compaction (wire 409) — reload a full snapshot."""
+        from nydus_snapshotter_tpu.parallel.sharded_dict import DictEpochError
+
+        path = f"/api/v1/dict/{namespace}/since?epoch={int(epoch)}"
+        if count_only:
+            path += "&count_only=1"
+        try:
+            _ctype, payload = self._request("GET", path)
+        except DictServiceError as e:
+            if "409" in str(e):
+                raise DictEpochError(str(e)) from e
+            raise
+        hdr = np.frombuffer(payload, dtype=np.uint64, count=_SINCE_HDR_FIELDS)
+        n = int(hdr[0])
+        meta = {
+            "entries": n,
+            "epoch": int(hdr[1]),
+            "rebuild_epoch": int(hdr[2]),
+        }
+        if count_only or n == 0:
+            return meta, np.zeros((0, 8), dtype="<u4"), np.zeros(0, dtype="<i8")
+        off = hdr.nbytes
+        digs = np.frombuffer(payload, dtype="<u4", count=n * 8, offset=off)
+        off += digs.nbytes
+        vals = np.frombuffer(payload, dtype="<i8", count=n, offset=off)
+        return meta, digs.reshape(-1, 8), vals
 
     def save(self, path: str, namespace: str = DEFAULT_NAMESPACE) -> dict:
         return json.loads(
@@ -687,8 +847,29 @@ class DictClient:
 # ---------------------------------------------------------------------------
 
 
+class _ShardState:
+    """One shard's replication cursor inside a sharded mirror."""
+
+    __slots__ = (
+        "client", "chunks", "blobs", "batches", "ciphers", "epoch",
+        "rebuild_epoch", "blob_map",
+    )
+
+    def __init__(self, client: DictClient):
+        self.client = client
+        self.chunks = 0
+        self.blobs = 0
+        self.batches = 0
+        self.ciphers = 0
+        self.epoch = 0
+        self.rebuild_epoch = 0
+        # shard-local blob index -> combined-mirror blob index
+        self.blob_map: list[int] = []
+
+
 class ServiceChunkDict:
-    """GrowingChunkDict-shaped view of one service namespace.
+    """GrowingChunkDict-shaped view of one service namespace, over one
+    service process or a rendezvous-sharded set of them.
 
     Pack/Merge probe the local mirror (``get``/``blob_id_for``/
     ``.bootstrap``) exactly as they would a private dict — the dict is
@@ -696,23 +877,65 @@ class ServiceChunkDict:
     ``add_bootstrap*`` ships the merged image to the service and
     ``sync()`` replays the append-only tail the mirror is missing, which
     also picks up what OTHER converters merged in the meantime.
+
+    **Sharded topology**: with N clients, the namespace key-space is
+    split by rendezvous hash over the shard addresses (:func:`shard_for`)
+    — a digest always routes to the same shard, so each shard's
+    first-wins serialization IS the global first-wins order for its
+    digests. ``add_bootstrap*`` partitions the image into per-shard
+    sub-bootstraps (only the chunks a shard owns, blobs reindexed) and
+    ``sync()`` replays every shard's append-only record tail into ONE
+    combined mirror, remapping shard-local blob indices onto the
+    combined blob table. Per-shard epochs are reconciled on every sync:
+    a shard whose reported epoch went backwards (restart, wiped table)
+    raises :class:`~nydus_snapshotter_tpu.parallel.sharded_dict.
+    DictEpochError` — the mirror cannot un-merge, the caller must
+    rebuild it. Converter output is byte-identical to the single-service
+    path at any shard count because dedup decisions depend only on the
+    digest → (blob id, extent) mapping, which partitioning preserves
+    (pinned in tests/test_dict_service.py).
     """
 
     def __init__(
         self,
-        client: DictClient,
+        client,
         namespace: str = DEFAULT_NAMESPACE,
         sync_on_init: bool = True,
     ):
         from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
 
-        self.client = client
+        clients = list(client) if isinstance(client, (list, tuple)) else [client]
+        if not clients:
+            raise ValueError("ServiceChunkDict needs at least one client")
+        self._shards = [_ShardState(c) for c in clients]
+        self.shard_addrs = [c.sock_path for c in clients]
+        # Back-compat accessor: shard 0 is where single-shard callers and
+        # the trained-zdict replication land.
+        self.client = clients[0]
         self.namespace = namespace
         self.bootstrap = Bootstrap(inodes=[])
         self._by_digest: dict[bytes, object] = {}
+        self._blob_index_of: dict[str, int] = {}
+        self._batch_seen: set[tuple[int, int]] = set()
         self.epoch = 0
         if sync_on_init:
             self.sync()
+
+    @property
+    def n_shards(self) -> int:
+        return len(self._shards)
+
+    def shard_epochs(self) -> list[dict]:
+        """Per-shard replication state (ntpuctl dict surfaces this)."""
+        return [
+            {
+                "address": self.shard_addrs[i],
+                "epoch": s.epoch,
+                "rebuild_epoch": s.rebuild_epoch,
+                "chunks": s.chunks,
+            }
+            for i, s in enumerate(self._shards)
+        ]
 
     # -- probe interface (mirror-local) --------------------------------------
 
@@ -736,49 +959,84 @@ class ServiceChunkDict:
 
     # -- reconciliation ------------------------------------------------------
 
-    def sync(self) -> int:
-        """Replay the service tail into the mirror; returns how many chunk
-        records arrived."""
-        from nydus_snapshotter_tpu.models.bootstrap import (
-            BatchRecord,
-            BlobRecord,
-            ChunkRecord,
-            CipherRecord,
-        )
+    def _combined_blob_index(self, shard: _ShardState, row) -> int:
+        """Adopt one shard blob row into the combined mirror (dedup by
+        blob id — two shards may both reference a blob whose chunks
+        straddle the key-space split)."""
+        from nydus_snapshotter_tpu.models.bootstrap import BlobRecord, CipherRecord
 
         bs = self.bootstrap
-        meta, ca, ba, ta, ea = self.client.entries(
-            self.namespace,
-            chunks=len(bs.chunks),
-            blobs=len(bs.blobs),
-            batches=len(bs.batches),
-            ciphers=len(bs.ciphers),
-        )
-        if meta["chunk_size"]:
-            bs.chunk_size = meta["chunk_size"]
-        for row in ba:
+        bid = row["blob_id"].decode()
+        idx = self._blob_index_of.get(bid)
+        if idx is None:
+            idx = len(bs.blobs)
+            self._blob_index_of[bid] = idx
             bs.blobs.append(
                 BlobRecord(
-                    blob_id=row["blob_id"].decode(),
+                    blob_id=bid,
                     compressed_size=int(row["csize"]),
                     uncompressed_size=int(row["usize"]),
                     chunk_count=int(row["chunk_count"]),
                     flags=int(row["flags"]),
                 )
             )
-        for row in ea:
-            algo = int(row["algo"])
-            bs.ciphers.append(
-                CipherRecord(
-                    algo=algo,
-                    key=row["key"].tobytes() if algo else b"",
-                    iv=row["iv"].tobytes() if algo else b"",
-                )
+            if bs.ciphers:
+                # keep the cipher table parallel to blobs once any blob
+                # is encrypted (Bootstrap serialization invariant)
+                while len(bs.ciphers) < len(bs.blobs):
+                    bs.ciphers.append(CipherRecord())
+        shard.blob_map.append(idx)
+        return idx
+
+    def _sync_shard(self, shard: _ShardState) -> int:
+        from nydus_snapshotter_tpu.models.bootstrap import (
+            BatchRecord,
+            ChunkRecord,
+            CipherRecord,
+        )
+        from nydus_snapshotter_tpu.parallel.sharded_dict import DictEpochError
+
+        bs = self.bootstrap
+        meta, ca, ba, ta, ea = shard.client.entries(
+            self.namespace,
+            chunks=shard.chunks,
+            blobs=shard.blobs,
+            batches=shard.batches,
+            ciphers=shard.ciphers,
+        )
+        # Epoch reconciliation: the service's epoch only ever advances.
+        # A regression means the shard restarted with a younger table —
+        # this mirror may hold records the shard no longer knows, and a
+        # counts-based tail would silently resume mid-stream. Fail loud.
+        if meta["epoch"] < shard.epoch or meta["total_chunks"] < shard.chunks:
+            raise DictEpochError(
+                f"dict shard {shard.client.sock_path} went backwards "
+                f"(epoch {meta['epoch']} < {shard.epoch} or "
+                f"{meta['total_chunks']} chunks < the {shard.chunks} already "
+                "replayed): shard restarted, rebuild the mirror"
             )
+        if meta["chunk_size"]:
+            bs.chunk_size = meta["chunk_size"]
+        for row in ba:
+            self._combined_blob_index(shard, row)
+        for j, row in enumerate(ea):
+            algo = int(row["algo"])
+            cipher = CipherRecord(
+                algo=algo,
+                key=row["key"].tobytes() if algo else b"",
+                iv=row["iv"].tobytes() if algo else b"",
+            )
+            # Cipher row j is parallel to shard blob j; place it at the
+            # combined position that blob adopted.
+            combined = shard.blob_map[shard.ciphers + j]
+            while len(bs.ciphers) < len(bs.blobs):
+                bs.ciphers.append(CipherRecord())
+            if algo:
+                bs.ciphers[combined] = cipher
         for row in ca:
             rec = ChunkRecord(
                 digest=row["digest"].tobytes(),
-                blob_index=int(row["blob_index"]),
+                blob_index=shard.blob_map[int(row["blob_index"])],
                 flags=int(row["flags"]),
                 uncompressed_offset=int(row["uoff"]),
                 compressed_offset=int(row["coff"]),
@@ -788,41 +1046,146 @@ class ServiceChunkDict:
             bs.chunks.append(rec)
             self._by_digest.setdefault(rec.digest, rec)
         for row in ta:
-            bs.batches.append(
-                BatchRecord(
-                    int(row["blob_index"]), int(row["coff"]),
-                    int(row["ubase"]), int(row["usize"]),
+            combined = shard.blob_map[int(row["blob_index"])]
+            key = (combined, int(row["coff"]))
+            if key not in self._batch_seen:
+                self._batch_seen.add(key)
+                bs.batches.append(
+                    BatchRecord(
+                        combined, int(row["coff"]),
+                        int(row["ubase"]), int(row["usize"]),
+                    )
                 )
-            )
-        self.epoch = meta["epoch"]
+        shard.chunks += len(ca)
+        shard.blobs += len(ba)
+        shard.batches += len(ta)
+        shard.ciphers += len(ea)
+        shard.epoch = meta["epoch"]
+        shard.rebuild_epoch = meta["rebuild_epoch"]
         return len(ca)
 
+    def sync(self) -> int:
+        """Replay every shard's service tail into the combined mirror;
+        returns how many chunk records arrived."""
+        got = 0
+        for shard in self._shards:
+            if len(self._shards) > 1:
+                failpoint.hit("dict.shard")
+                _SHARD_BATCHES.labels("sync").inc()
+            got += self._sync_shard(shard)
+        self.epoch = sum(s.epoch for s in self._shards)
+        return got
+
+    def _partition_bootstrap(self, data: bytes) -> list[Optional[bytes]]:
+        """Split one image's bootstrap into per-shard sub-bootstraps:
+        each shard receives exactly the chunks it owns (digest
+        rendezvous), with the blobs/ciphers/batches those chunks
+        reference, reindexed. Shards owning nothing get None."""
+        from nydus_snapshotter_tpu.models.bootstrap import (
+            BatchRecord,
+            Bootstrap,
+            ChunkRecord,
+            CipherRecord,
+        )
+
+        source = Bootstrap.from_bytes(data)
+        addrs = self.shard_addrs
+        subs: list[Optional[Bootstrap]] = [None] * len(addrs)
+        maps: list[dict[int, int]] = [{} for _ in addrs]
+        src_batches = {
+            (b.blob_index, b.compressed_offset): b for b in source.batches
+        }
+        batch_sent: list[set] = [set() for _ in addrs]
+        for rec in source.chunks:
+            i = shard_for(rec.digest, addrs)
+            sub = subs[i]
+            if sub is None:
+                sub = subs[i] = Bootstrap(chunk_size=source.chunk_size, inodes=[])
+            bmap = maps[i]
+            idx = bmap.get(rec.blob_index)
+            if idx is None:
+                idx = bmap[rec.blob_index] = len(sub.blobs)
+                sub.blobs.append(source.blobs[rec.blob_index])
+                cipher = source.cipher_for(rec.blob_index)
+                if cipher is not None or sub.ciphers:
+                    while len(sub.ciphers) < idx:
+                        sub.ciphers.append(CipherRecord())
+                    sub.ciphers.append(cipher or CipherRecord())
+            rec2 = ChunkRecord(**{**rec.__dict__})
+            rec2.blob_index = idx
+            sub.chunks.append(rec2)
+            batch = src_batches.get((rec.blob_index, rec.compressed_offset))
+            if (
+                batch is not None
+                and (idx, batch.compressed_offset) not in batch_sent[i]
+            ):
+                batch_sent[i].add((idx, batch.compressed_offset))
+                sub.batches.append(
+                    BatchRecord(
+                        idx, batch.compressed_offset,
+                        batch.uncompressed_base, batch.uncompressed_size,
+                    )
+                )
+        out: list[Optional[bytes]] = []
+        for sub in subs:
+            if sub is None:
+                out.append(None)
+                continue
+            if sub.ciphers:
+                while len(sub.ciphers) < len(sub.blobs):
+                    sub.ciphers.append(CipherRecord())
+            out.append(sub.to_bytes())
+        return out
+
     def add_bootstrap_bytes(self, data: bytes) -> int:
-        """Merge a converted image into the SERVICE dict, then pull the
-        resulting tail (including anything other converters added first)
-        into the mirror. Returns how many chunks this merge added."""
-        res = self.client.merge(data, self.namespace)
+        """Merge a converted image into the SERVICE dict (routed per
+        shard when the namespace is sharded), then pull the resulting
+        tails (including anything other converters added first) into the
+        mirror. Returns how many chunks this merge added."""
+        if len(self._shards) == 1:
+            res = self.client.merge(data, self.namespace)
+            added = int(res.get("added", 0))
+        else:
+            added = 0
+            for shard, sub in zip(self._shards, self._partition_bootstrap(data)):
+                if sub is None:
+                    continue
+                failpoint.hit("dict.shard")
+                _SHARD_BATCHES.labels("merge").inc()
+                res = shard.client.merge(sub, self.namespace)
+                added += int(res.get("added", 0))
         self.sync()
-        return int(res.get("added", 0))
+        return added
 
     def add_bootstrap(self, source) -> int:
         return self.add_bootstrap_bytes(source.to_bytes())
 
     def save(self, path: str) -> None:
         """Service-side persistence: bootstrap interop file + epoch-stamped
-        probe index (see :meth:`ServiceDict.save`)."""
-        self.client.save(path, self.namespace)
+        probe index per shard (see :meth:`ServiceDict.save`). A sharded
+        namespace persists one partition per shard
+        (``<path>.shard<i>-of-<n>``)."""
+        if len(self._shards) == 1:
+            self.client.save(path, self.namespace)
+            return
+        n = len(self._shards)
+        for i, shard in enumerate(self._shards):
+            shard.client.save(f"{path}.shard{i}-of-{n}", self.namespace)
 
 
 def open_chunk_dict(arg: str):
     """Resolve a ``chunk_dict_path``-shaped argument: the
-    ``service://<uds-path>[#namespace]`` scheme connects a
-    :class:`ServiceChunkDict` mirror; anything else is the file-based
-    dict (``bootstrap=…`` prefixed or bare path, as before)."""
+    ``service://<uds-path>[,<uds-path>...][#namespace]`` scheme connects
+    a :class:`ServiceChunkDict` mirror (comma-separated addresses =
+    rendezvous-sharded namespace); anything else is the file-based dict
+    (``bootstrap=…`` prefixed or bare path, as before)."""
     if arg.startswith("service://"):
         rest = arg[len("service://"):]
-        sock, _, ns = rest.partition("#")
-        return ServiceChunkDict(DictClient(sock), ns or DEFAULT_NAMESPACE)
+        socks, _, ns = rest.partition("#")
+        clients = [
+            DictClient(s.strip()) for s in socks.split(",") if s.strip()
+        ]
+        return ServiceChunkDict(clients, ns or DEFAULT_NAMESPACE)
     from nydus_snapshotter_tpu.models.bootstrap import ChunkDict, parse_chunk_dict_arg
 
     return ChunkDict.from_path(parse_chunk_dict_arg(arg))

@@ -51,6 +51,16 @@ class HostHealth:
             self.down_until = self._clock() + self.cooldown
             self.consecutive_failures = 0
 
+    def mark_down(self, duration: Optional[float] = None) -> None:
+        """Externally-sourced cooldown (e.g. the fleet registry flagging a
+        member stale): trip immediately without burning the failure
+        budget, so the host recovers the instant the source clears."""
+        self.down_until = max(
+            self.down_until,
+            self._clock() + (self.cooldown if duration is None else duration),
+        )
+        self.consecutive_failures = 0
+
 
 def split_mirror_host(mirror_host: str) -> tuple[str, bool]:
     """``https://mirror:5000`` → (netloc, plain_http)."""

@@ -387,3 +387,26 @@ class SociStreamReader:
         offsets against a compressed blob would warm garbage)."""
         _, comp_start, comp_end = self.index.resolve(offset, size)
         return comp_start, comp_end
+
+
+def warm_list_from_index(index, paths: list[str]) -> tuple[list, list[str]]:
+    """The soci index as a prefetch-trace source: translate an ordered
+    path list through the index's self-contained file → decompressed-
+    extent map into ``(path, comp_start, comp_end)`` compressed warm
+    ranges, one per file (vs one per bootstrap chunk record — the replay
+    issues whole-file ranges the fetch scheduler then coalesces).
+    Returns the warm list plus the paths the index doesn't map (the
+    caller replays those through the bootstrap as before). The ranges
+    are warmed at PREFETCH lane priority by the caller; order is the
+    trace's access order, which IS the replay priority."""
+    warms = []
+    missing: list[str] = []
+    for path in paths:
+        ext = index.file_extent(_norm_path(path))
+        if ext is None:
+            missing.append(path)
+            continue
+        uoff, usize = ext
+        _, comp_start, comp_end = index.resolve(uoff, usize)
+        warms.append((path, comp_start, comp_end))
+    return warms, missing

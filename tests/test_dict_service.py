@@ -337,3 +337,176 @@ class TestOpenChunkDict:
         cd = open_chunk_dict(p)
         assert isinstance(cd, ChunkDict)
         assert len(cd) == len(bc.dict)
+
+
+# ---------------------------------------------------------------------------
+# Sharded service: namespace key-space split across N service processes
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def shard_pool(tmp_path):
+    """Factory: spin up N DictService processes on tmp UDS paths."""
+    started = []
+
+    def make(n: int):
+        svcs = []
+        for i in range(n):
+            svc = DictService()
+            svc.run(str(tmp_path / f"shard{len(started)}_{i}.sock"))
+            svcs.append(svc)
+        started.extend(svcs)
+        return svcs
+
+    yield make
+    for svc in started:
+        svc.stop()
+
+
+class TestShardRouting:
+    def test_shard_for_stable_and_order_insensitive_scores(self):
+        from nydus_snapshotter_tpu.parallel.dict_service import shard_for
+
+        addrs = [f"/run/s{i}.sock" for i in range(4)]
+        digs = [bytes([i]) * 32 for i in range(64)]
+        owners = [shard_for(d, addrs) for d in digs]
+        assert owners == [shard_for(d, addrs) for d in digs]  # deterministic
+        assert len(set(owners)) > 1  # actually spreads
+        # single shard short-circuits
+        assert all(shard_for(d, addrs[:1]) == 0 for d in digs)
+
+    def test_partition_covers_every_digest_once(self):
+        from nydus_snapshotter_tpu.parallel.dict_service import partition_digests
+
+        addrs = [f"/run/s{i}.sock" for i in range(3)]
+        digs = [bytes([i % 251]) * 32 for i in range(300)]
+        parts = partition_digests(digs, addrs)
+        seen = sorted(p for part in parts for p in part)
+        assert seen == list(range(len(digs)))
+
+
+class TestShardedServiceIdentity:
+    @pytest.mark.parametrize("shards", [2, 4])
+    def test_output_identical_to_private_and_single_service(
+        self, shard_pool, shards
+    ):
+        """ISSUE 13 acceptance: sharded dict service output byte-identical
+        to the single-service path at 1/2/4 shards (1 = the existing
+        TestBatchByteIdentity pin)."""
+        images = [(f"img{k}", mk_image(300 + k)) for k in range(6)]
+        r_local = BatchConverter(OPT).convert_many(images)
+        svcs = shard_pool(shards)
+        addrs = ",".join(s.sock_path for s in svcs)
+        bc = BatchConverter(OPT, dict_service=addrs, namespace="shrd")
+        r_shard = bc.convert_many(images)
+        assert [r.bootstrap for r in r_local] == [r.bootstrap for r in r_shard]
+        assert [r.blob_digests for r in r_local] == [
+            r.blob_digests for r in r_shard
+        ]
+        assert [r.new_dict_chunks for r in r_local] == [
+            r.new_dict_chunks for r in r_shard
+        ]
+        assert bc.dict.n_shards == shards
+        # the key-space actually split: more than one shard holds chunks
+        per_shard = [e["chunks"] for e in bc.dict.shard_epochs()]
+        assert sum(per_shard) == len(bc.dict)
+        assert sum(1 for c in per_shard if c) > 1
+
+    def test_two_sharded_converters_share_the_table(self, shard_pool):
+        svcs = shard_pool(2)
+        addrs = ",".join(s.sock_path for s in svcs)
+        a = BatchConverter(OPT, dict_service=addrs, namespace="sh2")
+        b = BatchConverter(OPT, dict_service=addrs, namespace="sh2")
+        res_a = a.convert_image("a", mk_image(7))
+        b.dict.sync()
+        res_b = b.convert_image("b", mk_image(7, files=6))
+        assert res_b.new_dict_chunks < res_a.new_dict_chunks
+
+    def test_open_chunk_dict_multi_addr(self, shard_pool):
+        svcs = shard_pool(2)
+        addrs = ",".join(s.sock_path for s in svcs)
+        cd = open_chunk_dict(f"service://{addrs}#multi")
+        assert isinstance(cd, ServiceChunkDict)
+        assert cd.n_shards == 2
+
+
+class TestShardedEpochReconciliation:
+    def test_entries_since_tail_and_count_only(self, service):
+        from nydus_snapshotter_tpu.parallel.sharded_dict import DictEpochError
+
+        cli = DictClient(service.sock_path)
+        bc = BatchConverter(OPT)
+        res = bc.convert_image("img", mk_image(21))
+        cli.merge(res.bootstrap, "since")
+        meta, digs, vals = cli.entries_since("since", epoch=0)
+        assert meta["entries"] == len(bc.dict) == len(vals)
+        assert digs.shape == (len(vals), 8)
+        meta2, d2, v2 = cli.entries_since("since", epoch=0, count_only=True)
+        assert meta2["entries"] == meta["entries"]
+        assert len(d2) == len(v2) == 0
+        # caught-up caller gets an empty tail at the current epoch
+        meta3, d3, _v3 = cli.entries_since("since", epoch=meta["epoch"])
+        assert meta3["entries"] == 0 and meta3["epoch"] == meta["epoch"]
+        assert isinstance(DictEpochError("x"), RuntimeError)
+
+    def test_compacted_journal_is_a_409_epoch_error(self, service):
+        from nydus_snapshotter_tpu.parallel.sharded_dict import DictEpochError
+
+        cli = DictClient(service.sock_path)
+        sd = service.dict_for("cmp")
+        bc = BatchConverter(OPT)
+        cli.merge(bc.convert_image("img", mk_image(22)).bootstrap, "cmp")
+        # Force a rebuild/compaction: the journal before it is gone.
+        with sd._mu:
+            sd.index._rebuild()
+        with pytest.raises(DictEpochError):
+            cli.entries_since("cmp", epoch=0)
+
+    def test_shard_restart_detected_loudly(self, tmp_path):
+        """A shard that restarts with a younger table must not silently
+        resume the record tail mid-stream: sync raises DictEpochError."""
+        from nydus_snapshotter_tpu.parallel.sharded_dict import DictEpochError
+
+        sock = str(tmp_path / "restart.sock")
+        svc = DictService()
+        svc.run(sock)
+        try:
+            bc = BatchConverter(OPT, dict_service=sock, namespace="rst")
+            bc.convert_image("img", mk_image(23))
+            assert bc.dict._shards[0].epoch > 0
+            svc.stop()
+            svc = DictService()  # fresh, empty table on the same address
+            svc.run(sock)
+            bc.dict.client.close()
+            with pytest.raises(DictEpochError, match="backwards"):
+                bc.dict.sync()
+        finally:
+            svc.stop()
+
+
+class TestShardChaos:
+    def test_dict_shard_failpoint_fails_merge_loudly(self, shard_pool):
+        svcs = shard_pool(2)
+        addrs = ",".join(s.sock_path for s in svcs)
+        bc = BatchConverter(OPT, dict_service=addrs, namespace="chaos")
+        failpoint.inject("dict.shard", "error(OSError:shard-chaos)*1")
+        with pytest.raises(OSError, match="shard-chaos"):
+            bc.convert_image("img", mk_image(25))
+        failpoint.clear("dict.shard")
+        # one-shot fault: the next image converts and dedups normally
+        res = bc.convert_image("img", mk_image(25))
+        assert res.new_dict_chunks > 0
+
+    def test_dead_shard_surfaces_not_corrupts(self, shard_pool, tmp_path):
+        svcs = shard_pool(2)
+        addrs = ",".join(s.sock_path for s in svcs)
+        bc = BatchConverter(OPT, dict_service=addrs, namespace="dead")
+        bc.convert_image("img", mk_image(26))
+        svcs[1].stop()
+        for sh in bc.dict._shards:
+            # a crashed process drops its connections; ThreadingHTTPServer
+            # shutdown alone leaves kept-alive handler threads serving
+            sh.client.close()
+        with pytest.raises((DictServiceError, OSError)):
+            for k in range(8):  # enough images that shard 1 owns something
+                bc.convert_image(f"img{k}", mk_image(400 + k))

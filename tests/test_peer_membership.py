@@ -1,0 +1,360 @@
+"""Dynamic peer membership: fleet-registry discovery, rendezvous
+minimal-churn ownership, staleness cooldown, chaos, and the fleet
+/api/v1/fleet/peers route."""
+
+import threading
+import time
+
+import pytest
+
+from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.daemon import peer
+from nydus_snapshotter_tpu.remote.mirror import HostHealthRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoint.clear()
+    yield
+    failpoint.clear()
+
+
+def mk_membership(rows, seed=(), clock=None, registry=None, refresh=1.0):
+    return peer.PeerMembership(
+        seed=list(seed),
+        fetch=lambda: [dict(r) for r in rows],
+        refresh_secs=refresh,
+        clock=clock or time.monotonic,
+        health_registry=registry or HostHealthRegistry(),
+    )
+
+
+class TestMembershipView:
+    def test_registry_rows_become_live_set(self):
+        rows = [{"address": f"/run/p{i}.sock"} for i in range(3)]
+        m = mk_membership(rows)
+        assert m.addresses() == sorted(r["address"] for r in rows)
+        assert m.epoch == 1
+
+    def test_join_and_leave_bump_epoch_and_log_events(self):
+        clock = [0.0]
+        rows = [{"address": "/run/a.sock"}, {"address": "/run/b.sock"}]
+        m = mk_membership(rows, clock=lambda: clock[0])
+        m.addresses()
+        e0 = m.epoch
+        rows.append({"address": "/run/c.sock"})
+        clock[0] += 2
+        assert "/run/c.sock" in m.addresses()
+        assert m.epoch == e0 + 1
+        rows.pop(0)
+        clock[0] += 2
+        assert "/run/a.sock" not in m.addresses()
+        assert m.epoch == e0 + 2
+        kinds = [(e["kind"], e["address"]) for e in m.snapshot()["events"]]
+        assert ("join", "/run/c.sock") in kinds
+        assert ("leave", "/run/a.sock") in kinds
+
+    def test_unchanged_listing_keeps_epoch(self):
+        clock = [0.0]
+        rows = [{"address": "/run/a.sock"}]
+        m = mk_membership(rows, clock=lambda: clock[0])
+        m.addresses()
+        e0 = m.epoch
+        for _ in range(5):
+            clock[0] += 2
+            m.addresses()
+        assert m.epoch == e0
+
+    def test_refresh_rate_limited(self):
+        calls = [0]
+
+        def fetch():
+            calls[0] += 1
+            return [{"address": "/run/a.sock"}]
+
+        clock = [0.0]
+        m = peer.PeerMembership(
+            fetch=fetch, refresh_secs=1.0, clock=lambda: clock[0],
+            health_registry=HostHealthRegistry(),
+        )
+        for _ in range(10):
+            m.addresses()
+        assert calls[0] == 1
+        clock[0] += 2
+        m.addresses()
+        assert calls[0] == 2
+
+    def test_empty_registry_falls_back_to_seed(self):
+        m = mk_membership([], seed=["/run/seed.sock"])
+        assert m.addresses() == ["/run/seed.sock"]
+
+    def test_fetch_error_keeps_last_good_view(self):
+        clock = [0.0]
+        state = {"fail": False}
+
+        def fetch():
+            if state["fail"]:
+                raise OSError("controller down")
+            return [{"address": "/run/a.sock"}]
+
+        m = peer.PeerMembership(
+            seed=["/run/seed.sock"], fetch=fetch, refresh_secs=1.0,
+            clock=lambda: clock[0], health_registry=HostHealthRegistry(),
+        )
+        assert m.addresses() == ["/run/a.sock"]
+        state["fail"] = True
+        clock[0] += 2
+        # discovery outage: stale view, NOT an empty cluster / seed flap
+        assert m.addresses() == ["/run/a.sock"]
+        assert m.snapshot()["last_error"]
+
+    def test_down_member_cools_down_and_leaves_live_set(self):
+        reg = HostHealthRegistry()
+        rows = [
+            {"address": "/run/a.sock"},
+            {"address": "/run/b.sock", "up": False},
+        ]
+        m = mk_membership(rows, registry=reg)
+        assert m.addresses() == ["/run/a.sock"]
+        assert not reg.health_for("/run/b.sock").available()
+
+    def test_stale_member_cools_down(self):
+        reg = HostHealthRegistry()
+        rows = [{"address": "/run/a.sock", "stale": True}]
+        m = mk_membership(rows, seed=["/run/x.sock"], registry=reg)
+        # only-stale listing: seed floor holds, stale member on cooldown
+        assert m.addresses() == ["/run/x.sock"]
+        assert not reg.health_for("/run/a.sock").available()
+
+    def test_peer_member_chaos_keeps_last_good(self):
+        clock = [0.0]
+        rows = [{"address": "/run/a.sock"}]
+        m = mk_membership(rows, clock=lambda: clock[0])
+        assert m.addresses() == ["/run/a.sock"]
+        rows.append({"address": "/run/b.sock"})
+        clock[0] += 2
+        with failpoint.injected("peer.member", "error(OSError:chaos)*1"):
+            assert m.addresses() == ["/run/a.sock"]  # refresh failed, kept
+        clock[0] += 2
+        assert "/run/b.sock" in m.addresses()  # next refresh catches up
+
+    def test_concurrent_addresses_single_refresh(self):
+        calls = [0]
+        gate = threading.Event()
+
+        def fetch():
+            calls[0] += 1
+            gate.wait(0.2)
+            return [{"address": "/run/a.sock"}]
+
+        m = peer.PeerMembership(
+            fetch=fetch, refresh_secs=0.0, clock=time.monotonic,
+            health_registry=HostHealthRegistry(),
+        )
+        threads = [threading.Thread(target=m.addresses) for _ in range(8)]
+        for t in threads:
+            t.start()
+        gate.set()
+        for t in threads:
+            t.join()
+        # refresh_secs=0 but the in-progress flag serializes: no stampede
+        assert calls[0] <= 3
+
+
+class TestRouterWithMembership:
+    def test_router_reshapes_on_membership_change(self):
+        clock = [0.0]
+        rows = [{"address": f"/run/p{i}.sock"} for i in range(4)]
+        m = mk_membership(rows, clock=lambda: clock[0])
+        r = peer.PeerRouter([], region_bytes=64 << 10, membership=m,
+                            health_registry=HostHealthRegistry())
+        owners_before = {
+            off: r.ranked("blob", off)[0] for off in range(0, 1 << 21, 64 << 10)
+        }
+        rows.append({"address": "/run/p4.sock"})
+        clock[0] += 2
+        owners_after = {
+            off: r.ranked("blob", off)[0] for off in range(0, 1 << 21, 64 << 10)
+        }
+        assert owners_before != owners_after  # the joiner won something
+        moved = sum(
+            1 for off in owners_before if owners_before[off] != owners_after[off]
+        )
+        # every move must be TO the joiner (minimal churn: nothing else
+        # re-shuffles)
+        for off in owners_before:
+            if owners_before[off] != owners_after[off]:
+                assert owners_after[off] == "/run/p4.sock"
+        assert moved > 0
+
+    def test_static_router_unchanged_without_membership(self):
+        r = peer.PeerRouter(["/run/a.sock"], region_bytes=1 << 20)
+        assert r.current_peers() == ["/run/a.sock"]
+
+
+class TestRendezvousMinimalChurn:
+    """ISSUE 13 satellite: a join/leave event remaps <= ~K/n + slack
+    region ownerships and never remaps a key whose owner is unchanged."""
+
+    KEYS = [(f"blob{b}", off << 19) for b in range(11) for off in range(100)]
+
+    @staticmethod
+    def owners(addrs):
+        r = peer.PeerRouter(list(addrs), region_bytes=512 << 10,
+                            health_registry=HostHealthRegistry())
+        return {k: r.ranked(k[0], k[1])[0] for k in TestRendezvousMinimalChurn.KEYS}
+
+    @pytest.mark.parametrize("n", [4, 8, 16])
+    def test_join_moves_about_one_nth(self, n):
+        before = self.owners([f"h{i}" for i in range(n)])
+        after = self.owners([f"h{i}" for i in range(n + 1)])
+        moved = [k for k in self.KEYS if before[k] != after[k]]
+        frac = len(moved) / len(self.KEYS)
+        expect = 1.0 / (n + 1)
+        # binomial slack: 60% relative tolerance over the K/n expectation
+        assert frac <= expect * 1.6, f"join churn {frac:.3f} > {expect:.3f}+slack"
+        # every moved key moved TO the joiner; unmoved keys kept owners
+        assert all(after[k] == f"h{n}" for k in moved)
+
+    @pytest.mark.parametrize("n", [4, 8, 16])
+    def test_leave_moves_only_the_leavers_keys(self, n):
+        before = self.owners([f"h{i}" for i in range(n)])
+        after = self.owners([f"h{i}" for i in range(n - 1)])  # h{n-1} left
+        for k in self.KEYS:
+            if before[k] == f"h{n - 1}":
+                assert after[k] != f"h{n - 1}"
+            else:
+                # a key whose owner survives NEVER remaps
+                assert after[k] == before[k]
+        frac = sum(1 for k in self.KEYS if before[k] != after[k]) / len(self.KEYS)
+        assert frac <= (1.0 / n) * 1.6
+
+    def test_ownership_deterministic_across_routers(self):
+        a = self.owners([f"h{i}" for i in range(8)])
+        b = self.owners([f"h{i}" for i in range(7, -1, -1)])  # order-insensitive
+        assert a == b
+
+
+class TestFleetPeersRoute:
+    def test_peer_listing_flags_and_annotations(self):
+        from nydus_snapshotter_tpu import fleet
+
+        cfg = fleet.FleetRuntimeConfig(enable=True, scrape_interval_secs=60)
+        plane = fleet.FleetPlane(cfg=cfg)
+        plane.registry.register(fleet.Member(
+            name="p1", component="peer", address="/run/p1.sock", pid=101))
+        plane.registry.register(fleet.Member(
+            name="d1", component="daemon", address="/run/api1.sock", pid=102,
+            extra={"peer_listen": "/run/peer1.sock"}))
+        plane.registry.register(fleet.Member(
+            name="d2", component="daemon", address="/run/api2.sock", pid=103))
+        rows = {r["name"]: r for r in plane.peer_listing()}
+        assert rows["p1"]["address"] == "/run/p1.sock"
+        assert rows["d1"]["address"] == "/run/peer1.sock"  # annotated daemon
+        assert "d2" not in rows  # no peer surface, not a peer
+        # never-scraped members count as up (not shunned at birth)
+        assert rows["p1"]["up"] and not rows["p1"]["stale"]
+
+    def test_route_served_over_handle(self):
+        import json
+
+        from nydus_snapshotter_tpu import fleet
+
+        cfg = fleet.FleetRuntimeConfig(enable=True, scrape_interval_secs=60)
+        plane = fleet.FleetPlane(cfg=cfg)
+        plane.registry.register(fleet.Member(
+            name="p1", component="peer", address="/run/p1.sock", pid=11))
+        status, ctype, body = plane.handle(
+            "GET", "/api/v1/fleet/peers", {}, b"")
+        assert status == 200
+        rows = json.loads(body)
+        assert rows and rows[0]["address"] == "/run/p1.sock"
+
+
+class TestLiveChurnEndToEnd:
+    def test_reads_survive_join_and_deregistered_death(self, tmp_path):
+        """Two serving peers on a dynamic listing; one dies AND leaves
+        the listing, a third joins — reads stay byte-identical
+        throughout, no config edit anywhere."""
+        import hashlib
+
+        from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+        from nydus_snapshotter_tpu.daemon.fetch_sched import (
+            AdmissionGate,
+            FetchConfig,
+            MemoryBudget,
+        )
+
+        blob = bytes(range(256)) * 4096  # 1 MiB
+        blob_id = "cd" * 32
+        health = HostHealthRegistry()
+        rows = []
+        listing_lock = threading.Lock()
+
+        def fetch_rows():
+            with listing_lock:
+                return [dict(r) for r in rows]
+
+        servers = {}
+
+        def start_server(i):
+            addr = str(tmp_path / f"p{i}.sock")
+            cb = CachedBlob(
+                str(tmp_path / f"cache{i}"), blob_id,
+                lambda off, size: blob[off:off + size], blob_size=len(blob),
+                config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0),
+            )
+            cb.read_at(0, len(blob))  # warmed: serves cover-only
+            export = peer.PeerExport()
+            export.register(blob_id, cb)
+            srv = peer.PeerChunkServer(
+                export,
+                gate=AdmissionGate(budget=MemoryBudget(8 << 20), name=f"p{i}"),
+                pull_through=True,
+            )
+            srv.run(addr)
+            servers[i] = (srv, cb, addr)
+            with listing_lock:
+                rows.append({"address": addr, "up": True, "stale": False})
+            return addr
+
+        try:
+            start_server(0)
+            start_server(1)
+            membership = peer.PeerMembership(
+                fetch=fetch_rows, refresh_secs=0.05, health_registry=health,
+            )
+            router = peer.PeerRouter(
+                [], region_bytes=64 << 10, membership=membership,
+                health_registry=health,
+            )
+            fetcher = peer.PeerAwareFetcher(
+                blob_id, lambda off, size: blob[off:off + size], router,
+                timeout_s=2.0,
+            )
+            reader = CachedBlob(
+                str(tmp_path / "reader"), blob_id, fetcher.read_range,
+                blob_size=len(blob),
+                config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0),
+            )
+            h = hashlib.sha256()
+            quarter = len(blob) // 4
+            h.update(reader.read_at(0, quarter))
+            # death + deregistration of peer 0 mid-read
+            srv0, cb0, addr0 = servers.pop(0)
+            with listing_lock:
+                rows[:] = [r for r in rows if r["address"] != addr0]
+            srv0.stop()
+            cb0.close()
+            h.update(reader.read_at(quarter, quarter))
+            # a third peer joins
+            start_server(2)
+            time.sleep(0.1)  # one refresh interval
+            h.update(reader.read_at(2 * quarter, 2 * quarter))
+            assert h.hexdigest() == hashlib.sha256(blob).hexdigest()
+            assert membership.epoch >= 3  # initial + leave + join
+            reader.close()
+        finally:
+            for srv, cb, _addr in servers.values():
+                srv.stop()
+                cb.close()

@@ -590,7 +590,7 @@ class TestMiniStorm:
 
         blob = random.Random(5).randbytes(512 << 10)
         registry = StormRegistry(blob, latency_s=0.001, mibps=64.0)
-        wall, egress, calls, digests = _run_storm(
+        wall, egress, calls, digests, _peak = _run_storm(
             str(tmp_path), blob, "ee" * 32, 4, True, registry
         )
         oracle = hashlib.sha256(blob).hexdigest()
@@ -604,7 +604,7 @@ class TestMiniStorm:
 
         blob = random.Random(6).randbytes(256 << 10)
         registry = StormRegistry(blob, latency_s=0.001, mibps=64.0)
-        _, _, _, digests = _run_storm(
+        _, _, _, digests, _peak = _run_storm(
             str(tmp_path), blob, "ee" * 32, 4, True, registry, kill_at_frac=0.25
         )
         oracle = hashlib.sha256(blob).hexdigest()

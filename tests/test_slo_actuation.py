@@ -1,0 +1,397 @@
+"""SLO-driven admission actuation: lane caps/shedding on the gate,
+the actuator's escalate/restore ladder, the member-side follower,
+demand-read protection in the blobcache, and chaos on slo.actuate."""
+
+import threading
+import time
+
+import pytest
+
+from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.daemon import fetch_sched
+from nydus_snapshotter_tpu.daemon.fetch_sched import (
+    DEMAND,
+    PEER_SERVE,
+    PREFETCH,
+    READAHEAD,
+    AdmissionGate,
+    LaneShedError,
+)
+from nydus_snapshotter_tpu.metrics.slo import (
+    SloActuationFollower,
+    SloActuator,
+    SloEngine,
+    SloObjective,
+    SloSpecError,
+    resolve_slo_actuation,
+)
+from nydus_snapshotter_tpu.parallel.pipeline import MemoryBudget
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoint.clear()
+    yield
+    failpoint.clear()
+
+
+def mk_gate(**kw):
+    kw.setdefault("budget", MemoryBudget(8 << 20))
+    kw.setdefault("max_concurrent", 4)
+    kw.setdefault("name", "t")
+    return AdmissionGate(**kw)
+
+
+class FakeEngine:
+    """Engine stand-in: tests drive breach/burn state directly."""
+
+    def __init__(self):
+        self.b: list = []
+        self.burn = 0.0
+
+    def breached(self):
+        return list(self.b)
+
+    def max_burn_short(self):
+        return self.burn
+
+
+class TestGateLaneActuation:
+    def test_shed_lane_rejects_immediately(self):
+        g = mk_gate()
+        g.set_lane_cap(PEER_SERVE, 0)
+        with pytest.raises(LaneShedError):
+            g.acquire(100, lane=PEER_SERVE)
+        assert g.lane_state()["peer_serve"]["shed_total"] == 1
+        # demand is untouched
+        g.acquire(100, lane=DEMAND)
+        g.release(100, lane=DEMAND)
+
+    def test_queued_waiter_rejected_when_lane_sheds(self):
+        g = mk_gate(max_concurrent=1)
+        g.acquire(10, lane=DEMAND)  # occupy the only slot
+        err: list = []
+
+        def waiter():
+            try:
+                g.acquire(10, lane=PREFETCH)
+            except LaneShedError as e:
+                err.append(e)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.1)  # it is queued now
+        g.set_lane_cap(PREFETCH, 0)
+        t.join(timeout=5)
+        assert not t.is_alive() and err
+        g.release(10, lane=DEMAND)
+
+    def test_partial_cap_bounds_lane_in_service(self):
+        g = mk_gate(max_concurrent=8)
+        g.set_lane_cap(READAHEAD, 1)
+        g.acquire(10, lane=READAHEAD)
+        blocked = threading.Event()
+
+        def second():
+            g.acquire(10, lane=READAHEAD)
+            blocked.set()
+            g.release(10, lane=READAHEAD)
+
+        t = threading.Thread(target=second, daemon=True)
+        t.start()
+        assert not blocked.wait(0.3)  # capped at 1 in service
+        g.release(10, lane=READAHEAD)
+        assert blocked.wait(5)  # released slot admits the waiter
+        t.join()
+
+    def test_restore_reopens_lane(self):
+        g = mk_gate()
+        g.set_lane_cap(PEER_SERVE, 0)
+        g.set_lane_cap(PEER_SERVE, None)
+        g.acquire(10, lane=PEER_SERVE)
+        g.release(10, lane=PEER_SERVE)
+
+    def test_demand_lane_not_actuatable(self):
+        g = mk_gate()
+        with pytest.raises(ValueError):
+            g.set_lane_cap(DEMAND, 0)
+
+    def test_release_lane_accounting(self):
+        g = mk_gate()
+        g.acquire(10, lane=PREFETCH)
+        assert g.lane_state()["prefetch"]["in_service"] == 1
+        g.release(10, lane=PREFETCH)
+        assert g.lane_state()["prefetch"]["in_service"] == 0
+
+    def test_snapshot_carries_actuation_view(self):
+        g = mk_gate()
+        g.set_lane_cap(PREFETCH, 0)
+        snap = g.snapshot()
+        assert snap["lane_caps"]["prefetch"] == 0
+        assert snap["lane_caps"]["demand"] is None
+
+
+class TestDemandProtection:
+    def test_demand_read_survives_shed_background_flight(self, tmp_path):
+        """A demand read that piggybacks on a readahead flight the
+        actuation shed must REPLAN at demand priority, not fail."""
+        from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+        from nydus_snapshotter_tpu.daemon.fetch_sched import FetchConfig
+
+        blob = bytes(range(256)) * 2048  # 512 KiB
+        gate = mk_gate()
+        cb = CachedBlob(
+            str(tmp_path / "c"), "ee" * 32,
+            lambda off, size: blob[off:off + size], blob_size=len(blob),
+            config=FetchConfig(fetch_workers=2, merge_gap=0,
+                               readahead=128 << 10),
+            gate=gate,
+        )
+        try:
+            gate.set_lane_cap(READAHEAD, 0)
+            gate.set_lane_cap(PREFETCH, 0)
+            # sequential reads spawn readahead flights that shed; demand
+            # bytes must still come back correct
+            got = b"".join(
+                cb.read_at(off, 64 << 10) for off in range(0, len(blob), 64 << 10)
+            )
+            assert got == blob
+            # prefetch warming degrades (contained), never demand
+            flights = cb.warm(0, 64 << 10)
+            assert all(
+                f.error is None or isinstance(f.error, LaneShedError)
+                for f in flights
+            )
+        finally:
+            cb.close()
+
+    def test_peer_serve_read_fails_fast_when_shed(self, tmp_path):
+        from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+        from nydus_snapshotter_tpu.daemon.fetch_sched import FetchConfig
+
+        blob = b"x" * (64 << 10)
+        gate = mk_gate()
+        cb = CachedBlob(
+            str(tmp_path / "c"), "ff" * 32,
+            lambda off, size: blob[off:off + size], blob_size=len(blob),
+            config=FetchConfig(fetch_workers=1, merge_gap=0, readahead=0),
+            gate=gate,
+        )
+        try:
+            gate.set_lane_cap(PEER_SERVE, 0)
+            with pytest.raises(OSError):
+                cb.read_at(0, 1024, lane=PEER_SERVE)
+            gate.set_lane_cap(PEER_SERVE, None)
+            assert cb.read_at(0, 1024) == blob[:1024]
+        finally:
+            cb.close()
+
+
+class TestActuator:
+    def test_escalates_one_lane_per_tick_and_restores_in_reverse(self):
+        g = mk_gate()
+        eng = FakeEngine()
+        act = SloActuator(eng, gate=g)
+        eng.b = ["obj"]
+        e1 = act.tick()
+        assert (e1["action"], e1["lane"]) == ("shed", "peer_serve")
+        e2 = act.tick()
+        assert (e2["action"], e2["lane"]) == ("shed", "prefetch")
+        e3 = act.tick()
+        assert (e3["action"], e3["lane"]) == ("shed", "readahead")
+        assert act.tick() is None  # ladder exhausted, holds
+        assert act.state()["shed_lanes"] == ["peer_serve", "prefetch", "readahead"]
+        eng.b, eng.burn = [], 0.5
+        r1 = act.tick()
+        assert (r1["action"], r1["lane"]) == ("restore", "readahead")
+        assert act.tick()["lane"] == "prefetch"
+        assert act.tick()["lane"] == "peer_serve"
+        assert act.tick() is None
+        assert act.state()["shed_lanes"] == []
+
+    def test_no_restore_while_burn_high(self):
+        g = mk_gate()
+        eng = FakeEngine()
+        act = SloActuator(eng, gate=g, restore_burn=1.0)
+        eng.b = ["obj"]
+        act.tick()
+        eng.b, eng.burn = [], 1.5  # breach cleared but burn still hot
+        assert act.tick() is None
+        assert act.state()["shed_depth"] == 1
+
+    def test_demand_lane_rejected_in_config(self):
+        with pytest.raises(SloSpecError):
+            SloActuator(FakeEngine(), gate=mk_gate(), shed_lanes=["demand"])
+        with pytest.raises(SloSpecError):
+            SloActuator(FakeEngine(), gate=mk_gate(), shed_lanes=["bogus"])
+
+    def test_slo_actuate_chaos_surfaces(self):
+        g = mk_gate()
+        eng = FakeEngine()
+        act = SloActuator(eng, gate=g)
+        eng.b = ["obj"]
+        with failpoint.injected("slo.actuate", "error(OSError:chaos)*1"):
+            with pytest.raises(OSError, match="chaos"):
+                act.tick()
+        # one-shot: the next tick actuates (the fleet loop catches and
+        # retries next round — this pins that the fault doesn't wedge)
+        assert act.tick()["action"] == "shed"
+
+    def test_actuations_metered(self):
+        from nydus_snapshotter_tpu.metrics.slo import SLO_ACTUATIONS
+
+        base = SLO_ACTUATIONS.value("shed", "peer_serve")
+        eng = FakeEngine()
+        act = SloActuator(eng, gate=mk_gate())
+        eng.b = ["obj"]
+        act.tick()
+        assert SLO_ACTUATIONS.value("shed", "peer_serve") == base + 1
+
+
+class TestEngineActuatorLoop:
+    def test_real_engine_breach_drives_shed_and_restore(self):
+        """End-to-end on a real engine with a controlled clock: a latency
+        regression on the histogram sheds lanes; recovery restores."""
+        from nydus_snapshotter_tpu.metrics import registry as _metrics
+
+        reg = _metrics.Registry()
+        hist = reg.register(_metrics.Histogram(
+            "ntpu_slo_test_op_ms", "t", ("op",)))
+        clock = [0.0]
+        obj = SloObjective(
+            name="t", metric="ntpu_slo_test_op_ms", labels={"op": "x"},
+            threshold_ms=50.0, target=0.9, window_secs=10.0,
+            long_window_factor=2.0, burn_threshold=2.0,
+        )
+        from nydus_snapshotter_tpu.metrics.slo import local_source
+
+        eng = SloEngine([obj], source=local_source(reg),
+                        clock=lambda: clock[0])
+        g = mk_gate()
+        act = SloActuator(eng, gate=g, clock=lambda: clock[0])
+        # healthy traffic
+        for _ in range(10):
+            for _i in range(5):
+                hist.labels("x").observe(5.0)
+            eng.tick()
+            act.tick()
+            clock[0] += 5
+        assert act.state()["shed_depth"] == 0
+        # regression: every op over threshold
+        for _ in range(10):
+            for _i in range(5):
+                hist.labels("x").observe(500.0)
+            eng.tick()
+            act.tick()
+            clock[0] += 5
+        assert act.state()["shed_depth"] > 0
+        assert eng.status()["breaches"]
+        with pytest.raises(LaneShedError):
+            g.acquire(1, lane=PEER_SERVE)
+        # recovery
+        for _ in range(20):
+            for _i in range(20):
+                hist.labels("x").observe(5.0)
+            eng.tick()
+            act.tick()
+            clock[0] += 5
+        assert act.state()["shed_depth"] == 0
+        g.acquire(1, lane=PEER_SERVE)
+        g.release(1, lane=PEER_SERVE)
+
+
+class TestFollower:
+    def test_follower_applies_and_clears_published_state(self):
+        g = mk_gate()
+        published = {"shed_lanes": ["peer_serve"]}
+        f = SloActuationFollower("unused", gate=g, fetch=lambda: dict(published))
+        assert f.poll_once()
+        with pytest.raises(LaneShedError):
+            g.acquire(1, lane=PEER_SERVE)
+        published["shed_lanes"] = ["peer_serve", "prefetch"]
+        assert f.poll_once()
+        with pytest.raises(LaneShedError):
+            g.acquire(1, lane=PREFETCH)
+        published["shed_lanes"] = []
+        assert f.poll_once()
+        g.acquire(1, lane=PEER_SERVE)
+        g.release(1, lane=PEER_SERVE)
+
+    def test_poll_failure_keeps_last_state(self):
+        g = mk_gate()
+        state = {"fail": False}
+
+        def fetch():
+            if state["fail"]:
+                raise OSError("controller down")
+            return {"shed_lanes": ["prefetch"]}
+
+        f = SloActuationFollower("unused", gate=g, fetch=fetch)
+        f.poll_once()
+        state["fail"] = True
+        assert not f.poll_once()  # unchanged, no flap
+        with pytest.raises(LaneShedError):
+            g.acquire(1, lane=PREFETCH)
+
+    def test_stop_restores_everything(self):
+        g = mk_gate()
+        f = SloActuationFollower(
+            "unused", gate=g, fetch=lambda: {"shed_lanes": ["peer_serve"]}
+        )
+        f.poll_once()
+        f.stop()
+        g.acquire(1, lane=PEER_SERVE)
+        g.release(1, lane=PEER_SERVE)
+
+    def test_follower_never_sheds_demand(self):
+        g = mk_gate()
+        f = SloActuationFollower(
+            "unused", gate=g, fetch=lambda: {"shed_lanes": ["demand", "prefetch"]}
+        )
+        f.poll_once()
+        g.acquire(1, lane=DEMAND)
+        g.release(1, lane=DEMAND)
+        with pytest.raises(LaneShedError):
+            g.acquire(1, lane=PREFETCH)
+
+
+class TestConfigResolution:
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("NTPU_SLO_ACTUATE", "1")
+        monkeypatch.setenv("NTPU_SLO_SHED_LANES", "peer_serve,readahead")
+        monkeypatch.setenv("NTPU_SLO_RESTORE_BURN", "0.5")
+        actuate, lanes, restore = resolve_slo_actuation()
+        assert actuate
+        assert lanes == ["peer_serve", "readahead"]
+        assert restore == 0.5
+
+    def test_config_section_validation(self):
+        from nydus_snapshotter_tpu.config.config import ConfigError, load_config
+
+        with pytest.raises(ConfigError, match="demand"):
+            load_config(overrides={"slo": {"shed_lanes": ["demand"]}})
+        with pytest.raises(ConfigError, match="restore_burn"):
+            load_config(overrides={"slo": {"restore_burn": -1.0}})
+        cfg = load_config(overrides={"slo": {
+            "actuate": True, "shed_lanes": ["peer_serve"], "restore_burn": 0.8,
+        }})
+        assert cfg.slo.actuate and cfg.slo.restore_burn == 0.8
+
+    def test_peer_membership_validation(self):
+        from nydus_snapshotter_tpu.config.config import ConfigError, load_config
+
+        with pytest.raises(ConfigError, match="membership"):
+            load_config(overrides={"peer": {"membership": "gossip"}})
+        cfg = load_config(overrides={"peer": {
+            "membership": "fleet", "membership_refresh_secs": 0.5,
+        }})
+        assert cfg.peer.membership == "fleet"
+
+    def test_build_actuator_off_by_default(self, monkeypatch):
+        from nydus_snapshotter_tpu.metrics.slo import build_actuator
+
+        monkeypatch.delenv("NTPU_SLO_ACTUATE", raising=False)
+        assert build_actuator(SloEngine([])) is None
+        monkeypatch.setenv("NTPU_SLO_ACTUATE", "1")
+        act = build_actuator(SloEngine([]))
+        assert isinstance(act, SloActuator)

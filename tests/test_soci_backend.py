@@ -259,6 +259,60 @@ CONFIG_MATRIX = [
 ]
 
 
+class TestPrefetchFromIndex:
+    """ISSUE 13 satellite: the soci index as a prefetch-trace source —
+    ordered path lists translate through the file → extent map into
+    compressed warm ranges, one per file, warmed at PREFETCH lane."""
+
+    def test_warm_list_geometry_and_order(self, layer):
+        from nydus_snapshotter_tpu.soci.blob import warm_list_from_index
+
+        raw, gz, contents = layer
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        paths = ["/usr/lib/f0005.so", "usr/lib/f0100.so", "/no/such/file"]
+        warms, missing = warm_list_from_index(idx, paths)
+        assert missing == ["/no/such/file"]
+        # order is the trace's access order (that IS the replay priority)
+        assert [w[0] for w in warms] == paths[:2]
+        for path, c0, c1 in warms:
+            assert 0 <= c0 < c1 <= len(gz)
+            # the compressed range really decodes the file's bytes
+            uoff, usize = idx.file_extent("/" + path.strip("/"))
+            reader = SociStreamReader(idx, lambda o, s: gz[o : o + s])
+            assert reader.read_range(uoff, usize) == contents[
+                "/" + path.strip("/")
+            ]
+
+    def test_warm_ranges_through_cached_blob_at_prefetch_lane(
+        self, tmp_path, layer
+    ):
+        from nydus_snapshotter_tpu.soci.blob import warm_list_from_index
+
+        raw, gz, contents = layer
+        idx, _ = build_index_from_gzip(BLOB_ID, gz, stride=STRIDE)
+        cb = _cached_blob(tmp_path, gz, "pf", 2, 0, 0)
+        try:
+            paths = [f"/usr/lib/f{i:04d}.so" for i in range(0, 40, 5)]
+            warms, missing = warm_list_from_index(idx, paths)
+            assert not missing
+            for _path, c0, c1 in warms:
+                for f in cb.warm(c0, c1 - c0):  # PREFETCH lane inside
+                    assert f.wait(10)
+                    assert f.error is None
+            # every warmed file now reads without touching the origin
+            calls = []
+            reader = SociStreamReader(
+                idx, lambda o, s: (calls.append((o, s)), cb.read_at(o, s))[1]
+            )
+            for p in paths:
+                uoff, usize = idx.file_extent(p)
+                assert reader.read_range(uoff, usize) == contents[p]
+            for off, size in calls:
+                assert cb.covered(off, size)  # cache-resident, pre-warmed
+        finally:
+            cb.close()
+
+
 def _cached_blob(tmp_path, gz, tag, workers, gap, ra, fetch=None):
     from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
     from nydus_snapshotter_tpu.daemon.fetch_sched import FetchConfig

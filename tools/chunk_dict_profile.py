@@ -175,6 +175,124 @@ def service_profile(images: int = 6) -> dict:
     }
 
 
+def sharded_probe_profile(
+    entries: int = 2_000_000,
+    queries: int = 500_000,
+    batch: int = 50_000,
+    reps: int = 3,
+) -> dict:
+    """VERDICT r5 #4 honesty measurement: probe THROUGHPUT of the dict
+    service at 1 vs 2 shards on this box, paired best-rep.
+
+    Population goes straight into each shard's probe index (records
+    skipped — this measures the probe RPC + lookup path, which is what
+    the routed-mesh/host comparison measured). The sharded arm routes
+    every batch client-side by rendezvous (:func:`shard_for` discipline
+    via ``partition_digests``) and issues the per-shard RPCs
+    sequentially — on a 1-core box two service processes time-share the
+    core, so this records the honest single-box crossover instead of
+    claiming a win the hardware cannot show.
+    """
+    from nydus_snapshotter_tpu.parallel.dict_service import (
+        DictClient,
+        DictService,
+        partition_digests,
+    )
+
+    rng = np.random.default_rng(31)
+    digests = rng.integers(0, 2**32, size=(entries, 8), dtype=np.uint32)
+    dig_bytes = [digests[i].tobytes() for i in range(min(entries, queries))]
+    q_idx = rng.integers(0, len(dig_bytes), size=queries)
+    query_list = [dig_bytes[i] for i in q_idx]
+
+    def populate(svcs, addrs):
+        if len(svcs) == 1:
+            sd = svcs[0].dict_for("probe")
+            with sd._mu:
+                sd.index.insert_digests(dig_bytes)
+            return
+        parts = partition_digests(dig_bytes, addrs)
+        for svc, part in zip(svcs, parts):
+            sd = svc.dict_for("probe")
+            with sd._mu:
+                sd.index.insert_digests([dig_bytes[p] for p in part])
+
+    def probe_all(clients, addrs):
+        """One full probe pass; returns (seconds, answered)."""
+        t0 = time.perf_counter()
+        answered = 0
+        for start in range(0, len(query_list), batch):
+            chunk = query_list[start : start + batch]
+            if len(clients) == 1:
+                ans = clients[0].probe(chunk, "probe")
+                answered += int((ans >= 0).sum())
+            else:
+                parts = partition_digests(chunk, addrs)
+                for cli, part in zip(clients, parts):
+                    if not part:
+                        continue
+                    ans = cli.probe([chunk[p] for p in part], "probe")
+                    answered += int((ans >= 0).sum())
+        return time.perf_counter() - t0, answered
+
+    results = {}
+    with tempfile.TemporaryDirectory() as td:
+        arms = {}
+        for n in (1, 2):
+            svcs = [DictService() for _ in range(n)]
+            addrs = []
+            for i, svc in enumerate(svcs):
+                svc.run(os.path.join(td, f"probe{n}_{i}.sock"))
+                addrs.append(svc.sock_path)
+            populate(svcs, addrs)
+            arms[n] = (svcs, addrs, [DictClient(a) for a in addrs])
+        try:
+            walls = {1: [], 2: []}
+            hits = {}
+            for _ in range(reps):  # paired, interleaved reps
+                for n in (1, 2):
+                    _svcs, addrs, clients = arms[n]
+                    w, answered = probe_all(clients, addrs)
+                    walls[n].append(w)
+                    hits[n] = answered
+            for n in (1, 2):
+                best = min(walls[n])
+                results[f"shards_{n}"] = {
+                    "best_probe_s": round(best, 4),
+                    "probe_per_s": int(queries / best),
+                    "reps_s": [round(w, 4) for w in walls[n]],
+                    "answered": hits[n],
+                }
+            # every query must resolve identically on both topologies
+            results["answers_identical"] = hits[1] == hits[2] == queries
+        finally:
+            for svcs, _a, clients in arms.values():
+                for cli in clients:
+                    cli.close()
+                for svc in svcs:
+                    svc.stop()
+    one = results["shards_1"]["probe_per_s"]
+    two = results["shards_2"]["probe_per_s"]
+    results.update(
+        entries=entries,
+        queries=queries,
+        batch=batch,
+        sharded_vs_single_x=round(two / max(1, one), 3),
+        # The crossover record (VERDICT #4): on this box N service
+        # processes time-share the core, so sharding cannot win; it wins
+        # when (a) >= N real cores serve the shards concurrently, or
+        # (b) the table exceeds the single-table entry ceiling
+        # (REGISTRY_SCALE win_conditions: 134M entries) where one
+        # process physically cannot hold the namespace.
+        crossover={
+            "wins_on_this_box": two > one,
+            "requires_cores_ge_shards": True,
+            "single_table_entry_ceiling": 134_217_728,
+        },
+    )
+    return results
+
+
 def profile(entries_m: float = 2.0, grow_k: int = 200, min_speedup: float = 5.0) -> dict:
     g = growth_profile(int(entries_m * 1_000_000), grow_k * 1000)
     s = service_profile()
@@ -201,7 +319,20 @@ def main() -> None:
     ap.add_argument("--entries-m", type=float, default=2.0)
     ap.add_argument("--grow-k", type=int, default=200)
     ap.add_argument("--min-speedup", type=float, default=5.0)
+    ap.add_argument(
+        "--sharded-probe", action="store_true",
+        help="measure 1-vs-2-shard service probe throughput (paired "
+        "best-rep) and the single-box crossover record (VERDICT #4)",
+    )
     args = ap.parse_args()
+    if args.sharded_probe:
+        out = sharded_probe_profile(
+            entries=int(args.entries_m * 1_000_000)
+        )
+        print(json.dumps(out))
+        if not out["answers_identical"]:
+            raise SystemExit("sharded probe answers diverged from single-service")
+        return
     out = profile(args.entries_m, args.grow_k, args.min_speedup)
     print(json.dumps(out))
     if not out["ok"]:

@@ -169,9 +169,41 @@ def cmd_blobcache(args) -> int:
 def cmd_peers(args) -> int:
     direct = _get(args.sock, "/api/v1/peer/stat", args.timeout)
     if direct is not None:
-        _emit(args, direct, "\n".join(f"{k}: {v}" for k, v in sorted(direct.items())))
+        lines = [
+            f"{k}: {v}"
+            for k, v in sorted(direct.items())
+            if k not in ("membership", "admission")
+        ]
+        m = direct.get("membership")
+        if m:
+            lines.append(
+                f"membership: epoch {m['epoch']}, {len(m['peers'])} live peers"
+                + (f", last_error {m['last_error']}" if m.get("last_error") else "")
+            )
+            for e in m.get("events", [])[-8:]:
+                lines.append(f"  {e['kind']:5s} {e['address']}")
+        adm = direct.get("admission")
+        if adm:
+            shed = [k for k, v in adm.items() if v.get("cap") == 0]
+            lines.append(
+                "admission: "
+                + (f"SHED lanes {', '.join(shed)}" if shed else "no lanes shed")
+            )
+        _emit(args, direct, "\n".join(lines))
         return 0
+    # Controller: the fleet peers route IS the dynamic discovery source.
+    listing = _get(args.sock, "/api/v1/fleet/peers", args.timeout)
     board = _scoreboard(args)
+    if listing is not None and not args.json:
+        rows = [
+            [
+                p["name"], p["component"], p["address"],
+                "stale" if p["stale"] else ("up" if p["up"] else "down"),
+            ]
+            for p in listing
+        ]
+        if rows:
+            print(_table(rows, ["PEER", "ROLE", "SERVE-ADDR", "STATE"]))
     rows = []
     payload = {}
     for name, m in sorted(board["members"].items()):
@@ -227,14 +259,20 @@ def cmd_soci(args) -> int:
 def cmd_dict(args) -> int:
     direct = _get(args.sock, "/api/v1/dict", args.timeout)
     if direct is not None:
+        # Per-shard epochs: against a sharded deployment, point --sock at
+        # each shard; the epoch/rebuild-epoch pair IS the replication
+        # cursor mirrors reconcile against (chunk_dict_service.md).
         rows = [
             [
                 ns.get("namespace", "?"), ns.get("chunks", 0),
                 ns.get("blobs", 0), ns.get("epoch", 0),
+                ns.get("rebuild_epoch", 0),
             ]
             for ns in direct
         ]
-        _emit(args, direct, _table(rows, ["NAMESPACE", "CHUNKS", "BLOBS", "EPOCH"]))
+        _emit(args, direct, _table(
+            rows, ["NAMESPACE", "CHUNKS", "BLOBS", "EPOCH", "REBUILD-EPOCH"]
+        ))
         return 0
     board = _scoreboard(args)
     rows = []
@@ -281,6 +319,14 @@ def cmd_slo(args) -> int:
         human += f"\n{len(breaches)} breach event(s); latest: " + json.dumps(
             {k: breaches[-1][k] for k in ("objective", "at")}
         )
+    act = status.get("actuation")
+    if act is not None:
+        shed = act.get("shed_lanes", [])
+        human += "\nactuation: " + (
+            f"SHED lanes {', '.join(shed)}" if shed else "no lanes shed"
+        )
+        for e in act.get("events", [])[-6:]:
+            human += f"\n  {e['action']:7s} {e['lane']:10s} {e['reason']}"
     _emit(args, status, human)
     return 0
 
