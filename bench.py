@@ -567,73 +567,20 @@ def dedup_shaped_run(opt, pool: list[bytes]) -> dict:
 def _manifest_files(gen_of) -> list:
     """Materialize the committed REAL Ubuntu manifest as tar members.
 
-    misc/fixtures/ubuntu_v6_manifest.json.gz carries the real fixture's
-    tree (paths, modes, sizes, symlink targets — extracted by
-    tools/extract_real_manifest.py from the reference's v6 bootstrap of a
-    real rootfs). File CONTENT is synthesized deterministically per
-    (path, generation): bumping a file's generation models a changed file
-    in an upgraded image while every other byte stays identical.
+    The manifest machinery (including the per-(path, generation) content
+    synthesis) lives in scenario/corpus.py now, shared with the scenario
+    engine's real-tree corpora so every real-layout consumer synthesizes
+    the identical bytes.
     """
-    import gzip
-    import hashlib
-    import json
-    import stat as statmod
+    from nydus_snapshotter_tpu.scenario import corpus as _corpus
 
-    path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "misc", "fixtures", "ubuntu_v6_manifest.json.gz",
-    )
-    with gzip.open(path, "rb") as f:
-        manifest = json.load(f)
-
-    members = []
-    for e in manifest["entries"]:
-        p = e["path"].lstrip("/")
-        if not p:
-            continue
-        mode = e["mode"]
-        if statmod.S_ISDIR(mode):
-            members.append((p, mode, None, e.get("symlink")))
-        elif statmod.S_ISLNK(mode):
-            members.append((p, mode, None, e["symlink"]))
-        elif statmod.S_ISREG(mode):
-            seed = int.from_bytes(
-                hashlib.sha256(
-                    f"{e['path']}:{gen_of(e['path'])}".encode()
-                ).digest()[:8],
-                "little",
-            )
-            rng = np.random.default_rng(seed)
-            size = e["size"]
-            if seed % 5 < 3:  # text-ish: low-entropy, compressible
-                base = rng.integers(32, 127, max(1, size // 6 + 1), dtype=np.uint8)
-                data = np.tile(base, 7)[:size].tobytes()
-            else:  # binary: high-entropy
-                data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
-            members.append((p, mode, data, None))
-    return members
+    return _corpus.real_tree_members(gen_of=gen_of)
 
 
 def _members_to_tar(members) -> bytes:
-    import io
-    import tarfile
+    from nydus_snapshotter_tpu.scenario import corpus as _corpus
 
-    buf = io.BytesIO()
-    with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
-        for p, mode, data, link in members:
-            ti = tarfile.TarInfo(p)
-            ti.mode = mode & 0o7777
-            if data is None and link is not None:
-                ti.type = tarfile.SYMTYPE
-                ti.linkname = link
-                tf.addfile(ti)
-            elif data is None:
-                ti.type = tarfile.DIRTYPE
-                tf.addfile(ti)
-            else:
-                ti.size = len(data)
-                tf.addfile(ti, io.BytesIO(data))
-    return buf.getvalue()
+    return _corpus.members_to_tar(members)
 
 
 def real_image_run(opt) -> dict:
@@ -698,6 +645,15 @@ def real_image_run(opt) -> dict:
         if bs_b.blobs[c.blob_index].blob_id not in own
     )
     total_chunk_bytes = sum(c.uncompressed_size for c in bs_b.chunks)
+
+    # VERDICT r5 #8: real-vs-real CROSS-TREE dedup — the second
+    # real-derived tree (a sibling image: package subset + changed-file
+    # delta, tools/extract_real_manifest.py --derive-tree2) converted
+    # against tree1's real-bootstrap dict. The content-synthesis caveat
+    # rides in the result: layout/chunk-grid is real, bytes are not.
+    from nydus_snapshotter_tpu.scenario.corpus import cross_tree_dedup
+
+    cross_tree = cross_tree_dedup(ropt)
     return {
         "source": "real ubuntu rootfs tree (committed manifest of the "
         "reference's v6 fixture; content synthesized per file)",
@@ -709,6 +665,7 @@ def real_image_run(opt) -> dict:
         "dict_chunks": len(cdict),
         "convert_vs_real_dict_gibps": round(len(tar_b) / t_b / (1 << 30), 4),
         "dedup_ratio": round(dedup_bytes / max(1, total_chunk_bytes), 4),
+        "cross_tree_dedup": cross_tree,
     }
 
 

@@ -424,6 +424,26 @@ class SloConfig:
 
 
 @dataclass
+class ScenarioConfig:
+    """Scenario engine knobs (scenario/, tools/scenario_storm.py).
+
+    ``spec_dir`` is the catalog of ``*.toml`` scenario specs
+    (``ntpuctl scenario`` lists it; "" = the repo's ``misc/scenarios``).
+    ``report_path`` is where the gated storm banks its last-run report
+    JSON ("" = the repo's ``SCENARIO_STORM_r01.json``); ``seed`` and
+    ``pods`` are the defaults a spec inherits when it doesn't pin its
+    own. Environment variables override per-process
+    (``NTPU_SCENARIO_SPEC_DIR``, ``NTPU_SCENARIO_REPORT``,
+    ``NTPU_SCENARIO_SEED``, ``NTPU_SCENARIO_PODS``).
+    """
+
+    spec_dir: str = ""
+    report_path: str = ""
+    seed: int = 7
+    pods: int = 16
+
+
+@dataclass
 class MeshConfig:
     """Device-mesh convert sharding knobs (ops/mesh_pack.py,
     __graft_entry__.sharded_convert_step).
@@ -486,6 +506,7 @@ class SnapshotterConfig:
     fleet: FleetConfig = field(default_factory=FleetConfig)
     slo: SloConfig = field(default_factory=SloConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    scenario: ScenarioConfig = field(default_factory=ScenarioConfig)
     experimental: ExperimentalConfig = field(default_factory=ExperimentalConfig)
 
     # -- derived paths (reference config/global.go accessors) ---------------
@@ -668,6 +689,10 @@ class SnapshotterConfig:
             raise ConfigError("mesh.devices must be >= 0 (0 = all local devices)")
         if self.mesh.halo_kib < 0:
             raise ConfigError("mesh.halo_kib must be >= 0 (0 = auto read span)")
+        if self.scenario.pods < 1:
+            raise ConfigError("scenario.pods must be >= 1")
+        if self.scenario.seed < 0:
+            raise ConfigError("scenario.seed must be >= 0")
         if not 0.0 < self.chunk_dict.load_factor < 1.0:
             raise ConfigError("chunk_dict.load_factor must be within (0, 1)")
         if self.chunk_dict.headroom < 1.0:

@@ -100,6 +100,7 @@ KNOWN_SITES = (
     "soci.fetch",            # soci/blob.py compressed-range pull for a lazy read
     "fleet.scrape",          # metrics/federation.py per-member metrics scrape
     "fleet.collect",         # trace/aggregate.py per-member trace-ring pull
+    "scenario.phase",        # scenario/orchestrator.py phase entry
 )
 
 _lock = _an.make_lock("failpoint.table")

@@ -1,0 +1,103 @@
+"""Scenario engine: declarative worst-day-in-production storms.
+
+Every per-subsystem gate in this tree (pipeline determinism, blobcache
+chaos, snapshot storms, peer churn, SLO actuation) exercises ONE layer
+at a time on synthesized-content corpora. A production fleet sees all of
+them at once: adversarial layers, corrupt peers, and mixed
+convert+deploy+remove+GC churn with daemons crashing mid-storm. This
+package composes **corpus generators × fault schedules × lifecycle
+phases** into one gated end-to-end run:
+
+- :mod:`scenario.corpus` — deterministic corpus generators: real-derived
+  trees from the committed Ubuntu fixture manifests (including the
+  second tree for real-vs-real cross-tree dedup), plus adversarial
+  inputs — all-incompressible layers, chunk-boundary-resonant CDC
+  content, tiny-file floods, single huge files, and corrupt/truncated
+  blob variants for the peer tier;
+- :mod:`scenario.spec` — a TOML scenario spec
+  (``[[scenario.phases]]``) describing the phase sequence, corpus
+  bindings, fault schedule and SLO budget;
+- :mod:`scenario.orchestrator` — the runner: drives the REAL converter,
+  snapshot control plane, blobcache/peer data plane, cache GC and SLO
+  engine through the spec, replayable serially for byte-identity, with
+  an end-state metastore/cache audit.
+
+The gated profile lives in ``tools/scenario_storm.py`` and the spec
+catalog in ``misc/scenarios/``. ``ntpuctl scenario`` lists specs and the
+last banked gate results.
+
+Failpoint: ``scenario.phase`` fires at every phase entry (an armed error
+fails the run loudly, naming the phase). Metrics: ``ntpu_scenario_*``.
+Config: ``[scenario]`` with ``NTPU_SCENARIO*`` env overrides.
+"""
+
+from __future__ import annotations
+
+import os
+
+from nydus_snapshotter_tpu.metrics import registry as _metrics
+
+_reg = _metrics.default_registry
+
+PHASES_TOTAL = _reg.register(
+    _metrics.Counter(
+        "ntpu_scenario_phases_total",
+        "Scenario phases executed, by lifecycle op "
+        "(convert/deploy/remove/gc/crash_restart)",
+        ("op",),
+    )
+)
+RUNS_TOTAL = _reg.register(
+    _metrics.Counter(
+        "ntpu_scenario_runs_total",
+        "Scenario runs completed, by outcome (pass/fail)",
+        ("outcome",),
+    )
+)
+FAULTS_ARMED = _reg.register(
+    _metrics.Counter(
+        "ntpu_scenario_faults_armed_total",
+        "Failpoint arms performed by scenario fault schedules",
+    )
+)
+
+
+class ScenarioRuntimeConfig:
+    __slots__ = ("spec_dir", "report_path", "seed", "pods")
+
+    def __init__(self, spec_dir: str, report_path: str, seed: int, pods: int):
+        self.spec_dir = spec_dir
+        self.report_path = report_path
+        self.seed = seed
+        self.pods = pods
+
+
+def _global_scenario_config():
+    try:
+        from nydus_snapshotter_tpu.config import config as _cfg
+
+        return _cfg.get_global_config().scenario
+    except Exception:
+        return None
+
+
+def resolve_scenario_config() -> ScenarioRuntimeConfig:
+    """env (``NTPU_SCENARIO*``) > ``[scenario]`` global config > defaults."""
+    from nydus_snapshotter_tpu.daemon.fetch_sched import _env_int
+
+    sc = _global_scenario_config()
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    spec_dir = os.environ.get(
+        "NTPU_SCENARIO_SPEC_DIR",
+        getattr(sc, "spec_dir", "") or os.path.join(repo, "misc", "scenarios"),
+    )
+    report_path = os.environ.get(
+        "NTPU_SCENARIO_REPORT",
+        getattr(sc, "report_path", "") or os.path.join(repo, "SCENARIO_STORM_r01.json"),
+    )
+    return ScenarioRuntimeConfig(
+        spec_dir=spec_dir,
+        report_path=report_path,
+        seed=_env_int("NTPU_SCENARIO_SEED", getattr(sc, "seed", 7)),
+        pods=max(1, _env_int("NTPU_SCENARIO_PODS", getattr(sc, "pods", 16))),
+    )

@@ -1,0 +1,245 @@
+"""Scenario corpus generators: determinism, CDC-resonance properties,
+degenerate tree shapes, corrupt-blob CRC rejection (ISSUE 14 satellite).
+
+Every generator must be a pure function of its seed/parameters — the
+scenario engine's serial-replay identity gate depends on it — and the
+adversarial generators must actually have the adversarial property they
+claim (resonance proven against the FastCDC engine AND the byte-at-a-
+time sequential oracle, corruption proven rejected by the peer tier's
+CRC frame).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu.ops.cdc import (
+    CDCParams,
+    chunk_data_np,
+    chunk_sequential_reference,
+)
+from nydus_snapshotter_tpu.scenario import corpus
+
+
+def _tar_names(data: bytes) -> list:
+    with tarfile.open(fileobj=io.BytesIO(data)) as tf:
+        return [m.name for m in tf.getmembers()]
+
+
+class TestDeterminism:
+    def test_incompressible_deterministic(self):
+        assert corpus.incompressible_layer(7, 1) == corpus.incompressible_layer(7, 1)
+        assert corpus.incompressible_layer(7, 1) != corpus.incompressible_layer(8, 1)
+
+    def test_compressible_deterministic(self):
+        assert corpus.compressible_layer(3, 1) == corpus.compressible_layer(3, 1)
+
+    def test_tiny_files_deterministic(self):
+        a = corpus.tiny_files_layer(5, 500)
+        assert a == corpus.tiny_files_layer(5, 500)
+        assert a != corpus.tiny_files_layer(6, 500)
+
+    def test_huge_file_deterministic(self):
+        assert (
+            corpus.single_huge_file_layer(9, 2)
+            == corpus.single_huge_file_layer(9, 2)
+        )
+
+    def test_resonant_deterministic(self):
+        a = corpus.cdc_resonant_data(3, 64 << 10, 0x1000, "min")
+        assert a == corpus.cdc_resonant_data(3, 64 << 10, 0x1000, "min")
+        assert a != corpus.cdc_resonant_data(4, 64 << 10, 0x1000, "min")
+
+    def test_real_trees_deterministic(self):
+        t1 = corpus.members_to_tar(corpus.real_tree_members())
+        assert t1 == corpus.members_to_tar(corpus.real_tree_members())
+        t2 = corpus.members_to_tar(corpus.real_tree2_members())
+        assert t2 == corpus.members_to_tar(corpus.real_tree2_members())
+        assert t1 != t2
+
+
+class TestRealTrees:
+    def test_tree2_is_real_derived_subgraph(self):
+        """Tree2's paths are a subset of tree1's (a sibling sharing the
+        real base — no synthesized paths), with some files diverged."""
+        m1 = corpus.load_manifest(corpus.MANIFEST_TREE1)
+        m2 = corpus.load_manifest(corpus.MANIFEST_TREE2)
+        paths1 = {e["path"] for e in m1["entries"]}
+        paths2 = {e["path"] for e in m2["entries"]}
+        assert paths2 < paths1
+        assert m2["dropped"] > 0 and m2["changed"] > 0
+        assert m2["inodes"] == len(m2["entries"])
+        assert "derivation" in m2
+
+    def test_shared_paths_share_content_changed_do_not(self):
+        """Same (path, gen) synthesizes identical bytes across trees —
+        the mechanism cross-tree dedup rides on; gen=1 entries diverge."""
+        m2 = corpus.load_manifest(corpus.MANIFEST_TREE2)
+        changed = next(
+            e for e in m2["entries"] if e.get("gen") and e["size"] > 0
+        )
+        same = next(
+            e
+            for e in m2["entries"]
+            if not e.get("gen") and e.get("chunks") and e["size"] > 0
+        )
+        assert corpus.synth_content(same["path"], 0, same["size"]) == \
+            corpus.synth_content(same["path"], 0, same["size"])
+        assert corpus.synth_content(changed["path"], 1, changed["size"]) != \
+            corpus.synth_content(changed["path"], 0, changed["size"])
+
+    def test_cross_tree_dedup_ratio(self):
+        """Real-vs-real: tree2 against tree1's REAL-v6-round-trip dict.
+        Deterministic corpus + fixed grid => a stable, substantial ratio
+        strictly below 1 (the changed/dropped delta is real)."""
+        r = corpus.cross_tree_dedup()
+        assert 0.3 <= r["dedup_ratio"] < 1.0
+        assert r["dict_chunks"] > 0
+        assert "caveat" in r and "synthesized" in r["caveat"]
+
+
+class TestCdcResonance:
+    @pytest.mark.parametrize("avg", [0x1000, 0x4000])
+    def test_min_mode_every_chunk_cuts_at_min_size(self, avg):
+        params = CDCParams(avg)
+        data = corpus.cdc_resonant_data(11, 16 * params.min_size, avg, "min")
+        cuts = chunk_data_np(data, params)
+        sizes = np.diff(np.concatenate([[0], cuts]))
+        assert set(sizes[:-1].tolist()) == {params.min_size}
+        assert sizes[-1] <= params.min_size
+
+    def test_max_mode_no_content_cut_ever_fires(self):
+        params = CDCParams(0x1000)
+        data = corpus.cdc_resonant_data(11, 4 * params.max_size + 100, 0x1000, "max")
+        cuts = chunk_data_np(data, params)
+        sizes = np.diff(np.concatenate([[0], cuts]))
+        assert set(sizes[:-1].tolist()) == {params.max_size}
+
+    def test_resonance_holds_on_sequential_oracle(self):
+        """The property is an engine property, not a quirk of the
+        two-phase pipeline: the byte-at-a-time reference chunker agrees."""
+        params = CDCParams(0x1000)
+        data = corpus.cdc_resonant_data(2, 8 * params.min_size, 0x1000, "min")
+        seq = chunk_sequential_reference(data, params)
+        sizes = np.diff(np.concatenate([[0], seq]))
+        assert set(sizes[:-1].tolist()) == {params.min_size}
+
+    def test_min_mode_maximizes_chunk_count(self):
+        params = CDCParams(0x1000)
+        n = 32 * params.min_size
+        resonant = corpus.cdc_resonant_data(1, n, 0x1000, "min")
+        random_data = np.random.default_rng(1).integers(
+            0, 256, n, dtype=np.uint8
+        ).tobytes()
+        assert len(chunk_data_np(resonant, params)) > len(
+            chunk_data_np(random_data, params)
+        )
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError):
+            corpus.cdc_resonant_data(1, 4096, 0x1000, "sideways")
+
+
+class TestDegenerateTrees:
+    def test_tiny_files_layer_shape(self):
+        n = 2000
+        data = corpus.tiny_files_layer(3, n)
+        names = _tar_names(data)
+        assert len(names) == n
+        with tarfile.open(fileobj=io.BytesIO(data)) as tf:
+            sizes = [m.size for m in tf.getmembers()]
+        assert max(sizes) <= 64 and min(sizes) >= 1
+
+    def test_single_huge_file_layer_shape(self):
+        data = corpus.single_huge_file_layer(3, 2)
+        with tarfile.open(fileobj=io.BytesIO(data)) as tf:
+            members = tf.getmembers()
+        assert len(members) == 1
+        assert members[0].size == 2 << 20
+
+    def test_incompressible_really_is(self):
+        import zlib
+
+        data = corpus.incompressible_layer(5, 1)
+        assert len(zlib.compress(data, 6)) > 0.95 * len(data)
+
+    def test_compressible_really_is(self):
+        import zlib
+
+        data = corpus.compressible_layer(5, 1)
+        assert len(zlib.compress(data, 6)) < 0.5 * len(data)
+
+
+class TestCorruptVariants:
+    @pytest.mark.parametrize("mode", ["flip", "truncate", "zero"])
+    def test_corrupt_differs_and_is_deterministic(self, mode):
+        data = corpus.incompressible_layer(1, 1)
+        bad = corpus.corrupt_variant(data, 9, mode)
+        assert bad != data
+        assert bad == corpus.corrupt_variant(data, 9, mode)
+        if mode == "truncate":
+            assert len(bad) < len(data)
+        else:
+            assert len(bad) == len(data)
+
+    def test_empty_and_bad_mode_rejected(self):
+        with pytest.raises(ValueError):
+            corpus.corrupt_variant(b"", 1, "flip")
+        with pytest.raises(ValueError):
+            corpus.corrupt_variant(b"x", 1, "shuffle")
+
+    def test_peer_crc_rejects_corrupt_blob(self, tmp_path):
+        """The hostile-peer contract end to end: a peer serving a
+        corrupted payload under a stale CRC header is rejected by the
+        requester's CRC check, the fetcher falls back to the origin, and
+        the requester's cache holds the TRUE bytes."""
+        from nydus_snapshotter_tpu.daemon import peer
+        from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+        from nydus_snapshotter_tpu.daemon.fetch_sched import FetchConfig
+        from nydus_snapshotter_tpu.scenario.orchestrator import CorruptPeerServer
+
+        blob = corpus.incompressible_layer(2, 1)
+        blob_id = "cd" * 32
+        owner = CachedBlob(
+            str(tmp_path / "owner"), blob_id,
+            lambda off, size: blob[off : off + size], blob_size=len(blob),
+            config=FetchConfig(fetch_workers=1, merge_gap=0, readahead=0),
+        )
+        owner.read_at(0, len(blob))  # warmed: serves cover hits
+        export = peer.PeerExport()
+        export.register(blob_id, owner)
+        srv = CorruptPeerServer(
+            peer.PeerChunkServer(export, pull_through=True), seed=4
+        )
+        addr = str(tmp_path / "peer.sock")
+        srv.run(addr)
+        try:
+            router = peer.PeerRouter([addr], self_address="")
+            fetcher = peer.PeerAwareFetcher(
+                blob_id, lambda off, size: blob[off : off + size], router,
+                timeout_s=5.0,
+            )
+            requester = CachedBlob(
+                str(tmp_path / "req"), blob_id, fetcher.read_range,
+                blob_size=len(blob),
+                config=FetchConfig(fetch_workers=1, merge_gap=0, readahead=0),
+            )
+            got = requester.read_at(0, len(blob))
+            requester.close()
+            assert srv.corrupted > 0, "hostile peer never served"
+            assert hashlib.sha256(got).hexdigest() == hashlib.sha256(blob).hexdigest()
+            # The poisoned payload must never land in the cache file.
+            cache_file = str(tmp_path / "req" / f"{blob_id}.blob.data")
+            if os.path.exists(cache_file):
+                with open(cache_file, "rb") as f:
+                    cached = f.read()
+                assert cached[: len(blob)] == blob
+        finally:
+            srv.stop()
+            owner.close()

@@ -1,0 +1,393 @@
+"""Scenario engine: spec round-trip/validation, phase sequencing,
+crash/restart stickiness, the SLO judge, fault schedules and the
+serial-replay identity property (ISSUE 14 satellite).
+
+Everything runs on tiny corpora (1–3 MiB, 2–3 pods) — the shapes are
+what is under test, the scale lives in tools/scenario_storm.py.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.scenario import spec as sspec
+from nydus_snapshotter_tpu.scenario.orchestrator import ScenarioRunner
+from nydus_snapshotter_tpu.scenario.spec import ScenarioSpec, ScenarioSpecError
+
+MINI = """
+[scenario]
+name = "t"
+seed = 11
+pods = 3
+
+[[scenario.corpus]]
+id = "img"
+kind = "compressible"
+mib = 2
+
+[[scenario.phases]]
+op = "convert"
+corpus = ["img"]
+
+[[scenario.phases]]
+op = "deploy"
+corpus = ["img"]
+layers = 3
+%s
+
+[[scenario.phases]]
+op = "remove"
+fraction = 1.0
+
+[[scenario.phases]]
+op = "gc"
+"""
+
+
+def mini_spec(deploy_extra: str = "") -> ScenarioSpec:
+    return sspec.loads(MINI % deploy_extra)
+
+
+# ---------------------------------------------------------------------------
+# Spec loading / validation
+# ---------------------------------------------------------------------------
+
+
+class TestSpec:
+    def test_round_trip(self):
+        s = mini_spec('crash = "mid"\ncorrupt_peer = true')
+        again = ScenarioSpec.from_dict(json.loads(json.dumps(s.to_dict())))
+        assert again == s
+
+    @pytest.mark.parametrize(
+        "mutation, match",
+        [
+            ("[scenario]\nname = 't'", "at least one"),
+            ("[scenario]\nname = 't'\nphases = []", "at least one"),
+            ("[bogus]\nx = 1", "scenario"),
+        ],
+    )
+    def test_structurally_empty_specs_rejected(self, mutation, match):
+        with pytest.raises(ScenarioSpecError, match=match):
+            sspec.loads(mutation)
+
+    def test_unknown_keys_rejected_everywhere(self):
+        base = mini_spec().to_dict()
+        for path, key in (
+            (("scenario",), "zap"),
+            (("scenario", "corpus", 0), "zap"),
+            (("scenario", "phases", 0), "zap"),
+            (("scenario", "slo"), "zap"),
+        ):
+            d = json.loads(json.dumps(base))
+            node = d
+            for p in path:
+                node = node[p]
+            node[key] = 1
+            with pytest.raises(ScenarioSpecError, match="unknown keys"):
+                ScenarioSpec.from_dict(d)
+
+    def test_unknown_op_kind_and_mode_rejected(self):
+        with pytest.raises(ScenarioSpecError, match="unknown op"):
+            sspec.loads(MINI.replace('op = "convert"', 'op = "explode"') % "")
+        with pytest.raises(ScenarioSpecError, match="unknown kind"):
+            sspec.loads(MINI.replace('kind = "compressible"', 'kind = "gold"') % "")
+        with pytest.raises(ScenarioSpecError, match="crash must be"):
+            mini_spec('crash = "always"')
+
+    def test_corpus_refs_and_duplicates_validated(self):
+        d = mini_spec().to_dict()
+        d["scenario"]["phases"][0]["corpus"] = ["ghost"]
+        with pytest.raises(ScenarioSpecError, match="ghost"):
+            ScenarioSpec.from_dict(d)
+        d = mini_spec().to_dict()
+        d["scenario"]["corpus"].append(d["scenario"]["corpus"][0])
+        with pytest.raises(ScenarioSpecError, match="duplicate"):
+            ScenarioSpec.from_dict(d)
+
+    def test_faults_validated(self):
+        d = mini_spec().to_dict()
+        d["scenario"]["faults"] = [
+            {"site": "not.a.site", "action": "error(OSError)", "phase": 0}
+        ]
+        with pytest.raises(ScenarioSpecError, match="unknown failpoint site"):
+            ScenarioSpec.from_dict(d)
+        d["scenario"]["faults"] = [
+            {"site": "peer.fetch", "action": "kaboom{", "phase": 0}
+        ]
+        with pytest.raises(ScenarioSpecError, match="bad action"):
+            ScenarioSpec.from_dict(d)
+        d["scenario"]["faults"] = [
+            {"site": "peer.fetch", "action": "error(OSError)", "phase": 99}
+        ]
+        with pytest.raises(ScenarioSpecError, match="out of range"):
+            ScenarioSpec.from_dict(d)
+
+    def test_slo_threshold_must_align_to_bucket(self):
+        d = mini_spec().to_dict()
+        d["scenario"]["slo"]["demand_threshold_ms"] = 47.0
+        with pytest.raises(ScenarioSpecError, match="bucket boundary"):
+            ScenarioSpec.from_dict(d)
+
+    def test_cdc_resonant_params_validated(self):
+        d = mini_spec().to_dict()
+        d["scenario"]["corpus"][0] = {
+            "id": "img", "kind": "cdc_resonant", "avg_kib": 3,
+        }
+        with pytest.raises(ScenarioSpecError, match="power of two"):
+            ScenarioSpec.from_dict(d)
+
+    def test_list_specs_surfaces_broken_files(self, tmp_path):
+        (tmp_path / "good.toml").write_text(MINI % "")
+        (tmp_path / "bad.toml").write_text("[scenario]\nname='x'\nphases=[]")
+        listed = sspec.list_specs(str(tmp_path))
+        assert len(listed) == 2
+        by_name = {p.rsplit("/", 1)[-1]: (s, e) for p, s, e in listed}
+        assert by_name["good.toml"][0] is not None
+        assert by_name["bad.toml"][0] is None and by_name["bad.toml"][1]
+
+    def test_repo_spec_catalog_loads(self):
+        """The shipped specs must stay valid (the storm tool and
+        ntpuctl both load them)."""
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        listed = sspec.list_specs(os.path.join(repo, "misc", "scenarios"))
+        assert listed, "misc/scenarios is empty"
+        for path, s, err in listed:
+            assert s is not None, f"{path}: {err}"
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator
+# ---------------------------------------------------------------------------
+
+
+def run_pair(spec, tmp_path, **kw):
+    r1 = ScenarioRunner(spec, str(tmp_path / "conc"), serial=False, **kw)
+    rep1 = r1.run()
+    fp1, au1 = r1.fingerprint(), r1.audit()
+    r1.close()
+    r2 = ScenarioRunner(spec, str(tmp_path / "serial"), serial=True, **kw)
+    rep2 = r2.run()
+    fp2, au2 = r2.fingerprint(), r2.audit()
+    r2.close()
+    return (rep1, fp1, au1), (rep2, fp2, au2)
+
+
+class TestOrchestrator:
+    def test_phase_sequencing_and_report_shape(self, tmp_path):
+        spec = mini_spec()
+        runner = ScenarioRunner(spec, str(tmp_path), serial=False)
+        report = runner.run()
+        runner.close()
+        assert report["ok"], report["error"]
+        assert [p["op"] for p in report["phases"]] == [
+            "convert", "deploy", "remove", "gc",
+        ]
+        assert report["phases"][1]["pods"] == 3
+        assert report["slo"]["breaches"] == 0
+        assert report["origin"]["egress_bytes"] > 0
+
+    def test_serial_replay_identity_mini(self, tmp_path):
+        spec = mini_spec('crash = "mid"\ncorrupt_peer = true')
+        (rep1, fp1, au1), (rep2, fp2, au2) = run_pair(spec, tmp_path)
+        assert rep1["ok"], rep1["error"]
+        assert rep2["ok"], rep2["error"]
+        assert fp1 == fp2, "concurrent chaos run diverged from serial replay"
+        assert au1["clean"] and au2["clean"]
+        assert au1["metastore_rows"] == 0  # full teardown
+
+    def test_crash_restart_mid_deploy(self, tmp_path):
+        spec = mini_spec('crash = "mid"')
+        runner = ScenarioRunner(spec, str(tmp_path), serial=False)
+        report = runner.run()
+        fp = runner.fingerprint()
+        runner.close()
+        assert report["ok"], report["error"]
+        assert runner.crashes == 1
+        # Rows written before the crash survived it: the dump carries
+        # every pod's chain (teardown removed them; reads all recorded).
+        assert len(fp["reads"]) == 3
+
+    def test_crash_restart_phase_rows_stick(self, tmp_path):
+        """A standalone crash between two deploys: rows from the first
+        deploy persist across the restart, the second deploy builds on
+        the reopened plane, and the end state matches the serial replay."""
+        toml = """
+[scenario]
+name = "sticky"
+seed = 3
+pods = 2
+[[scenario.corpus]]
+id = "img"
+kind = "compressible"
+mib = 1
+[[scenario.phases]]
+op = "convert"
+corpus = ["img"]
+[[scenario.phases]]
+op = "deploy"
+corpus = ["img"]
+layers = 2
+[[scenario.phases]]
+op = "crash_restart"
+[[scenario.phases]]
+op = "deploy"
+corpus = ["img"]
+layers = 2
+"""
+        spec = sspec.loads(toml)
+        (rep1, fp1, au1), (rep2, fp2, au2) = run_pair(spec, tmp_path)
+        assert rep1["ok"] and rep2["ok"]
+        assert fp1 == fp2
+        # Both deploys' rows are live (no teardown in this spec).
+        assert au1["metastore_rows"] == au2["metastore_rows"] > 0
+        assert au1["clean"] and au2["clean"]
+
+    def test_slo_judge_breach_fails_the_run(self, tmp_path):
+        toml = """
+[scenario]
+name = "breach"
+seed = 5
+pods = 2
+[[scenario.corpus]]
+id = "img"
+kind = "incompressible"
+mib = 6
+[[scenario.phases]]
+op = "convert"
+corpus = ["img"]
+[[scenario.phases]]
+op = "deploy"
+corpus = ["img"]
+layers = 2
+peers = false
+[scenario.slo]
+demand_threshold_ms = 10.0
+target = 0.9
+window_secs = 0.2
+burn_threshold = 1.5
+"""
+        spec = sspec.loads(toml)
+        runner = ScenarioRunner(
+            spec, str(tmp_path), serial=False, origin_latency_s=0.04
+        )
+        report = runner.run()
+        runner.close()
+        assert not report["ok"]
+        assert "burn breach" in report["error"]
+        assert report["slo"]["breaches"] >= 1
+
+    def test_fault_schedule_armed_per_phase_and_cleared(self, tmp_path):
+        toml = """
+[scenario]
+name = "faulty"
+seed = 5
+pods = 2
+[[scenario.corpus]]
+id = "img"
+kind = "compressible"
+mib = 1
+[[scenario.phases]]
+op = "convert"
+corpus = ["img"]
+[[scenario.phases]]
+op = "deploy"
+corpus = ["img"]
+layers = 2
+peers = false
+[[scenario.faults]]
+site = "snapshot.commit"
+action = "error(OSError)"
+phase = 1
+"""
+        spec = sspec.loads(toml)
+        failpoint.clear()
+        runner = ScenarioRunner(spec, str(tmp_path / "a"), serial=False)
+        report = runner.run()
+        runner.close()
+        assert not report["ok"]
+        assert "phase 1 (deploy)" in report["error"]
+        assert failpoint.active() == {}, "fault leaked past its phase"
+        # The serial oracle never arms faults: the same spec replays clean.
+        oracle = ScenarioRunner(spec, str(tmp_path / "b"), serial=True)
+        assert oracle.run()["ok"]
+        oracle.close()
+
+    def test_scenario_phase_failpoint_fails_loudly(self, tmp_path):
+        spec = mini_spec()
+        with failpoint.injected("scenario.phase", "error(OSError)"):
+            runner = ScenarioRunner(spec, str(tmp_path), serial=False)
+            report = runner.run()
+            runner.close()
+        assert not report["ok"]
+        assert "phase 0 (convert)" in report["error"]
+
+    def test_audit_detects_leaks_and_gaps(self, tmp_path):
+        spec = sspec.loads("""
+[scenario]
+name = "rows"
+seed = 3
+pods = 2
+[[scenario.corpus]]
+id = "img"
+kind = "compressible"
+mib = 1
+[[scenario.phases]]
+op = "convert"
+corpus = ["img"]
+[[scenario.phases]]
+op = "deploy"
+corpus = ["img"]
+layers = 2
+""")
+        runner = ScenarioRunner(spec, str(tmp_path), serial=False)
+        report = runner.run()
+        assert report["ok"], report["error"]
+        assert runner.audit()["clean"]
+        # A row the runner does not expect => leaked; an expected row
+        # that is gone => missing. The audit must flag both.
+        victim = next(iter(runner.expected_keys))
+        runner.expected_keys.discard(victim)
+        issues = runner.audit()["issues"]
+        assert any("leaked" in i and victim in i for i in issues)
+        runner.expected_keys.add(victim)
+        runner.expected_keys.add("ghost-key")
+        issues = runner.audit()["issues"]
+        assert any("missing" in i and "ghost-key" in i for i in issues)
+        runner.close()
+
+    def test_soci_arm_reads_unconverted_layer(self, tmp_path):
+        spec = sspec.loads("""
+[scenario]
+name = "soci"
+seed = 5
+pods = 2
+[[scenario.corpus]]
+id = "gz"
+kind = "compressible"
+mib = 2
+[[scenario.phases]]
+op = "deploy"
+corpus = ["gz"]
+soci = true
+layers = 2
+""")
+        (rep1, fp1, au1), (rep2, fp2, au2) = run_pair(spec, tmp_path)
+        assert rep1["ok"] and rep2["ok"]
+        assert fp1 == fp2
+        assert "built" in rep1["soci_outcomes"]
+        assert any(k.endswith("-soci") for k in fp1["reads"])
+        assert au1["clean"] and au2["clean"]
+
+    def test_run_scenario_convenience(self):
+        from nydus_snapshotter_tpu.scenario.orchestrator import run_scenario
+
+        report, fp, audit = run_scenario(mini_spec(), pods=2)
+        assert report["ok"], report["error"]
+        assert audit["clean"]
+        assert fp["reads"]

@@ -11,15 +11,28 @@ re-synthesizes deterministic file CONTENT over this real metadata (the
 fixture ships no blob data), giving the benchmark a real image's file-size
 distribution, tree shape, and chunking layout.
 
-Usage: python tools/extract_real_manifest.py
+``--derive-tree2`` derives the SECOND real tree
+(misc/fixtures/ubuntu_v6_tree2_manifest.json.gz) for real-vs-real
+cross-tree dedup (VERDICT r5 #8): a sibling image sharing the fixture's
+real base — a deterministic ~19% of the real paths dropped (a different
+package subset) and a deterministic ~25% of the survivors marked
+``gen = 1`` (a diverged-content delta, an apt-upgrade-sized change).
+Only one real fixture ships, so tree2 is a real-derived SUBGRAPH of it,
+not an independently captured image; its layout (paths, modes, sizes,
+chunk runs) is still the real fixture's, and content stays synthesized
+per ``(path, gen)`` — the caveat bench.py records next to the ratio.
+
+Usage: python tools/extract_real_manifest.py [--derive-tree2]
 """
 
 from __future__ import annotations
 
 import gzip
+import hashlib
 import io
 import json
 import os
+import stat as statmod
 import tarfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -27,12 +40,71 @@ FIXTURE = (
     "/root/reference/pkg/filesystem/testdata/v6-bootstrap-chunk-pos-438272.tar.gz"
 )
 OUT = os.path.join(REPO, "misc", "fixtures", "ubuntu_v6_manifest.json.gz")
+OUT2 = os.path.join(REPO, "misc", "fixtures", "ubuntu_v6_tree2_manifest.json.gz")
+
+
+def _write_gz(path: str, manifest: dict) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    raw = json.dumps(manifest, separators=(",", ":")).encode()
+    with open(path, "wb") as f:
+        # mtime=0 => deterministic, diff-stable artifact
+        with gzip.GzipFile(fileobj=f, mode="wb", mtime=0) as gz:
+            gz.write(raw)
+
+
+def derive_tree2() -> None:
+    """Derive the second real tree from the committed tree1 manifest.
+
+    Deterministic in the path alone (sha256, no RNG): drop a file or
+    symlink when ``sha256(path)[0] < 48`` (~19% — the sibling's missing
+    package set), mark a surviving file changed (``gen = 1``) when
+    ``sha256(path)[1] < 64`` (~25%). Directories stay (a real tree keeps
+    its skeleton; empty dirs are real too)."""
+    with gzip.open(OUT, "rb") as f:
+        tree1 = json.load(f)
+    entries = []
+    dropped = changed = 0
+    for e in tree1["entries"]:
+        mode = e["mode"]
+        h = hashlib.sha256(e["path"].encode()).digest()
+        if not statmod.S_ISDIR(mode) and h[0] < 48:
+            dropped += 1
+            continue
+        out = dict(e)
+        if statmod.S_ISREG(mode) and h[1] < 64:
+            out["gen"] = 1
+            changed += 1
+        entries.append(out)
+    manifest = {
+        "source": tree1["source"],
+        "derivation": (
+            "real-derived sibling of tree1: sha256(path)[0]<48 files/"
+            "symlinks dropped (different package subset), sha256(path)[1]"
+            "<64 survivors gen=1 (diverged content); layout stays the "
+            "real fixture's, content synthesized per (path, gen)"
+        ),
+        "inodes": len(entries),
+        "dropped": dropped,
+        "changed": changed,
+        "file_bytes": sum(
+            e["size"] for e in entries if e.get("chunks") and statmod.S_ISREG(e["mode"])
+        ),
+        "entries": entries,
+    }
+    _write_gz(OUT2, manifest)
+    print(
+        f"{OUT2}: {len(entries)} inodes ({dropped} dropped, {changed} gen=1), "
+        f"{manifest['file_bytes']} file bytes, {os.path.getsize(OUT2)} bytes gz"
+    )
 
 
 def main() -> None:
     import sys
 
     sys.path.insert(0, REPO)
+    if "--derive-tree2" in sys.argv:
+        derive_tree2()
+        return
     from nydus_snapshotter_tpu.models.nydus_real import parse_real_bootstrap
 
     with tarfile.open(FIXTURE) as tf:
@@ -61,12 +133,7 @@ def main() -> None:
         "file_bytes": sum(e["size"] for e in entries if e["chunks"]),
         "entries": entries,
     }
-    os.makedirs(os.path.dirname(OUT), exist_ok=True)
-    raw = json.dumps(manifest, separators=(",", ":")).encode()
-    with open(OUT, "wb") as f:
-        # mtime=0 => deterministic, diff-stable artifact
-        with gzip.GzipFile(fileobj=f, mode="wb", mtime=0) as gz:
-            gz.write(raw)
+    _write_gz(OUT, manifest)
     print(f"{OUT}: {len(entries)} inodes, {manifest['file_bytes']} file bytes, "
           f"{os.path.getsize(OUT)} bytes gz")
 

@@ -15,6 +15,7 @@ that process alone.
     ntpuctl slo                         # objectives, budgets, breaches
     ntpuctl trace 5ce100000001          # one merged cross-process tree
     ntpuctl top                         # scoreboard, refreshed in place
+    ntpuctl scenario                    # spec catalog + last storm gates
     ntpuctl --sock /run/.../d1.sock blobcache
     ntpuctl --json members              # machine-readable everything
 
@@ -391,6 +392,63 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_scenario(args) -> int:
+    """Scenario-engine catalog + last banked gate results. Filesystem-
+    backed (spec dir + report JSON from ``[scenario]`` config /
+    ``NTPU_SCENARIO*`` env), no socket needed — storms are driven by
+    tools/scenario_storm.py, not a live daemon."""
+    from nydus_snapshotter_tpu.scenario import resolve_scenario_config
+    from nydus_snapshotter_tpu.scenario.spec import list_specs
+
+    cfg = resolve_scenario_config()
+    listed = list_specs(args.spec_dir or cfg.spec_dir)
+    payload = {"spec_dir": args.spec_dir or cfg.spec_dir, "specs": [], "report": None}
+    rows = []
+    for path, spec, err in listed:
+        name = os.path.basename(path)
+        if spec is None:
+            payload["specs"].append({"file": name, "error": err})
+            rows.append([name, "-", "-", "-", f"INVALID: {err[:50]}"])
+            continue
+        payload["specs"].append({
+            "file": name, "name": spec.name, "pods": spec.pods,
+            "seed": spec.seed,
+            "phases": [p.op for p in spec.phases],
+            "description": spec.description,
+        })
+        rows.append([
+            name, spec.name, spec.pods, len(spec.phases),
+            "+".join(p.op for p in spec.phases),
+        ])
+    human = _table(rows, ["FILE", "SCENARIO", "PODS", "PHASES", "PIPELINE"]) \
+        if rows else f"no specs in {payload['spec_dir']}"
+
+    report_path = args.report or cfg.report_path
+    if os.path.exists(report_path):
+        try:
+            with open(report_path) as f:
+                report = json.load(f)
+        except ValueError as e:
+            raise CtlError(f"unreadable report {report_path}: {e}") from e
+        payload["report"] = report
+        gates = report.get("gates_failed", [])
+        p95 = report.get("demand_p95", {})
+        human += (
+            f"\n\nlast run ({os.path.basename(report_path)}): "
+            f"{report.get('scenario', '?')} @ {report.get('pods', '?')} pods"
+            f"\n  identity={report.get('identity')}  crashes={report.get('crashes')}"
+            f"  corrupt_served={report.get('corrupt_served')}"
+            f"\n  demand p95 {p95.get('ratio', '?')}x unloaded "
+            f"(gate {p95.get('gate', '?')}x)  "
+            f"dedup {report.get('cross_tree_dedup', {}).get('dedup_ratio', '?')}"
+            f"\n  gates: " + ("ALL PASS" if not gates else "; ".join(gates))
+        )
+    else:
+        human += f"\n\nno banked report at {report_path}"
+    _emit(args, payload, human)
+    return 0
+
+
 def cmd_top(args) -> int:
     iterations = args.iterations
     n = 0
@@ -456,6 +514,11 @@ def main(argv=None) -> int:
     top.add_argument("--interval", type=float, default=2.0)
     top.add_argument("--iterations", type=int, default=0,
                      help="refresh count (0 = until interrupted)")
+    scn = sub.add_parser("scenario")
+    scn.add_argument("--spec-dir", default="",
+                     help="spec catalog dir (default: [scenario] config)")
+    scn.add_argument("--report", default="",
+                     help="gate-report JSON (default: [scenario] config)")
     args = ap.parse_args(argv)
 
     handlers = {
@@ -468,6 +531,7 @@ def main(argv=None) -> int:
         "slo": cmd_slo,
         "trace": cmd_trace,
         "top": cmd_top,
+        "scenario": cmd_scenario,
     }
     try:
         return handlers[args.cmd](args)

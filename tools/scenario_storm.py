@@ -1,0 +1,360 @@
+"""Scenario storm: the gated "worst day in production" profile.
+
+Loads a declarative scenario spec (default ``misc/scenarios/
+worst_day.toml``: real-derived + adversarial corpora, a 16-pod deploy
+storm with a hostile corrupt peer, a mid-storm control-plane
+crash/restart, concurrent watermark eviction, transient peer faults, an
+unconverted-image soci arm, remove/GC churn and a full teardown), runs
+it CONCURRENTLY with every chaos arm enabled, then replays the same
+spec SERIALLY with faults disarmed — the oracle — and gates
+(abort-on-fail, per ISSUE 14 acceptance):
+
+- **identity** — the concurrent chaos run's fingerprint (id-normalized
+  metastore dump + per-pod demand-read digests + per-corpus blob ids)
+  is byte-identical to the serial replay's, on every arm;
+- **corrupt peer** — the hostile peer actually served corrupted
+  payloads (arm engaged) and no pod cached them (identity above proves
+  it; the CRC frame is what rejected them);
+- **crash** — the mid-storm restart actually happened;
+- **SLO** — the in-run judge recorded zero multi-window burn breaches,
+  and demand p95 under storm stays within ``demand_p95_factor``× the
+  unloaded baseline (unloaded = the same read shape on one pod, best of
+  ``--reps`` paired reps — noisy-box discipline; the storm registry's
+  deterministic per-call latency is the analytic floor both sides
+  share);
+- **bypass at storm scale** — the adaptive-codec convert of the
+  all-incompressible corpus routed ≥90% of its bytes through the
+  store-raw bypass (codec counter delta around the concurrent run),
+  while blob ids still match the serial replay (the engine is
+  deterministic in content);
+- **audit** — the end-state metastore/cache audit is clean on BOTH
+  runs: no leaked snapshot rows, no orphan snapshot dirs, no
+  unaccounted cache entries, no staging leftovers;
+- **real-vs-real** — the cross-tree dedup ratio (second real-derived
+  tree vs tree1's real-bootstrap dict) is measured and banked with its
+  content-synthesis caveat.
+
+Usage: python tools/scenario_storm.py [--spec misc/scenarios/worst_day.toml]
+           [--pods N] [--reps 2] [--out SCENARIO_STORM_r01.json] [--json]
+
+The CI smoke is ``--spec misc/scenarios/mini.toml`` (4 pods, one
+crash/restart, one corrupt-peer injection).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Demand reads must be dominated by the deterministic origin latency,
+# not by this box's CPU time-sharing (1 core: N concurrent pods add
+# ~Nx to any CPU-bound section, which would swamp the p95 comparison
+# with GIL noise instead of measuring queueing/starvation). 60 ms is a
+# plausible cross-region registry RTT and is the analytic floor both
+# sides share: the box's per-read CPU overhead (~15 ms median, ~50 ms
+# p95 under a 16-pod storm, measured) then sits inside the latency
+# floor instead of dominating the ratio.
+# The serial oracle runs at zero latency — identity does not depend on
+# timing, and the replay stays fast.
+ORIGIN_LATENCY_S = 0.06
+BYPASS_MIN_FRACTION = 0.90
+DEDUP_BOUNDS = (0.3, 0.999)
+
+
+def _codec_counters() -> dict:
+    from nydus_snapshotter_tpu.converter.codec import BYPASS_BYTES, PROBE_TOTAL
+
+    return {
+        "bypass_bytes": BYPASS_BYTES.value(),
+        "probe_bypass": PROBE_TOTAL.value("bypass"),
+    }
+
+
+def _incompressible_bytes(spec) -> int:
+    """Total bytes of all-incompressible corpora the spec converts
+    adaptively — the denominator of the bypass gate."""
+    adaptive_ids = set()
+    for p in spec.phases:
+        if p.op == "convert" and p.adaptive:
+            adaptive_ids.update(p.corpus)
+    return sum(
+        c.mib << 20
+        for c in spec.corpus
+        if c.kind == "incompressible" and c.id in adaptive_ids
+    )
+
+
+def _unloaded_p95(spec, pods: int, reps: int) -> dict:
+    """The unloaded demand baseline: the SAME topology as the storm's
+    first deploy phase — same pod count, peer tier on, same corpus, same
+    origin latency — but pods read one at a time (``pods_sequential``)
+    and every chaos arm is off, so the p95 comparison isolates LOAD, not
+    the peer hop. Best (min) p95 across paired reps, per the box's
+    wall-noise discipline."""
+    from nydus_snapshotter_tpu.scenario.orchestrator import ScenarioRunner
+    from nydus_snapshotter_tpu.scenario.spec import ScenarioSpec
+
+    deploy = next(p for p in spec.phases if p.op == "deploy")
+    cid = deploy.corpus[0]
+    base = ScenarioSpec.from_dict({
+        "scenario": {
+            "name": f"{spec.name}-unloaded",
+            "seed": spec.seed,
+            "pods": pods,
+            "corpus": [spec.corpus_by_id(cid).to_dict()],
+            "phases": (
+                [] if deploy.soci else
+                [{"op": "convert", "corpus": [cid]}]
+            ) + [{
+                "op": "deploy", "corpus": [cid],
+                "peers": deploy.peers, "layers": deploy.layers,
+                "soci": deploy.soci, "read_mib": deploy.read_mib,
+            }],
+            "slo": spec.slo.to_dict(),
+        }
+    })
+    p95s = []
+    for _ in range(reps):
+        workdir = tempfile.mkdtemp(prefix="scn-unloaded-")
+        try:
+            runner = ScenarioRunner(
+                base, workdir, serial=False, pods_sequential=True,
+                origin_latency_s=ORIGIN_LATENCY_S,
+            )
+            rep = runner.run()
+            if not rep["ok"]:
+                raise AssertionError(f"unloaded baseline failed: {rep['error']}")
+            p95s.append(runner.demand_p95_ms())
+            runner.close()
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+    return {"p95_ms_reps": p95s, "best_p95_ms": min(p95s)}
+
+
+def profile(spec_path: str, pods: int = 0, reps: int = 2) -> dict:
+    from nydus_snapshotter_tpu.scenario.corpus import cross_tree_dedup
+    from nydus_snapshotter_tpu.scenario.orchestrator import ScenarioRunner
+    from nydus_snapshotter_tpu.scenario.spec import load_spec
+
+    spec = load_spec(spec_path)
+    gates: list[str] = []
+    workroot = tempfile.mkdtemp(prefix="scenario-storm-")
+    try:
+        # Concurrent chaos runs: ``reps`` full storms, p95 best-rep
+        # (paired with the unloaded best-rep below — the box's ~2x
+        # wall-noise discipline). Identity/audit/counter gates come from
+        # the first rep; every rep must pass its own SLO judge.
+        before = _codec_counters()
+        storm_p95s = []
+        storm_wall = 0.0
+        storm_report = storm_fp = storm_audit = None
+        crashes = corrupt_served = 0
+        after = before
+        for r in range(max(1, reps)):
+            t0 = time.perf_counter()
+            storm = ScenarioRunner(
+                spec, os.path.join(workroot, f"storm{r}"), serial=False,
+                pods=pods or None, origin_latency_s=ORIGIN_LATENCY_S,
+            )
+            rep_report = storm.run()
+            wall = time.perf_counter() - t0
+            storm_p95s.append(storm.demand_p95_ms())
+            if not rep_report["ok"]:
+                gates.append(
+                    f"storm rep {r} failed: {rep_report['error']}"
+                )
+            if r == 0:
+                storm_report = rep_report
+                storm_wall = wall
+                storm_fp = storm.fingerprint()
+                storm_audit = storm.audit()
+                crashes = storm.crashes
+                corrupt_served = storm.corrupt_served
+                after = _codec_counters()
+            storm.close()
+        storm_p95 = min(storm_p95s)
+
+        # Serial oracle: same spec, pods sequential, workers serial,
+        # peers off, faults disarmed.
+        t0 = time.perf_counter()
+        oracle = ScenarioRunner(
+            spec, os.path.join(workroot, "serial"), serial=True,
+            pods=pods or None, origin_latency_s=0.0,
+        )
+        oracle_report = oracle.run()
+        serial_wall = time.perf_counter() - t0
+        oracle_fp = oracle.fingerprint()
+        oracle_audit = oracle.audit()
+        oracle.close()
+        if not oracle_report["ok"]:
+            gates.append(f"serial replay failed: {oracle_report['error']}")
+
+        identical = storm_fp == oracle_fp
+        if not identical:
+            diffs = [k for k in storm_fp if storm_fp[k] != oracle_fp[k]]
+            gates.append(
+                f"storm fingerprint diverges from serial replay in {diffs}"
+            )
+
+        if any(p.corrupt_peer for p in spec.phases) and corrupt_served == 0:
+            gates.append("corrupt-peer arm never served a corrupted payload")
+        if any(p.crash for p in spec.phases) and crashes == 0:
+            gates.append("mid-storm crash/restart never happened")
+
+        for audit, tag in ((storm_audit, "storm"), (oracle_audit, "serial")):
+            if not audit["clean"]:
+                gates.append(
+                    f"{tag} end-state audit dirty: {audit['issues'][:4]}"
+                )
+
+        # Incompressible bypass at storm scale.
+        incompressible = _incompressible_bytes(spec)
+        bypass = {
+            "incompressible_bytes": incompressible,
+            "bypass_bytes_delta": after["bypass_bytes"] - before["bypass_bytes"],
+            "probe_bypass_delta": after["probe_bypass"] - before["probe_bypass"],
+        }
+        if incompressible:
+            # The serial replay converts the same corpus again, so the
+            # concurrent-run delta alone must clear the gate; chunks of
+            # other corpora may legitimately bypass too, which is why
+            # the gate is a floor, not an equality.
+            storm_delta = bypass["bypass_bytes_delta"]
+            frac = storm_delta / incompressible
+            bypass["fraction_of_incompressible"] = round(frac, 4)
+            if frac < BYPASS_MIN_FRACTION:
+                gates.append(
+                    f"incompressible bypass moved only {frac:.1%} of the "
+                    f"corpus through store-raw (gate {BYPASS_MIN_FRACTION:.0%})"
+                )
+
+        # Demand p95 under storm vs unloaded (paired best-rep).
+        unloaded = _unloaded_p95(spec, pods or spec.pods, reps)
+        p95_ratio = storm_p95 / max(1e-9, unloaded["best_p95_ms"])
+        if p95_ratio > spec.slo.demand_p95_factor:
+            gates.append(
+                f"demand p95 under storm {p95_ratio:.2f}x unloaded "
+                f"(gate {spec.slo.demand_p95_factor}x)"
+            )
+
+        # Real-vs-real cross-tree dedup, banked with its caveat.
+        dedup = cross_tree_dedup()
+        if not DEDUP_BOUNDS[0] <= dedup["dedup_ratio"] <= DEDUP_BOUNDS[1]:
+            gates.append(
+                f"cross-tree dedup ratio {dedup['dedup_ratio']} outside "
+                f"sanity bounds {DEDUP_BOUNDS}"
+            )
+
+        leaked = [
+            t.name
+            for t in threading.enumerate()
+            if t.name.startswith(("ntpu-fetch", "ntpu-peer", "ntpu-scn",
+                                  "ntpu-snap"))
+        ]
+        if leaked:
+            gates.append(f"leaked threads: {leaked}")
+
+        return {
+            "spec": os.path.relpath(spec_path, REPO),
+            "scenario": spec.name,
+            "pods": pods or spec.pods,
+            "seed": spec.seed,
+            "origin_latency_ms": ORIGIN_LATENCY_S * 1000,
+            "storm_wall_s": round(storm_wall, 3),
+            "serial_wall_s": round(serial_wall, 3),
+            "phases": storm_report["phases"],
+            "slo": storm_report.get("slo", {}),
+            "origin": storm_report["origin"],
+            "soci_outcomes": storm_report["soci_outcomes"],
+            "crashes": crashes,
+            "corrupt_served": corrupt_served,
+            "identity": identical,
+            "audit": {"storm": storm_audit, "serial": oracle_audit},
+            "bypass": bypass,
+            "demand_p95": {
+                "storm_ms": storm_p95,
+                "storm_ms_reps": storm_p95s,
+                "unloaded": unloaded,
+                "ratio": round(p95_ratio, 3),
+                "gate": spec.slo.demand_p95_factor,
+            },
+            "cross_tree_dedup": dedup,
+            "gates_failed": gates,
+        }
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--spec",
+        default=os.path.join(REPO, "misc", "scenarios", "worst_day.toml"),
+        help="scenario spec to run (misc/scenarios/*.toml)",
+    )
+    ap.add_argument(
+        "--pods", type=int, default=0,
+        help="override the spec's default pod count (phases with pods=0)",
+    )
+    ap.add_argument("--reps", type=int, default=2,
+                    help="unloaded-baseline paired reps (best taken)")
+    ap.add_argument("--out", default="",
+                    help="bank the report JSON here (e.g. SCENARIO_STORM_r01.json)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    report = profile(args.spec, pods=args.pods, reps=args.reps)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(
+            f"scenario {report['scenario']} ({report['pods']} pods): "
+            f"storm {report['storm_wall_s']}s  serial {report['serial_wall_s']}s  "
+            f"identity={report['identity']}"
+        )
+        print(
+            f"chaos: crashes {report['crashes']}, corrupt peer served "
+            f"{report['corrupt_served']}, soci {report['soci_outcomes']}"
+        )
+        b = report["bypass"]
+        if b["incompressible_bytes"]:
+            print(
+                f"bypass: {b['bypass_bytes_delta']} raw bytes "
+                f"({b.get('fraction_of_incompressible', 0):.1%} of the "
+                f"incompressible corpus)"
+            )
+        p = report["demand_p95"]
+        print(
+            f"demand p95: storm {p['storm_ms']}ms vs unloaded "
+            f"{p['unloaded']['best_p95_ms']}ms = {p['ratio']}x (gate {p['gate']}x)"
+        )
+        d = report["cross_tree_dedup"]
+        print(
+            f"real-vs-real dedup: {d['dedup_ratio']} over {d['dict_chunks']} "
+            f"real-dict chunks (see caveat in the banked JSON)"
+        )
+        a = report["audit"]
+        print(
+            f"audit: storm clean={a['storm']['clean']} "
+            f"serial clean={a['serial']['clean']}"
+        )
+    for g in report["gates_failed"]:
+        print(f"FAIL: {g}", file=sys.stderr)
+    return 1 if report["gates_failed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
