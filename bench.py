@@ -920,6 +920,36 @@ def chunk_dict_run(repo: str, timeout: float = 240.0) -> dict:
         return {"error": "chunk-dict profile produced no JSON"}
 
 
+_DICT_HA_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from tools.dict_ha_profile import profile
+print(json.dumps(profile(images=6, files=4, reps=2)))
+"""
+
+
+def dict_ha_run(repo: str, timeout: float = 420.0) -> dict:
+    """Dict-shard HA profile (tools/dict_ha_profile.py) in a child under
+    the hard watchdog: the 2-shard/1-replica kill-the-primary storm —
+    converter byte-identity across a SIGKILL, automatic promotion,
+    budget-bounded replica catch-up, and the paired best-rep demand-p95
+    gate. Spawns a controller + 4 member processes; a wedge costs one
+    timeout, not a hang."""
+    res = _run_child_watchdog(
+        [sys.executable, "-c", _DICT_HA_CHILD.format(repo=repo)], timeout=timeout
+    )
+    if res is None:
+        return {"error": f"dict-ha profile hung >{timeout:.0f}s (watchdog killed it)"}
+    rc, stdout, stderr = res
+    if rc != 0:
+        tail = stderr.strip().splitlines()[-1] if stderr.strip() else ""
+        return {"error": f"dict-ha profile exited rc={rc}: {tail}"[:200]}
+    try:
+        return json.loads(stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": "dict-ha profile produced no JSON"}
+
+
 _COMPRESSION_CHILD = """
 import json, sys
 sys.path.insert(0, {repo!r})
@@ -1191,6 +1221,7 @@ def main() -> None:
     snapshot_ops = snapshot_ops_run(repo)
     trace_detail = trace_run(repo)
     chunk_dict_detail = chunk_dict_run(repo)
+    dict_ha_detail = dict_ha_run(repo)
     peer_storm = peer_storm_run(repo)
     fleet_obs = fleet_obs_run(repo)
     soci_detail = soci_run(repo)
@@ -1234,6 +1265,7 @@ def main() -> None:
                     "snapshot_ops": snapshot_ops,
                     "trace": trace_detail,
                     "chunk_dict": chunk_dict_detail,
+                    "dict_ha": dict_ha_detail,
                     "peer_storm": peer_storm,
                     "fleet_obs": fleet_obs,
                     "soci": soci_detail,

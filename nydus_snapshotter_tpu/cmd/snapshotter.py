@@ -361,7 +361,50 @@ def main(argv=None) -> int:
         from nydus_snapshotter_tpu.parallel.dict_service import DictService
 
         dict_service = DictService()
+        if cfg.chunk_dict.replicas > 0 or cfg.chunk_dict.shards > 1:
+            # HA: this process's dict service is a placement candidate.
+            # The process's one member slot is already claimed as
+            # "snapshotter", so advertise the dict socket the same way a
+            # daemon advertises its peer server — an extra annotation
+            # the placement controller accepts (fleet.annotate_self).
+            from nydus_snapshotter_tpu.ha.replicate import HaAgent
+
+            HaAgent(dict_service, role="unassigned")
         dict_service.run(cfg.chunk_dict.service)
+        if dict_service.ha is not None:
+            from nydus_snapshotter_tpu import fleet
+
+            fleet.annotate_self("dict_listen", cfg.chunk_dict.service)
+    # Dict-shard HA plane (ha/, docs/chunk_dict_service.md HA section):
+    # with replicas configured and the fleet plane up, the controller
+    # places each shard's primary + replicas over the live dict members,
+    # replicates journals, and auto-promotes on primary death. The knobs
+    # reach spawned dict/converter processes via the NTPU_DICT_HA* env.
+    if cfg.chunk_dict.replicas > 0 or cfg.chunk_dict.shards > 1:
+        os.environ.setdefault("NTPU_DICT_HA_SHARDS", str(cfg.chunk_dict.shards))
+        os.environ.setdefault("NTPU_DICT_HA_REPLICAS", str(cfg.chunk_dict.replicas))
+        os.environ.setdefault(
+            "NTPU_DICT_HA_BUDGET_KIB", str(cfg.chunk_dict.replication_budget_kib)
+        )
+        os.environ.setdefault(
+            "NTPU_DICT_HA_POLL_MS", str(cfg.chunk_dict.replication_poll_ms)
+        )
+        if fleet_plane is not None:
+            from nydus_snapshotter_tpu import ha as ha_mod
+
+            fleet_plane.attach_placement(
+                ha_mod.PlacementController(
+                    fleet_plane.registry.members,
+                    fleet_plane.federator.liveness,
+                    shards=cfg.chunk_dict.shards,
+                    replicas=cfg.chunk_dict.replicas,
+                    engine=fleet_plane.slo,
+                )
+            )
+            logger.info(
+                "dict-ha placement plane attached (%d shards x %d replicas)",
+                cfg.chunk_dict.shards, cfg.chunk_dict.replicas,
+            )
     # Peer chunk tier (daemon/peer.py): serve locally cached chunk ranges
     # to cluster peers and route this node's lazy-read misses through the
     # registry -> peer -> local-cache waterfall. The section reaches the

@@ -342,10 +342,21 @@ class ChunkDictConfig:
     per-process copies ("" = in-process dict, no service).
     ``service_backend`` picks the service's probe arm (``auto`` = native
     host probe on one shard, the mesh-routed ``device`` probe on a multi-
-    chip mesh). Environment variables override per-process
+    chip mesh).
+
+    HA replication (``ha/``, docs/chunk_dict_service.md HA section):
+    ``shards`` is the placement controller's key-space shard count and
+    ``replicas`` how many warm replicas each shard's primary gets
+    (0 = HA off). ``replication_budget_kib`` bounds the bytes a replica
+    holds in flight per record-tail pull (the bounded-memory catch-up
+    contract) and ``replication_poll_ms`` the journal-tail poll cadence.
+
+    Environment variables override per-process
     (``NTPU_DICT_LOAD_FACTOR``, ``NTPU_DICT_HEADROOM``,
-    ``NTPU_DICT_SERVICE``, ``NTPU_DICT_NAMESPACE``) — that is also how
-    the section reaches spawned converter processes.
+    ``NTPU_DICT_SERVICE``, ``NTPU_DICT_NAMESPACE``,
+    ``NTPU_DICT_HA_SHARDS``, ``NTPU_DICT_HA_REPLICAS``,
+    ``NTPU_DICT_HA_BUDGET_KIB``, ``NTPU_DICT_HA_POLL_MS``) — that is
+    also how the section reaches spawned converter/dict processes.
     """
 
     load_factor: float = 0.85
@@ -353,6 +364,10 @@ class ChunkDictConfig:
     service: str = ""
     namespace: str = "default"
     service_backend: str = "auto"
+    shards: int = 1
+    replicas: int = 0
+    replication_budget_kib: int = 256
+    replication_poll_ms: float = 50.0
 
 
 @dataclass
@@ -701,6 +716,14 @@ class SnapshotterConfig:
             raise ConfigError(
                 f"invalid chunk_dict.service_backend {self.chunk_dict.service_backend!r}"
             )
+        if self.chunk_dict.shards < 1:
+            raise ConfigError("chunk_dict.shards must be >= 1")
+        if self.chunk_dict.replicas < 0:
+            raise ConfigError("chunk_dict.replicas must be >= 0")
+        if self.chunk_dict.replication_budget_kib < 64:
+            raise ConfigError("chunk_dict.replication_budget_kib must be >= 64")
+        if self.chunk_dict.replication_poll_ms <= 0:
+            raise ConfigError("chunk_dict.replication_poll_ms must be > 0")
         if self.daemon.fs_driver in (constants.FS_DRIVER_BLOCKDEV, constants.FS_DRIVER_PROXY):
             # Proxy/blockdev modes run without nydusd daemons
             # (reference config.go:300-311 forces daemon_mode none).

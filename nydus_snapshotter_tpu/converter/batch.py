@@ -138,6 +138,34 @@ class GrowingChunkDict:
                     )
         return added
 
+    def append_records(self, chunks, blobs, batches, ciphers) -> None:
+        """VERBATIM append of already-merged record rows (the HA replica
+        apply path, dict_service.ServiceDict.apply_replica_tail): unlike
+        :meth:`add_bootstrap` there is no per-digest dedup and no blob
+        reindexing — the rows arrive exactly as the primary's first-wins
+        merge ordered them, and they must land at exactly the same table
+        positions for a promoted replica to honor the clients' replay
+        cursors. The probe maps are maintained so later (post-promotion)
+        merges dedup correctly against the replicated state."""
+        with self._lock:
+            bs = self.bootstrap
+            for rec in blobs:
+                self._blob_index_of.setdefault(rec.blob_id, len(bs.blobs))
+                bs.blobs.append(rec)
+            for rec in chunks:
+                if rec.blob_index >= len(bs.blobs):
+                    raise ConvertError(
+                        f"replica chunk row references blob index "
+                        f"{rec.blob_index} outside the replicated blob table"
+                    )
+                bs.chunks.append(rec)
+                self._by_digest.setdefault(rec.digest, rec)
+            for rec in batches:
+                self._batch_seen.add((rec.blob_index, rec.compressed_offset))
+                bs.batches.append(rec)
+            for rec in ciphers:
+                bs.ciphers.append(rec)
+
     # -- persistence ---------------------------------------------------------
 
     def save(self, path: str) -> None:
@@ -241,15 +269,26 @@ class BatchConverter:
                 )
             # Comma-separated addresses = a rendezvous-sharded namespace
             # (one DictService process per shard); one address keeps the
-            # single-service path byte-for-byte.
-            self.dict = dict_service_mod.ServiceChunkDict(
-                [
-                    dict_service_mod.DictClient(s.strip())
-                    for s in service.split(",")
-                    if s.strip()
-                ],
-                self.namespace,
-            )
+            # single-service path byte-for-byte. A service:// /
+            # service+ha:// scheme (or "|" failover groups) resolves the
+            # HA topology through open_chunk_dict — replica failover and
+            # placement-map resolution included (ha/, ISSUE 15).
+            if service.startswith(("service://", "service+ha://")) or "|" in service:
+                arg = service if service.startswith("service") else (
+                    "service://" + service
+                )
+                if "#" not in arg:
+                    arg += "#" + self.namespace
+                self.dict = dict_service_mod.open_chunk_dict(arg)
+            else:
+                self.dict = dict_service_mod.ServiceChunkDict(
+                    [
+                        dict_service_mod.DictClient(s.strip())
+                        for s in service.split(",")
+                        if s.strip()
+                    ],
+                    self.namespace,
+                )
             if self.codec is not None and self.codec.trained is None:
                 # Cross-host sharing: adopt the namespace's already-trained
                 # dictionary (epoch-stamped) before converting anything.

@@ -747,6 +747,10 @@ class PeerMembership:
         self._last_refresh = float("-inf")
         self._last_error = ""
         self._refreshing = False
+        # address -> member name from the last registry listing, and the
+        # rate limiter for upward health reports (report_down).
+        self._names: dict[str, str] = {}
+        self._reported: dict[str, float] = {}
 
     def _fetch_controller(self) -> list[dict]:
         if not self.controller:
@@ -777,10 +781,13 @@ class PeerMembership:
         live: Optional[list[str]] = None
         if rows is not None:
             addrs = set()
+            names: dict[str, str] = {}
             for r in rows:
                 addr = _normalize_addr(str(r.get("address", "")))
                 if not addr:
                     continue
+                if r.get("name"):
+                    names[addr] = str(r["name"])
                 if r.get("up", True) and not r.get("stale", False):
                     addrs.add(addr)
                 else:
@@ -804,6 +811,8 @@ class PeerMembership:
             self._refreshing = False
             self._last_refresh = now
             self._last_error = err
+            if rows is not None:
+                self._names.update(names)
             if live is not None and live != self._live:
                 prev = set(self._live)
                 cur = set(live)
@@ -829,6 +838,46 @@ class PeerMembership:
         with self._mu:
             self._view_shared.read()
             return list(self._live)
+
+    def report_down(self, address: str, source: str = "peer-router") -> bool:
+        """Upward health signal: a peer at ``address`` stopped answering
+        (its health cooldown tripped). Resolves the member name from the
+        last registry listing and posts it to the controller's
+        ``/api/v1/fleet/placement/report`` — the dict-HA placement plane
+        promotes around a reported-down member without waiting out
+        scrape staleness. Rate-limited per address; best-effort (the
+        report rides a background thread, a down controller drops it)."""
+        addr = _normalize_addr(address)
+        now = self._clock()
+        with self._mu:
+            self._view_shared.write()
+            name = self._names.get(addr, "")
+            if not self.controller or not name:
+                return False
+            last = self._reported.get(addr, float("-inf"))
+            if now - last < self.stale_cooldown:
+                return False
+            self._reported[addr] = now
+        controller = self.controller
+
+        def push():
+            from nydus_snapshotter_tpu.utils import udshttp
+
+            try:
+                udshttp.post_json(
+                    controller,
+                    "/api/v1/fleet/placement/report",
+                    {"name": name, "source": source},
+                    timeout=2.0,
+                )
+                MEMBERSHIP_EVENTS.labels("report_down").inc()
+            except Exception:  # noqa: BLE001 — best-effort signal
+                pass
+
+        threading.Thread(
+            target=push, name="ntpu-peer-report-down", daemon=True
+        ).start()
+        return True
 
     @property
     def epoch(self) -> int:
@@ -937,6 +986,11 @@ class PeerRouter:
             h.record_success()
         else:
             h.record_failure()
+            if self.membership is not None and not h.available():
+                # Cooldown tripped: this node just WATCHED the member
+                # fail repeatedly — tell the controller so the dict-HA
+                # plane can promote around it before scrape staleness.
+                self.membership.report_down(addr)
 
 
 # ---------------------------------------------------------------------------

@@ -101,6 +101,9 @@ KNOWN_SITES = (
     "fleet.scrape",          # metrics/federation.py per-member metrics scrape
     "fleet.collect",         # trace/aggregate.py per-member trace-ring pull
     "scenario.phase",        # scenario/orchestrator.py phase entry
+    "ha.place",              # ha/placement.py PlacementController.tick entry
+    "ha.replicate",          # ha/replicate.py ReplicaTailer.poll_once entry
+    "ha.promote",            # ha/{placement,replicate}.py promotion transition
 )
 
 _lock = _an.make_lock("failpoint.table")

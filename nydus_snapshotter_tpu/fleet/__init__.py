@@ -225,8 +225,17 @@ class FleetPlane:
         # suffers; restore on budget recovery). Member processes follow
         # the published actuation state (metrics/slo.SloActuationFollower).
         self.actuator = _slo.build_actuator(self.slo, clock=clock)
+        # Optional ha.placement.PlacementController: dict-shard placement
+        # + automatic replica promotion, ticked by the scrape loop and
+        # published on /api/v1/fleet/placement.
+        self.placement = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def attach_placement(self, controller) -> None:
+        """Mount a dict-HA placement controller on this plane (ticked by
+        the scrape loop, served on ``/api/v1/fleet/placement``)."""
+        self.placement = controller
 
     def _local_metrics(self) -> str:
         """The controller process's own exposition, through the cached
@@ -260,6 +269,8 @@ class FleetPlane:
                 self.slo.tick()
                 if self.actuator is not None:
                     self.actuator.tick()
+                if self.placement is not None:
+                    self.placement.tick()
             except Exception:  # noqa: BLE001 — the loop must survive anything
                 logger.exception("fleet scrape round failed")
             if self._stop.wait(self.cfg.scrape_interval_secs):
@@ -315,8 +326,24 @@ class FleetPlane:
                     return self._json(
                         {"deregistered": self.registry.deregister(name)}
                     )
+            if route == "/api/v1/fleet/placement/report" and method == "POST":
+                # External health signal (a peer/client that watched a
+                # dict member's socket die) — feeds promotion faster than
+                # scrape staleness.
+                if self.placement is None:
+                    return self._json({"message": "no placement plane"}, 404)
+                d = json.loads(body or b"{}")
+                name = str(d.get("name", ""))
+                if not name:
+                    return self._json({"message": "member name required"}, 400)
+                self.placement.report_down(name, source=str(d.get("source", "")))
+                return self._json({"reported": name})
             if method != "GET":
                 return self._json({"message": "no such endpoint"}, 404)
+            if route == "/api/v1/fleet/placement":
+                if self.placement is None:
+                    return self._json({"message": "no placement plane"}, 404)
+                return self._json(self.placement.map())
             if route == "/api/v1/fleet/metrics":
                 return 200, "text/plain; version=0.0.4", self.federator.render().encode()
             if route == "/api/v1/fleet/scoreboard":

@@ -1,0 +1,172 @@
+"""Dict-shard HA plane: placement, journal-streaming replication, and
+automatic replica promotion.
+
+PR 13 sharded the chunk-dict service across N processes — and made each
+shard a single point of failure: kill a shard's host mid-convert and
+every converter in the fleet wedges or fails loudly with no path back.
+This package closes that gap with three cooperating pieces:
+
+- :mod:`ha.placement` — a **placement controller** on the system
+  controller assigns each shard a primary + R replicas across the live
+  ``dict`` members of the fleet registry (rendezvous placement: join/
+  leave moves only the assignments whose ranking actually changed;
+  primaries are STICKY — a healthy primary is never displaced, so the
+  map only churns when a member dies or joins into a replica slot).
+  The map is published with an epoch on ``/api/v1/fleet/placement``,
+  and role assignments are pushed to the members' ``/api/v1/ha``
+  surface.
+- :mod:`ha.replicate` — **journal-streaming replication**: each replica
+  tails its primary's ``since`` journal RPC (epoch probe, count-only)
+  and pulls the append-only record tail in byte-budgeted slices
+  (``replication_budget_kib`` — the in-flight bound of "Bounded-Memory
+  Parallel Image Pulling": catch-up never holds more than one budgeted
+  payload, so it cannot compete with demand traffic). Rows are applied
+  VERBATIM at the same table positions the primary holds them, which is
+  what makes a promoted replica byte-compatible with the clients'
+  replay cursors. A replica whose primary regressed (restart with a
+  younger table) cannot reconcile its cursor and resyncs from a full
+  snapshot — loudly (error log + ``ntpu_dict_ha_resyncs_total``).
+- **automatic promotion** — when the fleet registry flags a primary
+  stale/dead (scrape liveness, or a peer-reported down signal from
+  ``daemon/peer.py``), the controller promotes the most-caught-up live
+  replica, bumps the placement epoch, and records the event on the SLO
+  surface. ``ServiceChunkDict`` clients fail over mid-merge: the
+  un-acked sub-bootstrap is replayed against the promoted replica, and
+  any record tail the client's mirror holds beyond the replica's tables
+  is repaired back first — every mirror's per-shard knowledge is a
+  PREFIX of the shard's record sequence, so concurrent repairs compose
+  and the reconstructed table is position-identical to the dead
+  primary's. Converter output stays byte-identical to the no-failure
+  path (gated by ``tools/dict_ha_profile.py``).
+
+Config: ``[chunk_dict]`` ``shards`` / ``replicas`` /
+``replication_budget_kib`` / ``replication_poll_ms`` with
+``NTPU_DICT_HA_SHARDS`` / ``NTPU_DICT_HA_REPLICAS`` /
+``NTPU_DICT_HA_BUDGET_KIB`` / ``NTPU_DICT_HA_POLL_MS`` env overrides
+(the env is how the section reaches spawned dict-service processes).
+Failpoints: ``ha.place`` / ``ha.replicate`` / ``ha.promote``. Metrics:
+``ntpu_dict_ha_*``. Docs: chunk_dict_service.md (HA section).
+"""
+
+from __future__ import annotations
+
+import os
+
+from nydus_snapshotter_tpu.metrics import registry as _metrics
+
+_reg = _metrics.default_registry
+
+PLACEMENT_EPOCH = _reg.register(
+    _metrics.Gauge(
+        "ntpu_dict_ha_placement_epoch",
+        "Current dict-shard placement map epoch (controller)",
+    )
+)
+PROMOTIONS = _reg.register(
+    _metrics.Counter(
+        "ntpu_dict_ha_promotions_total",
+        "Automatic replica promotions performed, by shard",
+        ("shard",),
+    )
+)
+REPLICATION_PULLS = _reg.register(
+    _metrics.Counter(
+        "ntpu_dict_ha_replication_pulls_total",
+        "Byte-budgeted record-tail pulls performed by replica tailers",
+    )
+)
+REPLICATION_BYTES = _reg.register(
+    _metrics.Counter(
+        "ntpu_dict_ha_replication_bytes_total",
+        "Record-tail payload bytes replicated onto this replica",
+    )
+)
+REPLICA_LAG = _reg.register(
+    _metrics.Gauge(
+        "ntpu_dict_ha_replica_lag_chunks",
+        "Chunk records this replica is behind its primary, per namespace",
+        ("namespace",),
+    )
+)
+RESYNCS = _reg.register(
+    _metrics.Counter(
+        "ntpu_dict_ha_resyncs_total",
+        "Loud full-snapshot resyncs after a replica failed to reconcile",
+    )
+)
+FAILOVERS = _reg.register(
+    _metrics.Counter(
+        "ntpu_dict_ha_failovers_total",
+        "Client-side shard failovers (un-acked batch replayed onto the "
+        "promoted replica)",
+    )
+)
+
+DEFAULT_BUDGET_KIB = 256
+DEFAULT_POLL_MS = 50.0
+
+
+class HaRuntimeConfig:
+    """Resolved dict-HA knobs for this process."""
+
+    __slots__ = ("shards", "replicas", "budget_bytes", "poll_s")
+
+    def __init__(self, shards: int, replicas: int, budget_bytes: int, poll_s: float):
+        self.shards = shards
+        self.replicas = replicas
+        self.budget_bytes = budget_bytes
+        self.poll_s = poll_s
+
+    @property
+    def enabled(self) -> bool:
+        return self.replicas > 0
+
+
+def _env_num(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+def resolve_ha_config() -> HaRuntimeConfig:
+    """env (``NTPU_DICT_HA*``) > ``[chunk_dict]`` global config >
+    defaults. The env is also how the knobs reach spawned dict-service
+    processes, which carry no global snapshotter config."""
+    try:
+        from nydus_snapshotter_tpu.config import config as _cfg
+
+        cd = _cfg.get_global_config().chunk_dict
+    except Exception:
+        cd = None
+    shards = int(_env_num("NTPU_DICT_HA_SHARDS", getattr(cd, "shards", 1)))
+    replicas = int(_env_num("NTPU_DICT_HA_REPLICAS", getattr(cd, "replicas", 0)))
+    budget_kib = _env_num(
+        "NTPU_DICT_HA_BUDGET_KIB",
+        getattr(cd, "replication_budget_kib", DEFAULT_BUDGET_KIB),
+    )
+    poll_ms = _env_num(
+        "NTPU_DICT_HA_POLL_MS", getattr(cd, "replication_poll_ms", DEFAULT_POLL_MS)
+    )
+    return HaRuntimeConfig(
+        shards=max(1, shards),
+        replicas=max(0, replicas),
+        budget_bytes=max(64 << 10, int(budget_kib * 1024)),
+        poll_s=max(0.001, poll_ms / 1000.0),
+    )
+
+
+from nydus_snapshotter_tpu.ha.placement import (  # noqa: E402
+    PlacementController,
+    ShardAssignment,
+)
+from nydus_snapshotter_tpu.ha.replicate import HaAgent, ReplicaTailer  # noqa: E402
+
+__all__ = [
+    "HaAgent",
+    "HaRuntimeConfig",
+    "PlacementController",
+    "ReplicaTailer",
+    "ShardAssignment",
+    "resolve_ha_config",
+]

@@ -241,6 +241,19 @@ class SloEngine:
         self._state_shared = _an.shared("slo.engine.state")
         self._state = {o.name: _ObjectiveState() for o in self.objectives}
         self._events: deque = deque(maxlen=keep_events)
+        # Operational events other control planes surface here (the HA
+        # placement controller's promotions land on /api/v1/fleet/slo
+        # next to the breaches they often explain).
+        self._ops_events: deque = deque(maxlen=keep_events)
+
+    def record_event(self, kind: str, **detail) -> dict:
+        """Attach one operational event (e.g. ``dict_ha_promotion``) to
+        the SLO surface; returns the recorded event."""
+        event = {"kind": kind, "at": self._clock(), **detail}
+        with self._lock:
+            self._state_shared.write()
+            self._ops_events.append(event)
+        return event
 
     def _window(self, st: _ObjectiveState, now: float, secs: float):
         """(good delta, total delta) between now's snapshot and the
@@ -343,6 +356,7 @@ class SloEngine:
                     if self._state[o.name].last_status
                 ],
                 "breaches": [dict(e) for e in self._events],
+                "events": [dict(e) for e in self._ops_events],
             }
 
     def breached(self) -> list[str]:

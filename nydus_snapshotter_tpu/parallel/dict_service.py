@@ -109,6 +109,11 @@ class DictServiceError(RuntimeError):
     """An RPC failed on the service side (the message carries the op)."""
 
 
+class NotPrimaryError(DictServiceError):
+    """A write RPC reached a replica (wire status 503): the caller must
+    fail over to the shard's current primary (ha/ placement map)."""
+
+
 # ---------------------------------------------------------------------------
 # Shard routing: namespace key-space split across N service processes
 # ---------------------------------------------------------------------------
@@ -364,15 +369,31 @@ class ServiceDict:
             return self._zdict or b""
 
     def entries_delta(
-        self, chunks: int, blobs: int, batches: int, ciphers: int
+        self,
+        chunks: int,
+        blobs: int,
+        batches: int,
+        ciphers: int,
+        limit: int = 0,
     ) -> bytes:
         """The append-only record tail past the caller's counts, as one
         header + four fixed-width sections — a mirror replays it and is
-        exactly the service's tables (cost proportional to the tail)."""
+        exactly the service's tables (cost proportional to the tail).
+
+        ``limit`` (> 0) caps the CHUNK rows per response — the byte
+        budget of the HA replication stream (``ha/replicate.py``: a
+        chunk row is 64 wire bytes, so ``limit = budget // 64``). The
+        other sections ship whole: they are small, and a truncated
+        chunk tail may reference blob rows only a full blob tail
+        carries. The header's ``total_chunks`` still reports the full
+        table, so a budgeted reader knows how far behind it is."""
         with self._mu:
             self._records_shared.read()
             bs = self.records.bootstrap
-            c_rows = bs.chunks[chunks:]
+            c_rows = (
+                bs.chunks[chunks : chunks + limit] if limit > 0
+                else bs.chunks[chunks:]
+            )
             b_rows = bs.blobs[blobs:]
             t_rows = bs.batches[batches:]
             e_rows = bs.ciphers[ciphers:]
@@ -437,6 +458,79 @@ class ServiceDict:
              np.ascontiguousarray(vals, dtype="<i8").tobytes()]
         )
 
+    def apply_replica_tail(self, meta, ca, ba, ta, ea, base) -> int:
+        """Apply a primary's record tail VERBATIM (HA replication,
+        ``ha/replicate.py``): rows land at exactly the table positions
+        the primary holds them, so a promoted replica honors surviving
+        clients' counts-based replay cursors unchanged. ``base`` is the
+        (chunks, blobs, batches, ciphers) cursor the tail was requested
+        at — a mismatch means the stream has a gap and the replica must
+        resync (raised as :class:`DictServiceError`, loudly)."""
+        from nydus_snapshotter_tpu.models.bootstrap import (
+            BatchRecord,
+            BlobRecord,
+            ChunkRecord,
+            CipherRecord,
+        )
+
+        with self._mu:
+            self._records_shared.write()
+            bs = self.records.bootstrap
+            have = (len(bs.chunks), len(bs.blobs), len(bs.batches), len(bs.ciphers))
+            if have != tuple(base):
+                raise DictServiceError(
+                    f"replica tail base mismatch: have {have}, tail expects "
+                    f"{tuple(base)} — replication stream has a gap, resync"
+                )
+            if meta.get("chunk_size"):
+                bs.chunk_size = int(meta["chunk_size"])
+            blobs = [
+                BlobRecord(
+                    blob_id=row["blob_id"].decode(),
+                    compressed_size=int(row["csize"]),
+                    uncompressed_size=int(row["usize"]),
+                    chunk_count=int(row["chunk_count"]),
+                    flags=int(row["flags"]),
+                )
+                for row in ba
+            ]
+            chunks = [
+                ChunkRecord(
+                    digest=row["digest"].tobytes(),
+                    blob_index=int(row["blob_index"]),
+                    flags=int(row["flags"]),
+                    uncompressed_offset=int(row["uoff"]),
+                    compressed_offset=int(row["coff"]),
+                    uncompressed_size=int(row["usize"]),
+                    compressed_size=int(row["csize"]),
+                )
+                for row in ca
+            ]
+            batches = [
+                BatchRecord(
+                    int(row["blob_index"]), int(row["coff"]),
+                    int(row["ubase"]), int(row["usize"]),
+                )
+                for row in ta
+            ]
+            ciphers = [
+                CipherRecord(
+                    algo=int(row["algo"]),
+                    key=row["key"].tobytes() if int(row["algo"]) else b"",
+                    iv=row["iv"].tobytes() if int(row["algo"]) else b"",
+                )
+                for row in ea
+            ]
+            self.records.append_records(chunks, blobs, batches, ciphers)
+            if chunks:
+                got = self.index.insert_digests([c.digest for c in chunks])
+                if got[0] != base[0]:  # pragma: no cover - invariant guard
+                    raise DictServiceError(
+                        f"replica index/record skew: insert returned {got[0]}, "
+                        f"records at {base[0]}"
+                    )
+            return len(chunks)
+
     def save(self, path: str) -> dict:
         """Persist both faces: the dict-image bootstrap (reference interop,
         ``--chunk-dict bootstrap=…`` shape) at ``path`` and the
@@ -466,8 +560,33 @@ class ServiceDict:
 class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
     daemon_threads = True
 
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        # Open kept-alive connections, so stop() can sever them: a
+        # stopped service must look exactly like a killed process to its
+        # clients (handler threads otherwise keep serving an old
+        # HTTP/1.1 connection after shutdown — and an "HA-failed"
+        # primary that still answers would fork the table).
+        self._conns_lock = threading.Lock()
+        self._conns: set = set()
+
     def finish_request(self, request, client_address):
-        self.RequestHandlerClass(request, ("uds", 0), self)
+        with self._conns_lock:
+            self._conns.add(request)
+        try:
+            self.RequestHandlerClass(request, ("uds", 0), self)
+        finally:
+            with self._conns_lock:
+                self._conns.discard(request)
+
+    def sever_connections(self) -> None:
+        with self._conns_lock:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
 
 class DictService:
@@ -478,6 +597,11 @@ class DictService:
     dedicated UDS (the ``[chunk_dict] service`` address).
     """
 
+    # Write ops a non-primary member must reject (wire 503 — the HA
+    # role gate; reads stay allowed so replicas serve warm probes and
+    # the replication stream itself).
+    _WRITE_OPS = ("merge", "save", "zdict")
+
     def __init__(self, cfg: Optional[DictRuntimeConfig] = None, mesh=None):
         self.cfg = cfg or resolve_dict_config()
         self._mesh = mesh
@@ -485,6 +609,8 @@ class DictService:
         self._mu = _an.make_lock("dict_service.registry")
         self._httpd: Optional[_UnixHTTPServer] = None
         self.sock_path = ""
+        # Optional ha.replicate.HaAgent: role gate + /api/v1/ha routes.
+        self.ha = None
 
     def dict_for(self, namespace: str) -> ServiceDict:
         if not _NS_RE.match(namespace):
@@ -497,6 +623,29 @@ class DictService:
                 )
             return sd
 
+    def reset_namespace(self, namespace: str) -> None:
+        """Drop one namespace's tables (the HA replica's loud full-resync
+        path — the tailer re-pulls the snapshot from record zero)."""
+        if not _NS_RE.match(namespace):
+            raise ValueError(f"invalid dict namespace {namespace!r}")
+        with self._mu:
+            self._dicts.pop(namespace, None)
+
+    def reset_all(self) -> int:
+        """Drop every namespace (a replica RETARGETED to a different
+        shard's primary must not replay a foreign table); returns how
+        many namespaces were dropped."""
+        with self._mu:
+            n = len(self._dicts)
+            self._dicts.clear()
+            return n
+
+    def namespace_stats(self) -> list[dict]:
+        """Stats for every namespace (the HA status surface)."""
+        with self._mu:
+            dicts = list(self._dicts.values())
+        return [sd.stats() for sd in dicts]
+
     # -- request dispatch -----------------------------------------------------
 
     def handle(
@@ -506,6 +655,13 @@ class DictService:
         Adopts the caller's trace context from the ``x-ntpu-*`` headers so
         the server-side span joins the converter's ``convert`` root."""
         parsed = urlparse(path)
+        if parsed.path.startswith("/api/v1/ha"):
+            # HA control surface (ha/replicate.HaAgent): role pushes and
+            # promotion from the placement controller, status for the
+            # most-caught-up ranking and ntpuctl.
+            if self.ha is None:
+                return 404, "application/json", b'{"message": "ha plane not attached"}'
+            return self.ha.handle(method, parsed.path, body)
         if parsed.path == "/api/v1/traces" and method == "GET":
             # A standalone dict-service process is a fleet member: its
             # span ring (dict.rpc.* spans) joins the cluster-merged trace.
@@ -544,6 +700,14 @@ class DictService:
             _RPC_ERRORS.labels(op).inc()
             from nydus_snapshotter_tpu.parallel.sharded_dict import DictEpochError
 
+            if isinstance(e, NotPrimaryError):
+                # HA role gate: a write reached a replica — 503 tells the
+                # client to fail over to the placement map's primary.
+                return (
+                    503,
+                    "application/json",
+                    json.dumps({"message": str(e)}).encode(),
+                )
             if isinstance(e, DictEpochError):
                 # Epoch-consistency contract: a journal tail that was
                 # compacted away is a 409 — the caller must resync from a
@@ -560,6 +724,16 @@ class DictService:
         return 200, "application/json", json.dumps(payload).encode()
 
     def _dispatch(self, method: str, op: str, ns: Optional[str], query: str, body: bytes):
+        if (
+            self.ha is not None
+            and method == "POST"
+            and op in self._WRITE_OPS
+            and not self.ha.is_primary()
+        ):
+            raise NotPrimaryError(
+                f"dict member is {self.ha.role}, not primary — fail over "
+                "to the placement map's primary for this shard"
+            )
         if op == "list":
             with self._mu:
                 names = sorted(self._dicts)
@@ -581,7 +755,8 @@ class DictService:
                 return v
 
             return sd.entries_delta(
-                count("chunks"), count("blobs"), count("batches"), count("ciphers")
+                count("chunks"), count("blobs"), count("batches"),
+                count("ciphers"), limit=count("limit"),
             )
         if op == "since" and method == "GET":
             q = parse_qs(query)
@@ -655,8 +830,13 @@ class DictService:
         fleet.register_self("dict", sock_path)
 
     def stop(self) -> None:
+        if self.ha is not None:
+            tailer = getattr(self.ha, "tailer", None)
+            if tailer is not None:
+                tailer.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
+            self._httpd.sever_connections()
             self._httpd.server_close()
             self._httpd = None
         if self.sock_path:
@@ -757,12 +937,16 @@ class DictClient:
         blobs: int = 0,
         batches: int = 0,
         ciphers: int = 0,
+        limit: int = 0,
     ) -> tuple[dict, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-        _ctype, payload = self._request(
-            "GET",
+        path = (
             f"/api/v1/dict/{namespace}/entries?chunks={chunks}&blobs={blobs}"
-            f"&batches={batches}&ciphers={ciphers}",
+            f"&batches={batches}&ciphers={ciphers}"
         )
+        if limit:
+            # HA replication's byte budget: cap the chunk rows per pull.
+            path += f"&limit={int(limit)}"
+        _ctype, payload = self._request("GET", path)
         hdr = np.frombuffer(payload, dtype=np.uint64, count=_DELTA_HDR_FIELDS)
         nc, nb, nt, ne = (int(x) for x in hdr[:4])
         off = hdr.nbytes
@@ -852,10 +1036,12 @@ class _ShardState:
 
     __slots__ = (
         "client", "chunks", "blobs", "batches", "ciphers", "epoch",
-        "rebuild_epoch", "blob_map",
+        "rebuild_epoch", "blob_map", "route_key", "alternates",
+        "hist_chunks", "hist_blobs", "hist_batches", "hist_ciphers",
     )
 
-    def __init__(self, client: DictClient):
+    def __init__(self, client: DictClient, route_key: str = "",
+                 alternates: Optional[list[str]] = None):
         self.client = client
         self.chunks = 0
         self.blobs = 0
@@ -865,6 +1051,24 @@ class _ShardState:
         self.rebuild_epoch = 0
         # shard-local blob index -> combined-mirror blob index
         self.blob_map: list[int] = []
+        # HA: the STABLE rendezvous routing key for this shard. Digest ->
+        # shard routing must not move when a replica is promoted (the
+        # key-space split IS the first-wins ordering authority), so the
+        # key is pinned at construction — the original primary address,
+        # or a synthetic "dict-shard-<i>" under placement resolution —
+        # and never follows the current client address.
+        self.route_key = route_key or client.sock_path
+        # HA: replica addresses to fail over to (placement replicas).
+        self.alternates: list[str] = list(alternates or ())
+        # HA: the shard-local record rows this mirror replayed, in replay
+        # order (the repair source: a promoted replica that lags the old
+        # primary is healed by re-merging this history — every mirror's
+        # per-shard knowledge is a PREFIX of the shard's record sequence,
+        # so concurrent repairs compose position-identically).
+        self.hist_chunks: list = []
+        self.hist_blobs: list = []
+        self.hist_batches: list = []
+        self.hist_ciphers: list = []
 
 
 class ServiceChunkDict:
@@ -901,17 +1105,33 @@ class ServiceChunkDict:
         client,
         namespace: str = DEFAULT_NAMESPACE,
         sync_on_init: bool = True,
+        failover=None,
+        resolver=None,
+        route_keys: Optional[list[str]] = None,
+        failover_deadline_s: float = 15.0,
     ):
         from nydus_snapshotter_tpu.models.bootstrap import Bootstrap
 
         clients = list(client) if isinstance(client, (list, tuple)) else [client]
         if not clients:
             raise ValueError("ServiceChunkDict needs at least one client")
-        self._shards = [_ShardState(c) for c in clients]
-        self.shard_addrs = [c.sock_path for c in clients]
-        # Back-compat accessor: shard 0 is where single-shard callers and
-        # the trained-zdict replication land.
-        self.client = clients[0]
+        # HA failover inputs: ``failover`` lists each shard's replica
+        # addresses; ``resolver(shard_idx)`` re-reads the placement map
+        # ([primary, *replicas]) so promotion mid-merge is discoverable;
+        # ``route_keys`` pins the rendezvous keys when addresses are not
+        # stable identities (the service+ha:// scheme).
+        self._resolver = resolver
+        self._failover_deadline_s = failover_deadline_s
+        self._ha = bool(failover) or resolver is not None
+        alts = list(failover) if failover else [None] * len(clients)
+        keys = list(route_keys) if route_keys else [c.sock_path for c in clients]
+        if len(alts) != len(clients) or len(keys) != len(clients):
+            raise ValueError("failover/route_keys must match the shard count")
+        self._shards = [
+            _ShardState(c, route_key=k, alternates=a)
+            for c, k, a in zip(clients, keys, alts)
+        ]
+        self.shard_addrs = keys
         self.namespace = namespace
         self.bootstrap = Bootstrap(inodes=[])
         self._by_digest: dict[bytes, object] = {}
@@ -922,6 +1142,18 @@ class ServiceChunkDict:
             self.sync()
 
     @property
+    def client(self) -> DictClient:
+        # Back-compat accessor: shard 0 is where single-shard callers and
+        # the trained-zdict replication land (follows failover).
+        return self._shards[0].client
+
+    def close(self) -> None:
+        """Close every shard's client connection (the mirror itself is
+        plain memory and needs no teardown)."""
+        for shard in self._shards:
+            shard.client.close()
+
+    @property
     def n_shards(self) -> int:
         return len(self._shards)
 
@@ -929,12 +1161,13 @@ class ServiceChunkDict:
         """Per-shard replication state (ntpuctl dict surfaces this)."""
         return [
             {
-                "address": self.shard_addrs[i],
+                "address": s.client.sock_path,
+                "route_key": s.route_key,
                 "epoch": s.epoch,
                 "rebuild_epoch": s.rebuild_epoch,
                 "chunks": s.chunks,
             }
-            for i, s in enumerate(self._shards)
+            for s in self._shards
         ]
 
     # -- probe interface (mirror-local) --------------------------------------
@@ -1062,19 +1295,177 @@ class ServiceChunkDict:
         shard.ciphers += len(ea)
         shard.epoch = meta["epoch"]
         shard.rebuild_epoch = meta["rebuild_epoch"]
+        if self._ha and (len(ca) or len(ba) or len(ta) or len(ea)):
+            # Keep the raw replayed rows: the failover repair source
+            # (~64 B per chunk record; only kept when HA is on).
+            shard.hist_chunks.append(np.array(ca))
+            shard.hist_blobs.append(np.array(ba))
+            shard.hist_batches.append(np.array(ta))
+            shard.hist_ciphers.append(np.array(ea))
         return len(ca)
 
     def sync(self) -> int:
         """Replay every shard's service tail into the combined mirror;
         returns how many chunk records arrived."""
         got = 0
-        for shard in self._shards:
+        for i, shard in enumerate(self._shards):
             if len(self._shards) > 1:
                 failpoint.hit("dict.shard")
                 _SHARD_BATCHES.labels("sync").inc()
-            got += self._sync_shard(shard)
+            got += self._with_failover(i, lambda s=shard: self._sync_shard(s))
         self.epoch = sum(s.epoch for s in self._shards)
         return got
+
+    # -- HA failover ---------------------------------------------------------
+
+    def _with_failover(self, shard_idx: int, fn):
+        """Run one shard RPC; on transport failure or a 503 role gate,
+        fail over to the shard's promoted replica and retry (the un-acked
+        operation is simply re-run — merge is first-wins idempotent and
+        sync resumes from the counts cursor). DictEpochError passes
+        through untouched: an epoch regression is a real loud failure,
+        never papered over by a retry."""
+        attempts = 0
+        while True:
+            try:
+                return fn()
+            except (DictServiceError, OSError) as e:
+                if not self._ha or attempts >= 2:
+                    raise
+                attempts += 1
+                self._failover_shard(shard_idx, e)
+
+    def _failover_shard(self, shard_idx: int, cause: Exception) -> None:
+        """Re-resolve the shard's primary (placement map / replica list),
+        adopt it, and repair any record tail this mirror holds beyond the
+        promoted replica's tables (prefix re-merge — see _ShardState)."""
+        import time as _time
+
+        from nydus_snapshotter_tpu import ha as _ha_mod
+
+        shard = self._shards[shard_idx]
+        dead = shard.client.sock_path
+        logger.warning(
+            "dict shard %s: primary %s failed (%s); failing over",
+            shard.route_key, dead, cause,
+        )
+        deadline = _time.monotonic() + self._failover_deadline_s
+        while True:
+            candidates: list[str] = []
+            if self._resolver is not None:
+                try:
+                    candidates = list(self._resolver(shard_idx) or ())
+                except Exception:  # noqa: BLE001 — controller may lag the kill
+                    candidates = []
+            candidates += [a for a in shard.alternates if a not in candidates]
+            ordered = [c for c in candidates if c and c != dead]
+            if dead in candidates:
+                ordered.append(dead)  # it may have come back
+            for addr in ordered:
+                cli = DictClient(addr, timeout=shard.client.timeout)
+                try:
+                    try:
+                        st = json.loads(
+                            cli._request("GET", "/api/v1/ha/status")[1]
+                        )
+                        if st.get("role") != "primary":
+                            cli.close()
+                            continue
+                    except DictServiceError as e:
+                        if "404" not in str(e):
+                            raise
+                        # No HA agent on this member: primary-capable.
+                    stats = cli.stats(self.namespace)
+                except (DictServiceError, OSError):
+                    cli.close()
+                    continue
+                shard.client.close()
+                shard.client = cli
+                _ha_mod.FAILOVERS.inc()
+                repaired = self._repair_shard(shard, int(stats.get("chunks", 0)))
+                # Fresh trust in the promoted primary: its index epochs
+                # count ITS insert batches, not the dead primary's — the
+                # counts cursor stays valid (tables are position-
+                # identical), the epoch cursor re-bases.
+                shard.epoch = 0
+                shard.rebuild_epoch = 0
+                logger.warning(
+                    "dict shard %s: failed over to %s (repaired %d records)",
+                    shard.route_key, addr, repaired,
+                )
+                return
+            if _time.monotonic() > deadline:
+                raise DictServiceError(
+                    f"dict shard {shard.route_key}: no live primary within "
+                    f"{self._failover_deadline_s:.1f}s (last error: {cause})"
+                )
+            _time.sleep(0.1)
+
+    def _repair_shard(self, shard: _ShardState, new_total: int) -> int:
+        """Re-merge the shard-local record history this mirror holds past
+        the promoted replica's tables. History is a prefix of the dead
+        primary's record sequence, and merge is first-wins — already-
+        replicated rows dedup away, lost rows append in their original
+        order, so the reconstructed table is position-identical no matter
+        how many clients repair concurrently."""
+        if new_total >= shard.chunks or not shard.hist_chunks:
+            return 0
+        from nydus_snapshotter_tpu.models.bootstrap import (
+            BatchRecord,
+            BlobRecord,
+            Bootstrap,
+            ChunkRecord,
+            CipherRecord,
+        )
+
+        sub = Bootstrap(chunk_size=self.bootstrap.chunk_size, inodes=[])
+        for arr in shard.hist_blobs:
+            for row in arr:
+                sub.blobs.append(
+                    BlobRecord(
+                        blob_id=row["blob_id"].decode(),
+                        compressed_size=int(row["csize"]),
+                        uncompressed_size=int(row["usize"]),
+                        chunk_count=int(row["chunk_count"]),
+                        flags=int(row["flags"]),
+                    )
+                )
+        for arr in shard.hist_ciphers:
+            for row in arr:
+                algo = int(row["algo"])
+                sub.ciphers.append(
+                    CipherRecord(
+                        algo=algo,
+                        key=row["key"].tobytes() if algo else b"",
+                        iv=row["iv"].tobytes() if algo else b"",
+                    )
+                )
+        for arr in shard.hist_chunks:
+            for row in arr:
+                sub.chunks.append(
+                    ChunkRecord(
+                        digest=row["digest"].tobytes(),
+                        blob_index=int(row["blob_index"]),
+                        flags=int(row["flags"]),
+                        uncompressed_offset=int(row["uoff"]),
+                        compressed_offset=int(row["coff"]),
+                        uncompressed_size=int(row["usize"]),
+                        compressed_size=int(row["csize"]),
+                    )
+                )
+        for arr in shard.hist_batches:
+            for row in arr:
+                sub.batches.append(
+                    BatchRecord(
+                        int(row["blob_index"]), int(row["coff"]),
+                        int(row["ubase"]), int(row["usize"]),
+                    )
+                )
+        if sub.ciphers:
+            while len(sub.ciphers) < len(sub.blobs):
+                sub.ciphers.append(CipherRecord())
+        res = shard.client.merge(sub.to_bytes(), self.namespace)
+        return int(res.get("added", 0))
 
     def _partition_bootstrap(self, data: bytes) -> list[Optional[bytes]]:
         """Split one image's bootstrap into per-shard sub-bootstraps:
@@ -1143,16 +1534,27 @@ class ServiceChunkDict:
         tails (including anything other converters added first) into the
         mirror. Returns how many chunks this merge added."""
         if len(self._shards) == 1:
-            res = self.client.merge(data, self.namespace)
+            res = self._with_failover(
+                0, lambda: self.client.merge(data, self.namespace)
+            )
             added = int(res.get("added", 0))
         else:
             added = 0
-            for shard, sub in zip(self._shards, self._partition_bootstrap(data)):
+            for i, (shard, sub) in enumerate(
+                zip(self._shards, self._partition_bootstrap(data))
+            ):
                 if sub is None:
                     continue
                 failpoint.hit("dict.shard")
                 _SHARD_BATCHES.labels("merge").inc()
-                res = shard.client.merge(sub, self.namespace)
+                # Mid-merge failover: the un-acked sub-bootstrap is the
+                # replay unit — on a dead/demoted primary it is re-merged
+                # verbatim against the promoted replica (first-wins makes
+                # the replay idempotent whether or not the dead primary
+                # had applied it).
+                res = self._with_failover(
+                    i, lambda s=shard, b=sub: s.client.merge(b, self.namespace)
+                )
                 added += int(res.get("added", 0))
         self.sync()
         return added
@@ -1166,25 +1568,110 @@ class ServiceChunkDict:
         namespace persists one partition per shard
         (``<path>.shard<i>-of-<n>``)."""
         if len(self._shards) == 1:
-            self.client.save(path, self.namespace)
+            self._with_failover(0, lambda: self.client.save(path, self.namespace))
             return
         n = len(self._shards)
         for i, shard in enumerate(self._shards):
-            shard.client.save(f"{path}.shard{i}-of-{n}", self.namespace)
+            self._with_failover(
+                i,
+                lambda s=shard, p=f"{path}.shard{i}-of-{n}": s.client.save(
+                    p, self.namespace
+                ),
+            )
+
+
+def placement_resolver(controller: str, timeout: float = 5.0):
+    """``resolver(shard_idx) -> [primary_addr, *replica_addrs]`` backed by
+    the controller's ``/api/v1/fleet/placement`` map (ha/placement.py).
+    Returns the live candidate ordering a failing client retries against
+    — promotion shows up here as soon as the controller's epoch bumps."""
+    from nydus_snapshotter_tpu.utils import udshttp
+
+    def resolve(shard_idx: int) -> list[str]:
+        doc = udshttp.get_json(controller, "/api/v1/fleet/placement", timeout=timeout)
+        assignments = doc.get("assignments", [])
+        if shard_idx >= len(assignments):
+            return []
+        a = assignments[shard_idx]
+        out = [a.get("primary", {}).get("address", "")]
+        out += [r.get("address", "") for r in a.get("replicas", [])]
+        return [x for x in out if x]
+
+    return resolve
+
+
+def open_ha_chunk_dict(
+    controller: str,
+    namespace: str = DEFAULT_NAMESPACE,
+    resolve_deadline_s: float = 15.0,
+) -> "ServiceChunkDict":
+    """Placement-resolved HA mirror: shard primaries come from the
+    controller's placement map, rendezvous routing keys are the STABLE
+    synthetic shard names (``dict-shard-<i>``) so promotion never moves
+    the key-space split, and failover re-resolves the map mid-merge."""
+    import time as _time
+
+    resolver = placement_resolver(controller)
+    deadline = _time.monotonic() + resolve_deadline_s
+    while True:
+        from nydus_snapshotter_tpu.utils import udshttp
+
+        try:
+            doc = udshttp.get_json(controller, "/api/v1/fleet/placement")
+            assignments = doc.get("assignments", [])
+            primaries = [
+                a.get("primary", {}).get("address", "") for a in assignments
+            ]
+            if primaries and all(primaries):
+                break
+        except Exception:  # noqa: BLE001 — the controller may still be placing
+            pass
+        if _time.monotonic() > deadline:
+            raise DictServiceError(
+                f"placement map on {controller} has no full primary set "
+                f"within {resolve_deadline_s:.1f}s"
+            )
+        _time.sleep(0.1)
+    clients = [DictClient(p) for p in primaries]
+    return ServiceChunkDict(
+        clients,
+        namespace,
+        resolver=resolver,
+        route_keys=[f"dict-shard-{i}" for i in range(len(clients))],
+    )
 
 
 def open_chunk_dict(arg: str):
-    """Resolve a ``chunk_dict_path``-shaped argument: the
-    ``service://<uds-path>[,<uds-path>...][#namespace]`` scheme connects
-    a :class:`ServiceChunkDict` mirror (comma-separated addresses =
-    rendezvous-sharded namespace); anything else is the file-based dict
-    (``bootstrap=…`` prefixed or bare path, as before)."""
+    """Resolve a ``chunk_dict_path``-shaped argument:
+
+    - ``service://<uds>[|<replica-uds>...][,<uds>...][#namespace]`` —
+      a :class:`ServiceChunkDict` mirror; comma-separated groups are the
+      rendezvous shards, ``|``-separated addresses inside a group are
+      the shard's failover candidates (primary first; the FIRST address
+      stays the shard's routing key across failovers);
+    - ``service+ha://<controller-uds>[#namespace]`` — shard set and
+      failover candidates resolved live from the controller's placement
+      map (:func:`open_ha_chunk_dict`);
+    - anything else is the file-based dict (``bootstrap=…`` prefixed or
+      bare path, as before)."""
+    if arg.startswith("service+ha://"):
+        rest = arg[len("service+ha://"):]
+        controller, _, ns = rest.partition("#")
+        return open_ha_chunk_dict(controller.strip(), ns or DEFAULT_NAMESPACE)
     if arg.startswith("service://"):
         rest = arg[len("service://"):]
         socks, _, ns = rest.partition("#")
-        clients = [
-            DictClient(s.strip()) for s in socks.split(",") if s.strip()
+        groups = [
+            [a.strip() for a in g.split("|") if a.strip()]
+            for g in socks.split(",")
+            if g.strip()
         ]
+        clients = [DictClient(g[0]) for g in groups]
+        failover = [g[1:] for g in groups]
+        if any(failover):
+            return ServiceChunkDict(
+                clients, ns or DEFAULT_NAMESPACE, failover=failover
+            )
         return ServiceChunkDict(clients, ns or DEFAULT_NAMESPACE)
     from nydus_snapshotter_tpu.models.bootstrap import ChunkDict, parse_chunk_dict_arg
 

@@ -238,6 +238,56 @@ class SimFs:
         return None
 
 
+class _GrpcControlPlane:
+    """The ``deploy_api = "grpc"`` driver: pods issue their control-plane
+    RPC mix through the REAL snapshots.v1 gRPC surface on a UDS
+    (api/service.py), exactly as containerd's proxy plugin would —
+    instead of calling the Snapshotter object directly. The server wraps
+    the SAME Snapshotter, so the metastore fingerprint stays comparable
+    with the in-process driver (and with the serial replay, which runs
+    the same deploy_api). gRPC status codes map back onto the errdefs
+    the pod logic already handles."""
+
+    def __init__(self, sn, sock: str):
+        from nydus_snapshotter_tpu.api.client import SnapshotsClient
+        from nydus_snapshotter_tpu.api.service import serve
+
+        self.sock = sock
+        self.server = serve(sn, sock)
+        self.client = SnapshotsClient(sock, timeout=30.0)
+
+    def close(self) -> None:
+        self.client.close()
+        self.server.stop(grace=None)
+
+    @staticmethod
+    def _map(call):
+        import grpc
+
+        try:
+            return call()
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.ALREADY_EXISTS:
+                raise errdefs.AlreadyExists(e.details()) from e
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                raise errdefs.NotFound(e.details()) from e
+            raise ScenarioRunError(
+                f"grpc control plane: {e.code().name}: {e.details()}"
+            ) from e
+
+    def prepare(self, key, parent, labels=None):
+        return self._map(lambda: self.client.prepare(key, parent, labels))
+
+    def commit(self, name, key, labels=None):
+        return self._map(lambda: self.client.commit(name, key, labels))
+
+    def mounts(self, key):
+        return self._map(lambda: self.client.mounts(key))
+
+    def usage(self, key):
+        return self._map(lambda: self.client.usage(key))
+
+
 class CorruptPeerServer:
     """Hostile peer: wraps a real PeerChunkServer and corrupts blob
     payloads AFTER the CRC header is stamped — exactly what transit
@@ -366,10 +416,13 @@ class ScenarioRunner:
         self.corrupt_served = 0
         self.soci_outcomes: list[str] = []
         self.crashes = 0
+        self.ha_promotions = 0
         self._engine = None
         self._engine_stop = threading.Event()
         self._engine_thread = None
         self._demand_mu = _an.make_lock("scenario.demand")
+        self._grpc: Optional[_GrpcControlPlane] = None
+        self._grpc_mu = _an.make_lock("scenario.grpc")
 
     # -- control plane lifecycle --------------------------------------------
 
@@ -385,6 +438,16 @@ class ScenarioRunner:
             read_pool=4, prepare_fanout=4, usage_workers=1, cleanup_workers=2)
         self.sn = Snapshotter(root=self._snap_root(), fs=self.fs, **kw)
 
+    def _grpc_plane(self) -> _GrpcControlPlane:
+        """The lazily-opened gRPC control-plane driver over the current
+        Snapshotter (re-opened on crash/restart with it)."""
+        with self._grpc_mu:
+            if self._grpc is None:
+                self._grpc = _GrpcControlPlane(
+                    self.sn, os.path.join(self.workdir, "scn-grpc.sock")
+                )
+            return self._grpc
+
     def _crash_restart(self) -> None:
         """Close the control plane mid-run (daemons die with it) and
         reopen it over the same persisted metastore.
@@ -394,12 +457,21 @@ class ScenarioRunner:
         ``crash_restart`` phases run on the main thread between phases —
         so no lock is held across the close (which joins the usage
         accountant's workers)."""
+        with self._grpc_mu:
+            grpc_was_open = self._grpc is not None
+            plane, self._grpc = self._grpc, None
+        if plane is not None:
+            plane.close()
         if self.sn is not None:
             self.sn.close()
             self.sn = None
         self.fs.crash()
         self.crashes += 1
         self._open_control_plane()
+        if grpc_was_open:
+            # The gRPC surface died with the control plane; reopen it on
+            # the same socket so parked pods resume over the same API.
+            self._grpc_plane()
 
     # -- corpora -------------------------------------------------------------
 
@@ -451,6 +523,7 @@ class ScenarioRunner:
                 "tar_len": len(tar),
                 "blob": blob,
                 "blob_id": res.blob_id,
+                "bootstrap": res.bootstrap,
                 "digest": hashlib.sha256(blob).hexdigest(),
             }
 
@@ -477,7 +550,117 @@ class ScenarioRunner:
                 "tar_mib": round(r["tar_len"] / (1 << 20), 2),
                 "blob_mib": round(len(r["blob"]) / (1 << 20), 2),
             }
-        return {"converted": out}
+        detail = {"converted": out}
+        if phase.shard_failover and not self.serial:
+            detail["shard_failover"] = self._shard_failover_arm(idx, results)
+        return detail
+
+    def _shard_failover_arm(self, idx: int, results: list) -> dict:
+        """The ``shard_failover`` fault arm: drive the dict-HA plane end
+        to end with this phase's real converted bootstraps. A primary +
+        replica dict-service pair replicates under a placement
+        controller; the PRIMARY DIES mid-merge-sequence, the controller
+        promotes the replica (scrape-liveness path), the mirror client
+        fails over and replays its un-acked batch — and the surviving
+        table must be byte-identical to a straight-line single-service
+        oracle fed the same bootstraps in the same order. Skipped in the
+        serial replay (like the corrupt-peer probe, it is a fault arm,
+        not part of the identity surface)."""
+        from nydus_snapshotter_tpu import fleet as fleet_mod
+        from nydus_snapshotter_tpu.ha import PlacementController
+        from nydus_snapshotter_tpu.ha.replicate import HaAgent
+        from nydus_snapshotter_tpu.parallel.dict_service import (
+            DictClient,
+            DictService,
+            ServiceChunkDict,
+            ServiceDict,
+        )
+
+        # Converted bootstraps in deterministic (corpus) order; the arm
+        # needs at least two merges so the kill lands mid-sequence.
+        boots = [
+            self.images[r["cid"]].get("bootstrap")
+            for r in sorted(results, key=lambda r: r["cid"])
+        ]
+        boots = [b for b in boots if b]
+        if len(boots) < 2:
+            return {"skipped": "needs >= 2 converted bootstraps"}
+        sockdir = os.path.join(self.workdir, f"ph{idx}-ha")
+        os.makedirs(sockdir, exist_ok=True)
+        services, members = [], []
+        liveness = {}
+        for i in range(2):
+            svc = DictService()
+            HaAgent(svc, role="unassigned")
+            svc.run(os.path.join(sockdir, f"dict{i}.sock"))
+            services.append(svc)
+            members.append(
+                fleet_mod.Member(
+                    name=f"scn-dict-{i}", component="dict",
+                    address=svc.sock_path, pid=os.getpid(),
+                )
+            )
+            liveness[f"scn-dict-{i}"] = {"up": True, "stale": False}
+        controller = PlacementController(
+            lambda: members, lambda: dict(liveness), shards=1, replicas=1
+        )
+        oracle = ServiceDict("scnha")
+        promotions = 0
+        try:
+            controller.tick()
+            primary_name = controller.map()["assignments"][0]["primary"]["name"]
+            primary_i = int(primary_name.rsplit("-", 1)[1])
+            replica_i = 1 - primary_i
+            scd = ServiceChunkDict(
+                [DictClient(services[primary_i].sock_path)], "scnha",
+                failover=[[services[replica_i].sock_path]],
+            )
+            for b in boots:
+                oracle.merge_bootstrap_bytes(b)
+            half = max(1, len(boots) // 2)
+            for b in boots[:half]:
+                scd.add_bootstrap_bytes(b)
+            # Let the replica catch up to the acked half, then kill the
+            # primary without ceremony (its threads die unanswered).
+            deadline = time.monotonic() + 10.0
+            want = len(services[primary_i].dict_for("scnha").records.bootstrap.chunks)
+            while time.monotonic() < deadline:
+                got = len(
+                    services[replica_i].dict_for("scnha").records.bootstrap.chunks
+                )
+                if got >= want:
+                    break
+                time.sleep(0.02)
+            services[primary_i].stop()
+            liveness[primary_name] = {"up": False, "stale": True}
+            controller.tick()  # promotes the replica
+            promotions = controller.map()["promotions"]
+            for b in boots[half:]:
+                scd.add_bootstrap_bytes(b)  # mid-merge failover path
+            survivor = services[replica_i].dict_for("scnha")
+            identical = (
+                survivor.records.bootstrap.to_bytes()
+                == oracle.records.bootstrap.to_bytes()
+            )
+            scd.close()
+            if not identical:
+                raise ScenarioRunError(
+                    "shard_failover arm: post-promotion table diverged "
+                    "from the straight-line oracle"
+                )
+            if promotions < 1:
+                raise ScenarioRunError(
+                    "shard_failover arm: controller performed no promotion"
+                )
+            self.ha_promotions += promotions
+            return {
+                "promotions": promotions,
+                "chunks": len(survivor.records.bootstrap.chunks),
+                "identical": identical,
+            }
+        finally:
+            for svc in services:
+                svc.stop()
 
     def _image_for_deploy(self, cid: str, soci: bool) -> dict:
         """Converted image, or (soci arm) the UNCONVERTED gzip layer —
@@ -505,11 +688,14 @@ class ScenarioRunner:
             "(add a convert phase or set soci = true)"
         )
 
-    def _control_plane_pod(self, prefix: str, layers: int) -> dict:
+    def _control_plane_pod(self, prefix: str, layers: int, cp=None) -> dict:
         """The containerd cold-start RPC mix for one pod: layer chain +
         meta layer + writable container layer, then usage for every
-        name. Returns the chain record removal needs."""
-        sn = self.sn
+        name. ``cp`` is the control-plane driver — the Snapshotter
+        itself, or the gRPC facade when the phase sets
+        ``deploy_api = "grpc"``. Returns the chain record removal
+        needs."""
+        sn = cp if cp is not None else self.sn
         parent = ""
         names = []
         for j in range(layers - 1):
@@ -533,8 +719,10 @@ class ScenarioRunner:
         sn.prepare(
             meta_key, parent, {C.TARGET_SNAPSHOT_REF: meta_name, **meta_labels}
         )
-        sid = sn.ms.get_snapshot(meta_key).id
-        upper = sn.upper_path(sid)
+        # Upper-dir writes stay process-local (the gRPC surface carries
+        # no file I/O, exactly as with containerd).
+        sid = self.sn.ms.get_snapshot(meta_key).id
+        upper = self.sn.upper_path(sid)
         for i in range(8):
             with open(os.path.join(upper, f"f{i:02d}.bin"), "wb") as f:
                 f.write(bytes([(i * 7) % 251]) * (512 + 16 * i))
@@ -637,8 +825,16 @@ class ScenarioRunner:
         def _run_pod_traced(i: int, img: dict) -> None:
             enter_cp()
             try:
+                # Resolve the control-plane driver INSIDE the cp window:
+                # a crash/restart replaces both the Snapshotter and the
+                # gRPC plane, and enter_cp guarantees neither happens
+                # while this pod's RPC mix is in flight.
+                cp = (
+                    self._grpc_plane() if phase.deploy_api == "grpc" else None
+                )
                 chains[i] = self._control_plane_pod(
-                    f"ph{idx}-{img['cid'].replace(':', '_')}-pod{i}", layers
+                    f"ph{idx}-{img['cid'].replace(':', '_')}-pod{i}", layers,
+                    cp=cp,
                 )
             finally:
                 exit_cp()
@@ -1016,6 +1212,9 @@ class ScenarioRunner:
         }
 
     def close(self) -> None:
+        if self._grpc is not None:
+            self._grpc.close()
+            self._grpc = None
         if self.sn is not None:
             self.sn.close()
             self.sn = None

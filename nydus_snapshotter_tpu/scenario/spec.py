@@ -22,6 +22,9 @@ A spec is one TOML document::
     op = "convert"            # convert | deploy | remove | gc | crash_restart
     corpus = ["ubuntu"]
     # adaptive = true         # convert: enable the adaptive codec
+    # shard_failover = true   # convert: dict-HA fault arm (primary dies
+    #                         # mid-merge; promotion + failover must match
+    #                         # the straight-line oracle byte for byte)
 
     [[scenario.phases]]
     op = "deploy"
@@ -34,6 +37,7 @@ A spec is one TOML document::
     # read_mib = 8            # demand-read window per pod (0 = whole blob)
     # crash = "mid"           # crash/restart the control plane mid-phase
     # gc_watermark_mib = 8    # concurrent watermark eviction during the phase
+    # deploy_api = "grpc"     # drive the real snapshots.v1 gRPC surface
 
     [[scenario.phases]]
     op = "remove"
@@ -87,6 +91,7 @@ CORPUS_KINDS = (
 )
 PHASE_OPS = ("convert", "deploy", "remove", "gc", "crash_restart")
 CRASH_MODES = ("", "mid")
+DEPLOY_APIS = ("", "snapshotter", "grpc")
 
 
 def _only_keys(table: dict, allowed: set, where: str) -> None:
@@ -160,6 +165,17 @@ class PhaseSpec:
     gc_watermark_mib: int = 0
     watermark_mib: int = 0
     fraction: float = 0.5
+    # deploy: "" (default, in-process Snapshotter calls), "snapshotter"
+    # (explicit default), or "grpc" — pods drive the REAL snapshots.v1
+    # gRPC surface over a UDS (api/service.py), exactly as containerd
+    # would (ROADMAP item 5 follow-up).
+    deploy_api: str = ""
+    # convert: exercise the dict-HA plane end to end — the phase's
+    # converted bootstraps merge through a primary+replica dict set, the
+    # primary dies mid-sequence, the placement controller promotes, the
+    # client fails over, and the reconstructed table must be byte-
+    # identical to the straight-line oracle.
+    shard_failover: bool = False
 
     @classmethod
     def from_dict(cls, d: dict, idx: int) -> "PhaseSpec":
@@ -168,7 +184,7 @@ class PhaseSpec:
             d,
             {"op", "corpus", "pods", "layers", "adaptive", "peers",
              "corrupt_peer", "soci", "read_mib", "crash", "gc_watermark_mib",
-             "watermark_mib", "fraction"},
+             "watermark_mib", "fraction", "deploy_api", "shard_failover"},
             where,
         )
         op = d.get("op", "")
@@ -190,6 +206,8 @@ class PhaseSpec:
             gc_watermark_mib=int(d.get("gc_watermark_mib", 0)),
             watermark_mib=int(d.get("watermark_mib", 0)),
             fraction=float(d.get("fraction", 0.5)),
+            deploy_api=d.get("deploy_api", ""),
+            shard_failover=bool(d.get("shard_failover", False)),
         )
         if op in ("convert", "deploy") and not spec.corpus:
             raise ScenarioSpecError(f"{where}: {op} needs a corpus list")
@@ -201,6 +219,16 @@ class PhaseSpec:
             raise ScenarioSpecError(f"{where}: read_mib must be >= 0 (0 = whole blob)")
         if not 0.0 < spec.fraction <= 1.0:
             raise ScenarioSpecError(f"{where}: fraction must be in (0, 1]")
+        if spec.deploy_api not in DEPLOY_APIS:
+            raise ScenarioSpecError(
+                f"{where}: deploy_api must be one of {DEPLOY_APIS}"
+            )
+        if spec.deploy_api and op != "deploy":
+            raise ScenarioSpecError(f"{where}: deploy_api only applies to deploy")
+        if spec.shard_failover and op != "convert":
+            raise ScenarioSpecError(
+                f"{where}: shard_failover only applies to convert"
+            )
         return spec
 
     def to_dict(self) -> dict:
@@ -211,6 +239,8 @@ class PhaseSpec:
             "soci": self.soci, "read_mib": self.read_mib, "crash": self.crash,
             "gc_watermark_mib": self.gc_watermark_mib,
             "watermark_mib": self.watermark_mib, "fraction": self.fraction,
+            "deploy_api": self.deploy_api,
+            "shard_failover": self.shard_failover,
         }
 
 

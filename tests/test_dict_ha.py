@@ -1,0 +1,525 @@
+"""Dict-shard HA plane (ISSUE 15): placement properties, journal-
+streaming replication identity, loud resync, automatic promotion, and
+client mid-merge failover byte-identity."""
+
+import io
+import os
+import tarfile
+import time
+
+import numpy as np
+import pytest
+
+from nydus_snapshotter_tpu import failpoint, fleet
+from nydus_snapshotter_tpu.converter.batch import BatchConverter
+from nydus_snapshotter_tpu.converter.types import PackOption
+from nydus_snapshotter_tpu.ha import PlacementController, resolve_ha_config
+from nydus_snapshotter_tpu.ha.placement import _rank
+from nydus_snapshotter_tpu.ha.replicate import HaAgent, ReplicaTailer
+from nydus_snapshotter_tpu.metrics.slo import SloEngine
+from nydus_snapshotter_tpu.parallel.dict_service import (
+    DictClient,
+    DictService,
+    DictServiceError,
+    ServiceChunkDict,
+    ServiceDict,
+    open_chunk_dict,
+)
+
+RNG = np.random.default_rng(23)
+POOL = [
+    RNG.integers(0, 256, int(RNG.integers(4_000, 40_000)), dtype=np.uint8).tobytes()
+    for _ in range(16)
+]
+OPT = PackOption(chunk_size=0x10000, chunking="cdc")
+
+
+def mk_image(seed: int, layers: int = 2, files: int = 5) -> list[bytes]:
+    r = np.random.default_rng(seed)
+    out = []
+    for _li in range(layers):
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+            for fi in range(files):
+                data = POOL[int(r.integers(0, len(POOL)))]
+                ti = tarfile.TarInfo(f"d/f{seed}_{fi}")
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        out.append(buf.getvalue())
+    return out
+
+
+def bootstrap_of(seed: int) -> bytes:
+    bc = BatchConverter(OPT)
+    return bc.convert_image(f"img{seed}", mk_image(seed)).bootstrap
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoint.clear()
+    yield
+    failpoint.clear()
+
+
+def wait_until(pred, timeout=10.0, step=0.02, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(step)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture()
+def pair(tmp_path):
+    """Primary + replica dict services, replication running."""
+    prim = DictService()
+    HaAgent(prim, role="primary")
+    prim.run(str(tmp_path / "p.sock"))
+    repl = DictService()
+    agent = HaAgent(repl, role="unassigned")
+    repl.run(str(tmp_path / "r.sock"))
+    agent.configure("replica", upstream=prim.sock_path)
+    yield prim, repl, agent
+    tailer = agent.tailer
+    if tailer is not None:
+        tailer.stop()
+    repl.stop()
+    prim.stop()
+
+
+def replica_caught_up(prim, repl, ns="default"):
+    want = len(prim.dict_for(ns).records.bootstrap.chunks)
+    return want > 0 and len(repl.dict_for(ns).records.bootstrap.chunks) >= want
+
+
+# ---------------------------------------------------------------------------
+# Replication: journal-tail replay identity + budget + chaos
+# ---------------------------------------------------------------------------
+
+
+class TestReplication:
+    def test_journal_tail_replay_identity(self, pair):
+        """A caught-up replica's record store AND probe index answer
+        byte/position-identically to the primary's."""
+        prim, repl, _agent = pair
+        cli = DictClient(prim.sock_path)
+        for seed in (1, 2, 3):
+            cli.merge(bootstrap_of(seed), "default")
+        wait_until(lambda: replica_caught_up(prim, repl), what="replica catch-up")
+        p_sd, r_sd = prim.dict_for("default"), repl.dict_for("default")
+        assert (
+            p_sd.records.bootstrap.to_bytes() == r_sd.records.bootstrap.to_bytes()
+        )
+        digs = [c.digest for c in p_sd.records.bootstrap.chunks]
+        assert np.array_equal(
+            p_sd.probe(b"".join(digs)), r_sd.probe(b"".join(digs))
+        )
+        # Missing digests miss identically too.
+        miss = [bytes(RNG.integers(0, 256, 32, dtype=np.uint8)) for _ in range(4)]
+        assert (r_sd.probe(b"".join(miss)) == -1).all()
+
+    def test_byte_budget_bounds_in_flight_payload(self, tmp_path):
+        """Catch-up never holds more than one budgeted payload: with a
+        tiny budget the tail streams in many pulls, each within budget +
+        the unbudgeted non-chunk sections."""
+        prim = DictService()
+        prim.run(str(tmp_path / "p.sock"))
+        repl = DictService()
+        cli = DictClient(prim.sock_path)
+        for seed in (4, 5, 6):
+            cli.merge(bootstrap_of(seed), "default")
+        budget = 256  # 4 chunk rows per pull
+        tailer = ReplicaTailer(repl, prim.sock_path, budget_bytes=budget, poll_s=0.01)
+        try:
+            applied = tailer.poll_once()
+            want = len(prim.dict_for("default").records.bootstrap.chunks)
+            assert applied == want
+            assert tailer.pulls >= 2, "tiny budget must split the tail"
+            # Chunk rows are budgeted; blob/batch/cipher tails ride along
+            # (small by construction) — allow them as slack.
+            assert tailer.max_pull_bytes <= budget + 4096
+            assert replica_caught_up(prim, repl)
+        finally:
+            tailer.stop()
+            repl.stop()
+            prim.stop()
+
+    def test_replication_chaos_tailer_survives(self, pair):
+        """An armed ha.replicate fault fails rounds loudly; the tailer
+        keeps running and converges once the fault exhausts."""
+        prim, repl, agent = pair
+        failpoint.inject("ha.replicate", "error(OSError)*3")
+        cli = DictClient(prim.sock_path)
+        cli.merge(bootstrap_of(7), "default")
+        wait_until(lambda: replica_caught_up(prim, repl), what="post-chaos catch-up")
+        assert failpoint.counts().get("ha.replicate", 0) >= 3
+        assert agent.tailer.errors >= 3
+
+    def test_regressed_primary_resyncs_loudly(self, tmp_path, caplog):
+        """A primary that restarted with a YOUNGER table cannot be
+        reconciled: the replica logs an error, bumps the resync counter,
+        wipes, and re-replicates to identity."""
+        prim = DictService()
+        prim.run(str(tmp_path / "p.sock"))
+        repl = DictService()
+        cli = DictClient(prim.sock_path)
+        cli.merge(bootstrap_of(8), "default")
+        cli.merge(bootstrap_of(9), "default")
+        tailer = ReplicaTailer(repl, prim.sock_path, poll_s=0.01)
+        try:
+            tailer.poll_once()
+            assert replica_caught_up(prim, repl)
+            # "Restart" the primary younger: same socket, fresh tables,
+            # fewer records than the replica already applied.
+            prim.reset_namespace("default")
+            cli.merge(bootstrap_of(8), "default")
+            import logging
+
+            with caplog.at_level(logging.ERROR):
+                tailer.poll_once()  # detects the regression, resyncs
+                tailer.poll_once()  # re-pulls the snapshot
+            assert any(
+                "resyncing from a full snapshot" in r.message for r in caplog.records
+            )
+            st = tailer.status()["namespaces"]["default"]
+            assert st["resyncs"] == 1
+            assert (
+                prim.dict_for("default").records.bootstrap.to_bytes()
+                == repl.dict_for("default").records.bootstrap.to_bytes()
+            )
+        finally:
+            tailer.stop()
+            repl.stop()
+            prim.stop()
+
+    def test_replica_rejects_writes_with_503(self, pair):
+        """The HA role gate: a merge that reaches a replica fails loudly
+        (wire 503), it never forks the table."""
+        _prim, repl, _agent = pair
+        cli = DictClient(repl.sock_path)
+        with pytest.raises(DictServiceError, match="503"):
+            cli.merge(bootstrap_of(10), "default")
+        # Reads stay allowed (warm probes + the replication stream).
+        assert cli.stats("default")["chunks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Placement: assignment properties + promotion
+# ---------------------------------------------------------------------------
+
+
+def _members(n, addr="mem"):
+    return [
+        fleet.Member(name=f"dict-{i}", component="dict", address=f"/tmp/{addr}{i}.sock",
+                     pid=1000 + i)
+        for i in range(n)
+    ]
+
+
+def _live(members):
+    return {m.name: {"up": True, "stale": False} for m in members}
+
+
+class TestPlacement:
+    def test_initial_assignment_distinct_slots(self):
+        members = _members(6)
+        pc = PlacementController(
+            lambda: members, lambda: _live(members), shards=2, replicas=2
+        )
+        assert pc.tick() is True
+        m = pc.map()
+        assert m["epoch"] == 1
+        seen = set()
+        for a in m["assignments"]:
+            slots = [a["primary"]["name"]] + [r["name"] for r in a["replicas"]]
+            assert len(a["replicas"]) == 2
+            for s in slots:
+                assert s not in seen, "a member must hold at most one slot"
+                seen.add(s)
+
+    def test_sticky_primary_and_minimal_churn_on_join_leave(self):
+        members = _members(6)
+        live = _live(members)
+        pc = PlacementController(
+            lambda: list(members), lambda: dict(live), shards=2, replicas=1
+        )
+        pc.tick()
+        before = pc.map()["assignments"]
+        primaries = [a["primary"]["name"] for a in before]
+        # Join: primaries never move; replica churn is bounded by the
+        # shard count (one displaced member can cascade at most once per
+        # shard under the distinct-slot rule).
+        members.append(
+            fleet.Member(name="dict-9", component="dict", address="/tmp/mem9.sock",
+                         pid=1009)
+        )
+        live["dict-9"] = {"up": True, "stale": False}
+        pc.tick()
+        after = pc.map()["assignments"]
+        assert [a["primary"]["name"] for a in after] == primaries
+        churn = sum(
+            1
+            for b, a in zip(before, after)
+            for rb, ra in zip(b["replicas"], a["replicas"])
+            if rb["name"] != ra["name"]
+        )
+        assert churn <= len(after)
+        # Leave of an unassigned member: nothing changes at all.
+        assigned = {a["primary"]["name"] for a in after} | {
+            r["name"] for a in after for r in a["replicas"]
+        }
+        spare = next(m for m in members if m.name not in assigned)
+        live[spare.name] = {"up": False, "stale": True}
+        epoch_before = pc.map()["epoch"]
+        pc.tick()
+        assert pc.map()["epoch"] == epoch_before
+        assert pc.map()["assignments"] == after
+
+    def test_promotes_most_caught_up_replica(self, tmp_path):
+        """Primary dies -> the live replica with the most applied chunks
+        is promoted (status RPC ranking), the epoch bumps, the event
+        lands on the SLO surface and the promote RPC flips the member."""
+        prim = DictService()
+        HaAgent(prim, role="primary")
+        prim.run(str(tmp_path / "p.sock"))
+        replicas, agents = [], []
+        for i in range(2):
+            svc = DictService()
+            agents.append(HaAgent(svc, role="unassigned"))
+            svc.run(str(tmp_path / f"r{i}.sock"))
+            replicas.append(svc)
+        # r0 replicates; r1's tailer is stopped BEFORE any merge, so it
+        # stays empty — the controller must pick r0.
+        agents[0].configure("replica", upstream=prim.sock_path)
+        agents[1].configure("replica", upstream=prim.sock_path)
+        agents[1].tailer.stop()
+        cli = DictClient(prim.sock_path)
+        cli.merge(bootstrap_of(11), "default")
+        wait_until(
+            lambda: replica_caught_up(prim, replicas[0]), what="r0 catch-up"
+        )
+        members = [
+            fleet.Member(name="dict-p", component="dict", address=prim.sock_path,
+                         pid=1),
+            fleet.Member(name="dict-r0", component="dict",
+                         address=replicas[0].sock_path, pid=2),
+            fleet.Member(name="dict-r1", component="dict",
+                         address=replicas[1].sock_path, pid=3),
+        ]
+        live = _live(members)
+        engine = SloEngine([])
+        pc = PlacementController(
+            lambda: members, lambda: dict(live), shards=1, replicas=2,
+            engine=engine,
+        )
+        # Make the real pair the assignment regardless of hash order:
+        # tick once, then force the primary seat onto dict-p if needed.
+        pc.tick()
+        current = pc.map()["assignments"][0]["primary"]["name"]
+        if current != "dict-p":
+            # The rendezvous picked a replica as primary; flip liveness
+            # to steer — simpler: accept whichever member got the seat
+            # and kill THAT one below.
+            pass
+        seat = pc.map()["assignments"][0]["primary"]["name"]
+        addr_of = {m.name: m.address for m in members}
+        # Kill the seated primary's process-equivalent.
+        for svc in [prim] + replicas:
+            if svc.sock_path == addr_of[seat]:
+                svc.stop()
+        live[seat] = {"up": False, "stale": True}
+        failpoint.inject("ha.place", "delay(0)*1")  # site fires on tick
+        pc.tick()
+        m = pc.map()
+        promoted = m["assignments"][0]["primary"]["name"]
+        assert promoted != seat
+        assert m["promotions"] == 1
+        events = engine.status()["events"]
+        assert events and events[-1]["kind"] == "dict_ha_promotion"
+        # The promoted member really flipped role (promote RPC acked).
+        promoted_svc = next(
+            s for s in [prim] + replicas if s.sock_path == addr_of[promoted]
+        )
+        assert promoted_svc.ha.is_primary()
+        # The caught-up replica outranks the empty one when both are up.
+        if seat == "dict-p":
+            assert promoted == "dict-r0"
+        for a in agents:
+            if a.tailer is not None:
+                a.tailer.stop()
+        for svc in [prim] + replicas:
+            svc.stop()
+
+    def test_ha_place_failpoint_fails_tick_loudly(self):
+        members = _members(2)
+        pc = PlacementController(
+            lambda: members, lambda: _live(members), shards=1, replicas=1
+        )
+        failpoint.inject("ha.place", "error(OSError)")
+        with pytest.raises(OSError):
+            pc.tick()
+
+    def test_ha_promote_failpoint_fails_promotion_loudly(self, tmp_path):
+        svc = DictService()
+        agent = HaAgent(svc, role="unassigned")
+        failpoint.inject("ha.promote", "error(OSError)")
+        with pytest.raises(OSError):
+            agent.promote()
+
+    def test_restarted_member_gets_role_repushed(self):
+        """A member that re-registers under the same name (fresh pid)
+        lost its role — the acked-push cache must not swallow the
+        re-push, or it would sit unassigned rejecting writes."""
+        members = _members(2)
+        pc = PlacementController(
+            lambda: members, lambda: _live(members), shards=1, replicas=1
+        )
+        pushes = []
+        pc._push_role = lambda name, addr, payload: pushes.append(name) or True
+        pc.tick()
+        first = list(pushes)
+        assert set(first) == {"dict-0", "dict-1"}
+        pc.tick()
+        assert pushes == first, "unchanged config must not be re-pushed"
+        members[0].pid += 1000  # the member restarted
+        pc.tick()
+        assert pushes.count(members[0].name) == 2
+
+    def test_report_down_feeds_placement(self):
+        members = _members(3)
+        live = _live(members)
+        pc = PlacementController(
+            lambda: members, lambda: dict(live), shards=1, replicas=1
+        )
+        pc.tick()
+        seat = pc.map()["assignments"][0]["primary"]["name"]
+        # Scrape liveness still says up — but a peer watched it die.
+        pc.report_down(seat, source="test")
+        names, _addr = pc._live_members()
+        assert seat not in names
+
+    def test_fleet_placement_routes(self):
+        """/api/v1/fleet/placement GET + report POST round-trip."""
+        cfg = fleet.FleetRuntimeConfig(enable=True)
+        plane = fleet.FleetPlane(cfg=cfg, slo_objectives=[])
+        members = _members(2)
+        pc = PlacementController(
+            lambda: members, lambda: _live(members), shards=1, replicas=1,
+            engine=plane.slo,
+        )
+        plane.attach_placement(pc)
+        pc.tick()
+        status, _ctype, payload = plane.handle(
+            "GET", "/api/v1/fleet/placement", {}, b""
+        )
+        assert status == 200
+        import json
+
+        doc = json.loads(payload)
+        assert doc["epoch"] == 1 and len(doc["assignments"]) == 1
+        status, _ctype, payload = plane.handle(
+            "POST", "/api/v1/fleet/placement/report", {},
+            b'{"name": "dict-0", "source": "test"}',
+        )
+        assert status == 200
+        names, _ = pc._live_members()
+        assert "dict-0" not in names
+
+
+# ---------------------------------------------------------------------------
+# Client failover: mid-merge byte-identity, repair, schemes
+# ---------------------------------------------------------------------------
+
+
+class TestClientFailover:
+    def _oracle(self, boots):
+        oracle = ServiceDict("default")
+        for b in boots:
+            oracle.merge_bootstrap_bytes(b)
+        return oracle.records.bootstrap.to_bytes()
+
+    def test_mid_merge_failover_byte_identity(self, pair):
+        """Kill the primary mid-merge-sequence; the client replays its
+        un-acked batch against the promoted replica and the surviving
+        table is byte-identical to the no-failure path."""
+        prim, repl, agent = pair
+        boots = [bootstrap_of(s) for s in (20, 21, 22, 23)]
+        want = self._oracle(boots)
+        scd = ServiceChunkDict(
+            [DictClient(prim.sock_path)], failover=[[repl.sock_path]]
+        )
+        for b in boots[:2]:
+            scd.add_bootstrap_bytes(b)
+        wait_until(lambda: replica_caught_up(prim, repl), what="catch-up")
+        prim.stop()
+        agent.promote()
+        for b in boots[2:]:
+            scd.add_bootstrap_bytes(b)
+        assert repl.dict_for("default").records.bootstrap.to_bytes() == want
+        # The mirror itself converged on the same combined table.
+        assert len(scd.bootstrap.chunks) == len(
+            repl.dict_for("default").records.bootstrap.chunks
+        )
+        scd.close()
+
+    def test_failover_repairs_lagging_replica(self, tmp_path):
+        """Promotion of a BEHIND replica: the client's mirror holds the
+        lost record tail and re-merges it (prefix repair), so the
+        reconstructed table is position-identical to the dead primary's
+        and later merges still dedup against everything."""
+        prim = DictService()
+        prim.run(str(tmp_path / "p.sock"))
+        repl = DictService()
+        agent = HaAgent(repl, role="unassigned")
+        repl.run(str(tmp_path / "r.sock"))
+        boots = [bootstrap_of(s) for s in (30, 31, 32)]
+        want = self._oracle(boots)
+        scd = ServiceChunkDict(
+            [DictClient(prim.sock_path)], failover=[[repl.sock_path]]
+        )
+        # NO replication ran: the replica is maximally behind.
+        scd.add_bootstrap_bytes(boots[0])
+        scd.add_bootstrap_bytes(boots[1])
+        prim.stop()
+        agent.promote()
+        scd.add_bootstrap_bytes(boots[2])
+        assert repl.dict_for("default").records.bootstrap.to_bytes() == want
+        scd.close()
+        repl.stop()
+
+    def test_open_chunk_dict_failover_scheme(self, tmp_path):
+        svc = DictService()
+        svc.run(str(tmp_path / "s.sock"))
+        try:
+            scd = open_chunk_dict(
+                f"service://{svc.sock_path}|/tmp/replica.sock#ns1"
+            )
+            assert scd.namespace == "ns1"
+            assert scd._shards[0].alternates == ["/tmp/replica.sock"]
+            assert scd.shard_addrs == [svc.sock_path]  # stable route key
+            scd.close()
+        finally:
+            svc.stop()
+
+    def test_ha_config_resolution(self, monkeypatch):
+        monkeypatch.setenv("NTPU_DICT_HA_SHARDS", "3")
+        monkeypatch.setenv("NTPU_DICT_HA_REPLICAS", "2")
+        monkeypatch.setenv("NTPU_DICT_HA_BUDGET_KIB", "128")
+        monkeypatch.setenv("NTPU_DICT_HA_POLL_MS", "25")
+        cfg = resolve_ha_config()
+        assert (cfg.shards, cfg.replicas) == (3, 2)
+        assert cfg.budget_bytes == 128 << 10
+        assert abs(cfg.poll_s - 0.025) < 1e-9
+        assert cfg.enabled
+
+    def test_rank_is_deterministic_and_shard_dependent(self):
+        names = [f"m{i}" for i in range(8)]
+        assert _rank(0, names) == _rank(0, list(reversed(names)))
+        assert _rank(0, names) != _rank(1, names) or len(set(names)) == 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

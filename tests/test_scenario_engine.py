@@ -201,6 +201,69 @@ class TestOrchestrator:
         assert au1["clean"] and au2["clean"]
         assert au1["metastore_rows"] == 0  # full teardown
 
+    def test_deploy_api_grpc_drives_real_surface_identically(self, tmp_path):
+        """deploy_api = "grpc": pods issue the control-plane mix through
+        the real snapshots.v1 gRPC UDS; the metastore fingerprint stays
+        identical to the serial replay (which drives the same API)."""
+        spec = mini_spec("deploy_api = \"grpc\"")
+        (rep1, fp1, au1), (rep2, fp2, au2) = run_pair(spec, tmp_path)
+        assert rep1["ok"], rep1["error"]
+        assert rep2["ok"], rep2["error"]
+        assert fp1 == fp2, "grpc-driven storm diverged from serial replay"
+        assert au1["clean"], au1["issues"]
+
+    def test_deploy_api_grpc_survives_mid_storm_crash(self, tmp_path):
+        """The gRPC surface dies with the control plane on crash = "mid"
+        and reopens on the same socket; parked pods resume over it."""
+        spec = mini_spec("deploy_api = \"grpc\"\ncrash = \"mid\"")
+        (rep1, fp1, _au1), (rep2, fp2, _au2) = run_pair(spec, tmp_path)
+        assert rep1["ok"], rep1["error"]
+        assert rep2["ok"], rep2["error"]
+        assert rep1["phases"][1]["crashes"] >= 1
+        assert fp1 == fp2
+
+    def test_shard_failover_arm_promotes_and_matches_oracle(self, tmp_path):
+        """shard_failover = true on a convert phase: the dict-HA plane
+        runs end to end (primary dies mid-sequence, controller promotes,
+        client fails over) and the surviving table matches the
+        straight-line oracle byte for byte."""
+        toml = MINI % ""
+        toml = toml.replace(
+            'op = "convert"\ncorpus = ["img"]',
+            'op = "convert"\ncorpus = ["img", "img2"]\nshard_failover = true',
+        ).replace(
+            '[[scenario.phases]]\nop = "deploy"',
+            '[[scenario.corpus]]\nid = "img2"\nkind = "incompressible"\n'
+            'mib = 1\n\n[[scenario.phases]]\nop = "deploy"',
+        )
+        spec = sspec.loads(toml)
+        runner = ScenarioRunner(spec, str(tmp_path), serial=False, pods=2)
+        report = runner.run()
+        runner.close()
+        assert report["ok"], report["error"]
+        arm = report["phases"][0]["shard_failover"]
+        assert arm["promotions"] >= 1
+        assert arm["identical"] is True
+        # The serial replay skips the fault arm (identity surface
+        # untouched, like the corrupt-peer probe).
+        r2 = ScenarioRunner(spec, str(tmp_path / "serial"), serial=True, pods=2)
+        rep2 = r2.run()
+        r2.close()
+        assert rep2["ok"], rep2["error"]
+        assert "shard_failover" not in rep2["phases"][0]
+
+    def test_spec_rejects_bad_deploy_api_and_misplaced_keys(self):
+        with pytest.raises(ScenarioSpecError, match="deploy_api"):
+            sspec.loads(MINI % 'deploy_api = "rest"')
+        with pytest.raises(ScenarioSpecError, match="only applies to deploy"):
+            sspec.loads(
+                (MINI % "").replace(
+                    'op = "convert"', 'op = "convert"\ndeploy_api = "grpc"', 1
+                )
+            )
+        with pytest.raises(ScenarioSpecError, match="only applies to convert"):
+            sspec.loads(MINI % "shard_failover = true")
+
     def test_crash_restart_mid_deploy(self, tmp_path):
         spec = mini_spec('crash = "mid"')
         runner = ScenarioRunner(spec, str(tmp_path), serial=False)

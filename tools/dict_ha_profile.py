@@ -1,0 +1,496 @@
+"""Dict-shard HA profile: kill-the-primary storm, gated (ISSUE 15).
+
+Stands up the WHOLE plane with real processes: a system controller
+(FleetPlane + PlacementController) on a UDS, and ``shards x (1 +
+replicas)`` dict-service member processes (``python -m
+nydus_snapshotter_tpu.ha.runner``) that self-register, get placed, and
+replicate journals under the byte budget. A batch converter then runs a
+deterministic convert storm through the placement-resolved mirror
+(``service+ha://<controller>``), and the PRIMARY OF SHARD 0 IS
+SIGKILLED mid-storm.
+
+Gates (abort-on-fail, per the ISSUE 15 acceptance):
+
+- **identity** — every converted image's result (blob ids, layer blob
+  digests, bootstrap digest) from the kill arm is byte-identical to the
+  no-failure single-service baseline. Cross-image dedup state survived
+  the kill exactly: promotion + client failover + prefix repair
+  reconstructed the dead primary's table position-for-position.
+- **automatic promotion** — the placement map records >= 1 promotion
+  and the promoted member answers as primary, with no config edit and
+  no manual promote call anywhere in this file.
+- **bounded catch-up** — the replicas' observed ``max_pull_bytes`` stays
+  within ``budget + slack``: the ANALYTIC in-flight bound (a tailer
+  applies each payload before requesting the next, so catch-up holds at
+  most one budgeted payload; slack covers the unbudgeted non-chunk
+  sections and the wire header).
+- **demand unaffected** — probe-lane p95 on a service under ACTIVE
+  replication vs the same merge/probe load with no replica, compared as
+  the BEST of ``--reps`` paired runs (this box's ~2x wall noise, see
+  docs/known_env_failures.md discipline) — ratio <= --p95-factor.
+
+Usage: python tools/dict_ha_profile.py [--images 8] [--files 6]
+           [--replicas 1] [--budget-kib 64] [--reps 3] [--json]
+           [--out DICT_HA_r01.json]
+
+Doubles as the CI ``ha-smoke`` driver (2 shards x 1 replica mini storm)
+and feeds ``bench.py``'s ``detail.dict_ha``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tarfile
+import tempfile
+import time
+from time import perf_counter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from nydus_snapshotter_tpu import fleet  # noqa: E402
+from nydus_snapshotter_tpu.converter.batch import BatchConverter  # noqa: E402
+from nydus_snapshotter_tpu.converter.types import PackOption  # noqa: E402
+from nydus_snapshotter_tpu.ha import PlacementController  # noqa: E402
+from nydus_snapshotter_tpu.ha.replicate import ReplicaTailer  # noqa: E402
+from nydus_snapshotter_tpu.parallel.dict_service import (  # noqa: E402
+    DictClient,
+    DictService,
+)
+from nydus_snapshotter_tpu.system.system import SystemController  # noqa: E402
+from nydus_snapshotter_tpu.utils import udshttp  # noqa: E402
+
+OPT = PackOption(chunk_size=0x10000, chunking="cdc")
+SCRAPE_S = 0.25
+STALE_S = 1.0
+# Analytic slack on top of the chunk-row budget: wire header + the
+# unbudgeted blob/batch/cipher tails of one pull (small by construction
+# — a handful of 88/32/64-byte rows per merged image).
+BUDGET_SLACK = 64 << 10
+
+
+class GateFailure(AssertionError):
+    pass
+
+
+def gate(ok: bool, name: str, detail: str) -> dict:
+    print(f"  [{'PASS' if ok else 'FAIL'}] {name}: {detail}")
+    if not ok:
+        raise GateFailure(f"{name}: {detail}")
+    return {"gate": name, "ok": ok, "detail": detail}
+
+
+# ---------------------------------------------------------------------------
+# Deterministic corpus
+# ---------------------------------------------------------------------------
+
+
+def mk_images(n: int, files: int, seed0: int = 9000) -> list[tuple[str, list[bytes]]]:
+    pool_rng = np.random.default_rng(41)
+    pool = [
+        pool_rng.integers(0, 256, int(pool_rng.integers(8_000, 60_000)),
+                          dtype=np.uint8).tobytes()
+        for _ in range(24)
+    ]
+    out = []
+    for i in range(n):
+        r = np.random.default_rng(seed0 + i)
+        layers = []
+        for _li in range(2):
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w", format=tarfile.GNU_FORMAT) as tf:
+                for fi in range(files):
+                    data = pool[int(r.integers(0, len(pool)))]
+                    ti = tarfile.TarInfo(f"img{i}/f{fi}")
+                    ti.size = len(data)
+                    tf.addfile(ti, io.BytesIO(data))
+            layers.append(buf.getvalue())
+        out.append((f"img-{i}", layers))
+    return out
+
+
+def convert_storm(bc: BatchConverter, images) -> list[dict]:
+    """Deterministic convert sequence; the comparable per-image output."""
+    out = []
+    for name, layers in images:
+        res = bc.convert_image(name, layers)
+        out.append(
+            {
+                "name": name,
+                "blob_id": res.blob_id if hasattr(res, "blob_id") else "",
+                "blob_digests": list(res.blob_digests),
+                "bootstrap_sha": __import__("hashlib").sha256(
+                    res.bootstrap
+                ).hexdigest(),
+                "new_dict_chunks": res.new_dict_chunks,
+            }
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The plane: controller + runner processes
+# ---------------------------------------------------------------------------
+
+
+def start_controller(base: str, shards: int, replicas: int):
+    cfg = fleet.FleetRuntimeConfig(
+        enable=True,
+        scrape_interval_secs=SCRAPE_S,
+        stale_after_secs=STALE_S,
+        scoreboard_max_age_secs=0.2,
+    )
+    plane = fleet.FleetPlane(cfg=cfg, slo_objectives=[])
+    pc = PlacementController(
+        plane.registry.members,
+        plane.federator.liveness,
+        shards=shards,
+        replicas=replicas,
+        engine=plane.slo,
+    )
+    plane.attach_placement(pc)
+    csock = os.path.join(base, "system.sock")
+    controller = SystemController(fs=None, managers=[], sock_path=csock, fleet=plane)
+    controller.run()
+    plane.start()
+    return plane, pc, controller, csock
+
+
+def spawn_runner(i: int, base: str, csock: str, budget_kib: int) -> tuple:
+    sock = os.path.join(base, f"dict{i}.sock")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        NTPU_DICT_HA_BUDGET_KIB=str(budget_kib),
+        NTPU_DICT_HA_POLL_MS="20",
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "nydus_snapshotter_tpu.ha.runner",
+            "--listen", sock, "--controller", csock, "--name", f"dict-{i}",
+        ],
+        env=env,
+        cwd=REPO,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    return proc, sock
+
+
+def wait_for(pred, timeout: float, what: str, step: float = 0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(step)
+    raise GateFailure(f"timed out waiting for {what}")
+
+
+def placement_full(csock: str, shards: int, replicas: int):
+    def check():
+        try:
+            doc = udshttp.get_json(csock, "/api/v1/fleet/placement", timeout=2.0)
+        except Exception:
+            return None
+        asg = doc.get("assignments", [])
+        if len(asg) != shards:
+            return None
+        for a in asg:
+            if not a["primary"]["address"] or len(a["replicas"]) < replicas:
+                return None
+        return doc
+
+    return check
+
+
+def roles_pushed(doc) -> bool:
+    """Every assigned member answers /api/v1/ha/status with its role."""
+    for a in doc["assignments"]:
+        try:
+            st = udshttp.get_json(
+                a["primary"]["address"], "/api/v1/ha/status", timeout=2.0
+            )
+            if st.get("role") != "primary":
+                return False
+            for r in a["replicas"]:
+                st = udshttp.get_json(r["address"], "/api/v1/ha/status", timeout=2.0)
+                if st.get("role") != "replica":
+                    return False
+        except Exception:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Demand-lane p95 under replication (paired best-rep)
+# ---------------------------------------------------------------------------
+
+
+def probe_p95(sock: str, digests: list[bytes], bursts: int = 40) -> float:
+    cli = DictClient(sock)
+    xs = []
+    for _ in range(bursts):
+        t0 = perf_counter()
+        cli.probe(digests, "default")
+        xs.append((perf_counter() - t0) * 1000.0)
+    cli.close()
+    xs.sort()
+    return xs[int(len(xs) * 0.95)]
+
+
+def demand_phase(base: str, images, budget_kib: int, reps: int) -> dict:
+    """Probe-lane p95 on a primary under ACTIVE replication vs the same
+    merge+probe load with no replica — paired, best-of-reps.
+
+    Both arms run an identical background merge loop (fresh content per
+    merge, so the record tail keeps growing); the replicated arm's
+    tailer therefore PULLS throughout the probe burst. The only delta
+    between the arms is the replication traffic itself."""
+    import threading
+
+    with_repl, without = [], []
+    extra = mk_images(64, 3, seed0=77000)
+    for rep in range(reps):
+        for arm in ("replicated", "bare"):
+            svc = DictService()
+            svc.run(os.path.join(base, f"demand-{rep}-{arm}.sock"))
+            bc = BatchConverter(OPT, dict_service=svc.sock_path)
+            convert_storm(bc, images)
+            sd = svc.dict_for("default")
+            digests = [c.digest for c in sd.records.bootstrap.chunks[:256]]
+            tailer = None
+            if arm == "replicated":
+                repl = DictService()
+                tailer = ReplicaTailer(
+                    repl, svc.sock_path, budget_bytes=budget_kib << 10,
+                    poll_s=0.001,
+                )
+                tailer.start()
+            stop = threading.Event()
+
+            def merge_loop(seq=iter(extra)):
+                mbc = BatchConverter(OPT, dict_service=svc.sock_path)
+                for name, layers in seq:
+                    if stop.is_set():
+                        return
+                    mbc.convert_image(name, layers)
+
+            merger = threading.Thread(target=merge_loop, daemon=True)
+            merger.start()
+            p95 = probe_p95(svc.sock_path, digests)
+            stop.set()
+            merger.join(timeout=30)
+            if tailer is not None:
+                with_repl.append(p95)
+                tailer.stop()
+            else:
+                without.append(p95)
+            svc.stop()
+    return {
+        "p95_ms_replicated_best": min(with_repl),
+        "p95_ms_bare_best": min(without),
+        "ratio_best": min(with_repl) / max(1e-9, min(without)),
+        "reps": reps,
+    }
+
+
+# ---------------------------------------------------------------------------
+# The profile
+# ---------------------------------------------------------------------------
+
+
+def profile(
+    images: int = 8,
+    files: int = 6,
+    shards: int = 2,
+    replicas: int = 1,
+    budget_kib: int = 64,
+    reps: int = 3,
+    p95_factor: float = 2.0,
+) -> dict:
+    corpus = mk_images(images, files)
+    gates = []
+    out: dict = {
+        "images": images,
+        "shards": shards,
+        "replicas": replicas,
+        "budget_kib": budget_kib,
+    }
+
+    # ---- baseline: the no-failure single-service path --------------------
+    base = tempfile.mkdtemp(prefix="ntpu-dict-ha-", dir="/tmp")
+    procs = []
+    try:
+        print("== baseline: single dict service, no failures ==")
+        svc = DictService()
+        svc.run(os.path.join(base, "baseline.sock"))
+        baseline = convert_storm(
+            BatchConverter(OPT, dict_service=svc.sock_path), corpus
+        )
+        svc.stop()
+
+        # ---- the HA plane: controller + member processes -----------------
+        n_members = shards * (1 + replicas)
+        print(f"== ha plane: {shards} shards x (1 + {replicas}) = "
+              f"{n_members} member processes ==")
+        plane, pc, controller, csock = start_controller(base, shards, replicas)
+        procs = [spawn_runner(i, base, csock, budget_kib) for i in range(n_members)]
+        doc = wait_for(
+            placement_full(csock, shards, replicas), 120.0, "full placement map"
+        )
+        wait_for(lambda: roles_pushed(doc), 30.0, "role push convergence")
+
+        # ---- kill-the-primary convert storm ------------------------------
+        print("== kill arm: SIGKILL shard-0 primary mid-storm ==")
+        bc = BatchConverter(OPT, dict_service=f"service+ha://{csock}")
+        half = max(1, images // 2)
+        killed_results = convert_storm(bc, corpus[:half])
+
+        def replica_pull_stats() -> tuple[int, int]:
+            """(max in-flight pull bytes, total pulls) across replicas."""
+            max_pull = pulls = 0
+            cur = udshttp.get_json(csock, "/api/v1/fleet/placement")
+            for a in cur["assignments"]:
+                for r in a["replicas"]:
+                    try:
+                        rst = udshttp.get_json(
+                            r["address"], "/api/v1/ha/status", timeout=2.0
+                        )
+                    except Exception:
+                        continue
+                    repl = rst.get("replication", {}) or {}
+                    max_pull = max(max_pull, int(repl.get("max_pull_bytes", 0)))
+                    pulls += int(repl.get("pulls", 0))
+            return max_pull, pulls
+
+        # Pull-bound evidence while catch-up traffic exists (promotion
+        # re-seats replicas with fresh tailers, zeroing their counters).
+        wait_for(lambda: replica_pull_stats()[1] > 0, 30.0, "replication pulls")
+        max_pull, total_pulls = replica_pull_stats()
+        members = udshttp.get_json(csock, "/api/v1/fleet/members")
+        pid_of = {m["name"]: m["pid"] for m in members}
+        victim = doc["assignments"][0]["primary"]["name"]
+        os.kill(pid_of[victim], signal.SIGKILL)
+        t_kill = time.monotonic()
+        killed_results += convert_storm(bc, corpus[half:])
+        map_after = wait_for(
+            lambda: (
+                lambda d: d if d.get("promotions", 0) >= 1 else None
+            )(udshttp.get_json(csock, "/api/v1/fleet/placement")),
+            30.0,
+            "automatic promotion",
+        )
+        t_promoted = time.monotonic()
+
+        gates.append(gate(
+            killed_results == baseline,
+            "identity",
+            f"{len(baseline)} images byte-identical to the no-failure "
+            "single-service path across the SIGKILL",
+        ))
+        promoted = map_after["assignments"][0]["primary"]
+        st = udshttp.get_json(promoted["address"], "/api/v1/ha/status", timeout=2.0)
+        gates.append(gate(
+            map_after["promotions"] >= 1 and st.get("role") == "primary",
+            "automatic_promotion",
+            f"{victim} SIGKILLed -> {promoted['name']} promoted "
+            f"(placement epoch {map_after['epoch']}, no config edit)",
+        ))
+        # Bounded catch-up: the replicas really pulled, and no pull ever
+        # held more than one budgeted payload in flight.
+        post_pull, post_pulls = replica_pull_stats()
+        max_pull = max(max_pull, post_pull)
+        total_pulls += post_pulls
+        bound = (budget_kib << 10) + BUDGET_SLACK
+        gates.append(gate(
+            0 < max_pull <= bound,
+            "bounded_catchup",
+            f"max in-flight pull {max_pull} B (over {total_pulls} pulls) "
+            f"<= analytic bound {bound} B (budget {budget_kib} KiB + "
+            "non-chunk slack)",
+        ))
+        out["kill_arm"] = {
+            "victim": victim,
+            "promoted": promoted["name"],
+            "promotions": map_after["promotions"],
+            "placement_epoch": map_after["epoch"],
+            "promotion_s": round(t_promoted - t_kill, 3),
+            "max_pull_bytes": max_pull,
+        }
+
+        plane.stop()
+        controller.stop()
+
+        # ---- demand lane under replication (paired best-rep) -------------
+        print("== demand lane: probe p95 with vs without replication ==")
+        demand = demand_phase(base, corpus[: max(2, images // 2)], 16, reps)
+        out["demand"] = demand
+        gates.append(gate(
+            demand["ratio_best"] <= p95_factor,
+            "demand_p95",
+            f"best-rep p95 ratio {demand['ratio_best']:.2f}x <= "
+            f"{p95_factor}x (replicated {demand['p95_ms_replicated_best']:.2f}ms "
+            f"vs bare {demand['p95_ms_bare_best']:.2f}ms, {reps} paired reps)",
+        ))
+    finally:
+        for proc, _sock in procs:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        for proc, _sock in procs:
+            proc.wait()
+        shutil.rmtree(base, ignore_errors=True)
+
+    out["gates"] = gates
+    out["ok"] = all(g["ok"] for g in gates)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=8)
+    ap.add_argument("--files", type=int, default=6)
+    ap.add_argument("--shards", type=int, default=2)
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--budget-kib", type=int, default=64)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--p95-factor", type=float, default=2.0)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    try:
+        result = profile(
+            images=args.images,
+            files=args.files,
+            shards=args.shards,
+            replicas=args.replicas,
+            budget_kib=args.budget_kib,
+            reps=args.reps,
+            p95_factor=args.p95_factor,
+        )
+    except GateFailure as e:
+        print(f"GATE FAILED: {e}", file=sys.stderr)
+        return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"wrote {args.out}")
+    if args.json:
+        print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

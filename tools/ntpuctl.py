@@ -257,8 +257,75 @@ def cmd_soci(args) -> int:
                    "daemon apisock or a peer server")
 
 
+def _member_ha_status(address: str, timeout: float):
+    try:
+        status, body = udshttp.request(address, "/api/v1/ha/status", timeout=timeout)
+    except OSError:
+        return None
+    if status != 200:
+        return None
+    try:
+        return json.loads(body)
+    except ValueError:
+        return None
+
+
 def cmd_dict(args) -> int:
+    placement = _get(args.sock, "/api/v1/fleet/placement", args.timeout)
+    if placement is not None:
+        # Against a controller with the dict-HA plane attached: the
+        # placement map, per-replica replication lag (each replica's
+        # /api/v1/ha/status), and the promotion event log.
+        rows = []
+        payload = {"placement": placement, "replicas": {}}
+        for a in placement.get("assignments", []):
+            lag_cells = []
+            for r in a.get("replicas", []):
+                st = _member_ha_status(r.get("address", ""), args.timeout)
+                lag = "?"
+                if st is not None:
+                    payload["replicas"][r["name"]] = st
+                    namespaces = (st.get("replication", {}) or {}).get(
+                        "namespaces", {}
+                    ) or {}
+                    lag = sum(
+                        int(ns.get("lag_chunks", 0)) for ns in namespaces.values()
+                    )
+                lag_cells.append(f"{r.get('name', '?')}(lag={lag})")
+            rows.append([
+                a.get("shard", "?"),
+                a.get("primary", {}).get("name", "-") or "-",
+                " ".join(lag_cells) or "-",
+            ])
+        human = _table(rows, ["SHARD", "PRIMARY", "REPLICAS"]) + (
+            f"\nepoch {placement.get('epoch', 0)}, "
+            f"promotions {placement.get('promotions', 0)}"
+        )
+        events = placement.get("events", [])
+        if events:
+            human += "\n" + _table(
+                [
+                    [e.get("kind", "?"), e.get("shard", "?"),
+                     e.get("from", "-"), e.get("to", "-")]
+                    for e in events[-8:]
+                ],
+                ["EVENT", "SHARD", "FROM", "TO"],
+            )
+        _emit(args, payload, human)
+        return 0
+    ha = _member_ha_status(args.sock, args.timeout)
     direct = _get(args.sock, "/api/v1/dict", args.timeout)
+    if ha is not None and direct is not None and not args.json:
+        repl = ha.get("replication", {}) or {}
+        print(
+            f"role {ha.get('role', '?')} shard {ha.get('shard', '?')}"
+            + (
+                f" upstream {repl.get('upstream')}"
+                f" max-pull {repl.get('max_pull_bytes', 0)}B"
+                if repl.get("upstream")
+                else ""
+            )
+        )
     if direct is not None:
         # Per-shard epochs: against a sharded deployment, point --sock at
         # each shard; the epoch/rebuild-epoch pair IS the replication
