@@ -950,6 +950,36 @@ def dict_ha_run(repo: str, timeout: float = 420.0) -> dict:
         return {"error": "dict-ha profile produced no JSON"}
 
 
+_SOAK_CHILD = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+from tools.soak_profile import profile
+spec = os.path.join({repo!r}, "misc", "scenarios", "soak_smoke.toml")
+print(json.dumps(profile(spec, mini=True)))
+"""
+
+
+def soak_run(repo: str, timeout: float = 420.0) -> dict:
+    """Mini endurance soak (tools/soak_profile.py --mini over
+    soak_smoke.toml) in a child under the hard watchdog: 3 seeded
+    arrival epochs with corpus drift, per-epoch audit + leak sentinels,
+    one scale-up cycle and serial spot-epoch identity. A wedged epoch
+    costs one timeout, not a hang."""
+    res = _run_child_watchdog(
+        [sys.executable, "-c", _SOAK_CHILD.format(repo=repo)], timeout=timeout
+    )
+    if res is None:
+        return {"error": f"soak profile hung >{timeout:.0f}s (watchdog killed it)"}
+    rc, stdout, stderr = res
+    if rc != 0:
+        tail = stderr.strip().splitlines()[-1] if stderr.strip() else ""
+        return {"error": f"soak profile exited rc={rc}: {tail}"[:200]}
+    try:
+        return json.loads(stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": "soak profile produced no JSON"}
+
+
 _COMPRESSION_CHILD = """
 import json, sys
 sys.path.insert(0, {repo!r})
@@ -1222,6 +1252,7 @@ def main() -> None:
     trace_detail = trace_run(repo)
     chunk_dict_detail = chunk_dict_run(repo)
     dict_ha_detail = dict_ha_run(repo)
+    soak_detail = soak_run(repo)
     peer_storm = peer_storm_run(repo)
     fleet_obs = fleet_obs_run(repo)
     soci_detail = soci_run(repo)
@@ -1266,6 +1297,7 @@ def main() -> None:
                     "trace": trace_detail,
                     "chunk_dict": chunk_dict_detail,
                     "dict_ha": dict_ha_detail,
+                    "soak": soak_detail,
                     "peer_storm": peer_storm,
                     "fleet_obs": fleet_obs,
                     "soci": soci_detail,

@@ -459,6 +459,26 @@ class ScenarioConfig:
 
 
 @dataclass
+class SoakConfig:
+    """Endurance-soak runner knobs (scenario/soak.py, tools/soak_profile.py).
+
+    ``epochs`` overrides the spec's ``[scenario.soak]`` epoch count
+    (0 = use the spec's); ``spot_epochs`` is how many epochs the gated
+    profile replays serially for the identity spot-check;
+    ``report_path`` is where the profile banks its report JSON ("" =
+    the repo's ``SOAK_r01.json``). Environment variables override
+    per-process (``NTPU_SOAK_EPOCHS``, ``NTPU_SOAK_SPOT_EPOCHS``,
+    ``NTPU_SOAK_REPORT``). The arrival/evolution/scale-up shape itself
+    lives in the spec's ``[scenario.soak]`` table, not here — a soak
+    must be reproducible from the spec alone.
+    """
+
+    epochs: int = 0
+    spot_epochs: int = 2
+    report_path: str = ""
+
+
+@dataclass
 class MeshConfig:
     """Device-mesh convert sharding knobs (ops/mesh_pack.py,
     __graft_entry__.sharded_convert_step).
@@ -522,6 +542,7 @@ class SnapshotterConfig:
     slo: SloConfig = field(default_factory=SloConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     scenario: ScenarioConfig = field(default_factory=ScenarioConfig)
+    soak: SoakConfig = field(default_factory=SoakConfig)
     experimental: ExperimentalConfig = field(default_factory=ExperimentalConfig)
 
     # -- derived paths (reference config/global.go accessors) ---------------
@@ -708,6 +729,10 @@ class SnapshotterConfig:
             raise ConfigError("scenario.pods must be >= 1")
         if self.scenario.seed < 0:
             raise ConfigError("scenario.seed must be >= 0")
+        if self.soak.epochs < 0:
+            raise ConfigError("soak.epochs must be >= 0 (0 = spec's value)")
+        if self.soak.spot_epochs < 1:
+            raise ConfigError("soak.spot_epochs must be >= 1")
         if not 0.0 < self.chunk_dict.load_factor < 1.0:
             raise ConfigError("chunk_dict.load_factor must be within (0, 1)")
         if self.chunk_dict.headroom < 1.0:

@@ -544,6 +544,13 @@ class AdmissionGate:
         self._lane_caps: list[Optional[int]] = [None] * N_LANES
         self._lane_in_service = [0] * N_LANES
         self._shed_total = [0] * N_LANES
+        # Demand-pressure signal (scale-up actuation, metrics/slo.py
+        # SloScaleUp): an EWMA of demand-lane queue waits plus the live
+        # queue depth — cheap enough to keep on every acquire, read
+        # rarely.
+        self._demand_wait_ewma_ms = 0.0
+        self._demand_wait_samples = 0
+        self._demand_queued_peak = 0
 
     def weight(self, tenant: str) -> float:
         return max(1e-9, float(self.weights.get(tenant, 1.0)))
@@ -637,9 +644,10 @@ class AdmissionGate:
             self._seq += 1
             t = _Ticket(tenant, lane, n, self._seq)
             self._waiters.append(t)
-            ADMIT_QUEUED.labels(LANE_NAMES[lane]).set(
-                sum(1 for w in self._waiters if w.lane == lane)
-            )
+            depth = sum(1 for w in self._waiters if w.lane == lane)
+            ADMIT_QUEUED.labels(LANE_NAMES[lane]).set(depth)
+            if lane == DEMAND and depth > self._demand_queued_peak:
+                self._demand_queued_peak = depth
             try:
                 while not self._admissible(t):
                     if self._lane_caps[lane] == 0:
@@ -673,6 +681,11 @@ class AdmissionGate:
                 # fairness predicates of other waiters may now pass.
                 self._cv.notify_all()
             ADMIT_TENANT_BYTES.labels(tenant).set(self._tenant_bytes[tenant])
+            if lane == DEMAND:
+                self._demand_wait_samples += 1
+                self._demand_wait_ewma_ms += 0.2 * (
+                    (perf_counter() - t0) * 1000.0 - self._demand_wait_ewma_ms
+                )
         waited = perf_counter() - t0
         ADMITTED.labels(LANE_NAMES[lane]).inc()
         ADMIT_WAIT_MS.labels(LANE_NAMES[lane]).observe(waited * 1000.0)
@@ -734,6 +747,21 @@ class AdmissionGate:
                 "shed_per_lane": dict(zip(LANE_NAMES, self._shed_total)),
                 "tenant_inflight_bytes": dict(self._tenant_bytes),
                 "tenant_service_bytes": dict(self._tenant_service),
+            }
+
+    def demand_pressure(self) -> dict:
+        """The scale-up demand signal: live demand-lane queue depth, the
+        deepest queue seen over this gate's lifetime, and the wait EWMA.
+        Burn-clean-but-growing pressure here means the node is
+        UNDERSIZED, not misbehaving — the SLO scale-up policy
+        (metrics/slo.py) spawns capacity instead of shedding load."""
+        with self._cv:
+            self._state_shared.read()
+            return {
+                "queued": sum(1 for w in self._waiters if w.lane == DEMAND),
+                "queued_peak": self._demand_queued_peak,
+                "wait_ms": round(self._demand_wait_ewma_ms, 3),
+                "samples": self._demand_wait_samples,
             }
 
     def service_bytes(self, tenant: str) -> int:

@@ -104,6 +104,9 @@ KNOWN_SITES = (
     "ha.place",              # ha/placement.py PlacementController.tick entry
     "ha.replicate",          # ha/replicate.py ReplicaTailer.poll_once entry
     "ha.promote",            # ha/{placement,replicate}.py promotion transition
+    "soak.wave",             # scenario/soak.py per-epoch wave entry
+    "soak.evolve",           # scenario/soak.py corpus-evolution convert step
+    "soak.scaleup",          # metrics/slo.py scale-up spawn attempt
 )
 
 _lock = _an.make_lock("failpoint.table")

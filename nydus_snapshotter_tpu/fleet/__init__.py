@@ -229,6 +229,10 @@ class FleetPlane:
         # + automatic replica promotion, ticked by the scrape loop and
         # published on /api/v1/fleet/placement.
         self.placement = None
+        # Optional metrics/slo.SloScaleUp: the spawn/retire half of
+        # actuation, ticked after the shed actuator so a burn breach
+        # observed this round stands the scale-up policy down.
+        self.scaleup = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -236,6 +240,12 @@ class FleetPlane:
         """Mount a dict-HA placement controller on this plane (ticked by
         the scrape loop, served on ``/api/v1/fleet/placement``)."""
         self.placement = controller
+
+    def attach_scaleup(self, policy) -> None:
+        """Mount a capacity scale-up policy (metrics/slo.SloScaleUp):
+        ticked by the scrape loop, published under ``scaleup`` on
+        ``/api/v1/fleet/slo``."""
+        self.scaleup = policy
 
     def _local_metrics(self) -> str:
         """The controller process's own exposition, through the cached
@@ -269,6 +279,8 @@ class FleetPlane:
                 self.slo.tick()
                 if self.actuator is not None:
                     self.actuator.tick()
+                if self.scaleup is not None:
+                    self.scaleup.tick()
                 if self.placement is not None:
                     self.placement.tick()
             except Exception:  # noqa: BLE001 — the loop must survive anything
@@ -338,6 +350,25 @@ class FleetPlane:
                     return self._json({"message": "member name required"}, 400)
                 self.placement.report_down(name, source=str(d.get("source", "")))
                 return self._json({"reported": name})
+            if route == "/api/v1/fleet/placement/demote" and method == "POST":
+                # Planned primary handoff (ntpuctl dict demote <shard>):
+                # drain, wait for replica catch-up, promote, THEN demote.
+                if self.placement is None:
+                    return self._json({"message": "no placement plane"}, 404)
+                d = json.loads(body or b"{}")
+                try:
+                    shard = int(d.get("shard", -1))
+                except (TypeError, ValueError):
+                    return self._json({"message": "shard must be an int"}, 400)
+                try:
+                    event = self.placement.demote(
+                        shard, timeout_s=float(d.get("timeout_s", 10.0))
+                    )
+                except ValueError as e:
+                    return self._json({"message": str(e)}, 400)
+                except RuntimeError as e:
+                    return self._json({"message": str(e)}, 409)
+                return self._json(event)
             if method != "GET":
                 return self._json({"message": "no such endpoint"}, 404)
             if route == "/api/v1/fleet/placement":
@@ -357,6 +388,8 @@ class FleetPlane:
                 status = self.slo.status()
                 if self.actuator is not None:
                     status["actuation"] = self.actuator.state()
+                if self.scaleup is not None:
+                    status["scaleup"] = self.scaleup.state()
                 return self._json(status)
             if route == "/api/v1/fleet/peers":
                 return self._json(self.peer_listing())

@@ -29,6 +29,14 @@ Role assignments are PUSHED to members' ``/api/v1/ha/configure`` after
 every map change and re-pushed until acknowledged — a member that raced
 the controller's startup still converges. All member RPCs happen
 outside the controller's lock (no blocking under lock).
+
+Two operator/actuation entries ride on the same machinery:
+:meth:`PlacementController.demote` runs a PLANNED primary handoff
+(drain -> replica catch-up to the frozen journal head -> promote ->
+demoted member re-joins as replica; ``ntpuctl dict demote <shard>``),
+and :meth:`PlacementController.scale_replicas` adjusts the per-shard
+replica target — the dict-replica half of SLO scale-up actuation
+(metrics/slo.py :class:`SloScaleUp`).
 """
 
 from __future__ import annotations
@@ -306,6 +314,119 @@ class PlacementController:
             _ha.PLACEMENT_EPOCH.set(epoch)
         self._push_assignments(addr)
         return changed
+
+    def scale_replicas(self, delta: int, max_replicas: int = 8) -> int:
+        """Adjust the per-shard replica target (the dict-replica half of
+        SLO scale-up actuation: spawn -> +1, retire -> -1). Returns the
+        new target; the next tick refills/shrinks slots from the live
+        rendezvous ranking."""
+        with self._lock:
+            self._state_shared.write()
+            self.replicas = min(max_replicas, max(0, self.replicas + int(delta)))
+            target = self.replicas
+        logger.info("dict-ha: replica target scaled to %d", target)
+        return target
+
+    def demote(self, shard: int, timeout_s: float = 10.0,
+               poll_s: float = 0.05) -> dict:
+        """Planned primary demotion for one shard: drain, catch up, hand
+        off, THEN demote — zero client-visible errors by construction.
+
+        1. The primary is told to DRAIN (``/api/v1/ha/demote``): merges
+           bounce 503 from here on, freezing the journal head, while
+           writing clients park in their failover poll loop.
+        2. Replicas are polled until one reaches the frozen head (equal
+           applied-chunk totals — an exact condition, not a heuristic,
+           because nothing can advance the head anymore).
+        3. That replica is promoted (same RPC as crash promotion) and
+           the map is re-pointed; the drained member is pushed a replica
+           role of the successor (full resync — its tables are a foreign
+           prefix once the successor accepts writes).
+
+        If no replica catches up inside ``timeout_s`` the drain is
+        ABORTED by re-promoting the drained primary — the shard never
+        stays headless longer than the timeout.
+        """
+        if not 0 <= shard < self.shards:
+            raise ValueError(f"shard {shard} out of range (0..{self.shards - 1})")
+        with self._lock:
+            self._state_shared.read()
+            a = self._assign[shard]
+            primary, replicas = a.primary, list(a.replicas)
+            addr = dict(self._addr)
+        if not primary or not addr.get(primary):
+            raise ValueError(f"shard {shard} has no addressable primary")
+        if not replicas:
+            raise ValueError(f"shard {shard} has no replica to hand off to")
+        primary_addr = addr[primary]
+        udshttp.post_json(
+            primary_addr, "/api/v1/ha/demote", {}, timeout=self._rpc_timeout_s
+        )
+        want = self._applied_chunks(self._ha_status(primary_addr))
+        deadline = self._clock() + timeout_s
+        best: Optional[tuple[int, str]] = None
+        while want >= 0:
+            scored = [
+                (self._applied_chunks(self._ha_status(addr[r])), r)
+                for r in replicas if addr.get(r)
+            ]
+            scored.sort(key=lambda t: (-t[0], t[1]))
+            if scored and scored[0][0] >= want:
+                best = scored[0]
+                break
+            if self._clock() >= deadline:
+                break
+            time.sleep(poll_s)
+        if best is None:
+            # Abort: hand the role straight back — clients were parked,
+            # not failed, and resume against the same primary.
+            self._promote_member(primary, primary_addr)
+            raise RuntimeError(
+                f"planned demotion of shard {shard} aborted: no replica "
+                f"reached the journal head ({want} chunks) in {timeout_s}s"
+            )
+        applied, successor = best
+        failpoint.hit("ha.promote")
+        with trace.span(
+            "ha.promote", shard=str(shard), member=successor, planned="true"
+        ):
+            acked = self._promote_member(successor, addr.get(successor, ""))
+        event = {
+            "kind": "planned_demotion",
+            "at": self._clock(),
+            "shard": shard,
+            "from": primary,
+            "to": successor,
+            "applied_chunks": applied,
+            "acked": acked,
+        }
+        with self._lock:
+            self._state_shared.write()
+            a = self._assign[shard]
+            a.primary = successor
+            a.replicas = [r for r in replicas if r != successor] + [primary]
+            self.epoch += 1
+            self.promotions += 1
+            self._events.append(event)
+            epoch = self.epoch
+        _ha.PROMOTIONS.labels(str(shard)).inc()
+        _ha.PLACEMENT_EPOCH.set(epoch)
+        logger.warning(
+            "dict-ha: planned demotion handed shard %d from %s to %s "
+            "(applied_chunks=%d, acked=%s)",
+            shard, primary, successor, applied, acked,
+        )
+        if self._engine is not None:
+            self._engine.record_event(
+                "dict_ha_planned_demotion",
+                shard=shard, promoted=successor, previous=primary,
+                applied_chunks=applied,
+            )
+        # The drained member's re-push as replica happens here (its
+        # pushed-state key still holds the old primary tuple, so the
+        # push is not suppressed).
+        self._push_assignments(addr)
+        return event
 
     def _promote_member(self, name: str, address: str) -> bool:
         if not address:

@@ -31,11 +31,20 @@ poll round, for every namespace the primary lists:
 
 :class:`HaAgent` is the member-side control surface the placement
 controller drives: ``/api/v1/ha/status`` (role + per-namespace lag),
-``/api/v1/ha/configure`` (role/upstream assignment) and
-``/api/v1/ha/promote`` (replica -> primary, tailer stopped). A
+``/api/v1/ha/configure`` (role/upstream assignment),
+``/api/v1/ha/promote`` (replica -> primary, tailer stopped) and
+``/api/v1/ha/demote`` (primary -> draining: planned handoff). A
 non-primary member answers probe/entries/since reads but rejects
 merges with 503 — a client that reaches a replica fails loudly and
 fails over, it never forks the table.
+
+The **draining** role is the planned-demotion window: the member stops
+accepting merges (``is_primary()`` false -> writes bounce 503 and
+clients park in their failover poll loop), but keeps serving journal
+reads so its replicas can catch up to the journal head and the
+controller can verify they did before promoting one. Once a successor
+is primary the controller re-configures the drained member as its
+replica (full resync — its tables are a foreign prefix by then).
 """
 
 from __future__ import annotations
@@ -295,7 +304,7 @@ class HaAgent:
         self.service = service
         self.cfg = cfg or _ha.resolve_ha_config()
         self._mu = _an.make_lock("ha.agent")
-        self.role = role  # primary | replica
+        self.role = role  # primary | replica | draining
         self.shard = -1
         self.epoch = 0
         self.upstream = ""
@@ -373,6 +382,28 @@ class HaAgent:
             )
         return self.status()
 
+    def demote(self) -> dict:
+        """Primary -> draining (the controller's PLANNED handoff entry).
+
+        Merges start bouncing 503 immediately (``is_primary()`` flips
+        false), which parks writing clients in their failover poll loop;
+        journal reads keep flowing so replicas drain to the head. The
+        journal head is frozen by construction from this point — no
+        merge can advance it — so "replica chunks == drained primary
+        chunks" is a stable handoff condition, not a race.
+        """
+        with self._mu:
+            was = self.role
+            if was == "primary":
+                self.role = "draining"
+        if was != "primary":
+            raise ValueError(f"cannot demote from role {was!r}")
+        logger.warning(
+            "dict-ha: shard %d primary draining for planned demotion",
+            self.shard,
+        )
+        return self.status()
+
     def is_primary(self) -> bool:
         with self._mu:
             return self.role == "primary"
@@ -389,9 +420,11 @@ class HaAgent:
                 "upstream": self.upstream,
             }
         out["replication"] = tailer.status() if tailer is not None else {}
-        if tailer is None and out["role"] == "primary":
+        if tailer is None and out["role"] in ("primary", "draining"):
             # A promoted primary reports what it had applied — the
-            # controller's most-caught-up ranking reads this.
+            # controller's most-caught-up ranking reads this. A DRAINING
+            # primary reports the same view: that is the frozen journal
+            # head the drain loop compares replicas against.
             out["replication"] = {
                 "namespaces": {
                     s["namespace"]: {"chunks": s["chunks"]}
@@ -421,5 +454,13 @@ class HaAgent:
         if path == "/api/v1/ha/promote" and method == "POST":
             req = json.loads(body or b"{}")
             out = self.promote(epoch=int(req.get("epoch", 0)))
+            return 200, "application/json", json.dumps(out).encode()
+        if path == "/api/v1/ha/demote" and method == "POST":
+            try:
+                out = self.demote()
+            except ValueError as e:
+                return 409, "application/json", json.dumps(
+                    {"message": str(e)}
+                ).encode()
             return 200, "application/json", json.dumps(out).encode()
         return 404, "application/json", b'{"message": "no such ha endpoint"}'

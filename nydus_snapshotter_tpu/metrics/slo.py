@@ -94,6 +94,20 @@ SLO_LANE_SHED = _reg.register(
         ("lane",),
     )
 )
+SLO_SCALEUP = _reg.register(
+    _metrics.Counter(
+        "ntpu_slo_scaleup_total",
+        "Capacity scale-up transitions driven by clean-burn demand "
+        "pressure (spawn/retire/spawn_failed/retire_failed)",
+        ("action",),
+    )
+)
+SLO_SCALEUP_MEMBERS = _reg.register(
+    _metrics.Gauge(
+        "ntpu_slo_scaleup_members",
+        "Extra capacity members currently held by scale-up actuation",
+    )
+)
 
 
 class SloSpecError(ValueError):
@@ -499,6 +513,162 @@ class SloActuator:
             "shed_lanes": [names[lane] for lane in self.shed_lanes[:depth]],
             "shed_depth": depth,
             "restore_burn": self.restore_burn,
+            "events": events[-16:],
+        }
+
+
+class SloScaleUp:
+    """Closed-loop capacity scale-UP: the other half of actuation.
+
+    :class:`SloActuator` handles a burn breach by shedding background
+    lanes — correct when the node is *misbehaving*, wrong when it is
+    simply *undersized*: a fleet whose demand queues grow while burn
+    stays clean needs more capacity, not less work. This policy closes
+    that loop: when no objective is breached but the demand-pressure
+    signal (:meth:`AdmissionGate.demand_pressure` — queue depth and wait
+    EWMA) crosses its thresholds, ``spawn_fn`` asks the placement/fleet
+    plane for another member (peer server, dict replica); after
+    ``quiet_ticks`` calm ticks the newest member is retired again.
+
+    Failure contract (the chaos suite pins this): a spawn attempt fires
+    the ``soak.scaleup`` failpoint and any exception out of it — or out
+    of ``spawn_fn`` itself — degrades to a ``spawn_failed`` event plus a
+    ``cooldown_ticks`` back-off. The policy NEVER raises out of
+    :meth:`tick` and never blocks: a broken spawn path leaves the fleet
+    on the shed-only behaviour it had before this class existed.
+
+    During a burn breach the policy stands down entirely (no spawn, no
+    retire): shedding owns the gate until the burn clears, and spawning
+    while misbehaving would mask the breach with hardware.
+    """
+
+    def __init__(
+        self,
+        engine: SloEngine,
+        demand_fn: Callable[[], dict],
+        spawn_fn: Callable[[int], object],
+        retire_fn: Optional[Callable[[int], object]] = None,
+        queue_high: int = 4,
+        wait_high_ms: float = 25.0,
+        quiet_ticks: int = 2,
+        max_members: int = 2,
+        cooldown_ticks: int = 2,
+        clock: Callable[[], float] = time.monotonic,
+        keep_events: int = 64,
+    ):
+        self.engine = engine
+        self.demand_fn = demand_fn
+        self.spawn_fn = spawn_fn
+        self.retire_fn = retire_fn
+        self.queue_high = max(1, int(queue_high))
+        self.wait_high_ms = float(wait_high_ms)
+        self.quiet_ticks = max(1, int(quiet_ticks))
+        self.max_members = max(0, int(max_members))
+        self.cooldown_ticks = max(0, int(cooldown_ticks))
+        self._clock = clock
+        self._lock = _an.make_lock("slo.scaleup")
+        self._state_shared = _an.shared("slo.scaleup.state")
+        self.members = 0
+        self._quiet = 0
+        self._cooldown = 0
+        self._events: deque = deque(maxlen=keep_events)
+        SLO_SCALEUP_MEMBERS.set(0)
+
+    def _record(self, action: str, reason: str, **detail) -> dict:
+        event = {
+            "at": self._clock(),
+            "action": action,
+            "members": self.members,
+            "reason": reason,
+            **detail,
+        }
+        with self._lock:
+            self._state_shared.write()
+            self._events.append(event)
+        SLO_SCALEUP.labels(action).inc()
+        if self.engine is not None:
+            self.engine.record_event(f"slo_scaleup_{action}", **event)
+        logger.warning(
+            "SLO scale-up: %s -> %d members (%s)", action, self.members, reason
+        )
+        return event
+
+    def _spawn(self, reason: str) -> dict:
+        from nydus_snapshotter_tpu import failpoint, trace
+
+        target = self.members + 1
+        try:
+            with trace.span("slo.scaleup", action="spawn", target=target):
+                failpoint.hit("soak.scaleup")
+                self.spawn_fn(target)
+        except BaseException as e:  # noqa: BLE001 — degrade, never wedge
+            self._cooldown = self.cooldown_ticks
+            return self._record(
+                "spawn_failed", reason, error=repr(e)[:200]
+            )
+        self.members = target
+        SLO_SCALEUP_MEMBERS.set(self.members)
+        return self._record("spawn", reason)
+
+    def _retire(self, reason: str) -> dict:
+        from nydus_snapshotter_tpu import trace
+
+        target = self.members - 1
+        try:
+            with trace.span("slo.scaleup", action="retire", target=target):
+                if self.retire_fn is not None:
+                    self.retire_fn(target)
+        except BaseException as e:  # noqa: BLE001 — degrade, never wedge
+            self._cooldown = self.cooldown_ticks
+            return self._record(
+                "retire_failed", reason, error=repr(e)[:200]
+            )
+        self.members = target
+        SLO_SCALEUP_MEMBERS.set(self.members)
+        self._quiet = 0
+        return self._record("retire", reason)
+
+    def tick(self) -> Optional[dict]:
+        """One capacity decision; returns the transition event if any.
+        Call after :meth:`SloEngine.tick` on the same cadence."""
+        if self.engine is not None and self.engine.breached():
+            self._quiet = 0  # the shed path owns a breach window
+            return None
+        try:
+            press = self.demand_fn() or {}
+        except Exception:  # a dead signal source reads as zero pressure
+            press = {}
+        queued = int(press.get("queued", 0))
+        wait_ms = float(press.get("wait_ms", 0.0))
+        hot = queued >= self.queue_high or wait_ms >= self.wait_high_ms
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+        if hot:
+            self._quiet = 0
+            if self.members < self.max_members:
+                return self._spawn(
+                    f"demand queued={queued} wait_ms={wait_ms:.3f}"
+                )
+            return None
+        if self.members > 0:
+            self._quiet += 1
+            if self._quiet >= self.quiet_ticks:
+                return self._retire(f"quiet for {self._quiet} ticks")
+        return None
+
+    def state(self) -> dict:
+        """The capacity view the fleet surface publishes."""
+        with self._lock:
+            self._state_shared.read()
+            events = [dict(e) for e in self._events]
+        return {
+            "members": self.members,
+            "max_members": self.max_members,
+            "quiet": self._quiet,
+            "cooldown": self._cooldown,
+            "queue_high": self.queue_high,
+            "wait_high_ms": self.wait_high_ms,
             "events": events[-16:],
         }
 

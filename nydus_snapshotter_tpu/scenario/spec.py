@@ -59,6 +59,13 @@ A spec is one TOML document::
     window_secs = 0.6
     burn_threshold = 2.0
 
+    [scenario.soak]           # endurance plane (scenario/soak.py; optional)
+    epochs = 6                # waves to run
+    base_pods = 4             # Poisson mean, diurnal x flash modulated
+    drift_rate = 0.08         # corpus-evolution mutation probability
+    # full key set (arrivals, scale-up, sentinel growth bounds) in
+    # docs/scenarios.md
+
 Validation is strict: unknown keys, unknown ops/kinds, fault sites not
 in the failpoint catalog, unparsable fault actions and out-of-range
 phase references all raise :class:`ScenarioSpecError` naming the table.
@@ -324,6 +331,116 @@ class SloBudget:
 
 
 @dataclass(frozen=True)
+class SoakSpec:
+    """``[scenario.soak]`` — the endurance-plane knobs (docs/scenarios.md).
+
+    The soak runs ``epochs`` waves; each wave's pod count is a pure
+    function of ``(seed, epoch)``: a Poisson draw around ``base_pods``
+    modulated by a cosine diurnal curve (period ``epochs_per_day``,
+    amplitude ``diurnal_amplitude``) with a ``flash_prob`` chance of a
+    ``flash_factor`` flash crowd. ``drift_rate`` feeds the corpus
+    evolution model (per-epoch per-path mutation probability). The
+    ``*_growth_per_epoch`` bounds feed the leak sentinels; the scale-up
+    trio (``queue_high``/``wait_high_ms``/``quiet_epochs``) feeds the
+    closed-loop capacity policy.
+    """
+
+    epochs: int = 6
+    base_pods: int = 4
+    diurnal_amplitude: float = 0.5
+    epochs_per_day: int = 8
+    flash_prob: float = 0.12
+    flash_factor: float = 3.0
+    drift_rate: float = 0.08
+    reads_per_pod: int = 1
+    scaleup: bool = True
+    max_extra_members: int = 2
+    queue_high: int = 4
+    wait_high_ms: float = 25.0
+    quiet_epochs: int = 2
+    rss_growth_mib_per_epoch: float = 8.0
+    fd_growth_per_epoch: float = 4.0
+    row_growth_per_epoch: float = 2.0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SoakSpec":
+        where = "[scenario.soak]"
+        _only_keys(
+            d,
+            {"epochs", "base_pods", "diurnal_amplitude", "epochs_per_day",
+             "flash_prob", "flash_factor", "drift_rate", "reads_per_pod",
+             "scaleup", "max_extra_members", "queue_high", "wait_high_ms",
+             "quiet_epochs", "rss_growth_mib_per_epoch",
+             "fd_growth_per_epoch", "row_growth_per_epoch"},
+            where,
+        )
+        spec = cls(
+            epochs=int(d.get("epochs", 6)),
+            base_pods=int(d.get("base_pods", 4)),
+            diurnal_amplitude=float(d.get("diurnal_amplitude", 0.5)),
+            epochs_per_day=int(d.get("epochs_per_day", 8)),
+            flash_prob=float(d.get("flash_prob", 0.12)),
+            flash_factor=float(d.get("flash_factor", 3.0)),
+            drift_rate=float(d.get("drift_rate", 0.08)),
+            reads_per_pod=int(d.get("reads_per_pod", 1)),
+            scaleup=bool(d.get("scaleup", True)),
+            max_extra_members=int(d.get("max_extra_members", 2)),
+            queue_high=int(d.get("queue_high", 4)),
+            wait_high_ms=float(d.get("wait_high_ms", 25.0)),
+            quiet_epochs=int(d.get("quiet_epochs", 2)),
+            rss_growth_mib_per_epoch=float(d.get("rss_growth_mib_per_epoch", 8.0)),
+            fd_growth_per_epoch=float(d.get("fd_growth_per_epoch", 4.0)),
+            row_growth_per_epoch=float(d.get("row_growth_per_epoch", 2.0)),
+        )
+        if spec.epochs < 1 or spec.base_pods < 1:
+            raise ScenarioSpecError(f"{where}: epochs/base_pods must be >= 1")
+        if not 0.0 <= spec.diurnal_amplitude < 1.0:
+            raise ScenarioSpecError(f"{where}: diurnal_amplitude must be in [0, 1)")
+        if spec.epochs_per_day < 1:
+            raise ScenarioSpecError(f"{where}: epochs_per_day must be >= 1")
+        if not 0.0 <= spec.flash_prob <= 1.0:
+            raise ScenarioSpecError(f"{where}: flash_prob must be in [0, 1]")
+        if spec.flash_factor < 1.0:
+            raise ScenarioSpecError(f"{where}: flash_factor must be >= 1")
+        if not 0.0 <= spec.drift_rate <= 1.0:
+            raise ScenarioSpecError(f"{where}: drift_rate must be in [0, 1]")
+        if spec.reads_per_pod < 1 or spec.quiet_epochs < 1:
+            raise ScenarioSpecError(
+                f"{where}: reads_per_pod/quiet_epochs must be >= 1"
+            )
+        if spec.max_extra_members < 0 or spec.queue_high < 1:
+            raise ScenarioSpecError(
+                f"{where}: max_extra_members >= 0 and queue_high >= 1 required"
+            )
+        if spec.wait_high_ms <= 0:
+            raise ScenarioSpecError(f"{where}: wait_high_ms must be positive")
+        if (spec.rss_growth_mib_per_epoch < 0 or spec.fd_growth_per_epoch < 0
+                or spec.row_growth_per_epoch < 0):
+            raise ScenarioSpecError(f"{where}: growth bounds must be >= 0")
+        return spec
+
+    def to_dict(self) -> dict:
+        return {
+            "epochs": self.epochs,
+            "base_pods": self.base_pods,
+            "diurnal_amplitude": self.diurnal_amplitude,
+            "epochs_per_day": self.epochs_per_day,
+            "flash_prob": self.flash_prob,
+            "flash_factor": self.flash_factor,
+            "drift_rate": self.drift_rate,
+            "reads_per_pod": self.reads_per_pod,
+            "scaleup": self.scaleup,
+            "max_extra_members": self.max_extra_members,
+            "queue_high": self.queue_high,
+            "wait_high_ms": self.wait_high_ms,
+            "quiet_epochs": self.quiet_epochs,
+            "rss_growth_mib_per_epoch": self.rss_growth_mib_per_epoch,
+            "fd_growth_per_epoch": self.fd_growth_per_epoch,
+            "row_growth_per_epoch": self.row_growth_per_epoch,
+        }
+
+
+@dataclass(frozen=True)
 class ScenarioSpec:
     name: str
     description: str = ""
@@ -333,6 +450,7 @@ class ScenarioSpec:
     phases: tuple = ()
     faults: tuple = ()
     slo: SloBudget = field(default_factory=SloBudget)
+    soak: Optional[SoakSpec] = None
 
     @classmethod
     def from_dict(cls, data: dict) -> "ScenarioSpec":
@@ -345,7 +463,7 @@ class ScenarioSpec:
         _only_keys(
             sc,
             {"name", "description", "seed", "pods", "corpus", "phases",
-             "faults", "slo"},
+             "faults", "slo", "soak"},
             "[scenario]",
         )
         if not sc.get("name"):
@@ -380,6 +498,7 @@ class ScenarioSpec:
             phases=phases,
             faults=faults,
             slo=SloBudget.from_dict(sc.get("slo", {})),
+            soak=(SoakSpec.from_dict(sc["soak"]) if "soak" in sc else None),
         )
         if spec.pods < 1:
             raise ScenarioSpecError("[scenario]: pods must be >= 1")
@@ -392,18 +511,19 @@ class ScenarioSpec:
         raise KeyError(cid)
 
     def to_dict(self) -> dict:
-        return {
-            "scenario": {
-                "name": self.name,
-                "description": self.description,
-                "seed": self.seed,
-                "pods": self.pods,
-                "corpus": [c.to_dict() for c in self.corpus],
-                "phases": [p.to_dict() for p in self.phases],
-                "faults": [f.to_dict() for f in self.faults],
-                "slo": self.slo.to_dict(),
-            }
+        sc = {
+            "name": self.name,
+            "description": self.description,
+            "seed": self.seed,
+            "pods": self.pods,
+            "corpus": [c.to_dict() for c in self.corpus],
+            "phases": [p.to_dict() for p in self.phases],
+            "faults": [f.to_dict() for f in self.faults],
+            "slo": self.slo.to_dict(),
         }
+        if self.soak is not None:
+            sc["soak"] = self.soak.to_dict()
+        return {"scenario": sc}
 
 
 def loads(text: str) -> ScenarioSpec:

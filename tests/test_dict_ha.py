@@ -5,6 +5,7 @@ client mid-merge failover byte-identity."""
 import io
 import os
 import tarfile
+import threading
 import time
 
 import numpy as np
@@ -517,6 +518,215 @@ class TestClientFailover:
         names = [f"m{i}" for i in range(8)]
         assert _rank(0, names) == _rank(0, list(reversed(names)))
         assert _rank(0, names) != _rank(1, names) or len(set(names)) == 1
+
+
+# ---------------------------------------------------------------------------
+# Planned demotion: drain -> catch-up -> hand-off -> demote (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+class TestPlannedDemotion:
+    def _oracle(self, boots):
+        oracle = ServiceDict("default")
+        for b in boots:
+            oracle.merge_bootstrap_bytes(b)
+        return oracle.records.bootstrap.to_bytes()
+
+    def _cluster(self, tmp_path, n=2):
+        """n dict services + a placement controller over them; tick once
+        so roles are pushed and replication is running."""
+        svcs, agents = [], []
+        for i in range(n):
+            svc = DictService()
+            agents.append(HaAgent(svc, role="unassigned"))
+            svc.run(str(tmp_path / f"m{i}.sock"))
+            svcs.append(svc)
+        members = [
+            fleet.Member(name=f"dict-{i}", component="dict",
+                         address=svcs[i].sock_path, pid=i + 1)
+            for i in range(n)
+        ]
+        engine = SloEngine([])
+        pc = PlacementController(
+            lambda: members, lambda: _live(members), shards=1,
+            replicas=n - 1, engine=engine,
+        )
+        pc.tick()
+        addr_of = {m.name: m.address for m in members}
+        svc_of = {s.sock_path: s for s in svcs}
+        return svcs, agents, pc, engine, addr_of, svc_of
+
+    def _teardown(self, svcs, agents):
+        for a in agents:
+            if a.tailer is not None:
+                a.tailer.stop()
+        for s in svcs:
+            s.stop()
+
+    def test_demotion_byte_identity_zero_client_errors(self, tmp_path):
+        """`dict demote <shard>` while a client keeps merging: every
+        merge succeeds (clients park in the failover poll, they never
+        see an error) and the successor's table is byte-identical to
+        the straight-line oracle."""
+        svcs, agents, pc, engine, addr_of, svc_of = self._cluster(tmp_path)
+        try:
+            seat = pc.map()["assignments"][0]["primary"]["name"]
+            repl_name = pc.map()["assignments"][0]["replicas"][0]["name"]
+            prim = svc_of[addr_of[seat]]
+            repl = svc_of[addr_of[repl_name]]
+            boots = [bootstrap_of(s) for s in (40, 41, 42, 43)]
+            want = self._oracle(boots)
+            scd = ServiceChunkDict(
+                [DictClient(prim.sock_path)],
+                failover=[[repl.sock_path]],
+            )
+            for b in boots[:2]:
+                scd.add_bootstrap_bytes(b)
+            wait_until(lambda: replica_caught_up(prim, repl), what="catch-up")
+
+            errors = []
+
+            def writer():
+                try:
+                    for b in boots[2:]:
+                        scd.add_bootstrap_bytes(b)
+                except BaseException as e:  # noqa: BLE001 — the assertion
+                    errors.append(repr(e))
+
+            t = threading.Thread(target=writer)
+            t.start()
+            event = pc.demote(0, timeout_s=10.0)
+            t.join(timeout=30.0)
+            assert not t.is_alive(), "writer wedged through the drain"
+            assert errors == [], f"client saw errors during drain: {errors}"
+            assert event["kind"] == "planned_demotion"
+            assert event["from"] == seat and event["to"] == repl_name
+            # The successor converged on the oracle table byte-for-byte.
+            assert (
+                repl.dict_for("default").records.bootstrap.to_bytes() == want
+            )
+            m = pc.map()
+            assert m["assignments"][0]["primary"]["name"] == repl_name
+            assert m["promotions"] == 1
+            # The drained member is back in the replica set, pointed at
+            # the successor.
+            assert seat in [
+                r["name"] for r in m["assignments"][0]["replicas"]
+            ]
+            events = engine.status()["events"]
+            assert events[-1]["kind"] == "dict_ha_planned_demotion"
+            scd.close()
+        finally:
+            self._teardown(svcs, agents)
+
+    def test_demotion_aborts_and_restores_when_no_replica_catches_up(
+        self, tmp_path
+    ):
+        """No replica can reach the frozen head inside the timeout: the
+        drain is aborted, the primary gets its role straight back, and a
+        subsequent merge succeeds against it."""
+        svcs, agents, pc, engine, addr_of, svc_of = self._cluster(tmp_path)
+        try:
+            seat = pc.map()["assignments"][0]["primary"]["name"]
+            prim = svc_of[addr_of[seat]]
+            # Stop replication so the replica can never catch up.
+            for a in agents:
+                if a.tailer is not None:
+                    a.tailer.stop()
+            cli = DictClient(prim.sock_path)
+            cli.merge(bootstrap_of(50), "default")
+            with pytest.raises(RuntimeError, match="aborted"):
+                pc.demote(0, timeout_s=0.3, poll_s=0.05)
+            assert prim.ha.is_primary(), "abort must hand the role back"
+            cli.merge(bootstrap_of(51), "default")  # writes flow again
+            assert pc.map()["promotions"] == 0
+        finally:
+            self._teardown(svcs, agents)
+
+    def test_demote_validates_shard_and_topology(self, tmp_path):
+        svcs, agents, pc, _engine, _addr, _svc = self._cluster(tmp_path)
+        try:
+            with pytest.raises(ValueError, match="out of range"):
+                pc.demote(7)
+        finally:
+            self._teardown(svcs, agents)
+        members = _members(1)
+        lone = PlacementController(
+            lambda: members, lambda: _live(members), shards=1, replicas=0
+        )
+        lone.tick()
+        with pytest.raises(ValueError, match="no replica"):
+            lone.demote(0)
+
+    def test_draining_role_semantics(self, tmp_path):
+        """demote() freezes writes (503 to clients) without dropping the
+        journal head; promote() recovers a draining member (the abort
+        path); demote from a non-primary role is refused."""
+        svc = DictService()
+        agent = HaAgent(svc, role="primary")
+        svc.run(str(tmp_path / "d.sock"))
+        try:
+            cli = DictClient(svc.sock_path)
+            cli.merge(bootstrap_of(60), "default")
+            st = agent.demote()
+            assert st["role"] == "draining"
+            with pytest.raises(DictServiceError, match="503"):
+                cli.merge(bootstrap_of(61), "default")
+            # The frozen head is still reported for catch-up comparison.
+            chunks = st["replication"]["namespaces"]["default"]["chunks"]
+            assert chunks > 0
+            with pytest.raises(ValueError, match="draining"):
+                agent.demote()  # only a primary can start a drain
+            agent.promote()  # abort: straight back to primary
+            cli.merge(bootstrap_of(61), "default")
+        finally:
+            svc.stop()
+
+    def test_demote_http_routes(self, tmp_path):
+        """Member /api/v1/ha/demote (200/409) + controller
+        /api/v1/fleet/placement/demote (400/404)."""
+        svc = DictService()
+        HaAgent(svc, role="replica")
+        svc.run(str(tmp_path / "r.sock"))
+        try:
+            from nydus_snapshotter_tpu.utils import udshttp
+
+            status, body = udshttp.request(
+                svc.sock_path, "/api/v1/ha/demote", method="POST", body=b"{}"
+            )
+            assert status == 409  # replicas don't drain
+        finally:
+            svc.stop()
+        import json as _json
+
+        cfg = fleet.FleetRuntimeConfig(enable=True)
+        plane = fleet.FleetPlane(cfg=cfg, slo_objectives=[])
+        status, _ctype, _body = plane.handle(
+            "POST", "/api/v1/fleet/placement/demote", {}, b'{"shard": 0}'
+        )
+        assert status == 404  # no placement plane attached
+        members = _members(2)
+        pc = PlacementController(
+            lambda: members, lambda: _live(members), shards=1, replicas=1
+        )
+        plane.attach_placement(pc)
+        status, _ctype, body = plane.handle(
+            "POST", "/api/v1/fleet/placement/demote", {}, b'{"shard": 9}'
+        )
+        assert status == 400
+        assert "out of range" in _json.loads(body)["message"]
+
+    def test_scale_replicas_bounds(self):
+        members = _members(4)
+        pc = PlacementController(
+            lambda: members, lambda: _live(members), shards=1, replicas=1
+        )
+        assert pc.scale_replicas(+1) == 2
+        assert pc.scale_replicas(+100, max_replicas=3) == 3
+        assert pc.scale_replicas(-100) == 0
+        pc.scale_replicas(+1)
+        pc.tick()
+        assert len(pc.map()["assignments"][0]["replicas"]) == 1
 
 
 if __name__ == "__main__":

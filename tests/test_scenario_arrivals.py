@@ -1,0 +1,183 @@
+"""Arrival-process + corpus-evolution models (ISSUE 16): determinism,
+diurnal/flash shape properties, drift monotonicity and the analytic
+dedup-decay property the soak's aging story rests on.
+
+Everything here is pure functions of (seed, epoch) — no runner, no
+filesystem, so the suite is fast and exact."""
+
+from __future__ import annotations
+
+import dataclasses
+import stat
+
+import pytest
+
+from nydus_snapshotter_tpu.scenario import arrivals, corpus, evolution
+from nydus_snapshotter_tpu.scenario.spec import SoakSpec
+
+SOAK = SoakSpec(
+    epochs=32,
+    base_pods=4,
+    diurnal_amplitude=0.5,
+    epochs_per_day=8,
+    flash_prob=0.2,
+    flash_factor=3.0,
+)
+
+
+class TestArrivals:
+    def test_schedule_deterministic_in_seed(self):
+        a = arrivals.schedule(SOAK, 23)
+        b = arrivals.schedule(SOAK, 23)
+        assert a == b
+        assert arrivals.schedule(SOAK, 24) != a
+
+    def test_wave_pure_in_epoch_not_in_call_order(self):
+        """Epoch e's wave never depends on which other epochs were
+        drawn first — the property single-epoch replay relies on."""
+        forward = [arrivals.wave_for(SOAK, 23, e) for e in range(8)]
+        backward = [arrivals.wave_for(SOAK, 23, e) for e in reversed(range(8))]
+        assert forward == list(reversed(backward))
+
+    def test_diurnal_trough_and_peak(self):
+        assert arrivals.diurnal_factor(0, 8, 0.5) == pytest.approx(0.5)
+        assert arrivals.diurnal_factor(4, 8, 0.5) == pytest.approx(1.5)
+        # amplitude 0 or a degenerate day flattens the curve
+        assert arrivals.diurnal_factor(3, 8, 0.0) == 1.0
+        assert arrivals.diurnal_factor(3, 1, 0.9) == 1.0
+
+    def test_flash_crowds_multiply_the_rate(self):
+        ws = arrivals.schedule(SOAK, 23)
+        flash = [w for w in ws if w.flash]
+        calm = [w for w in ws if not w.flash]
+        assert flash, "flash_prob=0.2 over 32 epochs must flash somewhere"
+        assert calm
+        for w in flash:
+            assert w.rate == pytest.approx(
+                SOAK.base_pods * w.diurnal * SOAK.flash_factor
+            )
+        for w in calm:
+            assert w.rate == pytest.approx(SOAK.base_pods * w.diurnal)
+
+    def test_flash_coin_stable_under_extra_draws(self):
+        """The flash coin is a keyed hash, not an RNG stream: consuming
+        other draws (here: the evolution model's coins for a pile of
+        paths) cannot shift which epochs flash."""
+        before = [arrivals.wave_for(SOAK, 23, e).flash for e in range(16)]
+        for e in range(16):
+            evolution.mutations(23, 0.5, f"/p{e}", e)
+        after = [arrivals.wave_for(SOAK, 23, e).flash for e in range(16)]
+        assert before == after
+
+    def test_pod_count_positive_and_tail_clamped(self):
+        for seed in (1, 23, 999):
+            for w in arrivals.schedule(SOAK, seed):
+                assert w.pods >= 1
+                assert w.pods <= int(w.rate * 2.0) + 2
+
+    def test_unit_draw_range_and_salt_independence(self):
+        draws = [arrivals.unit_draw(23, e, "flash") for e in range(64)]
+        assert all(0.0 <= d < 1.0 for d in draws)
+        assert arrivals.unit_draw(23, 0, "flash") != arrivals.unit_draw(
+            23, 0, "evolve|/etc/hosts"
+        )
+
+    def test_wave_to_dict_round_trip_fields(self):
+        w = arrivals.wave_for(SOAK, 23, 5)
+        d = w.to_dict()
+        assert d["epoch"] == 5 and d["pods"] == w.pods
+        assert set(d) == {
+            "epoch", "pods", "reads_per_pod", "flash", "diurnal", "rate",
+        }
+
+
+class TestEvolution:
+    def test_mutations_deterministic_and_cumulative(self):
+        a = [evolution.mutations(23, 0.3, "/usr/bin/python", e) for e in range(16)]
+        b = [evolution.mutations(23, 0.3, "/usr/bin/python", e) for e in range(16)]
+        assert a == b
+        # Cumulative: never decreasing in epoch, zero at epoch 0.
+        assert a[0] == 0
+        assert all(x <= y for x, y in zip(a, a[1:]))
+
+    def test_mutations_monotone_in_drift_rate(self):
+        """A higher drift rate can only add mutation epochs (the coin
+        threshold grows, the draws are shared), never remove one."""
+        for path in ("/a", "/usr/lib/libc.so", "/etc/os-release"):
+            lo = evolution.mutations(23, 0.1, path, 24)
+            hi = evolution.mutations(23, 0.4, path, 24)
+            assert lo <= hi
+
+    def test_gen_of_stacks_on_manifest_gens(self):
+        manifest = corpus.load_manifest(corpus.MANIFEST_TREE2)
+        base_entry = next(
+            e for e in manifest["entries"]
+            if stat.S_ISREG(e["mode"]) and e.get("gen", 0) > 0
+        )
+        path = base_entry["path"]
+        g0 = evolution.gen_of(manifest, 23, 0.0, 0)(path)
+        assert g0 == base_entry["gen"], "zero drift = tree2 derivation gens"
+        g_late = evolution.gen_of(manifest, 23, 0.5, 16)(path)
+        assert g_late >= g0
+
+    def test_evolved_members_epoch0_identical_to_base(self):
+        manifest = corpus.load_manifest(corpus.MANIFEST_TREE2)
+        base = corpus.members_to_tar(corpus.manifest_members(manifest))
+        ev = corpus.members_to_tar(
+            evolution.evolved_members(manifest, 23, 0.25, 0)
+        )
+        assert ev == base
+
+    def test_evolved_members_deterministic_and_drifting(self):
+        manifest = corpus.load_manifest(corpus.MANIFEST_TREE2)
+        a = corpus.members_to_tar(evolution.evolved_members(manifest, 23, 0.25, 6))
+        b = corpus.members_to_tar(evolution.evolved_members(manifest, 23, 0.25, 6))
+        assert a == b
+        c = corpus.members_to_tar(evolution.evolved_members(manifest, 23, 0.25, 7))
+        assert c != a, "another epoch of drift must change the corpus"
+
+    def test_shared_fraction_monotone_decay(self):
+        """The dict-aging property: the fraction of bytes still at base
+        generation decays monotonically in epoch AND in drift rate —
+        dedup against a frozen dict can only get worse as a registry
+        ages, never better."""
+        manifest = corpus.load_manifest(corpus.MANIFEST_TREE2)
+        by_epoch = [
+            evolution.shared_fraction(manifest, 23, 0.15, e)
+            for e in (0, 2, 4, 8, 16, 32)
+        ]
+        assert by_epoch[0] == pytest.approx(1.0)
+        assert all(x >= y for x, y in zip(by_epoch, by_epoch[1:]))
+        assert by_epoch[-1] < 1.0
+        by_rate = [
+            evolution.shared_fraction(manifest, 23, r, 16)
+            for r in (0.0, 0.1, 0.3, 0.6)
+        ]
+        assert by_rate[0] == pytest.approx(1.0)
+        assert all(x >= y for x, y in zip(by_rate, by_rate[1:]))
+
+
+class TestSoakSpecTable:
+    def test_round_trip(self):
+        d = SOAK.to_dict()
+        assert SoakSpec.from_dict(d) == SOAK
+
+    def test_defaults_and_validation(self):
+        sk = SoakSpec.from_dict({})
+        assert sk.epochs == 6 and sk.scaleup
+        with pytest.raises(Exception, match="scenario.soak"):
+            SoakSpec.from_dict({"bogus_key": 1})
+        with pytest.raises(Exception):
+            SoakSpec.from_dict({"drift_rate": 1.5})
+        with pytest.raises(Exception):
+            SoakSpec.from_dict({"epochs": 0})
+
+    def test_frozen(self):
+        with pytest.raises(dataclasses.FrozenInstanceError):
+            SOAK.epochs = 1  # type: ignore[misc]
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

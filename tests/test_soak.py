@@ -1,0 +1,414 @@
+"""Endurance plane (ISSUE 16): leak sentinels, the closed-loop scale-up
+policy, and the soak loop itself — mini runs on tiny corpora, the
+year-scale run lives in tools/soak_profile.py.
+
+Pinned properties:
+
+* ``fit_slope`` drops the warm-up sample and fits the rest;
+* a *planted* fd leak is detected (and a healthy process is not);
+* ``SloScaleUp.tick`` spawns on hot demand, retires after quiet,
+  stands down during a burn breach, and NEVER raises — an armed
+  ``soak.scaleup`` failpoint degrades the fleet to shed-only;
+* a serial mini-soak passes its own audits end to end and a single
+  epoch replays to a byte-identical fingerprint.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.scenario import sentinel as sent
+from nydus_snapshotter_tpu.scenario import spec as sspec
+from nydus_snapshotter_tpu.scenario.orchestrator import ScenarioRunError
+from nydus_snapshotter_tpu.scenario.soak import (
+    SoakRunner,
+    replay_epoch,
+    resolve_soak_config,
+)
+from nydus_snapshotter_tpu.metrics.slo import SloScaleUp
+
+SOAK_MINI = """
+[scenario]
+name = "soak-mini"
+seed = 11
+pods = 2
+
+[scenario.soak]
+epochs = 2
+base_pods = 2
+flash_prob = 0.0
+drift_rate = 0.0
+%s
+rss_growth_mib_per_epoch = 512.0
+fd_growth_per_epoch = 64.0
+row_growth_per_epoch = 16.0
+
+[[scenario.corpus]]
+id = "img"
+kind = "compressible"
+mib = 2
+
+[[scenario.phases]]
+op = "convert"
+corpus = ["img"]
+
+[[scenario.phases]]
+op = "deploy"
+corpus = ["img"]
+layers = 3
+
+[[scenario.phases]]
+op = "remove"
+fraction = 1.0
+
+[[scenario.phases]]
+op = "gc"
+
+[scenario.slo]
+demand_threshold_ms = 400.0
+demand_p95_factor = 3.0
+target = 0.5
+window_secs = 0.6
+burn_threshold = 3.0
+"""
+
+
+def soak_spec(soak_extra: str = "") -> sspec.ScenarioSpec:
+    return sspec.loads(SOAK_MINI % soak_extra)
+
+
+# ---------------------------------------------------------------------------
+# fit_slope
+# ---------------------------------------------------------------------------
+
+
+class TestFitSlope:
+    def test_short_series_is_zero(self):
+        assert sent.fit_slope([]) == 0.0
+        assert sent.fit_slope([7]) == 0.0
+        # 3 samples: the warm-up one is dropped, 2 remain -> still a fit
+        assert sent.fit_slope([100, 10, 10]) == 0.0
+
+    def test_two_samples_fit_directly(self):
+        assert sent.fit_slope([10, 14]) == pytest.approx(4.0)
+
+    def test_warmup_sample_dropped(self):
+        # A big allocation burst in epoch 0 must not read as a leak.
+        assert sent.fit_slope([1000, 10, 10, 10, 10]) == pytest.approx(0.0)
+
+    def test_linear_growth_recovered(self):
+        assert sent.fit_slope([0, 5, 8, 11, 14]) == pytest.approx(3.0)
+
+    def test_wider_warmup_excludes_ramp_epochs(self):
+        """A full-size soak spends ~2 epochs on per-shape JIT ramp: with
+        warmup=2 the fit ignores both, with the default it would not."""
+        ramp = [100, 300, 310, 312, 314]
+        assert sent.fit_slope(ramp) > 2.0 * sent.fit_slope(ramp, warmup=2)
+        assert sent.fit_slope(ramp, warmup=2) == pytest.approx(2.0)
+        # warmup wider than the series leaves the fit untouched
+        assert sent.fit_slope([5, 10], warmup=3) == pytest.approx(5.0)
+
+    def test_series_warmup_threads_into_slopes(self):
+        s = sent.SentinelSeries({"rss_bytes": 4.0}, warmup=2)
+        assert s.min_samples == 4  # clamped: 2 fitted points past warmup
+        for v in (100, 300, 310, 312):
+            s.sample({"rss_bytes": v})
+        assert s.report()["slopes"]["rss_bytes"] == pytest.approx(2.0)
+        assert s.check() == []
+
+
+# ---------------------------------------------------------------------------
+# Leak sentinels
+# ---------------------------------------------------------------------------
+
+
+class TestSentinel:
+    def test_healthy_process_stays_quiet(self):
+        s = sent.SentinelSeries({"open_fds": 8.0, "threads": 4.0})
+        for _ in range(4):
+            s.sample()
+        assert s.check() == []
+        rep = s.report()
+        assert rep["samples"] == 4 and rep["issues"] == []
+        assert "rss_bytes" in rep["slopes"]
+
+    def test_planted_fd_leak_detected(self):
+        """Open 6 fds per 'epoch' against a 2/epoch bound: the fitted
+        slope must cross the bound, the issue must name the series and
+        the ``ntpu_soak_leak_alerts_total`` counter must tick."""
+        before = sent.LEAK_ALERTS.value("open_fds")
+        s = sent.SentinelSeries({"open_fds": 2.0})
+        leaked = []
+        try:
+            for _ in range(5):
+                s.sample()
+                leaked.extend(os.open(os.devnull, os.O_RDONLY) for _ in range(6))
+            issues = s.check()
+            assert len(issues) == 1
+            assert "open_fds" in issues[0] and "leak sentinel" in issues[0]
+            assert sent.LEAK_ALERTS.value("open_fds") == before + 1
+        finally:
+            for fd in leaked:
+                os.close(fd)
+
+    def test_caller_series_gate_and_unbounded_track(self):
+        s = sent.SentinelSeries({"metastore_rows": 1.0})
+        for i in range(4):
+            s.sample({"metastore_rows": i * 10, "cache_entries": i * 100})
+        issues = s.check()
+        assert len(issues) == 1 and "metastore_rows" in issues[0]
+        # cache_entries grows too but carries no bound: reported, not fatal
+        assert s.report()["slopes"]["cache_entries"] > 0
+
+    def test_negative_sample_exempts_platform_gaps(self):
+        s = sent.SentinelSeries({"open_fds": 0.0})
+        for _ in range(4):
+            s.sample({"open_fds": -1})
+        assert s.check() == []
+
+    def test_below_min_samples_never_gates(self):
+        s = sent.SentinelSeries({"metastore_rows": 0.0}, min_samples=3)
+        s.sample({"metastore_rows": 0})
+        s.sample({"metastore_rows": 1000})
+        assert s.check() == []
+
+
+# ---------------------------------------------------------------------------
+# Closed-loop scale-up policy
+# ---------------------------------------------------------------------------
+
+
+class _Engine:
+    """Minimal SloEngine stand-in: a breach switch + event log."""
+
+    def __init__(self):
+        self.is_breached = False
+        self.events = []
+
+    def breached(self):
+        return self.is_breached
+
+    def record_event(self, kind, **detail):
+        self.events.append((kind, detail))
+
+
+def _policy(spawns, retires, engine=None, **kw):
+    kw.setdefault("queue_high", 2)
+    kw.setdefault("wait_high_ms", 10.0)
+    kw.setdefault("quiet_ticks", 2)
+    kw.setdefault("max_members", 2)
+    kw.setdefault("cooldown_ticks", 2)
+    state = {"press": {}}
+    policy = SloScaleUp(
+        engine,
+        demand_fn=lambda: state["press"],
+        spawn_fn=spawns.append,
+        retire_fn=retires.append,
+        clock=lambda: 0.0,
+        **kw,
+    )
+    return policy, state
+
+
+class TestSloScaleUp:
+    def test_hot_spawns_then_quiet_retires(self):
+        spawns, retires = [], []
+        policy, state = _policy(spawns, retires)
+        state["press"] = {"queued": 5, "wait_ms": 0.0}
+        ev = policy.tick()
+        assert ev["action"] == "spawn" and policy.members == 1
+        assert spawns == [1]
+        state["press"] = {"queued": 0, "wait_ms": 0.0}
+        assert policy.tick() is None  # quiet 1 of 2
+        ev = policy.tick()
+        assert ev["action"] == "retire" and policy.members == 0
+        assert retires == [0]
+        # idle at zero members: nothing to retire, nothing to spawn
+        assert policy.tick() is None
+
+    def test_wait_ewma_alone_is_hot(self):
+        spawns, retires = [], []
+        policy, state = _policy(spawns, retires, wait_high_ms=5.0)
+        state["press"] = {"queued": 0, "wait_ms": 6.0}
+        assert policy.tick()["action"] == "spawn"
+
+    def test_max_members_caps_growth(self):
+        spawns, retires = [], []
+        policy, state = _policy(spawns, retires, max_members=1)
+        state["press"] = {"queued": 9, "wait_ms": 99.0}
+        assert policy.tick()["action"] == "spawn"
+        assert policy.tick() is None and policy.members == 1
+
+    def test_breach_stands_down(self):
+        spawns, retires = [], []
+        engine = _Engine()
+        policy, state = _policy(spawns, retires, engine=engine)
+        state["press"] = {"queued": 9, "wait_ms": 99.0}
+        engine.is_breached = True
+        assert policy.tick() is None and spawns == []
+        engine.is_breached = False
+        ev = policy.tick()
+        assert ev["action"] == "spawn"
+        assert [k for k, _ in engine.events] == ["slo_scaleup_spawn"]
+
+    def test_breach_resets_quiet_progress(self):
+        spawns, retires = [], []
+        engine = _Engine()
+        policy, state = _policy(spawns, retires, engine=engine)
+        state["press"] = {"queued": 5, "wait_ms": 0.0}
+        policy.tick()  # spawn
+        state["press"] = {"queued": 0, "wait_ms": 0.0}
+        policy.tick()  # quiet 1 of 2
+        engine.is_breached = True
+        policy.tick()  # breach window: quiet progress is discarded
+        engine.is_breached = False
+        assert policy.tick() is None  # quiet 1 of 2 again
+        assert policy.tick()["action"] == "retire"
+
+    def test_spawn_failure_degrades_with_cooldown(self):
+        spawns, retires = [], []
+        policy, state = _policy(spawns, retires)
+
+        def bad_spawn(target):
+            raise OSError("no capacity")
+
+        policy.spawn_fn = bad_spawn
+        state["press"] = {"queued": 9, "wait_ms": 99.0}
+        ev = policy.tick()
+        assert ev["action"] == "spawn_failed" and "OSError" in ev["error"]
+        assert policy.members == 0
+        assert policy.tick() is None  # cooldown 1
+        assert policy.tick() is None  # cooldown 2
+        assert policy.tick()["action"] == "spawn_failed"  # retried, still down
+
+    def test_dead_demand_source_reads_as_calm(self):
+        spawns, retires = [], []
+        policy, state = _policy(spawns, retires)
+
+        def boom():
+            raise RuntimeError("signal source gone")
+
+        policy.demand_fn = boom
+        assert policy.tick() is None and spawns == []
+
+    def test_armed_scaleup_failpoint_is_shed_only(self):
+        """The chaos contract: ``soak.scaleup`` armed -> every spawn
+        attempt records ``spawn_failed``, members never grow, and tick
+        never raises (the fleet keeps its pre-scale-up behaviour)."""
+        spawns, retires = [], []
+        policy, state = _policy(spawns, retires, cooldown_ticks=0)
+        state["press"] = {"queued": 9, "wait_ms": 99.0}
+        with failpoint.injected("soak.scaleup", "error(OSError)"):
+            for _ in range(4):
+                ev = policy.tick()
+                assert ev["action"] == "spawn_failed"
+        assert policy.members == 0 and spawns == []
+        assert policy.state()["members"] == 0
+        assert {e["action"] for e in policy.state()["events"]} == {"spawn_failed"}
+        # failpoint cleared: the same pressure now scales up
+        assert policy.tick()["action"] == "spawn"
+
+
+# ---------------------------------------------------------------------------
+# The soak loop
+# ---------------------------------------------------------------------------
+
+
+class TestSoakRun:
+    def test_runner_requires_soak_table(self):
+        d = soak_spec().to_dict()
+        d["scenario"].pop("soak")
+        plain = sspec.ScenarioSpec.from_dict(d)
+        with pytest.raises(ScenarioRunError, match="scenario.soak"):
+            SoakRunner(plain, "/tmp/unused")
+
+    def test_serial_mini_soak_and_replay_identity(self, tmp_path):
+        spec = soak_spec()
+        runner = SoakRunner(spec, str(tmp_path / "soak"), serial=True)
+        try:
+            report = runner.run_soak()
+        finally:
+            runner.close()
+        assert report["ok"], report["error"]
+        assert report["mode"] == "soak"
+        assert len(report["epochs"]) == 2
+        for ep in report["epochs"]:
+            assert ep["audit"]["clean"], ep["audit"]["issues"]
+            assert ep["retired_blobs"] >= 0
+            assert set(ep["fingerprint"]) == {"reads", "blobs"}
+        assert [w["epoch"] for w in report["waves"]] == [0, 1]
+        assert report["sentinel"]["issues"] == []
+        assert "scaleup" not in report  # serial runs never scale
+
+        # Identity oracle: a fresh runner re-deriving epoch 1 alone must
+        # land on byte-identical reads and blob ids.
+        replay = replay_epoch(spec, 1, str(tmp_path / "replay"))
+        assert replay["ok"]
+        assert replay["fingerprint"] == report["epochs"][1]["fingerprint"]
+
+    @pytest.mark.parametrize("site", ["soak.wave", "soak.evolve"])
+    def test_epoch_entry_faults_fail_loudly(self, tmp_path, site):
+        """``soak.wave`` / ``soak.evolve`` armed -> the run reports the
+        failing epoch instead of wedging or silently skipping it."""
+        runner = SoakRunner(soak_spec(), str(tmp_path / "soak"), serial=True)
+        try:
+            with failpoint.injected(site, "error(OSError)"):
+                report = runner.run_soak()
+        finally:
+            runner.close()
+        assert not report["ok"]
+        assert "epoch 0" in report["error"] and "OSError" in report["error"]
+        assert report["epochs"] == []
+
+    def test_soak_survives_armed_scaleup(self, tmp_path):
+        """End-to-end chaos: a concurrent soak whose scale-up trigger is
+        forced hot, with the spawn path failing every attempt — the run
+        must complete clean on base capacity (shed-only degrade)."""
+        spec = soak_spec(
+            "queue_high = 1\nwait_high_ms = 0.0001\nmax_extra_members = 1\n"
+        )
+        runner = SoakRunner(spec, str(tmp_path / "soak"), serial=False)
+        try:
+            with failpoint.injected("soak.scaleup", "error(OSError)"):
+                report = runner.run_soak()
+        finally:
+            runner.close()
+        assert report["ok"], report["error"]
+        assert report["scaleup"]["members"] == 0
+        actions = {e["action"] for e in report["scaleup"]["events"]}
+        assert actions == {"spawn_failed"}
+        assert all(ep["audit"]["clean"] for ep in report["epochs"])
+
+
+# ---------------------------------------------------------------------------
+# Runtime config resolution
+# ---------------------------------------------------------------------------
+
+
+class TestResolveSoakConfig:
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("NTPU_SOAK_EPOCHS", "12")
+        monkeypatch.setenv("NTPU_SOAK_SPOT_EPOCHS", "5")
+        monkeypatch.setenv("NTPU_SOAK_REPORT", "/tmp/r.json")
+        cfg = resolve_soak_config()
+        assert cfg.epochs == 12
+        assert cfg.spot_epochs == 5
+        assert cfg.report_path == "/tmp/r.json"
+
+    def test_defaults(self, monkeypatch):
+        for var in ("NTPU_SOAK_EPOCHS", "NTPU_SOAK_SPOT_EPOCHS",
+                    "NTPU_SOAK_REPORT"):
+            monkeypatch.delenv(var, raising=False)
+        cfg = resolve_soak_config()
+        assert cfg.epochs == 0  # 0 = use the spec's epoch count
+        assert cfg.spot_epochs >= 1
+        assert cfg.report_path.endswith("SOAK_r01.json")
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

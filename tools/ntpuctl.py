@@ -16,6 +16,8 @@ that process alone.
     ntpuctl trace 5ce100000001          # one merged cross-process tree
     ntpuctl top                         # scoreboard, refreshed in place
     ntpuctl scenario                    # spec catalog + last storm gates
+    ntpuctl soak                        # soak specs + last endurance gates
+    ntpuctl dict demote 0               # planned primary handoff, shard 0
     ntpuctl --sock /run/.../d1.sock blobcache
     ntpuctl --json members              # machine-readable everything
 
@@ -270,7 +272,43 @@ def _member_ha_status(address: str, timeout: float):
         return None
 
 
+def _dict_demote(args) -> int:
+    """Planned rolling demotion of one shard's primary: the controller
+    drains it (merges stop, replicas catch the frozen journal head,
+    hand-off, THEN demote) — zero client-visible errors by design."""
+    shard = args.shard
+    if shard is None:
+        raise CtlError("usage: ntpuctl dict demote <shard>")
+    body = json.dumps({"shard": int(shard)}).encode()
+    try:
+        status, resp = udshttp.request(
+            args.sock, "/api/v1/fleet/placement/demote", method="POST",
+            body=body, headers={"Content-Type": "application/json"},
+            # A drain waits for replica catch-up; give it longer than
+            # the default introspection timeout.
+            timeout=max(args.timeout, 30.0),
+        )
+    except OSError as e:
+        raise CtlError(f"cannot reach {args.sock}: {e}") from e
+    text = resp[:400].decode(errors="replace")
+    if status == 404:
+        raise CtlError("no placement controller here — point --sock at the "
+                       "controller with the dict-HA plane attached")
+    if status != 200:
+        raise CtlError(f"demote shard {shard} -> {status}: {text}")
+    payload = json.loads(text)
+    _emit(
+        args, payload,
+        f"shard {payload.get('shard', shard)}: "
+        f"{payload.get('from', '?')} -> {payload.get('to', '?')} "
+        f"(applied {payload.get('applied_chunks', '?')} chunks)",
+    )
+    return 0
+
+
 def cmd_dict(args) -> int:
+    if getattr(args, "action", None) == "demote":
+        return _dict_demote(args)
     placement = _get(args.sock, "/api/v1/fleet/placement", args.timeout)
     if placement is not None:
         # Against a controller with the dict-HA plane attached: the
@@ -516,6 +554,80 @@ def cmd_scenario(args) -> int:
     return 0
 
 
+def cmd_soak(args) -> int:
+    """Soak-engine view: soak-capable specs in the catalog + the last
+    banked endurance report. Filesystem-backed like ``scenario`` —
+    soaks are driven by tools/soak_profile.py, not a live daemon."""
+    from nydus_snapshotter_tpu.scenario import resolve_scenario_config
+    from nydus_snapshotter_tpu.scenario.soak import resolve_soak_config
+    from nydus_snapshotter_tpu.scenario.spec import list_specs
+
+    scfg = resolve_scenario_config()
+    cfg = resolve_soak_config()
+    listed = list_specs(args.spec_dir or scfg.spec_dir)
+    payload = {
+        "spec_dir": args.spec_dir or scfg.spec_dir,
+        "specs": [],
+        "report": None,
+    }
+    rows = []
+    for path, spec, err in listed:
+        if spec is None or spec.soak is None:
+            continue
+        name = os.path.basename(path)
+        sk = spec.soak
+        payload["specs"].append({
+            "file": name, "name": spec.name, "seed": spec.seed,
+            "soak": sk.to_dict(), "description": spec.description,
+        })
+        rows.append([
+            name, spec.name, sk.epochs, sk.base_pods,
+            f"{sk.flash_prob:.2f}", f"{sk.drift_rate:.2f}",
+            "on" if sk.scaleup else "off",
+        ])
+    human = _table(rows, [
+        "FILE", "SOAK", "EPOCHS", "BASE-PODS", "FLASH-P", "DRIFT", "SCALE-UP",
+    ]) if rows else f"no soak-capable specs in {payload['spec_dir']}"
+
+    report_path = args.report or cfg.report_path
+    if os.path.exists(report_path):
+        try:
+            with open(report_path) as f:
+                report = json.load(f)
+        except ValueError as e:
+            raise CtlError(f"unreadable report {report_path}: {e}") from e
+        payload["report"] = report
+        gates = report.get("gates_failed", [])
+        sent = report.get("sentinel", {})
+        eff = report.get("scaleup_efficacy", {})
+        spots = report.get("spot_checks", [])
+        human += (
+            f"\n\nlast soak ({os.path.basename(report_path)}): "
+            f"{report.get('scenario', '?')} — {report.get('epochs', '?')}/"
+            f"{report.get('epochs_planned', '?')} epochs in "
+            f"{report.get('soak_wall_s', '?')}s [{report.get('mode', '?')}]"
+            f"\n  sentinel slopes: {sent.get('slopes', {})}"
+            f"\n  scale-up: {eff.get('spawn_events', 0)} spawn(s)"
+            + (
+                f", A/B epoch {eff['epoch']}: p95 {eff['p95_ms_with']}ms with "
+                f"{eff['extra_serve_pods']} extra vs {eff['p95_ms_without']}ms without"
+                if "epoch" in eff else ""
+            )
+            + f"\n  spot checks: "
+            + (
+                " ".join(
+                    f"e{s['epoch']}={'ok' if s['identical'] else 'DIVERGED'}"
+                    for s in spots
+                ) or "none"
+            )
+            + "\n  gates: " + ("ALL PASS" if not gates else "; ".join(gates))
+        )
+    else:
+        human += f"\n\nno banked report at {report_path}"
+    _emit(args, payload, human)
+    return 0
+
+
 def cmd_top(args) -> int:
     iterations = args.iterations
     n = 0
@@ -573,7 +685,11 @@ def main(argv=None) -> int:
     sub.add_parser("blobcache")
     sub.add_parser("peers")
     sub.add_parser("soci")
-    sub.add_parser("dict")
+    dct = sub.add_parser("dict")
+    dct.add_argument("action", nargs="?", default=None,
+                     help="optional action: demote")
+    dct.add_argument("shard", nargs="?", default=None,
+                     help="shard index (for demote)")
     sub.add_parser("slo")
     tr = sub.add_parser("trace")
     tr.add_argument("trace_id")
@@ -586,6 +702,11 @@ def main(argv=None) -> int:
                      help="spec catalog dir (default: [scenario] config)")
     scn.add_argument("--report", default="",
                      help="gate-report JSON (default: [scenario] config)")
+    soak = sub.add_parser("soak")
+    soak.add_argument("--spec-dir", default="",
+                      help="spec catalog dir (default: [scenario] config)")
+    soak.add_argument("--report", default="",
+                      help="soak-report JSON (default: [soak] config)")
     args = ap.parse_args(argv)
 
     handlers = {
@@ -599,6 +720,7 @@ def main(argv=None) -> int:
         "trace": cmd_trace,
         "top": cmd_top,
         "scenario": cmd_scenario,
+        "soak": cmd_soak,
     }
     try:
         return handlers[args.cmd](args)

@@ -32,7 +32,10 @@ spec SERIALLY with faults disarmed — the oracle — and gates
   unaccounted cache entries, no staging leftovers;
 - **real-vs-real** — the cross-tree dedup ratio (second real-derived
   tree vs tree1's real-bootstrap dict) is measured and banked with its
-  content-synthesis caveat.
+  content-synthesis caveat;
+- **leak sentinel** — storm-scoped fd/thread growth, fitted across the
+  reps with the soak engine's shared measurement core
+  (``scenario/sentinel.py``), stays within per-run bounds.
 
 Usage: python tools/scenario_storm.py [--spec misc/scenarios/worst_day.toml]
            [--pods N] [--reps 2] [--out SCENARIO_STORM_r01.json] [--json]
@@ -143,10 +146,16 @@ def _unloaded_p95(spec, pods: int, reps: int) -> dict:
 def profile(spec_path: str, pods: int = 0, reps: int = 2) -> dict:
     from nydus_snapshotter_tpu.scenario.corpus import cross_tree_dedup
     from nydus_snapshotter_tpu.scenario.orchestrator import ScenarioRunner
+    from nydus_snapshotter_tpu.scenario.sentinel import SentinelSeries
     from nydus_snapshotter_tpu.scenario.spec import load_spec
 
     spec = load_spec(spec_path)
     gates: list[str] = []
+    # Storm-scoped leak sentinel (shared with the soak engine): one
+    # sample before the reps, one after each run — a storm that leaks
+    # fds or threads per rep fails the gate even when identity holds.
+    sentinel = SentinelSeries({"open_fds": 8.0, "threads": 4.0})
+    sentinel.sample()
     workroot = tempfile.mkdtemp(prefix="scenario-storm-")
     try:
         # Concurrent chaos runs: ``reps`` full storms, p95 best-rep
@@ -181,6 +190,7 @@ def profile(spec_path: str, pods: int = 0, reps: int = 2) -> dict:
                 corrupt_served = storm.corrupt_served
                 after = _codec_counters()
             storm.close()
+            sentinel.sample()
         storm_p95 = min(storm_p95s)
 
         # Serial oracle: same spec, pods sequential, workers serial,
@@ -195,6 +205,8 @@ def profile(spec_path: str, pods: int = 0, reps: int = 2) -> dict:
         oracle_fp = oracle.fingerprint()
         oracle_audit = oracle.audit()
         oracle.close()
+        sentinel.sample()
+        gates.extend(sentinel.check())
         if not oracle_report["ok"]:
             gates.append(f"serial replay failed: {oracle_report['error']}")
 
@@ -288,6 +300,7 @@ def profile(spec_path: str, pods: int = 0, reps: int = 2) -> dict:
                 "gate": spec.slo.demand_p95_factor,
             },
             "cross_tree_dedup": dedup,
+            "sentinel": sentinel.report(),
             "gates_failed": gates,
         }
     finally:

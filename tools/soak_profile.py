@@ -1,0 +1,338 @@
+"""Soak profile: the gated "production year" endurance run.
+
+Loads a soak-capable scenario spec (default ``misc/scenarios/
+soak.toml``: 104 epochs of the seeded Poisson x diurnal x flash-crowd
+arrival process over a drift-aged real-tree corpus), drives the full
+stack through continuous convert/deploy/read/remove/GC churn with the
+leak sentinels and the closed-loop capacity policy armed, and gates
+(abort-on-fail, per ISSUE 16 acceptance):
+
+- **audit drift** — the per-epoch end-state audit is clean on EVERY
+  epoch (the soak runner already fails the run on the first dirty one);
+- **leak sentinels** — fitted per-epoch growth of RSS / fds / metastore
+  rows stays within the spec's bounds across the whole soak;
+- **identity spot-checks** — ``spot_epochs`` epochs (first, a flash
+  crowd if the schedule has one, last) are replayed standalone in a
+  fresh SERIAL runner; read digests and blob ids must be byte-identical
+  to the soak's in-flight fingerprints (arrivals and corpus evolution
+  are pure in ``(seed, epoch)``, so any divergence is a concurrency
+  bug, not noise);
+- **flash-crowd p95** — demand p95 across the soak stays within
+  ``demand_p95_factor``x the paired best-rep unloaded baseline (same
+  discipline as the worst-day storm gate);
+- **scale-up efficacy** — the policy fired at least one spawn, and the
+  soak's deepest-queue epoch, replayed WITH and WITHOUT the serve
+  members the policy provisioned (same seed, same epoch, same load,
+  same origin-latency floor — a controlled A/B), shows the scaled arm
+  cutting the node gate's peak demand-queue depth and holding read p95
+  at or below the unscaled arm's (and the soak retired back to zero
+  members by quiet end or the policy state says why);
+- **capacity model** — pods / serve-members / demand GiB/s per epoch
+  are banked as a pods-per-GiB/s table for fleet sizing.
+
+Usage: python tools/soak_profile.py [--spec misc/scenarios/soak.toml]
+           [--epochs N] [--reps 2] [--out SOAK_r01.json] [--json] [--mini]
+
+``--mini`` is the CI smoke shape (soak-smoke job): it skips the paired
+A/B rerun and the unloaded baseline (the wall budget is ~90 s) but
+keeps every in-run gate — audit, sentinels, spot-check identity, one
+scale-up cycle.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Same analytic latency floor as the worst-day storm: demand reads are
+# dominated by the deterministic origin RTT, not this box's CPU
+# time-sharing, so the p95 ratio measures queueing, not GIL noise.
+ORIGIN_LATENCY_S = 0.06
+
+
+def _spot_epochs(report: dict, want: int) -> list:
+    """Which epochs to replay serially: first, last, and the earliest
+    flash crowd in between (the interesting one), up to ``want``."""
+    ran = [e["epoch"] for e in report["epochs"]]
+    if not ran:
+        return []
+    picks = [ran[0]]
+    flash = [e["epoch"] for e in report["epochs"] if e["wave"]["flash"]]
+    for cand in (flash + [ran[-1]]):
+        if cand not in picks:
+            picks.append(cand)
+    return picks[: max(1, want)]
+
+
+def _epoch_detail(report: dict, epoch: int) -> dict:
+    return next(e for e in report["epochs"] if e["epoch"] == epoch)
+
+
+def _gib_s(detail: dict) -> float:
+    """Demand throughput of one epoch: bytes the wave's pods pulled over
+    the epoch's deploy+read wall."""
+    dep = detail.get("deploy", {})
+    wall = detail.get("wall_s", 0.0)
+    return (dep.get("demand_bytes", 0) / (1 << 30) / wall) if wall else 0.0
+
+
+def profile(
+    spec_path: str,
+    epochs: int = 0,
+    reps: int = 2,
+    mini: bool = False,
+) -> dict:
+    from nydus_snapshotter_tpu.scenario.soak import (
+        SoakRunner,
+        replay_epoch,
+        resolve_soak_config,
+    )
+    from nydus_snapshotter_tpu.scenario.spec import load_spec
+
+    spec = load_spec(spec_path)
+    if spec.soak is None:
+        raise SystemExit(f"{spec_path}: spec has no [scenario.soak] table")
+    cfg = resolve_soak_config()
+    n_epochs = epochs or cfg.epochs or spec.soak.epochs
+    gates: list[str] = []
+    workroot = tempfile.mkdtemp(prefix="soak-profile-")
+    try:
+        t0 = time.perf_counter()
+        runner = SoakRunner(
+            spec, os.path.join(workroot, "soak"), serial=False,
+            epochs=n_epochs,
+            origin_latency_s=0.0 if mini else ORIGIN_LATENCY_S,
+        )
+        report = runner.run_soak()
+        soak_wall = time.perf_counter() - t0
+        soak_p95 = runner.demand_p95_ms()
+        runner.close()
+        if not report["ok"]:
+            gates.append(f"soak failed: {report['error']}")
+        for e in report["epochs"]:
+            if not e["audit"]["clean"]:
+                gates.append(
+                    f"epoch {e['epoch']} audit dirty: {e['audit']['issues'][:2]}"
+                )
+        gates.extend(report["sentinel"]["issues"])
+
+        # Identity spot-checks: standalone serial replays of picked
+        # epochs against the soak's in-flight fingerprints.
+        spots = []
+        for e in _spot_epochs(report, cfg.spot_epochs):
+            out = replay_epoch(
+                spec, e, os.path.join(workroot, f"spot{e}"), serial=True
+            )
+            want = _epoch_detail(report, e)["fingerprint"]
+            ok = out["fingerprint"] == want
+            spots.append({"epoch": e, "identical": ok})
+            if not ok:
+                diffs = [
+                    k for k in want if out["fingerprint"].get(k) != want[k]
+                ]
+                gates.append(
+                    f"epoch {e} serial replay diverges in {diffs}"
+                )
+
+        # Scale-up efficacy: the crowd the policy reacts to is the
+        # deepest-queue epoch — replay THAT epoch with and without the
+        # members the policy provisioned (identical seeded load, same
+        # origin-latency floor) and require the scaled arm to cut the
+        # node gate's peak demand queue without hurting read p95. (The
+        # first SCALED epoch is usually the calm follower of the crowd
+        # — nothing queues there either way, so it can't show relief.)
+        scaleup = report.get("scaleup", {})
+        spawns = [
+            ev for ev in scaleup.get("events", []) if ev["action"] == "spawn"
+        ]
+        efficacy: dict = {"spawn_events": len(spawns)}
+        scaled = [
+            e for e in report["epochs"] if e.get("extra_serve_pods", 0) > 0
+        ]
+        if spec.soak.scaleup:
+            if not spawns:
+                gates.append("scale-up policy never spawned a member")
+            elif not mini:
+                hot = max(
+                    report["epochs"],
+                    key=lambda e: e["demand_pressure"].get("queued_peak", 0),
+                )
+                probe = hot["epoch"]
+                extra = max(
+                    (e.get("extra_serve_pods", 0) for e in report["epochs"]),
+                    default=0,
+                ) or spec.soak.max_extra_members
+                with_p = replay_epoch(
+                    spec, probe, os.path.join(workroot, "ab-with"),
+                    serial=False, extra_serve_pods=extra,
+                    origin_latency_s=ORIGIN_LATENCY_S,
+                )
+                without = replay_epoch(
+                    spec, probe, os.path.join(workroot, "ab-without"),
+                    serial=False, extra_serve_pods=0,
+                    origin_latency_s=ORIGIN_LATENCY_S,
+                )
+                peak_with = with_p["demand_pressure"].get("queued_peak", 0)
+                peak_without = without["demand_pressure"].get("queued_peak", 0)
+                efficacy.update({
+                    "epoch": probe,
+                    "extra_serve_pods": extra,
+                    "p95_ms_with": with_p["demand_p95_ms"],
+                    "p95_ms_without": without["demand_p95_ms"],
+                    "queued_peak_with": peak_with,
+                    "queued_peak_without": peak_without,
+                    "wait_ms_with": with_p["demand_pressure"].get("wait_ms", 0.0),
+                    "wait_ms_without": without["demand_pressure"].get("wait_ms", 0.0),
+                })
+                if peak_without > 0 and peak_with >= peak_without:
+                    gates.append(
+                        f"scale-up A/B: epoch {probe} with {extra} extra "
+                        f"member(s) peak demand queue {peak_with} vs "
+                        f"{peak_without} without — scale-up did not relieve "
+                        "the admission queue"
+                    )
+                if with_p["demand_p95_ms"] > without["demand_p95_ms"] * 1.1:
+                    gates.append(
+                        f"scale-up A/B: epoch {probe} with {extra} extra "
+                        f"member(s) read p95 {with_p['demand_p95_ms']}ms vs "
+                        f"{without['demand_p95_ms']}ms without — scale-up "
+                        "made demand latency worse"
+                    )
+            if scaleup.get("members", 0) > 0:
+                last_hot = scaled[-1]["epoch"] if scaled else -1
+                if last_hot < report["epochs_planned"] - spec.soak.quiet_epochs - 1:
+                    gates.append(
+                        f"scale-up never retired: {scaleup.get('members')} "
+                        "member(s) still up at soak end with a quiet tail"
+                    )
+
+        # Flash-crowd p95 vs the paired unloaded baseline.
+        demand_p95: dict = {"soak_ms": soak_p95}
+        if not mini:
+            from tools.scenario_storm import _unloaded_p95
+
+            unloaded = _unloaded_p95(spec, spec.pods, reps)
+            ratio = soak_p95 / max(1e-9, unloaded["best_p95_ms"])
+            demand_p95.update({
+                "unloaded": unloaded,
+                "ratio": round(ratio, 3),
+                "gate": spec.slo.demand_p95_factor,
+            })
+            if ratio > spec.slo.demand_p95_factor:
+                gates.append(
+                    f"demand p95 across soak {ratio:.2f}x unloaded "
+                    f"(gate {spec.slo.demand_p95_factor}x)"
+                )
+
+        # Capacity model: per-epoch serve capacity vs demand throughput.
+        cores = os.cpu_count() or 1
+        capacity = []
+        for e in report["epochs"]:
+            gib_s = _gib_s(e)
+            servers = e["wave"]["pods"] + e.get("extra_serve_pods", 0)
+            capacity.append({
+                "epoch": e["epoch"],
+                "pods": e["wave"]["pods"],
+                "servers": servers,
+                "flash": e["wave"]["flash"],
+                "gib_s": round(gib_s, 4),
+                "pods_per_gib_s": round(servers / gib_s, 2) if gib_s else 0.0,
+                "cores_per_gib_s": round(cores / gib_s, 2) if gib_s else 0.0,
+            })
+
+        return {
+            "spec": os.path.relpath(spec_path, REPO),
+            "scenario": spec.name,
+            "mode": "mini" if mini else "full",
+            "seed": spec.seed,
+            "epochs": len(report["epochs"]),
+            "epochs_planned": n_epochs,
+            "soak_wall_s": round(soak_wall, 3),
+            "origin_latency_ms": (0.0 if mini else ORIGIN_LATENCY_S * 1000),
+            "waves": report["waves"],
+            "slo": report.get("slo", {}),
+            "sentinel": report["sentinel"],
+            "scaleup": scaleup,
+            "scaleup_efficacy": efficacy,
+            "spot_checks": spots,
+            "demand_p95": demand_p95,
+            "capacity": capacity,
+            "origin": report["origin"],
+            "gates_failed": gates,
+        }
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--spec",
+        default=os.path.join(REPO, "misc", "scenarios", "soak.toml"),
+        help="soak-capable scenario spec (needs a [scenario.soak] table)",
+    )
+    ap.add_argument("--epochs", type=int, default=0,
+                    help="override the spec's epoch count (0 = spec's)")
+    ap.add_argument("--reps", type=int, default=2,
+                    help="unloaded-baseline paired reps (best taken)")
+    ap.add_argument("--out", default="",
+                    help="bank the report JSON here (e.g. SOAK_r01.json)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--mini", action="store_true",
+                    help="CI smoke shape: skip the A/B rerun + unloaded baseline")
+    args = ap.parse_args()
+
+    report = profile(
+        args.spec, epochs=args.epochs, reps=args.reps, mini=args.mini
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(
+            f"soak {report['scenario']}: {report['epochs']}/"
+            f"{report['epochs_planned']} epochs in {report['soak_wall_s']}s "
+            f"({report['mode']})"
+        )
+        s = report["sentinel"]
+        print(f"sentinel: {s['samples']} samples, slopes {s['slopes']}")
+        print(
+            f"scale-up: {report['scaleup_efficacy'].get('spawn_events', 0)} "
+            f"spawn(s), efficacy {report['scaleup_efficacy']}"
+        )
+        print(f"spot checks: {report['spot_checks']}")
+        if "ratio" in report["demand_p95"]:
+            p = report["demand_p95"]
+            print(
+                f"demand p95: soak {p['soak_ms']}ms = {p['ratio']}x unloaded "
+                f"(gate {p['gate']}x)"
+            )
+        worst = max(
+            (c for c in report["capacity"] if c["gib_s"]),
+            key=lambda c: c["pods_per_gib_s"],
+            default=None,
+        )
+        if worst:
+            print(
+                f"capacity: worst epoch {worst['epoch']} needs "
+                f"{worst['pods_per_gib_s']} pods/GiB/s "
+                f"({worst['cores_per_gib_s']} cores/GiB/s)"
+            )
+    for g in report["gates_failed"]:
+        print(f"FAIL: {g}", file=sys.stderr)
+    return 1 if report["gates_failed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
