@@ -1009,6 +1009,44 @@ def compression_adaptive_run(repo: str, timeout: float = 240.0) -> dict:
         return {"error": "compression profile produced no JSON"}
 
 
+_VECTORIZED_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from tools.compression_profile import batched_profile, vectorized_profile
+out = {{}}
+try:
+    out["scan"] = vectorized_profile(mib=12, reps=2)
+except Exception as e:
+    out["scan"] = {{"error": str(e)[:200]}}
+try:
+    out["batch"] = batched_profile(mib=12, reps=3)
+except Exception as e:
+    out["batch"] = {{"error": str(e)[:200]}}
+print(json.dumps(out))
+"""
+
+
+def compression_vectorized_run(repo: str, timeout: float = 240.0) -> dict:
+    """Vectorized-scan + batched-lane gates (tools/compression_profile.py
+    --vectorized --batched) in a watchdogged child: cut/frame identity
+    aborts inside the child, so a diverging kernel surfaces as an error
+    row here instead of silently banking a wrong-output speedup."""
+    res = _run_child_watchdog(
+        [sys.executable, "-c", _VECTORIZED_CHILD.format(repo=repo)],
+        timeout=timeout,
+    )
+    if res is None:
+        return {"error": f"vectorized profile hung >{timeout:.0f}s (watchdog killed it)"}
+    rc, stdout, stderr = res
+    if rc != 0:
+        tail = stderr.strip().splitlines()[-1] if stderr.strip() else ""
+        return {"error": f"vectorized profile exited rc={rc}: {tail}"[:200]}
+    try:
+        return json.loads(stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": "vectorized profile produced no JSON"}
+
+
 def _device_available(repo: str, timeout: float = 120.0) -> tuple[bool, str]:
     """(ok, note) — probe jax.devices() in a subprocess under the hard
     watchdog (_run_child_watchdog): a wedged device tunnel must degrade
@@ -1259,6 +1297,9 @@ def main() -> None:
     # Adaptive-codec engine numbers ride under detail.compression next
     # to the per-codec economics they change.
     compression_economics["adaptive"] = compression_adaptive_run(repo)
+    # Vectorized scan + batched codec lane: identity-gated best-rep
+    # ratios and ns/byte bounds for the two compression-wall kernels.
+    compression_economics["vectorized"] = compression_vectorized_run(repo)
 
     print(
         json.dumps(

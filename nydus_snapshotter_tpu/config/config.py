@@ -158,12 +158,22 @@ class CompressionConfig:
     service. OFF by default: pack output stays byte-identical to the
     reference lane. Enabling trained dictionaries is a chunk-frame
     format change — frames carry a versioned ``nZD1`` header and readers
-    without the dictionary fail loudly. Environment variables override
-    per-process (``NTPU_COMPRESS_ADAPTIVE``, ``NTPU_COMPRESS_PROBE``,
+    without the dictionary fail loudly.
+
+    Two throughput knobs ride in this section because both are resolved
+    with the codec config and both hold byte-identity: ``batch_chunks``
+    sets how many queued chunks a pipeline compress worker drains into
+    ONE GIL-released native batch-encode call (0/1 = per-chunk), and
+    ``vectorized`` picks the CDC scan arm — ``auto`` uses the SIMD
+    lane-parallel table scanner when built, ``on`` requires it, ``off``
+    forces the sequential scanner; cut positions are identical across
+    arms. Environment variables override per-process
+    (``NTPU_COMPRESS_ADAPTIVE``, ``NTPU_COMPRESS_PROBE``,
     ``NTPU_COMPRESS_PROBE_SAMPLE_KIB``, ``NTPU_COMPRESS_BYPASS_RATIO``,
     ``NTPU_COMPRESS_DICT``, ``NTPU_COMPRESS_TRAIN``,
-    ``NTPU_COMPRESS_LEVELS`` — "fast,default,best" triple) — that is
-    also how the section reaches spawned converter processes.
+    ``NTPU_COMPRESS_LEVELS`` — "fast,default,best" triple,
+    ``NTPU_COMPRESS_BATCH_CHUNKS``, ``NTPU_COMPRESS_VECTORIZED``) — that
+    is also how the section reaches spawned converter processes.
     """
 
     adaptive: bool = False
@@ -179,6 +189,8 @@ class CompressionConfig:
     train: bool = False
     train_dict_kib: int = 112
     train_sample_mib: int = 8
+    batch_chunks: int = 16  # compress-worker batch size (0/1 = per-chunk)
+    vectorized: str = "auto"  # auto | on | off — CDC scan arm
 
 
 @dataclass
@@ -643,6 +655,15 @@ class SnapshotterConfig:
         if self.compression.train_dict_kib < 1 or self.compression.train_sample_mib < 1:
             raise ConfigError(
                 "compression.train_dict_kib/train_sample_mib must be >= 1"
+            )
+        if self.compression.batch_chunks < 0:
+            raise ConfigError(
+                "compression.batch_chunks must be >= 0 (0/1 = per-chunk)"
+            )
+        if self.compression.vectorized not in ("auto", "on", "off"):
+            raise ConfigError(
+                f"invalid compression.vectorized "
+                f"{self.compression.vectorized!r} (auto | on | off)"
             )
         if self.blobcache.fetch_workers < 1:
             raise ConfigError("blobcache.fetch_workers must be >= 1")

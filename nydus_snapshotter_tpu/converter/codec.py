@@ -99,6 +99,19 @@ TRAIN_TOTAL = _reg.register(
         ("outcome",),
     )
 )
+BATCH_TOTAL = _reg.register(
+    _metrics.Counter(
+        "ntpu_compress_batch_total",
+        "Batched encode calls served by the native batch lane "
+        "(one GIL-released ntpu_encode_batch call per level group)",
+    )
+)
+BATCH_CHUNKS = _reg.register(
+    _metrics.Counter(
+        "ntpu_compress_batch_chunks_total",
+        "Chunks whose zstd frame came out of the native batch lane",
+    )
+)
 
 
 class CodecError(RuntimeError):
@@ -141,6 +154,17 @@ class CodecConfig:
     train: bool = False  # train per-namespace during batch convert
     train_dict_kib: int = 112
     train_sample_mib: int = 8
+    # Batched codec lane: how many chunks a pipeline compress worker may
+    # drain into one encode_batch() call (0 disables draining — every
+    # chunk goes through encode() alone). Output is byte-identical either
+    # way; the batch only changes how many frames one GIL-released native
+    # call produces.
+    batch_chunks: int = 16
+    # Vectorized CDC scan: auto = use the SIMD lane-parallel scanner when
+    # the native library exposes it, on = require it (loud failure when
+    # absent), off = always the sequential gear scanner. Cut positions
+    # are identical across all three — this is purely a throughput knob.
+    vectorized: str = "auto"
 
     # Chunks below this size skip the probe (probe overhead beats any
     # possible saving) and compress at the default level.
@@ -170,6 +194,15 @@ def _env_int(name: str, default: int) -> int:
     try:
         v = int(os.environ.get(name, ""))
         return v if v > 0 else default
+    except ValueError:
+        return default
+
+
+def _env_int0(name: str, default: int) -> int:
+    """Like :func:`_env_int` but 0 is a valid (disabling) value."""
+    try:
+        v = int(os.environ.get(name, ""))
+        return v if v >= 0 else default
     except ValueError:
         return default
 
@@ -216,7 +249,15 @@ def resolve_codec_config() -> CodecConfig:
         level_fast=getattr(c, "level_fast", 1),
         level_default=getattr(c, "level_default", 0),
         level_best=getattr(c, "level_best", 3),
+        batch_chunks=_env_int0(
+            "NTPU_COMPRESS_BATCH_CHUNKS", getattr(c, "batch_chunks", 16)
+        ),
+        vectorized=_env_str(
+            "NTPU_COMPRESS_VECTORIZED", getattr(c, "vectorized", "") or "auto"
+        ),
     )
+    if cfg.vectorized not in ("auto", "on", "off"):
+        cfg.vectorized = "auto"
     levels = os.environ.get("NTPU_COMPRESS_LEVELS", "")
     if levels:
         try:
@@ -626,14 +667,11 @@ class AdaptiveCodec:
             self.counts[cls] += 1
             self.class_bytes[cls] += n
 
-    def encode(self, data) -> tuple[bytes, int]:
-        """One chunk → ``(payload, chunk_compressor_flag)``.
-
-        The pipeline's speculative compress workers and the serial
-        assembler both call exactly this; determinism in content keeps
-        them byte-identical.
-        """
-        failpoint.hit("compress.encode")
+    def _plan(self, data) -> tuple[str, Optional[int]]:
+        """Shared per-chunk front half of :meth:`encode` and
+        :meth:`encode_batch`: trainer offer, classification, class
+        accounting. Returns ``(cls, level)``; ``level is None`` means the
+        store-raw bypass already decided the chunk."""
         n = len(data)
         if self._trainer is not None and self.trained is None:
             self._trainer.offer(data)
@@ -642,29 +680,115 @@ class AdaptiveCodec:
         PROBE_TOTAL.labels(cls).inc()
         if cls == "bypass":
             BYPASS_BYTES.inc(n)
-            return bytes(data), constants.COMPRESSOR_NONE
-        level = self.cfg.effective_level(cls)
-        if getattr(self._tls, "st", None) is not None:
-            CTX_REUSE.inc()
-        st = self._state()
-        if self._handles is not None:
-            payload = _TRAINED_HEADER.pack(
-                TRAINED_FRAME_MAGIC, self.trained.dict_id
-            ) + zstd_native.compress_with_cdict(
-                st.ctx, data, self._handles.cdict(level)
-            )
-            DICT_BYTES.inc(n)
-        else:
-            payload = zstd_native.compress_with_ctx(st.ctx, data, level)
+            return cls, None
+        return cls, self.cfg.effective_level(cls)
+
+    def _seal(self, data, cls: str, level: int, payload: bytes) -> tuple[bytes, int]:
+        """Shared back half: per-level byte accounting plus the
+        late-bypass backstop. A frame that grew past the raw bytes stores
+        raw. (The probe already catches ~all of these; this is the
+        backstop that makes storing a frame never cost ratio. The
+        fallback class skips it — probe failure means always-compress.)"""
+        n = len(data)
         LEVEL_BYTES.labels(str(level)).inc(n)
-        # A frame that grew past the raw bytes is a late bypass: store
-        # raw. (The probe already catches ~all of these; this is the
-        # backstop that makes storing a frame never cost ratio. The
-        # fallback class skips it — probe failure means always-compress.)
         if len(payload) >= n and n > 0 and cls != "fallback":
             BYPASS_BYTES.inc(n)
             return bytes(data), constants.COMPRESSOR_NONE
         return payload, constants.COMPRESSOR_ZSTD
+
+    def _encode_dict(self, data, cls: str, level: int) -> tuple[bytes, int]:
+        """The trained-dictionary frame lane (``nZD1`` header + CDict
+        body). Per-chunk by nature: digested CDicts are per-frame zstd
+        API, so the batch lane below never routes these."""
+        if getattr(self._tls, "st", None) is not None:
+            CTX_REUSE.inc()
+        st = self._state()
+        payload = _TRAINED_HEADER.pack(
+            TRAINED_FRAME_MAGIC, self.trained.dict_id
+        ) + zstd_native.compress_with_cdict(st.ctx, data, self._handles.cdict(level))
+        DICT_BYTES.inc(len(data))
+        return self._seal(data, cls, level, payload)
+
+    def encode(self, data) -> tuple[bytes, int]:
+        """One chunk → ``(payload, chunk_compressor_flag)``.
+
+        The pipeline's speculative compress workers and the serial
+        assembler both call exactly this; determinism in content keeps
+        them byte-identical.
+        """
+        failpoint.hit("compress.encode")
+        cls, level = self._plan(data)
+        if level is None:
+            return bytes(data), constants.COMPRESSOR_NONE
+        if self._handles is not None:
+            return self._encode_dict(data, cls, level)
+        if getattr(self._tls, "st", None) is not None:
+            CTX_REUSE.inc()
+        st = self._state()
+        payload = zstd_native.compress_with_ctx(st.ctx, data, level)
+        return self._seal(data, cls, level, payload)
+
+    def encode_batch(self, views, n_threads: int = 1) -> list[tuple[bytes, int]]:
+        """Many chunks → ``[(payload, chunk_flag)]``, byte-identical to
+        ``[encode(v) for v in views]``.
+
+        Per-chunk probe/class/dictionary decisions stay in Python (pure
+        in content and cheap); every chunk that lands on the PLAIN zstd
+        lane is then compressed by ONE GIL-released native call per level
+        group (``ntpu_encode_batch``: pinned per-thread ``ZSTD_CCtx``s in
+        C, frames byte-identical to :func:`zstd.compress_with_ctx` —
+        libzstd's one-shot ``ZSTD_compressCCtx`` on both sides). Bypass,
+        trained-dict and fallback-class chunks take exactly the per-chunk
+        path, as does everything when the native arm is unavailable. The
+        batch entry is the future device-codec slot: a GPU/TPU codec
+        replaces the native call, not the converter walk.
+        """
+        failpoint.hit("compress.batch")
+        results: list[Optional[tuple[bytes, int]]] = [None] * len(views)
+        groups: dict[int, list[int]] = {}
+        classes: dict[int, str] = {}
+        for i, data in enumerate(views):
+            failpoint.hit("compress.encode")
+            cls, level = self._plan(data)
+            if level is None:
+                results[i] = (bytes(data), constants.COMPRESSOR_NONE)
+            elif self._handles is not None:
+                results[i] = self._encode_dict(data, cls, level)
+            else:
+                classes[i] = cls
+                groups.setdefault(level, []).append(i)
+        if not groups:
+            return results
+        from nydus_snapshotter_tpu.ops import native_cdc
+
+        if not native_cdc.encode_batch_available():
+            if getattr(self._tls, "st", None) is not None:
+                CTX_REUSE.inc()
+            st = self._state()
+            for level, idxs in groups.items():
+                for i in idxs:
+                    payload = zstd_native.compress_with_ctx(st.ctx, views[i], level)
+                    results[i] = self._seal(views[i], classes[i], level, payload)
+            return results
+        for level, idxs in sorted(groups.items()):
+            buf, ext = native_cdc.concat_extents([views[i] for i in idxs])
+            res = native_cdc.encode_batch_native(buf, ext, level, n_threads)
+            if res is None:
+                # The library raced away mid-run: per-chunk lane.
+                st = self._state()
+                for i in idxs:
+                    payload = zstd_native.compress_with_ctx(st.ctx, views[i], level)
+                    results[i] = self._seal(views[i], classes[i], level, payload)
+                continue
+            payloads, comp, _digests = res
+            BATCH_TOTAL.inc()
+            BATCH_CHUNKS.inc(len(idxs))
+            for k, i in enumerate(idxs):
+                coff, csz = int(comp[k, 0]), int(comp[k, 1])
+                results[i] = self._seal(
+                    views[i], classes[i], level, payloads[coff : coff + csz].tobytes()
+                )
+        return results
 
     # -- introspection -------------------------------------------------------
 

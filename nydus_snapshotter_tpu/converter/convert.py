@@ -120,6 +120,39 @@ class ThreadSafeCompressor:
             self._tls.fn = fn
         return fn(data)
 
+    def encode_many(self, views, n_threads: int = 1):
+        """Batch counterpart of ``__call__``: ``[(payload, flag)]``
+        byte-identical to ``[self(v) for v in views]``.
+
+        Routes the adaptive codec's :meth:`AdaptiveCodec.encode_batch`,
+        or — on the plain system-libzstd lane — one GIL-released native
+        batch call at the fixed level (``ntpu_encode_batch`` is one-shot
+        ``ZSTD_compressCCtx`` like ``compress_block``, so frames match).
+        Everything else (lz4, store-raw, the bundled-zstandard fallback)
+        loops per chunk.
+        """
+        if self._codec is not None:
+            return self._codec.encode_batch(views, n_threads=n_threads)
+        if self._kind == "zstd" and views:
+            from nydus_snapshotter_tpu.ops import native_cdc
+            from nydus_snapshotter_tpu.utils import zstd as zstd_native
+
+            if zstd_native.available() and native_cdc.encode_batch_available():
+                buf, ext = native_cdc.concat_extents(views)
+                res = native_cdc.encode_batch_native(buf, ext, _ZSTD_LEVEL, n_threads)
+                if res is not None:
+                    payloads, comp, _digests = res
+                    return [
+                        (
+                            payloads[
+                                int(comp[k, 0]) : int(comp[k, 0]) + int(comp[k, 1])
+                            ].tobytes(),
+                            constants.COMPRESSOR_ZSTD,
+                        )
+                        for k in range(len(views))
+                    ]
+        return [self(v) for v in views]
+
 
 def _decompress_chunk(data: bytes, flags: int, expect_size: int) -> bytes:
     comp = flags & constants.COMPRESSOR_MASK

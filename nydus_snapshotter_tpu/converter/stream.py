@@ -1161,6 +1161,11 @@ def pack_stream(
                         ThreadSafeCompressor,
                     )
 
+                    # ThreadSafeCompressor also carries the encode_many
+                    # batch seam: pipeline compress workers drain up to
+                    # [compression] batch_chunks queued chunks into one
+                    # GIL-released native batch-encode call (byte-identical
+                    # frames either way).
                     compress_fn = ThreadSafeCompressor(
                         opt.compressor, opt.lz4_acceleration, codec=codec
                     )

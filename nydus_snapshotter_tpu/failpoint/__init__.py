@@ -107,6 +107,8 @@ KNOWN_SITES = (
     "soak.wave",             # scenario/soak.py per-epoch wave entry
     "soak.evolve",           # scenario/soak.py corpus-evolution convert step
     "soak.scaleup",          # metrics/slo.py scale-up spawn attempt
+    "chunk.vec",             # ops/native_cdc.py vectorized table-scan entry
+    "compress.batch",        # converter/codec.py batched encode entry
 )
 
 _lock = _an.make_lock("failpoint.table")

@@ -352,6 +352,163 @@ void gear_bitmaps_range(const uint8_t *data, int64_t lo, int64_t hi,
   }
 }
 
+// ---- Table-based candidate bitmaps (the vectorized arm of
+// ntpu_cdc_chunk) ------------------------------------------------------
+//
+// Same position-parallel bitmap layout as the gear-v2 kernels above, but
+// for a CALLER-supplied 256-entry gear table (the ntpu_cdc_chunk ABI):
+// there is no mix arithmetic to inline, so the AVX2 arm runs the
+// sequential recurrence across 8 independent STRIPES — one per u32 lane —
+// with all 8 table lookups served by a single vpgatherdd per step. A
+// 32-bit gear hash retains exactly 32 bytes of history, so warming each
+// lane from stripe_start-31 makes every hash whole-stream identical (the
+// gear_bitmaps_scalar argument applied per stripe); stripe seams are
+// invisible in the bitmaps and cut resolution never learns they existed.
+
+void cdc_table_bitmaps_scalar(const uint8_t *data, int64_t lo, int64_t hi,
+                              const uint32_t *table, uint32_t mask_s,
+                              uint32_t mask_l, uint64_t *bm_s,
+                              uint64_t *bm_l) {
+  const int64_t w0 = lo >> 6, w1 = (hi + 63) >> 6;
+  std::memset(bm_s + w0, 0, (size_t)(w1 - w0) * 8);
+  std::memset(bm_l + w0, 0, (size_t)(w1 - w0) * 8);
+  uint32_t h = 0;
+  int64_t i = lo - 31;
+  if (i < 0) i = 0;
+  for (; i < hi; ++i) {
+    h = (h << 1) + table[data[i]];
+    if (i < lo) continue;
+    if ((h & mask_s) == 0) bm_s[i >> 6] |= 1ULL << (i & 63);
+    if ((h & mask_l) == 0) bm_l[i >> 6] |= 1ULL << (i & 63);
+  }
+}
+
+#ifdef NTPU_X86
+// Byte feed: one 32-bit load per lane covers the next 4 positions, so
+// the 8 scalar loads amortize across 4 gather steps. Candidates
+// accumulate as one movemask byte per step (bit l = stripe l) and the
+// 64x8 step-major matrix transposes to per-stripe bitmap words via the
+// slide-bit-l-to-MSB + movemask_epi8 column extract — no BMI2/pext
+// dependency (pext is microcoded on pre-Zen3 AMD).
+__attribute__((target("avx2")))
+void cdc_table_bitmaps_avx2(const uint8_t *data, int64_t lo, int64_t hi,
+                            const uint32_t *table, uint32_t mask_s,
+                            uint32_t mask_l, uint64_t *bm_s, uint64_t *bm_l) {
+  const int64_t len = hi - lo;
+  // Per-lane stripe length, 64-aligned so every stripe starts on a
+  // bitmap word boundary (lo arrives tile-aligned). Word loads at
+  // offsets 0,4,..,slen-4 stay strictly in-stripe: no read ever crosses
+  // hi, so no over-read guard is needed.
+  const int64_t slen = (len / 8) & ~(int64_t)63;
+  if (slen < 64) {
+    cdc_table_bitmaps_scalar(data, lo, hi, table, mask_s, mask_l, bm_s, bm_l);
+    return;
+  }
+  alignas(32) uint32_t hs[8];
+  for (int l = 0; l < 8; ++l) {
+    const int64_t s = lo + l * slen;
+    uint32_t h = 0;
+    int64_t i = s - 31;
+    if (i < 0) i = 0;
+    for (; i < s; ++i) h = (h << 1) + table[data[i]];
+    hs[l] = h;
+  }
+  __m256i hv = _mm256_load_si256((const __m256i *)hs);
+  const __m256i vms = _mm256_set1_epi32((int)mask_s);
+  const __m256i vml = _mm256_set1_epi32((int)mask_l);
+  const __m256i vzero = _mm256_setzero_si256();
+  const __m256i bytemask = _mm256_set1_epi32(0xFF);
+
+  alignas(32) uint8_t mb_s[64];
+  alignas(32) uint8_t mb_l[64];
+  for (int64_t t = 0; t < slen; t += 64) {
+    for (int64_t u = 0; u < 64; u += 4) {
+      alignas(32) uint32_t wsrc[8];
+      for (int l = 0; l < 8; ++l) {
+        std::memcpy(&wsrc[l], data + lo + l * slen + t + u, 4);
+      }
+      __m256i words = _mm256_load_si256((const __m256i *)wsrc);
+      for (int b = 0; b < 4; ++b) {
+        const __m256i idx = _mm256_and_si256(words, bytemask);
+        words = _mm256_srli_epi32(words, 8);
+        const __m256i g = _mm256_i32gather_epi32((const int *)table, idx, 4);
+        hv = _mm256_add_epi32(_mm256_slli_epi32(hv, 1), g);
+        mb_s[u + b] = (uint8_t)_mm256_movemask_ps(_mm256_castsi256_ps(
+            _mm256_cmpeq_epi32(_mm256_and_si256(hv, vms), vzero)));
+        mb_l[u + b] = (uint8_t)_mm256_movemask_ps(_mm256_castsi256_ps(
+            _mm256_cmpeq_epi32(_mm256_and_si256(hv, vml), vzero)));
+      }
+    }
+    const __m256i s_lo = _mm256_load_si256((const __m256i *)mb_s);
+    const __m256i s_hi = _mm256_load_si256((const __m256i *)(mb_s + 32));
+    const __m256i l_lo = _mm256_load_si256((const __m256i *)mb_l);
+    const __m256i l_hi = _mm256_load_si256((const __m256i *)(mb_l + 32));
+    for (int l = 0; l < 8; ++l) {
+      // bit l of every mask byte -> MSB, then movemask reads the column;
+      // stripe starts are 64-aligned, so the 64 steps are exactly one
+      // bitmap word per stripe and a direct store suffices
+      const __m128i sh = _mm_cvtsi32_si128(7 - l);
+      const int64_t word = (lo + l * slen + t) >> 6;
+      uint64_t ws = (uint32_t)_mm256_movemask_epi8(_mm256_sll_epi16(s_lo, sh));
+      ws |= (uint64_t)(uint32_t)_mm256_movemask_epi8(
+                _mm256_sll_epi16(s_hi, sh))
+            << 32;
+      bm_s[word] = ws;
+      uint64_t wl = (uint32_t)_mm256_movemask_epi8(_mm256_sll_epi16(l_lo, sh));
+      wl |= (uint64_t)(uint32_t)_mm256_movemask_epi8(
+                _mm256_sll_epi16(l_hi, sh))
+            << 32;
+      bm_l[word] = wl;
+    }
+  }
+  if (lo + 8 * slen < hi)
+    cdc_table_bitmaps_scalar(data, lo + 8 * slen, hi, table, mask_s, mask_l,
+                             bm_s, bm_l);
+}
+#endif  // NTPU_X86
+
+// Test hook: NTPU_CDC_FORCE_ISA=scalar pins the table-based dispatch so
+// the striped AVX2 arm is differential-testable against the portable arm
+// on the same host (mirrors NTPU_GEAR_FORCE_ISA for the fused kernels).
+int cdc_forced_isa() {
+  static const int forced = [] {
+    const char *e = std::getenv("NTPU_CDC_FORCE_ISA");
+    if (e == nullptr) return 0;
+    if (std::strcmp(e, "avx2") == 0) return 2;
+    if (std::strcmp(e, "scalar") == 0) return 1;
+    return 0;
+  }();
+  return forced;
+}
+
+// Which table-scan arm the dispatch selects (2 = avx2 striped,
+// 1 = scalar). Tests assert on this, not the env var: forcing avx2 on a
+// non-AVX2 host falls back to scalar and a naive differential would
+// compare scalar to scalar.
+int cdc_active_isa_impl() {
+  if (cdc_forced_isa() == 1) return 1;
+#ifdef NTPU_X86
+  if (__builtin_cpu_supports("avx2")) return 2;
+#endif
+  return 1;
+}
+
+void cdc_table_bitmaps_range(const uint8_t *data, int64_t lo, int64_t hi,
+                             const uint32_t *table, uint32_t mask_s,
+                             uint32_t mask_l, uint64_t *bm_s,
+                             uint64_t *bm_l) {
+  switch (cdc_active_isa_impl()) {
+#ifdef NTPU_X86
+    case 2:
+      cdc_table_bitmaps_avx2(data, lo, hi, table, mask_s, mask_l, bm_s, bm_l);
+      return;
+#endif
+    default:
+      cdc_table_bitmaps_scalar(data, lo, hi, table, mask_s, mask_l, bm_s,
+                               bm_l);
+  }
+}
+
 // First set bit in [lo, hi) of an LSB-first word bitmap, or -1.
 inline int64_t find_first_set(const uint64_t *bm, int64_t lo, int64_t hi) {
   if (lo >= hi) return -1;
@@ -517,6 +674,96 @@ int64_t ntpu_cdc_chunk(const uint8_t *data, int64_t n,
     if (n_cuts >= cuts_cap) return -1;
     cuts_out[n_cuts++] = n;
   }
+  return n_cuts;
+}
+
+// Which table-scan arm ntpu_cdc_chunk_vec dispatches to on this host +
+// env (2 = avx2 striped, 1 = scalar) — lets the differential battery
+// assert the arm it pinned actually runs.
+int64_t ntpu_cdc_active_isa(void) { return cdc_active_isa_impl(); }
+
+// Vectorized arm of ntpu_cdc_chunk: SAME ABI, SAME cuts. Candidate
+// bitmaps come from the striped table kernel (AVX2 gather lanes with a
+// portable-scalar fallback, runtime-dispatched); cuts are then resolved
+// with the exact region/judgement discipline of ntpu_cdc_chunk /
+// ops/cdc.resolve_cuts, so the output is cut-identical to the
+// sequential scanner and to chunk_sequential_reference by construction —
+// the bitmaps are position-exact whole-stream candidates (judged
+// positions sit >= min_size >= 32 past their chunk start, so per-chunk
+// hash state equals whole-stream state at every judged position), and
+// the resolution loop is shared. Differential-proven in
+// tests/test_chunk_engine.py, gear-table-resonance corpora included.
+// Bitmap tiles are computed lazily exactly as in ntpu_chunk_digest: the
+// resolution scan advances strictly forward, so skipped gaps
+// ([cut, cut + min_size - 32) of every chunk) are never hashed at all.
+int64_t ntpu_cdc_chunk_vec(const uint8_t *data, int64_t n,
+                           const uint32_t *table,
+                           uint32_t mask_small, uint32_t mask_large,
+                           int64_t min_size, int64_t normal_size,
+                           int64_t max_size,
+                           int64_t *cuts_out, int64_t cuts_cap) {
+  if (n <= 0) return 0;
+  const int64_t words = (n + 63) >> 6;
+  uint64_t *bm = (uint64_t *)std::malloc((size_t)words * 16);
+  if (bm == nullptr) return -1;
+  uint64_t *bm_s = bm, *bm_l = bm + words;
+
+  // 8 stripes x 1024 positions per lazy tile: big enough that the 31-byte
+  // per-stripe warm-up is ~3% overhead, small enough to stay cache-warm.
+  constexpr int64_t VTILE = 8192;
+  int64_t hashed_until = 0;
+  const auto ensure_tile = [&](int64_t pos) {
+    const int64_t t0 = pos & ~(VTILE - 1);
+    if (t0 < hashed_until) return;
+    const int64_t t1 = (t0 + VTILE < n) ? t0 + VTILE : n;
+    cdc_table_bitmaps_range(data, t0, t1, table, mask_small, mask_large,
+                            bm_s, bm_l);
+    hashed_until = t0 + VTILE;
+  };
+  const auto scan = [&](const uint64_t *bmx, int64_t lo, int64_t hi) {
+    int64_t pos = lo;
+    while (pos < hi) {
+      ensure_tile(pos);
+      int64_t te = (pos & ~(VTILE - 1)) + VTILE;
+      if (te > hi) te = hi;
+      const int64_t i = find_first_set(bmx, pos, te);
+      if (i >= 0) return i;
+      pos = te;
+    }
+    return (int64_t)-1;
+  };
+
+  int64_t n_cuts = 0;
+  int64_t start = 0;
+  while (n - start > min_size) {
+    const int64_t scan_end = (start + max_size < n) ? start + max_size : n;
+    const int64_t normal_end =
+        (start + normal_size - 1 < scan_end) ? start + normal_size - 1
+                                             : scan_end;
+    const int64_t judge_from = start + min_size - 1;
+    int64_t end = -1;
+    int64_t i = scan(bm_s, judge_from, normal_end);
+    if (i >= 0) end = i + 1;
+    if (end < 0) {
+      i = scan(bm_l, normal_end, scan_end);
+      if (i >= 0) end = i + 1;
+    }
+    if (end < 0) end = (scan_end == start + max_size) ? scan_end : n;
+    if (n_cuts >= cuts_cap) {
+      std::free(bm);
+      return -1;
+    }
+    cuts_out[n_cuts++] = end;
+    start = end;
+  }
+  if (n > start) {
+    if (n_cuts >= cuts_cap) {
+      std::free(bm);
+      return -1;
+    }
+    cuts_out[n_cuts++] = n;
+  }
+  std::free(bm);
   return n_cuts;
 }
 
@@ -1000,6 +1247,121 @@ int64_t ntpu_pack_section(const uint8_t *src0, const uint8_t *src1,
   if (blob_digest32 != nullptr) {
     const int64_t ext[2] = {0, coff};
     ntpu_sha::sha256_extents(out, ext, 1, blob_digest32);
+  }
+  return coff;
+}
+
+// Batched per-chunk zstd encode behind the adaptive codec's encode seam
+// (converter/codec.py): m independent chunks -> m independent zstd
+// frames at `level` in ONE GIL-released call. extents: m (off, size)
+// i64 pairs into data. Frames land back-to-back in out; comp_extents
+// gets (coff, csize) per chunk. Workers compress into bound-spaced
+// slots with one reusable ZSTD_CCtx each (the codec engine's
+// per-worker-context pin pushed down into C), then a serial pass
+// compacts left in place — bytes are identical to per-chunk
+// ZSTD_compressCCtx calls at the same level (== utils/zstd
+// compress_with_ctx, the cross-lane byte-identity anchor).
+// digests_out (nullable) additionally banks a 32-byte digest of each
+// UNCOMPRESSED chunk (algo 0 = SHA-256, 1 = BLAKE3): the future device
+// codec returns payloads + digests from one dispatch, so the batch ABI
+// carries both today. Returns the packed payload size; -1 on
+// overflow/codec failure; -2 when the system libzstd is absent.
+int64_t ntpu_encode_batch(const uint8_t *data, const int64_t *extents,
+                          int64_t m, int64_t level, int64_t n_threads,
+                          uint8_t *out, int64_t out_cap,
+                          int64_t *comp_extents, uint8_t *digests_out,
+                          int64_t algo) {
+  const ZstdApi *zstd = load_zstd();
+  if (zstd == nullptr) return -2;
+  if (m <= 0) return 0;
+  std::vector<int64_t> pre((size_t)m);
+  int64_t acc = 0;
+  for (int64_t j = 0; j < m; ++j) {
+    pre[(size_t)j] = acc;
+    acc += (int64_t)zstd->bound((size_t)extents[2 * j + 1]);
+  }
+  if (acc > out_cap) return -1;
+  auto encode_some = [&](void *ctx, int64_t j0, int64_t j1) -> bool {
+    for (int64_t j = j0; j < j1; ++j) {
+      const int64_t size = extents[2 * j + 1];
+      const size_t w = zstd->compress_cctx(
+          ctx, out + pre[(size_t)j], (size_t)zstd->bound((size_t)size),
+          data + extents[2 * j], (size_t)size, (int)level);
+      if (zstd->iserr(w)) return false;
+      comp_extents[2 * j + 1] = (int64_t)w;
+    }
+    return true;
+  };
+  if (n_threads <= 1 || m == 1) {
+    // Serial arm: frames go straight to the running cursor — already
+    // compacted (no memmove pass, and only the compressed prefix of out
+    // is ever touched, not the full sum-of-bounds span). The CCtx is
+    // pinned thread_local across calls: a pipeline compress worker
+    // draining batch after batch pays context alloc + workspace faults
+    // once, matching the per-chunk lane's pinned-ctx discipline.
+    // dstCapacity never changes the emitted bytes (only success/failure),
+    // so this stays byte-identical to the bound-spaced parallel arm.
+    static thread_local ZstdCtx zc(zstd);
+    if (zc.ctx == nullptr) return -1;
+    int64_t coff = 0;
+    for (int64_t j = 0; j < m; ++j) {
+      const int64_t size = extents[2 * j + 1];
+      const size_t w = zstd->compress_cctx(
+          zc.ctx, out + coff, (size_t)(out_cap - coff), data + extents[2 * j],
+          (size_t)size, (int)level);
+      if (zstd->iserr(w)) return -1;
+      comp_extents[2 * j] = coff;
+      comp_extents[2 * j + 1] = (int64_t)w;
+      coff += (int64_t)w;
+    }
+    if (digests_out != nullptr) {
+      if (algo == 1)
+        ntpu_b3::blake3_extents(data, extents, m, digests_out);
+      else
+        ntpu_sha::sha256_extents(data, extents, m, digests_out);
+    }
+    return coff;
+  }
+  {
+    std::atomic<int64_t> next{0};
+    std::atomic<bool> failed{false};
+    auto worker = [&]() {
+      constexpr int64_t GRAB = 8;  // chunks per work grab
+      ZstdCtx zc(zstd);
+      if (zc.ctx == nullptr) {
+        failed.store(true, std::memory_order_relaxed);
+        return;
+      }
+      for (;;) {
+        const int64_t j = next.fetch_add(GRAB);
+        if (j >= m || failed.load(std::memory_order_relaxed)) return;
+        const int64_t jend = j + GRAB < m ? j + GRAB : m;
+        if (!encode_some(zc.ctx, j, jend)) {
+          failed.store(true, std::memory_order_relaxed);
+          return;
+        }
+      }
+    };
+    std::vector<std::thread> pool;
+    const int64_t nt = n_threads < m ? n_threads : m;
+    for (int64_t t = 1; t < nt; ++t) pool.emplace_back(worker);
+    worker();
+    for (auto &th : pool) th.join();
+    if (failed.load()) return -1;
+  }
+  int64_t coff = 0;
+  for (int64_t j = 0; j < m; ++j) {
+    const int64_t csize = comp_extents[2 * j + 1];
+    if (coff != pre[(size_t)j])
+      std::memmove(out + coff, out + pre[(size_t)j], (size_t)csize);
+    comp_extents[2 * j] = coff;
+    coff += csize;
+  }
+  if (digests_out != nullptr) {
+    if (algo == 1)
+      ntpu_b3::blake3_extents(data, extents, m, digests_out);
+    else
+      ntpu_sha::sha256_extents(data, extents, m, digests_out);
   }
   return coff;
 }

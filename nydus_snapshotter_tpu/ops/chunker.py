@@ -245,7 +245,10 @@ class ChunkDigestEngine:
             from nydus_snapshotter_tpu.ops import native_cdc
 
             if native_cdc.available():
-                return native_cdc.chunk_data_native(arr, self.params)
+                # chunk_data_best: vectorized striped table scan when the
+                # [compression] vectorized knob allows it and the arm is
+                # built, sequential otherwise — cut-identical either way.
+                return native_cdc.chunk_data_best(arr, self.params)
             return cdc.chunk_data_np(arr, self.params)
         if self.backend == "numpy":
             return cdc.chunk_data_np(arr, self.params)

@@ -34,6 +34,22 @@ def _lib_path() -> str:
     )
 
 
+def _report_unbuildable(native_build) -> None:
+    """Loud-load path: the on-disk failure memo makes repeat ensure_built
+    calls degrade silently — including in processes that never ran the
+    compile — so surface the memoized compiler error ONCE per process
+    here, where the library is first found unusable."""
+    import logging
+
+    reason = native_build.failure_reason("libchunk_engine.so")
+    if reason:
+        logging.getLogger(__name__).warning(
+            "libchunk_engine.so unbuildable (memoized compile failure; "
+            "native arms disabled, Python lanes take over):\n%s",
+            reason,
+        )
+
+
 def load() -> Optional[ctypes.CDLL]:
     """The shared library; built (or rebuilt if sources changed) on first
     use per process via utils.native_build (atomic rename + on-disk
@@ -60,6 +76,7 @@ def load() -> Optional[ctypes.CDLL]:
                 and native_build.sources_newer("libchunk_engine.so", "chunk_engine")
             ):
                 _lib_missing = True
+                _report_unbuildable(native_build)
                 return None
         try:
             lib = ctypes.CDLL(path)
@@ -74,6 +91,21 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # min/normal/max
             ctypes.c_void_p, ctypes.c_int64,  # cuts_out, cap
         ]
+        if hasattr(lib, "ntpu_cdc_chunk_vec"):
+            lib.ntpu_cdc_chunk_vec.restype = ctypes.c_int64
+            lib.ntpu_cdc_chunk_vec.argtypes = list(lib.ntpu_cdc_chunk.argtypes)
+        if hasattr(lib, "ntpu_cdc_active_isa"):
+            lib.ntpu_cdc_active_isa.restype = ctypes.c_int64
+            lib.ntpu_cdc_active_isa.argtypes = []
+        if hasattr(lib, "ntpu_encode_batch"):
+            lib.ntpu_encode_batch.restype = ctypes.c_int64
+            lib.ntpu_encode_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,  # data, extents, m
+                ctypes.c_int64, ctypes.c_int64,  # level, n_threads
+                ctypes.c_void_p, ctypes.c_int64,  # out, out_cap
+                ctypes.c_void_p,  # comp_extents
+                ctypes.c_void_p, ctypes.c_int64,  # digests_out (nullable), algo
+            ]
         lib.ntpu_gear_hashes.restype = None
         lib.ntpu_gear_hashes.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p
@@ -199,6 +231,182 @@ def chunk_data_native(data: bytes | np.ndarray, params: cdc.CDCParams) -> np.nda
     if n < 0:
         raise RuntimeError("native chunker cut buffer overflow")
     return cuts[:n].copy()
+
+
+def vectorized_available() -> bool:
+    """The striped table-scan arm (ntpu_cdc_chunk_vec)."""
+    lib = load()
+    return lib is not None and hasattr(lib, "ntpu_cdc_chunk_vec")
+
+
+def cdc_active_isa() -> int:
+    """Which table-scan arm ntpu_cdc_chunk_vec dispatches to on this
+    host + env (2 = AVX2 striped, 1 = portable scalar; 0 = library or
+    symbol absent). Differential tests assert on this, not on
+    NTPU_CDC_FORCE_ISA — forcing avx2 on a non-AVX2 host silently falls
+    back to scalar."""
+    lib = load()
+    if lib is None or not hasattr(lib, "ntpu_cdc_active_isa"):
+        return 0
+    return int(lib.ntpu_cdc_active_isa())
+
+
+def forced_isa() -> str:
+    """NTPU_CDC_FORCE_ISA as the native kernel will see it ("avx2" /
+    "scalar" / "" = host dispatch). The C++ side memoizes the env read
+    at first dispatch, so flipping it mid-process has no effect —
+    differential tests pin it in a child process and assert on
+    cdc_active_isa() there."""
+    return os.environ.get("NTPU_CDC_FORCE_ISA", "")
+
+
+def chunk_data_vec_native(
+    data: bytes | np.ndarray, params: cdc.CDCParams
+) -> np.ndarray:
+    """Cut offsets via the VECTORIZED table scanner — cut-identical to
+    chunk_data_native / cdc.chunk_sequential_reference by construction
+    (position-exact whole-stream candidate bitmaps resolved with the
+    shared region discipline; differential-proven in
+    tests/test_chunk_engine.py, resonance corpora included)."""
+    from nydus_snapshotter_tpu import failpoint
+
+    lib = load()
+    if lib is None or not hasattr(lib, "ntpu_cdc_chunk_vec"):
+        raise RuntimeError(
+            "vectorized chunker not available "
+            "(make -C nydus_snapshotter_tpu/native)"
+        )
+    failpoint.hit("chunk.vec")
+    arr = (
+        np.frombuffer(data, dtype=np.uint8)
+        if isinstance(data, (bytes, bytearray))
+        else np.ascontiguousarray(data, dtype=np.uint8)
+    )
+    if arr.size == 0:
+        return np.asarray([], dtype=np.int64)
+    table = np.ascontiguousarray(gear.gear_table())
+    cap = arr.size // max(1, params.min_size) + 2
+    cuts = np.empty(cap, dtype=np.int64)
+    n = lib.ntpu_cdc_chunk_vec(
+        arr.ctypes.data, arr.size,
+        table.ctypes.data,
+        np.uint32(params.mask_small), np.uint32(params.mask_large),
+        params.min_size, params.normal_size, params.max_size,
+        cuts.ctypes.data, cap,
+    )
+    if n < 0:
+        raise RuntimeError("native vectorized chunker failed (overflow or OOM)")
+    return cuts[:n].copy()
+
+
+def vectorized_mode() -> str:
+    """The ``[compression] vectorized`` knob: ``NTPU_COMPRESS_VECTORIZED``
+    env > global config > ``"auto"``. auto = vectorized scan when built,
+    on = require it, off = always sequential."""
+    v = os.environ.get("NTPU_COMPRESS_VECTORIZED", "").strip().lower()
+    if v in ("auto", "on", "off"):
+        return v
+    try:
+        from nydus_snapshotter_tpu.config import config as _cfg
+
+        mode = getattr(_cfg.get_global_config().compression, "vectorized", "auto")
+    except Exception:
+        return "auto"
+    return mode if mode in ("auto", "on", "off") else "auto"
+
+
+def chunk_data_best(data: bytes | np.ndarray, params: cdc.CDCParams) -> np.ndarray:
+    """The hybrid backend's scan dispatch: the vectorized table scanner
+    when the ``vectorized`` knob allows it and the arm is built, else the
+    sequential native chunker — cut-identical either way. ``on`` without
+    the arm fails loudly instead of silently degrading throughput."""
+    mode = vectorized_mode()
+    if mode != "off" and vectorized_available():
+        return chunk_data_vec_native(data, params)
+    if mode == "on":
+        raise RuntimeError(
+            "[compression] vectorized = on but ntpu_cdc_chunk_vec is not "
+            "available (rebuild native/chunk_engine)"
+        )
+    return chunk_data_native(data, params)
+
+
+def concat_extents(views) -> "tuple[np.ndarray, np.ndarray]":
+    """Concatenate chunk views into the (buf u8, extents i64[m, 2]) pair
+    the batch entry points take. One copy per chunk — the price of a
+    single GIL-released native call over m independent chunks."""
+    ext = np.empty((len(views), 2), dtype=np.int64)
+    buf = np.empty(sum(len(v) for v in views), dtype=np.uint8)
+    off = 0
+    for k, v in enumerate(views):
+        a = np.frombuffer(v, dtype=np.uint8)
+        buf[off : off + a.size] = a
+        ext[k, 0], ext[k, 1] = off, a.size
+        off += a.size
+    return buf, ext
+
+
+def encode_batch_available() -> bool:
+    """The batched per-chunk zstd encode arm (ntpu_encode_batch)."""
+    from nydus_snapshotter_tpu.utils import zstd as zstd_native
+
+    lib = load()
+    return (
+        lib is not None
+        and hasattr(lib, "ntpu_encode_batch")
+        and zstd_native.available()  # same dlopen'd system library
+    )
+
+
+def encode_batch_native(
+    data: np.ndarray,
+    extents: np.ndarray,
+    level: int,
+    n_threads: int = 1,
+    digester: "str | None" = None,
+) -> "tuple[np.ndarray, np.ndarray, bytes] | None":
+    """m independent per-chunk zstd frames in ONE GIL-released call.
+
+    extents: i64[m, 2] of (off, size) into data. Returns (payloads u8
+    view of the packed frames, comp_extents i64[m, 2] of (coff, csize),
+    digests bytes — 32*m of the UNCOMPRESSED chunks when ``digester``
+    ("sha256"/"blake3") is set, else b""). Each frame is byte-identical
+    to utils.zstd.compress_with_ctx at the same level (the codec
+    engine's per-chunk lane), so batched and per-chunk paths cannot
+    diverge. None when the native arm cannot run (library or system
+    libzstd absent) — callers fall back to the per-chunk loop.
+    """
+    lib = load()
+    if lib is None or not hasattr(lib, "ntpu_encode_batch"):
+        return None
+    arr = np.ascontiguousarray(data, dtype=np.uint8)
+    ext = np.ascontiguousarray(extents, dtype=np.int64)
+    m = ext.shape[0]
+    if m == 0:
+        return np.empty(0, np.uint8), np.empty((0, 2), np.int64), b""
+    cap = _comp_bound_total(int(ext[:, 1].sum()), m, 2)
+    out = np.empty(max(cap, 1), dtype=np.uint8)
+    comp = np.empty((m, 2), dtype=np.int64)
+    digests = (
+        np.empty(m * 32, dtype=np.uint8) if digester is not None else None
+    )
+    total = lib.ntpu_encode_batch(
+        arr.ctypes.data, ext.ctypes.data, m,
+        level, max(1, n_threads),
+        out.ctypes.data, out.size,
+        comp.ctypes.data,
+        digests.ctypes.data if digests is not None else None,
+        DIGEST_ALGO[digester] if digester is not None else 0,
+    )
+    if total == -2:
+        return None  # system libzstd absent: per-chunk Python path takes over
+    if total < 0:
+        raise RuntimeError("native batch encode failed (overflow or codec error)")
+    return (
+        out[:total],
+        comp,
+        digests.tobytes() if digests is not None else b"",
+    )
 
 
 def chunk_digest_available() -> bool:

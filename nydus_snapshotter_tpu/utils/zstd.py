@@ -247,7 +247,14 @@ def cctx_release(ctx: int) -> None:
 def compress_with_ctx(ctx: int, data: bytes | memoryview, level: int = LEVEL) -> bytes:
     """One zstd frame on a caller-owned CCtx — the per-worker hot path:
     no context allocation, no pool lock. Output is byte-identical to
-    :func:`compress_block` at the same level."""
+    :func:`compress_block` at the same level.
+
+    This call is the byte-identity anchor for the native batched encode
+    lane (chunk_engine's ``ntpu_encode_batch``, reached through
+    ``ops.native_cdc.encode_batch_native``): both sides issue one-shot
+    ``ZSTD_compressCCtx`` against the SAME dlopen'd system libzstd, so a
+    batch of m chunks and m calls here cannot diverge frame-wise —
+    differential-tested in tests/test_chunk_engine.py."""
     import numpy as np
 
     # zero-copy source: memoryview chunk slices of the tar buffer go

@@ -11,7 +11,7 @@ import hashlib
 import numpy as np
 import pytest
 
-from nydus_snapshotter_tpu.ops import cdc, gear, sha256
+from nydus_snapshotter_tpu.ops import cdc, gear, native_cdc, sha256
 from nydus_snapshotter_tpu.ops.chunker import ChunkDigestEngine
 
 RNG = np.random.default_rng(1234)
@@ -204,6 +204,175 @@ class TestGearPallas:
         rs, rl = _hash_bitmaps_kernel(xj, jnp.uint32(ms), jnp.uint32(ml), n)
         assert np.array_equal(np.asarray(ps), np.asarray(rs))
         assert np.array_equal(np.asarray(pl_), np.asarray(rl))
+
+
+def _vec_corpora():
+    """The vectorized-scan battery: the base corpora plus the PR 14
+    gear-table-resonance adversaries (every cut at min_size / zero
+    candidates ⇒ every cut forced at max_size), dust, a huge stream,
+    incompressible bytes, and stripe/tile-boundary straddlers (the
+    striped kernel splits each 8192-byte tile into 8 stripes of 1024,
+    so lengths and cuts around those seams are the dangerous cases)."""
+    from nydus_snapshotter_tpu.scenario.corpus import cdc_resonant_data
+
+    rng = np.random.default_rng(99)
+    corpora = list(_corpora())
+    corpora += [
+        ("resonant-min", cdc_resonant_data(7, 300_000, 0x1000, mode="min")),
+        ("resonant-max", cdc_resonant_data(7, 300_000, 0x1000, mode="max")),
+        ("dust-33", rng.integers(0, 256, 33, dtype=np.uint8).tobytes()),
+        ("dust-1023", rng.integers(0, 256, 1023, dtype=np.uint8).tobytes()),
+        ("huge-random", rng.integers(0, 256, 16 << 20, dtype=np.uint8).tobytes()),
+        ("incompressible-8m", rng.integers(0, 256, 8 << 20, dtype=np.uint8).tobytes()),
+    ]
+    # Stripe-boundary straddlers: lengths hugging the 8-stripe split
+    # (slen = (len/8) & ~63 per scan range) and the 8192-byte lazy-tile
+    # seam, where a candidate's bitmap word is written by one stripe but
+    # judged while resolving a chunk that began in another.
+    for n in (511, 512, 513, 1024, 4095, 4096, 4097, 8191, 8192, 8193,
+              3 * 8192 - 1, 3 * 8192, 3 * 8192 + 1, 8 * 8192 + 65):
+        corpora.append((f"straddle-{n}", rng.integers(0, 256, n, dtype=np.uint8).tobytes()))
+    res = cdc_resonant_data(11, 8 * 8192 + 100, 0x1000, mode="min")
+    corpora.append(("straddle-resonant", res))
+    return corpora
+
+
+class TestVectorizedScan:
+    """The striped SIMD table scanner (ntpu_cdc_chunk_vec) must be
+    CUT-IDENTICAL to the sequential oracle on every corpus — the
+    whole-stream gear-hash identity (32-byte history + per-lane scalar
+    warmup) makes the lane-parallel bitmaps position-exact, and the
+    resolution loop is shared with the sequential arm."""
+
+    pytestmark = pytest.mark.skipif(
+        not native_cdc.vectorized_available(),
+        reason="vectorized scan arm not built",
+    )
+
+    @pytest.mark.parametrize("name,data", _vec_corpora())
+    def test_vec_equals_sequential_oracle(self, name, data):
+        seq = cdc.chunk_sequential_reference(data, PARAMS)
+        nat = native_cdc.chunk_data_native(data, PARAMS)
+        vec = native_cdc.chunk_data_vec_native(data, PARAMS)
+        assert np.array_equal(seq, nat), name
+        assert np.array_equal(seq, vec), name
+
+    def test_active_isa_reported(self):
+        # 2 = AVX2 striped, 1 = portable scalar — never 0 once the arm
+        # is built (0 means the symbol is missing entirely).
+        assert native_cdc.cdc_active_isa() in (1, 2)
+
+    def test_forced_scalar_cut_identical(self):
+        """NTPU_CDC_FORCE_ISA=scalar in a child process must (a) actually
+        pin the scalar arm — asserted through ntpu_cdc_active_isa, not
+        assumed — and (b) produce the same cuts as whatever arm this
+        process dispatches to."""
+        import subprocess
+        import sys
+
+        from nydus_snapshotter_tpu.scenario.corpus import cdc_resonant_data
+
+        data = cdc_resonant_data(5, 400_000, 0x1000, mode="min")
+        here = native_cdc.chunk_data_vec_native(data, PARAMS)
+        child = (
+            "import numpy as np\n"
+            "from nydus_snapshotter_tpu.ops import cdc, native_cdc\n"
+            "from nydus_snapshotter_tpu.scenario.corpus import cdc_resonant_data\n"
+            "data = cdc_resonant_data(5, 400_000, 0x1000, mode='min')\n"
+            "cuts = native_cdc.chunk_data_vec_native(data, cdc.CDCParams(0x1000))\n"
+            "print(native_cdc.cdc_active_isa(), ','.join(map(str, cuts.tolist())))\n"
+        )
+        env = dict(__import__("os").environ)
+        env["NTPU_CDC_FORCE_ISA"] = "scalar"
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run(
+            [sys.executable, "-c", child], capture_output=True, text=True,
+            timeout=300, env=env, check=True,
+        ).stdout.split()
+        assert out[0] == "1", "forced scalar arm did not engage"
+        assert out[1] == ",".join(map(str, here.tolist()))
+
+    def test_dispatch_knob(self, monkeypatch):
+        data = np.random.default_rng(3).integers(0, 256, 100_000, dtype=np.uint8)
+        want = native_cdc.chunk_data_native(data, PARAMS)
+        for mode in ("auto", "on", "off"):
+            monkeypatch.setenv("NTPU_COMPRESS_VECTORIZED", mode)
+            assert native_cdc.vectorized_mode() == mode
+            assert np.array_equal(native_cdc.chunk_data_best(data, PARAMS), want), mode
+        monkeypatch.setenv("NTPU_COMPRESS_VECTORIZED", "bogus")
+        assert native_cdc.vectorized_mode() == "auto"
+
+    def test_chunk_vec_failpoint_site(self):
+        from nydus_snapshotter_tpu import failpoint
+
+        data = np.random.default_rng(4).integers(0, 256, 50_000, dtype=np.uint8)
+        with failpoint.injected("chunk.vec", "error(OSError:vec-scan-down)"):
+            with pytest.raises(OSError):
+                native_cdc.chunk_data_vec_native(data, PARAMS)
+        # disarmed: the arm works again (no sticky failure state)
+        assert np.array_equal(
+            native_cdc.chunk_data_vec_native(data, PARAMS),
+            native_cdc.chunk_data_native(data, PARAMS),
+        )
+
+
+class TestEncodeBatch:
+    """The batched codec lane (ntpu_encode_batch) must be BYTE-identical
+    per frame to utils.zstd.compress_with_ctx — both are one-shot
+    ZSTD_compressCCtx against the same dlopen'd system libzstd."""
+
+    pytestmark = pytest.mark.skipif(
+        not native_cdc.encode_batch_available(),
+        reason="batch encode arm not built (needs system libzstd)",
+    )
+
+    def _chunks(self):
+        rng = np.random.default_rng(21)
+        out = []
+        for i in range(37):
+            n = int(rng.integers(1, 150_000))
+            if i % 3 == 0:
+                out.append(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
+            elif i % 3 == 1:
+                out.append(bytes(n))
+            else:
+                out.append((b"0123456789abcdef" * (n // 16 + 1))[:n])
+        out.append(b"")
+        return out
+
+    @pytest.mark.parametrize("level", [1, 3])
+    @pytest.mark.parametrize("n_threads", [1, 4])
+    def test_frames_byte_identical(self, level, n_threads):
+        from nydus_snapshotter_tpu.utils import zstd as zstd_native
+
+        chunks = self._chunks()
+        buf, ext = native_cdc.concat_extents(chunks)
+        payloads, comp, digests = native_cdc.encode_batch_native(
+            buf, ext, level, n_threads
+        )
+        assert digests == b""
+        for k, c in enumerate(chunks):
+            off, sz = int(comp[k, 0]), int(comp[k, 1])
+            assert payloads[off : off + sz].tobytes() == zstd_native.compress_block(
+                c, level
+            ), k
+
+    def test_batch_digests_match_oracles(self):
+        from nydus_snapshotter_tpu.utils import blake3 as pyb3
+
+        chunks = self._chunks()[:12]
+        buf, ext = native_cdc.concat_extents(chunks)
+        _p, _c, sha = native_cdc.encode_batch_native(buf, ext, 3, 1, digester="sha256")
+        _p, _c, b3 = native_cdc.encode_batch_native(buf, ext, 3, 2, digester="blake3")
+        for k, c in enumerate(chunks):
+            assert sha[32 * k : 32 * (k + 1)] == hashlib.sha256(c).digest(), k
+            assert b3[32 * k : 32 * (k + 1)] == pyb3.blake3(c), k
+
+    def test_empty_batch(self):
+        p, c, d = native_cdc.encode_batch_native(
+            np.empty(0, np.uint8), np.empty((0, 2), np.int64), 3
+        )
+        assert p.size == 0 and c.shape == (0, 2) and d == b""
 
 
 class TestPipelinedBoundaries:

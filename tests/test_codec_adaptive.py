@@ -91,6 +91,67 @@ def trained_dict(seed: int = 0, epoch: int = 7) -> codec_mod.TrainedDict:
     )
 
 
+def _batch_views(seed: int = 0) -> list[bytes]:
+    views = []
+    for i in range(30):
+        n = 2048 + 977 * i
+        views.append(textgen(n, seed + i) if i % 2 else randgen(n, seed + i))
+    views += [b"", b"q", bytes(50_000)]
+    return views
+
+
+class TestEncodeBatch:
+    """encode_batch must be byte-identical (payloads AND flags) to the
+    per-chunk encode loop — bypass, fallback, trained-dict and the
+    native-arm-absent degradation included."""
+
+    def test_identical_to_per_chunk(self):
+        views = _batch_views()
+        ref = [adaptive_codec().encode(v) for v in views]
+        assert adaptive_codec().encode_batch(views) == ref
+        assert adaptive_codec().encode_batch(views, n_threads=3) == ref
+
+    def test_identical_without_native_arm(self, monkeypatch):
+        from nydus_snapshotter_tpu.ops import native_cdc
+
+        views = _batch_views(3)
+        ref = [adaptive_codec().encode(v) for v in views]
+        monkeypatch.setattr(native_cdc, "encode_batch_available", lambda: False)
+        assert adaptive_codec().encode_batch(views) == ref
+
+    @needs_dict
+    def test_identical_with_trained_dict(self):
+        td = trained_dict(seed=4)
+        views = _batch_views(8)
+        c1 = adaptive_codec()
+        c1.set_trained(td)
+        ref = [c1.encode(v) for v in views]
+        c2 = adaptive_codec()
+        c2.set_trained(td)
+        assert c2.encode_batch(views) == ref
+
+    def test_fallback_class_identical(self):
+        """Probe failure (compress.probe armed) → fallback class; the
+        batch path must classify and compress those chunks exactly like
+        the per-chunk path."""
+        views = _batch_views(5)
+        with failpoint.injected("compress.probe", "error(OSError:probe-down)"):
+            ref = [adaptive_codec().encode(v) for v in views]
+            got = adaptive_codec().encode_batch(views)
+        assert got == ref
+        assert ref  # fallback frames still round-trip below
+        for (payload, flag), v in zip(ref, views):
+            if flag == constants.COMPRESSOR_ZSTD:
+                assert zstdcompat.decompress_block(
+                    payload, max_output_size=max(len(v), 1)
+                ) == bytes(v)
+
+    def test_batch_failpoint_site(self):
+        with failpoint.injected("compress.batch", "error(OSError:batch-down)"):
+            with pytest.raises(OSError, match="batch-down"):
+                adaptive_codec().encode_batch([b"x" * 8192])
+
+
 # ---------------------------------------------------------------------------
 # Probe + classes
 # ---------------------------------------------------------------------------

@@ -5,9 +5,10 @@ C++ engine here is the code most exposed to memory errors, so this test
 builds it with AddressSanitizer + UBSan (``make san``) and replays the
 differential battery against the instrumented arm in a child process
 (libasan must be preloaded before CPython). Any OOB read/write, UB, or
-use-after-free in the gear kernels, the lazy-tile fused pass, the SHA-NI
-schedulers, or the dict table aborts the child — the test fails on any
-non-zero exit.
+use-after-free in the gear kernels, the vectorized striped scanner, the
+lazy-tile fused pass, the SHA-NI schedulers, the batched zstd encoder,
+or the dict table aborts the child — the test fails on any non-zero
+exit.
 """
 
 import os
@@ -234,6 +235,54 @@ for trial in range(6):
             continue
         assert a[0].tobytes() == b[0].tobytes(), trial
         assert (a[1] == b[1]).all(), trial  # extent tables, not just bytes
+
+# Vectorized table scan under ASan: the striped gather kernel reads each
+# stripe with 32-bit loads and merges lazy candidate tiles — exactly the
+# pointer arithmetic ASan should watch. Cuts must equal the sequential
+# native arm on tile/stripe-edge sizes and the gear-resonance corpora.
+if native_cdc.vectorized_available():
+    assert native_cdc.cdc_active_isa() in (1, 2)
+    from nydus_snapshotter_tpu.scenario.corpus import cdc_resonant_data
+    vec_cases = [rng.integers(0, 256, s, dtype=np.uint8) for s in
+                 (0, 1, 31, 32, 63, 511, 512, 513, 4095, 4096, 4097,
+                  8191, 8192, 8193, 3 * 8192 - 1, 3 * 8192 + 1, 1 << 22)]
+    vec_cases.append(np.zeros(1 << 20, dtype=np.uint8))
+    vec_cases.append(np.frombuffer(
+        cdc_resonant_data(7, 300_000, 0x1000, mode="min"), dtype=np.uint8))
+    vec_cases.append(np.frombuffer(
+        cdc_resonant_data(8, 300_000, 0x1000, mode="max"), dtype=np.uint8))
+    for vdata in vec_cases:
+        want = native_cdc.chunk_data_native(vdata, params)
+        got = native_cdc.chunk_data_vec_native(vdata, params)
+        assert len(got) == len(want) and (got == want).all(), vdata.size
+
+# Batched codec lane under ASan: per-thread ZSTD_CCtx pinning, the
+# bound-spaced slot arithmetic, left-compaction, and the fused digest
+# taps. Frames must equal the per-chunk one-shot; digests must equal
+# the Python oracles. Serial and work-stealing arms both run.
+if native_cdc.encode_batch_available():
+    from nydus_snapshotter_tpu.utils import zstd as zstd_native
+    bviews = [b"", b"x", bytes(50_000), os.urandom(70_000),
+              (b"lorem ipsum " * 4000)]
+    bviews += [rng.integers(0, 256, int(s), dtype=np.uint8).tobytes()
+               for s in rng.integers(1, 120_000, 12)]
+    bbuf, bext = native_cdc.concat_extents(bviews)
+    for level in (1, 3):
+        for nt in (1, 4):
+            res = native_cdc.encode_batch_native(
+                bbuf, bext, level, nt, digester="sha256")
+            assert res is not None
+            payloads, comp, bdigs = res
+            for i, v in enumerate(bviews):
+                coff, csz = int(comp[i, 0]), int(comp[i, 1])
+                frame = payloads[coff:coff + csz].tobytes()
+                assert frame == zstd_native.compress_block(v, level), i
+                want = hashlib.sha256(bytes(v)).digest()
+                assert bdigs[32 * i:32 * (i + 1)] == want, i
+    res3 = native_cdc.encode_batch_native(bbuf, bext, 3, 2, digester="blake3")
+    assert res3 is not None
+    for i, v in enumerate(bviews):
+        assert res3[2][32 * i:32 * (i + 1)] == _pyb3.blake3(bytes(v)), i
 print("SANITIZED-ENGINE-OK")
 """
 
@@ -330,6 +379,55 @@ stop.set()
 for t in probers:
     t.join()
 assert not errs, errs
+
+# --- batched codec lane vs lock-free dict probes under TSan: the
+# encode workers steal extents off a shared atomic cursor and write
+# frames into bound-spaced slots of one output buffer, each with a
+# pinned per-thread ZSTD_CCtx, while dict probe threads hammer the
+# table from the section above. The two engines share no memory, so
+# any report is a real protocol bug (cursor ordering, slot overlap,
+# or a CCtx crossing threads).
+if native_cdc.encode_batch_available():
+    eviews = [np.random.default_rng(50 + i).integers(
+        0, 256, 20_000 + 7 * i, dtype=np.uint8).tobytes() for i in range(24)]
+    ebuf, eext = native_cdc.concat_extents(eviews)
+    ref = native_cdc.encode_batch_native(ebuf, eext, 3, 1)
+    assert ref is not None
+    stop2 = threading.Event()
+    errs2 = []
+
+    def prober2(tid):
+        qr = np.random.default_rng(500 + tid)
+        while not stop2.is_set():
+            q = np.ascontiguousarray(seed[qr.integers(0, len(seed), 256)])
+            ans = np.empty(len(q), dtype=np.int64)
+            lib.ntpu_dict_probe(q.ctypes.data, len(q), keys.ctypes.data,
+                                values.ctypes.data, n_shards, cap,
+                                INSERT_MAX_PROBE, ans.ctypes.data)
+            if (ans < 0).any():
+                errs2.append("probe missed a present key")
+                stop2.set()
+                return
+
+    def encoder(tid):
+        for _ in range(8):
+            got = native_cdc.encode_batch_native(ebuf, eext, 3, 4)
+            if got is None or got[0].tobytes() != ref[0].tobytes() \
+                    or not (got[1] == ref[1]).all():
+                errs2.append("threaded batch encode diverged")
+                stop2.set()
+                return
+
+    probers2 = [threading.Thread(target=prober2, args=(i,)) for i in range(2)]
+    encoders = [threading.Thread(target=encoder, args=(i,)) for i in range(2)]
+    for t in probers2 + encoders:
+        t.start()
+    for t in encoders:
+        t.join()
+    stop2.set()
+    for t in probers2:
+        t.join()
+    assert not errs2, errs2
 
 # --- threaded pack_section arm under TSan: internal worker threads
 # assembling into one shared output buffer at bound-spaced offsets.

@@ -186,7 +186,15 @@ class TestNativeBuild:
                 f.write("0.0")
             assert native_build.ensure_built(target, "chunk_engine") is False
             with open(marker) as f:
-                assert f.read() == stamp  # memo refreshed to current stamp
+                memo = f.read()
+            # Memo refreshed to the current stamp (first line), with the
+            # failed compile's stderr riding along so repeat callers get
+            # the WHY without re-paying the doomed build.
+            assert memo.partition("\n")[0] == stamp
+            assert "No rule to make target" in memo
+            assert "No rule to make target" in native_build.failure_reason(
+                target
+            )
         finally:
             try:
                 os.unlink(marker)
