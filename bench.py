@@ -842,6 +842,37 @@ def peer_storm_run(repo: str, timeout: float = 240.0) -> dict:
         return {"error": "peer storm produced no JSON"}
 
 
+_PEER_TOPOLOGY_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from tools.cluster_storm_profile import topology_profile
+print(json.dumps(topology_profile(pods=6, mib=2, reps=1)))
+"""
+
+
+def peer_topology_run(repo: str, timeout: float = 300.0) -> dict:
+    """Hierarchical rack/zone/region topology profile (the ISSUE 18
+    `--topology` arm of tools/cluster_storm_profile.py) in a child under
+    the hard watchdog: per-zone origin-egress ratio vs unique bytes,
+    hedged-vs-unhedged slow-peer p99 (paired best-rep), and the
+    kill-a-zone identity arm. A 3-rack x 2-zone mesh of UDS servers
+    spins up — a wedge must cost one timeout, not a hang."""
+    res = _run_child_watchdog(
+        [sys.executable, "-c", _PEER_TOPOLOGY_CHILD.format(repo=repo)],
+        timeout=timeout,
+    )
+    if res is None:
+        return {"error": f"peer topology hung >{timeout:.0f}s (watchdog killed it)"}
+    rc, stdout, stderr = res
+    if rc != 0:
+        tail = stderr.strip().splitlines()[-1] if stderr.strip() else ""
+        return {"error": f"peer topology exited rc={rc}: {tail}"[:200]}
+    try:
+        return json.loads(stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": "peer topology produced no JSON"}
+
+
 _SOCI_CHILD = """
 import json, sys
 sys.path.insert(0, {repo!r})
@@ -1292,6 +1323,7 @@ def main() -> None:
     dict_ha_detail = dict_ha_run(repo)
     soak_detail = soak_run(repo)
     peer_storm = peer_storm_run(repo)
+    peer_topology = peer_topology_run(repo)
     fleet_obs = fleet_obs_run(repo)
     soci_detail = soci_run(repo)
     # Adaptive-codec engine numbers ride under detail.compression next
@@ -1340,6 +1372,7 @@ def main() -> None:
                     "dict_ha": dict_ha_detail,
                     "soak": soak_detail,
                     "peer_storm": peer_storm,
+                    "peer_topology": peer_topology,
                     "fleet_obs": fleet_obs,
                     "soci": soci_detail,
                     "accel_profile": accel_profile,

@@ -239,7 +239,9 @@ class PeerConfig:
     ``NTPU_PEER_PEERS``, ``NTPU_PEER_REGION_KIB``,
     ``NTPU_PEER_TIMEOUT_MS``, ``NTPU_PEER_PULL_THROUGH``,
     ``NTPU_PEER_MAX_CONCURRENT``, ``NTPU_PEER_DEMAND_RESERVE``,
-    ``NTPU_PEER_TENANT_WEIGHTS``) — that is also how the section reaches
+    ``NTPU_PEER_TENANT_WEIGHTS``, ``NTPU_PEER_LOCALITY``,
+    ``NTPU_PEER_HEDGE``, ``NTPU_PEER_HEDGE_WINDOW``,
+    ``NTPU_PEER_TIER_BUDGETS``) — that is also how the section reaches
     spawned daemon processes.
     """
 
@@ -260,6 +262,18 @@ class PeerConfig:
     # ``NTPU_PEER_MEMBERSHIP``, ``NTPU_PEER_MEMBERSHIP_REFRESH_MS``.
     membership: str = "auto"
     membership_refresh_secs: float = 2.0
+    # Hierarchical topology (daemon/peer.PeerRouter): ``locality`` is a
+    # ``rack:zone:region`` label (empty = flat single-tier routing);
+    # lookups walk rack owner -> zone shield -> origin. ``hedge`` arms
+    # the demand-lane hedged second request once a flight exceeds the
+    # rolling per-tier p99 over the last ``hedge_window`` samples
+    # (0 = default 64, minimum 8). ``tier_budgets`` caps in-flight bytes
+    # per tier ({"zone": 32} = 32 MiB) so a melting zone cannot starve
+    # rack-local service.
+    locality: str = ""
+    hedge: bool = True
+    hedge_window: int = 0
+    tier_budgets: dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -696,6 +710,17 @@ class SnapshotterConfig:
             )
         if self.peer.membership_refresh_secs <= 0:
             raise ConfigError("peer.membership_refresh_secs must be positive")
+        if self.peer.locality:
+            parts = [p.strip() for p in self.peer.locality.split(":")]
+            if len(parts) != 3 or not all(parts):
+                raise ConfigError(
+                    f"invalid peer.locality {self.peer.locality!r} "
+                    "(expected rack:zone:region)"
+                )
+        if self.peer.hedge_window < 0:
+            raise ConfigError("peer.hedge_window must be >= 0 (0 = default)")
+        if any(v <= 0 for v in self.peer.tier_budgets.values()):
+            raise ConfigError("peer.tier_budgets MiB caps must all be positive")
         if self.soci.stride_kib < 64:
             # Checkpoints below one deflate window apart are pure index
             # bloat: the window alone is 32 KiB.

@@ -37,6 +37,14 @@ Observability lands in ``metrics/registry.default_registry`` as
 the fetch / coalesce / readahead / admission boundaries
 (``blobcache.{fetch,coalesce,readahead}``, ``peer.admit``) so the overlap
 is chaos-testable (docs/robustness.md).
+
+Tail-latency weapons for the topology-aware peer tier (daemon/peer.py)
+also live here, because they are admission-gate disciplines: per-tier
+in-flight byte budgets on :class:`AdmissionGate` (``tier_acquire`` is
+strictly non-blocking — a melting zone sheds, it never starves
+rack-local service) and :class:`Hedger`, the rolling-p99 hedged second
+request with loser cancellation that can never double-charge the
+``MemoryBudget`` (the ``peer.hedge`` failpoint arms its launch point).
 """
 
 from __future__ import annotations
@@ -63,6 +71,13 @@ MAX_FETCH_WORKERS = 32
 DEFAULT_ADMIT_CONCURRENT = 64
 DEFAULT_DEMAND_RESERVE = 1
 DEFAULT_TENANT = "default"
+# Tail-latency hedging (daemon/peer.py tier waterfall): a demand peer
+# read past its tier's rolling p99 fires ONE hedged second request at
+# the next tier. The p99 trigger bounds added egress to ~1% of flights
+# by construction; the window is the rolling-percentile sample count.
+DEFAULT_HEDGE_WINDOW = 64
+HEDGE_MIN_SAMPLES = 20
+HEDGE_PERCENTILE = 0.99
 
 # Flight priority lanes, strictly ordered: a demand read outranks the
 # sequential readahead window, which outranks prefetch-list replay, which
@@ -194,6 +209,29 @@ ADMIT_LANE_CAP = _reg.register(
         "ntpu_admission_lane_cap",
         "Current per-lane concurrency cap (-1 = unlimited, 0 = lane shed)",
         ("lane",),
+    )
+)
+ADMIT_TIER_INFLIGHT = _reg.register(
+    _metrics.Gauge(
+        "ntpu_admission_tier_inflight_bytes",
+        "In-flight peer-read bytes currently admitted per topology tier",
+        ("tier",),
+    )
+)
+ADMIT_TIER_REJECTED = _reg.register(
+    _metrics.Counter(
+        "ntpu_admission_tier_rejected_total",
+        "Peer-read attempts a tier's in-flight byte budget walked past"
+        " (the caller fell through to the next tier immediately)",
+        ("tier",),
+    )
+)
+HEDGE_TOTAL = _reg.register(
+    _metrics.Counter(
+        "ntpu_peer_hedge_total",
+        "Hedged second requests on slow peer-tier demand reads, by"
+        " outcome (fired / won / cancelled / skipped / error)",
+        ("outcome",),
     )
 )
 
@@ -472,6 +510,60 @@ def resolve_admission() -> tuple[int, int, dict[str, float]]:
     return max(1, max_c), max(0, reserve), weights
 
 
+def parse_tier_budgets(spec: str) -> dict[str, int]:
+    """``"zone=32,origin=64"`` (MiB per tier) → per-tier in-flight byte
+    caps (bad entries ignored; an unlisted tier is unbudgeted)."""
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        name, _, v = part.strip().partition("=")
+        if not name or not v:
+            continue
+        try:
+            mib = int(v)
+        except ValueError:
+            continue
+        if mib > 0:
+            out[name] = mib << 20
+    return out
+
+
+def resolve_tier_budgets() -> dict[str, int]:
+    """Per-tier in-flight byte budgets for the admission gate: env
+    (``NTPU_PEER_TIER_BUDGETS``, MiB spec) > ``[peer] tier_budgets`` >
+    unbudgeted. A budgeted tier sheds (walks past) rather than queues —
+    a melting zone cannot starve rack-local service."""
+    env = os.environ.get("NTPU_PEER_TIER_BUDGETS", "")
+    if env:
+        return parse_tier_budgets(env)
+    pc = _global_peer_config()
+    out: dict[str, int] = {}
+    for k, v in dict(getattr(pc, "tier_budgets", None) or {}).items():
+        try:
+            mib = int(v)
+        except (TypeError, ValueError):
+            continue
+        if mib > 0:
+            out[str(k)] = mib << 20
+    return out
+
+
+def resolve_hedge() -> tuple[bool, int]:
+    """(enabled, window) for peer-read tail hedging: env
+    (``NTPU_PEER_HEDGE``, ``NTPU_PEER_HEDGE_WINDOW``) > ``[peer]``
+    config > defaults."""
+    pc = _global_peer_config()
+    env = os.environ.get("NTPU_PEER_HEDGE", "")
+    if env:
+        enabled = env not in ("0", "off", "false")
+    else:
+        enabled = bool(getattr(pc, "hedge", True))
+    window = _env_int(
+        "NTPU_PEER_HEDGE_WINDOW",
+        getattr(pc, "hedge_window", 0) or DEFAULT_HEDGE_WINDOW,
+    )
+    return enabled, max(8, window)
+
+
 class _Ticket:
     __slots__ = ("tenant", "lane", "n", "seq")
 
@@ -519,6 +611,7 @@ class AdmissionGate:
         demand_reserve: int = DEFAULT_DEMAND_RESERVE,
         weights: Optional[dict[str, float]] = None,
         name: str = "gate",
+        tier_budgets: Optional[dict[str, int]] = None,
     ):
         self.budget = budget or shared_budget()
         self.cap = self.budget.total
@@ -544,6 +637,15 @@ class AdmissionGate:
         self._lane_caps: list[Optional[int]] = [None] * N_LANES
         self._lane_in_service = [0] * N_LANES
         self._shed_total = [0] * N_LANES
+        # Per-tier in-flight byte budgets (peer-read topology tiers:
+        # rack / zone / origin). Orthogonal to lanes: a tier cap never
+        # queues — tier_acquire is strictly non-blocking, the caller
+        # walks to the next tier on a full budget.
+        self._tier_caps: dict[str, int] = {
+            str(t): max(0, int(c)) for t, c in (tier_budgets or {}).items()
+        }
+        self._tier_bytes: dict[str, int] = {}
+        self._tier_rejected: dict[str, int] = {}
         # Demand-pressure signal (scale-up actuation, metrics/slo.py
         # SloScaleUp): an EWMA of demand-lane queue waits plus the live
         # queue depth — cheap enough to keep on every acquire, read
@@ -583,6 +685,64 @@ class AdmissionGate:
                     "shed_total": self._shed_total[i],
                 }
                 for i in range(N_LANES)
+            }
+
+    # -- per-tier byte budgets (peer-read topology) ---------------------------
+
+    def set_tier_budget(self, tier: str, cap: Optional[int]) -> None:
+        """Bound one tier's in-flight peer-read bytes (``None`` removes
+        the cap). Like the MemoryBudget, one read larger than the whole
+        cap admits alone rather than wedging the tier."""
+        with self._cv:
+            self._state_shared.write()
+            if cap is None:
+                self._tier_caps.pop(tier, None)
+            else:
+                self._tier_caps[tier] = max(0, int(cap))
+
+    def tier_acquire(self, tier: str, n: int) -> bool:
+        """Non-blocking per-tier byte admission. False = the tier's
+        budget is full RIGHT NOW: the caller falls through to the next
+        tier (or origin) immediately — a melting zone must not starve
+        rack-local service by queueing demand reads behind it. A True
+        must be paired with :meth:`tier_release`."""
+        n = max(0, int(n))
+        with self._cv:
+            self._state_shared.write()
+            cap = self._tier_caps.get(tier)
+            used = self._tier_bytes.get(tier, 0)
+            if cap is not None and used > 0 and used + n > cap:
+                self._tier_rejected[tier] = self._tier_rejected.get(tier, 0) + 1
+                ADMIT_TIER_REJECTED.labels(tier).inc()
+                return False
+            self._tier_bytes[tier] = used + n
+            ADMIT_TIER_INFLIGHT.labels(tier).set(used + n)
+        return True
+
+    def tier_release(self, tier: str, n: int) -> None:
+        n = max(0, int(n))
+        with self._cv:
+            self._state_shared.write()
+            left = max(0, self._tier_bytes.get(tier, 0) - n)
+            self._tier_bytes[tier] = left
+            ADMIT_TIER_INFLIGHT.labels(tier).set(left)
+
+    def tier_state(self) -> dict:
+        """{tier: {cap, inflight_bytes, rejected_total}} budget view."""
+        with self._cv:
+            self._state_shared.read()
+            tiers = (
+                set(self._tier_caps)
+                | set(self._tier_bytes)
+                | set(self._tier_rejected)
+            )
+            return {
+                t: {
+                    "cap": self._tier_caps.get(t),
+                    "inflight_bytes": self._tier_bytes.get(t, 0),
+                    "rejected_total": self._tier_rejected.get(t, 0),
+                }
+                for t in sorted(tiers)
             }
 
     # -- admission predicate (caller holds self._cv) -------------------------
@@ -709,6 +869,58 @@ class AdmissionGate:
             raise
         return waited
 
+    def try_acquire(
+        self, n: int, tenant: str = DEFAULT_TENANT, lane: int = DEMAND
+    ) -> bool:
+        """Non-blocking acquire for hedged second requests: admitted
+        only when a slot AND the bytes are free right now with nobody
+        queued at this or a higher lane — a hedge is pure opportunism,
+        it must never displace or delay first-request traffic. Returns
+        False instead of queueing; a True must be paired with the usual
+        ``release(n, tenant, lane)``."""
+        n = max(0, int(n))
+        lane = min(max(0, int(lane)), N_LANES - 1)
+        with self._cv:
+            self._state_shared.write()
+            if self._lane_caps[lane] == 0:
+                self._shed_total[lane] += 1
+                ADMIT_SHED.labels(LANE_NAMES[lane]).inc()
+                return False
+            t = _Ticket(tenant, lane, n, self._seq + 1)
+            if any(w.lane <= lane for w in self._waiters) or not self._fits(t):
+                return False
+            self._seq += 1
+            self._in_service += 1
+            self._lane_in_service[lane] += 1
+            self._held += n
+            self._tenant_bytes[tenant] = self._tenant_bytes.get(tenant, 0) + n
+            self._tenant_service[tenant] = (
+                self._tenant_service.get(tenant, 0) + n
+            )
+            self._admitted[lane] += 1
+            ADMIT_TENANT_BYTES.labels(tenant).set(self._tenant_bytes[tenant])
+        ADMITTED.labels(LANE_NAMES[lane]).inc()
+        # Settle against the shared byte pool outside the gate lock, non-
+        # blocking: budget co-users holding bytes fail the hedge instead
+        # of queueing it.
+        if not self.budget.try_acquire(n, timeout=0.0):
+            with self._cv:
+                self._state_shared.write()
+                self._in_service = max(0, self._in_service - 1)
+                self._lane_in_service[lane] = max(
+                    0, self._lane_in_service[lane] - 1
+                )
+                self._held = max(0, self._held - n)
+                self._tenant_bytes[tenant] = max(
+                    0, self._tenant_bytes.get(tenant, 0) - n
+                )
+                ADMIT_TENANT_BYTES.labels(tenant).set(
+                    self._tenant_bytes[tenant]
+                )
+                self._cv.notify_all()
+            return False
+        return True
+
     def release(
         self, n: int, tenant: str = DEFAULT_TENANT, lane: int = DEMAND
     ) -> None:
@@ -747,6 +959,18 @@ class AdmissionGate:
                 "shed_per_lane": dict(zip(LANE_NAMES, self._shed_total)),
                 "tenant_inflight_bytes": dict(self._tenant_bytes),
                 "tenant_service_bytes": dict(self._tenant_service),
+                "tiers": {
+                    t: {
+                        "cap": self._tier_caps.get(t),
+                        "inflight_bytes": self._tier_bytes.get(t, 0),
+                        "rejected_total": self._tier_rejected.get(t, 0),
+                    }
+                    for t in sorted(
+                        set(self._tier_caps)
+                        | set(self._tier_bytes)
+                        | set(self._tier_rejected)
+                    )
+                },
             }
 
     def demand_pressure(self) -> dict:
@@ -793,11 +1017,253 @@ def shared_gate() -> AdmissionGate:
         demand_reserve=reserve,
         weights=weights,
         name="shared",
+        tier_budgets=resolve_tier_budgets(),
     )
     with _shared_gate_lock:
         if _shared_gate is None:
             _shared_gate = gate
         return _shared_gate
+
+
+# ---------------------------------------------------------------------------
+# Tail-latency hedging (the peer tier's demand lane)
+# ---------------------------------------------------------------------------
+
+
+class RollingPercentile:
+    """Rolling latency percentile over the last ``window`` samples — the
+    trace exemplar reservoir's discipline (trace/export.py ExemplarStore):
+    a bounded deque, sorted lazily by the reader. Below ``min_samples``
+    there is no estimate at all — with no history every flight "exceeds
+    p99" and a hedge trigger would be pure noise."""
+
+    __slots__ = ("_samples", "min_samples")
+
+    def __init__(
+        self,
+        window: int = DEFAULT_HEDGE_WINDOW,
+        min_samples: int = HEDGE_MIN_SAMPLES,
+    ):
+        self._samples: deque = deque(maxlen=max(8, int(window)))
+        self.min_samples = max(1, int(min_samples))
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def record(self, ms: float) -> None:
+        self._samples.append(float(ms))
+
+    def percentile(self, q: float = HEDGE_PERCENTILE) -> Optional[float]:
+        snap = sorted(self._samples)
+        if len(snap) < self.min_samples:
+            return None
+        return snap[min(len(snap) - 1, int(q * len(snap)))]
+
+
+class Hedger:
+    """Hedged second requests for slow demand-lane peer reads.
+
+    A flight that exceeds its tier's rolling p99 fires ONE hedge at the
+    next tier (or origin); the first good response wins. The loser is
+    cancelled by ACCOUNTING, not interruption: the hedge admits its own
+    bytes through a non-blocking :meth:`AdmissionGate.try_acquire` (a
+    saturated node skips the hedge rather than queueing it behind
+    first-request traffic) and the hedge thread releases that charge in
+    its own ``finally`` — win or lose — so a hedged flight can never
+    double-charge the MemoryBudget (property-tested across 1k flights in
+    tests/test_peer_hedge.py). Because the trigger is the rolling p99,
+    at most ~1% of flights hedge: added egress is bounded by
+    construction, which is the analytic bound the storm profile gates.
+
+    The ``peer.hedge`` failpoint fires at the hedge-launch boundary; an
+    armed failure aborts the hedge and the primary proceeds exactly as
+    an unhedged flight (docs/robustness.md).
+    """
+
+    def __init__(
+        self,
+        gate: Optional[AdmissionGate] = None,
+        enabled: bool = True,
+        window: int = DEFAULT_HEDGE_WINDOW,
+        percentile: float = HEDGE_PERCENTILE,
+        name: str = "hedge",
+    ):
+        self.gate = gate if gate is not None else shared_gate()
+        self.enabled = enabled
+        self.percentile = percentile
+        self.window = max(8, int(window))
+        self._mu = _an.make_lock(f"fetch.hedge[{name}]")
+        # Lockset annotation: per-tier latency windows and the outcome
+        # counters only mutate under self._mu (NTPU_ANALYZE=1 verifies).
+        self._state_shared = _an.shared(f"fetch.hedge.state[{name}]")
+        self._lat: dict[str, RollingPercentile] = {}
+        self._counts: dict[str, int] = {}
+
+    def record(self, tier: str, ms: float) -> None:
+        with self._mu:
+            self._state_shared.write()
+            rp = self._lat.get(tier)
+            if rp is None:
+                rp = self._lat[tier] = RollingPercentile(self.window)
+            rp.record(ms)
+
+    def threshold_ms(self, tier: str) -> Optional[float]:
+        """The tier's rolling p99, or None while the window is cold."""
+        with self._mu:
+            self._state_shared.read()
+            rp = self._lat.get(tier)
+            return rp.percentile(self.percentile) if rp is not None else None
+
+    def _count(self, outcome: str) -> None:
+        with self._mu:
+            self._state_shared.write()
+            self._counts[outcome] = self._counts.get(outcome, 0) + 1
+        HEDGE_TOTAL.labels(outcome).inc()
+
+    def counters(self) -> dict:
+        with self._mu:
+            self._state_shared.read()
+            return {
+                k: self._counts.get(k, 0)
+                for k in ("fired", "won", "cancelled", "skipped", "error")
+            }
+
+    def fetch(
+        self,
+        size: int,
+        tier: str,
+        primary: Callable[[], bytes],
+        hedge_tier: Optional[str] = None,
+        hedge: Optional[Callable[[], bytes]] = None,
+        tenant: str = DEFAULT_TENANT,
+        lane: int = DEMAND,
+    ) -> tuple[bytes, str]:
+        """Run ``primary()``; past the tier's rolling p99, race
+        ``hedge()`` against it. Returns ``(data, winner_tier)``. When
+        both sides fail the PRIMARY error propagates, so the caller's
+        tier waterfall degrades exactly as it does unhedged."""
+        threshold = self.threshold_ms(tier) if self.enabled else None
+        t0 = perf_counter()
+        if threshold is None or hedge is None:
+            data = primary()
+            self.record(tier, (perf_counter() - t0) * 1000.0)
+            return data, tier
+
+        cv = threading.Condition()
+        results: dict[str, tuple] = {}
+
+        def run(which: str, fn, charged: bool) -> None:
+            t1 = perf_counter()
+            try:
+                out = (fn(), (perf_counter() - t1) * 1000.0, None)
+            except BaseException as e:  # noqa: BLE001 — surfaced to the waiter
+                out = (None, None, e)
+            finally:
+                if charged:
+                    # Loser-cancellation invariant: the hedge's extra
+                    # charge is released HERE, by the thread that owns
+                    # it, win or lose — never by the winner's path.
+                    self.gate.release(size, tenant=tenant, lane=lane)
+            with cv:
+                results[which] = out
+                cv.notify_all()
+
+        threading.Thread(
+            target=run,
+            args=("primary", primary, False),
+            name="ntpu-hedge-primary",
+            daemon=True,
+        ).start()
+        with cv:
+            cv.wait_for(
+                lambda: "primary" in results, timeout=threshold / 1000.0
+            )
+            done = dict(results)
+        hedged = False
+        if "primary" not in done:
+            # Past the tier's p99: fire the second request — IF the gate
+            # admits its bytes right now (a hedge never queues) and the
+            # chaos site lets it.
+            try:
+                failpoint.hit("peer.hedge")
+                hedged = self.gate.try_acquire(size, tenant=tenant, lane=lane)
+            except Exception:  # noqa: BLE001 — armed chaos aborts the
+                hedged = False  # hedge, never the primary
+            if hedged:
+                self._count("fired")
+                threading.Thread(
+                    target=run,
+                    args=("hedge", hedge, True),
+                    name="ntpu-hedge-second",
+                    daemon=True,
+                ).start()
+            else:
+                self._count("skipped")
+        want = {"primary", "hedge"} if hedged else {"primary"}
+        while True:
+            with cv:
+                cv.wait_for(
+                    lambda: len(results) > len(done)
+                    or (want & set(results)) == want
+                )
+                done = dict(results)
+            for which in ("hedge", "primary"):
+                if which in done and done[which][2] is None:
+                    if which == "hedge":
+                        self._count("won")
+                    elif hedged:
+                        self._count("cancelled")
+                    win_tier = tier if which == "primary" else (
+                        hedge_tier or "origin"
+                    )
+                    # Only the DELIVERED latency enters the rolling
+                    # window: a cancelled loser's eventual completion
+                    # was never observed by the caller, and recording
+                    # it would let one persistently slow peer ratchet
+                    # the p99 trigger up to its own latency, disarming
+                    # the hedge that is routing around it.
+                    self.record(win_tier, done[which][1])
+                    return done[which][0], win_tier
+            if (want & set(done)) == want:
+                if hedged and done.get("hedge", (None, None, None))[2] is not None:
+                    self._count("error")
+                err = done["primary"][2]
+                if isinstance(err, Exception):
+                    raise err
+                raise OSError(str(err))
+
+
+_shared_hedger: Optional[Hedger] = None
+_shared_hedger_lock = threading.Lock()
+
+
+def shared_hedger() -> Hedger:
+    """Process-wide hedger every peer-aware fetcher without an explicit
+    one shares: the rolling per-tier latency windows are per NODE —
+    every flight's sample sharpens every other flight's trigger."""
+    global _shared_hedger
+    with _shared_hedger_lock:
+        if _shared_hedger is not None:
+            return _shared_hedger
+    # Build outside the lock (shared_gate takes its own module lock —
+    # never nest the two); publish first-wins.
+    enabled, window = resolve_hedge()
+    hedger = Hedger(
+        gate=shared_gate(), enabled=enabled, window=window, name="shared"
+    )
+    with _shared_hedger_lock:
+        if _shared_hedger is None:
+            _shared_hedger = hedger
+        return _shared_hedger
+
+
+def hedge_counters() -> dict:
+    """Cumulative ``ntpu_peer_hedge_total`` values by outcome (ntpuctl
+    and the storm profile delta these around a run)."""
+    return {
+        k: HEDGE_TOTAL.value(k)
+        for k in ("fired", "won", "cancelled", "skipped", "error")
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -33,8 +33,38 @@ AdmissionGate` at PEER_SERVE priority, below local demand, readahead and
 prefetch replay — a node under local pressure sheds peer traffic first
 (requesters transparently fall back to the registry).
 
-Failpoint sites ``peer.{serve,fetch,admit}`` make every boundary
-chaos-testable (docs/robustness.md); metrics land as ``ntpu_peer_*``;
+At planet scale the flat ring is not enough: racks × zones × regions
+have wildly asymmetric link costs, and one slow peer can hold a demand
+read's tail hostage. The **hierarchical read tier** makes the topology
+explicit: every member carries a ``rack:zone:region`` locality label
+(``[peer] locality`` / ``NTPU_PEER_LOCALITY``, advertised through the
+membership records), and lookups walk a two-level rendezvous —
+
+- **rack owner**: rendezvous over this node's rack members; the cheap
+  hop, tried first;
+- **zone shield**: rendezvous over the zone's members; the shield is
+  the zone's single serve point against origin — with pull-through it
+  fetches a forwarded cold extent ONCE per zone (the only node whose
+  pull-through rule ignores the relay-depth bound), so a region's
+  unique bytes cross the zone boundary exactly once;
+- **origin**: the registry, reached only by the shield (or by a node
+  whose tiers are all cooling down — health cooldowns walk past dead
+  tiers immediately, never time out twice).
+
+Zone shields double as caching proxies for the hot small artifacts
+(soci indexes via ``/api/v1/peer/soci/*``, trained zdicts / dict
+journal tails via ``/api/v1/peer/artifact/<kind>/<key>``): on a miss
+the shield adopts the artifact from the flat owner once and re-serves
+it zone-locally. Tail latency rides the fetch scheduler's
+:class:`~nydus_snapshotter_tpu.daemon.fetch_sched.Hedger`: a flight
+past its tier's rolling p99 races a hedged second request at the next
+tier, loser cancelled by accounting (never a double charge, never a
+double-fetch into the cache — the winner's bytes are the only bytes
+delivered).
+
+Failpoint sites ``peer.{serve,fetch,admit,member,hedge,tier}`` make
+every boundary chaos-testable (docs/robustness.md); metrics land as
+``ntpu_peer_*`` (per-tier egress under ``ntpu_peer_tier_egress_bytes``);
 trace context rides the same ``x-ntpu-trace-*`` headers the dict service
 uses, so a peer-served read's span tree spans both nodes.
 """
@@ -69,6 +99,30 @@ DEFAULT_TIMEOUT_MS = 1500
 PEER_FAILURE_LIMIT = 3
 PEER_COOLDOWN_SECS = 2.0
 MAX_SERVE_BYTES = 64 << 20  # one ranged peer read, not a blob mirror
+
+# Topology tiers, in link-cost order. "flat" is the pre-topology single
+# rendezvous ring (no locality configured); "origin" is the registry
+# fallthrough. TIER_COSTS is the score multiplier of the cost-aware
+# ranking: the tier distance DOMINATES the rendezvous score, so a rack
+# hop always outranks a zone hop and the numbers only matter relative
+# to each other.
+TIER_RACK = "rack"
+TIER_ZONE = "zone"
+TIER_FLAT = "flat"
+TIER_ORIGIN = "origin"
+TIER_COSTS = {TIER_RACK: 1.0, TIER_FLAT: 1.0, TIER_ZONE: 4.0}
+
+
+def parse_locality(label: str) -> Optional[tuple[str, str, str]]:
+    """``"rack:zone:region"`` → ``(rack, zone, region)``, or None for an
+    empty/malformed label (a label-less member routes flat — topology is
+    strictly opt-in, mixed fleets keep working)."""
+    if not label:
+        return None
+    parts = [p.strip() for p in str(label).split(":")]
+    if len(parts) != 3 or not all(parts):
+        return None
+    return parts[0], parts[1], parts[2]
 
 _reg = _metrics.default_registry
 SERVE_REQUESTS = _reg.register(
@@ -132,6 +186,14 @@ MEMBERSHIP_EVENTS = _reg.register(
         ("kind",),
     )
 )
+TIER_EGRESS = _reg.register(
+    _metrics.Counter(
+        "ntpu_peer_tier_egress_bytes",
+        "Peer-read bytes by the topology tier that served them"
+        " (rack / zone / flat peer / origin fallthrough)",
+        ("tier",),
+    )
+)
 
 
 def snapshot_counters() -> dict:
@@ -148,6 +210,15 @@ def snapshot_counters() -> dict:
         "fallback_timeout": FETCH_FALLBACKS.value("timeout"),
         "fallback_error": FETCH_FALLBACKS.value("error"),
         "fallback_corrupt": FETCH_FALLBACKS.value("corrupt"),
+        "fallback_budget": FETCH_FALLBACKS.value("budget"),
+        "tier_rack_bytes": TIER_EGRESS.value(TIER_RACK),
+        "tier_zone_bytes": TIER_EGRESS.value(TIER_ZONE),
+        "tier_flat_bytes": TIER_EGRESS.value(TIER_FLAT),
+        "tier_origin_bytes": TIER_EGRESS.value(TIER_ORIGIN),
+        **{
+            f"hedge_{k}": v
+            for k, v in fetch_sched.hedge_counters().items()
+        },
     }
 
 
@@ -170,10 +241,12 @@ class PeerRuntimeConfig:
     __slots__ = (
         "enable", "listen", "peers", "region_bytes", "timeout_s",
         "pull_through", "membership", "membership_refresh_s",
+        "locality", "hedge", "hedge_window", "tier_budgets",
     )
 
     def __init__(self, enable, listen, peers, region_bytes, timeout_s,
-                 pull_through, membership="auto", membership_refresh_s=2.0):
+                 pull_through, membership="auto", membership_refresh_s=2.0,
+                 locality="", hedge=True, hedge_window=0, tier_budgets=None):
         self.enable = enable
         self.listen = listen
         self.peers = peers
@@ -185,6 +258,12 @@ class PeerRuntimeConfig:
         # is known, static otherwise.
         self.membership = membership
         self.membership_refresh_s = membership_refresh_s
+        # "rack:zone:region" label of THIS node ("" = flat routing).
+        self.locality = locality
+        self.hedge = hedge
+        self.hedge_window = hedge_window
+        # tier name -> in-flight byte cap (bytes, resolved from MiB).
+        self.tier_budgets = dict(tier_budgets or {})
 
 
 def _env_bool(name: str, default: bool) -> bool:
@@ -225,6 +304,7 @@ def resolve_peer_config() -> PeerRuntimeConfig:
         "NTPU_PEER_MEMBERSHIP_REFRESH_MS",
         int(float(getattr(pc, "membership_refresh_secs", 0) or 2.0) * 1000),
     )
+    hedge_on, hedge_window = fetch_sched.resolve_hedge()
     return PeerRuntimeConfig(
         enable=_env_bool("NTPU_PEER_ENABLE", bool(getattr(pc, "enable", False))),
         listen=os.environ.get("NTPU_PEER_LISTEN", getattr(pc, "listen", "")),
@@ -238,6 +318,12 @@ def resolve_peer_config() -> PeerRuntimeConfig:
             "NTPU_PEER_MEMBERSHIP", getattr(pc, "membership", "auto") or "auto"
         ),
         membership_refresh_s=max(0.05, refresh_ms / 1000.0),
+        locality=os.environ.get(
+            "NTPU_PEER_LOCALITY", getattr(pc, "locality", "") or ""
+        ),
+        hedge=hedge_on,
+        hedge_window=hedge_window,
+        tier_budgets=fetch_sched.resolve_tier_budgets(),
     )
 
 
@@ -275,6 +361,11 @@ class PeerExport:
         # blob_id -> persisted soci index path this node can replicate
         # (checksummed on the wire by the requester's index load).
         self._soci: dict[str, str] = {}
+        # Small hot artifacts beyond soci indexes (trained zdicts, dict
+        # journal tails): (kind, key) -> file path. Zone shields adopt
+        # remote ones into _adopted and re-serve them zone-locally.
+        self._artifacts: dict[tuple[str, str], str] = {}
+        self._adopted: dict[tuple[str, str], bytes] = {}
 
     def register(self, blob_id: str, cached_blob) -> None:
         with self._mu:
@@ -312,17 +403,56 @@ class PeerExport:
             self._blobs_shared.read()
             return self._soci.get(blob_id)
 
+    # -- generic artifact plane (zdicts, journal tails, ...) -----------------
+
+    def register_artifact(self, kind: str, key: str, path: str) -> None:
+        """Announce a small persisted artifact (a trained zdict, a dict
+        journal tail snapshot) under ``/api/v1/peer/artifact/kind/key``
+        — the hierarchy's replication unit beyond chunk extents."""
+        with self._mu:
+            self._blobs_shared.write()
+            self._artifacts[(kind, key)] = path
+
+    def unregister_artifact(self, kind: str, key: str) -> None:
+        with self._mu:
+            self._blobs_shared.write()
+            self._artifacts.pop((kind, key), None)
+
+    def artifact_path(self, kind: str, key: str):
+        with self._mu:
+            self._blobs_shared.read()
+            return self._artifacts.get((kind, key))
+
+    def adopt_artifact(self, kind: str, key: str, payload: bytes) -> None:
+        """Shield-adopted remote artifact, re-served from memory.
+        Bounded count with oldest-first eviction — these are small hot
+        artifacts, not a blob mirror."""
+        with self._mu:
+            self._blobs_shared.write()
+            self._adopted[(kind, key)] = bytes(payload)
+            while len(self._adopted) > 64:
+                self._adopted.pop(next(iter(self._adopted)))
+
+    def adopted_artifact(self, kind: str, key: str):
+        with self._mu:
+            self._blobs_shared.read()
+            return self._adopted.get((kind, key))
+
     def stats(self) -> dict:
         with self._mu:
             self._blobs_shared.read()
             blobs = dict(self._blobs)
             soci = dict(self._soci)
+            artifacts = sorted(self._artifacts)
+            adopted = sorted(self._adopted)
         return {
             "blobs": {
                 bid: {"covered_bytes": cb.coverage_bytes()}
                 for bid, cb in blobs.items()
             },
             "soci_indexes": sorted(soci),
+            "artifacts": [f"{k}/{key}" for k, key in artifacts],
+            "adopted": [f"{k}/{key}" for k, key in adopted],
         }
 
 
@@ -333,6 +463,7 @@ class PeerExport:
 
 _BLOB_ROUTE = "/api/v1/peer/blob/"
 _SOCI_ROUTE = "/api/v1/peer/soci/"
+_ART_ROUTE = "/api/v1/peer/artifact/"
 _STAT_ROUTE = "/api/v1/peer/stat"
 
 
@@ -396,8 +527,12 @@ class PeerChunkServer:
         if parsed.path == _STAT_ROUTE:
             stat = self.export.stats()
             stat["admission"] = self.gate.lane_state()
-            if self.router is not None and self.router.membership is not None:
-                stat["membership"] = self.router.membership.snapshot()
+            stat["tiers"] = self.gate.tier_state()
+            stat["hedge"] = fetch_sched.hedge_counters()
+            if self.router is not None:
+                stat["topology"] = self.router.topology()
+                if self.router.membership is not None:
+                    stat["membership"] = self.router.membership.snapshot()
             body = json.dumps(stat).encode()
             return 200, {"Content-Type": "application/json"}, body
         if parsed.path == "/api/v1/traces":
@@ -414,22 +549,16 @@ class PeerChunkServer:
             # amortizes across the fleet. The requester revalidates the
             # embedded SHA-256 before adopting (a corrupt relay costs a
             # local rebuild, never a poisoned read).
-            path = self.export.soci_path(parsed.path[len(_SOCI_ROUTE):])
-            if path is None:
-                SERVE_REQUESTS.labels("miss").inc()
-                return 404, {}, b'{"message": "no soci index"}'
-            try:
-                with open(path, "rb") as f:
-                    body = f.read()
-            except OSError as e:
-                SERVE_REQUESTS.labels("error").inc()
-                return 500, {}, json.dumps({"message": str(e)}).encode()
-            SERVE_REQUESTS.labels("hit").inc()
-            SERVED_BYTES.inc(len(body))
-            return 200, {
-                "Content-Type": "application/octet-stream",
-                "x-ntpu-peer-crc32": f"{_crc32(body):08x}",
-            }, body
+            blob_id = parsed.path[len(_SOCI_ROUTE):]
+            return self._serve_artifact("soci", blob_id, headers)
+        if parsed.path.startswith(_ART_ROUTE) and method == "GET":
+            # Generic small-artifact replication (trained zdicts, dict
+            # journal tail snapshots): same serve/adopt discipline as
+            # soci indexes, keyed "<kind>/<key>".
+            kind, _, key = parsed.path[len(_ART_ROUTE):].partition("/")
+            if not kind or not key:
+                return 400, {}, b'{"message": "bad artifact key"}'
+            return self._serve_artifact(kind, key, headers)
         if not parsed.path.startswith(_BLOB_ROUTE) or method != "GET":
             return 404, {}, b'{"message": "no such endpoint"}'
         blob_id = parsed.path[len(_BLOB_ROUTE):]
@@ -460,9 +589,17 @@ class PeerChunkServer:
                         outcome = "miss"
                         return 404, {}, b'{"message": "unknown blob"}'
                     covered = cb.covered(offset, size)
-                    if not covered and (depth > 0 or not self.pull_through):
-                        # Cover-only serving: never fetch on behalf of a
-                        # forwarded request — bounds the relay depth.
+                    if not covered and not self.pull_through:
+                        outcome = "miss"
+                        return 404, {}, b'{"message": "extent not cached"}'
+                    if not covered and depth > 0 and not (
+                        self.router is not None
+                        and self.router.is_shield(blob_id, offset)
+                    ):
+                        # Cover-only serving for forwarded requests —
+                        # bounds the relay depth — EXCEPT at the zone
+                        # shield, whose whole job is pulling a forwarded
+                        # cold extent through origin once per zone.
                         outcome = "miss"
                         return 404, {}, b'{"message": "extent not cached"}'
                     if covered:
@@ -504,6 +641,76 @@ class PeerChunkServer:
         finally:
             SERVE_REQUESTS.labels(outcome).inc()
             SERVE_MS.labels(outcome).observe((perf_counter() - t0) * 1000.0)
+
+    # -- artifact serving (soci indexes, zdicts, journal tails) --------------
+
+    def _serve_artifact(
+        self, kind: str, key: str, headers
+    ) -> tuple[int, dict, bytes]:
+        path = (
+            self.export.soci_path(key)
+            if kind == "soci"
+            else self.export.artifact_path(kind, key)
+        )
+        body = None
+        outcome = "hit"
+        if path is not None:
+            try:
+                with open(path, "rb") as f:
+                    body = f.read()
+            except OSError as e:
+                SERVE_REQUESTS.labels("error").inc()
+                return 500, {}, json.dumps({"message": str(e)}).encode()
+        if body is None:
+            body = self.export.adopted_artifact(kind, key)
+        if body is None:
+            body = self._shield_adopt(
+                kind, key, headers.get("x-ntpu-peer-depth", "0")
+            )
+            outcome = "pull"
+        if body is None:
+            SERVE_REQUESTS.labels("miss").inc()
+            return 404, {}, b'{"message": "no such artifact"}'
+        SERVE_REQUESTS.labels(outcome).inc()
+        SERVED_BYTES.inc(len(body))
+        return 200, {
+            "Content-Type": "application/octet-stream",
+            "x-ntpu-peer-crc32": f"{_crc32(body):08x}",
+        }, body
+
+    def _shield_adopt(self, kind: str, key: str, depth) -> Optional[bytes]:
+        """Zone-shield caching proxy: on an artifact miss the shield
+        pulls it ONCE from the flat owner (the topology-blind rendezvous
+        owner — where the artifact was first built and persisted),
+        adopts it, and re-serves it zone-locally — replicate down the
+        hierarchy instead of re-deriving per zone. Best-effort: any
+        failure is a plain miss (the requester rebuilds locally), and a
+        forwarded (depth > 0) request never adopts, which bounds the
+        relay exactly like chunk serving."""
+        try:
+            if int(depth) > 0:
+                return None
+        except (TypeError, ValueError):
+            return None
+        if (
+            not self.pull_through
+            or self.router is None
+            or not self.router.is_shield(key, 0)
+        ):
+            return None
+        owner = self.router.flat_owner(key)
+        if owner is None:
+            return None
+        try:
+            client = PeerClient(owner, resolve_peer_config().timeout_s)
+            if kind == "soci":
+                body = client.fetch_soci_index(key, depth=1)
+            else:
+                body = client.fetch_artifact(kind, key, depth=1)
+        except PeerError:
+            return None
+        self.export.adopt_artifact(kind, key, body)
+        return body
 
     # -- server lifecycle ----------------------------------------------------
 
@@ -645,17 +852,28 @@ class PeerClient:
             raise PeerError(f"peer {self.address} payload failed CRC32 check")
         return payload
 
-    def fetch_soci_index(self, blob_id: str) -> bytes:
+    def fetch_soci_index(self, blob_id: str, depth: int = 0) -> bytes:
         """The peer's persisted soci index artifact for ``blob_id``
         (serialized; the caller revalidates its embedded checksum).
         Raises :class:`PeerMiss`/:class:`PeerError` like ``read_range``."""
+        return self._fetch_checked(f"{_SOCI_ROUTE}{blob_id}", depth)
+
+    def fetch_artifact(self, kind: str, key: str, depth: int = 0) -> bytes:
+        """A small named artifact (``zdict``, ``journal``, ...) from the
+        peer's export — the zone-shield replication unit beyond chunk
+        extents. Raises :class:`PeerMiss`/:class:`PeerError`."""
+        return self._fetch_checked(f"{_ART_ROUTE}{kind}/{key}", depth)
+
+    def _fetch_checked(self, route: str, depth: int) -> bytes:
         conn = self._connect()
         try:
-            conn.request("GET", f"{_SOCI_ROUTE}{blob_id}")
+            conn.request(
+                "GET", route, headers={"x-ntpu-peer-depth": str(depth)}
+            )
             resp = conn.getresponse()
             payload = resp.read()
             if resp.status == 404:
-                raise PeerMiss(f"peer {self.address} has no index for {blob_id}")
+                raise PeerMiss(f"peer {self.address} misses {route}")
             if resp.status != 200:
                 raise PeerError(
                     f"peer {self.address} -> {resp.status}: {payload[:120]!r}"
@@ -668,7 +886,7 @@ class PeerClient:
         finally:
             conn.close()
         if want_crc and f"{zlib.crc32(payload) & 0xFFFFFFFF:08x}" != want_crc:
-            raise PeerError(f"peer {self.address} index failed CRC32 check")
+            raise PeerError(f"peer {self.address} artifact failed CRC32 check")
         return payload
 
     def stat(self) -> dict:
@@ -751,6 +969,9 @@ class PeerMembership:
         # rate limiter for upward health reports (report_down).
         self._names: dict[str, str] = {}
         self._reported: dict[str, float] = {}
+        # address -> "rack:zone:region" locality labels advertised on
+        # the member records — the topology source for tiered routing.
+        self._localities: dict[str, str] = {}
 
     def _fetch_controller(self) -> list[dict]:
         if not self.controller:
@@ -782,12 +1003,15 @@ class PeerMembership:
         if rows is not None:
             addrs = set()
             names: dict[str, str] = {}
+            locs: dict[str, str] = {}
             for r in rows:
                 addr = _normalize_addr(str(r.get("address", "")))
                 if not addr:
                     continue
                 if r.get("name"):
                     names[addr] = str(r["name"])
+                if r.get("locality"):
+                    locs[addr] = str(r["locality"])
                 if r.get("up", True) and not r.get("stale", False):
                     addrs.add(addr)
                 else:
@@ -813,6 +1037,7 @@ class PeerMembership:
             self._last_error = err
             if rows is not None:
                 self._names.update(names)
+                self._localities.update(locs)
             if live is not None and live != self._live:
                 prev = set(self._live)
                 cur = set(live)
@@ -838,6 +1063,14 @@ class PeerMembership:
         with self._mu:
             self._view_shared.read()
             return list(self._live)
+
+    def localities(self) -> dict[str, str]:
+        """address -> advertised ``rack:zone:region`` label, from the
+        last registry listing (no refresh of its own: callers pair this
+        with :meth:`addresses`, which refreshes)."""
+        with self._mu:
+            self._view_shared.read()
+            return dict(self._localities)
 
     def report_down(self, address: str, source: str = "peer-router") -> bool:
         """Upward health signal: a peer at ``address`` stopped answering
@@ -893,6 +1126,7 @@ class PeerMembership:
                 "peers": list(self._live),
                 "seed": list(self.seed),
                 "events": [dict(e) for e in self._events[-16:]],
+                "localities": dict(self._localities),
                 "last_error": self._last_error,
                 "controller": self.controller,
             }
@@ -917,6 +1151,15 @@ class PeerRouter:
     process-wide HostHealthRegistry), and returns None when this node
     itself ranks first (fetch from origin: we ARE the serve point for
     this region).
+
+    With a ``locality`` label (``rack:zone:region``) the flat ring
+    becomes a two-level hierarchy (:meth:`routes`): the rack-local
+    rendezvous owner is the cheap first hop, the zone's shield owner the
+    second, origin the last — and role-based cycle avoidance bounds
+    relays (the shield itself goes straight to origin; a rack owner
+    routes only upward to the shield). Members without a locality, or
+    in a foreign region, never own our tiers; a node with no locality
+    of its own keeps the flat single-ring behavior unchanged.
     """
 
     def __init__(
@@ -926,6 +1169,8 @@ class PeerRouter:
         region_bytes: int = DEFAULT_REGION_KIB << 10,
         health_registry=None,
         membership: Optional[PeerMembership] = None,
+        locality: str = "",
+        localities: Optional[dict[str, str]] = None,
     ):
         self.self_address = _normalize_addr(self_address)
         self.peers = [
@@ -938,6 +1183,14 @@ class PeerRouter:
             if health_registry is not None
             else mirror_mod.global_health_registry()
         )
+        self.locality = str(locality or "")
+        self._loc = parse_locality(self.locality)
+        # Static address -> locality map (tests, storm tooling); the
+        # membership's advertised labels overlay it when attached.
+        self.localities = {
+            _normalize_addr(a): str(l)
+            for a, l in (localities or {}).items()
+        }
 
     @staticmethod
     def _score(addr: str, blob_id: str, region: int) -> int:
@@ -964,17 +1217,162 @@ class PeerRouter:
             reverse=True,
         )
 
+    def _available(self, addr: str) -> bool:
+        return self.health.health_for(
+            addr,
+            failure_limit=PEER_FAILURE_LIMIT,
+            cooldown=PEER_COOLDOWN_SECS,
+        ).available()
+
+    def locality_map(self) -> dict[str, str]:
+        """address -> locality label: static map overlaid by the
+        membership's advertised labels, plus this node's own."""
+        out = dict(self.localities)
+        if self.membership is not None:
+            out.update(self.membership.localities())
+        if self.self_address and self.locality:
+            out[self.self_address] = self.locality
+        return out
+
+    def _tier_sets(self, members: set, locs: dict) -> tuple[list, list]:
+        """(rack members, zone members) sharing this node's locality
+        coordinates; foreign/unknown localities own no tier of ours."""
+        mine = self._loc
+        rack: list[str] = []
+        zone: list[str] = []
+        for a in members:
+            loc = parse_locality(locs.get(a, ""))
+            if loc is None or loc[2] != mine[2] or loc[1] != mine[1]:
+                continue
+            zone.append(a)
+            if loc[0] == mine[0]:
+                rack.append(a)
+        return rack, zone
+
+    def routes(self, blob_id: str, offset: int) -> list[tuple[str, str]]:
+        """The tier waterfall for this extent: healthy ``(addr, tier)``
+        candidates in cost order — the rack-local owner, then the zone's
+        shield owner; ``[]`` = fetch from origin. Without a locality
+        this is the flat single-owner route.
+
+        The ranking is cost-aware: tier distance dominates the
+        rendezvous score (TIER_COSTS — a rack hop always outranks a zone
+        hop), and cooled-down candidates are dropped HERE, so a dead
+        rack owner walks to the shield immediately instead of timing out
+        first. Role-based cycle avoidance bounds relays: the shield
+        itself returns ``[]`` (it IS the zone's serve point against
+        origin), and the rack owner routes only upward to the shield."""
+        if self._loc is None:
+            addr = self._flat_route(blob_id, offset)
+            return [(addr, TIER_FLAT)] if addr is not None else []
+        region = offset // self.region_bytes
+        members = set(self.current_peers())
+        if self.self_address:
+            members.add(self.self_address)
+        rack, zone = self._tier_sets(members, self.locality_map())
+
+        def score(a: str) -> int:
+            return self._score(a, blob_id, region)
+
+        shield = max(zone, key=score) if zone else None
+        if self.self_address and shield == self.self_address:
+            return []  # we ARE the zone shield: pull from origin
+        rack_owner = max(rack, key=score) if rack else None
+        out: list[tuple[str, str]] = []
+        if rack_owner is not None and rack_owner != self.self_address:
+            out.append((rack_owner, TIER_RACK))
+        if shield is not None and shield != rack_owner:
+            out.append((shield, TIER_ZONE))
+        out.sort(key=lambda at: (TIER_COSTS.get(at[1], 9.0), -score(at[0])))
+        return [(a, t) for a, t in out if self._available(a)]
+
+    def is_shield(self, blob_id: str, offset: int) -> bool:
+        """Is THIS node the zone's shield owner for the extent's region?
+        Shield-ness widens the server's pull-through rule: a shield may
+        fetch a forwarded (depth > 0) cold extent from origin on the
+        zone's behalf — the point where a region's unique bytes cross
+        the zone boundary exactly once."""
+        if self._loc is None or not self.self_address:
+            return False
+        region = offset // self.region_bytes
+        members = set(self.current_peers())
+        members.add(self.self_address)
+        _, zone = self._tier_sets(members, self.locality_map())
+        if not zone:
+            return False
+        return (
+            max(zone, key=lambda a: self._score(a, blob_id, region))
+            == self.self_address
+        )
+
+    def flat_owner(self, blob_id: str, offset: int = 0) -> Optional[str]:
+        """The flat (topology-blind) healthy owner, excluding self —
+        where a cluster-wide artifact (soci index, trained zdict) lives
+        before zone shields adopt it."""
+        for addr in self.ranked(blob_id, offset):
+            if addr == self.self_address:
+                continue
+            if self._available(addr):
+                return addr
+        return None
+
+    def topology(self, sample_regions: int = 64) -> dict:
+        """Introspection for ``ntpuctl peers``: this node's locality,
+        per-tier member counts, and its shield-ownership share over a
+        deterministic synthetic region sample."""
+        locs = self.locality_map()
+        members = set(self.current_peers())
+        if self.self_address:
+            members.add(self.self_address)
+        mine = self._loc
+        counts = {"rack": 0, "zone": 0, "region": 0, "remote": 0, "flat": 0}
+        racks: set = set()
+        zones: set = set()
+        for a in members:
+            loc = parse_locality(locs.get(a, ""))
+            if loc is None:
+                counts["flat"] += 1
+                continue
+            racks.add((loc[2], loc[1], loc[0]))
+            zones.add((loc[2], loc[1]))
+            if mine is None or loc[2] != mine[2]:
+                counts["remote"] += 1
+            elif loc[1] != mine[1]:
+                counts["region"] += 1
+            elif loc[0] != mine[0]:
+                counts["zone"] += 1
+            else:
+                counts["rack"] += 1
+        shielded = sum(
+            1
+            for r in range(max(0, int(sample_regions)))
+            if self.is_shield("_topology", r * self.region_bytes)
+        )
+        return {
+            "locality": self.locality,
+            "members": len(members),
+            "tiers": counts,
+            "racks": len(racks),
+            "zones": len(zones),
+            "shield_share": (
+                round(shielded / sample_regions, 3) if sample_regions else 0.0
+            ),
+        }
+
     def route(self, blob_id: str, offset: int) -> Optional[str]:
-        """The healthy peer to ask for this extent, or None for the
-        registry (self-owned region, or every peer cooling down)."""
+        """The healthy peer to ask FIRST for this extent, or None for
+        the registry — the head of the tier waterfall when a locality is
+        configured, the flat rendezvous owner otherwise."""
+        if self._loc is not None:
+            tiers = self.routes(blob_id, offset)
+            return tiers[0][0] if tiers else None
+        return self._flat_route(blob_id, offset)
+
+    def _flat_route(self, blob_id: str, offset: int) -> Optional[str]:
         for addr in self.ranked(blob_id, offset):
             if addr == self.self_address:
                 return None
-            if self.health.health_for(
-                addr,
-                failure_limit=PEER_FAILURE_LIMIT,
-                cooldown=PEER_COOLDOWN_SECS,
-            ).available():
+            if self._available(addr):
                 return addr
         return None
 
@@ -1002,10 +1400,22 @@ class PeerAwareFetcher:
     """Wraps a blob's origin ``fetch_range`` with the peer tier.
 
     Drop-in for the callable CachedBlob takes: the fetch scheduler's
-    flights call ``read_range`` concurrently, each flight first trying
-    the extent's healthy region owner and falling back to the origin
-    fetcher on any failure — transparently, so a dead/slow/corrupt peer
-    never fails a read (chaos-pinned via the ``peer.fetch`` site).
+    flights call ``read_range`` concurrently, each flight walking the
+    extent's tier waterfall (rack owner → zone shield → origin; flat
+    single owner without topology) and falling back a tier on miss /
+    timeout / error / corrupt payload / full tier budget — so a dead,
+    slow or melting tier never fails a read (chaos-pinned via the
+    ``peer.fetch`` and ``peer.tier`` sites).
+
+    With a :class:`~nydus_snapshotter_tpu.daemon.fetch_sched.Hedger`
+    attached, a flight past its tier's rolling p99 races a hedged
+    second request at the NEXT tier; the hedge admits and releases its
+    own gate charge (loser cancellation, never a double charge) and
+    only the winner's bytes are returned — a hedge can never
+    double-fetch into the cache. With an
+    :class:`~nydus_snapshotter_tpu.daemon.fetch_sched.AdmissionGate`
+    attached, per-tier in-flight byte budgets bound how much demand a
+    melting tier can absorb before the waterfall walks on.
     """
 
     def __init__(
@@ -1014,40 +1424,99 @@ class PeerAwareFetcher:
         origin_fetch: Callable[[int, int], bytes],
         router: PeerRouter,
         timeout_s: float = 0.0,
+        hedger=None,
+        gate=None,
+        tenant: str = fetch_sched.DEFAULT_TENANT,
     ):
         self.blob_id = blob_id
         self.origin_fetch = origin_fetch
         self.router = router
         self.timeout_s = timeout_s or resolve_peer_config().timeout_s
+        self.hedger = hedger
+        self.gate = gate
+        self.tenant = tenant
+
+    def _peer_read(self, addr: str, tier: str, offset: int, size: int):
+        depth = 1 if tier == TIER_ZONE else 0
+
+        def fetch() -> bytes:
+            return PeerClient(addr, self.timeout_s).read_range(
+                self.blob_id, offset, size, depth=depth
+            )
+
+        return fetch
+
+    def _hedge_target(self, rest, offset: int, size: int):
+        """(tier, fn) for the hedged second request: the next tier of
+        the waterfall, else origin."""
+        for addr, tier in rest:
+            return tier, self._peer_read(addr, tier, offset, size)
+        return TIER_ORIGIN, lambda: self.origin_fetch(offset, size)
 
     def read_range(self, offset: int, size: int) -> bytes:
-        addr = self.router.route(self.blob_id, offset)
-        if addr is not None:
+        tiers = self.router.routes(self.blob_id, offset)
+        for i, (addr, tier) in enumerate(tiers):
+            data = self._attempt(addr, tier, tiers[i + 1:], offset, size)
+            if data is not None:
+                return data
+        TIER_EGRESS.labels(TIER_ORIGIN).inc(size)
+        return self.origin_fetch(offset, size)
+
+    def _attempt(
+        self, addr: str, tier: str, rest, offset: int, size: int
+    ) -> Optional[bytes]:
+        """One tier of the waterfall; None = walk to the next tier."""
+        if self.gate is not None and not self.gate.tier_acquire(tier, size):
+            # Tier budget full (melting zone): walk on immediately —
+            # rack-local service never queues behind a saturated tier.
+            FETCH_FALLBACKS.labels("budget").inc()
+            return None
+        try:
             FETCH_REQUESTS.inc()
             with trace.span(
                 "peer.fetch",
                 blob=self.blob_id[:8],
                 peer=addr,
+                tier=tier,
                 offset=offset,
                 bytes=size,
             ) as sp:
                 try:
+                    failpoint.hit("peer.tier")
                     failpoint.hit("peer.fetch")
-                    data = PeerClient(addr, self.timeout_s).read_range(
-                        self.blob_id, offset, size
-                    )
+                    primary = self._peer_read(addr, tier, offset, size)
+                    if self.hedger is not None:
+                        hedge_tier, hedge_fn = self._hedge_target(
+                            rest, offset, size
+                        )
+                        data, winner = self.hedger.fetch(
+                            size,
+                            tier,
+                            primary,
+                            hedge_tier,
+                            hedge_fn,
+                            tenant=self.tenant,
+                        )
+                    else:
+                        data, winner = primary(), tier
                     self.router.record(addr, ok=True)
-                    FETCH_BYTES.inc(size)
-                    sp.annotate(outcome="hit")
+                    if winner != TIER_ORIGIN:
+                        FETCH_BYTES.inc(size)
+                    TIER_EGRESS.labels(winner).inc(size)
+                    sp.annotate(outcome="hit", tier=winner)
                     return data
                 except Exception as e:  # noqa: BLE001 — any peer failure
-                    # degrades to the registry, never to the reader
+                    # degrades to the next tier / registry, never to the
+                    # reader
                     reason = self._reason(e)
                     # A miss is an honest answer, not ill health.
                     self.router.record(addr, ok=isinstance(e, PeerMiss))
                     FETCH_FALLBACKS.labels(reason).inc()
                     sp.annotate(outcome=f"fallback:{reason}")
-        return self.origin_fetch(offset, size)
+                    return None
+        finally:
+            if self.gate is not None:
+                self.gate.tier_release(tier, size)
 
     @staticmethod
     def _reason(e: Exception) -> str:
@@ -1126,6 +1595,7 @@ def default_router() -> Optional[PeerRouter]:
                         self_address=cfg.listen,
                         region_bytes=cfg.region_bytes,
                         membership=membership,
+                        locality=cfg.locality,
                     )
         return _default_router
 
@@ -1155,10 +1625,16 @@ def start_from_config() -> Optional[PeerChunkServer]:
     # lists for the cluster.
     from nydus_snapshotter_tpu import fleet
 
-    fleet.register_self(
-        "peer", server.address, extra={"peer_listen": server.address}
-    )
+    extra = {"peer_listen": server.address}
+    if cfg.locality:
+        # The locality label rides the member record: the fleet peers
+        # listing re-advertises it, which is how every router learns the
+        # cluster's topology without a topology service.
+        extra["locality"] = cfg.locality
+    fleet.register_self("peer", server.address, extra=extra)
     fleet.annotate_self("peer_listen", server.address)
+    if cfg.locality:
+        fleet.annotate_self("locality", cfg.locality)
     return server
 
 

@@ -109,6 +109,8 @@ KNOWN_SITES = (
     "soak.scaleup",          # metrics/slo.py scale-up spawn attempt
     "chunk.vec",             # ops/native_cdc.py vectorized table-scan entry
     "compress.batch",        # converter/codec.py batched encode entry
+    "peer.tier",             # daemon/peer.py per-tier waterfall attempt entry
+    "peer.hedge",            # daemon/fetch_sched.py hedged second-request launch
 )
 
 _lock = _an.make_lock("failpoint.table")

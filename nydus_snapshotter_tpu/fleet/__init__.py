@@ -422,6 +422,10 @@ class FleetPlane:
                     # up: a joining peer must not be shunned at birth.
                     "up": True if live is None else bool(live["up"]),
                     "stale": False if live is None else bool(live["stale"]),
+                    # rack:zone:region label for tiered routing ("" = the
+                    # member routes flat): daemon/peer.py PeerMembership
+                    # feeds this straight into PeerRouter.locality_map.
+                    "locality": str(m.extra.get("locality", "")),
                 }
             )
         return rows

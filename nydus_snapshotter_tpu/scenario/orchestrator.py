@@ -345,7 +345,8 @@ class _Pod:
     (+ peer server when the tier is on)."""
 
     def __init__(self, idx, cache_dir, blob_id, blob_len, origin_fetch,
-                 addrs, peers_on, health, corrupt_seed=None):
+                 addrs, peers_on, health, corrupt_seed=None,
+                 localities=None, serve=True):
         from nydus_snapshotter_tpu.daemon import peer
         from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
         from nydus_snapshotter_tpu.daemon.fetch_sched import (
@@ -365,11 +366,14 @@ class _Pod:
         fetch_range = origin_fetch
         self.server = None
         if peers_on:
+            locs = localities or {}
             router = peer.PeerRouter(
                 addrs,
                 self_address=addrs[idx],
                 region_bytes=READ_CHUNK,
                 health_registry=health,
+                locality=locs.get(addrs[idx], ""),
+                localities=locs,
             )
             fetch_range = peer.PeerAwareFetcher(
                 blob_id, origin_fetch, router, timeout_s=5.0
@@ -383,10 +387,12 @@ class _Pod:
             gate=self.gate,
             tenant=f"scn-pod{idx}",
         )
-        if peers_on:
+        if peers_on and serve:
             export = peer.PeerExport()
             export.register(blob_id, self.cb)
-            srv = peer.PeerChunkServer(export, gate=self.gate, pull_through=True)
+            srv = peer.PeerChunkServer(
+                export, gate=self.gate, pull_through=True, router=router
+            )
             if corrupt_seed is not None:
                 srv = CorruptPeerServer(srv, corrupt_seed)
             srv.run(addrs[idx])
@@ -805,6 +811,24 @@ class ScenarioRunner:
         ]
         errors: list[str] = []
         chains: list = [None] * pods
+        # Topology fault arm: deterministic rack:zone:region localities
+        # (zone by pod-index parity, racks alternating in pairs) so the
+        # kill controller can SIGKILL-equivalent one whole zone's peer
+        # servers mid-deploy. Survivors must degrade to shield/origin;
+        # the serial replay (peers off) proves read identity.
+        kill_zone_on = phase.kill_zone and peers_on
+        localities = (
+            {
+                a: f"r{(i // 2) % 2}:z{i % 2}:reg0"
+                for i, a in enumerate(addrs)
+            }
+            if kill_zone_on
+            else None
+        )
+        zone_dead = threading.Event()
+        kill_done = threading.Event()
+        killed: list[int] = []
+        suppressed: list[int] = []
         crash_done = threading.Event()
         pause = threading.Event()
         resume = threading.Event()
@@ -854,6 +878,34 @@ class ScenarioRunner:
 
         open_pods: list = []
         pods_mu = _an.make_lock("scenario.pods")
+
+        def kill_zone_controller():
+            # Fire once half the pods completed their control-plane ops
+            # (the crash_controller trigger shape), then sweep until the
+            # phase ends: every registered zone-1 peer server goes down,
+            # including any that raced past the creation guard.
+            # Late-arriving zone-1 pods see zone_dead and never serve.
+            while not kill_done.is_set():
+                with quiesced:
+                    if state["completed"] >= max(1, pods // 2):
+                        break
+                time.sleep(0.005)
+            if kill_done.is_set():
+                return
+            zone_dead.set()
+            while True:
+                with pods_mu:
+                    targets = [
+                        (i, pod) for i, pod in open_pods
+                        if i % 2 == 1 and pod.server is not None
+                    ]
+                for i, pod in targets:
+                    srv, pod.server = pod.server, None
+                    srv.stop()
+                    killed.append(i)
+                if kill_done.is_set():
+                    return
+                time.sleep(0.005)
         # Pod threads open trace spans (prepare/commit/blobcache): carry
         # the phase's trace context so their spans don't detach.
         phase_ctx = trace.capture()
@@ -886,6 +938,12 @@ class ScenarioRunner:
             corrupt_seed = (
                 self.spec.seed if (phase.corrupt_peer and i == 0) else None
             )
+            serve = not (
+                kill_zone_on and zone_dead.is_set() and i % 2 == 1
+            )
+            if kill_zone_on and not serve:
+                with pods_mu:
+                    suppressed.append(i)
             pod = _Pod(
                 i,
                 os.path.join(self.workdir, f"ph{idx}-pod{i}"),
@@ -896,6 +954,8 @@ class ScenarioRunner:
                 peers_on,
                 health,
                 corrupt_seed=corrupt_seed,
+                localities=localities,
+                serve=serve,
             )
             with pods_mu:
                 open_pods.append((i, pod))
@@ -949,6 +1009,13 @@ class ScenarioRunner:
             )
             gc_thread.start()
 
+        kill_t = None
+        if kill_zone_on:
+            kill_t = threading.Thread(
+                target=kill_zone_controller, name="ntpu-scn-killzone"
+            )
+            kill_t.start()
+
         crash_t = None
         if phase.crash == "mid":
             if self.serial:
@@ -977,6 +1044,9 @@ class ScenarioRunner:
                 t.start()
             for t in threads:
                 t.join()
+        if kill_t is not None:
+            kill_done.set()
+            kill_t.join()
         if crash_t is not None:
             crash_done.set()
             crash_t.join()
@@ -1038,7 +1108,7 @@ class ScenarioRunner:
             min(len(images[i % len(images)]["blob"]), window)
             for i in range(pods)
         )
-        return {
+        out = {
             "pods": pods,
             "peers": peers_on,
             "extra_serve_pods": extra,
@@ -1046,6 +1116,13 @@ class ScenarioRunner:
             "corrupt_served": self.corrupt_served if phase.corrupt_peer else 0,
             "crashes": self.crashes,
         }
+        if kill_zone_on:
+            out["kill_zone"] = {
+                "zone": "z1",
+                "killed": sorted(killed),
+                "suppressed": sorted(suppressed),
+            }
+        return out
 
     def _corrupt_probe(self, img: dict, hostile_addr: str) -> None:
         """Deterministically engage the hostile-peer arm: rendezvous

@@ -38,6 +38,9 @@ A spec is one TOML document::
     # crash = "mid"           # crash/restart the control plane mid-phase
     # gc_watermark_mib = 8    # concurrent watermark eviction during the phase
     # deploy_api = "grpc"     # drive the real snapshots.v1 gRPC surface
+    # kill_zone = true        # topology fault arm: pods get deterministic
+    #                         # rack:zone:region localities and one whole
+    #                         # zone is killed mid-deploy
 
     [[scenario.phases]]
     op = "remove"
@@ -183,6 +186,11 @@ class PhaseSpec:
     # client fails over, and the reconstructed table must be byte-
     # identical to the straight-line oracle.
     shard_failover: bool = False
+    # deploy: topology fault arm — pods get deterministic rack:zone:region
+    # localities (two zones), every member of one zone is killed mid-
+    # deploy, and the survivors must degrade to shield/origin with
+    # serial-replay identity preserved.
+    kill_zone: bool = False
 
     @classmethod
     def from_dict(cls, d: dict, idx: int) -> "PhaseSpec":
@@ -191,7 +199,8 @@ class PhaseSpec:
             d,
             {"op", "corpus", "pods", "layers", "adaptive", "peers",
              "corrupt_peer", "soci", "read_mib", "crash", "gc_watermark_mib",
-             "watermark_mib", "fraction", "deploy_api", "shard_failover"},
+             "watermark_mib", "fraction", "deploy_api", "shard_failover",
+             "kill_zone"},
             where,
         )
         op = d.get("op", "")
@@ -215,6 +224,7 @@ class PhaseSpec:
             fraction=float(d.get("fraction", 0.5)),
             deploy_api=d.get("deploy_api", ""),
             shard_failover=bool(d.get("shard_failover", False)),
+            kill_zone=bool(d.get("kill_zone", False)),
         )
         if op in ("convert", "deploy") and not spec.corpus:
             raise ScenarioSpecError(f"{where}: {op} needs a corpus list")
@@ -236,6 +246,10 @@ class PhaseSpec:
             raise ScenarioSpecError(
                 f"{where}: shard_failover only applies to convert"
             )
+        if spec.kill_zone and op != "deploy":
+            raise ScenarioSpecError(f"{where}: kill_zone only applies to deploy")
+        if spec.kill_zone and not spec.peers:
+            raise ScenarioSpecError(f"{where}: kill_zone needs peers = true")
         return spec
 
     def to_dict(self) -> dict:
@@ -248,6 +262,7 @@ class PhaseSpec:
             "watermark_mib": self.watermark_mib, "fraction": self.fraction,
             "deploy_api": self.deploy_api,
             "shard_failover": self.shard_failover,
+            "kill_zone": self.kill_zone,
         }
 
 

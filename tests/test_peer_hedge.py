@@ -1,0 +1,444 @@
+"""Hedged tail requests + per-tier admission budgets (ISSUE 18).
+
+Covers the fetch-scheduler half of the planet-scale read tier:
+
+- RollingPercentile: no estimate below ``min_samples`` (a cold window
+  must not fire noise hedges), bounded window, p99-at-window = max;
+- Hedger: the rolling-p99 trigger, hedge-wins and primary-wins
+  (loser-cancellation) paths, the record-WINNER-only discipline (a
+  persistently slow peer must not ratchet the trigger up to its own
+  latency and disarm the hedge routing around it), gate-saturated skip,
+  chaos at the ``peer.hedge`` site (an armed failure aborts the hedge,
+  never the primary), and both-sides-fail error propagation;
+- the no-leak property: over 1k randomized hedged flights the
+  AdmissionGate and MemoryBudget come back to exactly zero — a
+  cancelled loser always releases its own charge;
+- AdmissionGate per-tier in-flight byte budgets: strictly non-blocking,
+  oversize-alone discipline, rejected counters, env/config resolution.
+"""
+
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.daemon.fetch_sched import (
+    DEFAULT_HEDGE_WINDOW,
+    HEDGE_MIN_SAMPLES,
+    AdmissionGate,
+    Hedger,
+    MemoryBudget,
+    RollingPercentile,
+    parse_tier_budgets,
+    resolve_hedge,
+    resolve_tier_budgets,
+)
+
+
+def _gate(total=64 << 20, **kw):
+    kw.setdefault("budget", MemoryBudget(total))
+    kw.setdefault("name", "hedge-test")
+    return AdmissionGate(**kw)
+
+
+def _hedger(gate=None, **kw):
+    kw.setdefault("name", "test")
+    return Hedger(gate=gate if gate is not None else _gate(), **kw)
+
+
+def _warm(h, tier="rack", ms=1.0, n=HEDGE_MIN_SAMPLES + 5):
+    for _ in range(n):
+        h.record(tier, ms)
+
+
+def _drain(gate, budget, timeout=5.0):
+    """Wait for every in-flight hedge/primary thread to settle its
+    accounting: the loser releases in its OWN finally, possibly after
+    the winner already returned to the caller."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        snap = gate.snapshot()
+        if (
+            snap["held_bytes"] == 0
+            and snap["in_service"] == 0
+            and budget.held == 0
+        ):
+            return snap
+        time.sleep(0.005)
+    raise AssertionError(f"gate never drained: {gate.snapshot()}")
+
+
+# ---------------------------------------------------------------------------
+# Rolling percentile
+# ---------------------------------------------------------------------------
+
+
+class TestRollingPercentile:
+    def test_no_estimate_below_min_samples(self):
+        rp = RollingPercentile(window=64, min_samples=20)
+        for i in range(19):
+            rp.record(float(i))
+            assert rp.percentile() is None
+        rp.record(19.0)
+        assert rp.percentile() is not None
+
+    def test_window_bounds_history(self):
+        rp = RollingPercentile(window=8, min_samples=1)
+        for _ in range(100):
+            rp.record(1000.0)
+        for _ in range(8):
+            rp.record(1.0)
+        # Old slow samples aged out entirely.
+        assert rp.percentile(0.99) == 1.0
+        assert len(rp) == 8
+
+    def test_p99_at_default_window_is_max(self):
+        rp = RollingPercentile(window=DEFAULT_HEDGE_WINDOW, min_samples=1)
+        vals = list(range(DEFAULT_HEDGE_WINDOW))
+        random.Random(7).shuffle(vals)
+        for v in vals:
+            rp.record(float(v))
+        assert rp.percentile(0.99) == float(DEFAULT_HEDGE_WINDOW - 1)
+
+    def test_window_floor(self):
+        # Hedger and RollingPercentile both clamp the window to >= 8.
+        assert RollingPercentile(window=1, min_samples=1)._samples.maxlen == 8
+
+
+# ---------------------------------------------------------------------------
+# Hedger paths
+# ---------------------------------------------------------------------------
+
+
+class TestHedger:
+    def test_cold_window_never_hedges(self):
+        h = _hedger()
+        called = threading.Event()
+
+        def hedge():
+            called.set()
+            return b"H"
+
+        data, winner = h.fetch(64, "rack", lambda: b"P", "zone", hedge)
+        assert (data, winner) == (b"P", "rack")
+        assert not called.is_set()
+        assert h.counters() == {
+            "fired": 0, "won": 0, "cancelled": 0, "skipped": 0, "error": 0,
+        }
+
+    def test_unhedged_flights_warm_the_window(self):
+        h = _hedger()
+        assert h.threshold_ms("rack") is None
+        for _ in range(HEDGE_MIN_SAMPLES):
+            h.fetch(64, "rack", lambda: b"x")
+        assert h.threshold_ms("rack") is not None
+
+    def test_hedge_wins_past_threshold(self):
+        budget = MemoryBudget(1 << 20)
+        gate = _gate(budget=budget)
+        h = _hedger(gate)
+        _warm(h, "rack", ms=1.0)
+
+        def slow_primary():
+            time.sleep(0.15)
+            return b"P"
+
+        data, winner = h.fetch(64, "rack", slow_primary, "zone", lambda: b"H")
+        assert (data, winner) == (b"H", "zone")
+        c = h.counters()
+        assert c["fired"] == 1 and c["won"] == 1 and c["cancelled"] == 0
+        _drain(gate, budget)
+
+    def test_primary_wins_hedge_cancelled(self):
+        budget = MemoryBudget(1 << 20)
+        gate = _gate(budget=budget)
+        h = _hedger(gate)
+        _warm(h, "rack", ms=1.0)
+        released = threading.Event()
+
+        def slow_hedge():
+            released.wait(5)
+            return b"H"
+
+        def primary():
+            time.sleep(0.05)  # past the 1ms threshold: the hedge fires
+            return b"P"
+
+        data, winner = h.fetch(64, "rack", primary, "zone", slow_hedge)
+        assert (data, winner) == (b"P", "rack")
+        c = h.counters()
+        assert c["fired"] == 1 and c["cancelled"] == 1 and c["won"] == 0
+        released.set()
+        # Loser-cancellation: the hedge thread settles its own charge.
+        _drain(gate, budget)
+
+    def test_record_winner_only_keeps_trigger_armed(self):
+        """The disarm regression: a persistently slow rack peer loses
+        every race, but if its eventual latency entered the rack window
+        the p99 (= window max) would ratchet up to the slow latency and
+        the hedge would stop firing. Only the DELIVERED flight records."""
+        budget = MemoryBudget(1 << 20)
+        gate = _gate(budget=budget)
+        h = _hedger(gate)
+        _warm(h, "rack", ms=1.0)
+
+        def slow_primary():
+            time.sleep(0.05)
+            return b"P"
+
+        for _ in range(5):
+            data, winner = h.fetch(
+                64, "rack", slow_primary, "zone", lambda: b"H"
+            )
+            assert winner == "zone"
+        _drain(gate, budget)
+        # The rack window never saw the ~50ms losses: trigger still ~1ms.
+        assert h.threshold_ms("rack") < 10.0
+        assert h.counters()["won"] == 5
+
+    def test_gate_saturated_skips_hedge(self):
+        budget = MemoryBudget(1024)
+        gate = _gate(budget=budget)
+        h = _hedger(gate)
+        _warm(h, "rack", ms=1.0)
+        gate.acquire(1024, tenant="other")  # the whole byte pool is held
+        called = threading.Event()
+
+        def hedge():
+            called.set()
+            return b"H"
+
+        def primary():
+            time.sleep(0.03)
+            return b"P"
+
+        try:
+            data, winner = h.fetch(512, "rack", primary, "zone", hedge)
+        finally:
+            gate.release(1024, tenant="other")
+        assert (data, winner) == (b"P", "rack")
+        assert not called.is_set()
+        assert h.counters()["skipped"] == 1
+        assert h.counters()["fired"] == 0
+        _drain(gate, budget)
+
+    def test_hedge_failpoint_aborts_hedge_not_primary(self):
+        budget = MemoryBudget(1 << 20)
+        gate = _gate(budget=budget)
+        h = _hedger(gate)
+        _warm(h, "rack", ms=1.0)
+        called = threading.Event()
+
+        def hedge():
+            called.set()
+            return b"H"
+
+        def primary():
+            time.sleep(0.03)
+            return b"P"
+
+        with failpoint.injected("peer.hedge", "error(OSError)"):
+            data, winner = h.fetch(64, "rack", primary, "zone", hedge)
+        assert (data, winner) == (b"P", "rack")
+        assert not called.is_set()
+        c = h.counters()
+        assert c["fired"] == 0 and c["skipped"] == 1
+        _drain(gate, budget)
+
+    def test_both_fail_primary_error_propagates(self):
+        budget = MemoryBudget(1 << 20)
+        gate = _gate(budget=budget)
+        h = _hedger(gate)
+        _warm(h, "rack", ms=1.0)
+
+        def primary():
+            time.sleep(0.03)
+            raise OSError("primary-boom")
+
+        def hedge():
+            raise ValueError("hedge-boom")
+
+        with pytest.raises(OSError, match="primary-boom"):
+            h.fetch(64, "rack", primary, "zone", hedge)
+        assert h.counters()["error"] == 1
+        _drain(gate, budget)
+
+    def test_disabled_hedger_never_races(self):
+        h = _hedger(enabled=False)
+        _warm(h, "rack", ms=1.0)
+        called = threading.Event()
+
+        def slow_primary():
+            time.sleep(0.03)
+            return b"P"
+
+        def hedge():
+            called.set()
+            return b"H"
+
+        data, winner = h.fetch(64, "rack", slow_primary, "zone", hedge)
+        assert (data, winner) == (b"P", "rack")
+        assert not called.is_set()
+
+
+# ---------------------------------------------------------------------------
+# The no-leak property
+# ---------------------------------------------------------------------------
+
+
+class TestNoLeakProperty:
+    def test_1k_hedged_flights_release_every_charge(self):
+        """Property (the loser-cancellation invariant at volume): over
+        1000 randomized flights — primaries fast/slow/failing, hedges
+        fast/failing, sizes varied — the gate and the budget both come
+        back to exactly zero, and no hedge thread leaks."""
+        budget = MemoryBudget(64 << 20)
+        gate = _gate(budget=budget, max_concurrent=64)
+        h = _hedger(gate)
+        _warm(h, "rack", ms=0.5, n=DEFAULT_HEDGE_WINDOW)
+        rng = random.Random(18)
+        flights = 1000
+        workers = 16
+        errors = []
+        idx = iter(range(flights))
+        idx_lock = threading.Lock()
+
+        def flight(i):
+            size = rng.randrange(1, 256 << 10)
+            mode = i % 10
+
+            def primary():
+                if mode < 5:
+                    return b"P"  # fast: no hedge fires
+                time.sleep(0.002)
+                if mode == 9:
+                    raise OSError("p")
+                return b"P"
+
+            def hedge():
+                if mode == 8:
+                    raise OSError("h")
+                return b"H"
+
+            try:
+                data, winner = h.fetch(size, "rack", primary, "zone", hedge)
+                assert data in (b"P", b"H")
+            except OSError:
+                assert mode == 9  # only the both-fail arm may raise
+            except BaseException as e:  # noqa: BLE001 — collected below
+                errors.append(e)
+
+        def worker():
+            while True:
+                with idx_lock:
+                    i = next(idx, None)
+                if i is None:
+                    return
+                flight(i)
+
+        threads = [threading.Thread(target=worker) for _ in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+            assert not t.is_alive(), "flight worker wedged"
+        assert not errors, errors
+        snap = _drain(gate, budget, timeout=10.0)
+        assert snap["held_bytes"] == 0
+        assert snap["in_service"] == 0
+        assert all(v == 0 for v in snap["tenant_inflight_bytes"].values())
+        assert budget.held == 0
+        c = h.counters()
+        assert c["fired"] >= c["won"]
+        # Every hedge thread settled (daemon threads named at spawn).
+        deadline = time.monotonic() + 10
+        while any(
+            t.name.startswith("ntpu-hedge-") for t in threading.enumerate()
+        ):
+            assert time.monotonic() < deadline, "hedge thread leaked"
+            time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# Per-tier admission budgets
+# ---------------------------------------------------------------------------
+
+
+class TestTierBudgets:
+    def test_acquire_within_cap_and_reject_at_cap(self):
+        gate = _gate(tier_budgets={"zone": 1024})
+        assert gate.tier_acquire("zone", 512)
+        assert gate.tier_acquire("zone", 512)
+        # Full RIGHT NOW: strictly non-blocking, the caller walks on.
+        t0 = time.monotonic()
+        assert not gate.tier_acquire("zone", 1)
+        assert time.monotonic() - t0 < 0.05
+        st = gate.tier_state()["zone"]
+        assert st["inflight_bytes"] == 1024
+        assert st["rejected_total"] == 1
+        gate.tier_release("zone", 512)
+        assert gate.tier_acquire("zone", 512)
+
+    def test_oversize_alone_discipline(self):
+        gate = _gate(tier_budgets={"zone": 1024})
+        # One read larger than the whole cap admits ALONE (used == 0)
+        # rather than wedging the tier forever...
+        assert gate.tier_acquire("zone", 4096)
+        # ...but never stacks on in-flight bytes.
+        assert not gate.tier_acquire("zone", 4096)
+        gate.tier_release("zone", 4096)
+        assert gate.tier_acquire("zone", 4096)
+
+    def test_unbudgeted_tier_always_admits(self):
+        gate = _gate(tier_budgets={"zone": 1024})
+        for _ in range(8):
+            assert gate.tier_acquire("rack", 1 << 20)
+        assert gate.tier_state()["rack"]["cap"] is None
+
+    def test_release_floors_at_zero(self):
+        gate = _gate(tier_budgets={"zone": 1024})
+        gate.tier_release("zone", 4096)
+        assert gate.tier_state()["zone"]["inflight_bytes"] == 0
+
+    def test_set_tier_budget_runtime(self):
+        gate = _gate()
+        gate.set_tier_budget("origin", 100)
+        assert gate.tier_acquire("origin", 100)
+        assert not gate.tier_acquire("origin", 1)
+        gate.set_tier_budget("origin", None)
+        assert gate.tier_acquire("origin", 1 << 20)
+
+    def test_snapshot_carries_tiers(self):
+        gate = _gate(tier_budgets={"zone": 1024})
+        assert gate.tier_acquire("zone", 10)
+        assert gate.snapshot()["tiers"]["zone"]["inflight_bytes"] == 10
+
+
+class TestResolution:
+    def test_parse_tier_budgets(self):
+        assert parse_tier_budgets("zone=32,origin=64") == {
+            "zone": 32 << 20,
+            "origin": 64 << 20,
+        }
+        # Bad entries are ignored, not fatal.
+        assert parse_tier_budgets("zone=x,=4,rack=-1,origin=1") == {
+            "origin": 1 << 20
+        }
+        assert parse_tier_budgets("") == {}
+
+    def test_resolve_tier_budgets_env_wins(self, monkeypatch):
+        monkeypatch.setenv("NTPU_PEER_TIER_BUDGETS", "zone=8")
+        assert resolve_tier_budgets() == {"zone": 8 << 20}
+
+    def test_resolve_hedge_env(self, monkeypatch):
+        monkeypatch.setenv("NTPU_PEER_HEDGE", "0")
+        monkeypatch.setenv("NTPU_PEER_HEDGE_WINDOW", "128")
+        enabled, window = resolve_hedge()
+        assert enabled is False and window == 128
+        monkeypatch.setenv("NTPU_PEER_HEDGE", "on")
+        monkeypatch.setenv("NTPU_PEER_HEDGE_WINDOW", "2")
+        enabled, window = resolve_hedge()
+        assert enabled is True and window == 8  # floor

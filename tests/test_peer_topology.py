@@ -1,0 +1,407 @@
+"""Hierarchical rack/zone/region peer topology (ISSUE 18).
+
+Covers the router half of the planet-scale read tier:
+
+- the tier waterfall (:meth:`PeerRouter.routes`): rack owner before
+  zone shield, the shield's own empty route (it IS the zone's serve
+  point against origin), cross-zone members never owning our tiers,
+  and the flat single-ring behavior without a locality;
+- shield agreement: every zone member independently computes the SAME
+  shield for a region (the no-gossip invariant, now two-level);
+- cost-aware health: a cooled-down rack owner is dropped from the
+  waterfall HERE, so the reader walks to the shield immediately;
+- the minimal-churn property: killing a whole OTHER zone never remaps
+  any rack or shield owner, and killing a same-zone/other-rack member
+  never remaps a rack owner (only the regions the dead member shielded
+  may move, and only to surviving zone members);
+- chaos at the ``peer.tier`` site: an armed per-tier failure walks the
+  waterfall to origin byte-identically;
+- topology introspection (``ntpuctl peers``) and the membership
+  locality overlay;
+- the zone-shield artifact proxy: a shield adopts a flat-owner
+  artifact once and re-serves it zone-locally, surviving the owner's
+  death.
+"""
+
+import os
+import random
+import threading
+
+import pytest
+
+from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.daemon import peer
+from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+from nydus_snapshotter_tpu.daemon.fetch_sched import FetchConfig
+from nydus_snapshotter_tpu.remote.mirror import HostHealthRegistry
+
+BLOB = random.Random(18).randbytes(1 << 20)
+BLOB_ID = "ab" * 32
+REGION = peer.DEFAULT_REGION_KIB << 10
+
+
+def _mesh(zones=2, racks=2, per=2, region="reg0"):
+    """(addrs, localities) for a zones x racks x per mesh."""
+    addrs, locs = [], {}
+    for z in range(zones):
+        for r in range(racks):
+            for p in range(per):
+                a = f"/peers/z{z}r{r}n{p}.sock"
+                addrs.append(a)
+                locs[a] = f"r{r}:z{z}:{region}"
+    return addrs, locs
+
+
+def _router(addrs, locs, self_addr, health=None, **kw):
+    return peer.PeerRouter(
+        addrs,
+        self_address=self_addr,
+        health_registry=health or HostHealthRegistry(),
+        locality=locs.get(self_addr, ""),
+        localities=locs,
+        **kw,
+    )
+
+
+def _offsets(n=48):
+    return [i * REGION for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# The tier waterfall
+# ---------------------------------------------------------------------------
+
+
+class TestRoutesWaterfall:
+    def test_rack_before_zone_always(self):
+        addrs, locs = _mesh()
+        rt = _router(addrs, locs, addrs[0])
+        saw_two_tiers = False
+        for off in _offsets():
+            tiers = [t for _, t in rt.routes(BLOB_ID, off)]
+            assert tiers == sorted(
+                tiers, key=lambda t: peer.TIER_COSTS.get(t, 9.0)
+            )
+            assert set(tiers) <= {peer.TIER_RACK, peer.TIER_ZONE}
+            if tiers == [peer.TIER_RACK, peer.TIER_ZONE]:
+                saw_two_tiers = True
+        assert saw_two_tiers, "no region produced the full two-hop waterfall"
+
+    def test_candidates_share_our_coordinates(self):
+        addrs, locs = _mesh()
+        rt = _router(addrs, locs, addrs[0])
+        mine = peer.parse_locality(locs[addrs[0]])
+        for off in _offsets():
+            for addr, tier in rt.routes(BLOB_ID, off):
+                loc = peer.parse_locality(locs[addr])
+                assert loc[1:] == mine[1:], "candidate outside our zone"
+                if tier == peer.TIER_RACK:
+                    assert loc[0] == mine[0], "rack candidate off-rack"
+
+    def test_shield_routes_to_origin(self):
+        addrs, locs = _mesh()
+        health = HostHealthRegistry()
+        shielded = 0
+        for a in addrs:
+            rt = _router(addrs, locs, a, health=health)
+            for off in _offsets(16):
+                if rt.is_shield(BLOB_ID, off):
+                    shielded += 1
+                    assert rt.routes(BLOB_ID, off) == []
+        assert shielded, "nobody shielded anything"
+
+    def test_cross_zone_never_in_routes(self):
+        addrs, locs = _mesh()
+        rt = _router(addrs, locs, addrs[0])
+        z1 = {a for a in addrs if ":z1:" in locs[a]}
+        for off in _offsets():
+            assert not z1 & {a for a, _ in rt.routes(BLOB_ID, off)}
+
+    def test_flat_without_locality(self):
+        addrs, _ = _mesh()
+        rt = peer.PeerRouter(
+            addrs, self_address=addrs[0],
+            health_registry=HostHealthRegistry(),
+        )
+        for off in _offsets(16):
+            routes = rt.routes(BLOB_ID, off)
+            assert len(routes) <= 1
+            if routes:
+                assert routes[0][1] == peer.TIER_FLAT
+                assert routes[0][0] == rt.route(BLOB_ID, off)
+
+    def test_shield_agreement_across_zone_members(self):
+        """Every z0 member independently computes the same shield, and
+        non-shield members route their zone tier AT that shield."""
+        addrs, locs = _mesh()
+        health = HostHealthRegistry()
+        z0 = [a for a in addrs if ":z0:" in locs[a]]
+        routers = {a: _router(addrs, locs, a, health=health) for a in z0}
+        for off in _offsets():
+            shields = [a for a, rt in routers.items()
+                       if rt.is_shield(BLOB_ID, off)]
+            assert len(shields) == 1, f"region {off}: shields {shields}"
+            for a, rt in routers.items():
+                if a == shields[0]:
+                    continue
+                zone_hops = [
+                    c for c, t in rt.routes(BLOB_ID, off)
+                    if t == peer.TIER_ZONE
+                ]
+                # The zone hop (when distinct from the rack owner)
+                # always lands on the agreed shield.
+                assert all(c == shields[0] for c in zone_hops)
+
+    def test_dead_rack_owner_walks_to_shield(self):
+        addrs, locs = _mesh()
+        health = HostHealthRegistry()
+        rt = _router(addrs, locs, addrs[0], health=health)
+        # Find a region with the full two-hop waterfall...
+        for off in _offsets(256):
+            routes = rt.routes(BLOB_ID, off)
+            if [t for _, t in routes] == [peer.TIER_RACK, peer.TIER_ZONE]:
+                rack_owner, shield = routes[0][0], routes[1][0]
+                break
+        else:
+            pytest.fail("no two-hop region found")
+        # ...cool the rack owner down: dropped from the waterfall HERE,
+        # no timeout spent discovering it.
+        for _ in range(peer.PEER_FAILURE_LIMIT):
+            rt.record(rack_owner, ok=False)
+        routes = rt.routes(BLOB_ID, off)
+        assert routes == [(shield, peer.TIER_ZONE)]
+
+    def test_topology_census(self):
+        addrs, locs = _mesh()  # 2 zones x 2 racks x 2 nodes
+        rt = _router(addrs, locs, addrs[0])
+        topo = rt.topology()
+        assert topo["members"] == 8
+        # From z0/r0: the rack-mate pair, the other-rack z0 pair, and
+        # the four z1 members sharing only the region.
+        assert topo["tiers"] == {
+            "rack": 2, "zone": 2, "region": 4, "remote": 0, "flat": 0,
+        }
+        assert topo["racks"] == 4 and topo["zones"] == 2
+        assert 0.0 <= topo["shield_share"] <= 1.0
+
+    def test_locality_map_membership_overlay(self):
+        addrs, locs = _mesh()
+
+        class StubMembership:
+            def addresses(self):
+                return list(addrs)
+
+            def localities(self):
+                # The live fleet advertises a DIFFERENT rack for node 1
+                # than the static map: the advertisement wins.
+                return {addrs[1]: "r9:z0:reg0"}
+
+            def report_down(self, address, source=""):
+                return False
+
+        rt = peer.PeerRouter(
+            [],
+            self_address=addrs[0],
+            health_registry=HostHealthRegistry(),
+            membership=StubMembership(),
+            locality=locs[addrs[0]],
+            localities=locs,
+        )
+        m = rt.locality_map()
+        assert m[addrs[1]] == "r9:z0:reg0"
+        assert m[addrs[2]] == locs[addrs[2]]
+        # And the overlay shapes routing: node 1 left our rack, so it
+        # can never be a rack-tier candidate now.
+        for off in _offsets():
+            for addr, tier in rt.routes(BLOB_ID, off):
+                if tier == peer.TIER_RACK:
+                    assert addr != addrs[1]
+
+
+# ---------------------------------------------------------------------------
+# Minimal churn under zone loss
+# ---------------------------------------------------------------------------
+
+
+class TestMinimalChurn:
+    def test_other_zone_kill_remaps_nothing(self):
+        """Property: every member of z1 dies; no rack owner and no
+        shield for a z0 reader moves (cross-zone members never owned
+        our tiers to begin with)."""
+        addrs, locs = _mesh(zones=2, racks=2, per=2)
+        health = HostHealthRegistry()
+        survivors = [a for a in addrs if ":z1:" not in locs[a]]
+        before = _router(addrs, locs, addrs[0], health=health)
+        after = _router(survivors, locs, addrs[0], health=health)
+        for off in _offsets(128):
+            assert before.routes(BLOB_ID, off) == after.routes(BLOB_ID, off)
+            assert before.is_shield(BLOB_ID, off) == after.is_shield(
+                BLOB_ID, off
+            )
+
+    def test_same_zone_member_loss_is_minimal_churn(self):
+        """Property: one same-zone/other-rack member dies. The rack
+        owner NEVER remaps; a shield moves only for regions the dead
+        member owned, and only to a surviving zone member."""
+        addrs, locs = _mesh(zones=1, racks=2, per=3)
+        health = HostHealthRegistry()
+        self_addr = addrs[0]
+        dead = next(a for a in addrs if locs[a].startswith("r1:"))
+        survivors = [a for a in addrs if a != dead]
+        before = _router(addrs, locs, self_addr, health=health)
+        after = _router(survivors, locs, self_addr, health=health)
+        moved = stable = 0
+        for off in _offsets(128):
+            rb = dict((t, a) for a, t in before.routes(BLOB_ID, off))
+            ra = dict((t, a) for a, t in after.routes(BLOB_ID, off))
+            assert rb.get(peer.TIER_RACK) == ra.get(peer.TIER_RACK)
+            sb, sa = rb.get(peer.TIER_ZONE), ra.get(peer.TIER_ZONE)
+            if sb == sa:
+                stable += 1
+            else:
+                moved += 1
+                assert sb == dead or sb is None, (
+                    f"shield moved from a SURVIVING owner {sb}"
+                )
+                assert sa != dead
+        assert stable > moved, "churn was not minimal"
+
+
+# ---------------------------------------------------------------------------
+# Fetcher chaos at the tier site
+# ---------------------------------------------------------------------------
+
+
+class _Origin:
+    def __init__(self):
+        self.calls = []
+        self._mu = threading.Lock()
+
+    def fetch(self, off, n):
+        with self._mu:
+            self.calls.append((off, n))
+        return BLOB[off : off + n]
+
+
+def _serving_pod(tmp, warm_bytes):
+    cb = CachedBlob(
+        str(tmp),
+        BLOB_ID,
+        lambda off, n: BLOB[off : off + n],
+        blob_size=len(BLOB),
+        config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0),
+    )
+    assert cb.read_at(0, warm_bytes) == BLOB[:warm_bytes]
+    export = peer.PeerExport()
+    export.register(BLOB_ID, cb)
+    srv = peer.PeerChunkServer(export, pull_through=True)
+    sock = os.path.join(str(tmp), "peer.sock")
+    srv.run(sock)
+    return srv, sock
+
+
+class TestFetcherChaos:
+    def test_tier_failpoint_walks_to_origin_byte_identical(self, tmp_path):
+        srv, sock = _serving_pod(tmp_path, warm_bytes=64 << 10)
+        try:
+            # A self address that is NOT region 0's shield (otherwise
+            # routes() is rightly [] and every read IS an origin read).
+            for i in range(64):
+                self_addr = f"/peers/self{i}.sock"
+                locs = {sock: "r0:z0:reg0", self_addr: "r0:z0:reg0"}
+                rt = _router([sock], locs, self_addr)
+                if rt.routes(BLOB_ID, 0):
+                    break
+            else:
+                pytest.fail("no non-shield self address found")
+            origin = _Origin()
+            f = peer.PeerAwareFetcher(
+                BLOB_ID, origin.fetch, rt, timeout_s=2.0
+            )
+            # Healthy: the rack peer serves, origin untouched.
+            assert f.read_range(0, 4096) == BLOB[:4096]
+            assert origin.calls == []
+            # Armed: EVERY tier attempt fails at the site; the read
+            # falls all the way to origin, still byte-identical.
+            with failpoint.injected("peer.tier", "error(OSError)*8"):
+                assert f.read_range(4096, 4096) == BLOB[4096:8192]
+            assert origin.calls == [(4096, 4096)]
+            # Disarmed (and the peer not cooled down by a MISS-free
+            # failpoint error count below the limit): peers serve again.
+            assert f.read_range(8192, 4096) == BLOB[8192 : 8192 + 4096]
+        finally:
+            srv.stop()
+
+    def test_tier_sites_are_catalogued(self):
+        assert "peer.tier" in failpoint.KNOWN_SITES
+        assert "peer.hedge" in failpoint.KNOWN_SITES
+
+
+# ---------------------------------------------------------------------------
+# Zone-shield artifact proxy
+# ---------------------------------------------------------------------------
+
+
+class TestShieldArtifactProxy:
+    def test_shield_adopts_flat_owner_artifact(self, tmp_path):
+        payload = random.Random(5).randbytes(32 << 10)
+        art = tmp_path / "table.zdict"
+        art.write_bytes(payload)
+
+        owner_sock = os.path.join(str(tmp_path), "owner.sock")
+        shield_sock = os.path.join(str(tmp_path), "shield.sock")
+        locs = {owner_sock: "r0:z0:reg0", shield_sock: "r1:z0:reg0"}
+        addrs = [owner_sock, shield_sock]
+
+        # A key the shield node actually shields (rendezvous over the
+        # two-member zone): scan until one lands on the shield.
+        shield_rt = _router(addrs, locs, shield_sock)
+        key = next(
+            f"zdict-{i}" for i in range(64)
+            if shield_rt.is_shield(f"zdict-{i}", 0)
+        )
+        assert shield_rt.flat_owner(key) == owner_sock
+
+        owner_export = peer.PeerExport()
+        owner_export.register_artifact("zdict", key, str(art))
+        owner_srv = peer.PeerChunkServer(owner_export, pull_through=True)
+        owner_srv.run(owner_sock)
+
+        shield_export = peer.PeerExport()
+        shield_srv = peer.PeerChunkServer(
+            shield_export, pull_through=True, router=shield_rt
+        )
+        shield_srv.run(shield_sock)
+        try:
+            client = peer.PeerClient(shield_sock, 2.0)
+            # Cold shield: adopts from the flat owner, re-serves.
+            assert client.fetch_artifact("zdict", key) == payload
+            assert shield_export.adopted_artifact("zdict", key) == payload
+            # The owner can die now: the zone keeps the artifact.
+            owner_srv.stop()
+            assert client.fetch_artifact("zdict", key) == payload
+        finally:
+            owner_srv.stop()
+            shield_srv.stop()
+
+    def test_forwarded_request_never_adopts(self, tmp_path):
+        """Depth > 0 bounds the relay: a forwarded artifact request is
+        a plain miss on a cold shield — no adopt, no further hop."""
+        shield_sock = os.path.join(str(tmp_path), "shield.sock")
+        other = "/peers/other.sock"
+        locs = {shield_sock: "r1:z0:reg0", other: "r0:z0:reg0"}
+        rt = _router([shield_sock, other], locs, shield_sock)
+        key = next(
+            f"zdict-{i}" for i in range(64)
+            if rt.is_shield(f"zdict-{i}", 0)
+        )
+        export = peer.PeerExport()
+        srv = peer.PeerChunkServer(export, pull_through=True, router=rt)
+        srv.run(shield_sock)
+        try:
+            client = peer.PeerClient(shield_sock, 2.0)
+            with pytest.raises(peer.PeerMiss):
+                client.fetch_artifact("zdict", key, depth=1)
+            assert export.adopted_artifact("zdict", key) is None
+        finally:
+            srv.stop()

@@ -48,8 +48,21 @@ Gates (abort-on-fail, per ISSUE 8 acceptance):
   peer fetch, and the owner process's ``peer.serve`` joined by the
   propagated trace id across the process boundary (ISSUE 9 acceptance).
 
+- **topology** (ISSUE 18, ``--topology rack:zone:region``): the
+  hierarchical-tier arm — pods carry rack:zone:region localities and
+  lookups walk rack owner -> zone shield -> origin. Gated: byte-identity
+  on every arm; each zone's origin egress <= ~1x unique bytes (a
+  region's bytes cross the zone boundary exactly once); hedged second
+  requests fire only past the rolling per-tier p99 so their added
+  egress stays under 1% of demand bytes (analytic bound); with one peer
+  turning deterministically slow mid-storm the hedged arm's demand p99
+  must not exceed the unhedged arm's (paired best-rep); and a
+  kill-a-zone chaos arm (every zone-1 server dies mid-storm) degrades
+  to shield/origin byte-identically.
+
 Usage: python tools/cluster_storm_profile.py [--pods 16] [--mib 2]
-           [--reps 2] [--chunk-kib 64] [--json]
+           [--reps 2] [--chunk-kib 64] [--topology rack:zone:region]
+           [--json]
 
 The thousand-pod gate run is ``--pods 128 --chunk-kib 256`` (pods are
 simulated as threads, the registry/peer data path is real; in-flight
@@ -86,6 +99,17 @@ QOS_P95_FACTOR = 2.0
 # 8 MiB private budget; the cluster's peak in-flight bytes are sampled
 # and gated against pods x this bound.
 POD_BUDGET_BYTES = 8 << 20
+# Topology arm (--topology rack:zone:region): fixed 2-zone x 3-rack
+# shape, hedging gated against a peer that turns deterministically slow
+# mid-storm, per-zone origin egress gated at ~1x unique bytes (a
+# region's bytes cross the zone boundary once), and the hedge's added
+# egress bounded analytically (fires only past the rolling p99).
+TOPO_ZONES = 2
+TOPO_RACKS = 3
+SLOW_SERVE_S = 0.12
+SLOW_AT_FRAC = 0.5
+ZONE_EGRESS_FACTOR = 1.05
+HEDGE_EGRESS_FRAC = 0.01
 
 
 class StormRegistry:
@@ -157,11 +181,18 @@ class Pod:
 
     With ``listing`` given (the churn arm), the router's peer set is the
     live membership view — joins/leaves re-shape region ownership at
-    the daemon/peer.PeerMembership refresh cadence, no config edit."""
+    the daemon/peer.PeerMembership refresh cadence, no config edit.
+
+    The topology arm adds ``localities`` (addr -> rack:zone:region, the
+    hierarchical router), ``hedge`` (a per-pod Hedger racing slow
+    flights), ``origin_fetch`` (a zone-attributing origin wrapper) and
+    ``slow_serve`` (an Event: while set, every serve this pod handles is
+    delayed — the deterministically slow peer of the hedging gate)."""
 
     def __init__(self, idx, workdir, blob_id, blob_len, registry, addrs,
-                 peers_on, region_bytes, listing=None):
-        from nydus_snapshotter_tpu.daemon import peer
+                 peers_on, region_bytes, listing=None, localities=None,
+                 hedge=False, origin_fetch=None, slow_serve=None):
+        from nydus_snapshotter_tpu.daemon import fetch_sched, peer
         from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
         from nydus_snapshotter_tpu.daemon.fetch_sched import (
             AdmissionGate,
@@ -177,7 +208,10 @@ class Pod:
             demand_reserve=1,
             name=f"pod{idx}",
         )
-        fetch_range = registry.fetch
+        origin = origin_fetch if origin_fetch is not None else registry.fetch
+        fetch_range = origin
+        self.router = None
+        self.hedger = None
         if peers_on:
             membership = None
             if listing is not None:
@@ -189,15 +223,23 @@ class Pod:
                 )
             # Pods share one health table per storm (a cluster-wide view
             # would be per-node; sharing only makes failover stricter).
+            locs = localities or {}
             self.router = peer.PeerRouter(
                 addrs if membership is None else [],
                 self_address=self.addr,
                 region_bytes=region_bytes,
                 health_registry=_STORM_HEALTH,
                 membership=membership,
+                locality=locs.get(self.addr, ""),
+                localities=locs,
             )
+            if hedge:
+                self.hedger = fetch_sched.Hedger(
+                    gate=self.gate, name=f"pod{idx}"
+                )
             fetch_range = peer.PeerAwareFetcher(
-                blob_id, registry.fetch, self.router, timeout_s=PEER_TIMEOUT_S
+                blob_id, origin, self.router, timeout_s=PEER_TIMEOUT_S,
+                hedger=self.hedger, gate=self.gate,
             ).read_range
         self.cb = CachedBlob(
             os.path.join(workdir, f"pod{idx}"),
@@ -213,8 +255,20 @@ class Pod:
             export = peer.PeerExport()
             export.register(blob_id, self.cb)
             self.server = peer.PeerChunkServer(
-                export, gate=self.gate, pull_through=True
+                export, gate=self.gate, pull_through=True, router=self.router
             )
+            if slow_serve is not None:
+                # The serve loop dispatches through the instance's
+                # ``handle`` attribute (the CorruptPeerServer pattern),
+                # so the delay hook installs the same way.
+                inner_handle = self.server.handle
+
+                def handle(method, path, headers, _inner=inner_handle):
+                    if slow_serve.is_set():
+                        time.sleep(SLOW_SERVE_S)
+                    return _inner(method, path, headers)
+
+                self.server.handle = handle
             self.server.run(self.addr)
 
     def stop_server(self) -> None:
@@ -755,6 +809,266 @@ def _fleet_phase(workroot: str, seed: int) -> dict:
         trace.reset()
 
 
+def _run_topology_storm(workdir, blob, blob_id, per_cell, registry,
+                        chunk, hedge=True, slow_idx=None, kill_zone_at=None):
+    """One hierarchical-topology storm rep: TOPO_ZONES x TOPO_RACKS x
+    ``per_cell`` pods with rack:zone:region localities cold-read the
+    whole blob concurrently through the tiered waterfall (rack owner ->
+    zone shield -> origin).
+
+    ``slow_idx`` arms the tail-latency scenario: that pod's serves turn
+    SLOW_SERVE_S slower once the storm passes SLOW_AT_FRAC progress (a
+    peer degrading mid-storm — the regime hedging exists for).
+    ``kill_zone_at`` stops every zone-1 server at that progress fraction
+    (the chaos arm: survivors must degrade to shield/origin).
+
+    Returns (wall_s, per-zone origin egress list, per-pod sha256 list,
+    flat per-read latency list, hedge-counter delta dict)."""
+    import hashlib
+
+    global _STORM_HEALTH
+    from nydus_snapshotter_tpu.daemon import fetch_sched
+    from nydus_snapshotter_tpu.remote.mirror import HostHealthRegistry
+
+    _STORM_HEALTH = HostHealthRegistry()
+    registry.reset()
+    pods = TOPO_ZONES * TOPO_RACKS * per_cell
+    sockdir = tempfile.mkdtemp(prefix="storm-topo-", dir="/tmp")
+    addrs = [os.path.join(sockdir, f"p{i}.sock") for i in range(pods)]
+    # Deterministic shape: zone by index parity, racks striped across
+    # the zone — every zone holds TOPO_RACKS racks of per_cell members.
+    zone_of = [i % TOPO_ZONES for i in range(pods)]
+    localities = {
+        a: f"r{(i // TOPO_ZONES) % TOPO_RACKS}:z{zone_of[i]}:reg0"
+        for i, a in enumerate(addrs)
+    }
+    zone_egress = [0] * TOPO_ZONES
+    ze_lock = threading.Lock()
+
+    def origin_for(z):
+        def fetch(off, size):
+            with ze_lock:
+                zone_egress[z] += size
+            return registry.fetch(off, size)
+        return fetch
+
+    slow_serve = threading.Event()
+    hedge0 = fetch_sched.hedge_counters()
+    nodes = [
+        Pod(i, workdir, blob_id, len(blob), registry, addrs, True, chunk,
+            localities=localities, hedge=hedge,
+            origin_fetch=origin_for(zone_of[i]),
+            slow_serve=(slow_serve if i == slow_idx else None))
+        for i in range(pods)
+    ]
+    plan = [
+        (off, min(chunk, len(blob) - off)) for off in range(0, len(blob), chunk)
+    ]
+    digests = [None] * pods
+    latencies = [[] for _ in range(pods)]
+    progress = [0] * pods
+    errors: list[str] = []
+    done = threading.Event()
+
+    def run_pod(i):
+        h = hashlib.sha256()
+        try:
+            for n, (off, size) in enumerate(plan):
+                t1 = time.perf_counter()
+                h.update(nodes[i].cb.read_at(off, size))
+                latencies[i].append(time.perf_counter() - t1)
+                progress[i] = n + 1
+            digests[i] = h.hexdigest()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(f"pod{i}: {e!r}")
+
+    def controller():
+        slow_want = int(pods * len(plan) * SLOW_AT_FRAC)
+        kill_want = (
+            int(pods * len(plan) * kill_zone_at)
+            if kill_zone_at is not None else None
+        )
+        zone_killed = False
+        while not done.is_set():
+            p = sum(progress)
+            if slow_idx is not None and not slow_serve.is_set() and p >= slow_want:
+                slow_serve.set()
+            if kill_want is not None and not zone_killed and p >= kill_want:
+                zone_killed = True
+                for i, node in enumerate(nodes):
+                    if zone_of[i] == 1:
+                        node.stop_server()
+            time.sleep(0.005)
+
+    t0 = time.perf_counter()
+    ctl = threading.Thread(target=controller)
+    ctl.start()
+    threads = [threading.Thread(target=run_pod, args=(i,)) for i in range(pods)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    done.set()
+    ctl.join()
+    wall = time.perf_counter() - t0
+    for node in nodes:
+        node.close()
+    shutil.rmtree(sockdir, ignore_errors=True)
+    if errors:
+        raise AssertionError(f"topology storm pod failures: {errors[:4]}")
+    hedge1 = fetch_sched.hedge_counters()
+    delta = {k: hedge1[k] - hedge0.get(k, 0) for k in hedge1}
+    flat = [s for per in latencies for s in per]
+    return wall, zone_egress, digests, flat, delta
+
+
+def _p99(xs: list) -> float:
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * 0.99))] if xs else 0.0
+
+
+def topology_profile(pods: int = 12, mib: int = 2, reps: int = 2,
+                     seed: int = 7) -> dict:
+    """The ``--topology rack:zone:region`` arm (ISSUE 18 acceptance):
+
+    - **identity**: every arm's per-pod reads byte-match the serial
+      single-node oracle;
+    - **tier egress**: each zone's origin bytes <= ~1x the unique bytes
+      (a region crosses the zone boundary exactly once — the shield
+      pull-through at work), hedge slack included;
+    - **hedge bound** (analytic): hedges fire only past the rolling
+      per-tier p99, so their added egress stays under
+      ``HEDGE_EGRESS_FRAC`` of the storm's demand bytes;
+    - **hedge p99** (measured, paired best-rep): with one peer turning
+      deterministically slow mid-storm, the hedged arm's demand p99
+      must not exceed the unhedged arm's;
+    - **kill-a-zone**: every zone-1 server dies mid-storm; survivors
+      degrade to shield/origin byte-identically.
+    """
+    import hashlib
+
+    per_cell = max(1, pods // (TOPO_ZONES * TOPO_RACKS))
+    pods = TOPO_ZONES * TOPO_RACKS * per_cell
+    # The hedging trigger needs warm per-tier windows (>= 20 samples)
+    # before the slow switch, so the topology arm reads a finer granule
+    # than the flat storm's CHUNK.
+    chunk = min(CHUNK, 16 << 10)
+    blob = random.Random(seed).randbytes(mib << 20)
+    blob_id = "ab" * 32
+    registry = StormRegistry(blob, LATENCY_S, BANDWIDTH_MIBPS)
+    gates: list[str] = []
+    oracle = hashlib.sha256(blob).hexdigest()
+    unique = len(blob)
+    workroot = tempfile.mkdtemp(prefix="cluster-topo-")
+    try:
+        # Clean hedged arm: tier-egress + analytic hedge bounds.
+        wall, zone_egress, digests, lats, hdelta = _run_topology_storm(
+            os.path.join(workroot, "clean"), blob, blob_id, per_cell,
+            registry, chunk, hedge=True,
+        )
+        if any(d != oracle for d in digests):
+            gates.append("topology arm: pod bytes differ from serial")
+        zone_ratios = [ze / unique for ze in zone_egress]
+        for z, ratio in enumerate(zone_ratios):
+            if ratio > ZONE_EGRESS_FACTOR:
+                gates.append(
+                    f"zone {z} origin egress {ratio:.3f}x unique bytes "
+                    f"(gate {ZONE_EGRESS_FACTOR}x: a region crosses the "
+                    "zone boundary once)"
+                )
+        demand_bytes = pods * unique
+        hedge_egress = hdelta["fired"] * chunk
+        if hedge_egress > HEDGE_EGRESS_FRAC * demand_bytes:
+            gates.append(
+                f"hedge egress {hedge_egress} bytes > "
+                f"{HEDGE_EGRESS_FRAC:.0%} of {demand_bytes} demand bytes "
+                "(the rolling-p99 trigger must bound added load)"
+            )
+
+        # Paired slow-peer arms: unhedged vs hedged, best rep each. The
+        # slow pod serves its zone as a rack owner and shield, so its
+        # SLOW_SERVE_S delay lands square on the demand path.
+        slow_idx = 2
+        p99_off, p99_on = [], []
+        won = 0
+        for r in range(reps):
+            _, _, d_off, lat_off, _ = _run_topology_storm(
+                os.path.join(workroot, f"slow-off{r}"), blob, blob_id,
+                per_cell, registry, chunk, hedge=False, slow_idx=slow_idx,
+            )
+            if any(d != oracle for d in d_off):
+                gates.append(f"slow-peer unhedged rep {r}: bytes differ")
+            p99_off.append(_p99(lat_off))
+            _, _, d_on, lat_on, hd = _run_topology_storm(
+                os.path.join(workroot, f"slow-on{r}"), blob, blob_id,
+                per_cell, registry, chunk, hedge=True, slow_idx=slow_idx,
+            )
+            if any(d != oracle for d in d_on):
+                gates.append(f"slow-peer hedged rep {r}: bytes differ")
+            p99_on.append(_p99(lat_on))
+            won += hd["won"]
+        best_off, best_on = min(p99_off), min(p99_on)
+        if won == 0:
+            gates.append("hedges never won against the slow peer")
+        if best_on > best_off:
+            gates.append(
+                f"hedged demand p99 {best_on * 1000:.1f}ms > unhedged "
+                f"{best_off * 1000:.1f}ms (paired best-rep)"
+            )
+
+        # Kill-a-zone chaos arm: zone 1 dies mid-storm; everyone still
+        # reads byte-identical (zone-0 via its own tiers, zone-1 via
+        # origin fallback once the cooldowns walk past the dead tiers).
+        _, kz_egress, kz_digests, _, _ = _run_topology_storm(
+            os.path.join(workroot, "killzone"), blob, blob_id, per_cell,
+            registry, chunk, hedge=True, kill_zone_at=0.4,
+        )
+        if any(d != oracle for d in kz_digests):
+            gates.append("kill-a-zone arm: pod bytes differ from serial")
+        if kz_egress[0] / unique > ZONE_EGRESS_FACTOR:
+            gates.append(
+                f"kill-a-zone arm: surviving zone 0 egress "
+                f"{kz_egress[0] / unique:.3f}x unique bytes (its tiers "
+                "are intact and must stay bounded)"
+            )
+
+        leaked = [
+            t.name
+            for t in threading.enumerate()
+            if t.name.startswith(("ntpu-fetch", "ntpu-peer"))
+        ]
+        if leaked:
+            gates.append(f"leaked threads: {leaked}")
+
+        return {
+            "topology": f"{TOPO_RACKS} racks x {TOPO_ZONES} zones",
+            "pods": pods,
+            "per_cell": per_cell,
+            "blob_mib": mib,
+            "chunk_kib": chunk >> 10,
+            "reps": reps,
+            "wall_s": round(wall, 4),
+            "zone_egress_bytes": zone_egress,
+            "zone_egress_ratios": [round(r, 4) for r in zone_ratios],
+            "zone_egress_gate": ZONE_EGRESS_FACTOR,
+            "hedge_clean": hdelta,
+            "hedge_egress_bytes": hedge_egress,
+            "hedge_egress_frac_gate": HEDGE_EGRESS_FRAC,
+            "slow_serve_ms": SLOW_SERVE_S * 1000,
+            "p99_unhedged_s": [round(x, 5) for x in p99_off],
+            "p99_hedged_s": [round(x, 5) for x in p99_on],
+            "best_p99_unhedged_ms": round(best_off * 1000, 3),
+            "best_p99_hedged_ms": round(best_on * 1000, 3),
+            "p99_ratio": round(best_off / max(1e-9, best_on), 3),
+            "hedges_won_slow": won,
+            "kill_zone_egress_bytes": kz_egress,
+            "identity": "byte-identical across clean/slow/kill-zone arms",
+            "gates_failed": gates,
+        }
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
 def profile(pods: int = 16, mib: int = 2, reps: int = 2, seed: int = 7) -> dict:
     assert pods >= 2, "storm needs at least 2 pods"
     blob = random.Random(seed).randbytes(mib << 20)
@@ -992,11 +1306,45 @@ def main() -> int:
         "--chunk-kib", type=int, default=64,
         help="read/region granule (256 keeps the 128-pod run tractable)",
     )
+    ap.add_argument(
+        "--topology", default="",
+        help="run the hierarchical-tier arm instead of the flat storm "
+             "(the only supported shape is rack:zone:region)",
+    )
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
     global CHUNK
     CHUNK = max(4, args.chunk_kib) << 10
+    if args.topology:
+        if args.topology != "rack:zone:region":
+            ap.error(f"unknown --topology {args.topology!r}")
+        report = topology_profile(
+            pods=args.pods, mib=args.mib, reps=args.reps
+        )
+        if args.json:
+            print(json.dumps(report))
+        else:
+            print(
+                f"topology({report['topology']}, {report['pods']} pods): "
+                f"zone egress {report['zone_egress_ratios']}x unique "
+                f"(gate {report['zone_egress_gate']}x)"
+            )
+            print(
+                f"hedge: clean-arm {report['hedge_clean']}, added egress "
+                f"{report['hedge_egress_bytes']} bytes; slow-peer p99 "
+                f"hedged {report['best_p99_hedged_ms']}ms vs unhedged "
+                f"{report['best_p99_unhedged_ms']}ms "
+                f"({report['p99_ratio']}x win, {report['hedges_won_slow']} "
+                "hedges won)"
+            )
+            print(
+                f"kill-a-zone: zone egress {report['kill_zone_egress_bytes']}"
+                " bytes, byte-identical"
+            )
+        for g in report["gates_failed"]:
+            print(f"FAIL: {g}", file=sys.stderr)
+        return 1 if report["gates_failed"] else 0
     report = profile(pods=args.pods, mib=args.mib, reps=args.reps)
     if args.json:
         print(json.dumps(report))

@@ -175,8 +175,38 @@ def cmd_peers(args) -> int:
         lines = [
             f"{k}: {v}"
             for k, v in sorted(direct.items())
-            if k not in ("membership", "admission")
+            if k not in ("membership", "admission", "topology", "hedge",
+                         "tiers")
         ]
+        topo = direct.get("topology")
+        if topo:
+            t = topo.get("tiers", {})
+            lines.append(
+                f"topology: {topo.get('locality') or 'flat'} — "
+                f"{topo.get('members', 0)} members "
+                f"(rack {t.get('rack', 0)}, zone {t.get('zone', 0)}, "
+                f"region {t.get('region', 0)}, remote {t.get('remote', 0)}) "
+                f"across "
+                f"{topo.get('racks', 0)} racks / {topo.get('zones', 0)} "
+                f"zones; shield share {topo.get('shield_share', 0.0):.2f}"
+            )
+        hedge = direct.get("hedge")
+        if hedge:
+            lines.append(
+                "hedge: " + ", ".join(
+                    f"{k} {int(hedge.get(k, 0))}"
+                    for k in ("fired", "won", "cancelled", "skipped", "error")
+                )
+            )
+        tiers = direct.get("tiers")
+        if tiers:
+            for tier, st in sorted(tiers.items()):
+                cap = st.get("cap")
+                lines.append(
+                    f"tier {tier}: in-flight {st.get('inflight_bytes', 0)} "
+                    f"/ {'∞' if cap is None else cap} bytes, "
+                    f"rejected {st.get('rejected_total', 0)}"
+                )
         m = direct.get("membership")
         if m:
             lines.append(
@@ -201,12 +231,28 @@ def cmd_peers(args) -> int:
         rows = [
             [
                 p["name"], p["component"], p["address"],
+                p.get("locality") or "-",
                 "stale" if p["stale"] else ("up" if p["up"] else "down"),
             ]
             for p in listing
         ]
         if rows:
-            print(_table(rows, ["PEER", "ROLE", "SERVE-ADDR", "STATE"]))
+            print(_table(rows, ["PEER", "ROLE", "SERVE-ADDR", "LOCALITY",
+                                "STATE"]))
+        # Tier census over the advertised localities: member counts per
+        # zone (rack:zone pairs collapse into their zone).
+        zones: dict = {}
+        for p in listing:
+            parts = (p.get("locality") or "").split(":")
+            if len(parts) == 3 and all(s.strip() for s in parts):
+                key = f"{parts[1].strip()}:{parts[2].strip()}"
+                zones[key] = zones.get(key, 0) + 1
+        if zones:
+            print(
+                "zones: " + ", ".join(
+                    f"{z} ({n} members)" for z, n in sorted(zones.items())
+                )
+            )
     rows = []
     payload = {}
     for name, m in sorted(board["members"].items()):
