@@ -902,6 +902,36 @@ def soci_run(repo: str, timeout: float = 300.0) -> dict:
         return {"error": "soci profile produced no JSON"}
 
 
+_SOCI_FORMATS_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from tools.soci_profile import formats_profile
+print(json.dumps(formats_profile(pods=4, mib=4, reps=2)))
+"""
+
+
+def soci_formats_run(repo: str, timeout: float = 300.0) -> dict:
+    """Universal lazy-format matrix (tools/soci_profile.py --formats) in
+    a child under the hard watchdog: per-format byte identity, cold
+    first-read ratios (zstd >= 5x), FormatRouter routing, and the
+    mini mixed-format storm (TOC adoption at ~zero prepare bytes,
+    egress <= 1.05x unique compressed bytes)."""
+    res = _run_child_watchdog(
+        [sys.executable, "-c", _SOCI_FORMATS_CHILD.format(repo=repo)],
+        timeout=timeout,
+    )
+    if res is None:
+        return {"error": f"soci formats hung >{timeout:.0f}s (watchdog killed it)"}
+    rc, stdout, stderr = res
+    if rc != 0:
+        tail = stderr.strip().splitlines()[-1] if stderr.strip() else ""
+        return {"error": f"soci formats exited rc={rc}: {tail}"[:200]}
+    try:
+        return json.loads(stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": "soci formats produced no JSON"}
+
+
 _FLEET_OBS_CHILD = """
 import json, sys
 sys.path.insert(0, {repo!r})
@@ -1326,6 +1356,7 @@ def main() -> None:
     peer_topology = peer_topology_run(repo)
     fleet_obs = fleet_obs_run(repo)
     soci_detail = soci_run(repo)
+    soci_detail["formats"] = soci_formats_run(repo)
     # Adaptive-codec engine numbers ride under detail.compression next
     # to the per-codec economics they change.
     compression_economics["adaptive"] = compression_adaptive_run(repo)

@@ -302,6 +302,14 @@ class SociConfig:
     enable: bool = False
     stride_kib: int = 1024
     replicate: bool = True
+    # zstd half of the lazy plane: frame-index zstd layers (seekable
+    # seek-table parse, or a frame walk during the one first-pull pass)
+    # instead of full pull + RAFS convert. NTPU_SOCI_ZSTD overrides.
+    zstd: bool = True
+    # Adopt a shipped TOC (eStargz / zstd:chunked) as the file→extent
+    # map — zero build-pass bytes on those layers. NTPU_SOCI_TOC_ADOPT
+    # overrides.
+    toc_adopt: bool = True
 
 
 @dataclass

@@ -102,6 +102,10 @@ STARGZ_LAYER = "containerd.io/snapshot/stargz"
 # Marks a snapshot holding a seekable-OCI indexed plain gzip layer
 # (soci/adaptor.py — this framework's backend, no reference equivalent).
 SOCI_LAYER = "containerd.io/snapshot/ntpu-soci"
+# The FormatRouter's backend decision for a soci-claimed layer
+# (toc-adopt / seekable-index / zran-index), surfaced on the snapshot so
+# tooling can see which lazy path each layer took (soci/router.py).
+SOCI_ROUTE = "containerd.io/snapshot/ntpu-soci-route"
 # Builder hint that an image should run in tarfs mode (label.go:63-65).
 TARFS_HINT = "containerd.io/snapshot/tarfs-hint"
 

@@ -231,6 +231,7 @@ class BlobReader:
         read_at: Callable[[int, int], bytes],
         batch_map: Optional[dict[tuple[int, int], tuple[int, int]]] = None,
         gzip_stream=None,
+        zstd_stream=None,
     ):
         self.bootstrap = bootstrap
         self.blob_index = blob_index
@@ -254,6 +255,11 @@ class BlobReader:
         # lock (each read owns its own inflate state).
         self._gzip_stream = gzip_stream
         self._gzip_lock = threading.Lock()
+        # Same arrangement for whole-zstd OCIRef blobs: frame-indexed
+        # ZstdStreamReader (concurrent) injected by the daemon, or the
+        # in-process sequential cursor built lazily under the lock.
+        self._zstd_stream = zstd_stream
+        self._zstd_lock = threading.Lock()
 
     def mount_gzip_stream(self, stream) -> None:
         """Swap in a checkpoint-indexed gzip reader (soci/blob.py) after
@@ -262,6 +268,12 @@ class BlobReader:
         reads served before it used the sequential path — identical
         bytes, just without checkpoint resume."""
         self._gzip_stream = stream
+
+    def mount_zstd_stream(self, stream) -> None:
+        """Swap in a frame-indexed zstd reader (soci/zblob.py) after
+        construction — the zstd mirror of :meth:`mount_gzip_stream`,
+        with identical atomicity and identical-bytes semantics."""
+        self._zstd_stream = stream
 
     def _read_plain(self, offset: int, size: int) -> bytes:
         raw = self.read_at(offset, size)
@@ -297,6 +309,27 @@ class BlobReader:
                         self.bootstrap.blobs[self.blob_index].compressed_size,
                     )
                 return self._gzip_stream.read_range(
+                    rec.uncompressed_offset, rec.uncompressed_size
+                )
+        from nydus_snapshotter_tpu.converter.zstd_ref import (
+            CHUNK_FLAG_ZSTD_STREAM,
+            ZstdSequentialReader,
+        )
+
+        if rec.flags & CHUNK_FLAG_ZSTD_STREAM:
+            # OCIRef: offsets address the decompressed stream of the
+            # original .tar.zst blob (converter/zstd_ref.py).
+            if getattr(self._zstd_stream, "concurrent", False):
+                return self._zstd_stream.read_range(
+                    rec.uncompressed_offset, rec.uncompressed_size
+                )
+            with self._zstd_lock:
+                if self._zstd_stream is None:
+                    self._zstd_stream = ZstdSequentialReader(
+                        self._read_plain,
+                        self.bootstrap.blobs[self.blob_index].compressed_size,
+                    )
+                return self._zstd_stream.read_range(
                     rec.uncompressed_offset, rec.uncompressed_size
                 )
         if rec.flags & CHUNK_FLAG_BATCH:

@@ -139,17 +139,39 @@ def pack_gzip_layer(
     full inflate pass) hand the output over instead of paying a second
     decompression of a multi-hundred-MiB layer.
     """
+    if tar_bytes is None:
+        try:
+            tar_bytes = gzip.decompress(raw_gzip)
+        except (OSError, EOFError, zlib.error) as e:
+            raise ConvertError(f"OCIRef layer is not valid gzip: {e}") from e
+    return pack_stream_layer(
+        raw_gzip, tar_bytes, opt,
+        chunk_flag=CHUNK_FLAG_GZIP_STREAM,
+        blob_compressor=constants.COMPRESSOR_GZIP,
+        engine=engine,
+    )
+
+
+def pack_stream_layer(
+    raw: bytes,
+    tar_bytes: bytes,
+    opt: PackOption,
+    chunk_flag: int,
+    blob_compressor: int,
+    engine=None,
+) -> Bootstrap:
+    """The format-agnostic half of OCIRef packing: chunk the DECOMPRESSED
+    tar stream per file, digest, and emit a bootstrap whose single blob
+    is the original compressed layer. ``chunk_flag`` marks how runtime
+    reads translate decompressed offsets back to blob bytes
+    (CHUNK_FLAG_GZIP_STREAM for gzip zran, CHUNK_FLAG_ZSTD_STREAM for
+    the zstd frame index — converter/zstd_ref.py)."""
     opt.validate()
     if opt.encrypt:
         # The original registry blob stays authoritative and plaintext;
         # claiming encryption would mislabel it (hooks annotates encrypted
         # blobs) and consumers would decrypt plaintext into garbage.
         raise ConvertError("oci_ref cannot be combined with encrypt")
-    if tar_bytes is None:
-        try:
-            tar_bytes = gzip.decompress(raw_gzip)
-        except (OSError, EOFError, zlib.error) as e:
-            raise ConvertError(f"OCIRef layer is not valid gzip: {e}") from e
 
     entries: dict[str, fstree.FileEntry] = {}
     # (path, decompressed data offset, size) per regular file, chunked.
@@ -220,7 +242,7 @@ def pack_gzip_layer(
             [(buf, o, s) for _p, o, s in chunk_meta]
         )
 
-    blob_id = hashlib.sha256(raw_gzip).hexdigest()
+    blob_id = hashlib.sha256(raw).hexdigest()
 
     inodes = []
     chunks: list[ChunkRecord] = []
@@ -239,7 +261,7 @@ def pack_gzip_layer(
                     ChunkRecord(
                         digest=digest,
                         blob_index=0,
-                        flags=CHUNK_FLAG_GZIP_STREAM,
+                        flags=chunk_flag,
                         uncompressed_offset=off,
                         compressed_offset=off,
                         uncompressed_size=size,
@@ -250,10 +272,10 @@ def pack_gzip_layer(
 
     blob = BlobRecord(
         blob_id=blob_id,
-        compressed_size=len(raw_gzip),
+        compressed_size=len(raw),
         uncompressed_size=len(tar_bytes),
         chunk_count=len(chunks),
-        flags=constants.COMPRESSOR_GZIP,
+        flags=blob_compressor,
     )
     from nydus_snapshotter_tpu.converter.convert import match_prefetch_paths
 

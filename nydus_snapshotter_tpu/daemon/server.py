@@ -140,6 +140,7 @@ class _Instance:
             for cached in cached_blobs:
                 export.unregister(cached.blob_id, cached)
                 export.unregister_soci(cached.blob_id)
+                export.unregister_artifact("zsoci", cached.blob_id)
         for cached in cached_blobs:
             try:
                 cached.close()
@@ -237,6 +238,10 @@ class _Instance:
             stream = self._soci_stream(blob_index, *soci_args)
             if stream is not None:
                 reader.mount_gzip_stream(stream)
+            else:
+                zstream = self._zsoci_stream(blob_index, *soci_args)
+                if zstream is not None:
+                    reader.mount_zstd_stream(zstream)
         return reader
 
     def _soci_stream(self, blob_index: int, blob_id: str, read_at, dirs):
@@ -306,6 +311,72 @@ class _Instance:
                     blob_id[:12], outcome, len(index.checkpoints))
         return stream
 
+    def _zsoci_stream(self, blob_index: int, blob_id: str, read_at, dirs):
+        """The zstd mirror of :meth:`_soci_stream`: a frame-indexed
+        stream reader for a zstd-stream (OCIRef) blob. Same store
+        waterfall — persisted ``.soci.zidx``, peer replication (generic
+        artifact kind ``zsoci``), rebuild-once from the original bytes —
+        and the same contract: returns None when no index is obtainable
+        (BlobReader then uses the sequential zstd cursor; correctness
+        never depends on the index)."""
+        from nydus_snapshotter_tpu.converter.zstd_ref import (
+            CHUNK_FLAG_ZSTD_STREAM,
+        )
+
+        if not any(
+            rec.blob_index == blob_index and rec.flags & CHUNK_FLAG_ZSTD_STREAM
+            for rec in self.bootstrap.chunks
+        ):
+            return None
+        from nydus_snapshotter_tpu.daemon import peer as peer_mod
+        from nydus_snapshotter_tpu.soci import blob as soci_blob
+        from nydus_snapshotter_tpu.soci import zblob
+        from nydus_snapshotter_tpu.soci.zindex import zindex_path
+
+        cfg = soci_blob.resolve_soci_config()
+        csize = self.bootstrap.blobs[blob_index].compressed_size
+        fetch_remote = None
+        if cfg.enable and cfg.replicate:
+            router = peer_mod.default_router()
+            if router is not None:
+                owner = router.route(blob_id, 0)
+                if owner is not None:
+                    fetch_remote = lambda: peer_mod.PeerClient(  # noqa: E731
+                        owner
+                    ).fetch_artifact(zblob.ZSOCI_ARTIFACT_KIND, blob_id)
+        try:
+            index, outcome = zblob.load_or_build_zindex(
+                [d for d in dirs if d],
+                blob_id,
+                csize=csize,
+                builder=(
+                    (lambda: read_at(0, csize)) if cfg.enable and csize else None
+                ),
+                fetch_remote=fetch_remote,
+            )
+        except Exception:  # noqa: BLE001 — incl. an armed soci.index
+            # failpoint: a broken index STORE degrades this blob to the
+            # sequential in-process reader; it must never fail reads.
+            logger.warning("zstd index store failed for %s; serving "
+                           "sequentially", blob_id[:12], exc_info=True)
+            return None
+        if index is None:
+            return None
+        stream = zblob.ZstdStreamReader(index, read_at, name=blob_id[:8])
+        self._soci_by_index[blob_index] = stream
+        # Announce the index to the peer tier under the generic artifact
+        # plane: one pod's build amortizes across the fleet.
+        for d in dirs:
+            if d and os.path.exists(zindex_path(d, blob_id)):
+                peer_mod.default_export().register_artifact(
+                    zblob.ZSOCI_ARTIFACT_KIND, blob_id, zindex_path(d, blob_id)
+                )
+                break
+        logger.info("zstd index for %s: %s (%d frames, %s)",
+                    blob_id[:12], outcome, len(index.frames),
+                    index.source_name)
+        return stream
+
     def blob_dir(self, default_dir: str) -> str:
         cfg = self._parsed_config()
         if cfg is not None and cfg.backend.blob_dir:
@@ -331,17 +402,23 @@ class _Instance:
             from nydus_snapshotter_tpu.converter.zran import (
                 CHUNK_FLAG_GZIP_STREAM,
             )
+            from nydus_snapshotter_tpu.converter.zstd_ref import (
+                CHUNK_FLAG_ZSTD_STREAM,
+            )
 
             # Ensure the blob's reader (and CachedBlob, for registry
             # backends) exists; raises after close(), ending the replay.
             reader = self._reader(rec.blob_index, blob_dir)
             cached = self._cached_by_index.get(rec.blob_index)
-            if cached is not None and rec.flags & CHUNK_FLAG_GZIP_STREAM:
-                # Gzip-stream (soci/OCIRef) chunks address the DECOMPRESSED
-                # stream; warming those offsets against the compressed blob
-                # would warm garbage. Translate through the checkpoint
-                # index when one is mounted, else warm through the reader
-                # (sequential, still background-lane contained).
+            if cached is not None and rec.flags & (
+                CHUNK_FLAG_GZIP_STREAM | CHUNK_FLAG_ZSTD_STREAM
+            ):
+                # Stream-addressed (soci/OCIRef) chunks — gzip or zstd —
+                # address the DECOMPRESSED stream; warming those offsets
+                # against the compressed blob would warm garbage.
+                # Translate through the mounted index when one exists,
+                # else warm through the reader (sequential, still
+                # background-lane contained).
                 soci = self._soci_by_index.get(rec.blob_index)
                 if soci is not None:
                     c0, c1 = soci.resolve_compressed(
@@ -689,6 +766,15 @@ class DaemonServer:
                     body = {"prefetch_data_amount": amount}
                     body.update(fetch_sched.snapshot_counters())
                     body["soci"] = soci_blob.snapshot_counters()
+                    from nydus_snapshotter_tpu.soci import router as soci_router
+                    from nydus_snapshotter_tpu.soci import zblob as soci_zblob
+
+                    body["soci"]["zindex_frames"] = (
+                        soci_zblob.ZINDEX_FRAMES.value()
+                    )
+                    routes = soci_router.route_counts()
+                    if routes:
+                        body["soci"]["routes"] = routes
                     # Metrics → traces link: the last root trace ids whose
                     # duration exceeded the rolling p95 (fetch them from
                     # /api/v1/traces or /debug/pprof/trace).

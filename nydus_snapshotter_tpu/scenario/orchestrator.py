@@ -698,31 +698,63 @@ class ScenarioRunner:
             for svc in services:
                 svc.stop()
 
-    def _image_for_deploy(self, cid: str, soci: bool) -> dict:
-        """Converted image, or (soci arm) the UNCONVERTED gzip layer —
-        registered lazily so a deploy can reference a corpus no convert
-        phase touched."""
-        key = f"soci:{cid}" if soci else cid
+    def _image_for_deploy(self, cid: str, soci: bool, fmt: str = "gzip") -> dict:
+        """Converted image, or (soci arm) the UNCONVERTED layer in one of
+        the lazy formats the FormatRouter recognizes — registered lazily
+        so a deploy can reference a corpus no convert phase touched."""
+        key = f"soci:{fmt}:{cid}" if soci else cid
         if key in self.images:
             return self.images[key]
         if soci:
             tar = self._corpus_tar(cid)
-            # mtime=0: the gzip header must not carry wall-clock time or
-            # the serial replay's blob id diverges from the storm's.
-            gz = _gzip.compress(tar, compresslevel=6, mtime=0)
-            blob_id = hashlib.sha256(gz).hexdigest()
+            # Every writer here is deterministic (gzip mtime=0, fixed
+            # zstd level): wall-clock in a header would fork the serial
+            # replay's blob id from the storm's.
+            blob = self._format_blob(tar, fmt)
+            blob_id = hashlib.sha256(blob).hexdigest()
             img = {
-                "cid": key, "blob": gz, "blob_id": blob_id,
-                "digest": hashlib.sha256(gz).hexdigest(),
-                "tar": tar, "soci": True,
+                "cid": key, "blob": blob, "blob_id": blob_id,
+                "digest": hashlib.sha256(blob).hexdigest(),
+                "tar": tar, "soci": True, "format": fmt,
             }
             self.images[key] = img
-            self.registry.register(blob_id, gz)
+            self.registry.register(blob_id, blob)
             return img
         raise ScenarioRunError(
             f"deploy references corpus {cid!r} with no converted image "
             "(add a convert phase or set soci = true)"
         )
+
+    @staticmethod
+    def _format_blob(tar: bytes, fmt: str) -> bytes:
+        """The corpus tar in one deployable lazy format. zstd shapes need
+        the system libzstd; a spec asking for them on a box without it is
+        a hard run error, not silent gzip."""
+        if fmt == "gzip":
+            return _gzip.compress(tar, compresslevel=6, mtime=0)
+        from nydus_snapshotter_tpu.soci import toc as ztoc
+        from nydus_snapshotter_tpu.soci import zframe
+        from nydus_snapshotter_tpu.utils import zstd as _zstd
+
+        if not (zframe.available() and _zstd.dctx_available()):
+            raise ScenarioRunError(
+                f"soci format {fmt!r} needs the system libzstd"
+            )
+        if fmt == "zstd-seekable":
+            return zframe.write_seekable(tar, frame_usize=256 << 10)
+        if fmt == "zstd-opaque":
+            return zframe.write_frames(tar, frame_usize=256 << 10)
+        if fmt == "zstd-chunked":
+            import io
+            import tarfile
+
+            files: dict[str, bytes] = {}
+            with tarfile.open(fileobj=io.BytesIO(tar), mode="r:") as tf:
+                for m in tf:
+                    if m.isreg():
+                        files[m.name] = tf.extractfile(m).read()
+            return ztoc.write_zstd_chunked(files, chunk_size=256 << 10)
+        raise ScenarioRunError(f"unhandled soci format {fmt!r}")
 
     def _control_plane_pod(self, prefix: str, layers: int, cp=None) -> dict:
         """The containerd cold-start RPC mix for one pod: layer chain +
@@ -797,8 +829,10 @@ class ScenarioRunner:
         pods = phase.pods or self.pods_default
         peers_on = phase.peers and not self.serial and pods > 1
         layers = phase.layers
+        fmts = phase.soci_formats or ("gzip",) * len(phase.corpus)
         images = [
-            self._image_for_deploy(cid, phase.soci) for cid in phase.corpus
+            self._image_for_deploy(cid, phase.soci, fmt)
+            for cid, fmt in zip(phase.corpus, fmts)
         ]
         from nydus_snapshotter_tpu.remote.mirror import HostHealthRegistry
 
@@ -1158,22 +1192,43 @@ class ScenarioRunner:
             time.sleep(0.05)
 
     def _soci_reads(self, pod, img, tag: str) -> None:
-        """The unconverted arm: first-pull checkpoint index over the
-        pod's CachedBlob, then per-file reads verified against the
-        original tar — the read path the soci backend deploys."""
+        """The unconverted arm: lazy per-file reads over the pod's
+        CachedBlob, verified against the original tar — the read path the
+        soci backend deploys for whichever format the image ships.
+        gzip → checkpoint index, zstd-seekable/opaque → frame index,
+        zstd-chunked → TOC adoption (zero index-build bytes)."""
+        fmt = img.get("format", "gzip")
+        if fmt == "zstd-chunked":
+            self._soci_reads_toc(pod, img, tag)
+            return
         from nydus_snapshotter_tpu.soci import blob as soci_blob
 
-        index, outcome = soci_blob.load_or_build_index(
-            [pod.cache_dir],
-            img["blob_id"],
-            csize=len(img["blob"]),
-            builder=lambda: pod.cb.read_at(0, len(img["blob"])),
-            stride=64 << 10,
-        )
+        if fmt == "gzip":
+            index, outcome = soci_blob.load_or_build_index(
+                [pod.cache_dir],
+                img["blob_id"],
+                csize=len(img["blob"]),
+                builder=lambda: pod.cb.read_at(0, len(img["blob"])),
+                stride=64 << 10,
+            )
+        else:  # zstd-seekable / zstd-opaque: the frame-index twin
+            from nydus_snapshotter_tpu.soci import zblob as soci_zblob
+
+            index, outcome = soci_zblob.load_or_build_zindex(
+                [pod.cache_dir],
+                img["blob_id"],
+                csize=len(img["blob"]),
+                builder=lambda: pod.cb.read_at(0, len(img["blob"])),
+            )
         self.soci_outcomes.append(outcome)
         if index is None:
             raise ScenarioRunError(f"{tag}: soci index unavailable ({outcome})")
-        reader = soci_blob.SociStreamReader(index, pod.cb.read_at, name=tag)
+        if fmt == "gzip":
+            reader = soci_blob.SociStreamReader(index, pod.cb.read_at, name=tag)
+        else:
+            from nydus_snapshotter_tpu.soci.zblob import ZstdStreamReader
+
+            reader = ZstdStreamReader(index, pod.cb.read_at, name=tag)
         tar = img["tar"]
         extents = sorted(soci_blob.file_extents(tar).items())
         h = hashlib.sha256()
@@ -1183,6 +1238,56 @@ class ScenarioRunner:
             want.update(tar[off : off + min(size, READ_CHUNK)])
         if h.hexdigest() != want.hexdigest():
             raise ScenarioRunError(f"{tag}: soci reads diverge from the tar")
+        self.read_digests[f"{tag}-soci"] = h.hexdigest()
+
+    def _soci_reads_toc(self, pod, img, tag: str) -> None:
+        """The toc-adopt arm: the shipped zstd:chunked TOC IS the
+        file→extent map — adopt it into a bootstrap, read files through
+        per-chunk ranged fetches of the ORIGINAL blob, verify against the
+        tar. No index artifact exists for this format, by design."""
+        from nydus_snapshotter_tpu.converter.convert import BlobReader
+        from nydus_snapshotter_tpu.soci import blob as soci_blob
+        from nydus_snapshotter_tpu.soci import toc as ztoc
+        from nydus_snapshotter_tpu.constants import COMPRESSOR_ZSTD
+        from nydus_snapshotter_tpu.stargz.index import bootstrap_from_toc
+
+        failpoint.hit("soci.index")
+        size = len(img["blob"])
+        toc = ztoc.read_toc(pod.cb.read_at, size)
+        loc = ztoc.parse_footer(
+            pod.cb.read_at(size - ztoc.FOOTER_SIZE, ztoc.FOOTER_SIZE)
+        )
+        if toc is None or loc is None:
+            raise ScenarioRunError(f"{tag}: zstd:chunked TOC unreadable")
+        bs = bootstrap_from_toc(
+            toc,
+            img["blob_id"],
+            chunk_size=256 << 10,
+            blob_compressed_size=loc[0],
+            compressor=COMPRESSOR_ZSTD,
+        )
+        self.soci_outcomes.append("toc-adopt")
+        br = BlobReader(bs, 0, pod.cb.read_at)
+        tar = img["tar"]
+        contents = {
+            p.lstrip("/"): tar[off : off + sz]
+            for p, (off, sz) in soci_blob.file_extents(tar).items()
+        }
+        import stat as statmod
+
+        inodes = sorted(
+            (i for i in bs.inodes if statmod.S_ISREG(i.mode)),
+            key=lambda i: i.path,
+        )
+        h = hashlib.sha256()
+        want = hashlib.sha256()
+        for ino in inodes[:: max(1, len(inodes) // 8)]:
+            recs = bs.chunks[ino.chunk_index : ino.chunk_index + ino.chunk_count]
+            got = b"".join(br.chunk_data(r) for r in recs)
+            h.update(got[:READ_CHUNK])
+            want.update(contents[ino.path.lstrip("/")][:READ_CHUNK])
+        if h.hexdigest() != want.hexdigest():
+            raise ScenarioRunError(f"{tag}: toc-adopt reads diverge from the tar")
         self.read_digests[f"{tag}-soci"] = h.hexdigest()
 
     def _phase_remove(self, idx: int, phase: PhaseSpec) -> dict:

@@ -102,6 +102,9 @@ CORPUS_KINDS = (
 PHASE_OPS = ("convert", "deploy", "remove", "gc", "crash_restart")
 CRASH_MODES = ("", "mid")
 DEPLOY_APIS = ("", "snapshotter", "grpc")
+# Per-layer lazy formats a soci deploy phase can ship (mirrors the
+# FormatRouter's probe classes; "gzip" is the historical default).
+SOCI_FORMATS = ("gzip", "zstd-seekable", "zstd-opaque", "zstd-chunked")
 
 
 def _only_keys(table: dict, allowed: set, where: str) -> None:
@@ -170,6 +173,12 @@ class PhaseSpec:
     peers: bool = True
     corrupt_peer: bool = False
     soci: bool = False
+    # deploy + soci: per-corpus lazy format, parallel to ``corpus``
+    # (one entry per image; empty = all gzip, the historical shape).
+    # Mixed lists put gzip + zstd-seekable + zstd-opaque + TOC layers
+    # in the SAME storm; every writer is deterministic so the serial
+    # replay keeps blob-id identity.
+    soci_formats: tuple = ()
     read_mib: int = 0  # demand-read window per pod (0 = whole blob)
     crash: str = ""
     gc_watermark_mib: int = 0
@@ -198,9 +207,9 @@ class PhaseSpec:
         _only_keys(
             d,
             {"op", "corpus", "pods", "layers", "adaptive", "peers",
-             "corrupt_peer", "soci", "read_mib", "crash", "gc_watermark_mib",
-             "watermark_mib", "fraction", "deploy_api", "shard_failover",
-             "kill_zone"},
+             "corrupt_peer", "soci", "soci_formats", "read_mib", "crash",
+             "gc_watermark_mib", "watermark_mib", "fraction", "deploy_api",
+             "shard_failover", "kill_zone"},
             where,
         )
         op = d.get("op", "")
@@ -217,6 +226,7 @@ class PhaseSpec:
             peers=bool(d.get("peers", True)),
             corrupt_peer=bool(d.get("corrupt_peer", False)),
             soci=bool(d.get("soci", False)),
+            soci_formats=tuple(d.get("soci_formats", ())),
             read_mib=int(d.get("read_mib", 0)),
             crash=d.get("crash", ""),
             gc_watermark_mib=int(d.get("gc_watermark_mib", 0)),
@@ -250,6 +260,24 @@ class PhaseSpec:
             raise ScenarioSpecError(f"{where}: kill_zone only applies to deploy")
         if spec.kill_zone and not spec.peers:
             raise ScenarioSpecError(f"{where}: kill_zone needs peers = true")
+        if spec.soci_formats:
+            if op != "deploy" or not spec.soci:
+                raise ScenarioSpecError(
+                    f"{where}: soci_formats only applies to deploy with"
+                    " soci = true"
+                )
+            if len(spec.soci_formats) != len(spec.corpus):
+                raise ScenarioSpecError(
+                    f"{where}: soci_formats must be parallel to corpus"
+                    f" ({len(spec.soci_formats)} formats for"
+                    f" {len(spec.corpus)} corpora)"
+                )
+            bad = [f for f in spec.soci_formats if f not in SOCI_FORMATS]
+            if bad:
+                raise ScenarioSpecError(
+                    f"{where}: unknown soci format(s) {bad}"
+                    f" (one of {', '.join(SOCI_FORMATS)})"
+                )
         return spec
 
     def to_dict(self) -> dict:
@@ -257,7 +285,8 @@ class PhaseSpec:
             "op": self.op, "corpus": list(self.corpus), "pods": self.pods,
             "layers": self.layers, "adaptive": self.adaptive,
             "peers": self.peers, "corrupt_peer": self.corrupt_peer,
-            "soci": self.soci, "read_mib": self.read_mib, "crash": self.crash,
+            "soci": self.soci, "soci_formats": list(self.soci_formats),
+            "read_mib": self.read_mib, "crash": self.crash,
             "gc_watermark_mib": self.gc_watermark_mib,
             "watermark_mib": self.watermark_mib, "fraction": self.fraction,
             "deploy_api": self.deploy_api,

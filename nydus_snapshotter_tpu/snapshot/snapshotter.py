@@ -467,12 +467,18 @@ class Snapshotter:
                                 snap_labels[C.STARGZ_LAYER] = "true"
                                 handler = skip_handler
                 if handler is None and self.fs.soci_enabled():
-                    # Seekable-OCI: claim the ordinary gzip layer nobody
-                    # will ever convert. Runs after the stargz arm so
-                    # cooperative estargz images keep their TOC path; the
-                    # detection is a 2-byte gzip-magic ranged read.
+                    # Seekable-OCI: claim the ordinary gzip or zstd layer
+                    # nobody will ever convert. Runs after the stargz arm
+                    # so cooperative estargz images keep their TOC path;
+                    # detection is the FormatRouter's two ranged probe
+                    # reads (4 head bytes + one tail read), which pick a
+                    # lazy backend by modeled cold-read cost or raise to
+                    # fall through to ordinary conversion (soci/router.py).
                     ok, blob = self.fs.is_soci_data_layer(snap_labels)
                     if ok:
+                        route = getattr(blob, "route", None)
+                        if route is not None:
+                            snap_labels[C.SOCI_ROUTE] = route.backend
                         if self._board.enabled:
                             # Optimistic skip, like stargz: the heavy
                             # first-pull index build overlaps on the board

@@ -1,31 +1,42 @@
-"""Snapshotter-side soci driver: probe, index-on-first-pull, merge.
+"""Snapshotter-side soci driver: probe, route, index-on-first-pull, merge.
 
 The exact shape of the stargz adaptor pair (stargz/{resolver,adaptor}.py),
-for layers that carry NO cooperation from the image builder:
+for layers that carry NO cooperation from the image builder — and, since
+the universal-formats work, for cooperating zstd:chunked / eStargz /
+seekable-zstd layers too:
 
-- :class:`SociResolver` detects a claimable layer the cheapest possible
-  way — one 2-byte ranged read proving the blob is gzip. Any plain OCI
-  ``.tar.gz`` layer qualifies; there is nothing to parse because the
-  whole point is that the image was never rewritten.
-- :class:`SociAdaptor.prepare_meta_layer` is the **one** full pull the
-  backend ever performs: stream the original blob, run the single zran
-  build pass (checkpoints + decompressed bytes in one inflate), emit the
-  layer bootstrap from that same pass via
-  :func:`~nydus_snapshotter_tpu.converter.zran.pack_gzip_layer` — the
-  blob referenced is the ORIGINAL registry layer, nothing is converted
-  or re-stored — and persist the checkpoint index into the cache dir
-  next to where the blob's chunk map will live. Subsequent pods skip
-  even this: the index replicates through the peer tier
-  (:func:`~nydus_snapshotter_tpu.soci.blob.load_or_build_index`).
+- :class:`SociResolver` probes a claimable layer the cheapest possible
+  way — two ranged reads (4 head bytes + one ≤56-byte tail) through the
+  per-layer :class:`~nydus_snapshotter_tpu.soci.router.FormatRouter`,
+  which picks {toc-adopt, seekable-index, zran-index} by modeled
+  cold-read cost. A layer the model routes to ``rafs-convert`` (unknown
+  compression, missing decoder surface) raises :class:`SociError` here,
+  cheaply, so the snapshotter falls through to ordinary conversion. The
+  decision rides the returned blob as ``blob.route``.
+- :class:`SociAdaptor.prepare_meta_layer` executes the routed backend:
+
+  * ``toc-adopt`` — fetch the shipped TOC (eStargz tar member or
+    zstd:chunked manifest) with ranged reads and emit the bootstrap
+    straight from it (``stargz/index.bootstrap_from_toc``): ZERO
+    build-pass bytes, no index artifact — the TOC is the index.
+  * ``seekable-index`` — the one full pull, one sequential frame pass
+    (:func:`~nydus_snapshotter_tpu.soci.zblob.build_zindex_from_zstd`
+    — free when a seek table is shipped), bootstrap via
+    ``pack_zstd_layer`` from the same pass, ``.soci.zidx`` persisted.
+    A degenerate single-frame blob demotes to ``rafs-convert`` (no
+    random access exists to index) by raising — the layer converts
+    normally.
+  * ``zran-index`` — the PR-12 gzip path, unchanged: one full pull, one
+    inflate pass, ``.soci.idx`` persisted.
+
 - ``merge_meta_layer`` is byte-for-byte the stargz merge (per-layer
   bootstraps named by digest hex → ``image.boot``), reused by
-  composition: zran bootstraps and TOC bootstraps merge identically
-  (pinned since the ``test_merge_mixes_zran_and_packed_layers`` days).
+  composition: zran, zstd-frame and TOC bootstraps merge identically.
 
-When the system libz lacks zran support the adaptor still claims the
-layer — the bootstrap alone makes it lazily readable via the sequential
-in-process reader — it just cannot persist checkpoints (documented
-degraded mode).
+When the needed decoder surface is missing (no libz zran, no libzstd
+frame API) the router's cost table simply lacks those candidates and
+the layer routes to what remains — degraded modes are routing outcomes,
+not special cases.
 """
 
 from __future__ import annotations
@@ -38,24 +49,41 @@ from typing import Callable, Mapping, Optional
 from nydus_snapshotter_tpu import constants
 from nydus_snapshotter_tpu.converter.types import PackOption
 from nydus_snapshotter_tpu.converter.zran import pack_gzip_layer
+from nydus_snapshotter_tpu.converter.zstd_ref import pack_zstd_layer
 from nydus_snapshotter_tpu.soci import blob as soci_blob
-from nydus_snapshotter_tpu.soci import zran
+from nydus_snapshotter_tpu.soci import router as soci_router
+from nydus_snapshotter_tpu.soci import toc as ztoc
+from nydus_snapshotter_tpu.soci import zblob, zran
 from nydus_snapshotter_tpu.soci.index import index_path
+from nydus_snapshotter_tpu.soci.router import (
+    BACKEND_RAFS,
+    BACKEND_SEEKABLE,
+    BACKEND_TOC_ADOPT,
+    FORMAT_ESTARGZ,
+    FormatRouter,
+)
+from nydus_snapshotter_tpu.soci.zindex import zindex_path
 from nydus_snapshotter_tpu.stargz.adaptor import StargzAdaptor
+from nydus_snapshotter_tpu.stargz.index import bootstrap_from_toc
 from nydus_snapshotter_tpu.stargz.resolver import Blob, Resolver, _blob_size
 from nydus_snapshotter_tpu.utils import errdefs
 
 logger = logging.getLogger(__name__)
-
-_GZIP_MAGIC = b"\x1f\x8b"
 
 
 class SociError(errdefs.NydusError):
     pass
 
 
+def _config_router() -> FormatRouter:
+    cfg = soci_blob.resolve_soci_config()
+    return FormatRouter(enable_zstd=cfg.zstd, enable_toc=cfg.toc_adopt)
+
+
 class SociResolver(Resolver):
-    """Ranged-blob resolver accepting ANY gzip layer (no footer needed)."""
+    """Ranged-blob resolver accepting any layer the FormatRouter can
+    route to a lazy backend (gzip, eStargz, seekable/opaque/chunked
+    zstd — no footer or annotation required)."""
 
     def get_blob(
         self, ref: str, digest: str, labels: Optional[Mapping[str, str]] = None
@@ -80,13 +108,18 @@ class SociResolver(Resolver):
             finally:
                 r.close()
 
-        # Detection is two bytes: a non-gzip layer (zstd, uncompressed
-        # tar, foreign media type) must fail here, cheaply, not later in
-        # the prepare path.
-        head = read_at(0, 2)
-        if head != _GZIP_MAGIC:
-            raise SociError(f"blob {digest} is not a gzip layer")
-        return Blob(ref, digest, read_at, size)
+        # Routing IS the detection: an unroutable layer (unknown magic,
+        # or every lazy candidate infeasible) must fail here, cheaply,
+        # not later in the prepare path.
+        decision = _config_router().route(read_at, size)
+        if decision.backend == BACKEND_RAFS:
+            raise SociError(
+                f"blob {digest} routed to rafs-convert "
+                f"({decision.format}: {decision.reason})"
+            )
+        blob = Blob(ref, digest, read_at, size)
+        blob.route = decision
+        return blob
 
 
 class SociAdaptor:
@@ -109,7 +142,7 @@ class SociAdaptor:
             chunk_size=chunk_size,
         )
 
-    # -- prepare (index on first pull) ---------------------------------------
+    # -- prepare (route → adopt or index on first pull) ----------------------
 
     def prepare_meta_layer(
         self, blob: Blob, storage_path: str,
@@ -121,13 +154,94 @@ class SociAdaptor:
         if os.path.exists(converted):
             return
 
-        # The one full pull. Everything after this is ranged.
-        raw = blob.read_at(0, blob.size)
-        if len(raw) != blob.size:
+        route = getattr(blob, "route", None)
+        if route is None:
+            # Direct callers (tests, tools) that skipped the resolver:
+            # route now, with the same counters.
+            route = _config_router().route(blob.read_at, blob.size)
+            if route.backend == BACKEND_RAFS:
+                raise SociError(
+                    f"blob {blob_id[:12]} routed to rafs-convert "
+                    f"({route.format}: {route.reason})"
+                )
+
+        if route.backend == BACKEND_TOC_ADOPT:
+            bootstrap = self._adopt_toc(blob, blob_id, route)
+        elif route.backend == BACKEND_SEEKABLE:
+            bootstrap = self._index_zstd(blob, blob_id, route)
+        else:
+            bootstrap = self._index_gzip(blob, blob_id)
+
+        fd, tmp = tempfile.mkstemp(prefix="converting-soci", dir=storage_path)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(bootstrap.to_bytes())
+            os.rename(tmp, converted)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        os.chmod(converted, 0o440)
+
+    # -- backend arms --------------------------------------------------------
+
+    def _adopt_toc(self, blob: Blob, blob_id: str, route) -> "object":
+        """TOC adoption: the shipped file→extent map becomes the
+        bootstrap. Ranged reads only — zero build-pass bytes."""
+        if route.format == FORMAT_ESTARGZ:
+            toc = blob.toc()
+            data_end = blob.get_toc_offset()
+            compressor = constants.COMPRESSOR_GZIP
+        else:
+            toc = ztoc.read_toc(blob.read_at, blob.size)
+            if toc is None:
+                raise SociError(
+                    f"blob {blob_id[:12]} routed toc-adopt but carries no TOC"
+                )
+            loc = route.toc_location or ztoc.parse_footer(
+                blob.read_at(blob.size - ztoc.FOOTER_SIZE, ztoc.FOOTER_SIZE)
+            )
+            data_end = loc[0]
+            compressor = constants.COMPRESSOR_ZSTD
+        logger.info("soci toc-adopt for %s (%s): bootstrap from shipped TOC",
+                    blob_id[:12], route.format)
+        return bootstrap_from_toc(
+            toc,
+            blob_id,
+            chunk_size=self.chunk_size,
+            blob_compressed_size=data_end,
+            compressor=compressor,
+        )
+
+    def _index_zstd(self, blob: Blob, blob_id: str, route) -> "object":
+        """seekable-index: the one full pull, one frame pass (seek table
+        trusted when shipped), bootstrap + persisted ``.soci.zidx``."""
+        raw = self._full_pull(blob, blob_id)
+        index, tar_bytes = zblob.build_zindex_from_zstd(blob_id, raw)
+        if len(index.frames) <= 1 and index.uncompressed_size > self.chunk_size:
+            # One frame = no random access to index: every cold read
+            # would decode from byte zero. The cost model's answer for
+            # that shape is conversion; re-route and decline.
+            soci_router.ROUTE_TOTAL.labels(BACKEND_RAFS).inc()
             raise SociError(
-                f"blob {blob_id[:12]} short pull: {len(raw)} of {blob.size}"
+                f"blob {blob_id[:12]} is single-frame zstd "
+                f"({index.uncompressed_size} bytes): re-routed to rafs-convert"
             )
 
+        opt = PackOption(chunk_size=self.chunk_size, oci_ref=True)
+        bootstrap = pack_zstd_layer(raw, opt, tar_bytes=tar_bytes)
+
+        if self.cache_dir:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            soci_blob.INDEX_BYTES.inc(
+                index.save(zindex_path(self.cache_dir, blob_id))
+            )
+            soci_blob.INDEX_EVENTS.labels("built").inc()
+        return bootstrap
+
+    def _index_gzip(self, blob: Blob, blob_id: str) -> "object":
+        """zran-index: the PR-12 gzip arm, unchanged."""
+        raw = self._full_pull(blob, blob_id)
         index = None
         tar_bytes = None
         stride = self.stride or soci_blob.resolve_soci_config().stride_bytes
@@ -150,17 +264,17 @@ class SociAdaptor:
                 "libz zran unavailable: soci layer %s gets no checkpoint "
                 "index (sequential cold reads)", blob_id[:12],
             )
+        return bootstrap
 
-        fd, tmp = tempfile.mkstemp(prefix="converting-soci", dir=storage_path)
-        try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(bootstrap.to_bytes())
-            os.rename(tmp, converted)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
-        os.chmod(converted, 0o440)
+    @staticmethod
+    def _full_pull(blob: Blob, blob_id: str) -> bytes:
+        # The one full pull. Everything after this is ranged.
+        raw = blob.read_at(0, blob.size)
+        if len(raw) != blob.size:
+            raise SociError(
+                f"blob {blob_id[:12]} short pull: {len(raw)} of {blob.size}"
+            )
+        return raw
 
     # -- merge ---------------------------------------------------------------
 

@@ -116,12 +116,21 @@ def snapshot_counters() -> dict:
 
 
 class SociRuntimeConfig:
-    __slots__ = ("enable", "stride_bytes", "replicate")
+    __slots__ = ("enable", "stride_bytes", "replicate", "zstd", "toc_adopt")
 
-    def __init__(self, enable: bool, stride_bytes: int, replicate: bool):
+    def __init__(
+        self,
+        enable: bool,
+        stride_bytes: int,
+        replicate: bool,
+        zstd: bool = True,
+        toc_adopt: bool = True,
+    ):
         self.enable = enable
         self.stride_bytes = stride_bytes
         self.replicate = replicate
+        self.zstd = zstd
+        self.toc_adopt = toc_adopt
 
 
 def _global_soci_config():
@@ -154,6 +163,10 @@ def resolve_soci_config() -> SociRuntimeConfig:
         stride_bytes=max(MIN_STRIDE_KIB, stride_kib) << 10,
         replicate=_bool(
             "NTPU_SOCI_REPLICATE", bool(getattr(sc, "replicate", True))
+        ),
+        zstd=_bool("NTPU_SOCI_ZSTD", bool(getattr(sc, "zstd", True))),
+        toc_adopt=_bool(
+            "NTPU_SOCI_TOC_ADOPT", bool(getattr(sc, "toc_adopt", True))
         ),
     )
 

@@ -138,12 +138,16 @@ def bootstrap_from_toc(
     chunk_size: int = DEFAULT_CHUNK_SIZE,
     blob_compressed_size: int = 0,
     fs_version: str = layout.RAFS_V6,
+    compressor: int = constants.COMPRESSOR_GZIP,
 ) -> Bootstrap:
     """Build the layer bootstrap pointing chunks at the estargz blob itself.
 
     ``blob_compressed_size`` (total blob size when known) bounds the last
     chunk's compressed extent; per-chunk compressed sizes are derived from
-    consecutive TOC stream offsets.
+    consecutive TOC stream offsets. ``compressor`` is the per-chunk codec
+    flag: gzip members for eStargz, or COMPRESSOR_ZSTD for zstd:chunked
+    TOCs whose chunks are independent zstd frames at the same offsets —
+    the TOC shape is identical, only the decode arm differs.
     """
     entries = parse_toc(toc)
 
@@ -177,7 +181,7 @@ def bootstrap_from_toc(
             chunks.append(
                 ChunkRecord(
                     digest=_raw_digest(e.chunk_digest),
-                    flags=constants.COMPRESSOR_GZIP,
+                    flags=compressor,
                     uncompressed_offset=uncompressed_pos,
                     compressed_offset=e.offset,
                     uncompressed_size=csize,
@@ -223,7 +227,7 @@ def bootstrap_from_toc(
             chunks.append(
                 ChunkRecord(
                     digest=_raw_digest(digest_src),
-                    flags=constants.COMPRESSOR_GZIP,
+                    flags=compressor,
                     uncompressed_offset=uncompressed_pos,
                     compressed_offset=e.offset,
                     uncompressed_size=csize,
@@ -246,7 +250,7 @@ def bootstrap_from_toc(
         compressed_size=blob_compressed_size,
         uncompressed_size=uncompressed_pos,
         chunk_count=len(chunks),
-        flags=constants.COMPRESSOR_GZIP,
+        flags=compressor,
     )
     ordered = sorted(inodes.values(), key=lambda i: i.path)
     return Bootstrap(

@@ -35,6 +35,22 @@ _CONTENTSIZE_UNKNOWN = 2**64 - 1
 _CONTENTSIZE_ERROR = 2**64 - 2
 
 
+class _InBuffer(ctypes.Structure):
+    _fields_ = [
+        ("src", ctypes.c_void_p),
+        ("size", ctypes.c_size_t),
+        ("pos", ctypes.c_size_t),
+    ]
+
+
+class _OutBuffer(ctypes.Structure):
+    _fields_ = [
+        ("dst", ctypes.c_void_p),
+        ("size", ctypes.c_size_t),
+        ("pos", ctypes.c_size_t),
+    ]
+
+
 class _Api:
     # A CCtx is not concurrency-safe and each one holds a multi-MiB
     # workspace, so contexts live in a small bounded pool instead of
@@ -79,6 +95,7 @@ class _Api:
         self.has_dctx = self._bind_dctx(lib)
         self.has_dict = self._bind_dict(lib)
         self.has_zdict = self._bind_zdict(lib)
+        self.has_frames = self._bind_frames(lib)
 
     @staticmethod
     def _bind_dctx(lib) -> bool:
@@ -128,6 +145,33 @@ class _Api:
                 ctypes.c_void_p, ctypes.c_size_t,
                 ctypes.c_void_p, ctypes.c_size_t,
                 ctypes.c_void_p,
+            ]
+        except AttributeError:
+            return False
+        return True
+
+    @staticmethod
+    def _bind_frames(lib) -> bool:
+        """Frame-walk + streaming surface for the seekable-zstd index
+        (soci/zframe.py): per-frame compressed size without decoding,
+        and a DStream decode for frames whose header omits the content
+        size. ``ZSTD_isSkippableFrame`` is NOT bound — absent from older
+        system builds (1.4.x) — the 4-byte magic check is done in
+        Python instead."""
+        try:
+            lib.ZSTD_findFrameCompressedSize.restype = ctypes.c_size_t
+            lib.ZSTD_findFrameCompressedSize.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t,
+            ]
+            # DStream == DCtx in every libzstd this binds, so the pooled
+            # decompress contexts double as streaming decoders.
+            lib.ZSTD_initDStream.restype = ctypes.c_size_t
+            lib.ZSTD_initDStream.argtypes = [ctypes.c_void_p]
+            lib.ZSTD_decompressStream.restype = ctypes.c_size_t
+            lib.ZSTD_decompressStream.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(_OutBuffer),
+                ctypes.POINTER(_InBuffer),
             ]
         except AttributeError:
             return False
@@ -475,3 +519,209 @@ def decompress_with_ddict(
             "(wrong or missing dictionary?)"
         )
     return buf[:w].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Frame surface (seekable-zstd index, soci/zframe.py)
+# ---------------------------------------------------------------------------
+
+# Skippable-frame magic range: 0x184D2A50 .. 0x184D2A5F (little-endian on
+# the wire). Checked by hand — ZSTD_isSkippableFrame is missing from the
+# 1.4.x system builds this module must keep working against.
+_SKIPPABLE_LO = 0x184D2A50
+_SKIPPABLE_HI = 0x184D2A5F
+
+
+def frames_available() -> bool:
+    """True when the bound libzstd exposes the frame-walk + streaming
+    surface (findFrameCompressedSize / decompressStream)."""
+    return _API is not None and _API.has_frames and _API.has_dctx
+
+
+def is_skippable_frame(data: bytes | memoryview, offset: int = 0) -> bool:
+    """Pure-Python skippable-frame probe on the 4-byte magic at
+    ``offset`` (no library call: older system builds lack the API)."""
+    head = bytes(data[offset : offset + 4])
+    if len(head) < 4:
+        return False
+    return _SKIPPABLE_LO <= int.from_bytes(head, "little") <= _SKIPPABLE_HI
+
+
+def find_frame_compressed_size(data: bytes | memoryview, offset: int = 0) -> int:
+    """Compressed size of the frame starting at ``offset`` — header,
+    blocks and checksum — WITHOUT decoding it (skippable frames report
+    their full on-wire size too). This is the frame-walk primitive: the
+    whole blob's frame table falls out of repeated calls at each
+    successive boundary."""
+    if not frames_available():
+        raise ZstdError("system libzstd lacks the frame surface")
+    import numpy as np
+
+    src = np.frombuffer(data, dtype=np.uint8)
+    if not 0 <= offset < src.size:
+        raise ZstdError(f"frame offset {offset} outside {src.size}-byte blob")
+    w = _API.lib.ZSTD_findFrameCompressedSize(
+        src.ctypes.data + offset, src.size - offset
+    )
+    if _API.lib.ZSTD_isError(w):
+        raise ZstdError(f"not a complete zstd frame at offset {offset}")
+    return int(w)
+
+
+def frame_content_size(data: bytes | memoryview, offset: int = 0):
+    """Declared decompressed size of the frame at ``offset``, or ``None``
+    when the header legitimately omits it (streaming-created frames;
+    skippable frames report 0). Raises on a malformed header."""
+    if _API is None or not _API.has_dctx:
+        raise ZstdError("system libzstd not available")
+    import numpy as np
+
+    src = np.frombuffer(data, dtype=np.uint8)
+    if not 0 <= offset < src.size:
+        raise ZstdError(f"frame offset {offset} outside {src.size}-byte blob")
+    size = _API.lib.ZSTD_getFrameContentSize(
+        src.ctypes.data + offset, src.size - offset
+    )
+    if size == _CONTENTSIZE_ERROR:
+        raise ZstdError(f"not a valid zstd frame at offset {offset}")
+    if size == _CONTENTSIZE_UNKNOWN:
+        return None
+    return int(size)
+
+
+def stream_decompress(
+    data: bytes | memoryview, max_output_size: int = 0
+) -> bytes:
+    """Streaming decode of one or more concatenated frames (skippable
+    frames are skipped by the decoder) on a pooled context. This is the
+    only decode that handles frames whose header omits the content size
+    — the one-shot :func:`decompress_block` cannot size its buffer for
+    those."""
+    if not frames_available():
+        raise ZstdError("system libzstd lacks the frame surface")
+    import numpy as np
+
+    src = np.frombuffer(data, dtype=np.uint8)
+    n = src.size
+    if n == 0:
+        return b""
+    ctx = _API.acquire_d()
+    out = bytearray()
+    step = 1 << 17
+    chunk = np.empty(step, dtype=np.uint8)
+    try:
+        w = _API.lib.ZSTD_initDStream(ctx)
+        if _API.lib.ZSTD_isError(w):
+            raise ZstdError("ZSTD_initDStream failed")
+        ib = _InBuffer(src.ctypes.data, n, 0)
+        while ib.pos < ib.size:
+            ob = _OutBuffer(chunk.ctypes.data, step, 0)
+            w = _API.lib.ZSTD_decompressStream(
+                ctx, ctypes.byref(ob), ctypes.byref(ib)
+            )
+            if _API.lib.ZSTD_isError(w):
+                raise ZstdError(
+                    f"zstd stream decode failed at input byte {ib.pos}"
+                )
+            out += chunk[: ob.pos].tobytes()
+            if max_output_size and len(out) > max_output_size:
+                raise ZstdError(
+                    f"decompressed stream exceeds max_output_size "
+                    f"{max_output_size}"
+                )
+            if w == 0 and ib.pos >= ib.size:
+                break
+            if ob.pos == 0 and ib.pos >= ib.size and w != 0:
+                raise ZstdError("truncated zstd frame (stream ended early)")
+        if w != 0:
+            raise ZstdError("truncated zstd frame (stream ended early)")
+    except BaseException:
+        # A context abandoned mid-frame must not rejoin the pool: the
+        # next one-shot borrower would inherit its half-decoded state.
+        _API.lib.ZSTD_freeDCtx(ctx)
+        ctx = 0
+        raise
+    finally:
+        _API.release_d(ctx)
+    return bytes(out)
+
+
+class StreamDecoder:
+    """A held streaming decode cursor for sequential zstd reads.
+
+    Unlike zlib's ``decompressobj`` a ZSTD_DCtx cannot be ``copy()``-ed,
+    so the sequential fallback reader (converter/zstd_ref.py) keeps ONE
+    forward cursor per blob: ``feed`` incremental compressed bytes, get
+    whatever decompressed bytes they complete; ``reset`` rewinds to
+    stream start (a full re-init — backward seeks re-decode from zero).
+    Concatenated and skippable frames are handled by the decoder. The
+    context comes from the pool and rejoins it on ``close`` after a
+    clean re-init; a decode error frees it instead (never pool-poisons).
+    """
+
+    def __init__(self):
+        if not frames_available():
+            raise ZstdError("system libzstd lacks the frame surface")
+        self._ctx = _API.acquire_d()
+        self._init()
+
+    def _init(self) -> None:
+        w = _API.lib.ZSTD_initDStream(self._ctx)
+        if _API.lib.ZSTD_isError(w):
+            _API.lib.ZSTD_freeDCtx(self._ctx)
+            self._ctx = 0
+            raise ZstdError("ZSTD_initDStream failed")
+
+    def reset(self) -> None:
+        if not self._ctx:
+            raise ZstdError("stream decoder is closed")
+        self._init()
+
+    def feed(self, data: bytes | memoryview) -> bytes:
+        """Decode ``data`` (the next compressed bytes in stream order)
+        and return every decompressed byte it completes."""
+        if not self._ctx:
+            raise ZstdError("stream decoder is closed")
+        import numpy as np
+
+        src = np.frombuffer(data, dtype=np.uint8)
+        n = src.size
+        if n == 0:
+            return b""
+        out = bytearray()
+        step = 1 << 17
+        chunk = np.empty(step, dtype=np.uint8)
+        ib = _InBuffer(src.ctypes.data, n, 0)
+        while True:
+            ob = _OutBuffer(chunk.ctypes.data, step, 0)
+            w = _API.lib.ZSTD_decompressStream(
+                self._ctx, ctypes.byref(ob), ctypes.byref(ib)
+            )
+            if _API.lib.ZSTD_isError(w):
+                _API.lib.ZSTD_freeDCtx(self._ctx)
+                self._ctx = 0
+                raise ZstdError(
+                    f"zstd stream decode failed at input byte {ib.pos}"
+                )
+            out += chunk[: ob.pos].tobytes()
+            if ib.pos >= ib.size and ob.pos < step:
+                break
+        return bytes(out)
+
+    def close(self) -> None:
+        ctx, self._ctx = self._ctx, 0
+        if not ctx:
+            return
+        # Re-init before rejoining the pool so no borrower can inherit
+        # mid-frame state; a failed init frees instead.
+        w = _API.lib.ZSTD_initDStream(ctx)
+        if _API.lib.ZSTD_isError(w):
+            _API.lib.ZSTD_freeDCtx(ctx)
+            return
+        _API.release_d(ctx)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

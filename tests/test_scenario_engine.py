@@ -447,6 +447,67 @@ layers = 2
         assert any(k.endswith("-soci") for k in fp1["reads"])
         assert au1["clean"] and au2["clean"]
 
+    def test_mixed_format_soci_deploy(self, tmp_path):
+        """One deploy ships gzip + zstd-seekable + zstd-opaque +
+        zstd:chunked(TOC) layers together; each pod reads its layer
+        through the format's own lazy path and the serial replay keeps
+        blob-id identity across all four."""
+        from nydus_snapshotter_tpu.soci import zframe
+        from nydus_snapshotter_tpu.utils import zstd as zstd_native
+
+        if not (zframe.available() and zstd_native.dctx_available()):
+            pytest.skip("system libzstd unavailable")
+        spec = sspec.loads("""
+[scenario]
+name = "soci-mixed"
+seed = 5
+pods = 4
+[[scenario.corpus]]
+id = "gz"
+kind = "compressible"
+mib = 2
+[[scenario.phases]]
+op = "deploy"
+corpus = ["gz", "gz", "gz", "gz"]
+soci = true
+soci_formats = ["gzip", "zstd-seekable", "zstd-opaque", "zstd-chunked"]
+layers = 2
+""")
+        (rep1, fp1, au1), (rep2, fp2, au2) = run_pair(spec, tmp_path)
+        assert rep1["ok"], rep1["error"]
+        assert rep2["ok"], rep2["error"]
+        assert fp1 == fp2
+        # gzip + the two zstd index arms build; the chunked arm adopts
+        # its shipped TOC — no index artifact at all.
+        assert sorted(rep1["soci_outcomes"]) == [
+            "built", "built", "built", "toc-adopt"
+        ]
+        assert au1["clean"] and au2["clean"]
+        # Four distinct blobs (one per format) from the same tar.
+        assert len(set(fp1["blobs"].values())) >= 4
+
+    def test_soci_formats_spec_validation(self):
+        base = """
+[scenario]
+name = "v"
+seed = 1
+pods = 2
+[[scenario.corpus]]
+id = "gz"
+kind = "compressible"
+mib = 1
+[[scenario.phases]]
+op = "deploy"
+corpus = ["gz"]
+%s
+"""
+        with pytest.raises(sspec.ScenarioSpecError, match="soci = true"):
+            sspec.loads(base % 'soci_formats = ["gzip"]')
+        with pytest.raises(sspec.ScenarioSpecError, match="parallel"):
+            sspec.loads(base % 'soci = true\nsoci_formats = ["gzip", "gzip"]')
+        with pytest.raises(sspec.ScenarioSpecError, match="unknown soci format"):
+            sspec.loads(base % 'soci = true\nsoci_formats = ["lz4"]')
+
     def test_run_scenario_convenience(self):
         from nydus_snapshotter_tpu.scenario.orchestrator import run_scenario
 

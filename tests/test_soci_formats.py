@@ -1,0 +1,652 @@
+"""Universal lazy formats: seekable-zstd frame index, zstd:chunked /
+eStargz TOC adoption, and cost-model format routing.
+
+The contract under test (soci/{zframe,zindex,zblob,toc,router}.py): any
+zstd layer gets a persisted, checksummed frame index on first pull —
+free when the blob ships a seekable-format seek table — and layers that
+ship their own TOC (eStargz, zstd:chunked) skip even that: the TOC is
+adopted as the file→extent map with zero build-pass bytes. The
+per-layer FormatRouter picks the backend by modeled cold-read cost from
+two ranged probe reads. The new ``.soci.zidx`` artifact holds the same
+hardening bar as ``.soci.idx``: corrupt/torn/stale fails loudly, is
+rebuilt once, and never poisons reads.
+"""
+
+import gzip
+import io
+import os
+import random
+import tarfile
+
+import pytest
+
+from nydus_snapshotter_tpu import failpoint
+from nydus_snapshotter_tpu.soci import router as soci_router
+from nydus_snapshotter_tpu.soci import toc as ztoc
+from nydus_snapshotter_tpu.soci import zframe, zran
+from nydus_snapshotter_tpu.soci.router import (
+    BACKEND_RAFS,
+    BACKEND_SEEKABLE,
+    BACKEND_TOC_ADOPT,
+    BACKEND_ZRAN,
+    FORMAT_ESTARGZ,
+    FORMAT_GZIP,
+    FORMAT_UNKNOWN,
+    FORMAT_ZSTD_CHUNKED,
+    FORMAT_ZSTD_OPAQUE,
+    FORMAT_ZSTD_SEEKABLE,
+    FormatRouter,
+)
+from nydus_snapshotter_tpu.soci.zblob import (
+    ZstdStreamReader,
+    build_zindex_from_zstd,
+    load_or_build_zindex,
+)
+from nydus_snapshotter_tpu.soci.zindex import (
+    SOURCE_FRAME_WALK,
+    SOURCE_SEEK_TABLE,
+    ZstdFrameIndex,
+    ZstdIndexError,
+    zindex_path,
+)
+
+pytestmark = pytest.mark.skipif(
+    not zframe.available(), reason="system libzstd with frame API required"
+)
+
+FRAME_USIZE = 32 << 10
+BLOB_ID = "ef" * 32
+
+
+def build_layer(n_files=80, seed=5):
+    """(tar bytes, {path: content}) — compressible+binary mix."""
+    rng = random.Random(seed)
+    contents = {}
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:", format=tarfile.GNU_FORMAT) as tf:
+        for i in range(n_files):
+            data = (b"payload %04d " % i) * rng.randrange(40, 300) + rng.randbytes(
+                rng.randrange(100, 3000)
+            )
+            name = f"opt/app/f{i:04d}.dat"
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            ti.mtime = 0
+            tf.addfile(ti, io.BytesIO(data))
+            contents["/" + name] = data
+    return buf.getvalue(), contents
+
+
+@pytest.fixture(scope="module")
+def layer():
+    return build_layer()
+
+
+@pytest.fixture(scope="module")
+def seekable(layer):
+    raw, _ = layer
+    return zframe.write_seekable(raw, frame_usize=FRAME_USIZE)
+
+
+@pytest.fixture(scope="module")
+def opaque(layer):
+    raw, _ = layer
+    return zframe.write_frames(raw, frame_usize=FRAME_USIZE)
+
+
+def _reader_for(blob):
+    return lambda o, s: blob[o : o + s]
+
+
+# ---------------------------------------------------------------------------
+# FormatRouter: classification, cost ordering, probe discipline
+# ---------------------------------------------------------------------------
+
+
+class TestFormatRouter:
+    def _route(self, blob, **kw):
+        return FormatRouter(**kw).route(_reader_for(blob), len(blob),
+                                        record=False)
+
+    def test_zstd_seekable_routes_seekable(self, seekable):
+        d = self._route(seekable)
+        assert (d.backend, d.format) == (BACKEND_SEEKABLE, FORMAT_ZSTD_SEEKABLE)
+
+    def test_zstd_opaque_routes_seekable(self, opaque):
+        d = self._route(opaque)
+        assert (d.backend, d.format) == (BACKEND_SEEKABLE, FORMAT_ZSTD_OPAQUE)
+
+    def test_zstd_chunked_routes_toc_adopt(self, layer):
+        _, contents = layer
+        blob = ztoc.write_zstd_chunked(
+            {k.lstrip("/"): v for k, v in contents.items()},
+            chunk_size=FRAME_USIZE,
+        )
+        d = self._route(blob)
+        assert (d.backend, d.format) == (BACKEND_TOC_ADOPT, FORMAT_ZSTD_CHUNKED)
+        assert d.toc_location is not None
+
+    @pytest.mark.skipif(not zran.available(), reason="zran needed")
+    def test_plain_gzip_routes_zran(self, layer):
+        raw, _ = layer
+        d = self._route(gzip.compress(raw, 6))
+        assert (d.backend, d.format) == (BACKEND_ZRAN, FORMAT_GZIP)
+
+    @pytest.mark.skipif(not zran.available(), reason="zran needed")
+    def test_estargz_routes_toc_adopt(self, layer):
+        from tests.test_stargz import build_estargz
+
+        _, contents = layer
+        blob = build_estargz({k.lstrip("/"): v for k, v in contents.items()})
+        d = self._route(blob)
+        assert (d.backend, d.format) == (BACKEND_TOC_ADOPT, FORMAT_ESTARGZ)
+        # The acceptance bar: TOC adoption must win WHENEVER a TOC
+        # exists — the cost model orders it below every index build.
+        assert d.costs[BACKEND_TOC_ADOPT] < d.costs[BACKEND_ZRAN]
+        assert d.costs[BACKEND_TOC_ADOPT] < d.costs[BACKEND_RAFS]
+
+    def test_unknown_magic_routes_rafs(self):
+        d = self._route(b"\x00" * 4096)
+        assert (d.backend, d.format) == (BACKEND_RAFS, FORMAT_UNKNOWN)
+
+    def test_probe_is_two_small_ranged_reads(self, seekable):
+        calls = []
+
+        def read_at(o, s):
+            calls.append((o, s))
+            return seekable[o : o + s]
+
+        d = FormatRouter().route(read_at, len(seekable), record=False)
+        assert len(calls) == 2  # head + tail, nothing else
+        assert d.probe_bytes <= 64
+
+    def test_cost_ordering_stable_across_sizes(self, layer):
+        # The closed-form model must hold its ordering on tiny blobs
+        # too, where a flat 1 MiB span would dwarf 2*size.
+        raw, _ = layer
+        for cut in (len(raw), 8 << 10):
+            blob = zframe.write_seekable(raw[:cut], frame_usize=4 << 10)
+            d = self._route(blob)
+            assert d.backend == BACKEND_SEEKABLE, cut
+            assert d.costs[BACKEND_SEEKABLE] < d.costs[BACKEND_RAFS], cut
+
+    def test_disable_toc_falls_back_to_index(self, layer):
+        _, contents = layer
+        blob = ztoc.write_zstd_chunked(
+            {k.lstrip("/"): v for k, v in contents.items()},
+            chunk_size=FRAME_USIZE,
+        )
+        d = self._route(blob, enable_toc=False)
+        # Still lazily readable: chunked frames are independent zstd
+        # frames, so the frame walk indexes them.
+        assert d.backend == BACKEND_SEEKABLE
+
+    def test_disable_zstd_routes_rafs(self, seekable):
+        d = self._route(seekable, enable_zstd=False, enable_toc=False)
+        assert d.backend == BACKEND_RAFS
+
+    def test_route_metric_counts(self, seekable):
+        before = soci_router.ROUTE_TOTAL.value(BACKEND_SEEKABLE)
+        FormatRouter().route(_reader_for(seekable), len(seekable))
+        assert soci_router.ROUTE_TOTAL.value(BACKEND_SEEKABLE) == before + 1
+
+
+# ---------------------------------------------------------------------------
+# zstd frame index: geometry and identity
+# ---------------------------------------------------------------------------
+
+
+class TestZstdIndexGeometry:
+    def test_seek_table_adopted_as_source(self, layer, seekable):
+        raw, _ = layer
+        idx, out = build_zindex_from_zstd(BLOB_ID, seekable)
+        assert out == raw
+        assert idx.source == SOURCE_SEEK_TABLE
+        assert idx.source_name == "seek_table"
+        assert len(idx.frames) == (len(raw) + FRAME_USIZE - 1) // FRAME_USIZE
+
+    def test_frame_walk_fallback(self, layer, opaque):
+        raw, _ = layer
+        idx, out = build_zindex_from_zstd(BLOB_ID, opaque)
+        assert out == raw
+        assert idx.source == SOURCE_FRAME_WALK
+        assert idx.source_name == "frame_walk"
+
+    def test_frame_tiling(self, layer, seekable):
+        raw, _ = layer
+        idx, _ = build_zindex_from_zstd(BLOB_ID, seekable)
+        upos = cpos = 0
+        for fr in idx.frames:
+            assert (fr.uout, fr.cin) == (upos, cpos)
+            upos += fr.usize
+            cpos += fr.csize
+        assert upos == len(raw)
+        assert cpos <= len(seekable)  # seek-table frame sits past the data
+
+    def test_resolve_covers_reads(self, layer, seekable):
+        raw, _ = layer
+        idx, _ = build_zindex_from_zstd(BLOB_ID, seekable)
+        frames, cs, ce = idx.resolve(FRAME_USIZE + 17, 10)
+        assert frames and frames[0].uout <= FRAME_USIZE + 17
+        assert frames[-1].uout + frames[-1].usize >= FRAME_USIZE + 27
+        assert 0 < cs < ce <= len(seekable)
+
+    def test_random_extract_identity(self, layer, seekable):
+        raw, _ = layer
+        idx, _ = build_zindex_from_zstd(BLOB_ID, seekable)
+        reader = ZstdStreamReader(idx, _reader_for(seekable))
+        rng = random.Random(4)
+        for _ in range(40):
+            off = rng.randrange(0, len(raw) - 1)
+            size = rng.randrange(1, min(150_000, len(raw) - off))
+            assert reader.read_range(off, size) == raw[off : off + size]
+
+    def test_extract_pulls_only_covering_frames(self, layer, seekable):
+        raw, _ = layer
+        idx, _ = build_zindex_from_zstd(BLOB_ID, seekable)
+        pulled = []
+
+        def tracking(pos, n):
+            pulled.append(n)
+            return seekable[pos : pos + n]
+
+        reader = ZstdStreamReader(idx, tracking)
+        off = 3 * FRAME_USIZE + 5
+        assert reader.read_range(off, 100) == raw[off : off + 100]
+        # One covering frame, not the blob.
+        assert sum(pulled) < len(seekable) / 4
+
+    def test_file_map_matches_tar(self, layer, seekable):
+        _, contents = layer
+        idx, _ = build_zindex_from_zstd(BLOB_ID, seekable)
+        assert set(idx.files) == set(contents)
+        reader = ZstdStreamReader(idx, _reader_for(seekable))
+        for path, (off, size) in idx.files.items():
+            assert reader.read_range(off, size) == contents[path], path
+
+    def test_read_past_end_fails_loudly(self, layer, seekable):
+        raw, _ = layer
+        idx, _ = build_zindex_from_zstd(BLOB_ID, seekable)
+        reader = ZstdStreamReader(idx, _reader_for(seekable))
+        with pytest.raises(ZstdIndexError):
+            reader.read_range(len(raw) - 5, 10)
+
+    def test_corrupt_seek_table_demotes_to_walk(self, layer, seekable):
+        raw, _ = layer
+        bad = bytearray(seekable)
+        bad[-6] ^= 0xFF  # descriptor/entry bytes: table no longer tiles
+        idx, out = build_zindex_from_zstd(BLOB_ID, bytes(bad))
+        # Never a failure, never wrong bytes: the walk rebuilds truth.
+        assert out == raw
+        assert idx.source == SOURCE_FRAME_WALK
+
+
+# ---------------------------------------------------------------------------
+# Persistence hardening: the .soci.zidx corruption matrix
+# ---------------------------------------------------------------------------
+
+
+class TestZstdIndexPersistence:
+    def _saved(self, tmp_path, seekable):
+        idx, _ = build_zindex_from_zstd(BLOB_ID, seekable)
+        path = zindex_path(str(tmp_path), BLOB_ID)
+        idx.save(path)
+        return idx, path
+
+    def test_roundtrip(self, tmp_path, layer, seekable):
+        idx, path = self._saved(tmp_path, seekable)
+        got = ZstdFrameIndex.load(path, blob_id=BLOB_ID, csize=len(seekable))
+        assert got.files == idx.files
+        assert got.source == idx.source
+        assert got.uncompressed_size == idx.uncompressed_size
+        assert [
+            (f.uout, f.cin, f.usize, f.csize) for f in got.frames
+        ] == [(f.uout, f.cin, f.usize, f.csize) for f in idx.frames]
+
+    @pytest.mark.parametrize("mutation", ["truncate", "flip_payload",
+                                          "flip_header", "empty"])
+    def test_corruption_fails_loudly(self, tmp_path, seekable, mutation):
+        _, path = self._saved(tmp_path, seekable)
+        raw = bytearray(open(path, "rb").read())
+        if mutation == "truncate":
+            raw = raw[: len(raw) // 2]
+        elif mutation == "flip_payload":
+            raw[len(raw) // 2] ^= 0xFF
+        elif mutation == "flip_header":
+            raw[0] ^= 0xFF
+        else:
+            raw = bytearray()
+        open(path, "wb").write(bytes(raw))
+        with pytest.raises(ZstdIndexError):
+            ZstdFrameIndex.load(path, blob_id=BLOB_ID, csize=len(seekable))
+
+    def test_stale_index_rejected(self, tmp_path, seekable):
+        _, path = self._saved(tmp_path, seekable)
+        with pytest.raises(ZstdIndexError):
+            ZstdFrameIndex.load(path, blob_id="cd" * 32)
+        with pytest.raises(ZstdIndexError):
+            # Re-pushed blob with different size: geometry is stale.
+            ZstdFrameIndex.load(path, blob_id=BLOB_ID, csize=len(seekable) + 1)
+
+    def test_corrupt_index_rebuilt_once_never_poisons(self, tmp_path, layer,
+                                                      seekable):
+        raw, _ = layer
+        _, path = self._saved(tmp_path, seekable)
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(path, "wb").write(bytes(blob))
+        builds = []
+
+        def builder():
+            builds.append(1)
+            return seekable
+
+        idx, outcome = load_or_build_zindex(
+            [str(tmp_path)], BLOB_ID, csize=len(seekable), builder=builder,
+        )
+        assert outcome == "rebuilt" and len(builds) == 1
+        # The rebuilt artifact is immediately good: loaded, not rebuilt.
+        idx2, outcome2 = load_or_build_zindex(
+            [str(tmp_path)], BLOB_ID, csize=len(seekable), builder=builder,
+        )
+        assert outcome2 == "loaded" and len(builds) == 1
+        reader = ZstdStreamReader(idx2, _reader_for(seekable))
+        assert reader.read_range(1000, 5000) == raw[1000:6000]
+
+    def test_missing_without_builder_degrades(self, tmp_path):
+        idx, outcome = load_or_build_zindex([str(tmp_path)], BLOB_ID, csize=1)
+        assert idx is None and outcome == "missing"
+
+    def test_cache_manager_accounts_zidx_companion(self, tmp_path):
+        from nydus_snapshotter_tpu.cache.manager import CacheManager
+
+        mgr = CacheManager(str(tmp_path / "cache"))
+        for sfx in ("", ".blob.data", ".soci.zidx"):
+            with open(os.path.join(mgr.cache_dir, "aa" * 32 + sfx), "wb") as f:
+                f.write(b"x" * 10)
+        assert mgr.cache_usage("aa" * 32).inodes == 3
+        mgr.remove_blob_cache("aa" * 32)
+        assert mgr.cache_usage("aa" * 32).inodes == 0
+
+
+# ---------------------------------------------------------------------------
+# Peer replication through the generic artifact plane (kind "zsoci")
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def peer_server(tmp_path):
+    from nydus_snapshotter_tpu.daemon import peer
+
+    export = peer.PeerExport()
+    server = peer.PeerChunkServer(export, pull_through=False)
+    sock = os.path.join(str(tmp_path), "peer.sock")
+    server.run(sock)
+    yield export, server, sock
+    server.stop()
+
+
+class TestPeerReplication:
+    def test_zindex_replicates_from_owner(self, tmp_path, seekable,
+                                          peer_server):
+        from nydus_snapshotter_tpu.daemon.peer import PeerClient
+        from nydus_snapshotter_tpu.soci.zblob import ZSOCI_ARTIFACT_KIND
+
+        export, _server, sock = peer_server
+        owner_dir = os.path.join(str(tmp_path), "owner")
+        idx, _ = build_zindex_from_zstd(BLOB_ID, seekable)
+        path = zindex_path(owner_dir, BLOB_ID)
+        idx.save(path)
+        export.register_artifact(ZSOCI_ARTIFACT_KIND, BLOB_ID, path)
+
+        local_dir = os.path.join(str(tmp_path), "local")
+        os.makedirs(local_dir)
+        got, outcome = load_or_build_zindex(
+            [local_dir], BLOB_ID, csize=len(seekable),
+            fetch_remote=lambda: PeerClient(sock).fetch_artifact(
+                ZSOCI_ARTIFACT_KIND, BLOB_ID
+            ),
+        )
+        assert outcome == "replicated"
+        assert len(got.frames) == len(idx.frames)
+        # Adopted replica persisted: the next pod-local open just loads.
+        _, outcome2 = load_or_build_zindex(
+            [local_dir], BLOB_ID, csize=len(seekable)
+        )
+        assert outcome2 == "loaded"
+
+    def test_corrupt_replica_falls_back_to_build(self, tmp_path, layer,
+                                                 seekable, peer_server):
+        from nydus_snapshotter_tpu.daemon.peer import PeerClient
+        from nydus_snapshotter_tpu.soci.zblob import ZSOCI_ARTIFACT_KIND
+
+        raw, _ = layer
+        export, _server, sock = peer_server
+        owner_dir = os.path.join(str(tmp_path), "owner")
+        idx, _ = build_zindex_from_zstd(BLOB_ID, seekable)
+        path = zindex_path(owner_dir, BLOB_ID)
+        idx.save(path)
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF  # owner's artifact is corrupt
+        open(path, "wb").write(bytes(blob))
+        export.register_artifact(ZSOCI_ARTIFACT_KIND, BLOB_ID, path)
+
+        local_dir = os.path.join(str(tmp_path), "local")
+        os.makedirs(local_dir)
+        builds = []
+
+        def builder():
+            builds.append(1)
+            return seekable
+
+        got, outcome = load_or_build_zindex(
+            [local_dir], BLOB_ID, csize=len(seekable),
+            fetch_remote=lambda: PeerClient(sock).fetch_artifact(
+                ZSOCI_ARTIFACT_KIND, BLOB_ID
+            ),
+            builder=builder,
+        )
+        # The checksum rejects the poisoned replica; the local build
+        # wins and reads stay correct.
+        assert outcome == "built" and len(builds) == 1
+        reader = ZstdStreamReader(got, _reader_for(seekable))
+        assert reader.read_range(500, 4000) == raw[500:4500]
+
+
+# ---------------------------------------------------------------------------
+# TOC adoption: zero build-pass bytes, byte identity
+# ---------------------------------------------------------------------------
+
+
+class TestTocAdoption:
+    def _prepare(self, tmp_path, blob, monkeypatch=None):
+        import hashlib
+
+        from nydus_snapshotter_tpu.soci.adaptor import SociAdaptor
+        from nydus_snapshotter_tpu.stargz.resolver import Blob as StargzBlob
+
+        digest = "sha256:" + hashlib.sha256(blob).hexdigest()
+        fetched = []
+
+        def read_at(off, ln):
+            fetched.append(ln)
+            return blob[off : off + ln]
+
+        b = StargzBlob("ref", digest, read_at, len(blob))
+        adaptor = SociAdaptor(
+            lambda s: os.path.join(str(tmp_path), "up", s),
+            cache_dir=os.path.join(str(tmp_path), "cache"),
+            chunk_size=FRAME_USIZE,
+        )
+        store = os.path.join(str(tmp_path), "store")
+        adaptor.prepare_meta_layer(b, store)
+        boot = open(os.path.join(store, digest.split(":")[1]), "rb").read()
+        return boot, digest.split(":")[1], sum(fetched)
+
+    def _unpacked_files(self, boot, blob_id, blob):
+        from nydus_snapshotter_tpu.converter.convert import Unpack
+
+        out_tar = Unpack(boot, {blob_id: blob})
+        got = {}
+        with tarfile.open(fileobj=io.BytesIO(out_tar)) as tf:
+            for m in tf:
+                if m.isreg():
+                    got["/" + m.name] = tf.extractfile(m).read()
+        return got
+
+    def test_zstd_chunked_adoption_zero_build_pass(self, tmp_path, layer):
+        _, contents = layer
+        blob = ztoc.write_zstd_chunked(
+            {k.lstrip("/"): v for k, v in contents.items()},
+            chunk_size=FRAME_USIZE,
+        )
+        boot, blob_id, fetched = self._prepare(tmp_path, blob)
+        # Probe + footer + manifest only — never the data region.
+        assert fetched < len(blob) // 2
+        got = self._unpacked_files(boot, blob_id, blob)
+        assert got == contents
+        # No index artifact either: the shipped TOC is the index.
+        cache = os.path.join(str(tmp_path), "cache")
+        assert not os.path.exists(zindex_path(cache, blob_id))
+
+    @pytest.mark.skipif(not zran.available(), reason="zran needed")
+    def test_estargz_adoption_zero_build_pass(self, tmp_path, layer):
+        from tests.test_stargz import build_estargz
+
+        _, contents = layer
+        blob = build_estargz({k.lstrip("/"): v for k, v in contents.items()})
+        boot, blob_id, fetched = self._prepare(tmp_path, blob)
+        assert fetched < len(blob) // 2
+        got = self._unpacked_files(boot, blob_id, blob)
+        assert got == contents
+
+    def test_seekable_prepare_persists_zidx(self, tmp_path, layer, seekable):
+        _, contents = layer
+        boot, blob_id, fetched = self._prepare(tmp_path, seekable)
+        # Index build needs the one full pull.
+        assert fetched >= len(seekable)
+        assert os.path.exists(
+            zindex_path(os.path.join(str(tmp_path), "cache"), blob_id)
+        )
+        assert self._unpacked_files(boot, blob_id, seekable) == contents
+
+    def test_single_frame_zstd_demotes_to_rafs(self, tmp_path, layer):
+        from nydus_snapshotter_tpu.soci.adaptor import SociError
+        from nydus_snapshotter_tpu.utils import zstd as _zstd
+
+        raw, _ = layer
+        blob = _zstd.compress_block(raw)  # one frame, no random access
+        before = soci_router.ROUTE_TOTAL.value(BACKEND_RAFS)
+        with pytest.raises(SociError):
+            self._prepare(tmp_path, blob)
+        assert soci_router.ROUTE_TOTAL.value(BACKEND_RAFS) == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Chaos: soci.{index,resolve,fetch} on the zstd path
+# ---------------------------------------------------------------------------
+
+
+class TestChaos:
+    def test_index_site_fails_store_loudly(self, tmp_path, seekable):
+        with failpoint.injected("soci.index", "error(OSError)"):
+            with pytest.raises(OSError):
+                load_or_build_zindex([str(tmp_path)], BLOB_ID,
+                                     csize=len(seekable),
+                                     builder=lambda: seekable)
+        # Disarmed: the same call succeeds (build + persist).
+        idx, outcome = load_or_build_zindex(
+            [str(tmp_path)], BLOB_ID, csize=len(seekable),
+            builder=lambda: seekable,
+        )
+        assert idx is not None and outcome == "built"
+
+    def test_index_site_fails_build_at_prepare(self, seekable):
+        with failpoint.injected("soci.index", "error(OSError)"):
+            with pytest.raises(OSError):
+                build_zindex_from_zstd(BLOB_ID, seekable)
+
+    def test_resolve_site_fails_read_never_wrong_bytes(self, layer, seekable):
+        raw, _ = layer
+        idx, _ = build_zindex_from_zstd(BLOB_ID, seekable)
+        reader = ZstdStreamReader(idx, _reader_for(seekable))
+        with failpoint.injected("soci.resolve", "error(OSError)*1"):
+            with pytest.raises(OSError):
+                reader.read_range(100, 100)
+        assert reader.read_range(100, 100) == raw[100:200]
+
+    def test_fetch_site_fails_read_then_recovers(self, tmp_path, layer,
+                                                 seekable):
+        from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+        from nydus_snapshotter_tpu.daemon.fetch_sched import FetchConfig
+
+        raw, _ = layer
+        idx, _ = build_zindex_from_zstd(BLOB_ID, seekable)
+        cb = CachedBlob(
+            os.path.join(str(tmp_path), "chaos"),
+            BLOB_ID,
+            _reader_for(seekable),
+            blob_size=len(seekable),
+            config=FetchConfig(fetch_workers=2),
+        )
+        reader = ZstdStreamReader(idx, cb.read_at)
+        with failpoint.injected("soci.fetch", "error(OSError)*1"):
+            with pytest.raises(OSError):
+                reader.read_range(0, 1000)
+        assert reader.read_range(0, 1000) == raw[:1000]
+        cb.close()
+
+
+# ---------------------------------------------------------------------------
+# BlobReader integration: indexed and sequential zstd-stream chunks
+# ---------------------------------------------------------------------------
+
+
+class TestBlobReaderIntegration:
+    def test_blobreader_mounts_zstd_stream(self, layer, seekable):
+        from nydus_snapshotter_tpu.converter.convert import BlobReader
+        from nydus_snapshotter_tpu.converter.types import PackOption
+        from nydus_snapshotter_tpu.converter.zstd_ref import pack_zstd_layer
+
+        raw, _ = layer
+        bs = pack_zstd_layer(seekable,
+                             PackOption(chunk_size=0x8000, oci_ref=True),
+                             tar_bytes=raw)
+        idx, _ = build_zindex_from_zstd(BLOB_ID, seekable)
+        read_at = _reader_for(seekable)
+        plain = BlobReader(bs, 0, read_at)  # lazy sequential fallback
+        indexed = BlobReader(bs, 0, read_at)
+        indexed.mount_zstd_stream(ZstdStreamReader(idx, read_at))
+        for rec in bs.chunks[:: max(1, len(bs.chunks) // 25)]:
+            assert indexed.chunk_data(rec) == plain.chunk_data(rec)
+
+    def test_mixed_format_merge(self, tmp_path, layer, seekable):
+        """zran, zstd-frame and TOC bootstraps merge identically —
+        one image can mix gzip and zstd layers."""
+        from nydus_snapshotter_tpu.converter.convert import Merge, Unpack
+        from nydus_snapshotter_tpu.converter.types import MergeOption, PackOption
+        from nydus_snapshotter_tpu.converter.zran import pack_gzip_layer
+        from nydus_snapshotter_tpu.converter.zstd_ref import pack_zstd_layer
+
+        raw, contents = layer
+        raw2, contents2 = build_layer(n_files=20, seed=9)
+        gz = gzip.compress(raw2, 6)
+        import hashlib
+
+        opt = PackOption(chunk_size=0x8000, oci_ref=True)
+        bs_z = pack_zstd_layer(seekable, opt, tar_bytes=raw)
+        bs_g = pack_gzip_layer(gz, opt, tar_bytes=raw2)
+        merged = Merge([bs_z, bs_g], MergeOption(oci_ref=True)).bootstrap
+        blob_map = {
+            hashlib.sha256(seekable).hexdigest(): seekable,
+            hashlib.sha256(gz).hexdigest(): gz,
+        }
+        out = Unpack(merged, blob_map)
+        got = {}
+        with tarfile.open(fileobj=io.BytesIO(out)) as tf:
+            for m in tf:
+                if m.isreg():
+                    got["/" + m.name] = tf.extractfile(m).read()
+        want = dict(contents)
+        want.update(contents2)
+        assert got == want

@@ -287,7 +287,17 @@ def cmd_soci(args) -> int:
             if s.get("read_bytes")
             else None
         )
-        human = "\n".join(f"{k}: {v}" for k, v in sorted(s.items()))
+        routes = s.get("routes") or {}
+        human = "\n".join(
+            f"{k}: {v}" for k, v in sorted(s.items()) if k != "routes"
+        )
+        if routes:
+            # FormatRouter decisions: which lazy backend each resolved
+            # layer took (toc-adopt / seekable-index / zran-index /
+            # rafs-convert).
+            human += "\nroutes: " + ", ".join(
+                f"{b}={int(n)}" for b, n in sorted(routes.items())
+            )
         human += "\nfetch_amplification: " + (
             f"{amp:.3f}x" if amp is not None else "-"
         )

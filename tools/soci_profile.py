@@ -31,8 +31,20 @@ bound wherever the physics gives one):
    walking every artifact written: nothing but ``.blob.data`` /
    ``.chunk_map`` / ``.soci.idx`` companions may exist (no RAFS blob).
 
+A fifth mode, ``--formats``, runs the universal-lazy-formats matrix
+(SOCI_FORMATS_r01 bank): the same corpus packaged as plain gzip
+(regression arm), seekable zstd, opaque multi-frame zstd, and
+zstd:chunked-with-TOC, each arm holding (a) FormatRouter routes it to
+the expected backend — toc-adopt WHENEVER a TOC exists, with zero
+build-pass bytes on those layers; (b) byte identity vs direct
+extraction through the routed prepare; (c) cold first-file-read beating
+the full pull by ≥``FORMAT_COLD_SPEEDUP``x on zstd arms (paired
+best-rep wall AND analytic bytes-fetched bound); (d) a ``--pods``-wide
+storm through the peer tier at ≤``FORMATS_EGRESS_FACTOR``x unique
+compressed bytes of origin egress with the no-RAFS-blob artifact walk.
+
 Usage: python tools/soci_profile.py [--pods 16] [--mib 8] [--reps 2]
-           [--json]
+           [--json] [--formats]
 """
 
 from __future__ import annotations
@@ -446,6 +458,445 @@ def _phase_storm(workroot, gz, raw, index, pods, gates):
     }
 
 
+# ---------------------------------------------------------------------------
+# Universal lazy formats matrix (--formats → SOCI_FORMATS bank)
+# ---------------------------------------------------------------------------
+
+FORMAT_FRAME_USIZE = 128 << 10
+FORMAT_COLD_SPEEDUP = 5.0  # zstd arms: first cold file read vs full pull
+FORMATS_EGRESS_FACTOR = 1.05
+_FORMAT_ALLOWED = (".blob.data", ".chunk_map", ".soci.idx", ".soci.zidx")
+
+
+def _format_blobs(raw: bytes, contents: dict) -> dict:
+    """The same corpus in every wire format the router must handle."""
+    from nydus_snapshotter_tpu.soci import toc as ztoc
+    from nydus_snapshotter_tpu.soci import zframe
+
+    files = {k.lstrip("/"): v for k, v in contents.items()}
+    return {
+        "gzip": gzip.compress(raw, 6),
+        "zstd-seekable": zframe.write_seekable(raw,
+                                               frame_usize=FORMAT_FRAME_USIZE),
+        "zstd-opaque": zframe.write_frames(raw,
+                                           frame_usize=FORMAT_FRAME_USIZE),
+        "zstd-chunked": ztoc.write_zstd_chunked(files,
+                                                chunk_size=FORMAT_FRAME_USIZE),
+    }
+
+
+_EXPECTED_ROUTE = {
+    "gzip": "zran-index",
+    "zstd-seekable": "seekable-index",
+    "zstd-opaque": "seekable-index",
+    "zstd-chunked": "toc-adopt",
+}
+
+
+class _BootFileReader:
+    """Per-file reads straight off a TOC-adopted bootstrap — the runtime
+    path of a toc-adopt layer: each chunk record resolves to a compressed
+    extent of the ORIGINAL blob, fetched ranged and decoded per chunk."""
+
+    def __init__(self, boot_bytes: bytes, read_at):
+        import stat as statmod
+
+        from nydus_snapshotter_tpu.converter.convert import BlobReader
+        from nydus_snapshotter_tpu.models.nydus_real import load_any_bootstrap
+
+        self._bs = load_any_bootstrap(boot_bytes)
+        self._br = BlobReader(self._bs, 0, read_at)
+        self._by_path = {
+            i.path: i for i in self._bs.inodes if statmod.S_ISREG(i.mode)
+        }
+
+    def read_file(self, path: str) -> bytes:
+        ino = self._by_path[path]
+        recs = self._bs.chunks[
+            ino.chunk_index : ino.chunk_index + ino.chunk_count
+        ]
+        return b"".join(self._br.chunk_data(r) for r in recs)
+
+    def paths(self):
+        return sorted(self._by_path)
+
+
+class _ExtentFileReader:
+    """Per-file reads through an index's file→extent map + stream reader
+    (the runtime path of zran-index and seekable-index layers)."""
+
+    def __init__(self, index, stream_reader):
+        self._files = index.files
+        self._stream = stream_reader
+
+    def read_file(self, path: str) -> bytes:
+        off, size = self._files[path]
+        return self._stream.read_range(off, size)
+
+    def paths(self):
+        return sorted(self._files)
+
+
+def _routed_prepare(arm: str, blob: bytes, workdir: str, gates: list):
+    """Route + prepare through the real SociAdaptor, counting every
+    origin byte the prepare pass fetched. Returns (bootstrap bytes,
+    blob_id, fetched_bytes, route backend)."""
+    from nydus_snapshotter_tpu.soci.adaptor import SociAdaptor
+    from nydus_snapshotter_tpu.soci.router import FormatRouter
+    from nydus_snapshotter_tpu.stargz.resolver import Blob as StargzBlob
+
+    blob_id = hashlib.sha256(blob).hexdigest()
+    fetched = [0]
+
+    def read_at(off, ln):
+        fetched[0] += ln
+        return blob[off : off + ln]
+
+    decision = FormatRouter().route(read_at, len(blob), record=False)
+    if decision.backend != _EXPECTED_ROUTE[arm]:
+        gates.append(
+            f"{arm}: routed {decision.backend}, expected "
+            f"{_EXPECTED_ROUTE[arm]} ({decision.reason})"
+        )
+    b = StargzBlob("ref", f"sha256:{blob_id}", read_at, len(blob))
+    b.route = decision
+    adaptor = SociAdaptor(
+        lambda s: os.path.join(workdir, "up", s),
+        cache_dir=os.path.join(workdir, "cache"),
+        chunk_size=FORMAT_FRAME_USIZE,
+        stride=256 << 10,
+    )
+    store = os.path.join(workdir, f"store-{arm}")
+    adaptor.prepare_meta_layer(b, store)
+    with open(os.path.join(store, blob_id), "rb") as f:
+        boot = f.read()
+    if decision.backend == "toc-adopt" and fetched[0] > len(blob) // 4:
+        gates.append(
+            f"{arm}: toc-adopt prepare fetched {fetched[0]} of {len(blob)} "
+            "blob bytes — the shipped TOC should make the build pass free"
+        )
+    return boot, blob_id, fetched[0], decision.backend
+
+
+def _format_reader(arm: str, boot: bytes, blob_id: str, workdir: str,
+                   read_at):
+    """The runtime per-file reader for an arm, loading the persisted
+    index artifact the prepare pass wrote (or the bootstrap itself for
+    toc-adopt)."""
+    from nydus_snapshotter_tpu.soci.blob import SociStreamReader
+    from nydus_snapshotter_tpu.soci.index import SociIndex, index_path
+    from nydus_snapshotter_tpu.soci.zblob import ZstdStreamReader
+    from nydus_snapshotter_tpu.soci.zindex import ZstdFrameIndex, zindex_path
+
+    cache = os.path.join(workdir, "cache")
+    if arm == "gzip":
+        idx = SociIndex.load(index_path(cache, blob_id), blob_id=blob_id)
+        return _ExtentFileReader(idx, SociStreamReader(idx, read_at))
+    if arm in ("zstd-seekable", "zstd-opaque"):
+        idx = ZstdFrameIndex.load(zindex_path(cache, blob_id),
+                                  blob_id=blob_id)
+        return _ExtentFileReader(idx, ZstdStreamReader(idx, read_at))
+    return _BootFileReader(boot, read_at)
+
+
+def _formats_cold(arm, blob, boot, blob_id, workdir, contents, reps, gates):
+    registry = SimRegistry(blob, LATENCY_S, BANDWIDTH_MIBPS)
+    paths = sorted(contents)
+    target = paths[len(paths) // 2]
+    lazy_walls, full_walls = [], []
+    lazy_fetched = 0
+    for r in range(max(1, reps)):
+        registry.reset()
+        cb_dir = os.path.join(workdir, f"cold-{arm}-{r}")
+        from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+        from nydus_snapshotter_tpu.daemon.fetch_sched import FetchConfig
+
+        cb = CachedBlob(
+            cb_dir, blob_id, registry.fetch, blob_size=len(blob),
+            config=FetchConfig(fetch_workers=4, merge_gap=64 << 10,
+                               readahead=0),
+        )
+        try:
+            reader = _format_reader(arm, boot, blob_id, workdir, cb.read_at)
+            t0 = time.perf_counter()
+            got = reader.read_file(target)
+            lazy_walls.append(time.perf_counter() - t0)
+            lazy_fetched = registry.egress
+            if got != contents[target]:
+                gates.append(f"{arm} cold: lazily-read bytes differ")
+        finally:
+            cb.close()
+        # Paired full-pull arm: fetch the whole blob, then read the same
+        # file from the local copy through the same reader machinery.
+        registry.reset()
+        t0 = time.perf_counter()
+        whole = bytearray()
+        pos = 0
+        while pos < len(blob):
+            step = min(1 << 20, len(blob) - pos)
+            whole += registry.fetch(pos, step)
+            pos += step
+        local = bytes(whole)
+        reader = _format_reader(arm, boot, blob_id, workdir,
+                                lambda o, s: local[o : o + s])
+        if reader.read_file(target) != contents[target]:
+            gates.append(f"{arm} cold: full-pull bytes differ")
+        full_walls.append(time.perf_counter() - t0)
+    measured = min(full_walls) / max(1e-9, min(lazy_walls))
+    analytic = len(blob) / max(1, lazy_fetched)
+    floor = FORMAT_COLD_SPEEDUP if arm.startswith("zstd") else 1.0
+    if measured < floor:
+        gates.append(
+            f"{arm} cold: first file read beat full pull only "
+            f"{measured:.2f}x (gate {floor}x, paired best-rep)"
+        )
+    if analytic < floor:
+        gates.append(
+            f"{arm} cold: fetched {lazy_fetched} of {len(blob)} bytes — "
+            f"{analytic:.2f}x bytes advantage (gate {floor}x)"
+        )
+    return {
+        "file": target,
+        "lazy_first_read_ms": round(min(lazy_walls) * 1000, 1),
+        "full_pull_ms": round(min(full_walls) * 1000, 1),
+        "lazy_fetched_bytes": lazy_fetched,
+        "measured_speedup": round(measured, 2),
+        "analytic_bytes_ratio": round(analytic, 2),
+        "speedup_gate": floor,
+    }
+
+
+def _formats_storm(arm, blob, boot, blob_id, workdir, contents, pods, gates):
+    from nydus_snapshotter_tpu.daemon import peer
+    from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+    from nydus_snapshotter_tpu.daemon.fetch_sched import (
+        AdmissionGate,
+        FetchConfig,
+        MemoryBudget,
+    )
+    from nydus_snapshotter_tpu.remote.mirror import HostHealthRegistry
+    from nydus_snapshotter_tpu.soci.blob import (
+        SociStreamReader,
+        load_or_build_index,
+    )
+    from nydus_snapshotter_tpu.soci.index import index_path
+    from nydus_snapshotter_tpu.soci.zblob import (
+        ZSOCI_ARTIFACT_KIND,
+        ZstdStreamReader,
+        load_or_build_zindex,
+    )
+    from nydus_snapshotter_tpu.soci.zindex import zindex_path
+
+    registry = SimRegistry(blob, LATENCY_S, BANDWIDTH_MIBPS)
+    health = HostHealthRegistry()
+    sockdir = tempfile.mkdtemp(prefix=f"soci-fmt-{arm}-", dir="/tmp")
+    addrs = [os.path.join(sockdir, f"p{i}.sock") for i in range(pods)]
+    oracle = hashlib.sha256(
+        b"".join(contents[p] for p in sorted(contents))
+    ).hexdigest()
+
+    storm_root = os.path.join(workdir, f"storm-{arm}")
+    os.makedirs(storm_root, exist_ok=True)
+    # Pod 0 owns the first-pull index artifact (when the arm has one).
+    cache = os.path.join(workdir, "cache")
+    pod0_dir = os.path.join(storm_root, "pod0")
+    os.makedirs(pod0_dir)
+    if arm == "gzip":
+        shutil.copy(index_path(cache, blob_id), index_path(pod0_dir, blob_id))
+    elif arm.startswith("zstd-") and arm != "zstd-chunked":
+        shutil.copy(zindex_path(cache, blob_id),
+                    zindex_path(pod0_dir, blob_id))
+
+    budgets, nodes, exports = [], [], []
+    for i in range(pods):
+        budget = MemoryBudget(POD_BUDGET_MIB << 20)
+        budgets.append(budget)
+        gate = AdmissionGate(budget=budget, max_concurrent=8,
+                             demand_reserve=1, name=f"fmt-{arm}-pod{i}")
+        router = peer.PeerRouter(addrs, self_address=addrs[i],
+                                 region_bytes=CHUNK, health_registry=health)
+        fetch = peer.PeerAwareFetcher(blob_id, registry.fetch, router,
+                                      timeout_s=10.0).read_range
+        cb = CachedBlob(
+            os.path.join(storm_root, f"pod{i}"),
+            blob_id,
+            fetch,
+            blob_size=len(blob),
+            config=FetchConfig(fetch_workers=2, merge_gap=0, readahead=0),
+            gate=gate,
+            tenant=f"pod{i}",
+        )
+        export = peer.PeerExport()
+        export.register(blob_id, cb)
+        if i == 0:
+            if arm == "gzip":
+                export.register_soci(blob_id, index_path(pod0_dir, blob_id))
+            elif arm != "zstd-chunked":
+                export.register_artifact(ZSOCI_ARTIFACT_KIND, blob_id,
+                                         zindex_path(pod0_dir, blob_id))
+        server = peer.PeerChunkServer(export, gate=gate, pull_through=True)
+        server.run(addrs[i])
+        nodes.append((cb, server))
+        exports.append(export)
+
+    probe = _BudgetProbe(budgets)
+    probe.start()
+    digests = [None] * pods
+    replicated = [0] * pods
+    errors: list[str] = []
+
+    def run_pod(i):
+        cb, _server = nodes[i]
+        try:
+            pod_dir = os.path.join(storm_root, f"pod{i}")
+            if arm == "zstd-chunked":
+                reader = _BootFileReader(boot, cb.read_at)
+            elif arm == "gzip":
+                idx, outcome = load_or_build_index(
+                    [pod_dir], blob_id, csize=len(blob),
+                    fetch_remote=None if i == 0 else (
+                        lambda: peer.PeerClient(addrs[0], timeout_s=10.0)
+                        .fetch_soci_index(blob_id)),
+                )
+                if outcome == "replicated":
+                    replicated[i] = 1
+                reader = _ExtentFileReader(idx, SociStreamReader(idx,
+                                                                 cb.read_at))
+            else:
+                idx, outcome = load_or_build_zindex(
+                    [pod_dir], blob_id, csize=len(blob),
+                    fetch_remote=None if i == 0 else (
+                        lambda: peer.PeerClient(addrs[0], timeout_s=10.0)
+                        .fetch_artifact(ZSOCI_ARTIFACT_KIND, blob_id)),
+                )
+                if outcome == "replicated":
+                    replicated[i] = 1
+                reader = _ExtentFileReader(idx, ZstdStreamReader(idx,
+                                                                 cb.read_at))
+            h = hashlib.sha256()
+            for p in sorted(contents):
+                h.update(reader.read_file(p))
+            digests[i] = h.hexdigest()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(f"pod{i}: {e!r}")
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=run_pod, args=(i,))
+               for i in range(pods)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    probe.stop()
+    for cb, server in nodes:
+        server.stop()
+        cb.close()
+    shutil.rmtree(sockdir, ignore_errors=True)
+
+    if errors:
+        gates.append(f"{arm} storm pod failures: {errors[:4]}")
+    if any(d != oracle for d in digests):
+        gates.append(f"{arm} storm: pod bytes differ from direct extraction")
+    egress_ratio = registry.egress / len(blob)
+    if egress_ratio > FORMATS_EGRESS_FACTOR:
+        gates.append(
+            f"{arm} storm origin egress {egress_ratio:.3f}x unique "
+            f"compressed bytes (gate {FORMATS_EGRESS_FACTOR}x at "
+            f"{pods} pods)"
+        )
+    want_replicas = pods - 1 if arm != "zstd-chunked" else 0
+    if sum(replicated) != want_replicas:
+        gates.append(
+            f"{arm} storm: {sum(replicated)}/{want_replicas} pods adopted "
+            "the first-pull index over the peer tier"
+        )
+    # The no-RAFS-blob walk: anything outside the original-blob cache
+    # companions + replicated index artifacts is a conversion output.
+    alien = [
+        os.path.join(dirpath, fn)
+        for dirpath, _dirnames, filenames in os.walk(storm_root)
+        for fn in filenames
+        if not fn.endswith(_FORMAT_ALLOWED)
+    ]
+    if alien:
+        gates.append(f"{arm} storm wrote conversion artifacts: {alien[:5]}")
+    return {
+        "pods": pods,
+        "wall_s": round(wall, 3),
+        "origin_egress_bytes": registry.egress,
+        "egress_ratio": round(egress_ratio, 3),
+        "egress_gate": FORMATS_EGRESS_FACTOR,
+        "indexes_replicated": sum(replicated),
+        "peak_inflight_bytes": probe.peak,
+        "no_rafs_blob_written": not alien,
+    }
+
+
+def formats_profile(pods: int = 16, mib: int = 4, reps: int = 2,
+                    seed: int = 7) -> dict:
+    from nydus_snapshotter_tpu.converter.convert import Unpack
+    from nydus_snapshotter_tpu.soci import zframe, zran
+
+    if not zran.available():
+        return {"error": "system libz with inflatePrime unavailable",
+                "gates_failed": ["zran unavailable on this host"]}
+    if not zframe.available():
+        return {"error": "system libzstd frame API unavailable",
+                "gates_failed": ["zstd frame surface unavailable"]}
+
+    gates: list[str] = []
+    raw, _gz, contents = build_layer(mib, seed)
+    blobs = _format_blobs(raw, contents)
+    workroot = tempfile.mkdtemp(prefix="soci-fmt-")
+    arms = {}
+    try:
+        for arm, blob in blobs.items():
+            boot, blob_id, prep_fetched, backend = _routed_prepare(
+                arm, blob, workroot, gates
+            )
+            # Byte identity straight through the routed bootstrap.
+            out_tar = Unpack(boot, {blob_id: blob})
+            got = {}
+            with tarfile.open(fileobj=io.BytesIO(out_tar)) as tf:
+                for m in tf:
+                    if m.isreg():
+                        got["/" + m.name] = tf.extractfile(m).read()
+            if got != contents:
+                gates.append(
+                    f"{arm}: unpacked tree differs from source "
+                    f"({len(got)} vs {len(contents)} files)"
+                )
+            cold = _formats_cold(arm, blob, boot, blob_id, workroot,
+                                 contents, reps, gates)
+            storm = _formats_storm(arm, blob, boot, blob_id, workroot,
+                                   contents, pods, gates)
+            arms[arm] = {
+                "blob_bytes": len(blob),
+                "backend": backend,
+                "prepare_fetched_bytes": prep_fetched,
+                "byte_identity": got == contents,
+                "cold": cold,
+                "storm": storm,
+            }
+        leaked = [
+            t.name for t in threading.enumerate()
+            if t.name.startswith(("ntpu-fetch", "ntpu-peer"))
+        ]
+        if leaked:
+            gates.append(f"leaked threads: {leaked}")
+        return {
+            "layer_mib": round(len(raw) / (1 << 20), 2),
+            "files": len(contents),
+            "frame_usize_kib": FORMAT_FRAME_USIZE >> 10,
+            "pods": pods,
+            "arms": arms,
+            "gates_failed": gates,
+        }
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
 def profile(pods: int = 16, mib: int = 8, reps: int = 2, seed: int = 7) -> dict:
     from nydus_snapshotter_tpu.soci import zran
     from nydus_snapshotter_tpu.soci.blob import build_index_from_gzip
@@ -497,7 +948,33 @@ def main() -> int:
     ap.add_argument("--mib", type=int, default=8, help="decompressed layer size")
     ap.add_argument("--reps", type=int, default=2, help="paired reps per arm")
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--formats", action="store_true",
+                    help="run the universal-lazy-formats matrix instead")
     args = ap.parse_args()
+
+    if args.formats:
+        report = formats_profile(pods=args.pods, mib=min(args.mib, 4),
+                                 reps=args.reps)
+        if args.json:
+            print(json.dumps(report))
+        elif "error" not in report:
+            for arm, a in report["arms"].items():
+                c, s = a["cold"], a["storm"]
+                print(
+                    f"{arm}: backend={a['backend']} identity="
+                    f"{a['byte_identity']} prepare_fetched="
+                    f"{a['prepare_fetched_bytes']}B cold "
+                    f"{c['lazy_first_read_ms']}ms vs {c['full_pull_ms']}ms "
+                    f"({c['measured_speedup']}x wall, "
+                    f"{c['analytic_bytes_ratio']}x bytes, gate "
+                    f"{c['speedup_gate']}x); storm({s['pods']}) egress "
+                    f"{s['egress_ratio']}x, replicated "
+                    f"{s['indexes_replicated']}, no_rafs="
+                    f"{s['no_rafs_blob_written']}"
+                )
+        for g in report["gates_failed"]:
+            print(f"FAIL: {g}", file=sys.stderr)
+        return 1 if report["gates_failed"] else 0
 
     report = profile(pods=args.pods, mib=args.mib, reps=args.reps)
     if args.json:
