@@ -24,10 +24,12 @@ from nydus_snapshotter_tpu.snapshot.metastore import Usage
 
 # Companion-file suffixes of one blob cache entry (manager.go:99-120,
 # plus the seekable-OCI checkpoint indexes — soci/index.py's gzip zran
-# index and soci/zindex.py's zstd frame index — which must be accounted,
-# GC'd and watermark-evicted with the blob they describe).
+# index and soci/zindex.py's zstd frame index — and the provenance
+# plane's .heat prefetch artifact (provenance/heat.py) — all of which
+# must be accounted, GC'd and watermark-evicted with the blob they
+# describe).
 _SUFFIXES = ("", ".blob.data", ".chunk_map", ".blob.meta", ".image.disk",
-             ".layer.disk", ".soci.idx", ".soci.zidx")
+             ".layer.disk", ".soci.idx", ".soci.zidx", ".heat")
 
 
 class CacheManager:

@@ -405,6 +405,37 @@ class ChunkDictConfig:
 
 
 @dataclass
+class ProvenanceConfig:
+    """Byte-provenance plane knobs (provenance/).
+
+    With ``enable`` on, every fetched extent entering the lazy-read data
+    plane is attributed to its cause (demand, readahead, prefetch,
+    peer_serve, hedge_winner, hedge_loser, soci_index_build) in a
+    lock-striped per-blob ledger with byte-exact conservation; overlap
+    with the actually-read extent set yields per-cause wasted-bytes and
+    prefetch-accuracy accounting (``ntpu_prov_*`` metrics, the
+    ``/api/v1/provenance`` endpoint and the ``ntpuctl prov`` /
+    ``ntpuctl waterfall`` views). With ``heat`` on, unmount distills the
+    read-extent heat into a persisted, checksummed ``.heat`` prefetch
+    artifact next to the blob cache, so the NEXT deploy prefetches in
+    observed-heat order under a ``heat_budget_mib`` byte budget instead
+    of bootstrap order; ``replicate`` shares the artifact over the peer
+    artifact plane so one pod's first deploy warms the fleet's second.
+    ``events`` bounds the per-blob waterfall event ring (drop-oldest).
+    Environment variables override per-process (``NTPU_PROV``,
+    ``NTPU_PROV_HEAT``, ``NTPU_PROV_HEAT_BUDGET_MIB``,
+    ``NTPU_PROV_EVENTS``, ``NTPU_PROV_REPLICATE``) — that is also how
+    the section reaches spawned daemon processes.
+    """
+
+    enable: bool = True
+    heat: bool = True
+    heat_budget_mib: int = 64
+    events: int = 4096
+    replicate: bool = True
+
+
+@dataclass
 class FleetConfig:
     """Fleet observability plane knobs (fleet/, metrics/federation.py,
     trace/aggregate.py).
@@ -571,6 +602,7 @@ class SnapshotterConfig:
     soci: SociConfig = field(default_factory=SociConfig)
     snapshots: SnapshotsConfig = field(default_factory=SnapshotsConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
+    provenance: ProvenanceConfig = field(default_factory=ProvenanceConfig)
     chunk_dict: ChunkDictConfig = field(default_factory=ChunkDictConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
     slo: SloConfig = field(default_factory=SloConfig)
@@ -749,6 +781,12 @@ class SnapshotterConfig:
             raise ConfigError("trace.slow_op_threshold_ms must be >= 0 (0 = off)")
         if not 0.0 <= self.trace.sample_ratio <= 1.0:
             raise ConfigError("trace.sample_ratio must be within [0, 1]")
+        if self.provenance.heat_budget_mib < 0:
+            raise ConfigError(
+                "provenance.heat_budget_mib must be >= 0 (0 = no heat warm)"
+            )
+        if self.provenance.events < 1:
+            raise ConfigError("provenance.events must be >= 1")
         if self.fleet.scrape_interval_secs <= 0:
             raise ConfigError("fleet.scrape_interval_secs must be positive")
         if self.fleet.stale_after_secs <= 0:

@@ -42,6 +42,7 @@ from nydus_snapshotter_tpu.daemon.fetch_sched import (
     FetchScheduler,
     IntervalSet,
 )
+from nydus_snapshotter_tpu.provenance import ledger as provenance
 from nydus_snapshotter_tpu.remote import mirror as mirror_mod
 from nydus_snapshotter_tpu.remote.mirror import HostHealth
 
@@ -241,6 +242,7 @@ class CachedBlob:
         self._last_end = -1  # sequential-access detector
         self._load_map()
         self.remote_bytes = 0  # fetched over the network (metrics)
+        self.tenant = tenant
         self.sched = FetchScheduler(
             self._lock,
             self._intervals,
@@ -251,7 +253,9 @@ class CachedBlob:
             name=blob_id[:8],
             gate=gate,
             tenant=tenant,
+            on_fetched=self._prov_fetched,
         )
+        provenance.set_blob_meta(blob_id, tenant=tenant)
 
     # -- persistence ---------------------------------------------------------
 
@@ -288,6 +292,31 @@ class CachedBlob:
         if self._map_dirty:
             self._map_f.flush()
             self._map_dirty = False
+
+    def _prov_fetched(self, flight, n: int) -> None:
+        """Attribute one delivered flight in the provenance ledger
+        (called by the scheduler under self._lock, on the worker thread
+        that ran the fetch). Cause resolution: a plan-time tag override
+        (e.g. the seekable-index build) wins, then a fired hedge race,
+        then the flight's QoS lane. Attribution can degrade (the
+        ``prov.record`` chaos contract) but can never fail the read."""
+        try:
+            notes = fetch_sched.take_fetch_notes()
+            if flight.tag:
+                cause = flight.tag
+            elif notes.get("hedged"):
+                cause = provenance.CAUSE_HEDGE_WINNER
+            else:
+                cause = fetch_sched.LANE_NAMES[flight.priority]
+            provenance.record_fetch(
+                self.blob_id,
+                flight.start,
+                n,
+                cause,
+                tier=str(notes.get("tier", "")),
+            )
+        except Exception:  # noqa: BLE001 — attribution never fails a read
+            logger.debug("provenance record failed", exc_info=True)
 
     # -- eviction survival ---------------------------------------------------
 
@@ -394,6 +423,12 @@ class CachedBlob:
                     self._account_ra_hit_locked(offset, end)
                     if sequential and lane == DEMAND:
                         self._plan_readahead_locked(end)
+                    if lane == DEMAND:
+                        # The read set the provenance waste accounting
+                        # overlays on the attributed extents (peer-serve
+                        # pull-throughs are a remote node's reads, not
+                        # local heat).
+                        provenance.record_read(self.blob_id, offset, size)
                     return os.pread(self._data_fd, size, offset)
                 flights = self.sched.plan_locked(offset, end, priority=lane)
                 if sequential and first_pass and lane == DEMAND:
@@ -429,6 +464,8 @@ class CachedBlob:
                 # holes (the while-loop re-checks under the lock).
                 if self._intervals.covered(offset, end):
                     self._account_ra_hit_locked(offset, end)
+                    if lane == DEMAND:
+                        provenance.record_read(self.blob_id, offset, size)
                     return os.pread(self._data_fd, size, offset)
 
     def covered(self, offset: int, size: int) -> bool:

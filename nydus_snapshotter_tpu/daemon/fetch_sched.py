@@ -53,6 +53,7 @@ import os
 import threading
 from bisect import bisect_left, bisect_right
 from collections import deque
+from contextlib import contextmanager
 from dataclasses import dataclass
 from time import perf_counter
 from typing import Callable, Optional
@@ -234,6 +235,62 @@ HEDGE_TOTAL = _reg.register(
         ("outcome",),
     )
 )
+HEDGE_WASTED_BYTES = _reg.register(
+    _metrics.Counter(
+        "ntpu_peer_hedge_wasted_bytes_total",
+        "Bytes fetched by the losing side of a hedge race and discarded"
+        " (cancelled by accounting — real network cost, zero delivery)",
+    )
+)
+
+
+# -- provenance plumbing (provenance/ledger.py) -----------------------------
+#
+# Two thread-local channels carry attribution context across the planner /
+# worker boundary without the scheduler knowing about the ledger:
+#
+# * ``fetch_tag``: a cause override captured at PLAN time (the planning
+#   thread) and pinned onto every flight it creates — e.g. the seekable-
+#   index build wraps its whole-layer pull in
+#   ``with fetch_tag("soci_index_build")``.
+# * ``fetch_note``: per-fetch annotations set by the WORKER thread while
+#   the fetch runs (the peer fetcher notes the winning tier and whether a
+#   hedge fired) and consumed by the delivery hook on the same thread.
+
+_prov_tls = threading.local()
+
+
+@contextmanager
+def fetch_tag(tag: str):
+    """Scope a provenance cause override onto flights planned within."""
+    prev = getattr(_prov_tls, "tag", None)
+    _prov_tls.tag = tag
+    try:
+        yield
+    finally:
+        _prov_tls.tag = prev
+
+
+def current_fetch_tag():
+    return getattr(_prov_tls, "tag", None)
+
+
+def fetch_note(key: str, value) -> None:
+    """Annotate the in-progress fetch on THIS worker thread."""
+    notes = getattr(_prov_tls, "notes", None)
+    if notes is None:
+        notes = _prov_tls.notes = {}
+    notes[key] = value
+
+
+def take_fetch_notes() -> dict:
+    """Drain this thread's fetch notes (cleared so a note can never leak
+    onto the worker's next flight)."""
+    notes = getattr(_prov_tls, "notes", None)
+    if not notes:
+        return {}
+    _prov_tls.notes = {}
+    return notes
 
 
 class LaneShedError(OSError):
@@ -1137,11 +1194,18 @@ class Hedger:
         hedge: Optional[Callable[[], bytes]] = None,
         tenant: str = DEFAULT_TENANT,
         lane: int = DEMAND,
+        on_loser: Optional[Callable[[str, int], None]] = None,
     ) -> tuple[bytes, str]:
         """Run ``primary()``; past the tier's rolling p99, race
         ``hedge()`` against it. Returns ``(data, winner_tier)``. When
         both sides fail the PRIMARY error propagates, so the caller's
-        tier waterfall degrades exactly as it does unhedged."""
+        tier waterfall degrades exactly as it does unhedged.
+
+        A loser that *successfully* fetched is accounted exactly once —
+        ``ntpu_peer_hedge_wasted_bytes_total`` plus the optional
+        ``on_loser(loser_tier, nbytes)`` callback (the provenance
+        ledger's hedge-loser waste record) — whether its result arrived
+        before or after the winner was chosen."""
         threshold = self.threshold_ms(tier) if self.enabled else None
         t0 = perf_counter()
         if threshold is None or hedge is None:
@@ -1151,6 +1215,19 @@ class Hedger:
 
         cv = threading.Condition()
         results: dict[str, tuple] = {}
+        decided: list[str] = []
+
+        def lost(which: str, nbytes: int) -> None:
+            HEDGE_WASTED_BYTES.inc(nbytes)
+            if on_loser is not None:
+                try:
+                    on_loser(
+                        (hedge_tier or "origin") if which == "hedge"
+                        else tier,
+                        nbytes,
+                    )
+                except Exception:  # noqa: BLE001 — accounting is advisory
+                    pass
 
         def run(which: str, fn, charged: bool) -> None:
             t1 = perf_counter()
@@ -1166,7 +1243,19 @@ class Hedger:
                     self.gate.release(size, tenant=tenant, lane=lane)
             with cv:
                 results[which] = out
+                # Posted after the decision: this side lost the race and
+                # its bytes are about to be discarded. (Posted before the
+                # decision, the winner's path does this accounting — the
+                # cv serializes the two, so exactly one side counts it.)
+                late_loss = (
+                    bool(decided)
+                    and decided[0] != which
+                    and out[2] is None
+                    and out[0] is not None
+                )
                 cv.notify_all()
+            if late_loss:
+                lost(which, len(out[0]))
 
         threading.Thread(
             target=run,
@@ -1191,6 +1280,9 @@ class Hedger:
                 hedged = False  # hedge, never the primary
             if hedged:
                 self._count("fired")
+                # Provenance: the bytes this flight delivers came out of
+                # a hedge race (cause hedge_winner, whichever side wins).
+                fetch_note("hedged", True)
                 threading.Thread(
                     target=run,
                     args=("hedge", hedge, True),
@@ -1216,6 +1308,14 @@ class Hedger:
                     win_tier = tier if which == "primary" else (
                         hedge_tier or "origin"
                     )
+                    other = "primary" if which == "hedge" else "hedge"
+                    with cv:
+                        decided.append(which)
+                        o = results.get(other) if hedged else None
+                    if o is not None and o[2] is None and o[0] is not None:
+                        # The loser had already posted a good result when
+                        # the race was decided: its bytes are waste.
+                        lost(other, len(o[0]))
                     # Only the DELIVERED latency enters the rolling
                     # window: a cancelled loser's eventual completion
                     # was never observed by the caller, and recording
@@ -1274,7 +1374,10 @@ def hedge_counters() -> dict:
 class Flight:
     """One in-flight ranged fetch covering ``[start, end)``."""
 
-    __slots__ = ("start", "end", "priority", "coalesced", "done", "error", "ctx")
+    __slots__ = (
+        "start", "end", "priority", "coalesced", "done", "error", "ctx",
+        "tag",
+    )
 
     def __init__(self, start: int, end: int, priority: int, coalesced: int = 1):
         self.start = start
@@ -1287,6 +1390,9 @@ class Flight:
         # background readahead fetch thereby records which trace spawned
         # it, even though it executes on a worker thread later.
         self.ctx = None
+        # Provenance cause override captured at plan time (fetch_tag),
+        # carried the same way the trace context is.
+        self.tag: Optional[str] = None
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.done.wait(timeout)
@@ -1314,6 +1420,7 @@ class FetchScheduler:
         name: str = "",
         gate: Optional[AdmissionGate] = None,
         tenant: str = DEFAULT_TENANT,
+        on_fetched: Optional[Callable[["Flight", int], None]] = None,
     ):
         self.cfg = config or resolve_config()
         # QoS admission: an explicit gate wins; an explicit budget gets a
@@ -1333,6 +1440,9 @@ class FetchScheduler:
         self._intervals = intervals
         self._fetch_range = fetch_range
         self._deliver = deliver
+        # Called under the shared lock right after a delivery, with the
+        # flight and its byte count — the provenance attribution hook.
+        self._on_fetched = on_fetched
         self._flights: list[Flight] = []  # active (queued or fetching)
         # One FIFO per priority lane, popped in lane order.
         self._queues: tuple[deque[Flight], ...] = tuple(
@@ -1375,8 +1485,10 @@ class FetchScheduler:
                 gaps.append((pos, e))
         new = self._coalesce(gaps, priority)
         ctx = trace.capture() if new else None
+        tag = current_fetch_tag() if new else None
         for f in new:
             f.ctx = ctx
+            f.tag = tag
             self._flights.append(f)
             self._queues[f.priority].append(f)
         if new:
@@ -1464,6 +1576,7 @@ class FetchScheduler:
                     sp.annotate(admission_wait_ms=round(waited * 1000.0, 3))
                 INFLIGHT_BYTES.set(self.budget.held)
                 failpoint.hit("blobcache.fetch")
+                take_fetch_notes()  # a prior flight's notes never leak in
                 data = self._fetch_range(flight.start, n)
                 FETCH_REQUESTS.inc()
                 if flight.coalesced > 1:
@@ -1472,6 +1585,11 @@ class FetchScheduler:
                 with self._lock:
                     if not self._closed:
                         self._deliver(flight.start, data)
+                        if self._on_fetched is not None:
+                            # Attribution hook: same thread as the fetch
+                            # (fetch notes are still this thread's), same
+                            # lock as the delivery.
+                            self._on_fetched(flight, len(data))
             except BaseException as e:  # noqa: BLE001 — surfaced to waiters
                 flight.error = e if isinstance(e, Exception) else OSError(str(e))
                 sp.annotate(error=repr(flight.error))

@@ -1453,6 +1453,19 @@ class PeerAwareFetcher:
             return tier, self._peer_read(addr, tier, offset, size)
         return TIER_ORIGIN, lambda: self.origin_fetch(offset, size)
 
+    def _record_hedge_loss(self, offset: int):
+        """on_loser callback for the hedger: a cancelled-by-accounting
+        loser's bytes enter the provenance ledger as pure waste (they
+        crossed the network but were never delivered to any cache)."""
+        from nydus_snapshotter_tpu.provenance import ledger as provenance
+
+        def on_loser(loser_tier: str, nbytes: int) -> None:
+            provenance.record_hedge_loss(
+                self.blob_id, offset, nbytes, tier=loser_tier
+            )
+
+        return on_loser
+
     def read_range(self, offset: int, size: int) -> bytes:
         tiers = self.router.routes(self.blob_id, offset)
         for i, (addr, tier) in enumerate(tiers):
@@ -1496,6 +1509,7 @@ class PeerAwareFetcher:
                             hedge_tier,
                             hedge_fn,
                             tenant=self.tenant,
+                            on_loser=self._record_hedge_loss(offset),
                         )
                     else:
                         data, winner = primary(), tier
@@ -1503,6 +1517,9 @@ class PeerAwareFetcher:
                     if winner != TIER_ORIGIN:
                         FETCH_BYTES.inc(size)
                     TIER_EGRESS.labels(winner).inc(size)
+                    # Provenance: the delivery hook on this same worker
+                    # thread attributes these bytes to the serving tier.
+                    fetch_sched.fetch_note("tier", winner)
                     sp.annotate(outcome="hit", tier=winner)
                     return data
                 except Exception as e:  # noqa: BLE001 — any peer failure

@@ -134,13 +134,34 @@ class _Instance:
         # CachedBlob.close joins fetch workers; doing that under
         # _reader_lock would deadlock against a worker delivering.
         if cached_blobs:
+            from nydus_snapshotter_tpu import provenance
             from nydus_snapshotter_tpu.daemon import peer as peer_mod
 
             export = peer_mod.default_export()
+            prov_cfg = provenance.config()
             for cached in cached_blobs:
                 export.unregister(cached.blob_id, cached)
                 export.unregister_soci(cached.blob_id)
                 export.unregister_artifact("zsoci", cached.blob_id)
+                # Heat closed loop: distill this deploy's observed read
+                # heat into the blob's .heat artifact before the cache
+                # closes — the next deploy (here, or a cold neighbour via
+                # the peer artifact plane) prefetches only what this one
+                # actually read. The artifact deliberately STAYS
+                # registered past the unmount: its whole value is to the
+                # next deploy.
+                if prov_cfg.enable and prov_cfg.heat:
+                    cache_dir = os.path.dirname(cached.data_path)
+                    art = provenance.compile_heat(
+                        cached.blob_id, cache_dir,
+                        source_size=cached.blob_size,
+                    )
+                    if art is not None and prov_cfg.replicate:
+                        export.register_artifact(
+                            provenance.ARTIFACT_KIND,
+                            cached.blob_id,
+                            provenance.heat_path(cache_dir, cached.blob_id),
+                        )
         for cached in cached_blobs:
             try:
                 cached.close()
@@ -275,6 +296,15 @@ class _Instance:
                     fetch_remote = lambda: peer_mod.PeerClient(  # noqa: E731
                         owner
                     ).fetch_soci_index(blob_id)
+        from nydus_snapshotter_tpu.daemon import fetch_sched
+
+        def build_pull():
+            # Provenance: the whole-layer pull an index (re)build costs is
+            # its own cause, not "demand" — the tag scope pins it onto
+            # every flight the pull plans.
+            with fetch_sched.fetch_tag("soci_index_build"):
+                return read_at(0, csize)
+
         try:
             index, outcome = soci_blob.load_or_build_index(
                 [d for d in dirs if d],
@@ -283,9 +313,7 @@ class _Instance:
                 # Rebuild-once (evicted/corrupt index) only when the
                 # backend is on: it costs one full pull of the original
                 # blob, written through the chunk cache like any fetch.
-                builder=(
-                    (lambda: read_at(0, csize)) if cfg.enable and csize else None
-                ),
+                builder=(build_pull if cfg.enable and csize else None),
                 fetch_remote=fetch_remote,
                 stride=cfg.stride_bytes,
             )
@@ -299,6 +327,9 @@ class _Instance:
             return None
         stream = soci_blob.SociStreamReader(index, read_at, name=blob_id[:8])
         self._soci_by_index[blob_index] = stream
+        from nydus_snapshotter_tpu import provenance
+
+        provenance.set_blob_meta(blob_id, fmt="soci_gzip")
         # Announce the index itself to the peer tier: one pod's build
         # amortizes across the fleet.
         for d in dirs:
@@ -344,14 +375,18 @@ class _Instance:
                     fetch_remote = lambda: peer_mod.PeerClient(  # noqa: E731
                         owner
                     ).fetch_artifact(zblob.ZSOCI_ARTIFACT_KIND, blob_id)
+        from nydus_snapshotter_tpu.daemon import fetch_sched
+
+        def build_pull():
+            with fetch_sched.fetch_tag("soci_index_build"):
+                return read_at(0, csize)
+
         try:
             index, outcome = zblob.load_or_build_zindex(
                 [d for d in dirs if d],
                 blob_id,
                 csize=csize,
-                builder=(
-                    (lambda: read_at(0, csize)) if cfg.enable and csize else None
-                ),
+                builder=(build_pull if cfg.enable and csize else None),
                 fetch_remote=fetch_remote,
             )
         except Exception:  # noqa: BLE001 — incl. an armed soci.index
@@ -364,6 +399,9 @@ class _Instance:
             return None
         stream = zblob.ZstdStreamReader(index, read_at, name=blob_id[:8])
         self._soci_by_index[blob_index] = stream
+        from nydus_snapshotter_tpu import provenance
+
+        provenance.set_blob_meta(blob_id, fmt="soci_zstd")
         # Announce the index to the peer tier under the generic artifact
         # plane: one pod's build amortizes across the fleet.
         for d in dirs:
@@ -397,8 +435,15 @@ class _Instance:
         from nydus_snapshotter_tpu.daemon.fetch_sched import PrefetchReplayer
 
         blob_dir = self.blob_dir(default_blob_dir)
+        heat_covered: set = set()
 
         def warm_chunk(rec) -> int:
+            if rec.blob_index in heat_covered:
+                # This blob was already warmed from its .heat artifact —
+                # replaying its bootstrap chunks on top would re-warm
+                # exactly the speculative bytes the heat loop exists to
+                # avoid fetching.
+                return 0
             from nydus_snapshotter_tpu.converter.zran import (
                 CHUNK_FLAG_GZIP_STREAM,
             )
@@ -460,6 +505,10 @@ class _Instance:
         )
         self._replayer = replayer
         try:
+            # Heat-closed-loop arm first: blobs with a .heat artifact are
+            # warmed in observed-read order under the byte budget and
+            # their bootstrap records drop out of the replay below.
+            heat_covered.update(self._prefetch_via_heat(replayer, blob_dir))
             paths = list(self.bootstrap.prefetch) + list(extra_paths or ())
             # Index-mapped paths warm straight from the soci file→extent
             # table (and accrue into replayer.warmed_bytes); the replay
@@ -469,6 +518,87 @@ class _Instance:
         finally:
             flush_maps()
             self._replayer = None
+
+    def _prefetch_via_heat(self, replayer, blob_dir: str) -> set:
+        """The heat-closed-loop prefetch arm: a blob with a valid
+        ``.heat`` artifact (compiled by a previous deploy's close here,
+        or adopted from the blob's peer-tier region owner) is warmed in
+        observed first-touch order under the ``[provenance]`` byte
+        budget INSTEAD of walking its bootstrap chunk list — the second
+        deploy prefetches only what the first one actually read.
+        Returns the covered blob indexes (their bootstrap records are
+        skipped by ``warm_chunk``). Heat is a hint: any failure here
+        degrades to the bootstrap-order replay the daemon always had."""
+        from nydus_snapshotter_tpu import provenance
+        from nydus_snapshotter_tpu.daemon import fetch_sched, peer as peer_mod
+
+        covered: set = set()
+        cfg = provenance.config()
+        if not (cfg.enable and cfg.heat):
+            return covered
+        budget = max(0, cfg.heat_budget_mib) << 20
+        router = peer_mod.default_router()
+        for blob_index in range(len(self.bootstrap.blobs)):
+            if replayer.cancelled or budget <= 0:
+                break
+            try:
+                self._reader(blob_index, blob_dir)
+            except Exception:  # noqa: BLE001 — heat is advisory
+                continue
+            cached = self._cached_by_index.get(blob_index)
+            if cached is None:
+                continue
+            blob_id = cached.blob_id
+            cache_dir = os.path.dirname(cached.data_path)
+            fetch_remote = None
+            if cfg.replicate and router is not None:
+                owner = router.route(blob_id, 0)
+                if owner is not None:
+                    fetch_remote = lambda _o=owner, _b=blob_id: (  # noqa: E731
+                        peer_mod.PeerClient(_o).fetch_artifact(
+                            provenance.ARTIFACT_KIND, _b
+                        )
+                    )
+            art = provenance.load_or_adopt_heat(
+                [cache_dir, blob_dir],
+                blob_id,
+                source_size=cached.blob_size,
+                fetch_remote=fetch_remote,
+            )
+            if art is None or not art.extents:
+                continue
+            covered.add(blob_index)
+            # Re-announce on the peer artifact plane (an adopted artifact
+            # makes this node a serving replica too).
+            if cfg.replicate:
+                peer_mod.default_export().register_artifact(
+                    provenance.ARTIFACT_KIND, blob_id,
+                    provenance.heat_path(cache_dir, blob_id),
+                )
+            warmed = 0
+            for off, size in art.extents:
+                if replayer.cancelled:
+                    return covered
+                if budget <= 0:
+                    break
+                flights = cached.warm(off, size)
+                for f in flights:
+                    while not f.wait(0.1):
+                        if replayer.cancelled:
+                            return covered
+                budget -= size
+                if all(f.error is None for f in flights):
+                    warmed += size
+            if warmed:
+                self.prefetched_bytes += warmed
+                replayer.warmed_bytes += warmed
+                replayer.files_replayed += 1
+                fetch_sched.PREFETCH_BYTES.inc(warmed)
+            logger.info(
+                "heat prefetch for %s: %d extents, %d bytes warmed",
+                blob_id[:12], len(art.extents), warmed,
+            )
+        return covered
 
     def _prefetch_via_soci_index(self, paths: list, replayer) -> list:
         """The soci index as a prefetch-trace source: paths the mounted
@@ -782,6 +912,35 @@ class DaemonServer:
                     self._reply(200, body)
                 elif u.path == "/api/v1/traces":
                     self._reply(200, trace.chrome_trace())
+                elif u.path == "/api/v1/provenance":
+                    # Byte-provenance accounting (provenance/ledger.py):
+                    # ?blob= narrows to one blob, ?waterfall=1 returns the
+                    # time-ordered cause breakdown joined to trace ids.
+                    from nydus_snapshotter_tpu import provenance
+
+                    blob = q.get("blob", [""])[0]
+                    if q.get("waterfall", ["0"])[0] not in ("", "0"):
+                        limit = int(q.get("limit", ["0"])[0] or 0)
+                        self._reply(
+                            200,
+                            {
+                                "waterfall": provenance.waterfall(
+                                    blob, limit=limit
+                                ),
+                                "heat": provenance.heat_counters(),
+                            },
+                        )
+                    elif blob:
+                        view = provenance.blob_snapshot(blob)
+                        if view is None:
+                            self._reply(404, {"error": f"no ledger for {blob}"})
+                        else:
+                            view["conservation"] = provenance.conservation(blob)
+                            self._reply(200, view)
+                    else:
+                        body = provenance.snapshot()
+                        body["heat"] = provenance.heat_counters()
+                        self._reply(200, body)
                 elif u.path in ("/metrics", "/v1/metrics"):
                     # Prometheus text exposition of this daemon process's
                     # registry — the fleet federator's per-member scrape

@@ -111,6 +111,9 @@ KNOWN_SITES = (
     "compress.batch",        # converter/codec.py batched encode entry
     "peer.tier",             # daemon/peer.py per-tier waterfall attempt entry
     "peer.hedge",            # daemon/fetch_sched.py hedged second-request launch
+    "prov.record",           # provenance/ledger.py per-extent attribution record
+    "prov.compile",          # provenance/heat.py .heat compile/persist boundary
+    "prov.adopt",            # provenance/heat.py peer heat-artifact adoption
 )
 
 _lock = _an.make_lock("failpoint.table")

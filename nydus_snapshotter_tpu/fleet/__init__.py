@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
+from nydus_snapshotter_tpu import failpoint
 from nydus_snapshotter_tpu.analysis import runtime as _an
 from nydus_snapshotter_tpu.metrics import federation as _fed
 from nydus_snapshotter_tpu.metrics import registry as _metrics
@@ -40,6 +41,7 @@ from nydus_snapshotter_tpu.utils import udshttp
 logger = logging.getLogger(__name__)
 
 MEMBERS_PATH = "/api/v1/fleet/members"
+PROVENANCE_PATH = "/api/v1/provenance"
 
 __all__ = [
     "FleetPlane",
@@ -391,12 +393,69 @@ class FleetPlane:
                 if self.scaleup is not None:
                     status["scaleup"] = self.scaleup.state()
                 return self._json(status)
+            if route == "/api/v1/fleet/provenance":
+                return self._json(self.collect_provenance())
             if route == "/api/v1/fleet/peers":
                 return self._json(self.peer_listing())
             return self._json({"message": "no such endpoint"}, 404)
         except Exception as e:  # noqa: BLE001 — the serve loop stays up
             logger.exception("fleet route %s failed", route)
             return self._json({"message": str(e)}, 500)
+
+    def collect_provenance(self) -> dict:
+        """Every member's ``/api/v1/provenance`` snapshot joined into one
+        fleet view: per-node snapshots plus a cluster-wide cause rollup.
+        Same degradation contract as the trace collector — a member that
+        dies mid-pull is counted and skipped, the view still serves."""
+        t0 = time.perf_counter()
+        nodes: dict[str, dict] = {}
+        errors = 0
+        for member in self.registry.members():
+            try:
+                failpoint.hit("fleet.collect")
+                if member.local:
+                    from nydus_snapshotter_tpu.provenance import (
+                        heat_counters,
+                        snapshot as _prov_snapshot,
+                    )
+
+                    snap = dict(_prov_snapshot(), heat=heat_counters())
+                else:
+                    snap = udshttp.get_json(
+                        member.address, PROVENANCE_PATH, timeout=5.0
+                    )
+                nodes[member.name] = snap
+            except Exception as e:  # noqa: BLE001 — degradation is the contract
+                errors += 1
+                _fed.FLEET_SCRAPE_ERRORS.labels(member.name).inc()
+                logger.warning(
+                    "fleet provenance pull of %s failed: %s", member.name, e
+                )
+        causes: dict[str, dict] = {}
+        totals = {"fetched_bytes": 0, "read_bytes": 0, "untagged_bytes": 0}
+        for snap in nodes.values():
+            for key in totals:
+                totals[key] += int(snap.get(key, 0) or 0)
+            for cause, c in (snap.get("causes") or {}).items():
+                agg = causes.setdefault(
+                    cause, {"bytes": 0, "read_bytes": 0, "wasted_bytes": 0}
+                )
+                for key in agg:
+                    agg[key] += int(c.get(key, 0) or 0)
+        for agg in causes.values():
+            agg["accuracy"] = (
+                round(agg["read_bytes"] / agg["bytes"], 4) if agg["bytes"] else 1.0
+            )
+        return {
+            "nodes": nodes,
+            "causes": dict(sorted(causes.items())),
+            **totals,
+            "fleet": {
+                "members": len(nodes),
+                "errors": errors,
+                "collect_ms": round((time.perf_counter() - t0) * 1000.0, 3),
+            },
+        }
 
     def peer_listing(self) -> list[dict]:
         """Dynamic peer discovery: every member with a peer serve address

@@ -109,6 +109,14 @@ MetricsCollectionErrors = reg.register(Counter(
     "visible here instead of only in the log.",
     ("collector",),
 ))
+CollectorSeconds = reg.register(Histogram(
+    "ntpu_metrics_collector_seconds",
+    "Wall time of one collector round, per collector — a collector "
+    "sliding toward the federation deadline is visible here before it "
+    "wedges a scrape round.",
+    ("collector",),
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0),
+))
 
 # -- request tracing ----------------------------------------------------------
 # (ntpu_trace_* counters are registered by trace/ and trace/ring.py; listed

@@ -88,16 +88,24 @@ class MetricsServer:
         # Per-collector isolation: one failing collector must not skip the
         # remaining ones, and each failure is counted per collector so a
         # broken collector is visible on the exposition, not just the log.
+        # Each round is also timed per collector: a collector sliding
+        # toward the federation deadline shows up in
+        # ntpu_metrics_collector_seconds long before it wedges a round.
         for name, c in (
             ("snapshotter", self.sn_collector),
             ("fs", self.fs_collector),
             ("daemon", self.daemon_collector),
         ):
+            t0 = time.perf_counter()
             try:
                 c.collect()
             except Exception:
                 data.MetricsCollectionErrors.labels(name).inc()
                 logger.exception("metrics collection failed (collector=%s)", name)
+            finally:
+                data.CollectorSeconds.labels(name).observe(
+                    time.perf_counter() - t0
+                )
 
     def _collect_loop(self) -> None:
         while not self._stop.wait(self._collect_interval):
@@ -105,11 +113,16 @@ class MetricsServer:
 
     def _inflight_loop(self) -> None:
         while not self._stop.wait(self._inflight_interval):
+            t0 = time.perf_counter()
             try:
                 self.inflight_collector.collect()
             except Exception:
                 data.MetricsCollectionErrors.labels("inflight").inc()
                 logger.exception("inflight metrics collection failed")
+            finally:
+                data.CollectorSeconds.labels("inflight").observe(
+                    time.perf_counter() - t0
+                )
 
     def start_collecting(self) -> None:
         for fn in (self._collect_loop, self._inflight_loop):

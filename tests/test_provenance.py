@@ -1,0 +1,390 @@
+"""Byte-provenance plane (provenance/): ledger attribution with the
+byte-exact conservation invariant, waste/accuracy accounting, the
+cold-start waterfall, the ``.heat`` artifact lifecycle (torn-write
+discipline, corrupt-delete-rebuild, peer adoption), hedge-loser waste
+surfacing, per-collector scrape timing, fleet federation, and chaos at
+the ``prov.record`` / ``prov.compile`` / ``prov.adopt`` sites.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import pytest
+
+from nydus_snapshotter_tpu import failpoint, provenance
+from nydus_snapshotter_tpu.daemon import fetch_sched
+from nydus_snapshotter_tpu.daemon.blobcache import CachedBlob
+from nydus_snapshotter_tpu.daemon.fetch_sched import FetchConfig
+from nydus_snapshotter_tpu.metrics import data
+from nydus_snapshotter_tpu.provenance import heat as heat_mod
+from nydus_snapshotter_tpu.provenance import ledger as ledger_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane(monkeypatch):
+    failpoint.clear()
+    provenance.reset()
+    provenance.invalidate_config()
+    yield
+    failpoint.clear()
+    provenance.reset()
+    provenance.invalidate_config()
+
+
+def _blob(n: int, seed: int = 1) -> bytes:
+    import random
+
+    return random.Random(seed).randbytes(n)
+
+
+# ---------------------------------------------------------------------- ledger
+
+
+class TestLedger:
+    def test_attribution_and_conservation_exact(self):
+        provenance.record_fetch("b1", 0, 100, provenance.CAUSE_DEMAND)
+        provenance.record_fetch("b1", 100, 400, provenance.CAUSE_READAHEAD)
+        provenance.record_hedge_loss("b1", 0, 50, tier="zone")
+        provenance.record_read("b1", 0, 100)
+        cons = provenance.conservation("b1")
+        assert cons["exact"]
+        assert cons["delivered_bytes"] == 500
+        assert cons["hedge_lost_bytes"] == 50
+        assert cons["fetched_bytes"] == 550
+        view = provenance.blob_snapshot("b1")
+        assert view["causes"]["demand"]["wasted_bytes"] == 0
+        assert view["causes"]["demand"]["accuracy"] == 1.0
+        assert view["causes"]["readahead"]["wasted_bytes"] == 400
+        assert view["causes"]["readahead"]["accuracy"] == 0.0
+        # Hedge-loser bytes are waste by definition: never delivered,
+        # never readable.
+        assert view["causes"]["hedge_loser"]["wasted_bytes"] == 50
+
+    def test_record_failure_degrades_to_untagged(self):
+        """An armed prov.record never fails the read path: the bytes
+        land as untagged and conservation stays exact."""
+        provenance.record_fetch("b2", 0, 128, provenance.CAUSE_DEMAND)
+        with failpoint.injected("prov.record", "error(OSError:boom)"):
+            provenance.record_fetch("b2", 128, 128, provenance.CAUSE_DEMAND)
+        cons = provenance.conservation("b2")
+        assert cons["exact"]
+        assert cons["untagged_bytes"] == 128
+        assert cons["delivered_bytes"] == 256
+        assert failpoint.counts().get("prov.record") == 1
+
+    def test_disabled_records_nothing(self):
+        with provenance.disabled():
+            provenance.record_fetch("b3", 0, 64, provenance.CAUSE_DEMAND)
+            provenance.record_read("b3", 0, 64)
+        assert provenance.blob_snapshot("b3") is None
+
+    def test_read_first_touch_only(self):
+        provenance.record_fetch("b4", 0, 1000, provenance.CAUSE_PREFETCH)
+        for _ in range(3):
+            provenance.record_read("b4", 0, 500)
+        view = provenance.blob_snapshot("b4")
+        assert view["read_bytes"] == 500
+        assert view["causes"]["prefetch"]["read_bytes"] == 500
+        assert view["causes"]["prefetch"]["wasted_bytes"] == 500
+
+    def test_heat_extents_access_order_coalesced(self):
+        provenance.record_read("b5", 4096, 100)
+        provenance.record_read("b5", 4196, 100)  # adjacent: coalesces
+        provenance.record_read("b5", 0, 64)      # earlier offset, later touch
+        assert provenance.heat_extents("b5") == [(4096, 200), (0, 64)]
+
+    def test_waterfall_rows_time_ordered_with_cause(self):
+        provenance.record_fetch("b6", 0, 10, provenance.CAUSE_DEMAND)
+        provenance.record_fetch("b6", 10, 20, provenance.CAUSE_READAHEAD,
+                                tier="rack")
+        rows = provenance.waterfall("b6")
+        assert [r["cause"] for r in rows] == ["demand", "readahead"]
+        assert rows[0]["t_ms"] <= rows[1]["t_ms"]
+        assert rows[1]["tier"] == "rack"
+        assert provenance.waterfall("b6", limit=1)[0]["cause"] == "readahead"
+
+    def test_event_ring_bounded_by_config(self, monkeypatch):
+        monkeypatch.setenv("NTPU_PROV_EVENTS", "16")
+        provenance.invalidate_config()
+        for i in range(20):
+            provenance.record_fetch("b7", i * 10, 10, provenance.CAUSE_DEMAND)
+        rows = provenance.waterfall("b7")
+        assert len(rows) == 16
+        # Drop-oldest: the surviving rows are the most recent fetches.
+        assert [r["offset"] for r in rows] == [i * 10 for i in range(4, 20)]
+        # Accounting is NOT bounded by the ring.
+        assert provenance.blob_snapshot("b7")["fetched_bytes"] == 200
+
+    def test_snapshot_rollups_and_tenants(self):
+        provenance.set_blob_meta("b8", tenant="team-a", fmt="soci_gzip")
+        provenance.record_fetch("b8", 0, 100, provenance.CAUSE_DEMAND)
+        provenance.record_read("b8", 0, 100)
+        provenance.record_fetch("b9", 0, 300, provenance.CAUSE_PREFETCH,
+                                tier="region")
+        snap = provenance.snapshot()
+        assert snap["causes"]["demand"]["accuracy"] == 1.0
+        assert snap["causes"]["prefetch"]["wasted_bytes"] == 300
+        assert snap["tenants"]["team-a"]["read_bytes"] == 100
+        assert snap["tiers"]["region"] == 300
+        assert snap["fetched_bytes"] == 400
+        b8 = next(b for b in snap["blobs"] if b["blob_id"] == "b8")
+        assert (b8["tenant"], b8["format"]) == ("team-a", "soci_gzip")
+
+    def test_conservation_concurrent_recorders(self):
+        """The lock-striped ledger under 8 recording threads: every byte
+        lands exactly once."""
+        n_threads, per = 8, 200
+
+        def rec(t):
+            for i in range(per):
+                provenance.record_fetch(
+                    f"blob{t % 4}", (t * per + i) * 10, 10,
+                    provenance.CAUSES[i % 4],
+                )
+
+        threads = [threading.Thread(target=rec, args=(t,)) for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = 0
+        for b in range(4):
+            cons = provenance.conservation(f"blob{b}")
+            assert cons["exact"]
+            total += cons["delivered_bytes"]
+        assert total == n_threads * per * 10
+
+
+# -------------------------------------------------------- data-plane wiring
+
+
+class TestCachedBlobWiring:
+    def test_demand_and_readahead_attribution(self, tmp_path):
+        blob = _blob(1 << 20)
+        cb = CachedBlob(
+            str(tmp_path), "aa" * 32, lambda o, s: blob[o : o + s],
+            blob_size=len(blob),
+            config=FetchConfig(fetch_workers=2, merge_gap=0,
+                               readahead=256 * 1024),
+            tenant="t-wired",
+        )
+        try:
+            # Sequential reads trip the readahead window.
+            for i in range(8):
+                assert cb.read_at(i * 4096, 4096) == blob[i * 4096 : (i + 1) * 4096]
+        finally:
+            cb.close()
+        cons = provenance.conservation("aa" * 32)
+        assert cons["exact"]
+        # Independent accounting domains must agree byte-for-byte.
+        assert cons["delivered_bytes"] == cb.remote_bytes
+        view = provenance.blob_snapshot("aa" * 32)
+        assert view["tenant"] == "t-wired"
+        assert view["causes"]["demand"]["bytes"] > 0
+        assert view["causes"].get("readahead", {}).get("bytes", 0) > 0
+
+    def test_fetch_tag_overrides_lane(self, tmp_path):
+        blob = _blob(64 * 1024)
+        cb = CachedBlob(
+            str(tmp_path), "bb" * 32, lambda o, s: blob[o : o + s],
+            blob_size=len(blob),
+            config=FetchConfig(fetch_workers=1, merge_gap=0, readahead=0),
+        )
+        try:
+            with fetch_sched.fetch_tag("soci_index_build"):
+                cb.read_at(0, 8192)
+        finally:
+            cb.close()
+        view = provenance.blob_snapshot("bb" * 32)
+        assert view["causes"]["soci_index_build"]["bytes"] >= 8192
+        assert "demand" not in view["causes"]
+
+    def test_hedge_loser_surfaces_wasted_metric(self):
+        """The losing side of a hedge race is real network cost with
+        zero delivery: ntpu_peer_hedge_wasted_bytes_total and the
+        ledger's hedge_loser cause both account it, exactly once."""
+        import time as _t
+
+        before = fetch_sched.HEDGE_WASTED_BYTES.value()
+        gate = fetch_sched.AdmissionGate(
+            budget=fetch_sched.MemoryBudget(1 << 20), name="prov-hedge"
+        )
+        h = fetch_sched.Hedger(gate=gate, name="prov-hedge")
+        for _ in range(fetch_sched.HEDGE_MIN_SAMPLES + 5):
+            h.record("rack", 1.0)
+
+        def slow_primary():
+            _t.sleep(0.15)
+            return b"P" * 1000
+
+        losses = []
+        data_, winner = h.fetch(
+            1000, "rack", slow_primary, "zone", lambda: b"P" * 1000,
+            on_loser=lambda t, n: losses.append((t, n)),
+        )
+        assert data_ == b"P" * 1000 and winner == "zone"
+        deadline = 100
+        while not losses and deadline:
+            _t.sleep(0.02)
+            deadline -= 1
+        assert losses == [("rack", 1000)]
+        assert fetch_sched.HEDGE_WASTED_BYTES.value() - before == 1000
+
+
+# ------------------------------------------------------------- heat artifact
+
+
+class TestHeatArtifact:
+    def test_round_trip(self, tmp_path):
+        art = heat_mod.HeatArtifact(
+            "cc" * 32, [(0, 4096), (1 << 20, 8192)], source_size=1 << 21
+        )
+        path = heat_mod.heat_path(str(tmp_path), "cc" * 32)
+        art.save(path)
+        back = heat_mod.HeatArtifact.load(
+            path, blob_id="cc" * 32, source_size=1 << 21
+        )
+        assert back.extents == [(0, 4096), (1 << 20, 8192)]
+        assert back.source_size == 1 << 21
+
+    def test_compile_from_ledger(self, tmp_path):
+        provenance.record_read("dd" * 32, 0, 4096)
+        provenance.record_read("dd" * 32, 65536, 4096)
+        art = heat_mod.compile_heat("dd" * 32, str(tmp_path), source_size=123)
+        assert art is not None
+        assert art.extents == [(0, 4096), (65536, 4096)]
+        assert os.path.exists(heat_mod.heat_path(str(tmp_path), "dd" * 32))
+
+    @pytest.mark.parametrize("mutation", ["truncate", "flip", "torn"])
+    def test_corrupt_deleted_then_rebuilt_once(self, tmp_path, mutation):
+        bid = "ee" * 32
+        provenance.record_read(bid, 0, 4096)
+        heat_mod.compile_heat(bid, str(tmp_path))
+        path = heat_mod.heat_path(str(tmp_path), bid)
+        raw = open(path, "rb").read()
+        if mutation == "truncate":
+            open(path, "wb").write(raw[: len(raw) // 2])
+        elif mutation == "flip":
+            open(path, "wb").write(raw[:-1] + bytes([raw[-1] ^ 0xFF]))
+        else:  # torn: payload written, real header never made it
+            open(path, "wb").write(b"\x00" * len(raw))
+        assert heat_mod.find_heat([str(tmp_path)], bid) is None
+        assert not os.path.exists(path), "corrupt artifact must be deleted"
+        # Rebuild once from the still-live ledger.
+        assert heat_mod.compile_heat(bid, str(tmp_path)) is not None
+        assert heat_mod.find_heat([str(tmp_path)], bid) is not None
+
+    def test_stale_source_size_rejected(self, tmp_path):
+        bid = "ff" * 32
+        heat_mod.HeatArtifact(bid, [(0, 10)], source_size=100).save(
+            heat_mod.heat_path(str(tmp_path), bid)
+        )
+        assert heat_mod.find_heat([str(tmp_path)], bid, source_size=200) is None
+        assert not os.path.exists(heat_mod.heat_path(str(tmp_path), bid))
+
+    def test_compile_chaos_degrades_to_none(self, tmp_path):
+        bid = "11" * 32
+        provenance.record_read(bid, 0, 4096)
+        with failpoint.injected("prov.compile", "error(OSError:disk)"):
+            assert heat_mod.compile_heat(bid, str(tmp_path)) is None
+        assert not os.path.exists(heat_mod.heat_path(str(tmp_path), bid))
+        # The failure is an outcome, not an exception.
+        assert heat_mod.heat_counters()["error"] >= 1
+
+    def test_adopt_from_peer_and_adopt_chaos(self, tmp_path):
+        bid = "22" * 32
+        remote = heat_mod.HeatArtifact(bid, [(0, 4096)], source_size=50)
+        raw = remote.to_bytes()
+        with failpoint.injected("prov.adopt", "error(OSError:net)"):
+            assert heat_mod.load_or_adopt_heat(
+                [str(tmp_path)], bid, source_size=50, fetch_remote=lambda: raw
+            ) is None
+        art = heat_mod.load_or_adopt_heat(
+            [str(tmp_path)], bid, source_size=50, fetch_remote=lambda: raw
+        )
+        assert art is not None and art.extents == [(0, 4096)]
+        # Adoption persisted locally: next lookup is a local load.
+        assert os.path.exists(heat_mod.heat_path(str(tmp_path), bid))
+        assert heat_mod.find_heat([str(tmp_path)], bid, source_size=50) is not None
+
+    def test_adopted_garbage_not_trusted(self, tmp_path):
+        bid = "33" * 32
+        art = heat_mod.load_or_adopt_heat(
+            [str(tmp_path)], bid, fetch_remote=lambda: b"garbage-not-a-heat"
+        )
+        assert art is None
+        assert not os.path.exists(heat_mod.heat_path(str(tmp_path), bid))
+
+
+# --------------------------------------------------- collector scrape timing
+
+
+class TestCollectorTiming:
+    def test_collect_once_observes_per_collector_seconds(self, tmp_path):
+        from nydus_snapshotter_tpu.metrics.serve import MetricsServer
+
+        srv = MetricsServer(cache_dir=str(tmp_path))
+        before = dict(data.CollectorSeconds._totals)
+        srv.collect_once()
+        for name in ("snapshotter", "fs", "daemon"):
+            key = (name,)
+            assert data.CollectorSeconds._totals.get(key, 0) \
+                == before.get(key, 0) + 1
+        assert "ntpu_metrics_collector_seconds" in data.CollectorSeconds.render()
+
+    def test_failing_collector_still_timed(self, tmp_path):
+        from nydus_snapshotter_tpu.metrics.serve import MetricsServer
+
+        srv = MetricsServer(cache_dir=str(tmp_path))
+        srv.fs_collector = type("Boom", (), {"collect": lambda self: 1 / 0})()
+        before = data.CollectorSeconds._totals.get(("fs",), 0)
+        err_before = data.MetricsCollectionErrors.value("fs")
+        srv.collect_once()
+        assert data.CollectorSeconds._totals.get(("fs",), 0) == before + 1
+        assert data.MetricsCollectionErrors.value("fs") == err_before + 1
+
+
+# ---------------------------------------------------------- fleet federation
+
+
+class TestFleetFederation:
+    def test_fleet_provenance_route_joins_members(self):
+        import json
+
+        from nydus_snapshotter_tpu import fleet
+
+        provenance.record_fetch("fb" * 32, 0, 256, provenance.CAUSE_DEMAND)
+        provenance.record_read("fb" * 32, 0, 256)
+        plane = fleet.FleetPlane()
+        plane.register_local("n0")
+        status, _ct, body = plane.handle(
+            "GET", "/api/v1/fleet/provenance", {}, b""
+        )
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["fleet"]["members"] == 1 and doc["fleet"]["errors"] == 0
+        assert doc["causes"]["demand"]["bytes"] == 256
+        assert doc["causes"]["demand"]["accuracy"] == 1.0
+        assert "n0" in doc["nodes"]
+
+    def test_member_pull_failure_degrades(self):
+        import json
+
+        from nydus_snapshotter_tpu import fleet
+
+        plane = fleet.FleetPlane()
+        plane.register_local("n0")
+        plane.registry.register(fleet.Member(
+            name="dead", component="daemon", address="/nonexistent.sock",
+            pid=1,
+        ))
+        with failpoint.injected("fleet.collect", "error(OSError:down)%1.0*1"):
+            status, _ct, body = plane.handle(
+                "GET", "/api/v1/fleet/provenance", {}, b""
+            )
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["fleet"]["errors"] >= 1
+        assert doc["fleet"]["members"] >= 1

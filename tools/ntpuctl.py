@@ -13,6 +13,8 @@ that process alone.
     ntpuctl soci                        # seekable-OCI index/read counters
     ntpuctl dict                        # shared chunk-dict namespaces
     ntpuctl slo                         # objectives, budgets, breaches
+    ntpuctl prov                        # byte-provenance waste accounting
+    ntpuctl waterfall                   # cold-start fetch waterfall
     ntpuctl trace 5ce100000001          # one merged cross-process tree
     ntpuctl top                         # scoreboard, refreshed in place
     ntpuctl scenario                    # spec catalog + last storm gates
@@ -684,6 +686,124 @@ def cmd_soak(args) -> int:
     return 0
 
 
+def cmd_prov(args) -> int:
+    """Byte-provenance accounting: why was each byte fetched, and did
+    anyone read it? Against the controller this is the fleet-joined
+    view; a bare member answers with its own ledger."""
+    if args.blob:
+        detail = _get(
+            args.sock, f"/api/v1/provenance?blob={args.blob}", args.timeout
+        )
+        if detail is None:
+            raise CtlError(
+                f"blob {args.blob!r} not in this member's ledger "
+                "(point --sock at the daemon apisock that served it)"
+            )
+        cons = detail.get("conservation", {})
+        rows = [
+            [cause, _fmt_bytes(c["bytes"]), _fmt_bytes(c["read_bytes"]),
+             _fmt_bytes(c["wasted_bytes"]), _fmt_ratio(c.get("accuracy"))]
+            for cause, c in sorted(detail.get("causes", {}).items())
+        ]
+        human = (
+            f"blob {detail.get('blob_id', args.blob)} "
+            f"(tenant {detail.get('tenant') or '-'}, "
+            f"format {detail.get('format') or '-'})\n"
+            + _table(rows, ["CAUSE", "FETCHED", "READ", "WASTED", "ACC%"])
+            + f"\nconservation: fetched {_fmt_bytes(cons.get('fetched_bytes'))}"
+            f" = delivered {_fmt_bytes(cons.get('delivered_bytes'))}"
+            f" + hedge-lost {_fmt_bytes(cons.get('hedge_lost_bytes'))}"
+            f" (untagged {_fmt_bytes(cons.get('untagged_bytes'))}) — "
+            + ("EXACT" if cons.get("exact") else "VIOLATED")
+        )
+        _emit(args, detail, human)
+        return 0
+    snap = _get(args.sock, "/api/v1/fleet/provenance", args.timeout)
+    scope = "fleet"
+    if snap is None:
+        snap = _get(args.sock, "/api/v1/provenance", args.timeout)
+        scope = "member"
+    if snap is None:
+        raise CtlError("no provenance endpoint on this socket "
+                       "(enable [provenance] and point --sock at the "
+                       "controller or a daemon apisock)")
+    rows = [
+        [cause, _fmt_bytes(c["bytes"]), _fmt_bytes(c["read_bytes"]),
+         _fmt_bytes(c["wasted_bytes"]), _fmt_ratio(c.get("accuracy"))]
+        for cause, c in sorted(snap.get("causes", {}).items())
+    ]
+    human = _table(rows, ["CAUSE", "FETCHED", "READ", "WASTED", "ACC%"]) \
+        if rows else "ledger empty"
+    human += (
+        f"\n{scope}: fetched {_fmt_bytes(snap.get('fetched_bytes'))}, "
+        f"read {_fmt_bytes(snap.get('read_bytes'))}, "
+        f"untagged {_fmt_bytes(snap.get('untagged_bytes'))}"
+    )
+    fleet = snap.get("fleet")
+    if fleet:
+        human += (
+            f" ({fleet.get('members', 0)} members, "
+            f"{fleet.get('errors', 0)} pull errors)"
+        )
+    heat = snap.get("heat")
+    if heat:
+        human += "\nheat: " + ", ".join(
+            f"{k} {int(v)}" for k, v in sorted(heat.items()) if v
+        )
+    _emit(args, snap, human)
+    return 0
+
+
+def cmd_waterfall(args) -> int:
+    """Cold-start waterfall: a member's fetches in time order, each row
+    attributed to its cause and joined to the trace that planned it.
+    The ledger is per-member; against the controller, every registered
+    member's waterfall is pulled and printed in its own section."""
+    path = f"/api/v1/provenance?waterfall=1&limit={args.limit}"
+    if args.blob:
+        path += f"&blob={args.blob}"
+    doc = _get(args.sock, path, args.timeout)
+    if doc is not None:
+        sections = [("", doc)]
+    else:
+        members = _get(args.sock, "/api/v1/fleet/members", args.timeout)
+        if members is None:
+            raise CtlError("no provenance endpoint on this socket "
+                           "(point --sock at the controller or a daemon "
+                           "apisock)")
+        sections = []
+        for m in members:
+            mdoc = _get(m.get("address", ""), path, args.timeout)
+            if mdoc is not None:
+                sections.append((m.get("name", "?"), mdoc))
+        if not sections:
+            raise CtlError("no registered member answered the waterfall "
+                           "pull (are the daemons' apisocks reachable?)")
+
+    def render(d: dict) -> str:
+        rows = [
+            [
+                f"{r['t_ms']:.1f}", r["cause"], r["blob_id"][:12],
+                r["offset"], _fmt_bytes(r["bytes"]), r["tier"] or "-",
+                r["trace_id"] or "-",
+            ]
+            for r in d.get("waterfall", ())
+        ]
+        return _table(
+            rows, ["T-MS", "CAUSE", "BLOB", "OFFSET", "BYTES", "TIER", "TRACE"]
+        ) if rows else "no recorded fetches"
+
+    if len(sections) == 1 and not sections[0][0]:
+        _emit(args, sections[0][1], render(sections[0][1]))
+    else:
+        payload = {name: d for name, d in sections}
+        human = "\n\n".join(
+            f"member {name}:\n{render(d)}" for name, d in sections
+        )
+        _emit(args, payload, human)
+    return 0
+
+
 def cmd_top(args) -> int:
     iterations = args.iterations
     n = 0
@@ -747,6 +867,14 @@ def main(argv=None) -> int:
     dct.add_argument("shard", nargs="?", default=None,
                      help="shard index (for demote)")
     sub.add_parser("slo")
+    prov = sub.add_parser("prov")
+    prov.add_argument("blob", nargs="?", default="",
+                      help="optional blob id for the per-blob breakdown")
+    wf = sub.add_parser("waterfall")
+    wf.add_argument("blob", nargs="?", default="",
+                    help="optional blob id filter")
+    wf.add_argument("--limit", type=int, default=64,
+                    help="most recent N rows (0 = all)")
     tr = sub.add_parser("trace")
     tr.add_argument("trace_id")
     top = sub.add_parser("top")
@@ -773,6 +901,8 @@ def main(argv=None) -> int:
         "soci": cmd_soci,
         "dict": cmd_dict,
         "slo": cmd_slo,
+        "prov": cmd_prov,
+        "waterfall": cmd_waterfall,
         "trace": cmd_trace,
         "top": cmd_top,
         "scenario": cmd_scenario,
